@@ -1,0 +1,9 @@
+"""paddlenlp_tpu: a TPU-native large-model development suite.
+
+Brand-new JAX/XLA/Pallas/pjit implementation of the capabilities of
+PaddlePaddle/PaddleNLP (see SURVEY.md for the blueprint).
+"""
+
+__version__ = "0.1.0.dev0"
+
+from . import ops, parallel, transformers, utils  # noqa: F401

@@ -1,0 +1,3 @@
+from .cross_entropy import causal_lm_loss, cross_entropy_with_ignore  # noqa: F401
+from .flash_attention import dot_product_attention, make_causal_mask, make_segment_mask  # noqa: F401
+from .rope import apply_rotary_pos_emb, rope_frequencies  # noqa: F401

@@ -1,0 +1,50 @@
+"""Cross-entropy losses for LM pretraining.
+
+Counterpart of the reference's ``LlamaPretrainingCriterion`` (llama/modeling.py:1777)
++ ``tensor_parallel_utils.py`` parallel cross entropy. Under GSPMD there is no
+separate "parallel" CE module: we keep logits sharded over the tp axis (vocab dim)
+with a sharding constraint and let XLA turn the log-sum-exp + gather into
+reduce-scattered collectives — the reference's fused parallel CE falls out of the
+partitioner.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cross_entropy_with_ignore", "causal_lm_loss"]
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy_with_ignore(
+    logits: jnp.ndarray,  # [..., vocab]
+    labels: jnp.ndarray,  # [...]
+    ignore_index: int = IGNORE_INDEX,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Token-mean CE over non-ignored labels; fp32 accumulation. Returns (loss, n_valid)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    token_loss = jnp.where(valid, lse - picked, 0.0)
+    n_valid = valid.sum()
+    loss = token_loss.sum() / jnp.maximum(n_valid, 1)
+    return loss, n_valid
+
+
+def causal_lm_loss(
+    logits: jnp.ndarray,  # [B, T, vocab]
+    labels: jnp.ndarray,  # [B, T] — already shifted or raw (set shift=True)
+    ignore_index: int = IGNORE_INDEX,
+    shift: bool = False,
+) -> jnp.ndarray:
+    if shift:
+        logits = logits[:, :-1]
+        labels = labels[:, 1:]
+    loss, _ = cross_entropy_with_ignore(logits, labels, ignore_index)
+    return loss

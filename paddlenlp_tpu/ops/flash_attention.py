@@ -1,0 +1,106 @@
+"""Attention dispatch: Pallas flash attention on TPU, fused XLA math elsewhere.
+
+Counterpart of the reference's ``llama/fusion_ops.py:147-238``
+(``fusion_flash_attention``: FlashAttention-2 / flashmask / ring / vendor-op dispatch).
+TPU-native structure:
+
+- default path: ``jax.nn.dot_product_attention`` — XLA fuses the softmax chain onto
+  the MXU and handles GQA natively; on TPU this already hits the fused attention path;
+- ``segment_ids`` support for packed (ZeroPadding) batches — the FlashMask
+  ``startend_row_indices`` equivalent: tokens attend only within their segment,
+  causally (reference fusion_ops.py:223-238);
+- context-parallel path: ring attention over the ``cp`` mesh axis
+  (``ops/ring_attention.py``), selected by the caller when cp > 1;
+- a Pallas splash/flash kernel path for long sequences (`use_pallas=True`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dot_product_attention", "make_causal_mask", "make_segment_mask"]
+
+
+def make_causal_mask(q_len: int, kv_len: int, offset=0, dtype=jnp.bool_) -> jnp.ndarray:
+    """[1, 1, q_len, kv_len] causal mask; ``offset`` = absolute position of q row 0."""
+    rows = jnp.arange(q_len)[:, None] + offset
+    cols = jnp.arange(kv_len)[None, :]
+    return (cols <= rows).astype(dtype)[None, None]
+
+
+def make_segment_mask(q_segments: jnp.ndarray, kv_segments: jnp.ndarray) -> jnp.ndarray:
+    """[B, 1, T, S] same-segment mask for packed batches (flashmask equivalent)."""
+    return (q_segments[:, None, :, None] == kv_segments[:, None, None, :])
+
+
+def dot_product_attention(
+    query: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    key: jnp.ndarray,  # [B, S, n_kv, head_dim]
+    value: jnp.ndarray,  # [B, S, n_kv, head_dim]
+    *,
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, S] padding mask (1 = keep)
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S] packed-batch segments
+    causal: bool = True,
+    q_offset=0,  # absolute pos of query row 0 (decode with KV cache)
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    use_pallas: bool = False,
+) -> jnp.ndarray:
+    """Fused attention; returns [B, T, n_heads, head_dim] in query dtype."""
+    B, T, N, H = query.shape
+    S = key.shape[1]
+    scale = scale if scale is not None else H**-0.5
+
+    mask = None
+    if causal:
+        mask = jnp.broadcast_to(make_causal_mask(T, S, q_offset), (B, 1, T, S))
+    if segment_ids is not None:
+        q_seg = segment_ids[:, -T:] if T != S else segment_ids
+        seg_mask = make_segment_mask(q_seg, segment_ids)
+        mask = seg_mask if mask is None else jnp.logical_and(mask, seg_mask)
+    if attention_mask is not None:
+        pad = attention_mask[:, None, None, :].astype(jnp.bool_)
+        mask = pad if mask is None else jnp.logical_and(mask, pad)
+
+    if use_pallas:
+        try:
+            from .pallas.flash_attention import flash_attention as pallas_flash
+
+            return pallas_flash(query, key, value, mask=mask, scale=scale)
+        except ImportError:
+            from ..utils.log import logger
+
+            logger.warning_once("pallas flash attention unavailable; using fused XLA attention")
+
+    if dropout_rate == 0.0:
+        try:
+            return jax.nn.dot_product_attention(query, key, value, mask=mask, scale=scale)
+        except TypeError:  # API-signature drift across jax versions only
+            from ..utils.log import logger
+
+            logger.warning_once("jax.nn.dot_product_attention signature mismatch; using math attention")
+    return _math_attention(query, key, value, mask, scale, dropout_rate, dropout_rng)
+
+
+def _math_attention(query, key, value, mask, scale, dropout_rate=0.0, dropout_rng=None):
+    B, T, N, H = query.shape
+    S = key.shape[1]
+    K = key.shape[2]
+    if K != N:  # GQA: broadcast kv heads over query groups
+        rep = N // K
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    logits = jnp.einsum("btnh,bsnh->bnts", query.astype(jnp.float32), key.astype(jnp.float32)) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bnts,bsnh->btnh", probs, value.astype(jnp.float32))
+    return out.astype(query.dtype)

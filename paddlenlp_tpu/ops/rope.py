@@ -1,0 +1,89 @@
+"""Rotary position embedding + scaling strategies.
+
+Counterpart of the reference's rotary classes (``llama/modeling.py:402-556``:
+base/NTK/dynamic-NTK/linear/Llama3) and ``long_sequence_strategies/embedding_strategies.py``.
+Tables are computed in fp32 (TPU bf16 mantissa is too short for large positions)
+and applied with the half-rotate convention used by LLaMA-family HF checkpoints.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["rope_frequencies", "apply_rotary_pos_emb", "rotate_half"]
+
+
+def rope_frequencies(
+    head_dim: int,
+    base: float = 10000.0,
+    scaling: Optional[dict] = None,
+) -> np.ndarray:
+    """inv_freq [head_dim//2], with optional rope_scaling dict (HF conventions)."""
+    inv_freq = 1.0 / (base ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if not scaling:
+        return inv_freq.astype(np.float32)
+    rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+    factor = float(scaling.get("factor", 1.0))
+    if rope_type == "linear":
+        inv_freq = inv_freq / factor
+    elif rope_type in ("ntk", "dynamic"):
+        # static NTK-by-parts approximation of dynamic NTK at the scaled context
+        base = base * (factor ** (head_dim / max(head_dim - 2, 1)))
+        inv_freq = 1.0 / (base ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    elif rope_type == "llama3":
+        low_factor = float(scaling.get("low_freq_factor", 1.0))
+        high_factor = float(scaling.get("high_freq_factor", 4.0))
+        orig_ctx = float(scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2 * math.pi / inv_freq
+        low_wl = orig_ctx / low_factor
+        high_wl = orig_ctx / high_factor
+        scaled = inv_freq / factor
+        smooth = (orig_ctx / wavelen - low_factor) / max(high_factor - low_factor, 1e-6)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        blended = (1 - smooth) * scaled + smooth * inv_freq
+        inv_freq = np.where(wavelen > low_wl, scaled, np.where(wavelen < high_wl, inv_freq, blended))
+    elif rope_type == "yarn":
+        # YaRN interpolation (simplified NTK-by-parts with attention temperature folded out)
+        orig_ctx = float(scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+
+        def find_dim(n_rot):
+            return (head_dim * math.log(orig_ctx / (n_rot * 2 * math.pi))) / (2 * math.log(base))
+
+        low = max(math.floor(find_dim(beta_fast)), 0)
+        high = min(math.ceil(find_dim(beta_slow)), head_dim // 2 - 1)
+        ramp = np.clip((np.arange(head_dim // 2) - low) / max(high - low, 1), 0, 1)
+        inv_freq = inv_freq / factor * ramp + inv_freq * (1 - ramp)
+    return inv_freq.astype(np.float32)
+
+
+def rope_tables(position_ids: jnp.ndarray, inv_freq: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin [B, T, head_dim] (half-dim tables tiled to full)."""
+    freqs = position_ids[..., None].astype(jnp.float32) * inv_freq[None, None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_pos_emb(
+    q: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    k: jnp.ndarray,  # [B, T, n_kv, head_dim]
+    cos: jnp.ndarray,  # [B, T, head_dim]
+    sin: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    dtype = q.dtype
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_out = q32 * cos + rotate_half(q32) * sin
+    k_out = k32 * cos + rotate_half(k32) * sin
+    return q_out.astype(dtype), k_out.astype(dtype)

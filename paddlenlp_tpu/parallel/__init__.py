@@ -1,0 +1,11 @@
+from .mesh import MESH_AXES, MeshConfig, create_mesh, mesh_axis_size, use_mesh  # noqa: F401
+from .partition import (  # noqa: F401
+    DEFAULT_LOGICAL_RULES,
+    P,
+    logical_axis_rules,
+    resolve_spec,
+    shard_constraint,
+    shard_params,
+    sharding_tree,
+    spec_tree_from_rules,
+)

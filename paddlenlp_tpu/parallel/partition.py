@@ -1,0 +1,240 @@
+"""Logical-axis partitioning: regex rules -> NamedSharding trees.
+
+This replaces the reference's per-model ``_get_tensor_parallel_mappings`` +
+fleet Column/RowParallelLinear wrappers (``paddlenlp/transformers/conversion_utils.py:352-676``,
+``llama/modeling.py:723-799``): instead of *rewriting modules* per strategy, each
+model declares, once, a list of ``(param-path regex, logical PartitionSpec)`` rules;
+the trainer maps logical axis names to physical mesh axes. The same model code then
+runs dp-only, tp, fsdp, or any hybrid purely by changing the mapping — XLA/GSPMD
+inserts all collectives.
+
+Logical axis vocabulary (superset of t5x/maxtext conventions):
+
+=========== ==========================================================
+``vocab``    embedding/vocab dim        -> tp
+``embed``    model hidden dim           -> fsdp (ZeRO param shard)
+``mlp``      ffn intermediate dim       -> tp
+``heads``    attention heads dim        -> tp
+``kv``       head_dim                   -> None
+``expert``   MoE expert dim             -> ep-bearing axes
+``batch``    activation batch           -> (dp, fsdp)
+``seq``      activation sequence        -> (sep, cp)
+=========== ==========================================================
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+__all__ = [
+    "P",
+    "DEFAULT_LOGICAL_RULES",
+    "resolve_spec",
+    "spec_tree_from_rules",
+    "sharding_tree",
+    "shard_params",
+    "shard_constraint",
+    "batch_spec",
+    "param_path_tree",
+]
+
+PartitionRules = Sequence[Tuple[str, PartitionSpec]]
+
+# logical axis name -> physical mesh axis (or tuple of axes, or None=replicate)
+DEFAULT_LOGICAL_RULES: Dict[str, Any] = {
+    # ---- parameter axes ----
+    "vocab": "tp",
+    "embed": "fsdp",
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "expert": ("dp", "fsdp"),  # expert parallel rides the data axes (reference: use_expert_parallel)
+    "norm": None,
+    "layers": None,  # becomes "pp" when the stacked-layer pipeline path is active
+    # ---- activation axes ----
+    "batch": ("dp", "fsdp"),
+    "seq": ("sep", "cp"),
+    "act_seq": ("sep", "cp"),  # residual-stream seq dim (sequence_parallel adds "tp")
+    "act_seq_attn": ("cp",),  # seq dim inside attention: sep moved onto heads (Ulysses)
+    "act_heads": ("tp", "sep"),
+    "act_kv_heads": ("tp", "sep"),
+    "act_mlp": "tp",
+    "act_vocab": "tp",
+    "act_embed": None,
+}
+
+_thread_rules = __import__("threading").local()
+
+
+class logical_axis_rules:
+    """Context manager overriding logical->physical mapping (e.g. Megatron SP adds
+    ``tp`` to the residual seq axis: ``{"act_seq": ("sep", "cp", "tp")}``)."""
+
+    def __init__(self, overrides: Dict[str, Any]):
+        self.rules = {**DEFAULT_LOGICAL_RULES, **overrides}
+
+    def __enter__(self):
+        self._prev = getattr(_thread_rules, "rules", None)
+        _thread_rules.rules = self.rules
+        return self.rules
+
+    def __exit__(self, *exc):
+        _thread_rules.rules = self._prev
+
+
+def active_logical_rules() -> Dict[str, Any]:
+    return getattr(_thread_rules, "rules", None) or DEFAULT_LOGICAL_RULES
+
+
+def _axes_size(mesh: Optional[Mesh], phys) -> int:
+    if mesh is None:
+        return 1
+    if phys is None:
+        return 1
+    if isinstance(phys, (tuple, list)):
+        out = 1
+        for p in phys:
+            out *= mesh.shape.get(p, 1)
+        return out
+    return mesh.shape.get(phys, 1)
+
+
+def resolve_spec(
+    logical_spec: PartitionSpec,
+    mesh: Optional[Mesh],
+    rules: Optional[Dict[str, Any]] = None,
+    shape: Optional[Tuple[int, ...]] = None,
+) -> PartitionSpec:
+    """Map a logical PartitionSpec to physical mesh axes.
+
+    Axes whose mesh size is 1 are dropped; if ``shape`` is given, axes that do not
+    divide the corresponding dim are dropped (with the same fallback semantics as the
+    reference's GQA ``assign_kv_heads`` escape hatch — replicate rather than crash).
+    """
+    rules = rules or active_logical_rules()
+    out = []
+    used = set()
+    for i, name in enumerate(logical_spec):
+        if name is None:
+            out.append(None)
+            continue
+        phys = rules.get(name, None) if isinstance(name, str) else name
+        if phys is None:
+            out.append(None)
+            continue
+        # drop axes already consumed by an earlier dim (a mesh axis may appear once)
+        if isinstance(phys, (tuple, list)):
+            phys = tuple(p for p in phys if p not in used and mesh is not None and mesh.shape.get(p, 1) > 1)
+            phys = phys if phys else None
+        else:
+            if phys in used or _axes_size(mesh, phys) == 1:
+                phys = None
+        if phys is not None and shape is not None:
+            size = _axes_size(mesh, phys)
+            if shape[i] % size != 0:
+                phys = None
+        if phys is not None:
+            for p in phys if isinstance(phys, tuple) else (phys,):
+                used.add(p)
+        out.append(phys)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def param_path_tree(tree) -> Any:
+    """Pytree of '/'-joined key paths, same structure as ``tree``."""
+
+    def _name(k):
+        if hasattr(k, "key"):
+            return str(k.key)
+        if hasattr(k, "idx"):
+            return str(k.idx)
+        return str(k)
+
+    paths = []
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    for path, _ in leaves:
+        paths.append("/".join(_name(k) for k in path))
+    return jax.tree_util.tree_unflatten(treedef, paths)
+
+
+def spec_tree_from_rules(
+    tree,
+    partition_rules: PartitionRules,
+    mesh: Optional[Mesh] = None,
+    logical_rules: Optional[Dict[str, Any]] = None,
+) -> Any:
+    """Match each param path against the regex rules; produce a PartitionSpec tree."""
+    compiled = [(re.compile(pat), spec) for pat, spec in partition_rules]
+
+    def resolve_one(path, leaf):
+        shape = getattr(leaf, "shape", None)
+        for pat, spec in compiled:
+            if pat.search(path):
+                return resolve_spec(spec, mesh, logical_rules, shape)
+        return PartitionSpec()
+
+    paths = param_path_tree(tree)
+    return jax.tree.map(resolve_one, paths, tree)
+
+
+def sharding_tree(tree, partition_rules: PartitionRules, mesh: Mesh, logical_rules=None):
+    specs = spec_tree_from_rules(tree, partition_rules, mesh, logical_rules)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+
+def shard_params(params, partition_rules: PartitionRules, mesh: Mesh, logical_rules=None):
+    """device_put a param tree according to its rules (host->HBM placement)."""
+    shardings = sharding_tree(params, partition_rules, mesh, logical_rules)
+    return jax.device_put(params, shardings)
+
+
+def shard_constraint(x, logical_spec: PartitionSpec, mesh: Optional[Mesh] = None, logical_rules=None):
+    """``with_sharding_constraint`` that understands logical names; no-op off-mesh."""
+    if mesh is None:
+        mesh = _current_mesh()
+    if mesh is None:
+        return x
+    spec = resolve_spec(logical_spec, mesh, logical_rules, shape=np.shape(x))
+    if all(s is None for s in spec):
+        return x
+    if isinstance(mesh, Mesh):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    # AbstractMesh (from jax.sharding.use_mesh context): bare specs are accepted
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _current_mesh():
+    """Active mesh from the `set_mesh`/`use_mesh` context (concrete preferred)."""
+    try:
+        m = jax.sharding.get_mesh()  # concrete mesh if one was set
+        if m is not None and isinstance(m, Mesh) and not m.empty:
+            return m
+    except Exception:
+        pass
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            return am
+    except Exception:
+        pass
+    try:
+        from jax._src import mesh as mesh_lib
+
+        m = mesh_lib.thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:
+        return None
+
+
+def batch_spec(extra_dims: int = 1) -> PartitionSpec:
+    """Spec for (batch, seq, ...) activations/inputs: batch over data axes, seq over sep/cp."""
+    return PartitionSpec(("dp", "fsdp"), ("sep", "cp"), *([None] * max(0, extra_dims - 2)))

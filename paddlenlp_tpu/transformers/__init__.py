@@ -1,0 +1,16 @@
+from .cache_utils import KVCache, init_cache  # noqa: F401
+from .configuration_utils import LlmMetaConfig, PretrainedConfig  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaForSequenceClassification,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+)
+from .model_outputs import (  # noqa: F401
+    BaseModelOutput,
+    BaseModelOutputWithPast,
+    CausalLMOutputWithPast,
+    ModelOutput,
+)
+from .model_utils import PretrainedModel  # noqa: F401

@@ -1,0 +1,60 @@
+"""Functional KV cache for autoregressive decoding.
+
+The reference grows python lists of past_key_values dynamically
+(``generation/utils.py`` + per-model ``forward``). Dynamic shapes don't compile on
+TPU: the cache here is a static-shape pytree ``[B, max_len, n_kv, head_dim]`` per
+layer plus a scalar write index, updated with ``lax.dynamic_update_slice`` — the
+whole decode loop stays inside one ``jit``/``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KVCache", "init_cache", "update_cache_layer"]
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Per-model cache: stacked-by-layer keys/values + scalar write offset."""
+
+    keys: Any  # tuple over layers of [B, S_max, n_kv, H]
+    values: Any
+    offset: jnp.ndarray  # scalar int32: number of tokens already written
+
+    def __len__(self):
+        return len(self.keys)
+
+
+jax.tree_util.register_dataclass(KVCache, data_fields=["keys", "values", "offset"], meta_fields=[])
+
+
+def init_cache(config, batch_size: int, max_length: int, dtype=jnp.bfloat16) -> KVCache:
+    n_layers = config.num_hidden_layers
+    n_kv = getattr(config, "num_key_value_heads", config.num_attention_heads)
+    head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
+    shape = (batch_size, max_length, n_kv, head_dim)
+    zeros = lambda: jnp.zeros(shape, dtype=dtype)  # noqa: E731
+    return KVCache(
+        keys=tuple(zeros() for _ in range(n_layers)),
+        values=tuple(zeros() for _ in range(n_layers)),
+        offset=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def update_cache_layer(
+    cache: KVCache, layer_idx: int, k: jnp.ndarray, v: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
+    """Write new [B, T, n_kv, H] k/v at the cache offset; return full-cache views."""
+    k_cache = jax.lax.dynamic_update_slice(cache.keys[layer_idx], k.astype(cache.keys[layer_idx].dtype),
+                                           (0, cache.offset.astype(jnp.int32), 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(cache.values[layer_idx], v.astype(cache.values[layer_idx].dtype),
+                                           (0, cache.offset.astype(jnp.int32), 0, 0))
+    keys = cache.keys[:layer_idx] + (k_cache,) + cache.keys[layer_idx + 1 :]
+    values = cache.values[:layer_idx] + (v_cache,) + cache.values[layer_idx + 1 :]
+    new_offset = cache.offset + k.shape[1] if layer_idx == len(cache) - 1 else cache.offset
+    return k_cache, v_cache, KVCache(keys=keys, values=values, offset=new_offset)

@@ -1,0 +1,8 @@
+from .configuration import LlamaConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    LlamaForCausalLM,
+    LlamaForSequenceClassification,
+    LlamaModel,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)

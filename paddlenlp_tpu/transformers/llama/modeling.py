@@ -1,0 +1,424 @@
+"""LLaMA / LLaMA-2 / LLaMA-3, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/llama/modeling.py`` (2071 LoC):
+``LlamaRMSNorm`` :352, rotary classes :402-556, ``LlamaMLP`` :580, ``LlamaAttention``
+:655 (TP head split, GQA, fused qkv, SP swaps), ``LlamaDecoderLayer`` :1122,
+``LlamaModel`` :1440, ``LlamaPretrainingCriterion`` :1777, ``LlamaLMHead`` :1849,
+``LlamaForCausalLM`` :1924.
+
+TPU-first redesign:
+- ONE network definition for every parallelism strategy. The reference swaps modules
+  per strategy (ColumnParallelLinear / RowSequenceParallelLinear / ReshardLayer /
+  modeling_pp.py / modeling_auto.py — four parallel copies of the net). Here the
+  linen module is strategy-free; ``get_partition_rules`` + activation sharding
+  constraints tell GSPMD where tensors live, and XLA inserts the collectives
+  (TP all-reduce, Megatron-SP reduce-scatter/all-gather, Ulysses all-to-all).
+- bf16 compute / fp32 params+norms; RoPE tables in fp32.
+- attention via ``ops.flash_attention`` dispatch (fused XLA or Pallas; ring
+  attention when the ``cp`` mesh axis is active).
+- rematerialization via ``flax.linen.remat`` with XLA-friendly policies instead of
+  the reference's recompute wrappers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
+
+from ...ops.cross_entropy import cross_entropy_with_ignore
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_cache_layer
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast, SequenceClassifierOutput
+from ..model_utils import PretrainedModel
+from .configuration import LlamaConfig
+
+__all__ = [
+    "LlamaRMSNorm",
+    "LlamaMLP",
+    "LlamaAttention",
+    "LlamaDecoderLayer",
+    "LlamaModule",
+    "LlamaModel",
+    "LlamaForCausalLM",
+    "LlamaForSequenceClassification",
+    "LlamaPretrainingCriterion",
+    "LlamaPretrainedModel",
+]
+
+ACT2FN = {
+    "silu": nn.silu,
+    "gelu": nn.gelu,
+    "relu": nn.relu,
+    "gelu_new": partial(nn.gelu, approximate=True),
+    "tanh": jnp.tanh,
+}
+
+
+class LlamaRMSNorm(nn.Module):
+    """RMSNorm in fp32 (reference llama/modeling.py:352; fused rms_norm op fusion_ops.py:119 —
+    on TPU, XLA fuses this chain natively)."""
+
+    dim: int
+    eps: float = 1e-6
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        scale = self.param("scale", nn.initializers.ones, (self.dim,), self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        x32 = x32 * jax.lax.rsqrt(var + self.eps)
+        return (x32 * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _dense(features, use_bias, config, dtype, param_dtype, name):
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=nn.initializers.normal(config.initializer_range),
+        name=name,
+    )
+
+
+class LlamaMLP(nn.Module):
+    """SwiGLU MLP (reference :580). gate/up are column-parallel, down row-parallel —
+    expressed purely via partition rules on the kernels."""
+
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        act = ACT2FN[cfg.hidden_act]
+        gate = _dense(cfg.intermediate_size, cfg.mlp_bias, cfg, self.dtype, self.param_dtype, "gate_proj")(x)
+        up = _dense(cfg.intermediate_size, cfg.mlp_bias, cfg, self.dtype, self.param_dtype, "up_proj")(x)
+        h = act(gate) * up
+        h = shard_constraint(h, P("batch", "seq", "act_mlp"))
+        return _dense(cfg.hidden_size, cfg.mlp_bias, cfg, self.dtype, self.param_dtype, "down_proj")(h)
+
+
+class LlamaAttention(nn.Module):
+    """GQA attention with RoPE (reference :655-1120).
+
+    The reference's TP machinery (head split bookkeeping, ``assign_kv_heads``, fused
+    qkv weights, ReshardQKV for sep parallel) reduces to: project, constrain the
+    heads dim onto the ``tp``(+``sep``) axes, call the attention dispatcher.
+    """
+
+    config: LlamaConfig
+    layer_idx: int = 0
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,
+        position_ids=None,
+        segment_ids=None,
+        cache: Optional[KVCache] = None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        B, T, _ = hidden_states.shape
+        n_heads, n_kv, head_dim = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+        q = _dense(n_heads * head_dim, cfg.attention_bias, cfg, self.dtype, self.param_dtype, "q_proj")(hidden_states)
+        k = _dense(n_kv * head_dim, cfg.attention_bias, cfg, self.dtype, self.param_dtype, "k_proj")(hidden_states)
+        v = _dense(n_kv * head_dim, cfg.attention_bias, cfg, self.dtype, self.param_dtype, "v_proj")(hidden_states)
+        q = q.reshape(B, T, n_heads, head_dim)
+        k = k.reshape(B, T, n_kv, head_dim)
+        v = v.reshape(B, T, n_kv, head_dim)
+        # heads onto tp(+sep): with an active sep axis this constraint IS the Ulysses
+        # seq<->heads all-to-all (reference segment_parallel_utils.py ReshardQKV).
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+
+        if position_ids is None:
+            offset = cache.offset if cache is not None else 0
+            position_ids = jnp.arange(T)[None, :] + offset
+        inv_freq = jnp.asarray(rope_frequencies(head_dim, cfg.rope_theta, cfg.rope_scaling))
+        cos, sin = rope_tables(position_ids, inv_freq)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+        q_offset = 0
+        if cache is not None:
+            q_offset = cache.offset
+            k, v, cache = update_cache_layer(cache, self.layer_idx, k, v)
+
+        dropout_rate = cfg.attention_dropout if not deterministic else 0.0
+        dropout_rng = self.make_rng("dropout") if dropout_rate > 0.0 else None
+        q = checkpoint_name(q, "attn_qkv")
+        k = checkpoint_name(k, "attn_qkv")
+        v = checkpoint_name(v, "attn_qkv")
+        attn_out = dot_product_attention(
+            q,
+            k,
+            v,
+            attention_mask=attention_mask,
+            segment_ids=segment_ids,
+            causal=True,
+            q_offset=q_offset,
+            dropout_rate=dropout_rate,
+            dropout_rng=dropout_rng,
+        )
+        attn_out = checkpoint_name(attn_out, "core_attn")
+        attn_out = attn_out.reshape(B, T, n_heads * head_dim)
+        out = _dense(cfg.hidden_size, cfg.attention_bias, cfg, self.dtype, self.param_dtype, "o_proj")(attn_out)
+        return out, cache
+
+
+class LlamaDecoderLayer(nn.Module):
+    """Pre-norm residual block (reference :1122)."""
+
+    config: LlamaConfig
+    layer_idx: int = 0
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,
+        position_ids=None,
+        segment_ids=None,
+        cache: Optional[KVCache] = None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        residual = hidden_states
+        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="input_layernorm")(hidden_states)
+        attn_out, cache = LlamaAttention(
+            cfg, self.layer_idx, self.dtype, self.param_dtype, name="self_attn"
+        )(h, attention_mask, position_ids, segment_ids, cache, deterministic)
+        h = residual + attn_out
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        residual = h
+        h2 = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="post_attention_layernorm")(h)
+        h2 = LlamaMLP(cfg, self.dtype, self.param_dtype, name="mlp")(h2)
+        h = residual + h2
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return h, cache
+
+
+def _remat_policy(granularity: str):
+    """Map the reference's recompute_granularity (training_args) onto jax.checkpoint
+    policies via named checkpoints tagged inside the attention op:
+
+    - ``full``      recompute the whole decoder layer (save nothing)
+    - ``full_attn`` save everything except attention internals (qkv + core)
+    - ``core_attn`` save everything except the attention core (softmax(qk)v)
+    """
+    if granularity == "full":
+        return None
+    if granularity == "full_attn":
+        return jax.checkpoint_policies.save_anything_except_these_names("attn_qkv", "core_attn")
+    if granularity == "core_attn":
+        return jax.checkpoint_policies.save_anything_except_these_names("core_attn")
+    raise ValueError(f"unknown recompute_granularity {granularity!r}")
+
+
+def _maybe_remat(layer_cls, config):
+    if not getattr(config, "recompute", False):
+        return layer_cls
+    policy = _remat_policy(getattr(config, "recompute_granularity", "full"))
+    # static_argnums counts the bound module as arg 0 -> `deterministic` is arg 6
+    return nn.remat(layer_cls, policy=policy, static_argnums=(6,))
+
+
+class LlamaModule(nn.Module):
+    """Embedding -> N decoder layers -> final norm (reference ``LlamaModel`` :1440)."""
+
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids=None,
+        attention_mask=None,
+        position_ids=None,
+        segment_ids=None,
+        cache: Optional[KVCache] = None,
+        inputs_embeds=None,
+        deterministic: bool = True,
+        output_hidden_states: bool = False,
+        return_dict: bool = True,
+    ):
+        cfg = self.config
+        if inputs_embeds is None:
+            embed = nn.Embed(
+                cfg.vocab_size,
+                cfg.hidden_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                embedding_init=nn.initializers.normal(cfg.initializer_range),
+                name="embed_tokens",
+            )
+            inputs_embeds = embed(input_ids)
+        h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+
+        layer_cls = _maybe_remat(LlamaDecoderLayer, cfg)
+        all_hidden = [] if output_hidden_states else None
+        for i in range(cfg.num_hidden_layers):
+            if output_hidden_states:
+                all_hidden.append(h)
+            h, cache = layer_cls(cfg, i, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                h, attention_mask, position_ids, segment_ids, cache, deterministic
+            )
+        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="norm")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(
+            last_hidden_state=h,
+            past_key_values=cache,
+            hidden_states=tuple(all_hidden) if all_hidden else None,
+        )
+
+
+class LlamaForCausalLMModule(nn.Module):
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids=None,
+        attention_mask=None,
+        position_ids=None,
+        segment_ids=None,
+        cache: Optional[KVCache] = None,
+        inputs_embeds=None,
+        deterministic: bool = True,
+        output_hidden_states: bool = False,
+        return_dict: bool = True,
+    ):
+        cfg = self.config
+        outputs = LlamaModule(cfg, self.dtype, self.param_dtype, name="model")(
+            input_ids,
+            attention_mask,
+            position_ids,
+            segment_ids,
+            cache,
+            inputs_embeds,
+            deterministic,
+            output_hidden_states,
+            True,
+        )
+        h = outputs.last_hidden_state
+        if cfg.tie_word_embeddings:
+            # reference LlamaLMHead with shared weight (modeling_pp.py:361-377 ties them)
+            embedding = self.get_variable("params", "model")["embed_tokens"]["embedding"]
+            logits = h @ embedding.T.astype(self.dtype)
+        else:
+            logits = _dense(cfg.vocab_size, False, cfg, self.dtype, self.param_dtype, "lm_head")(h)
+        # keep logits tp-sharded on vocab: the loss computes on shards
+        # (reference `parallel_matmul` + tensor_parallel_output, modeling.py:176)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(
+            logits=logits,
+            past_key_values=outputs.past_key_values,
+            hidden_states=outputs.hidden_states,
+        )
+
+
+class LlamaForSequenceClassificationModule(nn.Module):
+    config: LlamaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = LlamaModule(cfg, self.dtype, self.param_dtype, name="model")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds, deterministic, False, True
+        )
+        h = outputs.last_hidden_state
+        # pool at the last non-pad token (reference uses sequence end pooling)
+        if attention_mask is not None:
+            last = jnp.maximum(attention_mask.sum(axis=-1).astype(jnp.int32) - 1, 0)
+        else:
+            last = jnp.full((h.shape[0],), h.shape[1] - 1, dtype=jnp.int32)
+        pooled = h[jnp.arange(h.shape[0]), last]
+        logits = _dense(cfg.num_labels, False, cfg, self.dtype, self.param_dtype, "score")(pooled)
+        if not return_dict:
+            return (logits,)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class LlamaPretrainedModel(PretrainedModel):
+    config_class = LlamaConfig
+    base_model_prefix = "model"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        """Logical partition specs per param (reference `_get_tensor_parallel_mappings`
+        llama/modeling.py:1267-1330 — here one table covers tp AND fsdp AND anything else)."""
+        return [
+            (r"embed_tokens/embedding$", P("vocab", "embed")),
+            (r"self_attn/(q_proj|k_proj|v_proj)/kernel$", P("embed", "heads")),
+            (r"self_attn/(q_proj|k_proj|v_proj)/bias$", P("heads")),
+            (r"self_attn/o_proj/kernel$", P("heads", "embed")),
+            (r"mlp/(gate_proj|up_proj)/kernel$", P("embed", "mlp")),
+            (r"mlp/(gate_proj|up_proj)/bias$", P("mlp")),
+            (r"mlp/down_proj/kernel$", P("mlp", "embed")),
+            (r"(lm_head|score)/kernel$", P("embed", "vocab")),
+            (r"(input_layernorm|post_attention_layernorm|norm)/scale$", P()),
+        ]
+
+
+class LlamaModel(LlamaPretrainedModel):
+    module_class = LlamaModule
+
+
+class LlamaForCausalLM(LlamaPretrainedModel):
+    module_class = LlamaForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+    def get_model_flops(self, batch_size: int, seq_length: int) -> float:
+        cfg = self.config
+        n = self.num_parameters()
+        # 6ND for matmuls + 12*L*H*S^2 causal attention term (fwd+bwd, halved for causality)
+        return 6.0 * n * batch_size * seq_length + 6.0 * cfg.num_hidden_layers * cfg.head_dim * \
+            cfg.num_attention_heads * (seq_length**2) * batch_size
+
+
+class LlamaForSequenceClassification(LlamaPretrainedModel):
+    module_class = LlamaForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"score"]
+
+
+class LlamaPretrainingCriterion:
+    """Parallel-CE pretraining loss (reference :1777). Logits stay vocab-sharded;
+    XLA's partitioner builds the reduce across tp shards."""
+
+    def __init__(self, config: LlamaConfig, ignore_index: int = -100):
+        self.config = config
+        self.ignore_index = ignore_index
+
+    def __call__(self, logits, labels):
+        loss, _ = cross_entropy_with_ignore(logits, labels, self.ignore_index)
+        return loss

@@ -1,0 +1,145 @@
+"""Typed model outputs, registered as JAX pytrees.
+
+Counterpart of ``paddlenlp/transformers/model_outputs.py`` (1520 LoC of dataclass
+outputs). The TPU-native twist: every output class is a pytree node so it can flow
+through ``jit`` / ``grad`` / ``shard_map`` boundaries unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "ModelOutput",
+    "BaseModelOutput",
+    "BaseModelOutputWithPast",
+    "BaseModelOutputWithPoolingAndCrossAttentions",
+    "CausalLMOutput",
+    "CausalLMOutputWithPast",
+    "MaskedLMOutput",
+    "SequenceClassifierOutput",
+    "TokenClassifierOutput",
+    "QuestionAnsweringModelOutput",
+    "MoECausalLMOutputWithPast",
+    "Seq2SeqLMOutput",
+]
+
+
+class ModelOutput:
+    """Dataclass base: tuple-like + dict-like access, pytree-registered."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        dataclasses.dataclass(cls)
+        fields = [f.name for f in dataclasses.fields(cls)]
+
+        def flatten(obj):
+            return tuple(getattr(obj, f) for f in fields), None
+
+        def flatten_with_keys(obj):
+            return tuple((jax.tree_util.GetAttrKey(f), getattr(obj, f)) for f in fields), None
+
+        def unflatten(_, children):
+            return cls(**dict(zip(fields, children)))
+
+        jax.tree_util.register_pytree_with_keys(cls, flatten_with_keys, unflatten, flatten)
+
+    def __getitem__(self, k):
+        if isinstance(k, str):
+            return getattr(self, k)
+        return self.to_tuple()[k]
+
+    def get(self, k, default=None):
+        return getattr(self, k, default)
+
+    def keys(self):
+        return [f.name for f in dataclasses.fields(self) if getattr(self, f.name) is not None]
+
+    def to_tuple(self) -> Tuple[Any, ...]:
+        return tuple(getattr(self, f.name) for f in dataclasses.fields(self) if getattr(self, f.name) is not None)
+
+    def __iter__(self):
+        return iter(self.to_tuple())
+
+
+class BaseModelOutput(ModelOutput):
+    last_hidden_state: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class BaseModelOutputWithPast(ModelOutput):
+    last_hidden_state: Any = None
+    past_key_values: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class BaseModelOutputWithPoolingAndCrossAttentions(ModelOutput):
+    last_hidden_state: Any = None
+    pooler_output: Any = None
+    past_key_values: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+    cross_attentions: Optional[Tuple] = None
+
+
+class CausalLMOutput(ModelOutput):
+    logits: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class CausalLMOutputWithPast(ModelOutput):
+    logits: Any = None
+    past_key_values: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class MoECausalLMOutputWithPast(ModelOutput):
+    logits: Any = None
+    past_key_values: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+    router_logits: Optional[Tuple] = None
+    aux_loss: Any = None
+
+
+class MaskedLMOutput(ModelOutput):
+    logits: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class SequenceClassifierOutput(ModelOutput):
+    logits: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class TokenClassifierOutput(ModelOutput):
+    logits: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class QuestionAnsweringModelOutput(ModelOutput):
+    start_logits: Any = None
+    end_logits: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class Seq2SeqLMOutput(ModelOutput):
+    logits: Any = None
+    past_key_values: Any = None
+    decoder_hidden_states: Optional[Tuple] = None
+    decoder_attentions: Optional[Tuple] = None
+    cross_attentions: Optional[Tuple] = None
+    encoder_last_hidden_state: Any = None
+    encoder_hidden_states: Optional[Tuple] = None
+    encoder_attentions: Optional[Tuple] = None

@@ -1,0 +1,12 @@
+from .env import (  # noqa: F401
+    CONFIG_NAME,
+    DATA_HOME,
+    GENERATION_CONFIG_NAME,
+    MODEL_HOME,
+    SAFE_WEIGHTS_INDEX_NAME,
+    SAFE_WEIGHTS_NAME,
+    device_peak_flops,
+    get_env_device,
+)
+from .import_utils import is_package_available  # noqa: F401
+from .log import logger  # noqa: F401

@@ -1,0 +1,89 @@
+"""Checkpoint / artifact resolution.
+
+Counterpart of ``paddlenlp/utils/downloader.py`` + ``paddlenlp/utils/download/``:
+the reference resolves model names against BOS / HF hub / aistudio / modelscope.
+This build resolves, in order:
+
+1. a local directory path,
+2. the local framework cache (``MODEL_HOME/<name>``),
+3. the HuggingFace hub via ``huggingface_hub`` **if network access is available**
+   (gated — zero-egress environments skip it cleanly).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+from .env import MODEL_HOME
+from .log import logger
+
+__all__ = ["resolve_file", "resolve_model_dir", "get_path_from_url"]
+
+
+def _cache_dir(name: str) -> str:
+    return os.path.join(MODEL_HOME, *name.split("/"))
+
+
+def resolve_model_dir(pretrained_model_name_or_path: str, cache_dir: Optional[str] = None) -> str:
+    """Return a local directory holding the artifacts for ``name``; raise if unresolvable."""
+    name = str(pretrained_model_name_or_path)
+    if os.path.isdir(name):
+        return name
+    local = cache_dir or _cache_dir(name)
+    if os.path.isdir(local):
+        return local
+    raise FileNotFoundError(
+        f"'{name}' is not a local directory and is not present in the cache ({local}). "
+        "Download it with huggingface_hub or place files there manually."
+    )
+
+
+def resolve_file(
+    pretrained_model_name_or_path: str, filename: str, cache_dir: Optional[str] = None, required: bool = True
+) -> Optional[str]:
+    """Resolve one artifact file (config.json, model.safetensors, ...) to a local path."""
+    name = str(pretrained_model_name_or_path)
+    if os.path.isfile(name):
+        return name
+    candidates: List[str] = []
+    if os.path.isdir(name):
+        candidates.append(os.path.join(name, filename))
+    candidates.append(os.path.join(cache_dir or _cache_dir(name), filename))
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    path = _try_hf_hub(name, filename, cache_dir)
+    if path is not None:
+        return path
+    if required:
+        raise FileNotFoundError(f"cannot resolve '{filename}' for '{name}' (searched {candidates})")
+    return None
+
+
+def _try_hf_hub(repo_id: str, filename: str, cache_dir: Optional[str]) -> Optional[str]:
+    if os.environ.get("PDNLP_TPU_OFFLINE", "0") == "1":
+        return None
+    try:
+        from huggingface_hub import hf_hub_download
+
+        return hf_hub_download(repo_id=repo_id, filename=filename, cache_dir=cache_dir)
+    except Exception as e:  # network-less, missing dep, missing file — all non-fatal
+        logger.debug(f"hf hub resolution failed for {repo_id}/{filename}: {e}")
+        return None
+
+
+def get_path_from_url(url: str, root_dir: str) -> str:
+    """Fetch ``url`` into ``root_dir`` (reference: downloader.py:get_path_from_url)."""
+    fname = os.path.join(root_dir, url.split("/")[-1])
+    if os.path.isfile(fname):
+        return fname
+    os.makedirs(root_dir, exist_ok=True)
+    import urllib.request
+
+    tmp = fname + ".tmp"
+    with urllib.request.urlopen(url) as resp, open(tmp, "wb") as f:
+        shutil.copyfileobj(resp, f)
+    os.replace(tmp, fname)
+    return fname

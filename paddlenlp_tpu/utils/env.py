@@ -1,0 +1,92 @@
+"""Cache-dir / environment contract.
+
+Counterpart of ``paddlenlp/utils/env.py`` (MODEL_HOME etc.) and
+``paddlenlp/utils/tools.py::get_env_device``, re-targeted at JAX platforms.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "MODEL_HOME",
+    "DATA_HOME",
+    "PDNLP_TPU_HOME",
+    "get_env_device",
+    "device_peak_flops",
+    "CONFIG_NAME",
+    "GENERATION_CONFIG_NAME",
+    "MODEL_WEIGHTS_NAME",
+    "SAFE_WEIGHTS_NAME",
+    "SAFE_WEIGHTS_INDEX_NAME",
+    "TOKENIZER_CONFIG_NAME",
+    "CHAT_TEMPLATE_NAME",
+]
+
+
+def _get_home() -> str:
+    home = os.environ.get("PDNLP_TPU_HOME")
+    if home is None:
+        home = os.path.join(os.path.expanduser("~"), ".paddlenlp_tpu")
+    return home
+
+
+PDNLP_TPU_HOME = _get_home()
+MODEL_HOME = os.path.join(PDNLP_TPU_HOME, "models")
+DATA_HOME = os.path.join(PDNLP_TPU_HOME, "datasets")
+
+# Canonical artifact filenames (reference: paddlenlp/utils/env.py:55-86).
+CONFIG_NAME = "config.json"
+GENERATION_CONFIG_NAME = "generation_config.json"
+MODEL_WEIGHTS_NAME = "model_weights.msgpack"
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+TOKENIZER_CONFIG_NAME = "tokenizer_config.json"
+CHAT_TEMPLATE_NAME = "chat_template.json"
+
+
+def get_env_device() -> str:
+    """Return the active JAX platform name ("tpu", "cpu", "gpu")."""
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+        # axon tunnels expose TPU devices under a custom platform name.
+        if platform in ("axon",):
+            return "tpu"
+        return platform
+    except Exception:
+        return "cpu"
+
+
+# Peak dense bf16 FLOP/s per chip, for MFU / hardware-TFLOPS metrics
+# (reference computes hardware TFLOPS in trainer_utils.py:351-380 from model flops).
+_PEAK_FLOPS = {
+    "tpu v2": 22.5e12,
+    "tpu v3": 61.25e12,  # per chip (2 cores)
+    "tpu v4": 137.5e12 * 2,
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5": 229.5e12 * 2,  # v5p per chip
+    "tpu v6 lite": 918e12,
+    "a100": 312e12,
+    "h100": 989e12,
+}
+
+
+def device_peak_flops(device=None) -> float:
+    """Best-effort peak bf16 FLOP/s of the attached accelerator."""
+    try:
+        import jax
+
+        if device is None:
+            device = jax.devices()[0]
+        kind = getattr(device, "device_kind", "").lower()
+        for key, val in _PEAK_FLOPS.items():
+            if key in kind:
+                return val
+        if device.platform in ("tpu", "axon"):
+            return 197e12  # conservative default: v5e
+    except Exception:
+        pass
+    return 0.0

@@ -1,0 +1,47 @@
+"""Optional-dependency gating (reference: paddlenlp/utils/import_utils.py)."""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from functools import lru_cache
+
+__all__ = [
+    "is_package_available",
+    "is_tokenizers_available",
+    "is_sentencepiece_available",
+    "is_datasets_available",
+    "is_transformers_available",
+    "is_torch_available",
+]
+
+
+@lru_cache(maxsize=None)
+def is_package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def is_tokenizers_available() -> bool:
+    return is_package_available("tokenizers")
+
+
+def is_sentencepiece_available() -> bool:
+    return is_package_available("sentencepiece")
+
+
+def is_datasets_available() -> bool:
+    return is_package_available("datasets")
+
+
+def is_transformers_available() -> bool:
+    return is_package_available("transformers")
+
+
+def is_torch_available() -> bool:
+    return is_package_available("torch")
+
+
+def require(name: str, hint: str = ""):
+    if not is_package_available(name):
+        raise ImportError(f"`{name}` is required for this feature. {hint}")
+    return importlib.import_module(name)

@@ -1,0 +1,123 @@
+"""Colored, rank-aware logging.
+
+TPU-native counterpart of the reference's ``paddlenlp/utils/log.py`` (colorlog-based
+singleton logger). Here rank-awareness comes from ``jax.process_index()`` instead of
+``paddle.distributed`` env vars; only process 0 logs at INFO by default.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+import threading
+import time
+
+__all__ = ["logger"]
+
+_COLORS = {
+    "DEBUG": "\033[35m",  # purple
+    "INFO": "\033[32m",  # green
+    "WARNING": "\033[33m",  # yellow
+    "ERROR": "\033[31m",  # red
+    "CRITICAL": "\033[31;1m",
+}
+_RESET = "\033[0m"
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time (jax init is expensive and
+    # ordering-sensitive wrt XLA_FLAGS); fall back to env contract.
+    try:
+        import jax
+
+        # jax.process_index() initializes the backend; only call if initialized.
+        if jax._src.xla_bridge._backends:  # noqa: SLF001
+            return jax.process_index()
+    except Exception:
+        pass
+    return int(os.environ.get("JAX_PROCESS_INDEX", os.environ.get("RANK", "0")))
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):  # noqa: A003
+        color = _COLORS.get(record.levelname, "")
+        timestamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(record.created))
+        msg = record.getMessage()
+        if record.exc_info:
+            msg = msg + "\n" + self.formatException(record.exc_info)
+        return f"{color}[{timestamp}] [{record.levelname:>8}]{_RESET} {record.pathname.split('/')[-1]}:{record.lineno} - {msg}"
+
+
+class Logger:
+    """Singleton logger with level context manager, mirroring reference semantics."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __new__(cls, *args, **kwargs):
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __init__(self, name: str = "paddlenlp_tpu"):
+        if getattr(self, "_initialized", False):
+            return
+        self._initialized = True
+        self.logger = logging.getLogger(name)
+        self.logger.propagate = False
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_ColorFormatter())
+        self.logger.addHandler(handler)
+        level = os.environ.get("PDNLP_TPU_LOG_LEVEL", "INFO").upper()
+        self.logger.setLevel(level)
+
+    def _log(self, level: int, msg, *args):
+        if _process_index() != 0 and level < logging.WARNING:
+            return
+        self.logger.log(level, msg, *args, stacklevel=3)
+
+    def debug(self, msg, *args):
+        self._log(logging.DEBUG, msg, *args)
+
+    def info(self, msg, *args):
+        self._log(logging.INFO, msg, *args)
+
+    def warning(self, msg, *args):
+        self._log(logging.WARNING, msg, *args)
+
+    def error(self, msg, *args):
+        self._log(logging.ERROR, msg, *args)
+
+    def critical(self, msg, *args):
+        self._log(logging.CRITICAL, msg, *args)
+
+    @functools.lru_cache(maxsize=None)  # noqa: B019
+    def warning_once(self, msg):
+        self.warning(msg)
+
+    def set_level(self, level: str):
+        self.logger.setLevel(level.upper())
+
+    class _LevelContext:
+        def __init__(self, logger: "Logger", level: str):
+            self._logger = logger
+            self._level = level.upper()
+            self._old = None
+
+        def __enter__(self):
+            self._old = self._logger.logger.level
+            self._logger.logger.setLevel(self._level)
+            return self._logger
+
+        def __exit__(self, *exc):
+            self._logger.logger.setLevel(self._old)
+
+    def processing(self, level: str = "DEBUG"):
+        return Logger._LevelContext(self, level)
+
+
+logger = Logger()
