@@ -1,0 +1,116 @@
+"""Host-side data loading.
+
+Counterpart of ``paddlenlp/data/dist_dataloader.py`` + ``utils/batch_sampler.py``.
+The reference loads data on dataset-replica rank 0 and **broadcasts** batches over
+mp/pp comm groups (dist_dataloader.py:135-205). Under a single-controller JAX
+program there is nothing to broadcast: the host assembles the global batch and
+``device_put`` shards it onto the mesh's data axes. On multi-host, each process
+feeds its addressable shard (``jax.make_array_from_process_local_data``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+__all__ = ["DataLoader", "DistributedBatchSampler"]
+
+
+class DistributedBatchSampler:
+    """Deterministic shuffled batch sampler with ``consumed_samples`` fast-forward
+    for resume (reference utils/batch_sampler.py:22,119-145)."""
+
+    def __init__(
+        self,
+        dataset_len: int,
+        batch_size: int,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+        consumed_samples: int = 0,
+    ):
+        self.dataset_len = dataset_len
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.consumed_samples = consumed_samples
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.dataset_len // self.batch_size
+        return (self.dataset_len + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[List[int]]:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(self.dataset_len)
+        else:
+            order = np.arange(self.dataset_len)
+        start = self.consumed_samples % self.dataset_len if self.consumed_samples else 0
+        order = order[start:]
+        n = len(order)
+        end = n - n % self.batch_size if self.drop_last else n
+        for i in range(0, end, self.batch_size):
+            yield order[i : i + self.batch_size].tolist()
+
+
+class DataLoader:
+    """Minimal map-style loader: sampler + collate into numpy batches."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        collate_fn: Optional[Callable] = None,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+        sampler: Optional[DistributedBatchSampler] = None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _stack_collate
+        if sampler is None and _has_len(dataset):
+            sampler = DistributedBatchSampler(
+                len(dataset), batch_size, shuffle=shuffle, drop_last=drop_last, seed=seed
+            )
+        self.batch_sampler = sampler
+
+    def set_epoch(self, epoch: int):
+        if self.batch_sampler is not None:
+            self.batch_sampler.set_epoch(epoch)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("iterable dataset has no length")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self.batch_sampler is not None:
+            for idx_batch in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idx_batch])
+        else:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+
+
+def _stack_collate(features: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    return {k: np.stack([np.asarray(f[k]) for f in features]) for k in features[0]}
+
+
+def _has_len(x) -> bool:
+    try:
+        len(x)
+        return True
+    except TypeError:
+        return False

@@ -1,0 +1,21 @@
+from .argparser import PdArgumentParser  # noqa: F401
+from .trainer import Trainer, TrainState  # noqa: F401
+from .trainer_callback import (  # noqa: F401
+    DefaultFlowCallback,
+    EarlyStoppingCallback,
+    PrinterCallback,
+    ProgressCallback,
+    TrainerCallback,
+    TrainerControl,
+    TrainerState,
+)
+from .trainer_utils import (  # noqa: F401
+    EvalPrediction,
+    IntervalStrategy,
+    SchedulerType,
+    get_last_checkpoint,
+    get_scheduler,
+    set_seed,
+    speed_metrics,
+)
+from .training_args import TrainingArguments  # noqa: F401

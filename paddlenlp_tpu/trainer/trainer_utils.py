@@ -1,0 +1,176 @@
+"""Trainer utilities: enums, seeding, LR schedules, speed metrics, checkpoint discovery.
+
+Counterpart of ``paddlenlp/trainer/trainer_utils.py`` (seed control :73/:1095,
+``speed_metrics`` incl. tokens/sec/device + hardware TFLOPS :351-380, LR schedulers
+:391-613, checkpoint discovery :259, ``IterableDatasetShard`` :943).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import re
+import time
+from enum import Enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "IntervalStrategy",
+    "SchedulerType",
+    "EvalPrediction",
+    "PredictionOutput",
+    "TrainOutput",
+    "set_seed",
+    "get_scheduler",
+    "speed_metrics",
+    "get_last_checkpoint",
+    "has_length",
+    "seed_worker",
+    "PREFIX_CHECKPOINT_DIR",
+]
+
+PREFIX_CHECKPOINT_DIR = "checkpoint"
+_re_checkpoint = re.compile(r"^" + PREFIX_CHECKPOINT_DIR + r"-(\d+)$")
+
+
+class ExplicitEnum(str, Enum):
+    @classmethod
+    def _missing_(cls, value):
+        raise ValueError(f"{value} is not a valid {cls.__name__}: pick one of {list(cls._value2member_map_)}")
+
+
+class IntervalStrategy(ExplicitEnum):
+    NO = "no"
+    STEPS = "steps"
+    EPOCH = "epoch"
+
+
+class SchedulerType(ExplicitEnum):
+    LINEAR = "linear"
+    COSINE = "cosine"
+    CONSTANT = "constant"
+    CONSTANT_WITH_WARMUP = "constant_with_warmup"
+    POLYNOMIAL = "polynomial"
+
+
+class EvalPrediction:
+    def __init__(self, predictions, label_ids):
+        self.predictions = predictions
+        self.label_ids = label_ids
+
+
+class PredictionOutput:
+    def __init__(self, predictions, label_ids, metrics):
+        self.predictions = predictions
+        self.label_ids = label_ids
+        self.metrics = metrics
+
+
+class TrainOutput:
+    def __init__(self, global_step: int, training_loss: float, metrics: Dict[str, float]):
+        self.global_step = global_step
+        self.training_loss = training_loss
+        self.metrics = metrics
+
+
+def set_seed(seed: int):
+    """Python/numpy seeding; JAX keys derive from fold_in trees (no global jax seed).
+
+    The reference builds per-axis seed trees (``_get_distributed_seeds``,
+    trainer_utils.py:73) so tp ranks share init seeds while dp ranks differ; under
+    GSPMD init runs as ONE logical program, so a single key suffices and per-rank
+    divergence (dropout on dp shards) comes from `jax_threefry_partitionable`
+    splitting the key across the sharded batch.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def seed_worker(worker_id: int, rank: int, seed: int):
+    worker_seed = (seed + rank * 1009 + worker_id) % 2**32
+    np.random.seed(worker_seed)
+    random.seed(worker_seed)
+
+
+def get_scheduler(
+    name,
+    learning_rate: float,
+    num_warmup_steps: int,
+    num_training_steps: int,
+    min_lr: float = 0.0,
+    power: float = 1.0,
+):
+    """Return an optax schedule fn (reference LR zoo trainer_utils.py:391-613)."""
+    import optax
+
+    name = SchedulerType(name) if not isinstance(name, SchedulerType) else name
+    warmup = optax.linear_schedule(0.0, learning_rate, max(num_warmup_steps, 1))
+    decay_steps = max(num_training_steps - num_warmup_steps, 1)
+    if name == SchedulerType.LINEAR:
+        decay = optax.linear_schedule(learning_rate, min_lr, decay_steps)
+    elif name == SchedulerType.COSINE:
+        decay = optax.cosine_decay_schedule(learning_rate, decay_steps, alpha=min_lr / max(learning_rate, 1e-12))
+    elif name == SchedulerType.POLYNOMIAL:
+        decay = optax.polynomial_schedule(learning_rate, min_lr, power, decay_steps)
+    elif name in (SchedulerType.CONSTANT, SchedulerType.CONSTANT_WITH_WARMUP):
+        decay = optax.constant_schedule(learning_rate)
+    else:
+        raise ValueError(f"unknown scheduler {name}")
+    if num_warmup_steps > 0:
+        return optax.join_schedules([warmup, decay], [num_warmup_steps])
+    return decay
+
+
+def speed_metrics(
+    split: str,
+    start_time: float,
+    num_samples: Optional[int] = None,
+    num_steps: Optional[int] = None,
+    num_tokens: Optional[int] = None,
+    model_flops: Optional[float] = None,
+) -> Dict[str, float]:
+    """Throughput metrics incl. the reference's ``*_tokens_per_second_per_device``
+    and ``*_hardware_tflops_per_device`` (trainer_utils.py:351-380)."""
+    import jax
+
+    from ..utils.env import device_peak_flops
+
+    runtime = time.time() - start_time
+    result = {f"{split}_runtime": round(runtime, 4)}
+    if runtime == 0:
+        return result
+    n_dev = max(jax.device_count(), 1)
+    if num_samples is not None:
+        result[f"{split}_samples_per_second"] = round(num_samples / runtime, 3)
+    if num_steps is not None:
+        result[f"{split}_steps_per_second"] = round(num_steps / runtime, 3)
+    if num_tokens is not None:
+        result[f"{split}_tokens_per_second"] = round(num_tokens / runtime, 2)
+        result[f"{split}_tokens_per_second_per_device"] = round(num_tokens / runtime / n_dev, 2)
+    if model_flops is not None:
+        tflops = model_flops / runtime / n_dev / 1e12
+        result[f"{split}_hardware_tflops_per_device"] = round(tflops, 2)
+        peak = device_peak_flops()
+        if peak > 0:
+            result[f"{split}_model_flops_utilization"] = round(model_flops / runtime / n_dev / peak, 4)
+    return result
+
+
+def get_last_checkpoint(folder: str) -> Optional[str]:
+    """Newest ``checkpoint-<step>`` subdir (reference trainer_utils.py:259)."""
+    if not os.path.isdir(folder):
+        return None
+    checkpoints = [d for d in os.listdir(folder) if _re_checkpoint.match(d) and os.path.isdir(os.path.join(folder, d))]
+    if not checkpoints:
+        return None
+    return os.path.join(folder, max(checkpoints, key=lambda d: int(_re_checkpoint.match(d).group(1))))
+
+
+def has_length(dataset) -> bool:
+    try:
+        return len(dataset) is not None
+    except TypeError:
+        return False

@@ -1,0 +1,224 @@
+"""``TrainingArguments`` — the run-configuration surface.
+
+Counterpart of ``paddlenlp/trainer/training_args.py`` (~130 dataclass fields whose
+``__post_init__`` builds a ``fleet.DistributedStrategy`` and calls ``fleet.init``).
+TPU-native: ``__post_init__`` validates and derives a **MeshConfig**; there is no
+process-group plumbing to initialize — the mesh IS the strategy. Sharding stages map:
+
+- stage1/stage2 (optimizer/grad sharding)  -> optimizer state sharded over ``fsdp``,
+  params replicated (``sharding_stage<=2``)
+- stage3 (param sharding / ZeRO-3)         -> params also sharded over ``fsdp``
+
+Field names keep the reference's spelling so the ``llm/config/*.json`` launch
+artifacts translate 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..utils.log import logger
+from .trainer_utils import IntervalStrategy, SchedulerType
+
+__all__ = ["TrainingArguments"]
+
+
+@dataclass
+class TrainingArguments:
+    output_dir: str = field(default="output", metadata={"help": "output directory for checkpoints/logs"})
+    overwrite_output_dir: bool = False
+
+    do_train: bool = False
+    do_eval: bool = False
+    do_predict: bool = False
+
+    per_device_train_batch_size: int = field(default=8, metadata={"help": "per data-parallel-shard batch size"})
+    per_device_eval_batch_size: int = 8
+    gradient_accumulation_steps: int = 1
+
+    learning_rate: float = 5e-5
+    min_learning_rate: float = 0.0
+    weight_decay: float = 0.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    max_grad_norm: float = 1.0
+
+    num_train_epochs: float = 3.0
+    max_steps: int = -1
+    lr_scheduler_type: str = "linear"
+    warmup_ratio: float = 0.0
+    warmup_steps: int = 0
+
+    logging_first_step: bool = False
+    logging_strategy: str = "steps"
+    logging_steps: int = 500
+    evaluation_strategy: str = "no"
+    eval_steps: int = 500
+    save_strategy: str = "steps"
+    save_steps: int = 500
+    save_total_limit: Optional[int] = None
+    resume_from_checkpoint: Optional[str] = None
+
+    seed: int = 42
+    data_seed: Optional[int] = None
+
+    bf16: bool = False
+    fp16: bool = False  # accepted for config compat; mapped to bf16 on TPU
+    amp_master_grad: bool = True  # fp32 params + grads ("O2 + master weights")
+
+    dataloader_drop_last: bool = True
+    dataloader_num_workers: int = 0
+    remove_unused_columns: bool = True
+    label_names: Optional[List[str]] = None
+
+    load_best_model_at_end: bool = False
+    metric_for_best_model: Optional[str] = None
+    greater_is_better: Optional[bool] = None
+    ignore_data_skip: bool = False
+    skip_data_intervals: Optional[List[List[int]]] = None
+
+    run_name: Optional[str] = None
+    report_to: Optional[List[str]] = None
+    disable_tqdm: bool = False
+
+    # ---- parallelism (reference degrees, training_args.py:539-705) ----
+    tensor_parallel_degree: int = 1
+    pipeline_parallel_degree: int = 1
+    sharding_parallel_degree: int = -1
+    sep_parallel_degree: int = 1
+    context_parallel_degree: int = 1
+    sharding: str = field(default="", metadata={"help": '"" | stage1 | stage2 | stage3'})
+    data_parallel_degree: int = -1  # derived
+    use_expert_parallel: bool = False
+    sequence_parallel: bool = False
+    tensor_parallel_output: bool = True
+
+    # ---- model runtime knobs bridged via LlmMetaConfig ----
+    use_flash_attention: bool = True
+    recompute: bool = False
+    recompute_granularity: str = "full"
+    use_scan_layers: bool = True
+
+    # ---- checkpointing ----
+    unified_checkpoint: bool = True
+    async_save: bool = False
+
+    def __post_init__(self):
+        self.logging_strategy = IntervalStrategy(self.logging_strategy)
+        self.evaluation_strategy = IntervalStrategy(self.evaluation_strategy)
+        self.save_strategy = IntervalStrategy(self.save_strategy)
+        self.lr_scheduler_type = SchedulerType(self.lr_scheduler_type)
+        if self.fp16:
+            logger.warning_once("fp16 requested: TPU MXU native dtype is bf16; using bf16")
+            self.bf16, self.fp16 = True, False
+        if self.load_best_model_at_end and self.metric_for_best_model is None:
+            self.metric_for_best_model = "loss"
+        if self.greater_is_better is None and self.metric_for_best_model is not None:
+            self.greater_is_better = not self.metric_for_best_model.endswith("loss")
+        if self.data_seed is None:
+            self.data_seed = self.seed
+        sharding = (self.sharding or "").replace(",", " ").split()
+        self.sharding_stage = 0
+        for s in sharding:
+            if s.startswith("stage"):
+                self.sharding_stage = int(s[5:])
+        if self.sharding_parallel_degree == -1 and self.sharding_stage > 0:
+            self.sharding_parallel_degree = 0  # resolved against device count in mesh()
+        self._mesh = None
+
+    # ------------------------------------------------------------------ topology
+    @property
+    def world_size(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    @property
+    def process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    @property
+    def local_process_index(self) -> int:
+        return self.process_index
+
+    @property
+    def should_save(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def should_log(self) -> bool:
+        return self.process_index == 0
+
+    def mesh(self):
+        """Build (once) the device mesh implied by the parallel degrees."""
+        if self._mesh is None:
+            import jax
+
+            from ..parallel.mesh import MeshConfig, create_mesh
+
+            n = jax.device_count()
+            fixed = self.tensor_parallel_degree * self.pipeline_parallel_degree * \
+                self.sep_parallel_degree * self.context_parallel_degree
+            fsdp = self.sharding_parallel_degree
+            if fsdp in (-1, 0):
+                # absorb everything not taken by other axes into fsdp when sharding
+                # was requested, else into dp
+                fsdp = (n // fixed) if self.sharding_stage > 0 else 1
+            cfg = MeshConfig(
+                dp=-1,
+                fsdp=fsdp,
+                pp=self.pipeline_parallel_degree,
+                sep=self.sep_parallel_degree,
+                cp=self.context_parallel_degree,
+                tp=self.tensor_parallel_degree,
+            ).resolve(n)
+            self.data_parallel_degree = cfg.dp
+            self._mesh = create_mesh(cfg)
+        return self._mesh
+
+    @property
+    def dataset_world_size(self) -> int:
+        """Number of batch shards (dp x fsdp), reference `dataset_world_size`."""
+        m = self.mesh()
+        return m.shape["dp"] * m.shape["fsdp"]
+
+    @property
+    def train_batch_size(self) -> int:
+        return self.per_device_train_batch_size
+
+    @property
+    def eval_batch_size(self) -> int:
+        return self.per_device_eval_batch_size
+
+    @property
+    def global_train_batch_size(self) -> int:
+        return self.per_device_train_batch_size * self.gradient_accumulation_steps * self.dataset_world_size
+
+    @property
+    def global_eval_batch_size(self) -> int:
+        return self.per_device_eval_batch_size * self.dataset_world_size
+
+    def get_warmup_steps(self, num_training_steps: int) -> int:
+        return self.warmup_steps if self.warmup_steps > 0 else math.ceil(num_training_steps * self.warmup_ratio)
+
+    # ------------------------------------------------------------------ io
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k, v in d.items():
+            if isinstance(v, IntervalStrategy) or isinstance(v, SchedulerType):
+                d[k] = v.value
+        return d
+
+    def to_json_string(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=str)
+
+    def __str__(self):
+        return f"TrainingArguments {self.to_json_string()}"

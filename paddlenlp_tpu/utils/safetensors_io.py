@@ -116,10 +116,11 @@ class SafeSlice:
 
     def __getitem__(self, index) -> np.ndarray:
         # memmap-backed: only the touched pages are read from disk.
-        return np.ascontiguousarray(self._view()[index])
+        out = self._view()[index]
+        return np.ascontiguousarray(out).reshape(out.shape)  # keep 0-d as 0-d
 
     def numpy(self) -> np.ndarray:
-        return np.ascontiguousarray(self._view())
+        return self[...]
 
 
 class SafeFile:
@@ -187,12 +188,13 @@ def save_file(tensors: Dict[str, np.ndarray], path: str, metadata: Optional[Dict
     offset = 0
     arrays = {}
     for name, arr in tensors.items():
-        arr = np.ascontiguousarray(arr)
+        orig = np.asarray(arr)
+        arr = np.ascontiguousarray(orig)  # NB: promotes 0-d to 1-d; header keeps orig shape
         arrays[name] = arr
         nbytes = arr.nbytes
         header[name] = {
             "dtype": _encode_dtype(arr.dtype),
-            "shape": list(arr.shape),
+            "shape": list(orig.shape),
             "data_offsets": [offset, offset + nbytes],
         }
         offset += nbytes

@@ -1,0 +1,261 @@
+"""Trainer end-to-end tests: loss decreases, checkpoint resume (incl. topology
+change), callbacks fire, argparser parses JSON configs — mirroring the reference's
+tests/trainer suite at tiny scale."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.trainer import (
+    IntervalStrategy,
+    PdArgumentParser,
+    Trainer,
+    TrainerCallback,
+    TrainingArguments,
+)
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+
+def tiny_model(seed=0):
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    return LlamaForCausalLM.from_config(cfg, seed=seed)
+
+
+class ToyLMDataset:
+    """Deterministic token sequences with a learnable pattern."""
+
+    def __init__(self, n=64, seq_len=16, vocab=128, seed=0):
+        rng = np.random.default_rng(seed)
+        base = rng.integers(2, vocab, size=(8, seq_len))
+        self.data = base[rng.integers(0, 8, size=n)]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        ids = self.data[i].astype(np.int32)
+        return {"input_ids": ids, "labels": ids.copy()}
+
+
+def make_args(tmp_path, **kw):
+    defaults = dict(
+        output_dir=str(tmp_path),
+        per_device_train_batch_size=4,
+        learning_rate=1e-3,
+        max_steps=8,
+        logging_steps=4,
+        save_strategy="no",
+        seed=0,
+    )
+    defaults.update(kw)
+    return TrainingArguments(**defaults)
+
+
+class TestTrainerLoop:
+    def test_loss_decreases(self, tmp_path):
+        model = tiny_model()
+        args = make_args(tmp_path, max_steps=12)
+        trainer = Trainer(model=model, args=args, train_dataset=ToyLMDataset())
+        out = trainer.train()
+        assert out.global_step == 12
+        first_logs = trainer.state.log_history[0]
+        assert out.training_loss < first_logs["loss"], (out.training_loss, first_logs["loss"])
+        assert "train_tokens_per_second_per_device" in out.metrics
+
+    def test_grad_accumulation_matches_big_batch(self, tmp_path):
+        """accum=2 x bs=2 must match bs=4 updates (same data order)."""
+        ds = ToyLMDataset(n=32)
+        m1 = tiny_model()
+        t1 = Trainer(model=m1, args=make_args(tmp_path / "a", max_steps=4,
+                                              per_device_train_batch_size=4), train_dataset=ds)
+        t1.train()
+        m2 = tiny_model()
+        t2 = Trainer(model=m2, args=make_args(tmp_path / "b", max_steps=4,
+                                              per_device_train_batch_size=2,
+                                              gradient_accumulation_steps=2), train_dataset=ds)
+        t2.train()
+        l1 = jax.tree.leaves(t1.train_state.params)
+        l2 = jax.tree.leaves(t2.train_state.params)
+        for a, b in zip(l1, l2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_evaluate(self, tmp_path):
+        model = tiny_model()
+        trainer = Trainer(
+            model=model,
+            args=make_args(tmp_path, max_steps=2),
+            train_dataset=ToyLMDataset(),
+            eval_dataset=ToyLMDataset(n=16, seed=3),
+        )
+        trainer.train()
+        metrics = trainer.evaluate()
+        assert "eval_loss" in metrics and np.isfinite(metrics["eval_loss"])
+
+    def test_callbacks_fire(self, tmp_path):
+        events = []
+
+        class Recorder(TrainerCallback):
+            def on_train_begin(self, args, state, control, **kw):
+                events.append("train_begin")
+
+            def on_step_end(self, args, state, control, **kw):
+                events.append("step_end")
+
+            def on_log(self, args, state, control, **kw):
+                events.append("log")
+
+            def on_train_end(self, args, state, control, **kw):
+                events.append("train_end")
+
+        trainer = Trainer(
+            model=tiny_model(),
+            args=make_args(tmp_path, max_steps=4, logging_steps=2),
+            train_dataset=ToyLMDataset(),
+            callbacks=[Recorder()],
+        )
+        trainer.train()
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert events.count("step_end") == 4
+        assert events.count("log") == 2
+
+
+class TestCheckpointResume:
+    def test_save_and_resume_exact(self, tmp_path):
+        """12 straight steps == 6 steps + save + resume + 6 steps (loss parity)."""
+        ds = ToyLMDataset(n=64)
+        m1 = tiny_model()
+        t1 = Trainer(model=m1, args=make_args(tmp_path / "straight", max_steps=12), train_dataset=ds)
+        t1.train()
+
+        m2 = tiny_model()
+        args2 = make_args(tmp_path / "resume", max_steps=12, save_strategy="steps", save_steps=6)
+        t2 = Trainer(model=m2, args=args2, train_dataset=ds)
+        t2.train()
+        ckpt = os.path.join(str(tmp_path / "resume"), "checkpoint-6")
+        assert os.path.isdir(ckpt)
+
+        m3 = tiny_model(seed=99)  # different init: must be overwritten by the checkpoint
+        args3 = make_args(tmp_path / "resume", max_steps=12, save_strategy="no")
+        t3 = Trainer(model=m3, args=args3, train_dataset=ds)
+        t3.train(resume_from_checkpoint=ckpt)
+        assert t3.state.global_step == 12
+
+        for a, b in zip(jax.tree.leaves(t1.train_state.params), jax.tree.leaves(t3.train_state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_topology_change_resume(self, tmp_path, eight_devices):
+        """Save on dp-only mesh, resume on tp=4 mesh (the reference's N1C8->N2C4
+        unified-checkpoint matrix, re-expressed as mesh change)."""
+        ds = ToyLMDataset(n=64)
+        m1 = tiny_model()
+        args1 = make_args(tmp_path / "src", max_steps=4, save_strategy="steps", save_steps=4)
+        t1 = Trainer(model=m1, args=args1, train_dataset=ds)
+        t1.train()
+        ckpt = os.path.join(str(tmp_path / "src"), "checkpoint-4")
+
+        m2 = tiny_model(seed=5)
+        args2 = make_args(tmp_path / "dst", max_steps=8, tensor_parallel_degree=4)
+        t2 = Trainer(model=m2, args=args2, train_dataset=ds)
+        t2.train(resume_from_checkpoint=ckpt)
+        assert t2.state.global_step == 8
+        # param placement follows the new mesh
+        qk = t2.train_state.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+        assert "tp" in str(qk.sharding.spec)
+
+    def test_rotation(self, tmp_path):
+        args = make_args(tmp_path, max_steps=6, save_strategy="steps", save_steps=2, save_total_limit=2)
+        t = Trainer(model=tiny_model(), args=args, train_dataset=ToyLMDataset())
+        t.train()
+        ckpts = sorted(d for d in os.listdir(tmp_path) if d.startswith("checkpoint-"))
+        assert ckpts == ["checkpoint-4", "checkpoint-6"]
+
+
+class TestShardedTraining:
+    def test_fsdp_tp_loss_parity(self, tmp_path, eight_devices):
+        """fsdp=2 x tp=4 training tracks dp-only training step-for-step.
+
+        SGD keeps the comparison linear in the gradients (Adam's first-step update
+        is ~lr*sign(g), which amplifies reduction-order rounding into sign flips),
+        so per-step loss and grad-norm parity is tight.
+        """
+        import optax
+
+        ds = ToyLMDataset(n=32)
+
+        losses = {}
+        for name, extra in {
+            "ref": {},  # dp=8 -> 8 data shards
+            "sharded": dict(tensor_parallel_degree=4, sharding="stage3", sharding_parallel_degree=2),
+        }.items():
+            model = tiny_model()
+            per_step = []
+
+            class Rec(TrainerCallback):
+                def on_log(self, args, state, control, logs=None, **kw):
+                    if logs and "loss" in logs:
+                        per_step.append((logs["loss"], logs["grad_norm"]))
+
+            # keep the GLOBAL batch identical (16) across topologies
+            args = make_args(tmp_path / name, max_steps=4, logging_steps=1, **extra)
+            args.per_device_train_batch_size = 16 // args.dataset_world_size
+            t = Trainer(model=model, args=args,
+                        train_dataset=ds, callbacks=[Rec()],
+                        optimizers=(optax.sgd(5e-2), None))
+            t.train()
+            losses[name] = per_step
+            if name == "sharded":
+                qk = t.train_state.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+                assert "tp" in str(qk.sharding.spec) and "fsdp" in str(qk.sharding.spec)
+
+        for (l_ref, g_ref), (l_sh, g_sh) in zip(losses["ref"], losses["sharded"]):
+            np.testing.assert_allclose(l_ref, l_sh, atol=1e-4)
+            np.testing.assert_allclose(g_ref, g_sh, rtol=1e-3)
+
+
+class TestArgParser:
+    def test_json_config_roundtrip(self, tmp_path):
+        cfg = {
+            "output_dir": str(tmp_path),
+            "per_device_train_batch_size": 2,
+            "learning_rate": 3e-4,
+            "max_steps": 10,
+            "tensor_parallel_degree": 4,
+            "sharding": "stage2",
+            "bf16": True,
+        }
+        path = tmp_path / "run.json"
+        path.write_text(json.dumps(cfg))
+        parser = PdArgumentParser([TrainingArguments])
+        (args,) = parser.parse_json_file(str(path))
+        assert args.learning_rate == 3e-4
+        assert args.tensor_parallel_degree == 4
+        assert args.sharding_stage == 2
+        assert args.bf16 is True
+
+    def test_cli_args(self, tmp_path):
+        parser = PdArgumentParser([TrainingArguments])
+        (args,) = parser.parse_args_into_dataclasses(
+            ["--output_dir", str(tmp_path), "--learning_rate", "1e-4", "--bf16", "true",
+             "--logging_strategy", "epoch"]
+        )
+        assert args.learning_rate == 1e-4
+        assert args.bf16 is True
+        assert args.logging_strategy == IntervalStrategy.EPOCH
+
+    def test_unknown_cli_arg_raises(self, tmp_path):
+        parser = PdArgumentParser([TrainingArguments])
+        with pytest.raises(ValueError):
+            parser.parse_args_into_dataclasses(["--output_dir", str(tmp_path), "--not_a_flag", "1"])

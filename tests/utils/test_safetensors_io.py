@@ -1,0 +1,88 @@
+"""safetensors IO: roundtrip (incl. 0-d), lazy slicing, sharding, bf16."""
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.utils.safetensors_io import SafeFile, load_file, safe_keys, save_file, shard_checkpoint
+
+
+class TestRoundTrip:
+    def test_basic(self, tmp_path):
+        path = str(tmp_path / "t.safetensors")
+        tensors = {
+            "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones((2,), dtype=np.int64),
+            "c": np.asarray(True),
+        }
+        save_file(tensors, path)
+        out = load_file(path)
+        for k in tensors:
+            np.testing.assert_array_equal(out[k], tensors[k])
+            assert out[k].shape == tensors[k].shape
+
+    def test_zero_dim_preserved(self, tmp_path):
+        """Regression: np.ascontiguousarray promotes 0-d to 1-d; header/read must not."""
+        path = str(tmp_path / "s.safetensors")
+        save_file({"step": np.asarray(7, dtype=np.int32)}, path)
+        out = load_file(path)["step"]
+        assert out.shape == ()
+        assert int(out) == 7
+
+    def test_noncontiguous_input(self, tmp_path):
+        path = str(tmp_path / "f.safetensors")
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6).T  # F-order view
+        save_file({"x": arr}, path)
+        np.testing.assert_array_equal(load_file(path)["x"], arr)
+
+    def test_bf16(self, tmp_path):
+        import ml_dtypes
+
+        path = str(tmp_path / "bf.safetensors")
+        arr = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        save_file({"x": arr}, path)
+        out = load_file(path)["x"]
+        assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(out.astype(np.float32), arr.astype(np.float32))
+
+    def test_interop_with_upstream_safetensors(self, tmp_path):
+        """Files we write must parse with the official safetensors package and back."""
+        from safetensors.numpy import load_file as hf_load, save_file as hf_save
+
+        ours = str(tmp_path / "ours.safetensors")
+        save_file({"w": np.ones((2, 3), dtype=np.float16)}, ours)
+        theirs = hf_load(ours)
+        np.testing.assert_array_equal(theirs["w"], np.ones((2, 3), dtype=np.float16))
+
+        hf_path = str(tmp_path / "hf.safetensors")
+        hf_save({"w": np.full((3,), 2.0, dtype=np.float32)}, hf_path)
+        np.testing.assert_array_equal(load_file(hf_path)["w"], np.full((3,), 2.0, dtype=np.float32))
+
+
+class TestLazySlicing:
+    def test_get_slice_reads_subrange(self, tmp_path):
+        path = str(tmp_path / "big.safetensors")
+        arr = np.arange(1000, dtype=np.float32).reshape(100, 10)
+        save_file({"x": arr}, path)
+        with SafeFile(path) as sf:
+            sl = sf.get_slice("x")
+            assert sl.get_shape() == [100, 10]
+            np.testing.assert_array_equal(sl[10:20], arr[10:20])
+            np.testing.assert_array_equal(sl[:, 3], arr[:, 3])
+
+    def test_keys(self, tmp_path):
+        path = str(tmp_path / "k.safetensors")
+        save_file({"a": np.zeros(1), "b": np.zeros(2)}, path)
+        assert set(safe_keys(path)) == {"a", "b"}
+
+
+class TestShardCheckpoint:
+    def test_single_shard(self):
+        shards, index = shard_checkpoint({"a": np.zeros(10, dtype=np.float32)})
+        assert index is None and len(shards) == 1
+
+    def test_multi_shard_index(self):
+        tensors = {f"p{i}": np.zeros(256, dtype=np.float32) for i in range(8)}
+        shards, index = shard_checkpoint(tensors, max_shard_size=1024 * 3)
+        assert len(shards) > 1
+        assert set(index["weight_map"]) == set(tensors)
+        assert index["metadata"]["total_size"] == 8 * 256 * 4
