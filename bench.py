@@ -1,0 +1,101 @@
+"""Benchmark: LLaMA pretraining step throughput on the attached TPU chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Baseline: the reference's published LLaMA-7B pretrain number — 3754.73
+tokens/card/sec on A100-80G (llm/docs/pretrain.rst:188, BASELINE.md), which is
+~52.5% MFU (6*6.7e9*3754.7 / 312e12). A single v5e chip (197 bf16 TFLOP/s, 16 GB)
+cannot hold 7B training state, so the comparison is MFU-normalized: we run a
+~350M-param LLaMA at seq 2048 and report achieved MFU; vs_baseline = our_MFU / 0.525.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    tiny = "--tiny" in sys.argv
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+    from paddlenlp_tpu.utils.env import device_peak_flops
+
+    if tiny:
+        config = LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=512,
+        )
+        batch, seq_len, steps = 2, 256, 3
+    else:
+        config = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816, num_hidden_layers=24,
+            num_attention_heads=16, num_key_value_heads=16, max_position_embeddings=4096,
+            recompute=True, recompute_granularity="core_attn",
+        )
+        batch, seq_len, steps = 8, 2048, 10
+
+    model = LlamaForCausalLM(config, dtype=jnp.bfloat16, param_dtype=jnp.float32)
+    params = model.init_weights(seed=0)
+    n_params = model.num_parameters()
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
+    opt_state = jax.jit(tx.init)(params)
+
+    def loss_fn(params, ids):
+        logits = model.module.apply({"params": params}, input_ids=ids[:, :-1], deterministic=True).logits
+        logits = logits.astype(jnp.float32)
+        labels = ids[:, 1:]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        return (lse - picked).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq_len + 1)), dtype=jnp.int32)
+
+    # warmup / compile
+    params, opt_state, loss = train_step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    tokens = batch * seq_len * steps
+    tok_per_sec = tokens / dt
+    # 6N matmul + attention FLOPs (causal: halved)
+    attn_flops = 6 * config.num_hidden_layers * config.num_attention_heads * config.head_dim * seq_len
+    flops_per_token = 6.0 * n_params + attn_flops
+    peak = device_peak_flops() or 197e12
+    mfu = tok_per_sec * flops_per_token / peak
+    baseline_mfu = 0.525
+    result = {
+        "metric": "llama350m_pretrain_mfu",
+        "value": round(mfu, 4),
+        "unit": "model_flops_utilization (vs A100 llama7b baseline MFU 0.525)",
+        "vs_baseline": round(mfu / baseline_mfu, 4),
+        "tokens_per_second_per_chip": round(tok_per_sec, 1),
+        "n_params": n_params,
+        "seq_len": seq_len,
+        "device": str(jax.devices()[0]),
+        "loss": float(loss),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

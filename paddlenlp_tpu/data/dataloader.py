@@ -52,7 +52,10 @@ class DistributedBatchSampler:
             order = rng.permutation(self.dataset_len)
         else:
             order = np.arange(self.dataset_len)
+        # consumed_samples is a ONE-TIME fast-forward for the resumed epoch; later
+        # epochs iterate in full.
         start = self.consumed_samples % self.dataset_len if self.consumed_samples else 0
+        self.consumed_samples = 0
         order = order[start:]
         n = len(order)
         end = n - n % self.batch_size if self.drop_last else n

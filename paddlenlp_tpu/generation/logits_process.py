@@ -1,0 +1,176 @@
+"""Logits processors & warpers — all trace-compatible (run inside the jitted
+decode loop; no data-dependent Python control flow).
+
+Counterpart of ``paddlenlp/generation/logits_process.py`` (646 LoC): repetition /
+presence / frequency penalties, min-length, no-repeat-ngram, top-k/top-p/temperature.
+Each processor is ``(ids_buf, logits, cur_len) -> logits`` where ``ids_buf`` is the
+static [B, max_len] decode buffer (prefix < cur_len is valid) — the static-shape
+re-expression of the reference's dynamically-growing ``input_ids``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LogitsProcessorList",
+    "MinLengthLogitsProcessor",
+    "RepetitionPenaltyLogitsProcessor",
+    "PresencePenaltyLogitsProcessor",
+    "FrequencyPenaltyLogitsProcessor",
+    "NoRepeatNGramLogitsProcessor",
+    "ForcedBOSTokenLogitsProcessor",
+    "ForcedEOSTokenLogitsProcessor",
+    "TemperatureLogitsWarper",
+    "TopKLogitsWarper",
+    "TopPLogitsWarper",
+]
+
+NEG_INF = -1e9
+
+
+class LogitsProcessor:
+    def __call__(self, ids_buf, logits, cur_len):
+        raise NotImplementedError
+
+
+class LogitsProcessorList(list):
+    def __call__(self, ids_buf, logits, cur_len):
+        for proc in self:
+            logits = proc(ids_buf, logits, cur_len)
+        return logits
+
+
+def _valid_counts(ids_buf: jnp.ndarray, cur_len, vocab_size: int) -> jnp.ndarray:
+    """[B, vocab] counts of each token in the valid prefix (one-hot scatter-sum)."""
+    B, L = ids_buf.shape
+    valid = (jnp.arange(L)[None, :] < cur_len).astype(jnp.int32)
+    onehot = jax.nn.one_hot(ids_buf, vocab_size, dtype=jnp.int32)
+    return (onehot * valid[..., None]).sum(axis=1)
+
+
+class MinLengthLogitsProcessor(LogitsProcessor):
+    def __init__(self, min_length: int, eos_token_id: int, prompt_len: int = 0):
+        self.min_length = min_length
+        self.eos_token_id = eos_token_id
+        self.prompt_len = prompt_len
+
+    def __call__(self, ids_buf, logits, cur_len):
+        block = (cur_len - self.prompt_len) < self.min_length
+        eos_mask = jnp.zeros_like(logits).at[:, self.eos_token_id].set(NEG_INF)
+        return jnp.where(block, logits + eos_mask, logits)
+
+
+class RepetitionPenaltyLogitsProcessor(LogitsProcessor):
+    """CTRL-style: divide positive / multiply negative logits of seen tokens."""
+
+    def __init__(self, penalty: float):
+        self.penalty = penalty
+
+    def __call__(self, ids_buf, logits, cur_len):
+        counts = _valid_counts(ids_buf, cur_len, logits.shape[-1])
+        seen = counts > 0
+        penalized = jnp.where(logits > 0, logits / self.penalty, logits * self.penalty)
+        return jnp.where(seen, penalized, logits)
+
+
+class PresencePenaltyLogitsProcessor(LogitsProcessor):
+    def __init__(self, penalty: float):
+        self.penalty = penalty
+
+    def __call__(self, ids_buf, logits, cur_len):
+        seen = _valid_counts(ids_buf, cur_len, logits.shape[-1]) > 0
+        return logits - seen.astype(logits.dtype) * self.penalty
+
+
+class FrequencyPenaltyLogitsProcessor(LogitsProcessor):
+    def __init__(self, penalty: float):
+        self.penalty = penalty
+
+    def __call__(self, ids_buf, logits, cur_len):
+        counts = _valid_counts(ids_buf, cur_len, logits.shape[-1])
+        return logits - counts.astype(logits.dtype) * self.penalty
+
+
+class NoRepeatNGramLogitsProcessor(LogitsProcessor):
+    """Ban tokens that would complete an already-seen n-gram (vectorized O(L^2))."""
+
+    def __init__(self, ngram_size: int):
+        self.n = ngram_size
+
+    def __call__(self, ids_buf, logits, cur_len):
+        n = self.n
+        B, L = ids_buf.shape
+        if n <= 1 or L < n:
+            return logits
+        # current (n-1)-gram suffix ending at cur_len-1
+        def suffix_at(off):
+            return jnp.take_along_axis(ids_buf, (cur_len - (n - 1) + off)[None, None].repeat(B, 0), axis=1)[:, 0]
+
+        cur_suffix = jnp.stack([suffix_at(jnp.asarray(i)) for i in range(n - 1)], axis=1)  # [B, n-1]
+        # all historical (n-1)-grams and their next tokens
+        starts = jnp.arange(L - n + 1)
+        windows = jnp.stack([ids_buf[:, s : s + L - n + 1] for s in range(n - 1)], axis=2)  # [B, L-n+1, n-1]
+        next_tokens = ids_buf[:, n - 1 :]  # [B, L-n+1]
+        match = (windows == cur_suffix[:, None, :]).all(axis=-1)  # [B, L-n+1]
+        # only n-grams fully inside the valid prefix count
+        valid = (starts[None, :] + n - 1) < cur_len
+        match = match & valid & ((cur_len - (n - 1)) >= 0)
+        banned = jax.vmap(
+            lambda m, nt: jnp.zeros(logits.shape[-1], jnp.bool_).at[nt].max(m)
+        )(match, next_tokens)
+        return jnp.where(banned, logits + NEG_INF, logits)
+
+
+class ForcedBOSTokenLogitsProcessor(LogitsProcessor):
+    def __init__(self, bos_token_id: int):
+        self.bos_token_id = bos_token_id
+
+    def __call__(self, ids_buf, logits, cur_len):
+        forced = jnp.full_like(logits, NEG_INF).at[:, self.bos_token_id].set(0.0)
+        return jnp.where(cur_len == 1, forced, logits)
+
+
+class ForcedEOSTokenLogitsProcessor(LogitsProcessor):
+    def __init__(self, max_length: int, eos_token_id: int):
+        self.max_length = max_length
+        self.eos_token_id = eos_token_id
+
+    def __call__(self, ids_buf, logits, cur_len):
+        forced = jnp.full_like(logits, NEG_INF).at[:, self.eos_token_id].set(0.0)
+        return jnp.where(cur_len == self.max_length - 1, forced, logits)
+
+
+class TemperatureLogitsWarper(LogitsProcessor):
+    def __init__(self, temperature: float):
+        self.temperature = temperature
+
+    def __call__(self, ids_buf, logits, cur_len):
+        return logits / self.temperature
+
+
+class TopKLogitsWarper(LogitsProcessor):
+    def __init__(self, top_k: int):
+        self.top_k = top_k
+
+    def __call__(self, ids_buf, logits, cur_len):
+        k = min(self.top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        return jnp.where(logits < kth, NEG_INF, logits)
+
+
+class TopPLogitsWarper(LogitsProcessor):
+    def __init__(self, top_p: float):
+        self.top_p = top_p
+
+    def __call__(self, ids_buf, logits, cur_len):
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep smallest prefix with cumulative prob >= top_p (always keep the top-1)
+        keep_sorted = (cum - probs) < self.top_p
+        kth = jnp.where(keep_sorted, sorted_logits, jnp.inf).min(axis=-1, keepdims=True)
+        return jnp.where(logits < kth, NEG_INF, logits)

@@ -1,0 +1,53 @@
+"""Stopping criteria (reference: paddlenlp/generation/stopping_criteria.py, 91 LoC).
+
+Inside the jitted decode loop, stopping is a traced predicate over
+``(ids_buf, cur_len, finished)``; max-length/max-time live at the loop boundary.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+import jax.numpy as jnp
+
+__all__ = ["StoppingCriteria", "StoppingCriteriaList", "MaxLengthCriteria", "MaxTimeCriteria"]
+
+
+class StoppingCriteria:
+    def __call__(self, ids_buf, cur_len, **kwargs) -> bool:
+        raise NotImplementedError
+
+
+class StoppingCriteriaList(list):
+    def __call__(self, ids_buf, cur_len, **kwargs):
+        done = jnp.asarray(False)
+        for crit in self:
+            done = jnp.logical_or(done, crit(ids_buf, cur_len, **kwargs))
+        return done
+
+    @property
+    def max_length(self):
+        for c in self:
+            if isinstance(c, MaxLengthCriteria):
+                return c.max_length
+        return None
+
+
+class MaxLengthCriteria(StoppingCriteria):
+    def __init__(self, max_length: int):
+        self.max_length = max_length
+
+    def __call__(self, ids_buf, cur_len, **kwargs):
+        return cur_len >= self.max_length
+
+
+class MaxTimeCriteria(StoppingCriteria):
+    """Host-side wall clock bound — usable only in the eager (streamer) loop."""
+
+    def __init__(self, max_time: float):
+        self.max_time = max_time
+        self.start = time.time()
+
+    def __call__(self, ids_buf, cur_len, **kwargs):
+        return jnp.asarray(time.time() - self.start > self.max_time)

@@ -1,0 +1,242 @@
+"""``GenerationMixin`` — autoregressive decoding, fully inside ``jit``.
+
+Counterpart of ``paddlenlp/generation/utils.py`` (``GenerationMixin`` :319,
+``generate`` :609, ``greedy_search`` :1036, ``sample`` :1137). TPU-native redesign:
+the reference's per-token Python loop with dynamically growing ``past_key_values``
+becomes ONE ``lax.while_loop`` over a static [B, max_length] token buffer and a
+static-shape KV cache — zero host sync per token, compiled once per shape. The
+reference's ``sample_d2s`` dynamic-to-static export path (:1331) is unnecessary:
+the decode loop IS static.
+
+Batched decode uses LEFT padding (tokenizer ``padding_side="left"``), matching the
+HF/fast-decode convention; position ids derive from the attention mask.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import logger
+from .configuration_utils import GenerationConfig
+from .logits_process import (
+    ForcedEOSTokenLogitsProcessor,
+    FrequencyPenaltyLogitsProcessor,
+    LogitsProcessorList,
+    MinLengthLogitsProcessor,
+    NoRepeatNGramLogitsProcessor,
+    PresencePenaltyLogitsProcessor,
+    RepetitionPenaltyLogitsProcessor,
+    TemperatureLogitsWarper,
+    TopKLogitsWarper,
+    TopPLogitsWarper,
+)
+
+__all__ = ["GenerationMixin"]
+
+
+class GenerationMixin:
+    """Mixed into ``PretrainedModel``; relies on self.{module,params,config}."""
+
+    def get_logits_processors(self, generation_config: GenerationConfig, prompt_len: int) -> LogitsProcessorList:
+        g = generation_config
+        procs = LogitsProcessorList()
+        if g.min_new_tokens or g.min_length:
+            min_new = g.min_new_tokens if g.min_new_tokens else g.min_length
+            if g.eos_token_id is not None:
+                procs.append(MinLengthLogitsProcessor(min_new, _first(g.eos_token_id), prompt_len))
+        if g.repetition_penalty and g.repetition_penalty != 1.0:
+            procs.append(RepetitionPenaltyLogitsProcessor(g.repetition_penalty))
+        if g.presence_penalty:
+            procs.append(PresencePenaltyLogitsProcessor(g.presence_penalty))
+        if g.frequency_penalty:
+            procs.append(FrequencyPenaltyLogitsProcessor(g.frequency_penalty))
+        if g.no_repeat_ngram_size:
+            procs.append(NoRepeatNGramLogitsProcessor(g.no_repeat_ngram_size))
+        return procs
+
+    def get_logits_warpers(self, generation_config: GenerationConfig) -> LogitsProcessorList:
+        g = generation_config
+        warpers = LogitsProcessorList()
+        if g.temperature is not None and g.temperature != 1.0:
+            warpers.append(TemperatureLogitsWarper(g.temperature))
+        if g.top_k is not None and g.top_k > 0:
+            warpers.append(TopKLogitsWarper(g.top_k))
+        if g.top_p is not None and g.top_p < 1.0:
+            warpers.append(TopPLogitsWarper(g.top_p))
+        return warpers
+
+    def _resolve_generation_config(self, kwargs) -> GenerationConfig:
+        base = self.generation_config or GenerationConfig.from_model_config(self.config)
+        g = GenerationConfig(**base.to_dict())
+        g.update(**kwargs)
+        if g.pad_token_id is None:
+            g.pad_token_id = getattr(self.config, "pad_token_id", None) or 0
+        if g.eos_token_id is None:
+            g.eos_token_id = getattr(self.config, "eos_token_id", None)
+        if g.decode_strategy == "sampling":
+            g.do_sample = True
+        return g
+
+    # ------------------------------------------------------------------
+    def generate(
+        self,
+        input_ids,
+        attention_mask=None,
+        generation_config: Optional[GenerationConfig] = None,
+        params=None,
+        seed: int = 0,
+        streamer=None,
+        logits_processors: Optional[LogitsProcessorList] = None,
+        **kwargs,
+    ):
+        """Returns (sequences, None): generated ids ([B, new_tokens] when
+        ``trunc_input``, reference behavior), scores reserved for beam search."""
+        if generation_config is not None:
+            kwargs = {**generation_config.to_dict(), **kwargs}
+        g = self._resolve_generation_config(kwargs)
+        params = params if params is not None else self.params
+        input_ids = jnp.asarray(input_ids, dtype=jnp.int32)
+        B, T0 = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T0), dtype=jnp.int32)
+        else:
+            attention_mask = jnp.asarray(attention_mask, dtype=jnp.int32)
+            tail = np.asarray(attention_mask[:, -1])
+            if (tail == 0).any():
+                logger.warning_once(
+                    "right-padded prompts detected in generate(); use tokenizer padding_side='left' for batched decode"
+                )
+
+        if g.max_new_tokens is not None:
+            max_length = T0 + int(g.max_new_tokens)
+        else:
+            max_length = T0 + int(g.max_length)  # reference semantics: max_length counts new tokens
+        procs = self.get_logits_processors(g, T0)
+        if logits_processors:
+            procs.extend(logits_processors)
+        warpers = self.get_logits_warpers(g) if g.do_sample else LogitsProcessorList()
+
+        eos_ids = tuple(g.eos_token_id) if isinstance(g.eos_token_id, (list, tuple)) else (
+            (g.eos_token_id,) if g.eos_token_id is not None else ()
+        )
+        decode = self._get_decode_fn(
+            max_length=max_length,
+            prompt_len=T0,
+            do_sample=bool(g.do_sample),
+            pad_id=int(g.pad_token_id),
+            eos_ids=eos_ids,
+            procs=procs,
+            warpers=warpers,
+            forced_eos=None,
+        )
+        key = jax.random.key(seed)
+        if streamer is not None:
+            streamer.put(np.asarray(input_ids))
+        ids_buf, lengths = decode(params, input_ids, attention_mask, key)
+        if streamer is not None:
+            for t in range(T0, max_length):
+                streamer.put(np.asarray(ids_buf[:, t]))
+            streamer.end()
+        if g.trunc_input:
+            return ids_buf[:, T0:], None
+        return ids_buf, None
+
+    # ------------------------------------------------------------------
+    def _get_decode_fn(self, *, max_length, prompt_len, do_sample, pad_id, eos_ids, procs, warpers, forced_eos):
+        def _sig(ps):
+            return tuple((type(p).__name__, tuple(sorted(p.__dict__.items()))) for p in ps)
+
+        cache_key = (max_length, prompt_len, do_sample, pad_id, eos_ids, _sig(procs), _sig(warpers))
+        cache = getattr(self, "_decode_cache", None)
+        if cache is None:
+            cache = self._decode_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        module = self.module
+        config = self.config
+
+        def decode(params, input_ids, attention_mask, key):
+            from ..transformers.cache_utils import init_cache
+
+            B, T0 = input_ids.shape
+            ids_buf = jnp.full((B, max_length), pad_id, dtype=jnp.int32)
+            ids_buf = jax.lax.dynamic_update_slice(ids_buf, input_ids, (0, 0))
+            pad_mask = jnp.concatenate(
+                [attention_mask, jnp.ones((B, max_length - T0), jnp.int32)], axis=1
+            )
+            kv = init_cache(config, B, max_length, dtype=jnp.bfloat16 if module.dtype == jnp.bfloat16 else jnp.float32)
+
+            # ---- prefill ----
+            prompt_pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+            out = module.apply(
+                {"params": params},
+                input_ids=input_ids,
+                attention_mask=pad_mask,
+                position_ids=prompt_pos,
+                cache=kv,
+                deterministic=True,
+            )
+            kv = out.past_key_values
+            logits0 = out.logits[:, -1].astype(jnp.float32)
+            finished = jnp.zeros((B,), jnp.bool_)
+
+            def sample_token(logits, ids_buf, cur_len, key, finished):
+                logits = procs(ids_buf, logits, cur_len)
+                if do_sample:
+                    logits = warpers(ids_buf, logits, cur_len)
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, logits, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = jnp.where(finished, pad_id, nxt).astype(jnp.int32)
+                newly = jnp.zeros_like(finished)
+                for e in eos_ids:
+                    newly = newly | (nxt == e)
+                finished = finished | newly
+                return nxt, key, finished
+
+            nxt, key_, finished = sample_token(logits0, ids_buf, jnp.asarray(T0), key, finished)
+            ids_buf = jax.lax.dynamic_update_slice(ids_buf, nxt[:, None], (0, T0))
+
+            def cond(state):
+                ids_buf, kv, cur_len, key, finished = state
+                return (cur_len < max_length) & ~finished.all()
+
+            def body(state):
+                ids_buf, kv, cur_len, key, finished = state
+                tok = jax.lax.dynamic_slice(ids_buf, (0, cur_len - 1), (B, 1))
+                pos = jnp.sum(pad_mask * (jnp.arange(max_length)[None, :] < (cur_len - 1)), axis=1)
+                out = module.apply(
+                    {"params": params},
+                    input_ids=tok,
+                    attention_mask=pad_mask,
+                    position_ids=pos[:, None],
+                    cache=kv,
+                    deterministic=True,
+                )
+                kv = out.past_key_values
+                logits = out.logits[:, -1].astype(jnp.float32)
+                nxt, key, finished = sample_token(logits, ids_buf, cur_len, key, finished)
+                ids_buf = jax.lax.dynamic_update_slice(ids_buf, nxt[:, None], (0, cur_len))
+                return (ids_buf, kv, cur_len + 1, key, finished)
+
+            state = (ids_buf, kv, jnp.asarray(T0 + 1, jnp.int32), key_, finished)
+            if max_length > T0 + 1:
+                state = jax.lax.while_loop(cond, body, state)
+            ids_buf, kv, cur_len, _, finished = state
+            return ids_buf, cur_len
+
+        fn = jax.jit(decode)
+        cache[cache_key] = fn
+        return fn
+
+
+def _first(x):
+    if isinstance(x, (list, tuple)):
+        return x[0]
+    return x

@@ -152,19 +152,55 @@ class Trainer:
             self.optimizer = optax.chain(*chain)
         return self.optimizer
 
-    def _shard_params(self, params):
-        """Place params on the mesh per the model's partition rules (stage3/ZeRO
-        param sharding + TP), unless already placed."""
+    def _shard_params(self, params, logical_overrides=None):
+        """Place params on the mesh per the model's partition rules."""
+        from ..parallel.partition import logical_axis_rules
+
         rules = type(self.model).get_partition_rules(self.model.config)
-        shardings = sharding_tree(params, rules, self.mesh)
+        with logical_axis_rules(logical_overrides or {}):
+            shardings = sharding_tree(params, rules, self.mesh)
         return jax.device_put(params, shardings)
 
+    def _zero1_opt_shardings(self, params):
+        """Optimizer-state shardings for sharding stage1/2: moments sharded over the
+        fsdp axis (first divisible dim), params replicated (reference
+        DygraphShardingOptimizer semantics, trainer.py:2016-2022)."""
+        from jax.sharding import NamedSharding
+
+        fsdp = self.mesh.shape.get("fsdp", 1)
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+
+        def leaf_sharding(leaf):
+            for axis, dim in enumerate(getattr(leaf, "shape", ())):
+                if dim % fsdp == 0 and dim >= fsdp:
+                    spec = [None] * len(leaf.shape)
+                    spec[axis] = "fsdp"
+                    return NamedSharding(self.mesh, P(*spec))
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree.map(leaf_sharding, opt_shapes)
+
     def _make_train_state(self) -> TrainState:
+        """Params + optimizer state onto the mesh.
+
+        - stage3 (or no sharding config): params sharded per the model's partition
+          rules (ZeRO-3 + TP); optimizer state inherits param placement via jit.
+        - stage1/stage2: params REPLICATED over fsdp (only tp etc. applies),
+          optimizer moments explicitly sharded over fsdp (ZeRO-1; XLA chooses
+          reduce-scatter for the grad consumer, the moral stage2).
+        """
         params = self.model.params
-        if self.args.sharding_stage == 3 or self.args.tensor_parallel_degree > 1 or True:
+        fsdp = self.mesh.shape.get("fsdp", 1)
+        stage = self.args.sharding_stage
+        if stage in (1, 2) and fsdp > 1:
+            params = self._shard_params(params, logical_overrides={"embed": None})
+            opt_shardings = self._zero1_opt_shardings(params)
+            with use_mesh(self.mesh):
+                opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
+        else:
             params = self._shard_params(params)
-        with use_mesh(self.mesh):
-            opt_state = jax.jit(self.optimizer.init)(params)  # shardings follow params
+            with use_mesh(self.mesh):
+                opt_state = jax.jit(self.optimizer.init)(params)  # shardings follow params
         return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
 
     # ------------------------------------------------------------------ loss
@@ -330,7 +366,7 @@ class Trainer:
         self.control = self.callback_handler.on_train_begin(args, self.state, self.control)
         dropout_rng = jax.random.key(args.seed)
         accum = args.gradient_accumulation_steps
-        tr_loss_sum, tr_loss_count = 0.0, 0
+        self._interval_losses = []  # device arrays; only sync'd at logging time
         last_metrics = None
         train_start = time.time()
         tokens_seen = 0
@@ -351,18 +387,19 @@ class Trainer:
                     batch = self._device_put_batch(host_batch, accum)
                     self.train_state, metrics = self._train_step_fn(self.train_state, batch, dropout_rng)
                     last_metrics = metrics
+                    self._interval_losses.append(metrics["loss"])
                     self.state.global_step += 1
                     self.state.epoch = self.state.global_step / steps_per_epoch
                     self.state.consumed_samples += args.global_train_batch_size
                     if "input_ids" in host_batch:
                         tokens_seen += int(np.prod(np.asarray(host_batch["input_ids"]).shape))
                     self.control = self.callback_handler.on_step_end(args, self.state, self.control)
-                    self._maybe_log_save_evaluate(tr_loss_sum, last_metrics, train_start, tokens_seen)
+                    self._maybe_log_save_evaluate(last_metrics, train_start, tokens_seen)
                     if self.control.should_training_stop or self.state.global_step >= max_steps:
                         break
                 epoch += 1
                 self.control = self.callback_handler.on_epoch_end(args, self.state, self.control)
-                self._maybe_log_save_evaluate(tr_loss_sum, last_metrics, train_start, tokens_seen)
+                self._maybe_log_save_evaluate(last_metrics, train_start, tokens_seen)
                 if not has_length(train_dataloader):
                     break
 
@@ -389,11 +426,15 @@ class Trainer:
             pass
         return None
 
-    def _maybe_log_save_evaluate(self, tr_loss_sum, metrics, train_start, tokens_seen):
+    def _maybe_log_save_evaluate(self, metrics, train_start, tokens_seen):
         args = self.args
         if self.control.should_log and metrics is not None:
+            # interval-mean loss (reference logs the mean over logging_steps); the
+            # device->host sync happens only here, once per logging interval
+            interval = [float(x) for x in self._interval_losses] or [float(metrics["loss"])]
+            self._interval_losses = []
             logs = {
-                "loss": round(float(metrics["loss"]), 6),
+                "loss": round(float(np.mean(interval)), 6),
                 "grad_norm": round(float(metrics["grad_norm"]), 6),
                 "learning_rate": float(self.lr_scheduler(max(self.state.global_step - 1, 0)))
                 if callable(self.lr_scheduler)
@@ -457,15 +498,9 @@ class Trainer:
             metrics.update({f"{metric_key_prefix}_{k}" if not k.startswith(metric_key_prefix) else k: v
                             for k, v in extra.items()})
         metrics.update(speed_metrics(metric_key_prefix, start, num_steps=n_batches))
-        if self.args.metric_for_best_model:
-            key = self.args.metric_for_best_model
-            if not key.startswith("eval_"):
-                key = f"eval_{key}"
-            if key in metrics:
-                if self.state.best_metric is None or (
-                    (metrics[key] > self.state.best_metric) == bool(self.args.greater_is_better)
-                ):
-                    self.state.best_metric = metrics[key]
+        # best_metric bookkeeping belongs to callbacks (EarlyStoppingCallback) /
+        # checkpoint logic, NOT here — updating before on_evaluate would make every
+        # improvement invisible to patience counters.
         self.state.log_history.append(dict(metrics))
         return metrics
 

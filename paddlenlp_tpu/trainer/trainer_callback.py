@@ -271,6 +271,7 @@ class EarlyStoppingCallback(TrainerCallback):
             and abs(metric_value - state.best_metric) > self.early_stopping_threshold
         ):
             self.early_stopping_patience_counter = 0
+            state.best_metric = metric_value  # this callback owns best-metric tracking
         else:
             self.early_stopping_patience_counter += 1
         if self.early_stopping_patience_counter >= self.early_stopping_patience:

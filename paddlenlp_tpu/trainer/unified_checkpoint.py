@@ -92,6 +92,17 @@ def wait_for_pending_saves():
         _pending_saves.pop().join()
 
 
+def _resolve_optimizer_files(ckpt_dir: str):
+    """Single optimizer.safetensors OR sharded optimizer-XXXXX-of-NNNNN via index."""
+    index_path = os.path.join(ckpt_dir, OPTIMIZER_NAME + ".index.json")
+    if os.path.isfile(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        return [os.path.join(ckpt_dir, f) for f in sorted(set(index["weight_map"].values()))]
+    single = os.path.join(ckpt_dir, OPTIMIZER_NAME)
+    return [single] if os.path.isfile(single) else []
+
+
 def load_unified_checkpoint(
     ckpt_dir: str,
     model,
@@ -110,21 +121,29 @@ def load_unified_checkpoint(
     params = reloaded.params
 
     opt_state = None
-    opt_path = os.path.join(ckpt_dir, OPTIMIZER_NAME)
-    if train_state is not None and os.path.isfile(opt_path):
+    opt_files = _resolve_optimizer_files(ckpt_dir)
+    if train_state is not None and opt_files:
         target = train_state.opt_state
         flat_target = _flatten_opt_state(target)
-        with SafeFile(opt_path) as sf:
+        open_files = [SafeFile(f) for f in opt_files]
+        key_to_file = {}
+        for sf in open_files:
+            for k in sf.keys():
+                key_to_file[k] = sf
+        try:
             loaded: Dict[str, np.ndarray] = {}
             for key, leaf in flat_target.items():
-                if key in sf:
-                    arr = sf.get_tensor(key)
+                if key in key_to_file:
+                    arr = key_to_file[key].get_tensor(key)
                     sharding = getattr(leaf, "sharding", None)
                     loaded[key] = jax.device_put(arr, sharding) if sharding is not None else arr
                 else:
                     logger.warning(f"optimizer leaf {key} missing in checkpoint; keeping fresh init")
                     loaded[key] = leaf
-            step = sf.get_tensor("__step__") if "__step__" in sf else np.zeros((), np.int32)
+            step = key_to_file["__step__"].get_tensor("__step__") if "__step__" in key_to_file else np.zeros((), np.int32)
+        finally:
+            for sf in open_files:
+                sf.close()
         # rebuild the optax pytree with loaded leaves in structure order
         leaves_with_path = jax.tree_util.tree_flatten_with_path(target)
         treedef = leaves_with_path[1]

@@ -2,32 +2,36 @@
 
 The reference grows python lists of past_key_values dynamically
 (``generation/utils.py`` + per-model ``forward``). Dynamic shapes don't compile on
-TPU: the cache here is a static-shape pytree ``[B, max_len, n_kv, head_dim]`` per
-layer plus a scalar write index, updated with ``lax.dynamic_update_slice`` — the
-whole decode loop stays inside one ``jit``/``lax.while_loop``.
+TPU: the cache here is a static-shape **stacked** pytree ``[L, B, max_len, n_kv,
+head_dim]`` plus a scalar write index, updated with ``lax.dynamic_update_slice`` —
+the whole decode loop stays inside one ``jit``/``lax.while_loop``, and the stacked
+layout is exactly what the scanned-layer (``lax.scan``) model path consumes.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["KVCache", "init_cache", "update_cache_layer"]
+__all__ = ["KVCache", "init_cache", "update_layer_kv"]
 
 
 @dataclasses.dataclass
 class KVCache:
-    """Per-model cache: stacked-by-layer keys/values + scalar write offset."""
+    """Stacked-by-layer cache: keys/values [L, B, S_max, n_kv, H] + write offset."""
 
-    keys: Any  # tuple over layers of [B, S_max, n_kv, H]
-    values: Any
+    keys: jnp.ndarray
+    values: jnp.ndarray
     offset: jnp.ndarray  # scalar int32: number of tokens already written
 
     def __len__(self):
-        return len(self.keys)
+        return self.keys.shape[0]
+
+    def layer(self, idx) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return self.keys[idx], self.values[idx]
 
 
 jax.tree_util.register_dataclass(KVCache, data_fields=["keys", "values", "offset"], meta_fields=[])
@@ -37,24 +41,23 @@ def init_cache(config, batch_size: int, max_length: int, dtype=jnp.bfloat16) -> 
     n_layers = config.num_hidden_layers
     n_kv = getattr(config, "num_key_value_heads", config.num_attention_heads)
     head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
-    shape = (batch_size, max_length, n_kv, head_dim)
-    zeros = lambda: jnp.zeros(shape, dtype=dtype)  # noqa: E731
+    shape = (n_layers, batch_size, max_length, n_kv, head_dim)
     return KVCache(
-        keys=tuple(zeros() for _ in range(n_layers)),
-        values=tuple(zeros() for _ in range(n_layers)),
+        keys=jnp.zeros(shape, dtype=dtype),
+        values=jnp.zeros(shape, dtype=dtype),
         offset=jnp.zeros((), dtype=jnp.int32),
     )
 
 
-def update_cache_layer(
-    cache: KVCache, layer_idx: int, k: jnp.ndarray, v: jnp.ndarray
-) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
-    """Write new [B, T, n_kv, H] k/v at the cache offset; return full-cache views."""
-    k_cache = jax.lax.dynamic_update_slice(cache.keys[layer_idx], k.astype(cache.keys[layer_idx].dtype),
-                                           (0, cache.offset.astype(jnp.int32), 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(cache.values[layer_idx], v.astype(cache.values[layer_idx].dtype),
-                                           (0, cache.offset.astype(jnp.int32), 0, 0))
-    keys = cache.keys[:layer_idx] + (k_cache,) + cache.keys[layer_idx + 1 :]
-    values = cache.values[:layer_idx] + (v_cache,) + cache.values[layer_idx + 1 :]
-    new_offset = cache.offset + k.shape[1] if layer_idx == len(cache) - 1 else cache.offset
-    return k_cache, v_cache, KVCache(keys=keys, values=values, offset=new_offset)
+def update_layer_kv(
+    k_cache: jnp.ndarray,  # [B, S_max, n_kv, H] — one layer's cache
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, T, n_kv, H]
+    v_new: jnp.ndarray,
+    offset,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write new k/v at ``offset``; returns the full-cache views."""
+    idx = (0, jnp.asarray(offset, jnp.int32), 0, 0)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), idx)
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), idx)
+    return k_cache, v_cache

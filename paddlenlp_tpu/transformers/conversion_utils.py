@@ -49,6 +49,48 @@ class StateDictNameMapping:
         return array
 
 
+@dataclasses.dataclass
+class StackedLayerMapping:
+    """One stacked target param [L, ...] <- L per-layer checkpoint keys.
+
+    Used by the scanned-layer model path (lax.scan over a stacked layer axis):
+    checkpoints stay in HF per-layer format; stacking/unstacking happens here, so
+    scan and unrolled models produce byte-identical checkpoints.
+    """
+
+    source_template: str  # e.g. "model.layers.{}.self_attn.q_proj.weight"
+    target_name: str  # e.g. "model/layers/self_attn/q_proj/kernel"
+    n_layers: int = 0
+    action: Optional[str] = None  # applied per layer slice
+
+    @property
+    def source_name(self) -> str:  # for unified bookkeeping/messages
+        return self.source_template
+
+    def source_names(self) -> List[str]:
+        return [self.source_template.format(i) for i in range(self.n_layers)]
+
+    def apply_stack(self, get_source: Callable[[str], Optional[np.ndarray]]) -> Optional[np.ndarray]:
+        slices = []
+        for name in self.source_names():
+            arr = get_source(name)
+            if arr is None:
+                return None
+            if self.action == "transpose":
+                arr = np.ascontiguousarray(np.asarray(arr).T)
+            slices.append(np.asarray(arr))
+        return np.stack(slices, axis=0)
+
+    def reverse_unstack(self, array: np.ndarray) -> Dict[str, np.ndarray]:
+        out = {}
+        for i in range(array.shape[0]):
+            a = array[i]
+            if self.action == "transpose":
+                a = np.ascontiguousarray(a.T)
+            out[self.source_template.format(i)] = a
+        return out
+
+
 def flatten_params(tree, sep: str = "/") -> Dict[str, object]:
     """Nested dict -> { 'a/b/c': leaf } (insertion-ordered, deterministic)."""
     out: Dict[str, object] = {}
@@ -100,21 +142,30 @@ def auto_name_mappings(
 ) -> List[StateDictNameMapping]:
     """Derive the full mapping table from our param tree's flat shape dict.
 
-    ``overrides`` maps target path -> explicit mapping (for fused qkv etc.).
+    Handles both unrolled (``layers_<i>``) and scanned (``layers`` with a stacked
+    leading dim) layouts. ``overrides`` maps target path -> explicit mapping.
     """
     mappings = []
     for path in flat_shapes:
         if overrides and path in overrides:
             mappings.append(overrides[path])
             continue
+        leaf = flat_shapes[path]
+        ndim = len(getattr(leaf, "shape", ()))
+        stacked = "/layers/" in f"/{path}"
+        action = "transpose" if path.endswith("/kernel") else None
+        if action == "transpose" and ndim - (1 if stacked else 0) != 2:
+            action = None  # conv kernels etc. handled by explicit overrides
+        if stacked:
+            hf_key = target_to_hf_key(path.replace("/layers/", "/layers_0/", 1)).replace("layers.0.", "layers.{}.", 1)
+            if hf_prefix and not hf_key.startswith(hf_prefix + "."):
+                hf_key = hf_prefix + "." + hf_key
+            n_layers = getattr(leaf, "shape", (0,))[0]
+            mappings.append(StackedLayerMapping(hf_key, path, n_layers, action))
+            continue
         hf_key = target_to_hf_key(path)
         if hf_prefix:
             hf_key = hf_prefix + "." + hf_key if not hf_key.startswith(hf_prefix + ".") else hf_key
-        action = "transpose" if path.endswith("/kernel") else None
-        leaf = flat_shapes[path]
-        ndim = len(getattr(leaf, "shape", ()))
-        if action == "transpose" and ndim != 2:
-            action = None  # conv kernels etc. handled by explicit overrides
         mappings.append(StateDictNameMapping(hf_key, path, action))
     return mappings
 

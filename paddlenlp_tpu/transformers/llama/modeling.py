@@ -13,11 +13,13 @@ TPU-first redesign:
   linen module is strategy-free; ``get_partition_rules`` + activation sharding
   constraints tell GSPMD where tensors live, and XLA inserts the collectives
   (TP all-reduce, Megatron-SP reduce-scatter/all-gather, Ulysses all-to-all).
+- decoder layers run UNROLLED (``layers_<i>``) or SCANNED over a stacked [L, ...]
+  param axis (``config.use_scan_layers``, the MaxText idiom): L-times smaller HLO,
+  near-constant compile time in depth, and the natural substrate for pipeline
+  parallelism. Checkpoints are identical either way (HF per-layer keys).
 - bf16 compute / fp32 params+norms; RoPE tables in fp32.
-- attention via ``ops.flash_attention`` dispatch (fused XLA or Pallas; ring
-  attention when the ``cp`` mesh axis is active).
-- rematerialization via ``flax.linen.remat`` with XLA-friendly policies instead of
-  the reference's recompute wrappers.
+- rematerialization via ``flax.linen.remat`` with named-checkpoint policies
+  (full / full_attn / core_attn) instead of the reference's recompute wrappers.
 """
 
 from __future__ import annotations
@@ -35,7 +37,7 @@ from ...ops.cross_entropy import cross_entropy_with_ignore
 from ...ops.flash_attention import dot_product_attention
 from ...ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
 from ...parallel.partition import P, shard_constraint
-from ..cache_utils import KVCache, update_cache_layer
+from ..cache_utils import KVCache, update_layer_kv
 from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast, SequenceClassifierOutput
 from ..model_utils import PretrainedModel
 from .configuration import LlamaConfig
@@ -63,8 +65,8 @@ ACT2FN = {
 
 
 class LlamaRMSNorm(nn.Module):
-    """RMSNorm in fp32 (reference llama/modeling.py:352; fused rms_norm op fusion_ops.py:119 —
-    on TPU, XLA fuses this chain natively)."""
+    """RMSNorm in fp32 (reference llama/modeling.py:352; the fused rms_norm custom op
+    fusion_ops.py:119 is unnecessary — XLA fuses this chain natively)."""
 
     dim: int
     eps: float = 1e-6
@@ -92,7 +94,7 @@ def _dense(features, use_bias, config, dtype, param_dtype, name):
 
 
 class LlamaMLP(nn.Module):
-    """SwiGLU MLP (reference :580). gate/up are column-parallel, down row-parallel —
+    """SwiGLU MLP (reference :580). gate/up column-parallel, down row-parallel —
     expressed purely via partition rules on the kernels."""
 
     config: LlamaConfig
@@ -113,13 +115,14 @@ class LlamaMLP(nn.Module):
 class LlamaAttention(nn.Module):
     """GQA attention with RoPE (reference :655-1120).
 
-    The reference's TP machinery (head split bookkeeping, ``assign_kv_heads``, fused
+    The reference's TP machinery (head-split bookkeeping, ``assign_kv_heads``, fused
     qkv weights, ReshardQKV for sep parallel) reduces to: project, constrain the
     heads dim onto the ``tp``(+``sep``) axes, call the attention dispatcher.
+    ``kv`` is one layer's cache slice (k, v) [B, S_max, n_kv, H]; ``offset`` is the
+    global cache write index.
     """
 
     config: LlamaConfig
-    layer_idx: int = 0
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -130,7 +133,8 @@ class LlamaAttention(nn.Module):
         attention_mask=None,
         position_ids=None,
         segment_ids=None,
-        cache: Optional[KVCache] = None,
+        kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        offset=0,
         deterministic: bool = True,
     ):
         cfg = self.config
@@ -150,16 +154,17 @@ class LlamaAttention(nn.Module):
         v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
 
         if position_ids is None:
-            offset = cache.offset if cache is not None else 0
-            position_ids = jnp.arange(T)[None, :] + offset
+            position_ids = jnp.arange(T)[None, :] + (offset if kv is not None else 0)
         inv_freq = jnp.asarray(rope_frequencies(head_dim, cfg.rope_theta, cfg.rope_scaling))
         cos, sin = rope_tables(position_ids, inv_freq)
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
         q_offset = 0
-        if cache is not None:
-            q_offset = cache.offset
-            k, v, cache = update_cache_layer(cache, self.layer_idx, k, v)
+        new_kv = None
+        if kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(kv[0], kv[1], k, v, offset)
+            new_kv = (k, v)
 
         dropout_rate = cfg.attention_dropout if not deterministic else 0.0
         dropout_rng = self.make_rng("dropout") if dropout_rate > 0.0 else None
@@ -180,33 +185,34 @@ class LlamaAttention(nn.Module):
         attn_out = checkpoint_name(attn_out, "core_attn")
         attn_out = attn_out.reshape(B, T, n_heads * head_dim)
         out = _dense(cfg.hidden_size, cfg.attention_bias, cfg, self.dtype, self.param_dtype, "o_proj")(attn_out)
-        return out, cache
+        return out, new_kv
 
 
 class LlamaDecoderLayer(nn.Module):
-    """Pre-norm residual block (reference :1122)."""
+    """Pre-norm residual block (reference :1122) with a scan-compatible signature:
+    ``(carry=(h, offset), layer_kv, ...) -> ((h, offset), new_layer_kv)``."""
 
     config: LlamaConfig
-    layer_idx: int = 0
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(
         self,
-        hidden_states,
+        carry,
+        layer_kv,
         attention_mask=None,
         position_ids=None,
         segment_ids=None,
-        cache: Optional[KVCache] = None,
         deterministic: bool = True,
     ):
         cfg = self.config
+        hidden_states, offset = carry
         residual = hidden_states
         h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="input_layernorm")(hidden_states)
-        attn_out, cache = LlamaAttention(
-            cfg, self.layer_idx, self.dtype, self.param_dtype, name="self_attn"
-        )(h, attention_mask, position_ids, segment_ids, cache, deterministic)
+        attn_out, new_kv = LlamaAttention(cfg, self.dtype, self.param_dtype, name="self_attn")(
+            h, attention_mask, position_ids, segment_ids, layer_kv, offset, deterministic
+        )
         h = residual + attn_out
         h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
         residual = h
@@ -214,7 +220,7 @@ class LlamaDecoderLayer(nn.Module):
         h2 = LlamaMLP(cfg, self.dtype, self.param_dtype, name="mlp")(h2)
         h = residual + h2
         h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
-        return h, cache
+        return (h, offset), new_kv
 
 
 def _remat_policy(granularity: str):
@@ -243,7 +249,8 @@ def _maybe_remat(layer_cls, config):
 
 
 class LlamaModule(nn.Module):
-    """Embedding -> N decoder layers -> final norm (reference ``LlamaModel`` :1440)."""
+    """Embedding -> N decoder layers (unrolled or scanned) -> final norm
+    (reference ``LlamaModel`` :1440)."""
 
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32
@@ -274,15 +281,43 @@ class LlamaModule(nn.Module):
             )
             inputs_embeds = embed(input_ids)
         h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
 
         layer_cls = _maybe_remat(LlamaDecoderLayer, cfg)
         all_hidden = [] if output_hidden_states else None
-        for i in range(cfg.num_hidden_layers):
-            if output_hidden_states:
-                all_hidden.append(h)
-            h, cache = layer_cls(cfg, i, self.dtype, self.param_dtype, name=f"layers_{i}")(
-                h, attention_mask, position_ids, segment_ids, cache, deterministic
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
             )
+            (h, _), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
+                (h, offset), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                cache = KVCache(keys=new_kv[0], values=new_kv[1],
+                                offset=offset + (input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]))
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                    (h, offset), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+
         h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="norm")(h)
         if output_hidden_states:
             all_hidden.append(h)
@@ -327,7 +362,7 @@ class LlamaForCausalLMModule(nn.Module):
         )
         h = outputs.last_hidden_state
         if cfg.tie_word_embeddings:
-            # reference LlamaLMHead with shared weight (modeling_pp.py:361-377 ties them)
+            # reference LlamaLMHead with shared weight (modeling_pp.py:361-377)
             embedding = self.get_variable("params", "model")["embed_tokens"]["embedding"]
             logits = h @ embedding.T.astype(self.dtype)
         else:
@@ -357,7 +392,7 @@ class LlamaForSequenceClassificationModule(nn.Module):
             input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds, deterministic, False, True
         )
         h = outputs.last_hidden_state
-        # pool at the last non-pad token (reference uses sequence end pooling)
+        # pool at the last non-pad token (reference pools the sequence end)
         if attention_mask is not None:
             last = jnp.maximum(attention_mask.sum(axis=-1).astype(jnp.int32) - 1, 0)
         else:
@@ -376,7 +411,8 @@ class LlamaPretrainedModel(PretrainedModel):
     @classmethod
     def get_partition_rules(cls, config=None):
         """Logical partition specs per param (reference `_get_tensor_parallel_mappings`
-        llama/modeling.py:1267-1330 — here one table covers tp AND fsdp AND anything else)."""
+        llama/modeling.py:1267-1330 — here one table covers tp AND fsdp AND the rest;
+        scanned layers get a leading `layers` axis prepended automatically)."""
         return [
             (r"embed_tokens/embedding$", P("vocab", "embed")),
             (r"self_attn/(q_proj|k_proj|v_proj)/kernel$", P("embed", "heads")),
@@ -401,7 +437,7 @@ class LlamaForCausalLM(LlamaPretrainedModel):
     def get_model_flops(self, batch_size: int, seq_length: int) -> float:
         cfg = self.config
         n = self.num_parameters()
-        # 6ND for matmuls + 12*L*H*S^2 causal attention term (fwd+bwd, halved for causality)
+        # 6ND for matmuls + causal attention term (fwd+bwd)
         return 6.0 * n * batch_size * seq_length + 6.0 * cfg.num_hidden_layers * cfg.head_dim * \
             cfg.num_attention_heads * (seq_length**2) * batch_size
 

@@ -26,12 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..generation.utils import GenerationMixin
 from ..utils.downloader import resolve_file, resolve_model_dir
 from ..utils.env import CONFIG_NAME, GENERATION_CONFIG_NAME, SAFE_WEIGHTS_INDEX_NAME, SAFE_WEIGHTS_NAME
 from ..utils.log import logger
 from ..utils.safetensors_io import SafeFile, save_file, shard_checkpoint
 from .configuration_utils import PretrainedConfig
 from .conversion_utils import (
+    StackedLayerMapping,
     StateDictNameMapping,
     auto_name_mappings,
     flatten_params,
@@ -54,7 +56,7 @@ def dtype_byte_size(dtype) -> float:
     return jnp.dtype(dtype).itemsize
 
 
-class PretrainedModel:
+class PretrainedModel(GenerationMixin):
     config_class: Type[PretrainedConfig] = PretrainedConfig
     module_class: Optional[type] = None
     base_model_prefix: str = "model"
@@ -207,23 +209,38 @@ class PretrainedModel:
         else:
             shardings_flat = {}
 
+        def get_source(key):
+            sf = key_to_file.get(key)
+            return sf.get_tensor(key) if sf is not None else None
+
         flat_params: Dict[str, jax.Array] = {}
         missing: List[str] = []
         for path, shape_struct in flat_shapes.items():
             m = mappings.get(path)
-            src_key = m.source_name if m else path
-            if src_key not in key_to_file:
-                missing.append(path)
-                continue
-            arr = m.apply(key_to_file[src_key].get_tensor(src_key)) if m else key_to_file[src_key].get_tensor(src_key)
+            if isinstance(m, StackedLayerMapping):
+                arr = m.apply_stack(get_source)
+                if arr is None:
+                    missing.append(path)
+                    continue
+            else:
+                src_key = m.source_name if m else path
+                if src_key not in key_to_file:
+                    missing.append(path)
+                    continue
+                arr = m.apply(get_source(src_key)) if m else get_source(src_key)
             if tuple(arr.shape) != tuple(shape_struct.shape):
                 raise ValueError(f"shape mismatch for {path}: ckpt {arr.shape} vs model {shape_struct.shape}")
             arr = _cast_np(arr, param_dtype)
             sharding = shardings_flat.get(path)
             flat_params[path] = jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr)
 
-        loaded_targets = set(flat_params) | set(missing)
-        unexpected = [k for k in key_to_file if k not in {mappings[p].source_name for p in mappings}]
+        expected_sources = set()
+        for m in mappings.values():
+            if isinstance(m, StackedLayerMapping):
+                expected_sources.update(m.source_names())
+            else:
+                expected_sources.add(m.source_name)
+        unexpected = [k for k in key_to_file if k not in expected_sources]
         if missing:
             missing_fatal = [k for k in missing if not _matches_any(k, cls._keys_to_ignore_on_load_missing)]
             if missing_fatal:
@@ -267,8 +284,11 @@ class PretrainedModel:
         for path, leaf in flat.items():
             arr = np.asarray(jax.device_get(leaf))
             m = mappings.get(path)
-            key = m.source_name if m else path
-            tensors[key] = m.reverse(arr) if m else arr
+            if isinstance(m, StackedLayerMapping):
+                tensors.update(m.reverse_unstack(arr))
+            else:
+                key = m.source_name if m else path
+                tensors[key] = m.reverse(arr) if m else arr
         shards, index = shard_checkpoint(tensors, max_shard_size, SAFE_WEIGHTS_NAME)
         for fname, shard in shards:
             save_file(shard, os.path.join(save_directory, fname), metadata={"format": "np"})

@@ -1,0 +1,124 @@
+"""Generation tests: greedy parity with manual decode, sampling determinism,
+logits processors, batched left-pad decode, eos stopping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.generation import GenerationConfig, LogitsProcessorList, TopKLogitsWarper, TopPLogitsWarper
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(
+        vocab_size=96,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        eos_token_id=2,
+        pad_token_id=0,
+    )
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+class TestGreedy:
+    def test_greedy_matches_manual_loop(self, model):
+        """Jitted while_loop decode == naive re-forward-everything greedy."""
+        prompt = jnp.array([[5, 6, 7, 8]], dtype=jnp.int32)
+        out, _ = model.generate(prompt, max_new_tokens=6, do_sample=False)
+        # manual: full forward each step, argmax
+        ids = np.asarray(prompt)
+        for _ in range(6):
+            logits = model(input_ids=jnp.asarray(ids)).logits
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ids = np.concatenate([ids, [[nxt]]], axis=1)
+            if nxt == 2:
+                break
+        manual = ids[0, 4:]
+        got = np.asarray(out[0])[: len(manual)]
+        np.testing.assert_array_equal(got, manual)
+
+    def test_batched_left_padding(self, model):
+        """Left-padded batch rows decode identically to unpadded single rows."""
+        single, _ = model.generate(jnp.array([[5, 6, 7]], jnp.int32), max_new_tokens=4, do_sample=False)
+        batch_ids = jnp.array([[0, 0, 5, 6, 7], [11, 12, 13, 14, 15]], jnp.int32)
+        mask = jnp.array([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]], jnp.int32)
+        batched, _ = model.generate(batch_ids, attention_mask=mask, max_new_tokens=4, do_sample=False)
+        np.testing.assert_array_equal(np.asarray(batched[0]), np.asarray(single[0]))
+
+    def test_eos_stops_row(self, model):
+        """After a row hits eos, it must emit pad only."""
+        prompt = jnp.array([[5, 6, 7, 8]], dtype=jnp.int32)
+        out, _ = model.generate(prompt, max_new_tokens=20, do_sample=False)
+        toks = np.asarray(out[0])
+        if 2 in toks:
+            i = int(np.argmax(toks == 2))
+            assert (toks[i + 1 :] == 0).all()
+
+    def test_trunc_input_false(self, model):
+        prompt = jnp.array([[5, 6, 7]], dtype=jnp.int32)
+        out, _ = model.generate(prompt, max_new_tokens=2, do_sample=False, trunc_input=False)
+        np.testing.assert_array_equal(np.asarray(out[0, :3]), [5, 6, 7])
+        assert out.shape == (1, 5)
+
+
+class TestSampling:
+    def test_seeded_reproducible(self, model):
+        prompt = jnp.array([[5, 6, 7]], dtype=jnp.int32)
+        a, _ = model.generate(prompt, max_new_tokens=8, do_sample=True, top_k=20, seed=13)
+        b, _ = model.generate(prompt, max_new_tokens=8, do_sample=True, top_k=20, seed=13)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_temperature_zero_k1_is_greedy(self, model):
+        prompt = jnp.array([[5, 6, 7]], dtype=jnp.int32)
+        greedy, _ = model.generate(prompt, max_new_tokens=5, do_sample=False)
+        k1, _ = model.generate(prompt, max_new_tokens=5, do_sample=True, top_k=1, seed=3)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+class TestWarpers:
+    def test_top_k_masks(self):
+        warper = TopKLogitsWarper(2)
+        logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5]])
+        out = warper(None, logits, 0)
+        assert out[0, 1] == 3.0 and out[0, 2] == 2.0
+        assert out[0, 0] < -1e8 and out[0, 3] < -1e8
+
+    def test_top_p_keeps_head(self):
+        warper = TopPLogitsWarper(0.5)
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.1, 0.1]]))
+        out = warper(None, logits, 0)
+        assert out[0, 0] > -1e8  # top-1 always kept
+        assert out[0, 2] < -1e8 and out[0, 3] < -1e8
+
+    def test_repetition_penalty_blocks_loop(self, model):
+        prompt = jnp.array([[5, 6, 5, 6, 5, 6]], dtype=jnp.int32)
+        plain, _ = model.generate(prompt, max_new_tokens=8, do_sample=False)
+        pen, _ = model.generate(prompt, max_new_tokens=8, do_sample=False, repetition_penalty=2.0)
+        # both valid sequences; penalized must differ if plain repeats the prompt bigram
+        assert plain.shape == pen.shape
+
+    def test_no_repeat_ngram(self, model):
+        prompt = jnp.array([[5, 6, 7]], dtype=jnp.int32)
+        out, _ = model.generate(prompt, max_new_tokens=16, do_sample=False, no_repeat_ngram_size=2, eos_token_id=None)
+        full = np.concatenate([np.asarray(prompt[0]), np.asarray(out[0])])
+        bigrams = set()
+        for i in range(len(full) - 1):
+            bg = (full[i], full[i + 1])
+            if 0 in bg:
+                continue
+            assert bg not in bigrams, f"repeated bigram {bg}"
+            bigrams.add(bg)
+
+
+class TestGenerationConfig:
+    def test_save_load(self, tmp_path):
+        g = GenerationConfig(max_new_tokens=32, do_sample=True, top_p=0.9, eos_token_id=2)
+        g.save_pretrained(str(tmp_path))
+        g2 = GenerationConfig.from_pretrained(str(tmp_path))
+        assert g2.max_new_tokens == 32 and g2.top_p == 0.9

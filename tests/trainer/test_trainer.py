@@ -172,7 +172,7 @@ class TestCheckpointResume:
         t2.train(resume_from_checkpoint=ckpt)
         assert t2.state.global_step == 8
         # param placement follows the new mesh
-        qk = t2.train_state.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+        qk = t2.train_state.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
         assert "tp" in str(qk.sharding.spec)
 
     def test_rotation(self, tmp_path):
@@ -217,7 +217,7 @@ class TestShardedTraining:
             t.train()
             losses[name] = per_step
             if name == "sharded":
-                qk = t.train_state.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
+                qk = t.train_state.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
                 assert "tp" in str(qk.sharding.spec) and "fsdp" in str(qk.sharding.spec)
 
         for (l_ref, g_ref), (l_sh, g_sh) in zip(losses["ref"], losses["sharded"]):

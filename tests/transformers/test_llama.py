@@ -122,12 +122,17 @@ class TestLlamaSaveLoad:
         assert "lm_head.weight" in keys
 
     def test_load_from_hf_torch_layout(self, tmp_path):
-        """A checkpoint written with torch [out,in] Linear layout loads correctly."""
+        """A checkpoint written with torch [out,in] Linear layout loads correctly.
+
+        Weights are perturbed (x1.5) before the torch round-trip so a silent
+        fallback to same-seed fresh init CANNOT pass the parity check.
+        """
         import torch
         from safetensors.torch import save_file as torch_save
 
-        cfg = tiny_config(num_hidden_layers=1)
+        cfg = tiny_config(num_hidden_layers=1, use_scan_layers=False)
         model = LlamaForCausalLM.from_config(cfg, seed=0)
+        model.params = jax.tree.map(lambda x: x * 1.5, model.params)
         # round-trip through a torch-style file: transpose kernels like HF does
         from paddlenlp_tpu.transformers.conversion_utils import flatten_params
         flat = flatten_params(model.params)
@@ -162,7 +167,7 @@ class TestLlamaSharded:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-4)
 
     def test_param_shardings_applied(self, eight_devices):
-        cfg = tiny_config()
+        cfg = tiny_config(use_scan_layers=False)
         mesh = create_mesh(MeshConfig(dp=1, fsdp=2, tp=4))
         model = LlamaForCausalLM.from_config(cfg, seed=0, mesh=mesh)
         qk = model.params["model"]["layers_0"]["self_attn"]["q_proj"]["kernel"]
@@ -191,3 +196,59 @@ class TestLlamaRecompute:
         g_remat = jax.grad(loss_fn)(model.params, cfg_r)
         for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+class TestLlamaScanLayers:
+    def test_scan_matches_unrolled(self):
+        """Scanned-layer stack == unrolled layers, loading the SAME checkpoint."""
+        import tempfile
+
+        cfg = tiny_config(use_scan_layers=False)  # baseline: genuinely unrolled
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        ids = jnp.array([[3, 1, 4, 1, 5, 9, 2, 6]], dtype=jnp.int32)
+        ref = model(input_ids=ids).logits
+        with tempfile.TemporaryDirectory() as d:
+            model.save_pretrained(d)
+            scan_cfg = tiny_config(use_scan_layers=True)
+            scan_model = LlamaForCausalLM.from_pretrained(d, config=scan_cfg)
+            got = scan_model(input_ids=ids).logits
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=1e-5)
+
+    def test_scan_checkpoint_identical_keys(self, tmp_path):
+        """A scan model's checkpoint keeps HF per-layer keys (interop both ways)."""
+        from paddlenlp_tpu.utils.safetensors_io import safe_keys
+
+        cfg = tiny_config(use_scan_layers=True)
+        m = LlamaForCausalLM.from_config(cfg, seed=0)
+        m.save_pretrained(str(tmp_path))
+        keys = set(safe_keys(str(tmp_path / "model.safetensors")))
+        assert "model.layers.0.self_attn.q_proj.weight" in keys
+        assert "model.layers.1.mlp.down_proj.weight" in keys
+        # and it loads back as unrolled
+        unrolled = LlamaForCausalLM.from_pretrained(str(tmp_path), config=tiny_config(use_scan_layers=False))
+        ids = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(m(input_ids=ids).logits), np.asarray(unrolled(input_ids=ids).logits), atol=1e-5
+        )
+
+    def test_scan_generate_cache(self):
+        cfg = tiny_config(use_scan_layers=True)
+        ref_cfg = tiny_config(use_scan_layers=False)
+        import tempfile
+
+        model = LlamaForCausalLM.from_config(ref_cfg, seed=0)
+        with tempfile.TemporaryDirectory() as d:
+            model.save_pretrained(d)
+            scan_model = LlamaForCausalLM.from_pretrained(d, config=cfg)
+        prompt = jnp.array([[5, 6, 7, 8]], dtype=jnp.int32)
+        a, _ = model.generate(prompt, max_new_tokens=6, do_sample=False)
+        b, _ = scan_model.generate(prompt, max_new_tokens=6, do_sample=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_scan_sharded_params(self, eight_devices):
+        cfg = tiny_config(use_scan_layers=True)
+        mesh = create_mesh(MeshConfig(dp=2, tp=4))
+        m = LlamaForCausalLM.from_config(cfg, seed=0, mesh=mesh)
+        qk = m.params["model"]["layers"]["self_attn"]["q_proj"]["kernel"]
+        assert qk.ndim == 3  # [L, in, out]
+        assert qk.sharding.spec == jax.sharding.PartitionSpec(None, None, "tp")
