@@ -25,11 +25,15 @@ import jax.numpy as jnp
 __all__ = ["dot_product_attention", "make_causal_mask", "make_segment_mask"]
 
 
-def make_causal_mask(q_len: int, kv_len: int, offset=0, dtype=jnp.bool_) -> jnp.ndarray:
-    """[1, 1, q_len, kv_len] causal mask; ``offset`` = absolute position of q row 0."""
+def make_causal_mask(q_len: int, kv_len: int, offset=0, dtype=jnp.bool_, window: Optional[int] = None) -> jnp.ndarray:
+    """[1, 1, q_len, kv_len] causal mask; ``offset`` = absolute position of q row 0.
+    ``window`` adds a sliding-window lower bound (mistral-style local attention)."""
     rows = jnp.arange(q_len)[:, None] + offset
     cols = jnp.arange(kv_len)[None, :]
-    return (cols <= rows).astype(dtype)[None, None]
+    mask = cols <= rows
+    if window is not None:
+        mask = mask & (cols > rows - window)
+    return mask.astype(dtype)[None, None]
 
 
 def make_segment_mask(q_segments: jnp.ndarray, kv_segments: jnp.ndarray) -> jnp.ndarray:
@@ -49,6 +53,7 @@ def dot_product_attention(
     scale: Optional[float] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    window: Optional[int] = None,
     use_pallas: bool = False,
 ) -> jnp.ndarray:
     """Fused attention; returns [B, T, n_heads, head_dim] in query dtype."""
@@ -58,7 +63,7 @@ def dot_product_attention(
 
     mask = None
     if causal:
-        mask = jnp.broadcast_to(make_causal_mask(T, S, q_offset), (B, 1, T, S))
+        mask = jnp.broadcast_to(make_causal_mask(T, S, q_offset, window=window), (B, 1, T, S))
     if segment_ids is not None:
         q_seg = segment_ids[:, -T:] if T != S else segment_ids
         seg_mask = make_segment_mask(q_seg, segment_ids)

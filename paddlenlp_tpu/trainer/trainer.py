@@ -215,8 +215,13 @@ class Trainer:
             raise ValueError("training requires `labels` in inputs (or override compute_loss)")
         logits = outputs.logits if hasattr(outputs, "logits") else outputs[0]
         if self.criterion is not None:
-            return self.criterion(logits, labels)
-        return causal_lm_loss(logits, labels, shift=True)
+            loss = self.criterion(logits, labels)
+        else:
+            loss = causal_lm_loss(logits, labels, shift=True)
+        aux = getattr(outputs, "aux_loss", None)
+        if aux is not None:  # MoE router load-balancing (pre-weighted by its coef)
+            loss = loss + aux
+        return loss
 
     # ------------------------------------------------------------------ train step
     def _build_train_step(self):

@@ -1,5 +1,31 @@
+from .auto import (  # noqa: F401
+    AutoConfig,
+    AutoModel,
+    AutoModelForCausalLM,
+    AutoModelForCausalLMPipe,
+    AutoModelForMaskedLM,
+    AutoModelForSequenceClassification,
+    AutoModelForTokenClassification,
+    AutoTokenizer,
+)
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertForTokenClassification,
+    BertModel,
+)
 from .cache_utils import KVCache, init_cache  # noqa: F401
 from .configuration_utils import LlmMetaConfig, PretrainedConfig  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieForTokenClassification,
+    ErnieModel,
+)
+from .gemma import GemmaConfig, GemmaForCausalLM, GemmaModel  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaForCausalLM,
@@ -7,6 +33,8 @@ from .llama import (  # noqa: F401
     LlamaModel,
     LlamaPretrainingCriterion,
 )
+from .mistral import MistralConfig, MistralForCausalLM, MistralModel  # noqa: F401
+from .mixtral import MixtralConfig, MixtralForCausalLM, MixtralModel  # noqa: F401
 from .model_outputs import (  # noqa: F401
     BaseModelOutput,
     BaseModelOutputWithPast,
@@ -14,3 +42,6 @@ from .model_outputs import (  # noqa: F401
     ModelOutput,
 )
 from .model_utils import PretrainedModel  # noqa: F401
+from .qwen2 import Qwen2Config, Qwen2ForCausalLM, Qwen2ForSequenceClassification, Qwen2Model  # noqa: F401
+from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM, Qwen2MoeModel  # noqa: F401
+from .tokenizer_utils import BatchEncoding, PretrainedTokenizer  # noqa: F401

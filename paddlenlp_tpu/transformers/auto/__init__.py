@@ -1,0 +1,10 @@
+from .configuration import AutoConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    AutoModel,
+    AutoModelForCausalLM,
+    AutoModelForCausalLMPipe,
+    AutoModelForMaskedLM,
+    AutoModelForSequenceClassification,
+    AutoModelForTokenClassification,
+)
+from .tokenizer import AutoTokenizer  # noqa: F401

@@ -1,0 +1,56 @@
+"""AutoConfig (reference: paddlenlp/transformers/auto/configuration.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["AutoConfig", "CONFIG_MAPPING", "register_config"]
+
+CONFIG_MAPPING: Dict[str, Type[PretrainedConfig]] = {}
+
+
+def register_config(model_type: str, config_class: Type[PretrainedConfig]):
+    CONFIG_MAPPING[model_type] = config_class
+
+
+def _populate():
+    if CONFIG_MAPPING:
+        return
+    from ..bert.configuration import BertConfig
+    from ..ernie.configuration import ErnieConfig
+    from ..gemma.configuration import GemmaConfig
+    from ..gpt.configuration import GPTConfig
+    from ..llama.configuration import LlamaConfig
+    from ..mistral.configuration import MistralConfig
+    from ..mixtral.configuration import MixtralConfig
+    from ..qwen2.configuration import Qwen2Config
+    from ..qwen2_moe.configuration import Qwen2MoeConfig
+
+    for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
+                ErnieConfig, MixtralConfig, Qwen2MoeConfig):
+        register_config(cfg.model_type, cfg)
+    register_config("gpt2", GPTConfig)
+
+
+class AutoConfig:
+    @classmethod
+    def from_pretrained(cls, pretrained_model_name_or_path, **kwargs) -> PretrainedConfig:
+        _populate()
+        config_dict, kwargs = PretrainedConfig.get_config_dict(pretrained_model_name_or_path, **kwargs)
+        model_type = config_dict.get("model_type")
+        if model_type in CONFIG_MAPPING:
+            return CONFIG_MAPPING[model_type].from_dict(config_dict, **kwargs)
+        # fall back: architectures hint
+        for arch in config_dict.get("architectures") or []:
+            for mt, ccls in CONFIG_MAPPING.items():
+                if arch.lower().startswith(mt.replace("_", "")):
+                    return ccls.from_dict(config_dict, **kwargs)
+        raise ValueError(
+            f"unrecognized model_type {model_type!r}; known: {sorted(CONFIG_MAPPING)}"
+        )
+
+    @staticmethod
+    def register(model_type: str, config_class):
+        register_config(model_type, config_class)

@@ -1,0 +1,117 @@
+"""Auto model classes (reference: paddlenlp/transformers/auto/modeling.py —
+``AutoModelForCausalLM`` incl. the ``AutoModelForCausalLMPipe`` variant; under one
+mesh-driven network per model there is no separate Pipe class to dispatch to)."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from ..configuration_utils import PretrainedConfig
+from .configuration import CONFIG_MAPPING, AutoConfig, _populate
+
+__all__ = [
+    "AutoModel",
+    "AutoModelForCausalLM",
+    "AutoModelForSequenceClassification",
+    "AutoModelForMaskedLM",
+    "AutoModelForCausalLMPipe",
+]
+
+_MODEL_MAPPING: Dict[str, Dict[str, type]] = {}
+
+
+def register_model(model_type: str, task: str, model_class: type):
+    _MODEL_MAPPING.setdefault(model_type, {})[task] = model_class
+
+
+def _populate_models():
+    if _MODEL_MAPPING:
+        return
+    _populate()
+    from ..bert import modeling as bert
+    from ..ernie import modeling as ernie
+    from ..gemma import modeling as gemma
+    from ..gpt import modeling as gpt
+    from ..llama import modeling as llama
+    from ..mistral import modeling as mistral
+    from ..mixtral import modeling as mixtral
+    from ..qwen2 import modeling as qwen2
+    from ..qwen2_moe import modeling as qwen2_moe
+
+    register_model("llama", "base", llama.LlamaModel)
+    register_model("llama", "causal_lm", llama.LlamaForCausalLM)
+    register_model("llama", "sequence_classification", llama.LlamaForSequenceClassification)
+    register_model("gpt", "base", gpt.GPTModel)
+    register_model("gpt", "causal_lm", gpt.GPTForCausalLM)
+    register_model("gpt2", "base", gpt.GPTModel)
+    register_model("gpt2", "causal_lm", gpt.GPTForCausalLM)
+    register_model("qwen2", "base", qwen2.Qwen2Model)
+    register_model("qwen2", "causal_lm", qwen2.Qwen2ForCausalLM)
+    register_model("qwen2", "sequence_classification", qwen2.Qwen2ForSequenceClassification)
+    register_model("mistral", "base", mistral.MistralModel)
+    register_model("mistral", "causal_lm", mistral.MistralForCausalLM)
+    register_model("gemma", "base", gemma.GemmaModel)
+    register_model("gemma", "causal_lm", gemma.GemmaForCausalLM)
+    register_model("bert", "base", bert.BertModel)
+    register_model("bert", "masked_lm", bert.BertForMaskedLM)
+    register_model("bert", "sequence_classification", bert.BertForSequenceClassification)
+    register_model("bert", "token_classification", bert.BertForTokenClassification)
+    register_model("ernie", "base", ernie.ErnieModel)
+    register_model("ernie", "masked_lm", ernie.ErnieForMaskedLM)
+    register_model("ernie", "sequence_classification", ernie.ErnieForSequenceClassification)
+    register_model("ernie", "token_classification", ernie.ErnieForTokenClassification)
+    register_model("mixtral", "causal_lm", mixtral.MixtralForCausalLM)
+    register_model("qwen2_moe", "causal_lm", qwen2_moe.Qwen2MoeForCausalLM)
+
+
+class _AutoBase:
+    task = "base"
+
+    @classmethod
+    def _resolve(cls, pretrained_model_name_or_path, config=None, **kwargs):
+        _populate_models()
+        if config is None:
+            config = AutoConfig.from_pretrained(pretrained_model_name_or_path)
+        model_type = config.model_type
+        task_map = _MODEL_MAPPING.get(model_type)
+        if not task_map or cls.task not in task_map:
+            raise ValueError(f"no {cls.task} model registered for model_type={model_type!r}")
+        return task_map[cls.task], config
+
+    @classmethod
+    def from_pretrained(cls, pretrained_model_name_or_path, config=None, **kwargs):
+        model_class, config = cls._resolve(pretrained_model_name_or_path, config)
+        return model_class.from_pretrained(pretrained_model_name_or_path, config=config, **kwargs)
+
+    @classmethod
+    def from_config(cls, config, **kwargs):
+        _populate_models()
+        task_map = _MODEL_MAPPING.get(config.model_type)
+        if not task_map or cls.task not in task_map:
+            raise ValueError(f"no {cls.task} model registered for model_type={config.model_type!r}")
+        return task_map[cls.task].from_config(config, **kwargs)
+
+
+class AutoModel(_AutoBase):
+    task = "base"
+
+
+class AutoModelForCausalLM(_AutoBase):
+    task = "causal_lm"
+
+
+class AutoModelForSequenceClassification(_AutoBase):
+    task = "sequence_classification"
+
+
+class AutoModelForTokenClassification(_AutoBase):
+    task = "token_classification"
+
+
+class AutoModelForMaskedLM(_AutoBase):
+    task = "masked_lm"
+
+
+# The reference exposes AutoModelForCausalLMPipe for pipeline-parallel runs
+# (auto/modeling.py); here pipelining is a mesh axis on the SAME model class.
+AutoModelForCausalLMPipe = AutoModelForCausalLM

@@ -1,0 +1,14 @@
+"""AutoTokenizer (reference: paddlenlp/transformers/auto/tokenizer.py). One fast
+tokenizer class serves all models (tokenizer.json artifact)."""
+
+from __future__ import annotations
+
+from ..tokenizer_utils import PretrainedTokenizer
+
+__all__ = ["AutoTokenizer"]
+
+
+class AutoTokenizer:
+    @classmethod
+    def from_pretrained(cls, pretrained_model_name_or_path, **kwargs) -> PretrainedTokenizer:
+        return PretrainedTokenizer.from_pretrained(pretrained_model_name_or_path, **kwargs)

@@ -1,0 +1,8 @@
+from .configuration import BertConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertForTokenClassification,
+    BertModel,
+    BertPretrainedModel,
+)

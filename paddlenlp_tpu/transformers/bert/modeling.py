@@ -1,0 +1,285 @@
+"""BERT encoder family, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/bert/modeling.py``. Bidirectional encoder:
+word/position/token-type embeddings + post-LN transformer blocks + pooler, with
+MLM / sequence- / token-classification heads. Checkpoint keys follow HF bert
+(``bert.encoder.layer.N.attention.self.query.weight`` ...).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import BertConfig
+
+__all__ = [
+    "BertModel",
+    "BertForMaskedLM",
+    "BertForSequenceClassification",
+    "BertForTokenClassification",
+    "BertPretrainedModel",
+]
+
+from ..llama.modeling import ACT2FN
+
+
+def _dense(features, config, dtype, param_dtype, name):
+    return nn.Dense(features, use_bias=True, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(config.initializer_range), name=name)
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        init = nn.initializers.normal(cfg.initializer_range)
+        words = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init, name="word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                       param_dtype=self.param_dtype, embedding_init=init, name="position_embeddings")(position_ids)
+        types = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init, name="token_type_embeddings")(token_type_ids)
+        h = words + pos + types
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        return h
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        # self-attention (post-LN residual, HF layout attention.self / attention.output)
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_query")(h).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_key")(h).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_value")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        k = shard_constraint(k, P("batch", None, "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", None, "act_kv_heads", None))
+        drop = cfg.attention_probs_dropout_prob if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask, causal=False,
+                                     dropout_rate=drop, dropout_rng=rng).reshape(B, T, D)
+        attn = _dense(D, cfg, self.dtype, self.param_dtype, "attention_output_dense")(attn)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="attention_output_LayerNorm")(h + attn)
+        # feed-forward
+        ff = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "intermediate_dense")(h)
+        ff = ACT2FN[cfg.hidden_act](ff)
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dense(D, cfg, self.dtype, self.param_dtype, "output_dense")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="output_LayerNorm")(h + ff)
+        return h
+
+
+
+class BertModule(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = BertEmbeddings(cfg, self.dtype, self.param_dtype, name="embeddings")(
+            input_ids, token_type_ids, position_ids, deterministic
+        )
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        all_hidden = [] if output_hidden_states else None
+        for i in range(cfg.num_hidden_layers):
+            if output_hidden_states:
+                all_hidden.append(h)
+            h = BertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic
+            )
+        if output_hidden_states:
+            all_hidden.append(h)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "pooler_dense")(h[:, 0])
+            pooled = jnp.tanh(pooled)
+        if not return_dict:
+            return (h, pooled)
+        return BaseModelOutputWithPoolingAndCrossAttentions(
+            last_hidden_state=h, pooler_output=pooled,
+            hidden_states=tuple(all_hidden) if all_hidden else None,
+        )
+
+
+class BertForMaskedLMModule(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = BertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False, name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, output_hidden_states, True
+        )
+        h = outputs.last_hidden_state
+        h = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "predictions_transform_dense")(h)
+        h = ACT2FN[cfg.hidden_act](h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="predictions_transform_LayerNorm")(h)
+        embedding = self.get_variable("params", "bert")["embeddings"]["word_embeddings"]["embedding"]
+        bias = self.param("predictions_bias", nn.initializers.zeros, (cfg.vocab_size,), self.param_dtype)
+        logits = h @ embedding.T.astype(self.dtype) + bias.astype(self.dtype)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits,)
+        return MaskedLMOutput(logits=logits, hidden_states=outputs.hidden_states)
+
+
+class BertForSequenceClassificationModule(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = BertModule(cfg, self.dtype, self.param_dtype, name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        pooled = outputs.pooler_output
+        dropout = cfg.classifier_dropout if cfg.classifier_dropout is not None else cfg.hidden_dropout_prob
+        if not deterministic and dropout > 0:
+            pooled = nn.Dropout(dropout)(pooled, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier")(pooled)
+        if not return_dict:
+            return (logits,)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class BertForTokenClassificationModule(nn.Module):
+    config: BertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = BertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False, name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        h = outputs.last_hidden_state
+        dropout = cfg.classifier_dropout if cfg.classifier_dropout is not None else cfg.hidden_dropout_prob
+        if not deterministic and dropout > 0:
+            h = nn.Dropout(dropout)(h, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier")(h)
+        if not return_dict:
+            return (logits,)
+        return TokenClassifierOutput(logits=logits)
+
+
+class BertPretrainedModel(PretrainedModel):
+    config_class = BertConfig
+    base_model_prefix = "bert"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"(position|token_type)_embeddings/embedding$", P(None, "embed")),
+            (r"attention_self_(query|key|value)/kernel$", P("embed", "heads")),
+            (r"attention_self_(query|key|value)/bias$", P("heads")),
+            (r"attention_output_dense/kernel$", P("heads", "embed")),
+            (r"intermediate_dense/kernel$", P("embed", "mlp")),
+            (r"intermediate_dense/bias$", P("mlp")),
+            (r"output_dense/kernel$", P("mlp", "embed")),
+            (r"LayerNorm/(scale|bias)$", P()),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        """Our flat module names -> HF dotted names (encoder_layer_N -> encoder.layer.N,
+        attention_self_query -> attention.self.query, ...)."""
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = path
+            key = key.replace("encoder_layer_", "encoder@layer@")
+            key = key.replace("attention_self_", "attention@self@")
+            key = key.replace("attention_output_LayerNorm", "attention@output@LayerNorm")
+            key = key.replace("attention_output_dense", "attention@output@dense")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("predictions_transform_LayerNorm", "cls@predictions@transform@LayerNorm")
+            key = key.replace("predictions_transform_dense", "cls@predictions@transform@dense")
+            key = key.replace("predictions_bias", "cls@predictions@bias")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith(".kernel") or key.endswith(".scale") or key.endswith(".embedding"):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class BertModel(BertPretrainedModel):
+    module_class = BertModule
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+
+class BertForMaskedLM(BertPretrainedModel):
+    module_class = BertForMaskedLMModule
+    _keys_to_ignore_on_load_missing = [r"predictions"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.seq_relationship", r"\.decoder\.", r"position_ids"]
+
+
+class BertForSequenceClassification(BertPretrainedModel):
+    module_class = BertForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.", r"position_ids"]
+
+
+class BertForTokenClassification(BertPretrainedModel):
+    module_class = BertForTokenClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.", r"pooler", r"position_ids"]

@@ -51,24 +51,37 @@ class StateDictNameMapping:
 
 @dataclasses.dataclass
 class StackedLayerMapping:
-    """One stacked target param [L, ...] <- L per-layer checkpoint keys.
+    """One stacked target param [d0, d1, ..., ...] <- product(d_i) checkpoint keys.
 
-    Used by the scanned-layer model path (lax.scan over a stacked layer axis):
-    checkpoints stay in HF per-layer format; stacking/unstacking happens here, so
-    scan and unrolled models produce byte-identical checkpoints.
+    Used by the scanned-layer model path (lax.scan over a stacked layer axis) and
+    stacked-expert MoE weights: checkpoints stay in HF per-layer/per-expert format;
+    stacking/unstacking happens here, so scan and unrolled models produce
+    byte-identical checkpoints. ``dims`` holds one entry per stacked leading axis
+    (e.g. (n_layers,) or (n_layers, n_experts)); the template carries one ``{}``
+    slot per dim.
     """
 
     source_template: str  # e.g. "model.layers.{}.self_attn.q_proj.weight"
     target_name: str  # e.g. "model/layers/self_attn/q_proj/kernel"
-    n_layers: int = 0
-    action: Optional[str] = None  # applied per layer slice
+    n_layers: int = 0  # legacy single-dim spelling
+    action: Optional[str] = None  # applied per slice
+    dims: Optional[tuple] = None
+
+    def __post_init__(self):
+        if self.dims is None:
+            self.dims = (self.n_layers,)
 
     @property
     def source_name(self) -> str:  # for unified bookkeeping/messages
         return self.source_template
 
+    def _indices(self):
+        import itertools
+
+        return itertools.product(*(range(d) for d in self.dims))
+
     def source_names(self) -> List[str]:
-        return [self.source_template.format(i) for i in range(self.n_layers)]
+        return [self.source_template.format(*idx) for idx in self._indices()]
 
     def apply_stack(self, get_source: Callable[[str], Optional[np.ndarray]]) -> Optional[np.ndarray]:
         slices = []
@@ -79,15 +92,17 @@ class StackedLayerMapping:
             if self.action == "transpose":
                 arr = np.ascontiguousarray(np.asarray(arr).T)
             slices.append(np.asarray(arr))
-        return np.stack(slices, axis=0)
+        stacked = np.stack(slices, axis=0)
+        return stacked.reshape(tuple(self.dims) + stacked.shape[1:])
 
     def reverse_unstack(self, array: np.ndarray) -> Dict[str, np.ndarray]:
         out = {}
-        for i in range(array.shape[0]):
-            a = array[i]
+        flat = array.reshape((-1,) + array.shape[len(self.dims):])
+        for j, idx in enumerate(self._indices()):
+            a = flat[j]
             if self.action == "transpose":
                 a = np.ascontiguousarray(a.T)
-            out[self.source_template.format(i)] = a
+            out[self.source_template.format(*idx)] = a
         return out
 
 

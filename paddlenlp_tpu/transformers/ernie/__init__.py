@@ -1,0 +1,7 @@
+from .configuration import ErnieConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieForTokenClassification,
+    ErnieModel,
+)

@@ -1,0 +1,22 @@
+"""ERNIE configuration (reference: paddlenlp/transformers/ernie/configuration.py).
+
+ERNIE 1.0/3.0 are BERT-architecture encoders (knowledge-masking pretraining differs,
+the network does not); task_type embeddings are the one structural addition.
+"""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["ErnieConfig"]
+
+
+class ErnieConfig(BertConfig):
+    model_type = "ernie"
+
+    def __init__(self, vocab_size: int = 18000, use_task_id: bool = False, task_type_vocab_size: int = 3, **kwargs):
+        kwargs.setdefault("intermediate_size", 3072)
+        kwargs.setdefault("hidden_act", "gelu")
+        super().__init__(vocab_size=vocab_size, **kwargs)
+        self.use_task_id = use_task_id
+        self.task_type_vocab_size = task_type_vocab_size

@@ -1,0 +1,61 @@
+"""ERNIE, TPU-native (reference: paddlenlp/transformers/ernie/modeling.py).
+
+Network-identical to BERT (see configuration.py); the modules are reused with the
+``ernie`` base prefix so checkpoints keyed ``ernie.encoder.layer...`` load.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..bert.modeling import (
+    BertForMaskedLMModule,
+    BertForSequenceClassificationModule,
+    BertForTokenClassificationModule,
+    BertModule,
+    BertPretrainedModel,
+)
+from .configuration import ErnieConfig
+
+__all__ = ["ErnieModel", "ErnieForMaskedLM", "ErnieForSequenceClassification",
+           "ErnieForTokenClassification", "ErniePretrainedModel"]
+
+
+class ErniePretrainedModel(BertPretrainedModel):
+    config_class = ErnieConfig
+    base_model_prefix = "ernie"
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        mappings = super()._get_name_mappings(config, flat_shapes)
+        for m in mappings:
+            if m.source_name.startswith("bert."):
+                m.source_name = "ernie." + m.source_name[len("bert."):]
+        return mappings
+
+
+class ErnieModel(ErniePretrainedModel):
+    module_class = BertModule
+
+
+class _ErnieMaskedLMModule(BertForMaskedLMModule):
+    pass
+
+
+class ErnieForMaskedLM(ErniePretrainedModel):
+    module_class = BertForMaskedLMModule
+    _keys_to_ignore_on_load_missing = [r"predictions"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.", r"position_ids"]
+
+
+class ErnieForSequenceClassification(ErniePretrainedModel):
+    module_class = BertForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.", r"position_ids"]
+
+
+class ErnieForTokenClassification(ErniePretrainedModel):
+    module_class = BertForTokenClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.", r"pooler", r"position_ids"]

@@ -1,0 +1,2 @@
+from .configuration import GemmaConfig  # noqa: F401
+from .modeling import GemmaForCausalLM, GemmaModel  # noqa: F401

@@ -1,0 +1,40 @@
+"""Gemma, TPU-native (reference: paddlenlp/transformers/gemma/modeling.py).
+
+Gemma = the LLaMA graph with three conventions the shared modules read from config:
+(1+scale) RMSNorm (``rms_norm_add_unit_offset``), sqrt(hidden) embedding scaling
+(``scale_embeddings``), tanh-gelu MLP, tied embeddings, explicit head_dim.
+"""
+
+from __future__ import annotations
+
+from ..llama.modeling import (
+    LlamaForCausalLMModule,
+    LlamaForSequenceClassificationModule,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from .configuration import GemmaConfig
+
+__all__ = ["GemmaModel", "GemmaForCausalLM", "GemmaPretrainedModel"]
+
+
+class GemmaPretrainedModel(LlamaPretrainedModel):
+    config_class = GemmaConfig
+
+
+class GemmaModel(GemmaPretrainedModel):
+    module_class = LlamaModule
+
+
+class GemmaForCausalLM(GemmaPretrainedModel):
+    module_class = LlamaForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+class GemmaForSequenceClassification(GemmaPretrainedModel):
+    module_class = LlamaForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"score"]
+
+
+GemmaPretrainingCriterion = LlamaPretrainingCriterion

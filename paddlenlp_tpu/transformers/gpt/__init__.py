@@ -1,0 +1,2 @@
+from .configuration import GPTConfig  # noqa: F401
+from .modeling import GPTForCausalLM, GPTModel, GPTPretrainedModel  # noqa: F401

@@ -1,0 +1,54 @@
+"""GPT/GPT-2 configuration (reference: paddlenlp/transformers/gpt/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["GPTConfig"]
+
+
+class GPTConfig(PretrainedConfig):
+    model_type = "gpt"
+    attribute_map = {
+        "n_embd": "hidden_size",
+        "n_layer": "num_hidden_layers",
+        "n_head": "num_attention_heads",
+        "n_positions": "max_position_embeddings",
+        "n_inner": "intermediate_size",
+        "activation_function": "hidden_act",
+    }
+
+    def __init__(
+        self,
+        vocab_size: int = 50257,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        intermediate_size: int = None,
+        hidden_act: str = "gelu_new",
+        max_position_embeddings: int = 1024,
+        initializer_range: float = 0.02,
+        layer_norm_epsilon: float = 1e-5,
+        embd_pdrop: float = 0.1,
+        attn_pdrop: float = 0.1,
+        resid_pdrop: float = 0.1,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size if intermediate_size else 4 * hidden_size
+        self.hidden_act = hidden_act
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.embd_pdrop = embd_pdrop
+        self.attn_pdrop = attn_pdrop
+        self.resid_pdrop = resid_pdrop
+        self.num_key_value_heads = num_attention_heads
+        self.head_dim = hidden_size // num_attention_heads
+        kwargs.setdefault("tie_word_embeddings", True)
+        kwargs.setdefault("bos_token_id", 50256)
+        kwargs.setdefault("eos_token_id", 50256)
+        super().__init__(**kwargs)

@@ -1,0 +1,263 @@
+"""GPT-2-family, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/gpt/modeling.py`` (+ modeling_pp/auto).
+Architecture: learned position embeddings, pre-LN blocks, FUSED qkv (``c_attn``
+[D, 3D] — the reference's ``fuse_attention_qkv`` option is the native layout here),
+gelu MLP, tied LM head. Checkpoint keys follow HF gpt2 (``transformer.h.N...``,
+Conv1D kernels stored [in, out] — no transpose on load).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import GPTConfig
+
+__all__ = ["GPTModel", "GPTForCausalLM", "GPTPretrainedModel", "GPTPretrainingCriterion"]
+
+from ..llama.modeling import ACT2FN, _maybe_remat
+from ..llama.modeling import LlamaPretrainingCriterion as GPTPretrainingCriterion  # same parallel CE
+
+
+def _gpt_dense(features, config, dtype, param_dtype, name):
+    return nn.Dense(
+        features,
+        use_bias=True,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=nn.initializers.normal(config.initializer_range),
+        name=name,
+    )
+
+
+class GPTBlock(nn.Module):
+    """ln_1 -> fused-qkv attention -> ln_2 -> mlp (scan-compatible carry)."""
+
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_kv, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        B, T, D = h.shape
+        n_heads, head_dim = cfg.num_attention_heads, cfg.head_dim
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_1")(h)
+        attn = GPTAttention(cfg, self.dtype, self.param_dtype, name="attn")
+        attn_out, new_kv = attn(x, attention_mask, segment_ids, layer_kv, offset, deterministic)
+        h = h + attn_out
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_2")(h)
+        mlp = GPTMLP(cfg, self.dtype, self.param_dtype, name="mlp")
+        h = h + mlp(x, deterministic)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_kv
+
+
+class GPTAttention(nn.Module):
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, layer_kv, offset, deterministic):
+        cfg = self.config
+        B, T, D = x.shape
+        n_heads, head_dim = cfg.num_attention_heads, cfg.head_dim
+        qkv = _gpt_dense(3 * D, cfg, self.dtype, self.param_dtype, "c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, n_heads, head_dim)
+        k = k.reshape(B, T, n_heads, head_dim)
+        v = v.reshape(B, T, n_heads, head_dim)
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+        q_offset = 0
+        new_kv = None
+        if layer_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(layer_kv[0], layer_kv[1], k, v, offset)
+            new_kv = (k, v)
+        dropout_rate = cfg.attn_pdrop if not deterministic else 0.0
+        rng = self.make_rng("dropout") if dropout_rate > 0.0 else None
+        out = dot_product_attention(
+            q, k, v, attention_mask=attention_mask, segment_ids=segment_ids, causal=True,
+            q_offset=q_offset, dropout_rate=dropout_rate, dropout_rng=rng,
+        )
+        out = out.reshape(B, T, D)
+        out = _gpt_dense(D, cfg, self.dtype, self.param_dtype, "c_proj")(out)
+        if not deterministic and cfg.resid_pdrop > 0:
+            out = nn.Dropout(cfg.resid_pdrop)(out, deterministic=False)
+        return out, new_kv
+
+
+class GPTMLP(nn.Module):
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = _gpt_dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "c_fc")(x)
+        h = ACT2FN[cfg.hidden_act](h)
+        h = shard_constraint(h, P("batch", "seq", "act_mlp"))
+        h = _gpt_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "c_proj")(h)
+        if not deterministic and cfg.resid_pdrop > 0:
+            h = nn.Dropout(cfg.resid_pdrop)(h, deterministic=False)
+        return h
+
+
+class GPTModule(nn.Module):
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[KVCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        B, T = input_ids.shape if input_ids is not None else inputs_embeds.shape[:2]
+        if inputs_embeds is None:
+            wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                           embedding_init=nn.initializers.normal(cfg.initializer_range), name="wte")
+            inputs_embeds = wte(input_ids)
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :] + offset
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       embedding_init=nn.initializers.normal(cfg.initializer_range), name="wpe")
+        h = inputs_embeds + wpe(position_ids)
+        if not deterministic and cfg.embd_pdrop > 0:
+            h = nn.Dropout(cfg.embd_pdrop)(h, deterministic=False)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+        layer_cls = _maybe_remat(GPTBlock, cfg)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+        aux = jnp.zeros((), jnp.float32)
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
+            )
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="h")(
+                (h, offset, aux), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                cache = KVCache(keys=new_kv[0], values=new_kv[1], offset=offset + T)
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"h_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_f")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(
+            last_hidden_state=h, past_key_values=cache,
+            hidden_states=tuple(all_hidden) if all_hidden else None,
+        )
+
+
+class GPTForCausalLMModule(nn.Module):
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        outputs = GPTModule(cfg, self.dtype, self.param_dtype, name="transformer")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        if cfg.tie_word_embeddings:
+            wte = self.get_variable("params", "transformer")["wte"]["embedding"]
+            logits = h @ wte.T.astype(self.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.initializer_range), name="lm_head")(h)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states)
+
+
+class GPTPretrainedModel(PretrainedModel):
+    config_class = GPTConfig
+    base_model_prefix = "transformer"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"wte/embedding$", P("vocab", "embed")),
+            (r"wpe/embedding$", P(None, "embed")),
+            (r"attn/c_attn/kernel$", P("embed", "heads")),
+            (r"attn/c_attn/bias$", P("heads")),
+            (r"attn/c_proj/kernel$", P("heads", "embed")),
+            (r"mlp/c_fc/kernel$", P("embed", "mlp")),
+            (r"mlp/c_fc/bias$", P("mlp")),
+            (r"mlp/c_proj/kernel$", P("mlp", "embed")),
+            (r"lm_head/kernel$", P("embed", "vocab")),
+            (r"(ln_1|ln_2|ln_f)/(scale|bias)$", P()),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import auto_name_mappings
+
+        mappings = auto_name_mappings(flat_shapes)
+        # HF gpt2 Conv1D kernels are stored [in, out] — identical to flax Dense:
+        # undo the default transpose action for them.
+        for m in mappings:
+            if any(t in m.target_name for t in ("/c_attn/", "/c_proj/", "/c_fc/")) and \
+                    m.target_name.endswith("/kernel"):
+                m.action = None
+        return mappings
+
+
+class GPTModel(GPTPretrainedModel):
+    module_class = GPTModule
+
+
+class GPTForCausalLM(GPTPretrainedModel):
+    module_class = GPTForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+    _keys_to_ignore_on_load_unexpected = [r"\.attn\.bias$", r"\.attn\.masked_bias$"]

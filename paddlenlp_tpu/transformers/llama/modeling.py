@@ -57,29 +57,36 @@ __all__ = [
 
 ACT2FN = {
     "silu": nn.silu,
-    "gelu": nn.gelu,
+    "gelu": partial(nn.gelu, approximate=False),
     "relu": nn.relu,
     "gelu_new": partial(nn.gelu, approximate=True),
+    "gelu_pytorch_tanh": partial(nn.gelu, approximate=True),
     "tanh": jnp.tanh,
 }
 
 
 class LlamaRMSNorm(nn.Module):
     """RMSNorm in fp32 (reference llama/modeling.py:352; the fused rms_norm custom op
-    fusion_ops.py:119 is unnecessary — XLA fuses this chain natively)."""
+    fusion_ops.py:119 is unnecessary — XLA fuses this chain natively).
+    ``unit_offset`` selects the gemma convention ((1 + scale) with zeros-init)."""
 
     dim: int
     eps: float = 1e-6
     param_dtype: jnp.dtype = jnp.float32
+    unit_offset: bool = False
 
     @nn.compact
     def __call__(self, x):
         dtype = x.dtype
-        scale = self.param("scale", nn.initializers.ones, (self.dim,), self.param_dtype)
+        init = nn.initializers.zeros if self.unit_offset else nn.initializers.ones
+        scale = self.param("scale", init, (self.dim,), self.param_dtype)
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         x32 = x32 * jax.lax.rsqrt(var + self.eps)
-        return (x32 * scale.astype(jnp.float32)).astype(dtype)
+        scale32 = scale.astype(jnp.float32)
+        if self.unit_offset:
+            scale32 = scale32 + 1.0
+        return (x32 * scale32).astype(dtype)
 
 
 def _dense(features, use_bias, config, dtype, param_dtype, name):
@@ -181,20 +188,29 @@ class LlamaAttention(nn.Module):
             q_offset=q_offset,
             dropout_rate=dropout_rate,
             dropout_rng=dropout_rng,
+            window=getattr(cfg, "sliding_window", None),
         )
         attn_out = checkpoint_name(attn_out, "core_attn")
         attn_out = attn_out.reshape(B, T, n_heads * head_dim)
-        out = _dense(cfg.hidden_size, cfg.attention_bias, cfg, self.dtype, self.param_dtype, "o_proj")(attn_out)
+        out_bias = getattr(cfg, "attention_out_bias", cfg.attention_bias)
+        out = _dense(cfg.hidden_size, out_bias, cfg, self.dtype, self.param_dtype, "o_proj")(attn_out)
         return out, new_kv
 
 
 class LlamaDecoderLayer(nn.Module):
     """Pre-norm residual block (reference :1122) with a scan-compatible signature:
-    ``(carry=(h, offset), layer_kv, ...) -> ((h, offset), new_layer_kv)``."""
+    ``(carry=(h, offset, aux), layer_kv, ...) -> ((h, offset, aux), new_layer_kv)``.
+    ``aux`` accumulates MoE load-balancing loss across layers (0.0 for dense MLP).
+
+    Variant architectures override the class attributes: ``mlp_cls``/``mlp_name``
+    (mixtral's block_sparse_moe, qwen2-moe) — the attention/norm skeleton is shared.
+    """
 
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    mlp_cls = LlamaMLP  # class attr, not a dataclass field (subclass-overridable)
+    mlp_name = "mlp"
 
     @nn.compact
     def __call__(
@@ -207,20 +223,26 @@ class LlamaDecoderLayer(nn.Module):
         deterministic: bool = True,
     ):
         cfg = self.config
-        hidden_states, offset = carry
+        hidden_states, offset, aux = carry
+        unit_offset = bool(getattr(cfg, "rms_norm_add_unit_offset", False))
         residual = hidden_states
-        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="input_layernorm")(hidden_states)
+        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, unit_offset=unit_offset,
+                         name="input_layernorm")(hidden_states)
         attn_out, new_kv = LlamaAttention(cfg, self.dtype, self.param_dtype, name="self_attn")(
             h, attention_mask, position_ids, segment_ids, layer_kv, offset, deterministic
         )
         h = residual + attn_out
         h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
         residual = h
-        h2 = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="post_attention_layernorm")(h)
-        h2 = LlamaMLP(cfg, self.dtype, self.param_dtype, name="mlp")(h2)
+        h2 = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, unit_offset=unit_offset,
+                          name="post_attention_layernorm")(h)
+        h2 = type(self).mlp_cls(cfg, self.dtype, self.param_dtype, name=type(self).mlp_name)(h2)
+        if isinstance(h2, tuple):  # MoE MLPs return (out, aux_loss)
+            h2, layer_aux = h2
+            aux = aux + layer_aux
         h = residual + h2
         h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
-        return (h, offset), new_kv
+        return (h, offset, aux), new_kv
 
 
 def _remat_policy(granularity: str):
@@ -255,6 +277,7 @@ class LlamaModule(nn.Module):
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    decoder_layer_cls = LlamaDecoderLayer  # class attr (subclass-overridable)
 
     @nn.compact
     def __call__(
@@ -280,10 +303,12 @@ class LlamaModule(nn.Module):
                 name="embed_tokens",
             )
             inputs_embeds = embed(input_ids)
+        if getattr(cfg, "scale_embeddings", False):  # gemma: h *= sqrt(hidden)
+            inputs_embeds = inputs_embeds * jnp.asarray(cfg.hidden_size**0.5, dtype=inputs_embeds.dtype)
         h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
         offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
 
-        layer_cls = _maybe_remat(LlamaDecoderLayer, cfg)
+        layer_cls = _maybe_remat(type(self).decoder_layer_cls, cfg)
         all_hidden = [] if output_hidden_states else None
         use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
 
@@ -296,20 +321,22 @@ class LlamaModule(nn.Module):
                 in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
                 length=cfg.num_hidden_layers,
             )
-            (h, _), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
-                (h, offset), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            aux0 = jnp.zeros((), jnp.float32)
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
+                (h, offset, aux0), scan_kv, attention_mask, position_ids, segment_ids, deterministic
             )
             if cache is not None:
                 cache = KVCache(keys=new_kv[0], values=new_kv[1],
                                 offset=offset + (input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]))
         else:
             new_keys, new_values = [], []
+            aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_hidden_layers):
                 if output_hidden_states:
                     all_hidden.append(h)
                 layer_kv = cache.layer(i) if cache is not None else None
-                (h, _), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
-                    (h, offset), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
                 )
                 if kv_i is not None:
                     new_keys.append(kv_i[0])
@@ -318,7 +345,11 @@ class LlamaModule(nn.Module):
                 T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
                 cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
 
-        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="norm")(h)
+        # normalize the layer-summed MoE aux loss to the HF convention (computed
+        # once over all layers' router logits, not summed per layer)
+        aux = aux / cfg.num_hidden_layers
+        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                         unit_offset=bool(getattr(cfg, "rms_norm_add_unit_offset", False)), name="norm")(h)
         if output_hidden_states:
             all_hidden.append(h)
         if not return_dict:
@@ -327,6 +358,7 @@ class LlamaModule(nn.Module):
             last_hidden_state=h,
             past_key_values=cache,
             hidden_states=tuple(all_hidden) if all_hidden else None,
+            aux_loss=aux,
         )
 
 
@@ -334,6 +366,7 @@ class LlamaForCausalLMModule(nn.Module):
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    base_module_cls = LlamaModule  # class attr (subclass-overridable)
 
     @nn.compact
     def __call__(
@@ -349,7 +382,7 @@ class LlamaForCausalLMModule(nn.Module):
         return_dict: bool = True,
     ):
         cfg = self.config
-        outputs = LlamaModule(cfg, self.dtype, self.param_dtype, name="model")(
+        outputs = type(self).base_module_cls(cfg, self.dtype, self.param_dtype, name="model")(
             input_ids,
             attention_mask,
             position_ids,
@@ -376,6 +409,7 @@ class LlamaForCausalLMModule(nn.Module):
             logits=logits,
             past_key_values=outputs.past_key_values,
             hidden_states=outputs.hidden_states,
+            aux_loss=outputs.aux_loss,
         )
 
 

@@ -1,0 +1,39 @@
+"""Mistral, TPU-native (reference: paddlenlp/transformers/mistral/modeling.py).
+
+Mistral = the LLaMA graph + sliding-window local attention (config.sliding_window,
+honored by the shared attention's windowed causal mask) + GQA defaults.
+"""
+
+from __future__ import annotations
+
+from ..llama.modeling import (
+    LlamaForCausalLMModule,
+    LlamaForSequenceClassificationModule,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from .configuration import MistralConfig
+
+__all__ = ["MistralModel", "MistralForCausalLM", "MistralForSequenceClassification", "MistralPretrainedModel"]
+
+
+class MistralPretrainedModel(LlamaPretrainedModel):
+    config_class = MistralConfig
+
+
+class MistralModel(MistralPretrainedModel):
+    module_class = LlamaModule
+
+
+class MistralForCausalLM(MistralPretrainedModel):
+    module_class = LlamaForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+class MistralForSequenceClassification(MistralPretrainedModel):
+    module_class = LlamaForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"score"]
+
+
+MistralPretrainingCriterion = LlamaPretrainingCriterion

@@ -1,0 +1,2 @@
+from .configuration import MixtralConfig  # noqa: F401
+from .modeling import MixtralForCausalLM, MixtralModel  # noqa: F401

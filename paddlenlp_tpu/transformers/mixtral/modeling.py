@@ -1,0 +1,94 @@
+"""Mixtral (sparse MoE), TPU-native.
+
+Counterpart of ``paddlenlp/transformers/mixtral/modeling.py``. The attention/norm
+skeleton is the shared LLaMA graph; the MLP is the stacked-expert ``MoEMLP``
+(one einsum per projection over [E, D, F] weights — MXU-friendly — instead of the
+reference's per-expert masked loop). Expert parallelism is the ``expert`` logical
+axis; the aux load-balancing loss rides the layer carry through ``lax.scan``.
+
+Checkpoint interop: HF stores per-expert ``block_sparse_moe.experts.{e}.w1/w2/w3``;
+the explicit mappings below stack/unstack them (layers x experts for scan mode).
+"""
+
+from __future__ import annotations
+
+from ...parallel.partition import P
+from ..conversion_utils import StackedLayerMapping, auto_name_mappings
+from ..llama.modeling import (
+    LlamaDecoderLayer,
+    LlamaForCausalLMModule,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from ..moe_layers import MoEMLP
+from .configuration import MixtralConfig
+
+__all__ = ["MixtralModel", "MixtralForCausalLM", "MixtralPretrainedModel"]
+
+
+class MixtralMoEMLP(MoEMLP):
+    gate_name = "gate"
+    names = ("w1", "w3", "w2")  # HF mixtral: w1=gate, w3=up, w2=down
+
+
+class MixtralDecoderLayer(LlamaDecoderLayer):
+    mlp_cls = MixtralMoEMLP
+    mlp_name = "block_sparse_moe"
+
+
+class MixtralModule(LlamaModule):
+    decoder_layer_cls = MixtralDecoderLayer
+
+
+class MixtralForCausalLMModule(LlamaForCausalLMModule):
+    base_module_cls = MixtralModule
+
+
+class MixtralPretrainedModel(LlamaPretrainedModel):
+    config_class = MixtralConfig
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return list(LlamaPretrainedModel.get_partition_rules(config)) + [
+            (r"block_sparse_moe/gate/kernel$", P("embed", None)),
+            (r"block_sparse_moe/(w1|w3)$", P("expert", "embed", "mlp")),
+            (r"block_sparse_moe/w2$", P("expert", "mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        expert_paths = {}
+        plain = {}
+        for path, leaf in flat_shapes.items():
+            if "/block_sparse_moe/" in path and path.rsplit("/", 1)[-1] in ("w1", "w2", "w3"):
+                expert_paths[path] = leaf
+            else:
+                plain[path] = leaf
+        mappings = auto_name_mappings(plain)
+        n_layers = config.num_hidden_layers
+        n_experts = config.num_local_experts
+        for path, leaf in expert_paths.items():
+            wname = path.rsplit("/", 1)[-1]
+            scan = "/layers/" in f"/{path}"
+            if scan:
+                template = f"model.layers.{{}}.block_sparse_moe.experts.{{}}.{wname}.weight"
+                dims = (n_layers, n_experts)
+            else:
+                layer_idx = path.split("/layers_")[1].split("/")[0]
+                template = f"model.layers.{layer_idx}.block_sparse_moe.experts.{{}}.{wname}.weight"
+                dims = (n_experts,)
+            mappings.append(StackedLayerMapping(template, path, action="transpose", dims=dims))
+        return mappings
+
+
+class MixtralModel(MixtralPretrainedModel):
+    module_class = MixtralModule
+
+
+class MixtralForCausalLM(MixtralPretrainedModel):
+    module_class = MixtralForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+MixtralPretrainingCriterion = LlamaPretrainingCriterion

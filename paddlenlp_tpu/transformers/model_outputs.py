@@ -76,6 +76,7 @@ class BaseModelOutputWithPast(ModelOutput):
     past_key_values: Any = None
     hidden_states: Optional[Tuple] = None
     attentions: Optional[Tuple] = None
+    aux_loss: Any = None  # MoE load-balancing loss (0/None for dense models)
 
 
 class BaseModelOutputWithPoolingAndCrossAttentions(ModelOutput):
@@ -98,6 +99,7 @@ class CausalLMOutputWithPast(ModelOutput):
     past_key_values: Any = None
     hidden_states: Optional[Tuple] = None
     attentions: Optional[Tuple] = None
+    aux_loss: Any = None  # MoE load-balancing loss (0/None for dense models)
 
 
 class MoECausalLMOutputWithPast(ModelOutput):

@@ -1,0 +1,108 @@
+"""Mixture-of-Experts feed-forward blocks.
+
+Counterpart of ``paddlenlp/transformers/qwen2_moe/modeling.py:686``
+(``Qwen2MoeSparseMoEBlock``) and the mixtral MoE block. The reference computes MoE
+densely (every expert on every token, gathered by mask) and expresses expert
+parallelism as "exclude expert params from dp allreduce" (``use_expert_parallel``,
+trainer.py:1079-1085). TPU-native:
+
+- expert weights are ONE stacked tensor [E, ...] — a single einsum per projection
+  keeps the MXU busy instead of looping E small matmuls;
+- routing is top-k softmax with dense weighted combine (exact — no token dropping;
+  capacity-based dispatch is a later optimization);
+- expert parallelism = the ``expert`` logical axis on the stacked dim (rides the
+  data axes per the reference's EP-over-dp design); GSPMD partitions the einsum;
+- the load-balancing aux loss (Switch/Mixtral style) is threaded through the layer
+  carry so it survives ``lax.scan`` over layers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel.partition import P, shard_constraint
+
+__all__ = ["MoEMLP", "load_balancing_loss"]
+
+
+def load_balancing_loss(router_probs: jnp.ndarray, expert_mask: jnp.ndarray, num_experts: int, top_k: int):
+    """Switch-transformer aux loss: E * sum_e f_e * P_e (f = token fraction to e,
+    P = mean router prob for e)."""
+    # router_probs [N, E]; expert_mask [N, E] in {0,1} (top-k selections)
+    tokens_per_expert = expert_mask.mean(axis=0) / top_k
+    prob_per_expert = router_probs.mean(axis=0)
+    return num_experts * jnp.sum(tokens_per_expert * prob_per_expert)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts (+ optional always-on shared expert, qwen2-moe
+    style). Param names follow the host model's HF convention via ``names``."""
+
+    config: object
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    # class attributes (NOT dataclass fields) so subclasses can override them
+    gate_name = "gate"  # router linear
+    names = ("w1", "w3", "w2")  # (gate/up/down) param names, mixtral order
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.config
+        E = cfg.num_local_experts
+        K = cfg.num_experts_per_tok
+        D = cfg.hidden_size
+        F = cfg.moe_intermediate_size
+        B, T, _ = x.shape
+        act = nn.silu
+
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32, param_dtype=self.param_dtype,
+                          kernel_init=nn.initializers.normal(cfg.initializer_range), name=type(self).gate_name)
+        router_logits = router(x.astype(jnp.float32)).reshape(-1, E)  # [N, E] fp32 routing
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        topk_probs, topk_idx = jax.lax.top_k(probs, K)  # [N, K]
+        if getattr(cfg, "norm_topk_prob", True):
+            topk_probs = topk_probs / jnp.clip(topk_probs.sum(-1, keepdims=True), 1e-9)
+        # dense combine weights [N, E]: prob if selected else 0
+        combine = jnp.zeros_like(probs)
+        combine = jax.vmap(lambda c, i, p: c.at[i].set(p))(combine, topk_idx, topk_probs)
+
+        init = nn.initializers.normal(cfg.initializer_range)
+        gname, uname, dname = type(self).names
+        w_gate = self.param(gname, init, (E, D, F), self.param_dtype)
+        w_up = self.param(uname, init, (E, D, F), self.param_dtype)
+        w_down = self.param(dname, init, (E, F, D), self.param_dtype)
+        w_gate_ = shard_constraint(w_gate.astype(self.dtype), P("expert", "embed", "mlp"))
+        w_up_ = shard_constraint(w_up.astype(self.dtype), P("expert", "embed", "mlp"))
+        w_down_ = shard_constraint(w_down.astype(self.dtype), P("expert", "mlp", "embed"))
+
+        xf = x.reshape(-1, D)
+        # dense expert compute: [N, E, F] — exact, no token dropping
+        g = jnp.einsum("nd,edf->nef", xf, w_gate_)
+        u = jnp.einsum("nd,edf->nef", xf, w_up_)
+        h = act(g) * u
+        expert_out = jnp.einsum("nef,efd->ned", h, w_down_)
+        out = jnp.einsum("ned,ne->nd", expert_out, combine.astype(expert_out.dtype))
+
+        # optional qwen2-moe shared expert (+ sigmoid gate)
+        if getattr(cfg, "shared_expert_intermediate_size", 0):
+            Fs = cfg.shared_expert_intermediate_size
+            from .llama.modeling import _dense
+
+            shared_gate = _dense(Fs, False, cfg, self.dtype, self.param_dtype, "shared_expert_gate_proj")
+            shared_up = _dense(Fs, False, cfg, self.dtype, self.param_dtype, "shared_expert_up_proj")
+            shared_down = _dense(D, False, cfg, self.dtype, self.param_dtype, "shared_expert_down_proj")
+            sh = act(shared_gate(x)) * shared_up(x)
+            sh = shared_down(sh).reshape(-1, D)
+            gate_logit = nn.Dense(1, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+                                  kernel_init=init, name="shared_expert_gate")(x).reshape(-1, 1)
+            out = out + jax.nn.sigmoid(gate_logit.astype(jnp.float32)).astype(out.dtype) * sh
+
+        # aux load-balancing loss, pre-weighted by the coefficient
+        expert_mask = jnp.zeros_like(probs)
+        expert_mask = jax.vmap(lambda c, i: c.at[i].set(1.0))(expert_mask, topk_idx)
+        aux = load_balancing_loss(probs, expert_mask, E, K) * getattr(cfg, "router_aux_loss_coef", 0.0)
+        return out.reshape(B, T, D), aux

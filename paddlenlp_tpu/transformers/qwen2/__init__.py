@@ -1,0 +1,7 @@
+from .configuration import Qwen2Config  # noqa: F401
+from .modeling import (  # noqa: F401
+    Qwen2ForCausalLM,
+    Qwen2ForSequenceClassification,
+    Qwen2Model,
+    Qwen2PretrainedModel,
+)

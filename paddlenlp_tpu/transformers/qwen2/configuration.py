@@ -1,0 +1,77 @@
+"""Qwen2 configuration (reference: paddlenlp/transformers/qwen2/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["Qwen2Config"]
+
+
+class Qwen2Config(PretrainedConfig):
+    model_type = "qwen2"
+
+    def __init__(
+        self,
+        vocab_size: int = 151936,
+        hidden_size: int = 4096,
+        intermediate_size: int = 22016,
+        num_hidden_layers: int = 32,
+        num_attention_heads: int = 32,
+        num_key_value_heads: int = 32,
+        head_dim: int = None,
+        hidden_act: str = "silu",
+        max_position_embeddings: int = 32768,
+        initializer_range: float = 0.02,
+        rms_norm_eps: float = 1e-6,
+        rope_theta: float = 10000.0,
+        rope_scaling: dict = None,
+        use_sliding_window: bool = False,
+        sliding_window: int = 4096,
+        max_window_layers: int = 28,
+        attention_dropout: float = 0.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads
+        self.head_dim = head_dim if head_dim is not None else hidden_size // num_attention_heads
+        self.hidden_act = hidden_act
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.rope_scaling = rope_scaling
+        self.use_sliding_window = use_sliding_window
+        self._sliding_window = sliding_window
+        self.max_window_layers = max_window_layers
+        self.attention_dropout = attention_dropout
+        # qwen2: qkv projections carry biases, o_proj does not
+        self.attention_bias = True
+        self.attention_out_bias = False
+        self.mlp_bias = False
+        kwargs.setdefault("tie_word_embeddings", False)
+        super().__init__(**kwargs)
+
+    @property
+    def sliding_window(self):
+        if not self.use_sliding_window:
+            return None
+        if self.max_window_layers < self.num_hidden_layers:
+            # HF semantics window only layers >= max_window_layers; per-layer windows
+            # don't fit the scanned-layer stack yet. Full attention is the safe
+            # superset — warn instead of silently mis-masking the early layers.
+            from ...utils.log import logger
+
+            logger.warning_once(
+                "qwen2 use_sliding_window with max_window_layers < num_hidden_layers is "
+                "not yet supported; using full attention for all layers"
+            )
+            return None
+        return self._sliding_window
+
+    @sliding_window.setter
+    def sliding_window(self, value):
+        self._sliding_window = value

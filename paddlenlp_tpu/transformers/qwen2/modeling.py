@@ -1,0 +1,48 @@
+"""Qwen2 / Qwen1.5, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/qwen2/modeling.py`` (+ ``modeling_pp.py``).
+Qwen2 IS the LLaMA computation graph with qkv biases and (optionally) sliding-window
+attention — the reference restates ~2k LoC; here the llama linen modules are reused
+directly and the deltas live in ``Qwen2Config`` (attention_bias/attention_out_bias/
+sliding_window), which the shared attention already honors.
+"""
+
+from __future__ import annotations
+
+from ..llama.modeling import (
+    LlamaForCausalLMModule,
+    LlamaForSequenceClassificationModule,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from .configuration import Qwen2Config
+
+__all__ = [
+    "Qwen2Model",
+    "Qwen2ForCausalLM",
+    "Qwen2ForSequenceClassification",
+    "Qwen2PretrainedModel",
+    "Qwen2PretrainingCriterion",
+]
+
+
+class Qwen2PretrainedModel(LlamaPretrainedModel):
+    config_class = Qwen2Config
+
+
+class Qwen2Model(Qwen2PretrainedModel):
+    module_class = LlamaModule
+
+
+class Qwen2ForCausalLM(Qwen2PretrainedModel):
+    module_class = LlamaForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+class Qwen2ForSequenceClassification(Qwen2PretrainedModel):
+    module_class = LlamaForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"score"]
+
+
+Qwen2PretrainingCriterion = LlamaPretrainingCriterion

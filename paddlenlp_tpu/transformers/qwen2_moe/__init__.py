@@ -1,0 +1,2 @@
+from .configuration import Qwen2MoeConfig  # noqa: F401
+from .modeling import Qwen2MoeForCausalLM, Qwen2MoeModel  # noqa: F401

@@ -1,0 +1,111 @@
+"""Qwen2-MoE, TPU-native (reference: paddlenlp/transformers/qwen2_moe/modeling.py,
+``Qwen2MoeSparseMoEBlock`` :686).
+
+Qwen2-MoE = qwen2 attention skeleton + routed experts WITH an always-on shared
+expert gated by a sigmoid. Expert weights are stacked [E, D, F] einsums; EP is the
+``expert`` logical mesh axis (the reference's `use_expert_parallel` no-sync flag
+machinery, trainer.py:1079-1085, is unnecessary under GSPMD).
+"""
+
+from __future__ import annotations
+
+from ...parallel.partition import P
+from ..conversion_utils import StackedLayerMapping, auto_name_mappings
+from ..llama.modeling import (
+    LlamaDecoderLayer,
+    LlamaForCausalLMModule,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from ..moe_layers import MoEMLP
+from .configuration import Qwen2MoeConfig
+
+__all__ = ["Qwen2MoeModel", "Qwen2MoeForCausalLM", "Qwen2MoePretrainedModel"]
+
+
+class Qwen2MoeMLP(MoEMLP):
+    gate_name = "gate"
+    names = ("gate_proj", "up_proj", "down_proj")
+
+
+class Qwen2MoeDecoderLayer(LlamaDecoderLayer):
+    mlp_cls = Qwen2MoeMLP
+    mlp_name = "mlp"
+
+
+class Qwen2MoeModule(LlamaModule):
+    decoder_layer_cls = Qwen2MoeDecoderLayer
+
+
+class Qwen2MoeForCausalLMModule(LlamaForCausalLMModule):
+    base_module_cls = Qwen2MoeModule
+
+
+class Qwen2MoePretrainedModel(LlamaPretrainedModel):
+    config_class = Qwen2MoeConfig
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return list(LlamaPretrainedModel.get_partition_rules(config)) + [
+            (r"mlp/gate/kernel$", P("embed", None)),
+            (r"mlp/(gate_proj|up_proj)$", P("expert", "embed", "mlp")),
+            (r"mlp/down_proj$", P("expert", "mlp", "embed")),
+            (r"shared_expert_(gate_proj|up_proj)/kernel$", P("embed", "mlp")),
+            (r"shared_expert_down_proj/kernel$", P("mlp", "embed")),
+            (r"shared_expert_gate/kernel$", P("embed", None)),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        expert_names = {"gate_proj", "up_proj", "down_proj"}
+        mappings = []
+        plain = {}
+        n_layers, n_experts = config.num_hidden_layers, config.num_local_experts
+
+        def layer_template(path, suffix_hf):
+            """HF key template + stacked dims for a (possibly scanned) layer param."""
+            if "/layers/" in f"/{path}":
+                return f"model.layers.{{}}.{suffix_hf}", (n_layers,)
+            layer_idx = path.split("/layers_")[1].split("/")[0]
+            return f"model.layers.{layer_idx}.{suffix_hf}", ()
+
+        for path, leaf in flat_shapes.items():
+            tail = path.rsplit("/", 1)[-1]
+            if "/mlp/" in path and tail in expert_names and len(leaf.shape) >= 3:
+                tpl, dims = layer_template(path, f"mlp.experts.{{}}.{tail}.weight")
+                mappings.append(StackedLayerMapping(tpl, path, action="transpose", dims=dims + (n_experts,)))
+            elif "shared_expert_gate/" in path:
+                tpl, dims = layer_template(path, "mlp.shared_expert_gate.weight")
+                if dims:
+                    mappings.append(StackedLayerMapping(tpl, path, action="transpose", dims=dims))
+                else:
+                    from ..conversion_utils import StateDictNameMapping
+
+                    mappings.append(StateDictNameMapping(tpl, path, "transpose"))
+            elif "shared_expert_" in path:
+                proj = tail if tail != "kernel" else path.rsplit("/", 2)[-2]
+                hf_proj = proj.replace("shared_expert_", "")
+                tpl, dims = layer_template(path, f"mlp.shared_expert.{hf_proj}.weight")
+                if dims:
+                    mappings.append(StackedLayerMapping(tpl, path, action="transpose", dims=dims))
+                else:
+                    from ..conversion_utils import StateDictNameMapping
+
+                    mappings.append(StateDictNameMapping(tpl, path, "transpose"))
+            else:
+                plain[path] = leaf
+        mappings.extend(auto_name_mappings(plain))
+        return mappings
+
+
+class Qwen2MoeModel(Qwen2MoePretrainedModel):
+    module_class = Qwen2MoeModule
+
+
+class Qwen2MoeForCausalLM(Qwen2MoePretrainedModel):
+    module_class = Qwen2MoeForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+Qwen2MoePretrainingCriterion = LlamaPretrainingCriterion

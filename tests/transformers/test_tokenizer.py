@@ -1,0 +1,113 @@
+"""Tokenizer tests: encode/pad/truncate, left padding, save/load, chat templates
+(reference: tests/transformers/test_tokenizer_common.py pattern)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+
+@pytest.fixture(scope="module")
+def tok(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3}
+    for i, w in enumerate("the quick brown fox jumps over lazy dog hello world how are you".split()):
+        vocab[w] = i + 4
+    t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    return PretrainedTokenizer(
+        tokenizer_object=t,
+        pad_token="<pad>",
+        bos_token="<s>",
+        eos_token="</s>",
+        unk_token="<unk>",
+    )
+
+
+class TestEncodeDecode:
+    def test_basic(self, tok):
+        enc = tok("the quick brown fox")
+        assert enc["input_ids"] == [4, 5, 6, 7]
+        assert enc["attention_mask"] == [1, 1, 1, 1]
+        assert tok.decode(enc["input_ids"]) == "the quick brown fox"
+
+    def test_unk(self, tok):
+        enc = tok("the zebra")
+        assert enc["input_ids"] == [4, 3]
+
+    def test_batch_right_pad(self, tok):
+        enc = tok(["the quick", "hello world how are"], padding=True)
+        assert enc["input_ids"][0] == [4, 5, 0, 0]
+        assert enc["attention_mask"][0] == [1, 1, 0, 0]
+
+    def test_batch_left_pad(self, tok):
+        enc = tok(["the quick", "hello world how are"], padding=True, padding_side="left")
+        assert enc["input_ids"][0] == [0, 0, 4, 5]
+        assert enc["attention_mask"][0] == [0, 0, 1, 1]
+
+    def test_max_length_pad_and_truncate(self, tok):
+        enc = tok(["the quick"], padding="max_length", max_length=6)
+        assert len(enc["input_ids"][0]) == 6
+        enc = tok(["hello world how are you"], truncation=True, max_length=3)
+        assert len(enc["input_ids"][0]) == 3
+
+    def test_return_np(self, tok):
+        enc = tok(["the quick", "hello world"], padding=True, return_tensors="np")
+        assert isinstance(enc["input_ids"], np.ndarray)
+        assert enc["input_ids"].shape == (2, 2)
+
+    def test_special_ids(self, tok):
+        assert tok.pad_token_id == 0
+        assert tok.bos_token_id == 1
+        assert tok.eos_token_id == 2
+
+    def test_vocab(self, tok):
+        assert tok.vocab_size >= 17
+        assert tok.convert_tokens_to_ids("fox") == 7
+        assert tok.convert_ids_to_tokens(7) == "fox"
+
+
+class TestSaveLoad:
+    def test_roundtrip(self, tok, tmp_path):
+        tok.save_pretrained(str(tmp_path))
+        assert (tmp_path / "tokenizer.json").exists()
+        assert (tmp_path / "tokenizer_config.json").exists()
+        reloaded = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        assert reloaded("the quick")["input_ids"] == [4, 5]
+        assert reloaded.pad_token_id == 0
+
+    def test_auto_tokenizer(self, tok, tmp_path):
+        from paddlenlp_tpu.transformers import AutoTokenizer
+
+        tok.save_pretrained(str(tmp_path))
+        reloaded = AutoTokenizer.from_pretrained(str(tmp_path))
+        assert reloaded("hello world")["input_ids"] == [12, 13]
+
+
+class TestChatTemplate:
+    def test_render(self, tok):
+        tok.chat_template = (
+            "{% for m in messages %}<|{{ m['role'] }}|>{{ m['content'] }}</s>{% endfor %}"
+            "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+        )
+        out = tok.apply_chat_template(
+            [{"role": "user", "content": "hello"}, {"role": "assistant", "content": "world"},
+             {"role": "user", "content": "how are you"}],
+        )
+        assert out == "<|user|>hello</s><|assistant|>world</s><|user|>how are you</s><|assistant|>"
+
+    def test_template_persisted(self, tok, tmp_path):
+        tok.chat_template = "{% for m in messages %}{{ m['content'] }} {% endfor %}"
+        tok.save_pretrained(str(tmp_path))
+        reloaded = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        assert reloaded.chat_template == tok.chat_template
+
+    def test_no_template_raises(self, tok):
+        tok2 = PretrainedTokenizer(tokenizer_object=tok._tokenizer)
+        with pytest.raises(ValueError, match="chat_template"):
+            tok2.apply_chat_template([{"role": "user", "content": "x"}])
