@@ -54,15 +54,29 @@ def dot_product_attention(
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     window: Optional[int] = None,
+    positions: Optional[jnp.ndarray] = None,  # [B, T] or [T] ABSOLUTE positions (permuted layouts)
     use_pallas: bool = False,
 ) -> jnp.ndarray:
-    """Fused attention; returns [B, T, n_heads, head_dim] in query dtype."""
+    """Fused attention; returns [B, T, n_heads, head_dim] in query dtype.
+
+    ``positions``: when the sequence axis is physically permuted (context-parallel
+    zigzag layout), index order != causal order; pass absolute positions and the
+    causal/window mask is built from them instead of array indices.
+    """
     B, T, N, H = query.shape
     S = key.shape[1]
     scale = scale if scale is not None else H**-0.5
 
     mask = None
-    if causal:
+    if causal and positions is not None:
+        pos = positions if positions.ndim == 2 else positions[None, :]
+        pos = jnp.broadcast_to(pos, (B, S))
+        q_pos = pos[:, -T:] if T != S else pos
+        m = pos[:, None, None, :] <= q_pos[:, None, :, None]
+        if window is not None:
+            m = m & (pos[:, None, None, :] > q_pos[:, None, :, None] - window)
+        mask = m
+    elif causal:
         mask = jnp.broadcast_to(make_causal_mask(T, S, q_offset, window=window), (B, 1, T, S))
     if segment_ids is not None:
         q_seg = segment_ids[:, -T:] if T != S else segment_ids

@@ -105,6 +105,10 @@ class Trainer:
         self._train_step_fn = None
         self._eval_step_fn = None
         self.mesh = args.mesh()
+        # cp>1 + built-in loss: labels are pre-shifted host-side before the zigzag
+        # reorder (a post-permutation causal shift would be wrong); both the train
+        # and eval steps then compute the loss with shift=False.
+        self._labels_preshifted = self.mesh.shape.get("cp", 1) > 1 and criterion is None
         callbacks = DEFAULT_CALLBACKS + (callbacks or [])
         self.callback_handler = CallbackHandler(callbacks, self.model, self.tokenizer)
         set_seed(args.seed)
@@ -214,10 +218,11 @@ class Trainer:
         if labels is None:
             raise ValueError("training requires `labels` in inputs (or override compute_loss)")
         logits = outputs.logits if hasattr(outputs, "logits") else outputs[0]
+        shift = not getattr(self, "_labels_preshifted", False)
         if self.criterion is not None:
             loss = self.criterion(logits, labels)
         else:
-            loss = causal_lm_loss(logits, labels, shift=True)
+            loss = causal_lm_loss(logits, labels, shift=shift)
         aux = getattr(outputs, "aux_loss", None)
         if aux is not None:  # MoE router load-balancing (pre-weighted by its coef)
             loss = loss + aux
@@ -262,6 +267,8 @@ class Trainer:
         return jax.jit(train_step, donate_argnums=(0,))
 
     def _build_eval_step(self):
+        shift = not self._labels_preshifted
+
         def eval_step(params, batch):
             inputs = dict(batch)
             labels = inputs.pop("labels", None)
@@ -272,7 +279,7 @@ class Trainer:
             if self.criterion is not None:
                 loss = self.criterion(logits, labels)
             else:
-                loss = causal_lm_loss(logits, labels, shift=True)
+                loss = causal_lm_loss(logits, labels, shift=shift)
             return {"loss": loss, "logits": logits}
 
         return jax.jit(eval_step)
@@ -305,8 +312,42 @@ class Trainer:
 
     def _device_put_batch(self, batch: Dict[str, np.ndarray], accum: int):
         """Shard the host batch onto the mesh: [global_B, ...] -> batch axes (dp,fsdp);
-        with accumulation, reshape to [accum, global_B/accum, ...] first."""
+        with accumulation, reshape to [accum, global_B/accum, ...] first.
+
+        Context parallel (cp>1): the sequence axis is reordered into the zigzag
+        load-balanced layout (reference context_parallel_utils.py:32) with explicit
+        position_ids, and labels are pre-shifted on the host (a post-reorder causal
+        shift would be wrong).
+        """
         from jax.sharding import NamedSharding
+
+        cp = self.mesh.shape.get("cp", 1)
+        if cp > 1:
+            from ..ops.ring_attention import zigzag_positions
+
+            batch = dict(batch)
+            ref_key = next((k for k in ("input_ids", "labels", "inputs_embeds") if k in batch), None)
+            if ref_key is None:
+                raise ValueError("context parallel needs input_ids/labels in the batch")
+            seq_len = np.asarray(batch[ref_key]).shape[1]
+            # pre-shift labels on the host ONLY for the built-in loss; a user
+            # criterion keeps its own contract (labels already dataset-aligned)
+            if "labels" in batch and self.criterion is None:
+                labels = np.asarray(batch["labels"]).copy()
+                labels[..., :-1] = labels[..., 1:]
+                labels[..., -1] = -100
+                batch["labels"] = labels
+            order = np.asarray(zigzag_positions(seq_len, cp))
+            if "position_ids" not in batch:
+                shape = np.asarray(batch[ref_key]).shape[:2]
+                batch["position_ids"] = np.broadcast_to(order, shape)
+            else:
+                batch["position_ids"] = np.asarray(batch["position_ids"])[..., order]
+            for key in ("input_ids", "labels", "attention_mask", "segment_ids"):
+                if key in batch:
+                    batch[key] = np.asarray(batch[key])[..., order]
+            if "inputs_embeds" in batch:
+                batch["inputs_embeds"] = np.asarray(batch["inputs_embeds"])[:, order]
 
         def put(x):
             x = np.asarray(x)

@@ -178,18 +178,43 @@ class LlamaAttention(nn.Module):
         q = checkpoint_name(q, "attn_qkv")
         k = checkpoint_name(k, "attn_qkv")
         v = checkpoint_name(v, "attn_qkv")
-        attn_out = dot_product_attention(
-            q,
-            k,
-            v,
-            attention_mask=attention_mask,
-            segment_ids=segment_ids,
-            causal=True,
-            q_offset=q_offset,
-            dropout_rate=dropout_rate,
-            dropout_rng=dropout_rng,
-            window=getattr(cfg, "sliding_window", None),
-        )
+
+        # context parallel: ring attention over the cp axis (reference
+        # fusion_ops.py:209-216 dispatches RingFlashAttention when cp>1) —
+        # O(S/cp) K/V per chip instead of the GSPMD all-gather. When masks or
+        # dropout make the ring kernel inapplicable, the fallback still masks by
+        # ABSOLUTE positions (the cp input layout is zigzag-permuted, so index
+        # order != causal order).
+        from ...parallel.partition import _current_mesh
+
+        mesh = _current_mesh()
+        cp_active = mesh is not None and getattr(mesh, "shape", {}).get("cp", 1) > 1
+        if (
+            cp_active
+            and kv is None
+            and attention_mask is None
+            and segment_ids is None
+            and dropout_rate == 0.0
+            and getattr(cfg, "sliding_window", None) is None
+        ):
+            from ...ops.ring_attention import ring_self_attention
+
+            ring_pos = position_ids[0] if position_ids.ndim > 1 else position_ids
+            attn_out = ring_self_attention(q, k, v, mesh, positions=ring_pos)
+        else:
+            attn_out = dot_product_attention(
+                q,
+                k,
+                v,
+                attention_mask=attention_mask,
+                segment_ids=segment_ids,
+                causal=True,
+                q_offset=q_offset,
+                dropout_rate=dropout_rate,
+                dropout_rng=dropout_rng,
+                window=getattr(cfg, "sliding_window", None),
+                positions=position_ids if (cp_active and kv is None) else None,
+            )
         attn_out = checkpoint_name(attn_out, "core_attn")
         attn_out = attn_out.reshape(B, T, n_heads * head_dim)
         out_bias = getattr(cfg, "attention_out_bias", cfg.attention_bias)
