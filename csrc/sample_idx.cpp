@@ -1,0 +1,72 @@
+// Native helpers for pretraining dataset index construction.
+//
+// Counterpart of the reference's compiled dataset helpers used by
+// paddlenlp/data/causal_dataset.py::_build_index_mappings (:417) — the
+// O(total_epoch_tokens) sample-boundary walk is the only part of data prep that
+// is too slow in Python for billion-token corpora.
+//
+// Built lazily by paddlenlp_tpu/data/native.py:
+//   g++ -O3 -shared -fPIC -o libpdnlp_data.so sample_idx.cpp
+
+#include <cstdint>
+
+extern "C" {
+
+// Walk documents (in doc_idx order, cycling epochs) and emit, for each training
+// sample boundary, the (document position, within-document offset) pair.
+//   sizes:      [n_seqs]   token count of each sequence
+//   doc_idx:    [n_docs_total] shuffled document order (already epoch-repeated)
+//   sample_idx: [ (n_samples+1) * 2 ] output: (doc_pos, doc_offset) per boundary
+// Returns 0 on success, -1 if the corpus is exhausted before n_samples.
+int build_sample_idx(const int32_t* sizes,
+                     const int64_t* doc_idx,
+                     int64_t n_docs_total,
+                     int64_t seq_length,
+                     int64_t n_samples,
+                     int64_t* sample_idx) {
+  int64_t doc_pos = 0;      // index into doc_idx
+  int64_t doc_offset = 0;   // token offset within current document
+  sample_idx[0] = doc_pos;
+  sample_idx[1] = doc_offset;
+  for (int64_t i = 1; i <= n_samples; ++i) {
+    int64_t remaining = seq_length + 1;  // +1: targets are inputs shifted by one
+    while (remaining > 0) {
+      if (doc_pos >= n_docs_total) return -1;
+      int64_t doc_len = sizes[doc_idx[doc_pos]] - doc_offset;
+      if (doc_len > remaining) {
+        doc_offset += remaining;
+        remaining = 0;
+      } else {
+        remaining -= doc_len;
+        ++doc_pos;
+        doc_offset = 0;
+        // the boundary token shared between samples: step back one token so the
+        // next sample re-reads it (classic Megatron overlap) — only when the
+        // document ended exactly at the boundary is no overlap needed
+      }
+    }
+    sample_idx[2 * i] = doc_pos;
+    sample_idx[2 * i + 1] = doc_offset;
+  }
+  return 0;
+}
+
+// Fisher-Yates shuffle with a splitmix64 PRNG (deterministic across platforms).
+static inline uint64_t splitmix64(uint64_t* state) {
+  uint64_t z = (*state += 0x9E3779B97f4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+void shuffle_int64(int64_t* arr, int64_t n, uint64_t seed) {
+  uint64_t state = seed;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = (int64_t)(splitmix64(&state) % (uint64_t)(i + 1));
+    int64_t tmp = arr[i];
+    arr[i] = arr[j];
+    arr[j] = tmp;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+from .zero_padding_dataset import (  # noqa: F401
+    ZeroPaddingIterableDataset,
+    ZeroPaddingMapDataset,
+    greedy_pack,
+)

@@ -33,7 +33,8 @@ __all__ = ["ring_attention_local", "ring_self_attention", "zigzag_split", "zigza
 def _chunk_attention(q, k, v, q_pos, kv_pos, scale):
     """Masked attention contribution of one kv chunk: returns UNNORMALIZED
     (num [B,Tq,N,H], den [B,N,Tq], m [B,N,Tq]) in fp32 — the flash-attention
-    accumulator triple. ``m`` is -inf for fully-masked rows."""
+    accumulator triple. ``m`` is -inf for fully-masked rows. Positions are
+    per-row [B, Tq]/[B, Tk] (absolute), so heterogeneous batches mask correctly."""
     B, Tq, N, H = q.shape
     K = k.shape[2]
     if K != N:
@@ -41,11 +42,11 @@ def _chunk_attention(q, k, v, q_pos, kv_pos, scale):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     logits = jnp.einsum("btnh,bsnh->bnts", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
-    mask = kv_pos[None, :] <= q_pos[:, None]  # causal by absolute position
-    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    mask = kv_pos[:, None, None, :] <= q_pos[:, None, :, None]  # [B,1,Tq,Tk] causal by abs position
+    logits = jnp.where(mask, logits, -jnp.inf)
     m = jnp.max(logits, axis=-1)  # [B,N,Tq], -inf when fully masked
     safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
-    probs = jnp.where(mask[None, None], jnp.exp(logits - safe_m[..., None]), 0.0)
+    probs = jnp.where(mask, jnp.exp(logits - safe_m[..., None]), 0.0)
     den = probs.sum(axis=-1)
     num = jnp.einsum("bnts,bsnh->btnh", probs, v.astype(jnp.float32))
     return num, den, m
@@ -67,8 +68,8 @@ def ring_attention_local(
     q: jnp.ndarray,  # [B, Tq, N, H] — this device's query chunk
     k: jnp.ndarray,  # [B, Tk, K, H] — this device's kv chunk
     v: jnp.ndarray,
-    q_positions: jnp.ndarray,  # [Tq] absolute positions of the q chunk
-    kv_positions: jnp.ndarray,  # [Tk] absolute positions of the kv chunk
+    q_positions: jnp.ndarray,  # [B, Tq] absolute positions of the q chunk
+    kv_positions: jnp.ndarray,  # [B, Tk] absolute positions of the kv chunk
     axis_name: str = "cp",
     scale: Optional[float] = None,
 ) -> jnp.ndarray:
@@ -103,26 +104,26 @@ def ring_self_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     mesh: Mesh,
-    positions: Optional[jnp.ndarray] = None,  # [S] absolute positions (zigzag layouts)
+    positions: Optional[jnp.ndarray] = None,  # [S] or [B, S] absolute positions (zigzag layouts)
     axis_name: str = "cp",
     scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """shard_map wrapper: manual over ``cp`` only — batch/heads axes stay under
     GSPMD (the reference needs a dedicated cp process group; here it's one axis)."""
-    S = q.shape[1]
+    B, S = q.shape[:2]
     if positions is None:
         positions = jnp.arange(S, dtype=jnp.int32)
-    cp = mesh.shape.get(axis_name, 1)
+    if positions.ndim == 1:
+        positions = jnp.broadcast_to(positions[None, :], (B, S))
 
     def local(q_c, k_c, v_c, pos_c):
-        idx = jax.lax.axis_index(axis_name)
         return ring_attention_local(q_c, k_c, v_c, pos_c, pos_c, axis_name, scale)
 
     qspec = P(None, axis_name, None, None)
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(qspec, qspec, qspec, P(axis_name)),
+        in_specs=(qspec, qspec, qspec, P(None, axis_name)),
         out_specs=qspec,
         axis_names={axis_name},
         check_vma=False,
@@ -140,8 +141,12 @@ def zigzag_split(x: jnp.ndarray, cp: int, axis: int = 1) -> jnp.ndarray:
     return jnp.take(x, idx, axis=axis)
 
 
-def zigzag_positions(S: int, cp: int) -> jnp.ndarray:
-    """Absolute positions, zigzag order: concat over r of chunk r and chunk 2cp-1-r."""
+@functools.lru_cache(maxsize=64)
+def zigzag_positions(S: int, cp: int) -> "np.ndarray":
+    """Absolute positions, zigzag order: concat over r of chunk r and chunk 2cp-1-r.
+    Pure NumPy + cached: this sits on the per-batch host data path."""
+    import numpy as np
+
     if S % (2 * cp) != 0:
         raise ValueError(
             f"context parallel requires seq_len divisible by 2*cp for the zigzag "
@@ -152,11 +157,14 @@ def zigzag_positions(S: int, cp: int) -> jnp.ndarray:
     for r in range(cp):
         order.extend(range(r * chunk, (r + 1) * chunk))
         order.extend(range((2 * cp - 1 - r) * chunk, (2 * cp - r) * chunk))
-    return jnp.asarray(order, dtype=jnp.int32)
+    return np.asarray(order, dtype=np.int32)
 
 
 def zigzag_unsplit(x: jnp.ndarray, cp: int, axis: int = 1) -> jnp.ndarray:
+    import numpy as np
+
     S = x.shape[axis]
-    idx = zigzag_positions(S, cp)
-    inv = jnp.zeros_like(idx).at[idx].set(jnp.arange(S, dtype=jnp.int32))
+    idx = np.asarray(zigzag_positions(S, cp))
+    inv = np.zeros_like(idx)
+    inv[idx] = np.arange(S, dtype=np.int32)
     return jnp.take(x, inv, axis=axis)

@@ -1,0 +1,217 @@
+"""LoRA: low-rank adapters, TPU-native.
+
+Counterpart of ``paddlenlp/peft/lora/lora_model.py`` (``LoRAModel`` :134,
+find-and-replace module surgery :427, TP-aware save/merge :320-371) and
+``lora_layers.py`` (LoRALinear + Column/Row/SequenceParallel TP variants).
+
+TPU-first redesign — NO module surgery and NO parallel layer variants:
+LoRA params (A [in, r], B [r, out]) live as sibling leaves of each targeted
+kernel; the forward **functionally merges** ``W' = W + scaling * A @ B`` before
+the unchanged base module applies. Gradients flow only to A/B (the trainer masks
+the rest), merged lazily under jit so XLA fuses the rank-r update into the layer;
+TP sharding falls out of the partition rules (A inherits the kernel's input-dim
+sharding, B its output-dim sharding).
+
+With ``lora_dropout > 0`` the merged form is approximate (dropout would apply to
+the adapter input only); this implementation keeps the exact merged math and
+applies no adapter dropout.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...transformers.conversion_utils import flatten_params, unflatten_params
+from ...utils.log import logger
+from ...utils.safetensors_io import SafeFile, save_file
+from .lora_config import DEFAULT_TARGETS, LoRAConfig
+
+__all__ = ["LoRAModel"]
+
+LORA_WEIGHTS_NAME = "lora_model.safetensors"
+
+
+def _merge_lora(params: dict, scaling: float) -> dict:
+    """kernel + scaling * A @ B wherever adapters exist (pure; jit-fusable)."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            out[k] = walk(v)
+        if "kernel" in out and "lora_A" in out and "lora_B" in out:
+            a, b = out["lora_A"], out["lora_B"]
+            # @ batches any leading axes: works for [in,r]@[r,out] and the scanned
+            # [L,in,r]@[L,r,out] layout alike
+            delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scaling
+            out = dict(out)
+            out["kernel"] = (out["kernel"].astype(jnp.float32) + delta).astype(out["kernel"].dtype)
+        return out
+
+    return walk(params)
+
+
+class _LoRAMergedModule:
+    """Duck-typed linen-module shim: merges adapters, then applies the base module."""
+
+    def __init__(self, base_module, scaling: float):
+        self._base = base_module
+        self._scaling = scaling
+        self.dtype = getattr(base_module, "dtype", jnp.float32)
+
+    def apply(self, variables, *args, **kwargs):
+        params = variables["params"] if "params" in variables else variables
+        merged = _merge_lora(params, self._scaling)
+        return self._base.apply({"params": merged}, *args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._base, item)
+
+
+class LoRAModel:
+    """Wraps a PretrainedModel; quacks like one (module/params/config/generate)."""
+
+    def __init__(self, model, lora_config: Optional[LoRAConfig] = None, params: Optional[dict] = None):
+        self.model = model
+        self.lora_config = lora_config or LoRAConfig()
+        self.config = model.config
+        self.dtype = model.dtype
+        self.generation_config = model.generation_config
+        patterns = self.lora_config.target_modules or [t.rsplit("/", 1)[0] for t in DEFAULT_TARGETS]
+        self._target_res = [re.compile(p if p.endswith("$") or "/" in p else rf"\b{p}\b") for p in patterns]
+        self.params = params if params is not None else self._init_lora_params(model.params)
+        self.module = _LoRAMergedModule(model.module, self.lora_config.scaling)
+        self.mesh = model.mesh
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ init
+    def _matches(self, kernel_path: str) -> bool:
+        module_path = kernel_path.rsplit("/", 1)[0]
+        return any(p.search(module_path) or p.search(kernel_path) for p in self._target_res)
+
+    def _init_lora_params(self, base_params: dict) -> dict:
+        cfg = self.lora_config
+        rng = np.random.default_rng(0)
+        flat = flatten_params(base_params)
+        added = 0
+        out = dict(flat)
+        for path, leaf in flat.items():
+            if not path.endswith("/kernel") or getattr(leaf, "ndim", 0) < 2:
+                continue
+            if not self._matches(path):
+                continue
+            shape = leaf.shape
+            in_dim, out_dim = shape[-2], shape[-1]
+            lead = shape[:-2]  # scanned layers keep the [L] axis on the adapters too
+            a = rng.standard_normal(lead + (in_dim, cfg.r)).astype(np.float32) / math.sqrt(in_dim)
+            b = np.zeros(lead + (cfg.r, out_dim), dtype=np.float32)
+            prefix = path.rsplit("/", 1)[0]
+            out[prefix + "/lora_A"] = jnp.asarray(a)
+            out[prefix + "/lora_B"] = jnp.asarray(b)
+            added += 1
+        if added == 0:
+            raise ValueError(f"no modules matched LoRA target patterns {cfg.target_modules}")
+        logger.info(f"LoRA: adapters added to {added} kernels (r={cfg.r}, scaling={cfg.scaling:.3f})")
+        return unflatten_params(out)
+
+    # ------------------------------------------------------------------ training glue
+    def trainable_mask(self) -> dict:
+        """pytree of bool: True = trainable (lora_A/lora_B only)."""
+        flat = flatten_params(self.params)
+        mask = {p: ("/lora_A" in p or "/lora_B" in p) for p in flat}
+        return unflatten_params(mask)
+
+    def print_trainable_parameters(self):
+        flat = flatten_params(self.params)
+        total = sum(int(np.prod(v.shape)) for v in flat.values())
+        trainable = sum(int(np.prod(v.shape)) for p, v in flat.items() if "/lora_" in p)
+        logger.info(f"trainable params: {trainable:,} / {total:,} ({100 * trainable / total:.3f}%)")
+
+    def mark_only_lora_as_trainable(self):
+        return self.trainable_mask()
+
+    # ------------------------------------------------------------------ facade
+    def __call__(self, *args, **kwargs):
+        params = kwargs.pop("params", None)
+        orig = self.model.params
+        self.model.params = params if params is not None else self.params
+        self.model.module, base_module = self.module, self.model.module
+        try:
+            return self.model(*args, **kwargs)
+        finally:
+            self.model.params = orig
+            self.model.module = base_module
+
+    def apply(self, params, *args, **kwargs):
+        return self.module.apply({"params": params}, *args, **kwargs)
+
+    def generate(self, *args, **kwargs):
+        kwargs.setdefault("params", self.params)
+        orig_module = self.model.module
+        self.model.module = self.module
+        try:
+            return self.model.generate(*args, **kwargs)
+        finally:
+            self.model.module = orig_module
+
+    def num_parameters(self, params=None):
+        return self.model.num_parameters(params if params is not None else self.params)
+
+    def get_model_flops(self, *a, **kw):
+        return self.model.get_model_flops(*a, **kw)
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        # adapters: A shards like the kernel's input dim, B like its output dim
+        raise NotImplementedError  # instance method below is used
+
+    def get_partition_rules_instance(self):
+        base = type(self.model).get_partition_rules(self.config)
+        from ...parallel.partition import P
+
+        return list(base) + [
+            (r"lora_A$", P("embed", None)),
+            (r"lora_B$", P(None, "mlp")),
+        ]
+
+    # ------------------------------------------------------------------ save/load
+    def merge_and_unload(self):
+        """Return the base model with adapters folded in (reference `merge` :853)."""
+        merged = jax.jit(lambda p: _merge_lora(p, self.lora_config.scaling))(self.params)
+        flat = {p: v for p, v in flatten_params(merged).items() if "/lora_" not in p}
+        self.model.params = unflatten_params(flat)
+        return self.model
+
+    def save_pretrained(self, save_directory: str, merge_tensor_parallel: bool = False, **kw):
+        """Save ONLY the adapters + config (reference TP-aware save :320; gathering
+        shards is jax.device_get here)."""
+        os.makedirs(save_directory, exist_ok=True)
+        self.lora_config.save_pretrained(save_directory)
+        flat = flatten_params(self.params)
+        tensors = {
+            p: np.asarray(jax.device_get(v)) for p, v in flat.items() if "/lora_" in p
+        }
+        save_file(tensors, os.path.join(save_directory, LORA_WEIGHTS_NAME), metadata={"format": "np"})
+        logger.info(f"LoRA adapters saved to {save_directory}")
+
+    @classmethod
+    def from_pretrained(cls, model, lora_path: str) -> "LoRAModel":
+        config = LoRAConfig.from_pretrained(lora_path)
+        obj = cls(model, config)
+        flat = flatten_params(obj.params)
+        with SafeFile(os.path.join(lora_path, LORA_WEIGHTS_NAME)) as sf:
+            for key in sf.keys():
+                if key not in flat:
+                    logger.warning(f"adapter key {key} not in model; skipping")
+                    continue
+                flat[key] = jnp.asarray(sf.get_tensor(key))
+        obj.params = unflatten_params(flat)
+        return obj

@@ -1,0 +1,1 @@
+from .prefix_model import PrefixConfig, PrefixModelForCausalLM  # noqa: F401

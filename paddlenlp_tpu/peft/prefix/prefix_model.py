@@ -1,0 +1,152 @@
+"""Prefix tuning (reference: paddlenlp/peft/prefix/ — ``PrefixModelForCausalLM``
+with per-model past-KV reshape fns).
+
+TPU-native: the learned prefix IS a pre-filled slice of the static KV cache —
+no per-model reshape functions needed. Forward: build a cache of size
+``num_prefix_tokens + T``, write the (batch-broadcast) prefix K/V, run the base
+module with that cache. Only the prefix tensor trains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...transformers.cache_utils import KVCache
+from ...transformers.conversion_utils import flatten_params, unflatten_params
+from ...utils.log import logger
+from ...utils.safetensors_io import SafeFile, save_file
+
+__all__ = ["PrefixConfig", "PrefixModelForCausalLM"]
+
+PREFIX_WEIGHTS_NAME = "prefix_model.safetensors"
+PREFIX_CONFIG_NAME = "prefix_config.json"
+
+
+@dataclasses.dataclass
+class PrefixConfig:
+    num_prefix_tokens: int = 64
+    init_std: float = 0.02
+
+    def save_pretrained(self, d: str):
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, PREFIX_CONFIG_NAME), "w") as f:
+            json.dump(dataclasses.asdict(self), f)
+
+    @classmethod
+    def from_pretrained(cls, d: str):
+        with open(os.path.join(d, PREFIX_CONFIG_NAME)) as f:
+            return cls(**json.load(f))
+
+
+class PrefixModelForCausalLM:
+    def __init__(self, model, prefix_config: Optional[PrefixConfig] = None, params: Optional[dict] = None):
+        self.model = model
+        self.prefix_config = prefix_config or PrefixConfig()
+        self.config = model.config
+        self.dtype = model.dtype
+        cfg = model.config
+        P = self.prefix_config.num_prefix_tokens
+        n_kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+        head_dim = getattr(cfg, "head_dim", cfg.hidden_size // cfg.num_attention_heads)
+        if params is not None:
+            self.params = params
+        else:
+            rng = np.random.default_rng(0)
+            prefix_kv = rng.standard_normal(
+                (cfg.num_hidden_layers, 2, P, n_kv, head_dim)
+            ).astype(np.float32) * self.prefix_config.init_std
+            self.params = dict(model.params)
+            self.params["prefix_kv"] = jnp.asarray(prefix_kv)
+        self.module = _PrefixModule(model.module, cfg, self.prefix_config)
+        self.mesh = model.mesh
+        self.generation_config = model.generation_config
+        self._jit_cache: Dict[Any, Any] = {}
+
+    def get_partition_rules_instance(self):
+        """Base model rules + replicated prefix (it's tiny)."""
+        from ...parallel.partition import P
+
+        return list(type(self.model).get_partition_rules(self.config)) + [(r"^prefix_kv$", P())]
+
+    def get_model_flops(self, *a, **kw):
+        return self.model.get_model_flops(*a, **kw)
+
+    def trainable_mask(self) -> dict:
+        flat = flatten_params(self.params)
+        return unflatten_params({p: p.startswith("prefix_kv") for p in flat})
+
+    def print_trainable_parameters(self):
+        n = int(np.prod(self.params["prefix_kv"].shape))
+        total = self.model.num_parameters() + n
+        logger.info(f"trainable params: {n:,} / {total:,} ({100 * n / total:.3f}%)")
+
+    def __call__(self, *args, **kwargs):
+        params = kwargs.pop("params", self.params)
+        rngs_kwargs = {}
+        out = self.module.apply({"params": params}, *args, **kwargs)
+        return out
+
+    def apply(self, params, *args, **kwargs):
+        return self.module.apply({"params": params}, *args, **kwargs)
+
+    def num_parameters(self, params=None):
+        return self.model.num_parameters(self.model.params) + int(np.prod(self.params["prefix_kv"].shape))
+
+    def save_pretrained(self, d: str, **kw):
+        os.makedirs(d, exist_ok=True)
+        self.prefix_config.save_pretrained(d)
+        save_file({"prefix_kv": np.asarray(jax.device_get(self.params["prefix_kv"]))},
+                  os.path.join(d, PREFIX_WEIGHTS_NAME), metadata={"format": "np"})
+
+    @classmethod
+    def from_pretrained(cls, model, d: str):
+        cfgp = PrefixConfig.from_pretrained(d)
+        obj = cls(model, cfgp)
+        with SafeFile(os.path.join(d, PREFIX_WEIGHTS_NAME)) as sf:
+            obj.params = dict(obj.params)
+            obj.params["prefix_kv"] = jnp.asarray(sf.get_tensor("prefix_kv"))
+        return obj
+
+
+class _PrefixModule:
+    """Shim module: prepends the learned prefix to a fresh KV cache, then applies
+    the base module; logits are returned for the input tokens only."""
+
+    def __init__(self, base_module, config, prefix_config: PrefixConfig):
+        self._base = base_module
+        self._config = config
+        self._prefix_config = prefix_config
+        self.dtype = getattr(base_module, "dtype", jnp.float32)
+
+    def apply(self, variables, input_ids=None, attention_mask=None, position_ids=None, **kwargs):
+        params = dict(variables["params"] if "params" in variables else variables)
+        prefix_kv = params.pop("prefix_kv")
+        P = self._prefix_config.num_prefix_tokens
+        B, T = input_ids.shape
+        L = self._config.num_hidden_layers
+        cache_dtype = jnp.bfloat16 if self.dtype == jnp.bfloat16 else jnp.float32
+        keys = jnp.zeros((L, B, P + T) + prefix_kv.shape[3:], cache_dtype)
+        values = jnp.zeros_like(keys)
+        pk = jnp.broadcast_to(prefix_kv[:, 0][:, None], (L, B, P) + prefix_kv.shape[3:]).astype(cache_dtype)
+        pv = jnp.broadcast_to(prefix_kv[:, 1][:, None], (L, B, P) + prefix_kv.shape[3:]).astype(cache_dtype)
+        keys = keys.at[:, :, :P].set(pk)
+        values = values.at[:, :, :P].set(pv)
+        cache = KVCache(keys=keys, values=values, offset=jnp.asarray(P, jnp.int32))
+        if attention_mask is not None:
+            attention_mask = jnp.concatenate([jnp.ones((B, P), attention_mask.dtype), attention_mask,
+                                              jnp.zeros((B, 0), attention_mask.dtype)], axis=1)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        out = self._base.apply({"params": params}, input_ids=input_ids, attention_mask=attention_mask,
+                               position_ids=position_ids, cache=cache, **kwargs)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._base, item)

@@ -153,14 +153,23 @@ class Trainer:
                     mask=_no_decay_mask if args.weight_decay > 0 else None,
                 )
             )
-            self.optimizer = optax.chain(*chain)
+            tx = optax.chain(*chain)
+            # PEFT: frozen params get set_to_zero (no optimizer state allocated)
+            if hasattr(self.model, "trainable_mask"):
+                mask = self.model.trainable_mask()
+                labels = jax.tree.map(lambda t: "train" if t else "freeze", mask)
+                tx = optax.multi_transform({"train": tx, "freeze": optax.set_to_zero()}, labels)
+            self.optimizer = tx
         return self.optimizer
 
     def _shard_params(self, params, logical_overrides=None):
         """Place params on the mesh per the model's partition rules."""
         from ..parallel.partition import logical_axis_rules
 
-        rules = type(self.model).get_partition_rules(self.model.config)
+        if hasattr(self.model, "get_partition_rules_instance"):
+            rules = self.model.get_partition_rules_instance()
+        else:
+            rules = type(self.model).get_partition_rules(self.model.config)
         with logical_axis_rules(logical_overrides or {}):
             shardings = sharding_tree(params, rules, self.mesh)
         return jax.device_put(params, shardings)
@@ -360,12 +369,49 @@ class Trainer:
 
         return {k: put(v) for k, v in batch.items()}
 
+    def _maybe_unsplit_seq(self, arr: np.ndarray) -> np.ndarray:
+        """Undo the cp zigzag permutation on collected logits so they align with
+        the (unpermuted) host labels handed to compute_metrics/predict."""
+        cp = self.mesh.shape.get("cp", 1)
+        if cp <= 1 or arr.ndim < 2:
+            return arr
+        from ..ops.ring_attention import zigzag_positions
+
+        idx = np.asarray(zigzag_positions(arr.shape[1], cp))
+        inv = np.zeros_like(idx)
+        inv[idx] = np.arange(len(idx), dtype=idx.dtype)
+        return arr[:, inv]
+
+    def _pad_batch_to_shards(self, batch: Dict[str, np.ndarray]):
+        """Pad a partial (last) eval batch to a multiple of the data shards by
+        repeating row 0 with labels=-100: the masked token-mean loss ignores the
+        filler, and callers slice the filler rows off logits. Returns (batch, n_pad)."""
+        n_shards = self.args.dataset_world_size
+        any_val = next(iter(batch.values()))
+        bsz = np.asarray(any_val).shape[0]
+        n_pad = (-bsz) % n_shards
+        if n_pad == 0:
+            return batch, 0
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            filler = np.repeat(v[:1], n_pad, axis=0)
+            if k == "labels":
+                filler = np.full_like(filler, -100)
+            out[k] = np.concatenate([v, filler], axis=0)
+        return out, n_pad
+
     # ------------------------------------------------------------------ main loop
     def train(self, resume_from_checkpoint: Optional[str] = None, **kwargs):
         args = self.args
         train_dataloader = self.get_train_dataloader()
         if has_length(train_dataloader):
             steps_per_epoch = len(train_dataloader)
+            if steps_per_epoch == 0:
+                raise ValueError(
+                    f"dataset yields 0 batches: {len(self.train_dataset)} samples < global batch "
+                    f"{args.global_train_batch_size} with drop_last; reduce batch size/data shards"
+                )
             if args.max_steps > 0:
                 max_steps = args.max_steps
                 num_train_epochs = math.ceil(max_steps / steps_per_epoch)
@@ -516,6 +562,7 @@ class Trainer:
         all_logits, all_labels = [], []
         with use_mesh(self.mesh):
             for host_batch in dataloader:
+                host_batch, n_pad = self._pad_batch_to_shards(host_batch)
                 batch = self._device_put_batch(host_batch, accum=1)
                 out = self._eval_step_fn(params, batch)
                 if "loss" in out:
@@ -524,9 +571,11 @@ class Trainer:
                     logits = out["logits"]
                     if self.preprocess_logits_for_metrics is not None:
                         logits = self.preprocess_logits_for_metrics(logits, host_batch.get("labels"))
-                    all_logits.append(np.asarray(jax.device_get(logits)))
+                    arr = self._maybe_unsplit_seq(np.asarray(jax.device_get(logits)))
+                    all_logits.append(arr[: arr.shape[0] - n_pad] if n_pad else arr)
                     if "labels" in host_batch:
-                        all_labels.append(np.asarray(host_batch["labels"]))
+                        lab = np.asarray(host_batch["labels"])
+                        all_labels.append(lab[: lab.shape[0] - n_pad] if n_pad else lab)
                 n_batches += 1
         metrics = {}
         if losses:
@@ -560,11 +609,14 @@ class Trainer:
         logits_all, labels_all = [], []
         with use_mesh(self.mesh):
             for host_batch in dataloader:
+                host_batch, n_pad = self._pad_batch_to_shards(host_batch)
                 batch = self._device_put_batch(host_batch, accum=1)
                 out = self._eval_step_fn(params, batch)
-                logits_all.append(np.asarray(jax.device_get(out["logits"])))
+                arr = self._maybe_unsplit_seq(np.asarray(jax.device_get(out["logits"])))
+                logits_all.append(arr[: arr.shape[0] - n_pad] if n_pad else arr)
                 if "labels" in host_batch:
-                    labels_all.append(np.asarray(host_batch["labels"]))
+                    lab = np.asarray(host_batch["labels"])
+                    labels_all.append(lab[: lab.shape[0] - n_pad] if n_pad else lab)
         preds = np.concatenate(logits_all, axis=0) if logits_all else None
         labels = np.concatenate(labels_all, axis=0) if labels_all else None
         metrics = {}

@@ -199,8 +199,7 @@ class LlamaAttention(nn.Module):
         ):
             from ...ops.ring_attention import ring_self_attention
 
-            ring_pos = position_ids[0] if position_ids.ndim > 1 else position_ids
-            attn_out = ring_self_attention(q, k, v, mesh, positions=ring_pos)
+            attn_out = ring_self_attention(q, k, v, mesh, positions=position_ids)
         else:
             attn_out = dot_product_attention(
                 q,

@@ -1,0 +1,180 @@
+"""End-to-end entry-point tests (reference tests/llm pattern: run the actual
+llm/run_*.py scripts in-process against tiny fixtures)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "llm"))
+
+
+@pytest.fixture(scope="module")
+def tiny_hub(tmp_path_factory):
+    """A hub dir with tiny llama + tokenizer + a .bin/.idx corpus + sft jsonl."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from paddlenlp_tpu.data import MMapIndexedDatasetBuilder
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM, PretrainedTokenizer
+
+    root = tmp_path_factory.mktemp("hub")
+    model_dir = root / "tiny-llama"
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64,
+                      eos_token_id=2, pad_token_id=0)
+    LlamaForCausalLM.from_config(cfg, seed=0).save_pretrained(str(model_dir))
+
+    vocab = {"<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3}
+    for i, w in enumerate("a b c d e f g h i j k l m n o p".split()):
+        vocab[w] = i + 4
+    t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    tok = PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", bos_token="<s>",
+                              eos_token="</s>", unk_token="<unk>")
+    tok.save_pretrained(str(model_dir))
+
+    # corpus
+    rng = np.random.default_rng(0)
+    builder = MMapIndexedDatasetBuilder(str(root / "corpus"), dtype=np.uint16)
+    for _ in range(64):
+        builder.add_document(rng.integers(4, 20, size=int(rng.integers(20, 60))))
+    builder.finalize()
+
+    # sft data
+    data_dir = root / "sft"
+    data_dir.mkdir()
+    rows = [{"src": "a b c", "tgt": "d e"}, {"src": "f g", "tgt": "h i j"}] * 32
+    with open(data_dir / "train.json", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    with open(data_dir / "dev.json", "w") as f:
+        for r in rows[:4]:
+            f.write(json.dumps(r) + "\n")
+    return {"root": root, "model": model_dir, "corpus": root / "corpus", "sft": data_dir}
+
+
+class TestRunPretrain:
+    def test_pretrain_from_json_config(self, tiny_hub, tmp_path, monkeypatch):
+        import run_pretrain
+
+        cfg = {
+            "model_name_or_path": str(tiny_hub["model"]),
+            "input_dir": str(tiny_hub["corpus"]),
+            "output_dir": str(tmp_path / "out"),
+            "max_seq_length": 32,
+            "per_device_train_batch_size": 2,
+            "max_steps": 4,
+            "logging_steps": 2,
+            "save_steps": 4,
+            "save_strategy": "steps",
+            "do_train": True,
+            "learning_rate": 1e-3,
+            "dtype": "float32",
+        }
+        cfg_path = tmp_path / "pretrain.json"
+        cfg_path.write_text(json.dumps(cfg))
+        monkeypatch.setattr(sys, "argv", ["run_pretrain.py", str(cfg_path)])
+        trainer = run_pretrain.main()
+        assert trainer.state.global_step == 4
+        assert os.path.isdir(tmp_path / "out" / "checkpoint-4")
+        assert os.path.isfile(tmp_path / "out" / "model.safetensors")
+
+    def test_resume_from_checkpoint(self, tiny_hub, tmp_path, monkeypatch):
+        import run_pretrain
+
+        out = tmp_path / "out2"
+        base = {
+            "model_name_or_path": str(tiny_hub["model"]),
+            "input_dir": str(tiny_hub["corpus"]),
+            "output_dir": str(out),
+            "max_seq_length": 32,
+            "per_device_train_batch_size": 2,
+            "max_steps": 2,
+            "save_steps": 2,
+            "save_strategy": "steps",
+            "do_train": True,
+            "dtype": "float32",
+        }
+        p = tmp_path / "a.json"
+        p.write_text(json.dumps(base))
+        monkeypatch.setattr(sys, "argv", ["run_pretrain.py", str(p)])
+        run_pretrain.main()
+        base["max_steps"] = 4
+        p.write_text(json.dumps(base))
+        monkeypatch.setattr(sys, "argv", ["run_pretrain.py", str(p)])
+        trainer = run_pretrain.main()  # auto-resumes from checkpoint-2
+        assert trainer.state.global_step == 4
+
+
+class TestRunFinetune:
+    def test_sft_zero_padding(self, tiny_hub, tmp_path, monkeypatch):
+        import run_finetune
+
+        cfg = {
+            "model_name_or_path": str(tiny_hub["model"]),
+            "dataset_name_or_path": str(tiny_hub["sft"]),
+            "output_dir": str(tmp_path / "sft_out"),
+            "max_length": 32,
+            "per_device_train_batch_size": 1,
+            "max_steps": 3,
+            "logging_steps": 1,
+            "save_strategy": "no",
+            "do_train": True,
+            "do_eval": True,
+            "dtype": "float32",
+        }
+        p = tmp_path / "sft.json"
+        p.write_text(json.dumps(cfg))
+        monkeypatch.setattr(sys, "argv", ["run_finetune.py", str(p)])
+        trainer = run_finetune.main()
+        assert trainer.state.global_step == 3
+
+    def test_sft_lora(self, tiny_hub, tmp_path, monkeypatch):
+        import run_finetune
+
+        cfg = {
+            "model_name_or_path": str(tiny_hub["model"]),
+            "dataset_name_or_path": str(tiny_hub["sft"]),
+            "output_dir": str(tmp_path / "lora_out"),
+            "max_length": 32,
+            "per_device_train_batch_size": 1,
+            "max_steps": 2,
+            "save_strategy": "no",
+            "do_train": True,
+            "lora": True,
+            "lora_rank": 4,
+            "dtype": "float32",
+        }
+        p = tmp_path / "lora.json"
+        p.write_text(json.dumps(cfg))
+        monkeypatch.setattr(sys, "argv", ["run_finetune.py", str(p)])
+        trainer = run_finetune.main()
+        assert trainer.state.global_step == 2
+        assert os.path.isfile(tmp_path / "lora_out" / "lora_model.safetensors")
+
+
+class TestPreprocess:
+    def test_preprocess_tool(self, tiny_hub, tmp_path):
+        corpus = tmp_path / "raw.jsonl"
+        with open(corpus, "w") as f:
+            for i in range(10):
+                f.write(json.dumps({"text": "a b c d e f g"}) + "\n")
+        out_prefix = tmp_path / "prep" / "data"
+        rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "llm", "tools", "preprocess_data.py"),
+             "--input", str(corpus), "--output_prefix", str(out_prefix),
+             "--tokenizer_name_or_path", str(tiny_hub["model"]), "--append_eos"],
+            capture_output=True, text=True,
+        )
+        assert rc.returncode == 0, rc.stderr[-2000:]
+        from paddlenlp_tpu.data import MMapIndexedDataset
+
+        ds = MMapIndexedDataset(str(out_prefix))
+        assert ds.n_docs == 10
+        assert ds[0][-1] == 2  # eos appended
