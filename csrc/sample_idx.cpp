@@ -40,15 +40,41 @@ int build_sample_idx(const int32_t* sizes,
         remaining -= doc_len;
         ++doc_pos;
         doc_offset = 0;
-        // the boundary token shared between samples: step back one token so the
-        // next sample re-reads it (classic Megatron overlap) — only when the
-        // document ended exactly at the boundary is no overlap needed
       }
     }
     sample_idx[2 * i] = doc_pos;
     sample_idx[2 * i + 1] = doc_offset;
   }
   return 0;
+}
+
+// Weighted blend assignment (largest-deficit greedy, the Megatron
+// build_blending_indices semantics): for each blended sample i, pick the
+// component whose running count is furthest behind its quota.
+//   weights:              [n_components], sum to 1
+//   dataset_index:        [n_samples] out (component id)
+//   dataset_sample_index: [n_samples] out (index within component)
+void build_blending_indices(const double* weights,
+                            int64_t n_components,
+                            int64_t n_samples,
+                            int32_t* dataset_index,
+                            int64_t* dataset_sample_index) {
+  int64_t* counts = new int64_t[n_components]();
+  for (int64_t i = 0; i < n_samples; ++i) {
+    double best = -1e18;
+    int64_t best_c = 0;
+    for (int64_t c = 0; c < n_components; ++c) {
+      double deficit = (double)(i + 1) * weights[c] - (double)counts[c];
+      if (deficit > best) {
+        best = deficit;
+        best_c = c;
+      }
+    }
+    dataset_index[i] = (int32_t)best_c;
+    dataset_sample_index[i] = counts[best_c];
+    ++counts[best_c];
+  }
+  delete[] counts;
 }
 
 // Fisher-Yates shuffle with a splitmix64 PRNG (deterministic across platforms).

@@ -1,0 +1,121 @@
+"""DPO alignment entry point (reference: /root/reference/llm/alignment/dpo/run_dpo.py :58).
+
+Data: jsonl rows {"src": prompt, "chosen": ..., "rejected": ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+
+import numpy as np
+
+from paddlenlp_tpu.trainer import PdArgumentParser, TrainingArguments
+from paddlenlp_tpu.transformers import AutoConfig, AutoModelForCausalLM, AutoTokenizer, LlmMetaConfig
+from paddlenlp_tpu.trl import DPOCriterion, DPOTrainer
+from paddlenlp_tpu.utils.log import logger
+
+
+@dataclass
+class ModelArguments:
+    model_name_or_path: str = "facebook/llama-7b"
+    ref_model_name_or_path: Optional[str] = None
+    dtype: str = "bfloat16"
+
+
+@dataclass
+class DPOArguments:
+    dataset_name_or_path: str = "data"
+    max_length: int = 1024
+    max_prompt_length: int = 512
+    beta: float = 0.1
+    loss_type: str = "sigmoid"
+    label_smoothing: float = 0.0
+    simpo_gamma: float = 0.5
+    sft_loss_ratio: float = 0.0
+
+
+def load_preference_dataset(path: str, tokenizer, dpo_args: DPOArguments):
+    rows = []
+    max_len = dpo_args.max_length
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            r = json.loads(line)
+            prompt = tokenizer.encode(str(r["src"]))[: dpo_args.max_prompt_length]
+            eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
+
+            def build(resp):
+                resp_ids = (tokenizer.encode(str(resp)) + eos)[: max_len - len(prompt)]
+                ids = np.asarray(prompt + resp_ids, dtype=np.int32)
+                labels = np.asarray([-100] * len(prompt) + resp_ids, dtype=np.int32)
+                pad = max_len - len(ids)
+                return (np.pad(ids, (0, pad)), np.pad(labels, (0, pad), constant_values=-100))
+
+            ci, cl = build(r["chosen"])
+            ri, rl = build(r["rejected"])
+            rows.append({"chosen_input_ids": ci, "chosen_labels": cl,
+                         "rejected_input_ids": ri, "rejected_labels": rl})
+    return rows
+
+
+class ListDataset:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+
+def main():
+    parser = PdArgumentParser((ModelArguments, DPOArguments, TrainingArguments))
+    model_args, dpo_args, training_args = parser.parse_args_into_dataclasses()
+
+    tokenizer = AutoTokenizer.from_pretrained(model_args.model_name_or_path)
+    config = AutoConfig.from_pretrained(model_args.model_name_or_path)
+    LlmMetaConfig.set_llm_config(config, training_args)
+    model = AutoModelForCausalLM.from_pretrained(
+        model_args.model_name_or_path, config=config, dtype=model_args.dtype, param_dtype="float32"
+    )
+    ref_model = None
+    if model_args.ref_model_name_or_path:
+        ref_model = AutoModelForCausalLM.from_pretrained(
+            model_args.ref_model_name_or_path, dtype=model_args.dtype, param_dtype="float32"
+        )
+
+    rows = load_preference_dataset(
+        os.path.join(dpo_args.dataset_name_or_path, "train.json"), tokenizer, dpo_args
+    )
+    criterion = DPOCriterion(
+        beta=dpo_args.beta,
+        loss_type=dpo_args.loss_type,
+        label_smoothing=dpo_args.label_smoothing,
+        simpo_gamma=dpo_args.simpo_gamma,
+        sft_loss_ratio=dpo_args.sft_loss_ratio,
+    )
+    trainer = DPOTrainer(
+        model=model,
+        ref_model=ref_model,
+        dpo_criterion=criterion,
+        args=training_args,
+        train_dataset=ListDataset(rows),
+        tokenizer=tokenizer,
+    )
+    if training_args.do_train:
+        result = trainer.train(resume_from_checkpoint=training_args.resume_from_checkpoint)
+        trainer.save_model()
+        logger.info(f"dpo done: {result.metrics}")
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

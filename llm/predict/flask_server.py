@@ -1,0 +1,103 @@
+"""Streaming HTTP chat server.
+
+Counterpart of ``/root/reference/llm/predict/flask_server.py`` (235 LoC: streaming
+HTTP on flask + the get_output SysV message queue). Stdlib-only (no flask in this
+image): ``ThreadingHTTPServer`` + server-sent-event streaming straight from the
+engine's token callbacks — the IPC hop disappears because the engine is in-process.
+
+POST /generate  {"src": str, "max_length"?: int, "stream"?: bool}
+GET  /health
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paddlenlp_tpu.trainer import PdArgumentParser
+from paddlenlp_tpu.utils.log import logger
+from predictor import BlockPredictor, PredictorArgument, create_predictor
+
+
+def make_handler(predictor, lock: threading.Lock):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug(fmt % args)
+
+        def do_GET(self):
+            if self.path == "/health":
+                body = json.dumps({"status": "ok"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                text = payload["src"]
+            except (json.JSONDecodeError, KeyError) as e:
+                body = json.dumps({"error": f"bad request: {e}"}).encode()
+                self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            stream = bool(payload.get("stream", False))
+            if "max_length" in payload:
+                predictor.args.max_length = int(payload["max_length"])
+            with lock:  # one generation at a time per engine (batching inside)
+                if stream and isinstance(predictor, BlockPredictor):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    for piece in predictor.stream_predict(text):
+                        self.wfile.write(f"data: {json.dumps({'token': piece})}\n\n".encode())
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                else:
+                    out = predictor.predict([text])[0]
+                    body = json.dumps({"output": out}, ensure_ascii=False).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+    return Handler
+
+
+def serve(predictor, port: int = 8011):
+    server = ThreadingHTTPServer(("0.0.0.0", port), make_handler(predictor, threading.Lock()))
+    logger.info(f"serving on :{port} (POST /generate)")
+    server.serve_forever()
+
+
+def main():
+    parser = PdArgumentParser((PredictorArgument,))
+    (args, remaining) = parser.parse_args_into_dataclasses(return_remaining_strings=True)
+    port = 8011
+    for i, r in enumerate(remaining):
+        if r == "--port":
+            port = int(remaining[i + 1])
+    serve(create_predictor(args), port)
+
+
+if __name__ == "__main__":
+    main()

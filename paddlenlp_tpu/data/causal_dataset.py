@@ -70,7 +70,8 @@ class GPTDataset:
         ).hexdigest()[:16]
         cache_base = cache_dir or os.path.join(os.path.dirname(indexed._prefix) or ".", "index-cache")
         cache_path = os.path.join(cache_base, f"{os.path.basename(indexed._prefix)}-{cache_key}")
-        if os.path.isfile(cache_path + "-sample.npy"):
+        done_marker = cache_path + "-done"
+        if os.path.isfile(done_marker):  # marker written LAST via atomic rename
             self.doc_idx = np.load(cache_path + "-doc.npy")
             self.sample_idx = np.load(cache_path + "-sample.npy")
             self.shuffle_idx = np.load(cache_path + "-shuffle.npy")
@@ -83,11 +84,18 @@ class GPTDataset:
         self.sample_idx = build_sample_idx(self.indexed.sizes, self.doc_idx, seq_length, n_samples)
         self.shuffle_idx = rng.permutation(n_samples).astype(np.int64)
         try:
-            # single-writer build (reference: rank 0 builds, others spin :417)
+            # concurrent-safe publish: per-file tmp + os.replace, done-marker last.
+            # Concurrent builders compute identical (deterministic) indices, so the
+            # last replace wins harmlessly; readers gate on the marker.
             os.makedirs(cache_base, exist_ok=True)
-            np.save(cache_path + "-doc.npy", self.doc_idx)
-            np.save(cache_path + "-sample.npy", self.sample_idx)
-            np.save(cache_path + "-shuffle.npy", self.shuffle_idx)
+            tmp_suffix = f".tmp{os.getpid()}"
+            for suffix, arr in (("-doc.npy", self.doc_idx), ("-sample.npy", self.sample_idx),
+                                ("-shuffle.npy", self.shuffle_idx)):
+                np.save(cache_path + suffix + tmp_suffix, arr)
+                os.replace(cache_path + suffix + tmp_suffix + ".npy", cache_path + suffix)
+            with open(done_marker + tmp_suffix, "w") as f:
+                f.write("ok")
+            os.replace(done_marker + tmp_suffix, done_marker)
         except OSError as e:
             logger.warning(f"index cache write failed: {e}")
         logger.info(f"built {name} GPTDataset index in {time.time() - t0:.2f}s "
@@ -125,17 +133,11 @@ class BlendableDataset:
         w = np.asarray(weights, dtype=np.float64)
         w = w / w.sum()
         self.n_samples = n_samples
-        # deterministic assignment: greedy largest-deficit (matches megatron's
-        # helper semantics without the native build)
-        counts = np.zeros(len(w))
-        self.dataset_index = np.zeros(n_samples, dtype=np.int32)
-        self.dataset_sample_index = np.zeros(n_samples, dtype=np.int64)
-        for i in range(n_samples):
-            deficit = (i + 1) * w - counts
-            d = int(np.argmax(deficit))
-            self.dataset_index[i] = d
-            self.dataset_sample_index[i] = counts[d]
-            counts[d] += 1
+        # deterministic largest-deficit assignment in the native helper (the
+        # reference/Megatron build_blending_indices hot loop)
+        from .native import build_blending_indices
+
+        self.dataset_index, self.dataset_sample_index = build_blending_indices(w, n_samples)
 
     def __len__(self):
         return self.n_samples
@@ -158,16 +160,24 @@ def build_train_valid_test_datasets(
     if isinstance(data_prefix, (list, tuple)) and len(data_prefix) > 1:
         weights = [float(w) for w in data_prefix[0::2]]
         prefixes = [str(p) for p in data_prefix[1::2]]
+        total_w = sum(weights)
         per_split = []
         for split_i in range(3):
+            n = train_valid_test_num_samples[split_i]
+            if n <= 0:
+                per_split.append(None)
+                continue
             comps = []
-            for prefix in prefixes:
+            for prefix, w in zip(prefixes, weights):
+                # each component only needs ~weight*n samples (+margin for the
+                # greedy assignment), not the full blend size
+                comp_counts = [0, 0, 0]
+                comp_counts[split_i] = int(np.ceil(n * w / total_w)) + 1
                 t, v, te = build_train_valid_test_datasets(
-                    prefix, seq_length, train_valid_test_num_samples, splits_string, seed, cache_dir
+                    prefix, seq_length, tuple(comp_counts), splits_string, seed, cache_dir
                 )
                 comps.append((t, v, te)[split_i])
-            n = train_valid_test_num_samples[split_i]
-            per_split.append(BlendableDataset(comps, weights, n, seed) if n > 0 else None)
+            per_split.append(BlendableDataset(comps, weights, n, seed))
         return tuple(per_split)
 
     prefix = data_prefix[0] if isinstance(data_prefix, (list, tuple)) else data_prefix

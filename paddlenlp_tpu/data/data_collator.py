@@ -47,6 +47,7 @@ class DataCollatorWithPadding:
     max_length: Optional[int] = None
     pad_to_multiple_of: Optional[int] = None
     return_attention_mask: bool = True
+    label_pad_token_id: int = -100
 
     def __call__(self, features: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
         pad_id = self.tokenizer.pad_token_id if self.tokenizer is not None else 0
@@ -65,18 +66,14 @@ class DataCollatorWithPadding:
             if vals[0].ndim == 0:
                 batch[key] = np.stack(vals)
             else:
-                fill = -100 if key == "labels" else 0
+                fill = self.label_pad_token_id if key == "labels" else 0
                 batch[key] = _pad_to(vals, fill, self.pad_to_multiple_of, side)
         return batch
 
 
 @dataclasses.dataclass
 class DataCollatorForSeq2Seq(DataCollatorWithPadding):
-    label_pad_token_id: int = -100
-
-    def __call__(self, features):
-        batch = super().__call__(features)
-        return batch
+    pass
 
 
 @dataclasses.dataclass

@@ -52,6 +52,14 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_int64),
             ]
+            lib.build_blending_indices.restype = None
+            lib.build_blending_indices.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
             _lib = lib
         except Exception as e:
             logger.warning(f"native data helpers unavailable ({e}); using numpy fallback")
@@ -82,6 +90,30 @@ def build_sample_idx(sizes: np.ndarray, doc_idx: np.ndarray, seq_length: int, n_
             raise ValueError("corpus exhausted before n_samples; increase epochs in doc_idx")
         return out
     return _build_sample_idx_np(sizes, doc_idx, seq_length, n_samples)
+
+
+def build_blending_indices(weights: np.ndarray, n_samples: int):
+    """Largest-deficit greedy blend assignment -> (dataset_index i32, sample_index i64)."""
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    dataset_index = np.zeros(n_samples, dtype=np.int32)
+    dataset_sample_index = np.zeros(n_samples, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        lib.build_blending_indices(
+            weights.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(weights),
+            n_samples,
+            dataset_index.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            dataset_sample_index.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return dataset_index, dataset_sample_index
+    counts = np.zeros(len(weights))
+    for i in range(n_samples):
+        d = int(np.argmax((i + 1) * weights - counts))
+        dataset_index[i] = d
+        dataset_sample_index[i] = counts[d]
+        counts[d] += 1
+    return dataset_index, dataset_sample_index
 
 
 def _build_sample_idx_np(sizes, doc_idx, seq_length, n_samples):

@@ -1,0 +1,3 @@
+from .engine import InferenceEngine, Request, SamplingParams  # noqa: F401
+from .inference_model import PagedInferenceModel  # noqa: F401
+from .paged_cache import BlockManager, PagedKVPool, init_paged_pool  # noqa: F401

@@ -1,0 +1,228 @@
+"""Continuous-batching inference engine.
+
+Counterpart of the reference's dynamic-insertion serving loop:
+``GenerationBlockInferenceModel.sample`` per-token loop
+(experimental/transformers/generation_utils.py:403) + the ``step_paddle`` block
+scheduler (csrc/gpu/step.cu:316 — dispatch/free/preempt/recover). Host-side
+scheduler + two jitted device programs (bucketed prefill, fixed-shape decode):
+
+- admission: waiting requests prefill one-at-a-time into freshly allocated block
+  tables (prompt lengths bucketed to powers of two to bound retraces);
+- decode: ALL running sequences advance one token per step in a single fixed
+  [max_batch_size] jit — empty slots point at the sentinel block and are masked;
+- preemption: on block exhaustion the youngest sequence is evicted and requeued
+  with prompt+generated as its new prompt (recompute-style recovery, the
+  ``is_block_step``/recover list of step.cu);
+- streaming: per-request callbacks fire as tokens land (the reference pushes
+  tokens over a SysV message queue to the serving process; in-process callbacks
+  replace the IPC hop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import logger
+from .inference_model import PagedInferenceModel
+from .paged_cache import BlockManager, init_paged_pool
+
+__all__ = ["InferenceEngine", "Request", "SamplingParams"]
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_new_tokens: int = 64
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: int
+    prompt_ids: np.ndarray
+    sampling: SamplingParams
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    stream_cb: Optional[Callable[[int, bool], None]] = None
+    _rng: Optional[np.random.Generator] = None
+    arrival_t: float = 0.0
+    first_token_t: Optional[float] = None
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        model,
+        tokenizer=None,
+        max_batch_size: int = 8,
+        block_size: int = 16,
+        num_blocks: int = 512,
+        max_blocks_per_seq: int = 64,
+        eos_token_id: Optional[int] = None,
+        dtype=jnp.float32,
+    ):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.infer = PagedInferenceModel(model, block_size, num_blocks, max_blocks_per_seq, dtype=dtype)
+        self.pool = init_paged_pool(model.config, num_blocks, block_size,
+                                    dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32)
+        self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq)
+        self.max_batch_size = max_batch_size
+        eos = eos_token_id if eos_token_id is not None else getattr(model.config, "eos_token_id", None)
+        self.eos_ids = set(eos) if isinstance(eos, (list, tuple)) else ({eos} if eos is not None else set())
+        self.waiting: deque[Request] = deque()
+        self.running: Dict[int, Request] = {}  # seq_id == req_id
+        self._next_id = itertools.count()
+        self._last_token: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------ api
+    def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
+                    stream_cb: Optional[Callable] = None) -> int:
+        sampling = sampling or SamplingParams()
+        req = Request(
+            req_id=next(self._next_id),
+            prompt_ids=np.asarray(prompt_ids, dtype=np.int32).reshape(-1),
+            sampling=sampling,
+            stream_cb=stream_cb,
+            _rng=np.random.default_rng(sampling.seed),
+            arrival_t=time.time(),
+        )
+        self.waiting.append(req)
+        return req.req_id
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
+        """Submit a batch and run to completion (convenience API)."""
+        ids = [self.add_request(p, sampling) for p in prompts]
+        results: Dict[int, Request] = {}
+        while self.has_work():
+            for req in self.step():
+                results[req.req_id] = req
+        return [results[i].output_ids for i in ids]
+
+    # ------------------------------------------------------------------ scheduling
+    def step(self) -> List[Request]:
+        """One engine iteration: admit + decode. Returns requests finished this step."""
+        finished: List[Request] = []
+        self._admit(finished)
+        self._decode_running(finished)
+        return finished
+
+    def _admit(self, finished: List[Request]):
+        while self.waiting and len(self.running) < self.max_batch_size:
+            req = self.waiting[0]
+            prompt_len = len(req.prompt_ids)
+            # reserve prompt + 1 so the first decode never immediately preempts
+            if not self.mgr.can_allocate(prompt_len + 1):
+                break
+            self.waiting.popleft()
+            self.mgr.allocate(req.req_id, prompt_len)
+            table = jnp.asarray(self.mgr.table_array(req.req_id))
+            padded = _bucket(prompt_len)
+            ids = np.zeros((1, padded), np.int32)
+            ids[0, :prompt_len] = req.prompt_ids
+            logits, self.pool = self.infer.prefill(
+                self.model.params, self.pool, jnp.asarray(ids), table, jnp.asarray(prompt_len)
+            )
+            tok = self._sample(req, np.asarray(logits[0]))
+            self._emit(req, tok)
+            if req.done:
+                self.mgr.free_seq(req.req_id)
+                finished.append(req)
+            else:
+                self.running[req.req_id] = req
+                self._last_token[req.req_id] = tok
+
+    def _decode_running(self, finished: List[Request]):
+        if not self.running:
+            return
+        # grow tables; preempt (recompute-requeue) youngest on exhaustion
+        for req_id in sorted(self.running, reverse=True):
+            req = self.running[req_id]
+            if self.mgr.extend(req_id, 1) is None:
+                logger.warning(f"req {req_id}: KV blocks exhausted; preempting (recompute)")
+                self.mgr.free_seq(req_id)
+                del self.running[req_id]
+                req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
+                req.output_ids = []
+                self.waiting.appendleft(req)
+
+        if not self.running:
+            return
+        B = self.max_batch_size
+        tokens = np.zeros(B, np.int32)
+        tables = np.zeros((B, self.mgr.max_blocks_per_seq), np.int32)
+        ctx = np.zeros(B, np.int32)
+        slots = list(self.running.values())
+        for i, req in enumerate(slots):
+            tokens[i] = self._last_token[req.req_id]
+            tables[i] = self.mgr.table_array(req.req_id)
+            ctx[i] = req.total_len - 1  # position of the token being fed
+        logits, self.pool = self.infer.decode(
+            self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(ctx)
+        )
+        logits_np = np.asarray(logits)
+        for i, req in enumerate(slots):
+            tok = self._sample(req, logits_np[i])
+            self._emit(req, tok)
+            if req.done:
+                self.mgr.free_seq(req.req_id)
+                del self.running[req.req_id]
+                self._last_token.pop(req.req_id, None)
+                finished.append(req)
+            else:
+                self._last_token[req.req_id] = tok
+
+    # ------------------------------------------------------------------ sampling
+    def _sample(self, req: Request, logits: np.ndarray) -> int:
+        s = req.sampling
+        if not s.do_sample:
+            return int(np.argmax(logits))
+        logits = logits.astype(np.float64) / max(s.temperature, 1e-6)
+        if s.top_k and s.top_k > 0:
+            kth = np.partition(logits, -s.top_k)[-s.top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        if s.top_p < 1.0:
+            order = np.argsort(probs)[::-1]
+            csum = np.cumsum(probs[order])
+            cutoff = np.searchsorted(csum, s.top_p) + 1
+            mask = np.zeros_like(probs)
+            mask[order[:cutoff]] = probs[order[:cutoff]]
+            probs = mask / mask.sum()
+        return int(req._rng.choice(len(probs), p=probs))
+
+    def _emit(self, req: Request, tok: int):
+        if req.first_token_t is None:
+            req.first_token_t = time.time()
+        req.output_ids.append(tok)
+        is_eos = tok in self.eos_ids
+        hit_max = len(req.output_ids) >= req.sampling.max_new_tokens
+        req.done = is_eos or hit_max
+        if req.stream_cb is not None:
+            req.stream_cb(tok, req.done)

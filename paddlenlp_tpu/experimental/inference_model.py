@@ -1,0 +1,173 @@
+"""Paged-attention inference forward for llama-family models.
+
+Counterpart of ``paddlenlp/experimental/transformers/fused_transformer_layers.py``
+(``FusedBlockMultiTransformer`` :2192) + per-model ``*BlockInferenceModel`` classes:
+a decode-optimized forward that REUSES the training params (scanned [L] layout)
+but runs its own fused loop — mirroring the reference's split between training
+models and the experimental inference runtime.
+
+TPU-native: one ``lax.scan`` over the stacked layer params + the [L]-leading paged
+pool; block-table gathers/scatters instead of CUDA append-attention kernels; the
+whole prefill/decode step is a single jit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
+from .paged_cache import PagedKVPool, gather_kv, write_kv_block
+
+__all__ = ["PagedInferenceModel"]
+
+
+def _rms(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+class PagedInferenceModel:
+    """Holds jitted prefill/decode over (params, pool). Llama-family only
+    (llama/qwen2/mistral: config-driven biases + GQA + rope)."""
+
+    def __init__(self, model, block_size: int = 16, num_blocks: int = 512, max_blocks_per_seq: int = 64,
+                 dtype=jnp.bfloat16):
+        self.model = model
+        self.config = model.config
+        if "layers" not in model.params.get("model", {}):
+            raise ValueError("PagedInferenceModel requires the scanned-layer param layout (use_scan_layers)")
+        self.dtype = dtype
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_blocks_per_seq = max_blocks_per_seq
+        cfg = self.config
+        self.eps = cfg.rms_norm_eps
+        self.n_heads = cfg.num_attention_heads
+        self.n_kv = cfg.num_key_value_heads
+        self.head_dim = cfg.head_dim
+        self.inv_freq = jnp.asarray(rope_frequencies(self.head_dim, cfg.rope_theta, cfg.rope_scaling))
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode = jax.jit(self._decode_impl)
+
+    # ------------------------------------------------------------------ forward core
+    def _attend(self, q, k, v, q_positions, kv_len_mask):
+        """q [B,T,N,H]; k/v [B,S,K,H]; causal by absolute position + length mask."""
+        B, T, N, H = q.shape
+        S = k.shape[1]
+        if self.n_kv != N:
+            k = jnp.repeat(k, N // self.n_kv, axis=2)
+            v = jnp.repeat(v, N // self.n_kv, axis=2)
+        logits = jnp.einsum("btnh,bsnh->bnts", q.astype(jnp.float32), k.astype(jnp.float32)) * (H**-0.5)
+        kv_pos = jnp.arange(S)[None, :]
+        mask = (kv_pos[:, None, :] <= q_positions[:, :, None]) & kv_len_mask[:, None, :]
+        logits = jnp.where(mask[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bnts,bsnh->btnh", probs, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    def _layer(self, carry, scanned, block_tables, q_positions, kv_len_mask, write_pos):
+        """One decoder layer inside lax.scan: scanned = (layer_params, pool_layer)."""
+        h = carry
+        lp, pool_layer = scanned
+        cfg = self.config
+        B, T, D = h.shape
+
+        x = _rms(h, lp["input_layernorm"]["scale"], self.eps)
+        attn = lp["self_attn"]
+
+        def proj(p, x, heads):
+            y = x @ p["kernel"].astype(self.dtype)
+            if "bias" in p:
+                y = y + p["bias"].astype(self.dtype)
+            return y.reshape(B, T, heads, self.head_dim)
+
+        q = proj(attn["q_proj"], x, self.n_heads)
+        k = proj(attn["k_proj"], x, self.n_kv)
+        v = proj(attn["v_proj"], x, self.n_kv)
+        cos, sin = rope_tables(q_positions, self.inv_freq)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+        # scatter new K/V into the pool (vmapped over the batch)
+        def write_one(pool_l, k_i, v_i, table_i, start_i):
+            return write_kv_block(pool_l, k_i, v_i, table_i, start_i)
+
+        pool_layer = functools.reduce(
+            lambda pl, i: write_one(pl, k[i], v[i], block_tables[i], write_pos[i]),
+            range(B),
+            pool_layer,
+        )
+        k_all, v_all = gather_kv(pool_layer, block_tables)
+        attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
+        attn_out = attn_out.reshape(B, T, self.n_heads * self.head_dim)
+        o = attn_out @ attn["o_proj"]["kernel"].astype(self.dtype)
+        if "bias" in attn["o_proj"]:
+            o = o + attn["o_proj"]["bias"].astype(self.dtype)
+        h = h + o
+
+        x = _rms(h, lp["post_attention_layernorm"]["scale"], self.eps)
+        mlp = lp["mlp"]
+        gate = x @ mlp["gate_proj"]["kernel"].astype(self.dtype)
+        up = x @ mlp["up_proj"]["kernel"].astype(self.dtype)
+        h = h + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(self.dtype)
+        return h, pool_layer
+
+    def _forward(self, params, pool_kv, input_ids, block_tables, q_positions, kv_len_mask, write_pos, last_pos):
+        """input_ids [B,T]; returns (logits at last_pos [B,V], new pool kv [L,...])."""
+        m = params["model"]
+        embed = m["embed_tokens"]["embedding"]
+        h = embed[input_ids].astype(self.dtype)
+        if getattr(self.config, "scale_embeddings", False):
+            h = h * jnp.asarray(self.config.hidden_size**0.5, h.dtype)
+
+        def body(carry, scanned):
+            return self._layer(carry, scanned, block_tables, q_positions, kv_len_mask, write_pos)
+
+        h, new_pool = jax.lax.scan(body, h, (m["layers"], pool_kv))
+        h = _rms(h, m["norm"]["scale"], self.eps)
+        last = h[jnp.arange(h.shape[0]), last_pos]
+        if "lm_head" in params:
+            logits = last @ params["lm_head"]["kernel"].astype(self.dtype)
+        else:
+            logits = last @ embed.T.astype(self.dtype)
+        return logits.astype(jnp.float32), new_pool
+
+    # ------------------------------------------------------------------ entry points
+    def _prefill_impl(self, params, pool_kv, input_ids, block_table, prompt_len):
+        """One sequence [1, T_pad]; valid prefix length = prompt_len."""
+        T = input_ids.shape[1]
+        positions = jnp.arange(T)[None, :]
+        S = block_table.shape[0] * self.block_size
+        kv_len_mask = jnp.arange(S)[None, :] < prompt_len
+        logits, new_pool = self._forward(
+            params, pool_kv, input_ids, block_table[None], positions,
+            kv_len_mask, jnp.zeros((1,), jnp.int32),
+            jnp.asarray([prompt_len - 1]),  # last VALID token (input may be padded)
+        )
+        return logits, new_pool
+
+    def _decode_impl(self, params, pool_kv, tokens, block_tables, context_lens):
+        """tokens [B] (the next input token per seq, at position context_lens)."""
+        B = tokens.shape[0]
+        positions = context_lens[:, None]
+        S = block_tables.shape[1] * self.block_size
+        kv_len_mask = jnp.arange(S)[None, :] <= context_lens[:, None]
+        logits, new_pool = self._forward(
+            params, pool_kv, tokens[:, None], block_tables, positions,
+            kv_len_mask, context_lens,
+            jnp.zeros((B,), jnp.int32),
+        )
+        return logits, new_pool
+
+    def prefill(self, params, pool: PagedKVPool, input_ids, block_table, prompt_len) -> Tuple[jnp.ndarray, PagedKVPool]:
+        logits, kv = self._prefill(params, pool.kv, input_ids, block_table, prompt_len)
+        return logits, PagedKVPool(kv=kv)
+
+    def decode(self, params, pool: PagedKVPool, tokens, block_tables, context_lens) -> Tuple[jnp.ndarray, PagedKVPool]:
+        logits, kv = self._decode(params, pool.kv, tokens, block_tables, context_lens)
+        return logits, PagedKVPool(kv=kv)

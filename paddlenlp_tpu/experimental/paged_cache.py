@@ -1,0 +1,152 @@
+"""Paged (block) KV cache + host-side block manager.
+
+Counterpart of the reference's block-attention machinery: the CUDA block pool in
+``csrc/gpu/append_attn/*`` (write_cache_with_rope, c16 cache) and the in-kernel
+allocator ``csrc/gpu/step.cu`` (op ``step_paddle`` :316 — free/dispatch blocks,
+preempt + recover). TPU-native split:
+
+- device side: ONE pool tensor ``[L, 2, num_blocks, block_size, n_kv, H]``;
+  prefill/decode scatter new K/V into table-addressed slots
+  (``lax`` scatter via ``.at[]``) and attention gathers whole block rows — static
+  shapes, jit-compiled once;
+- host side: ``BlockManager`` does the step.cu bookkeeping (free list, per-seq
+  tables, allocate/extend/free, preemption candidates) in plain Python — the
+  allocator runs between device steps, so there is no launch-latency reason to
+  put it in-kernel as CUDA must.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedKVPool", "BlockManager", "init_paged_pool", "write_kv_block", "gather_kv"]
+
+
+@dataclasses.dataclass
+class PagedKVPool:
+    """Device-side pool: kv [L, 2, num_blocks, block_size, n_kv, head_dim]."""
+
+    kv: jnp.ndarray
+
+    @property
+    def num_blocks(self) -> int:
+        return self.kv.shape[2]
+
+    @property
+    def block_size(self) -> int:
+        return self.kv.shape[3]
+
+
+jax.tree_util.register_dataclass(PagedKVPool, data_fields=["kv"], meta_fields=[])
+
+
+def init_paged_pool(config, num_blocks: int, block_size: int = 16, dtype=jnp.bfloat16) -> PagedKVPool:
+    n_kv = getattr(config, "num_key_value_heads", config.num_attention_heads)
+    head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
+    shape = (config.num_hidden_layers, 2, num_blocks, block_size, n_kv, head_dim)
+    return PagedKVPool(kv=jnp.zeros(shape, dtype=dtype))
+
+
+def write_kv_block(pool_layer: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   block_table: jnp.ndarray, start_pos) -> jnp.ndarray:
+    """Scatter new tokens' K/V into the pool (one layer).
+
+    pool_layer [2, num_blocks, bs, K, H]; k/v [T, K, H] for ONE sequence;
+    block_table [max_blocks]; start_pos scalar — token i lands at logical position
+    start_pos+i -> (block_table[(start_pos+i)//bs], (start_pos+i)%bs).
+    """
+    T = k.shape[0]
+    bs = pool_layer.shape[2]
+    pos = start_pos + jnp.arange(T)
+    blocks = block_table[pos // bs]
+    offs = pos % bs
+    pool_layer = pool_layer.at[0, blocks, offs].set(k.astype(pool_layer.dtype))
+    pool_layer = pool_layer.at[1, blocks, offs].set(v.astype(pool_layer.dtype))
+    return pool_layer
+
+
+def gather_kv(pool_layer: jnp.ndarray, block_tables: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Gather per-sequence K/V views (one layer).
+
+    pool_layer [2, num_blocks, bs, K, H]; block_tables [B, max_blocks] ->
+    (k, v) each [B, max_blocks*bs, K, H]. Out-of-range table entries must point at
+    a zeroed sentinel block; masking by context length happens in attention.
+    """
+    k = pool_layer[0][block_tables]  # [B, max_blocks, bs, K, H]
+    v = pool_layer[1][block_tables]
+    B, M, bs, K, H = k.shape
+    return k.reshape(B, M * bs, K, H), v.reshape(B, M * bs, K, H)
+
+
+class BlockManager:
+    """Host-side allocator (the step.cu bookkeeping in Python).
+
+    Block 0 is reserved as the zero sentinel for unused table slots.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.free: List[int] = list(range(1, num_blocks))  # block 0 = sentinel
+        self.tables: Dict[int, List[int]] = {}
+        self.lengths: Dict[int, int] = {}
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.block_size - 1) // self.block_size
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_needed(n_tokens) <= len(self.free)
+
+    def allocate(self, seq_id: int, n_tokens: int) -> List[int]:
+        need = self.blocks_needed(n_tokens)
+        if need > len(self.free):
+            raise RuntimeError(f"out of KV blocks: need {need}, free {len(self.free)}")
+        if need > self.max_blocks_per_seq:
+            raise ValueError(f"sequence needs {need} blocks > max_blocks_per_seq {self.max_blocks_per_seq}")
+        blocks = [self.free.pop() for _ in range(need)]
+        self.tables[seq_id] = blocks
+        self.lengths[seq_id] = n_tokens
+        return blocks
+
+    def extend(self, seq_id: int, n_new_tokens: int = 1) -> Optional[List[int]]:
+        """Grow a sequence; returns newly-allocated blocks (None if OOM -> preempt)."""
+        new_len = self.lengths[seq_id] + n_new_tokens
+        need = self.blocks_needed(new_len) - len(self.tables[seq_id])
+        if need > 0:
+            if need > len(self.free):
+                return None
+            if self.blocks_needed(new_len) > self.max_blocks_per_seq:
+                return None
+            new_blocks = [self.free.pop() for _ in range(need)]
+            self.tables[seq_id].extend(new_blocks)
+        else:
+            new_blocks = []
+        self.lengths[seq_id] = new_len
+        return new_blocks
+
+    def free_seq(self, seq_id: int):
+        blocks = self.tables.pop(seq_id, [])
+        self.lengths.pop(seq_id, None)
+        self.free.extend(blocks)
+
+    def table_array(self, seq_id: int) -> np.ndarray:
+        """Padded table row (sentinel block 0 for unused slots)."""
+        out = np.zeros(self.max_blocks_per_seq, dtype=np.int32)
+        blocks = self.tables.get(seq_id, [])
+        out[: len(blocks)] = blocks
+        return out
+
+    def longest_seq(self) -> Optional[int]:
+        """Preemption candidate (reference step.cu preempts the longest)."""
+        if not self.lengths:
+            return None
+        return max(self.lengths, key=lambda s: self.lengths[s])

@@ -168,19 +168,21 @@ class LoRAModel:
     def get_model_flops(self, *a, **kw):
         return self.model.get_model_flops(*a, **kw)
 
-    @classmethod
-    def get_partition_rules(cls, config=None):
-        # adapters: A shards like the kernel's input dim, B like its output dim
-        raise NotImplementedError  # instance method below is used
-
     def get_partition_rules_instance(self):
-        base = type(self.model).get_partition_rules(self.config)
+        """Adapter specs DERIVED from each kernel rule: lora_A inherits the
+        kernel's input-dim logical axis, lora_B its output-dim axis — so e.g.
+        down_proj (P('mlp','embed')) gets A: P('mlp', None), B: P(None, 'embed')."""
         from ...parallel.partition import P
 
-        return list(base) + [
-            (r"lora_A$", P("embed", None)),
-            (r"lora_B$", P(None, "mlp")),
-        ]
+        base = list(type(self.model).get_partition_rules(self.config))
+        derived = []
+        for pattern, spec in base:
+            if not pattern.endswith("/kernel$") or len(spec) < 2:
+                continue
+            prefix = pattern[: -len("/kernel$")]
+            derived.append((prefix + "/lora_A$", P(spec[0], None)))
+            derived.append((prefix + "/lora_B$", P(None, spec[-1])))
+        return base + derived
 
     # ------------------------------------------------------------------ save/load
     def merge_and_unload(self):

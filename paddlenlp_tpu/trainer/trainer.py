@@ -369,18 +369,16 @@ class Trainer:
 
         return {k: put(v) for k, v in batch.items()}
 
-    def _maybe_unsplit_seq(self, arr: np.ndarray) -> np.ndarray:
-        """Undo the cp zigzag permutation on collected logits so they align with
-        the (unpermuted) host labels handed to compute_metrics/predict."""
+    def _maybe_unsplit_seq(self, logits):
+        """Undo the cp zigzag permutation on eval logits (device-side) so every
+        downstream consumer — preprocess_logits_for_metrics, compute_metrics,
+        predict — sees dataset sequence order aligned with the host labels."""
         cp = self.mesh.shape.get("cp", 1)
-        if cp <= 1 or arr.ndim < 2:
-            return arr
-        from ..ops.ring_attention import zigzag_positions
+        if cp <= 1 or getattr(logits, "ndim", 0) < 2:
+            return logits
+        from ..ops.ring_attention import zigzag_unsplit
 
-        idx = np.asarray(zigzag_positions(arr.shape[1], cp))
-        inv = np.zeros_like(idx)
-        inv[idx] = np.arange(len(idx), dtype=idx.dtype)
-        return arr[:, inv]
+        return zigzag_unsplit(logits, cp, axis=1)
 
     def _pad_batch_to_shards(self, batch: Dict[str, np.ndarray]):
         """Pad a partial (last) eval batch to a multiple of the data shards by
@@ -568,10 +566,10 @@ class Trainer:
                 if "loss" in out:
                     losses.append(float(out["loss"]))
                 if self.compute_metrics is not None:
-                    logits = out["logits"]
+                    logits = self._maybe_unsplit_seq(out["logits"])  # BEFORE any positional preprocessing
                     if self.preprocess_logits_for_metrics is not None:
                         logits = self.preprocess_logits_for_metrics(logits, host_batch.get("labels"))
-                    arr = self._maybe_unsplit_seq(np.asarray(jax.device_get(logits)))
+                    arr = np.asarray(jax.device_get(logits))
                     all_logits.append(arr[: arr.shape[0] - n_pad] if n_pad else arr)
                     if "labels" in host_batch:
                         lab = np.asarray(host_batch["labels"])
@@ -612,7 +610,7 @@ class Trainer:
                 host_batch, n_pad = self._pad_batch_to_shards(host_batch)
                 batch = self._device_put_batch(host_batch, accum=1)
                 out = self._eval_step_fn(params, batch)
-                arr = self._maybe_unsplit_seq(np.asarray(jax.device_get(out["logits"])))
+                arr = np.asarray(jax.device_get(self._maybe_unsplit_seq(out["logits"])))
                 logits_all.append(arr[: arr.shape[0] - n_pad] if n_pad else arr)
                 if "labels" in host_batch:
                     lab = np.asarray(host_batch["labels"])

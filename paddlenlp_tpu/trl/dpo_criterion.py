@@ -1,0 +1,109 @@
+"""Preference-optimization loss zoo.
+
+Counterpart of ``paddlenlp/trl/dpo_criterion.py`` (the DPO/SimPO/ORPO/KTO loss
+family selected by ``loss_type``). All losses are pure functions of per-sequence
+log-probabilities — jit-safe, fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DPOCriterion", "sequence_logps"]
+
+
+def sequence_logps(logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int = -100) -> jnp.ndarray:
+    """Sum log p(label) over valid positions, per sequence. logits [B,T,V], labels [B,T]
+    (already aligned: labels[t] is the target for logits[t])."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, tok, 0.0).sum(axis=-1)
+
+
+class DPOCriterion:
+    """loss_type: sigmoid (DPO) | hinge | ipo | simpo | orpo | kto_pair."""
+
+    def __init__(
+        self,
+        beta: float = 0.1,
+        loss_type: str = "sigmoid",
+        label_smoothing: float = 0.0,
+        simpo_gamma: float = 0.5,
+        sft_loss_ratio: float = 0.0,
+    ):
+        self.beta = beta
+        self.loss_type = loss_type
+        self.label_smoothing = label_smoothing
+        self.simpo_gamma = simpo_gamma
+        self.sft_loss_ratio = sft_loss_ratio
+
+    @property
+    def needs_reference(self) -> bool:
+        return self.loss_type not in ("simpo", "orpo")
+
+    def __call__(
+        self,
+        policy_chosen_logps: jnp.ndarray,
+        policy_rejected_logps: jnp.ndarray,
+        reference_chosen_logps: Optional[jnp.ndarray] = None,
+        reference_rejected_logps: Optional[jnp.ndarray] = None,
+        chosen_lengths: Optional[jnp.ndarray] = None,
+        rejected_lengths: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        beta = self.beta
+        if self.loss_type in ("sigmoid", "hinge", "ipo", "kto_pair"):
+            assert reference_chosen_logps is not None, f"{self.loss_type} needs a reference model"
+            chosen_rewards = beta * (policy_chosen_logps - reference_chosen_logps)
+            rejected_rewards = beta * (policy_rejected_logps - reference_rejected_logps)
+            margin = chosen_rewards - rejected_rewards
+            if self.loss_type == "sigmoid":
+                loss = (
+                    -jax.nn.log_sigmoid(margin) * (1 - self.label_smoothing)
+                    - jax.nn.log_sigmoid(-margin) * self.label_smoothing
+                )
+            elif self.loss_type == "hinge":
+                loss = jax.nn.relu(1.0 - margin)
+            elif self.loss_type == "ipo":
+                loss = (margin / beta - 1.0 / (2.0 * beta)) ** 2
+            else:  # kto_pair
+                chosen_kl = jnp.clip(jnp.mean(reference_chosen_logps - policy_chosen_logps), 0.0)
+                rejected_kl = jnp.clip(jnp.mean(reference_rejected_logps - policy_rejected_logps), 0.0)
+                loss = jnp.concatenate(
+                    [
+                        1.0 - jax.nn.sigmoid(beta * ((policy_chosen_logps - reference_chosen_logps) - rejected_kl)),
+                        1.0 - jax.nn.sigmoid(beta * (chosen_kl - (policy_rejected_logps - reference_rejected_logps))),
+                    ]
+                )
+        elif self.loss_type == "simpo":
+            # length-normalized, reference-free
+            assert chosen_lengths is not None
+            pc = policy_chosen_logps / jnp.maximum(chosen_lengths, 1)
+            pr = policy_rejected_logps / jnp.maximum(rejected_lengths, 1)
+            margin = beta * (pc - pr) - self.simpo_gamma
+            loss = -jax.nn.log_sigmoid(margin)
+            chosen_rewards, rejected_rewards = beta * pc, beta * pr
+        elif self.loss_type == "orpo":
+            # odds-ratio penalty on top of SFT loss (caller adds the sft part)
+            assert chosen_lengths is not None
+            pc = policy_chosen_logps / jnp.maximum(chosen_lengths, 1)
+            pr = policy_rejected_logps / jnp.maximum(rejected_lengths, 1)
+            log_odds = (pc - pr) - (jnp.log1p(-jnp.clip(jnp.exp(pc), a_max=1 - 1e-6))
+                                    - jnp.log1p(-jnp.clip(jnp.exp(pr), a_max=1 - 1e-6)))
+            loss = -jax.nn.log_sigmoid(beta * log_odds)
+            chosen_rewards, rejected_rewards = pc, pr
+        else:
+            raise ValueError(f"unknown dpo loss_type {self.loss_type}")
+
+        metrics = {
+            "rewards_chosen": chosen_rewards.mean(),
+            "rewards_rejected": rejected_rewards.mean(),
+            "rewards_accuracy": (chosen_rewards > rejected_rewards).mean(),
+            "rewards_margin": (chosen_rewards - rejected_rewards).mean(),
+        }
+        return loss.mean(), metrics

@@ -1,0 +1,77 @@
+"""DPO trainer.
+
+Counterpart of ``paddlenlp/trl/dpo_trainer.py`` (565 LoC; also runs SimPO/ORPO/KTO
+via the criterion zoo) + ``llm/alignment/dpo/run_dpo.py``. Batches carry
+``chosen_input_ids/chosen_labels/rejected_input_ids/rejected_labels`` (prompt
+positions masked with -100); chosen+rejected are concatenated on the batch axis
+for ONE forward (the reference's zero-padding concat scheme, trl_data.py), and the
+frozen reference params ride the jitted step as captured constants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..trainer.trainer import Trainer
+from ..utils.log import logger
+from .dpo_criterion import DPOCriterion, sequence_logps
+
+__all__ = ["DPOTrainer"]
+
+
+class DPOTrainer(Trainer):
+    def __init__(self, model=None, ref_model=None, dpo_criterion: Optional[DPOCriterion] = None,
+                 beta: float = 0.1, loss_type: str = "sigmoid", **kwargs):
+        self.dpo_criterion = dpo_criterion or DPOCriterion(beta=beta, loss_type=loss_type)
+        super().__init__(model=model, **kwargs)
+        self.ref_params = None
+        if self.dpo_criterion.needs_reference:
+            if ref_model is not None:
+                self.ref_params = ref_model.params
+            else:
+                # frozen DEEP copy of the starting policy (standard DPO init).
+                # A real buffer copy is required: the jitted train step donates the
+                # policy params, which would delete aliased reference buffers.
+                self.ref_params = jax.tree.map(jnp.copy, model.params)
+                logger.info("DPO: using a frozen copy of the policy as the reference model")
+
+    def compute_loss(self, params, inputs: Dict[str, Any], dropout_rng=None):
+        inputs = dict(inputs)
+        chosen_ids = inputs.pop("chosen_input_ids")
+        rejected_ids = inputs.pop("rejected_input_ids")
+        chosen_labels = inputs.pop("chosen_labels")
+        rejected_labels = inputs.pop("rejected_labels")
+        ids = jnp.concatenate([chosen_ids, rejected_ids], axis=0)
+        labels = jnp.concatenate([chosen_labels, rejected_labels], axis=0)
+        B = chosen_ids.shape[0]
+        rngs = {"dropout": dropout_rng} if dropout_rng is not None else {}
+
+        # shift: labels[t] should be the target of logits[t]
+        def seq_logps(p, deterministic):
+            out = self.model.module.apply({"params": p}, input_ids=ids[:, :-1],
+                                          deterministic=deterministic, rngs=rngs if not deterministic else {})
+            logits = out.logits if hasattr(out, "logits") else out[0]
+            return sequence_logps(logits, labels[:, 1:])
+
+        logps = seq_logps(params, deterministic=False)
+        policy_chosen, policy_rejected = logps[:B], logps[B:]
+        ref_chosen = ref_rejected = None
+        if self.ref_params is not None:
+            ref_logps = jax.lax.stop_gradient(seq_logps(self.ref_params, deterministic=True))
+            ref_chosen, ref_rejected = ref_logps[:B], ref_logps[B:]
+
+        chosen_len = (chosen_labels[:, 1:] != -100).sum(axis=-1)
+        rejected_len = (rejected_labels[:, 1:] != -100).sum(axis=-1)
+        loss, metrics = self.dpo_criterion(
+            policy_chosen, policy_rejected, ref_chosen, ref_rejected, chosen_len, rejected_len
+        )
+        if self.dpo_criterion.loss_type == "orpo" or self.dpo_criterion.sft_loss_ratio > 0:
+            # SFT anchor on the chosen responses
+            sft = -(policy_chosen / jnp.maximum(chosen_len, 1)).mean()
+            ratio = self.dpo_criterion.sft_loss_ratio or 1.0
+            loss = loss + ratio * sft
+        return loss

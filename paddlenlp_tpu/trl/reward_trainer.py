@@ -1,0 +1,37 @@
+"""Reward-model trainer.
+
+Counterpart of ``/root/reference/llm/alignment/rm/reward_trainer.py``: pairwise
+Bradley-Terry ranking loss ``-log sigmoid(r_chosen - r_rejected)`` over a
+sequence-classification head (num_labels=1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..trainer.trainer import Trainer
+
+__all__ = ["RewardTrainer"]
+
+
+class RewardTrainer(Trainer):
+    def compute_loss(self, params, inputs: Dict[str, Any], dropout_rng=None):
+        inputs = dict(inputs)
+        chosen_ids = inputs.pop("chosen_input_ids")
+        rejected_ids = inputs.pop("rejected_input_ids")
+        chosen_mask = inputs.pop("chosen_attention_mask", None)
+        rejected_mask = inputs.pop("rejected_attention_mask", None)
+        ids = jnp.concatenate([chosen_ids, rejected_ids], axis=0)
+        mask = None
+        if chosen_mask is not None:
+            mask = jnp.concatenate([chosen_mask, rejected_mask], axis=0)
+        rngs = {"dropout": dropout_rng} if dropout_rng is not None else {}
+        out = self.model.module.apply({"params": params}, input_ids=ids, attention_mask=mask,
+                                      deterministic=False, rngs=rngs)
+        rewards = (out.logits if hasattr(out, "logits") else out[0])[..., 0].astype(jnp.float32)
+        B = chosen_ids.shape[0]
+        margin = rewards[:B] - rewards[B:]
+        return -jax.nn.log_sigmoid(margin).mean()

@@ -1,0 +1,125 @@
+"""Predictor + server tests (reference tests/llm/test_predictor.py pattern)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "llm", "predict"))
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM, PretrainedTokenizer
+
+    d = tmp_path_factory.mktemp("predict-model")
+    cfg = LlamaConfig(vocab_size=32, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=128,
+                      eos_token_id=2, pad_token_id=0)
+    LlamaForCausalLM.from_config(cfg, seed=0).save_pretrained(str(d))
+    vocab = {"<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3}
+    for i, w in enumerate("alpha beta gamma delta epsilon zeta eta theta".split()):
+        vocab[w] = i + 4
+    t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", bos_token="<s>", eos_token="</s>",
+                        unk_token="<unk>").save_pretrained(str(d))
+    return str(d)
+
+
+class TestPredictors:
+    def _args(self, model_dir, **kw):
+        from predictor import PredictorArgument
+
+        defaults = dict(model_name_or_path=model_dir, dtype="float32", max_length=8,
+                        batch_size=2, decode_strategy="greedy_search", num_kv_blocks=64,
+                        block_size=4, max_blocks_per_seq=16)
+        defaults.update(kw)
+        return PredictorArgument(**defaults)
+
+    def test_eager_and_block_agree(self, model_dir):
+        from predictor import create_predictor
+
+        texts = ["alpha beta gamma", "delta epsilon"]
+        eager = create_predictor(self._args(model_dir, mode="eager"))
+        block = create_predictor(self._args(model_dir, mode="block"), model=None)
+        oe = eager.predict(texts)
+        ob = block.predict(texts)
+        assert oe == ob, (oe, ob)
+
+    def test_stream_predict(self, model_dir):
+        from predictor import create_predictor
+
+        block = create_predictor(self._args(model_dir))
+        pieces = list(block.stream_predict("alpha beta"))
+        full = block.predict(["alpha beta"])[0]
+        assert "".join(pieces) == full
+
+    def test_unknown_mode(self, model_dir):
+        from predictor import create_predictor
+
+        with pytest.raises(ValueError, match="unknown predictor mode"):
+            create_predictor(self._args(model_dir, mode="static"))
+
+
+class TestServer:
+    def test_http_generate_and_stream(self, model_dir):
+        import socket
+
+        from flask_server import make_handler
+        from http.server import ThreadingHTTPServer
+
+        from predictor import create_predictor
+
+        predictor = create_predictor(self._args(model_dir))
+        server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(predictor, threading.Lock()))
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            # health
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=30) as r:
+                assert json.loads(r.read())["status"] == "ok"
+            # non-stream generate
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"src": "alpha beta"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out = json.loads(r.read())["output"]
+            assert isinstance(out, str)
+            # streaming generate
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"src": "alpha beta", "stream": True}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                body = r.read().decode()
+            assert "data:" in body and "[DONE]" in body
+            pieces = [json.loads(line[6:])["token"] for line in body.splitlines()
+                      if line.startswith("data:") and "[DONE]" not in line]
+            assert "".join(pieces) == out
+            # bad request
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/generate", data=b"not json",
+                                         headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.shutdown()
+
+    _args = TestPredictors._args

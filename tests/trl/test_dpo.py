@@ -1,0 +1,172 @@
+"""DPO / RM tests: criterion math, trainer learns a preference, entry point runs."""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.trainer import TrainingArguments
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM, LlamaForSequenceClassification
+from paddlenlp_tpu.trl import DPOCriterion, DPOTrainer, RewardTrainer, sequence_logps
+
+
+def tiny_model(seed=0, cls=LlamaForCausalLM, **kw):
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64, **kw)
+    return cls.from_config(cfg, seed=seed)
+
+
+class TestCriterion:
+    def test_sequence_logps_masks_prompt(self):
+        logits = jnp.zeros((1, 4, 8))  # uniform -> logp = -log(8) per token
+        labels = jnp.asarray([[-100, 1, 2, -100]])
+        lp = sequence_logps(logits, labels)
+        np.testing.assert_allclose(float(lp[0]), -2 * np.log(8), rtol=1e-5)
+
+    def test_sigmoid_loss_prefers_chosen(self):
+        crit = DPOCriterion(beta=0.1, loss_type="sigmoid")
+        good = crit(jnp.asarray([-5.0]), jnp.asarray([-10.0]), jnp.asarray([-7.0]), jnp.asarray([-7.0]))[0]
+        bad = crit(jnp.asarray([-10.0]), jnp.asarray([-5.0]), jnp.asarray([-7.0]), jnp.asarray([-7.0]))[0]
+        assert float(good) < float(bad)
+
+    @pytest.mark.parametrize("loss_type", ["sigmoid", "hinge", "ipo", "kto_pair"])
+    def test_ref_losses_finite(self, loss_type):
+        crit = DPOCriterion(loss_type=loss_type)
+        loss, metrics = crit(jnp.asarray([-4.0, -6.0]), jnp.asarray([-5.0, -5.5]),
+                             jnp.asarray([-5.0, -6.0]), jnp.asarray([-5.0, -6.0]))
+        assert np.isfinite(float(loss))
+        assert 0.0 <= float(metrics["rewards_accuracy"]) <= 1.0
+
+    @pytest.mark.parametrize("loss_type", ["simpo", "orpo"])
+    def test_ref_free_losses(self, loss_type):
+        crit = DPOCriterion(loss_type=loss_type)
+        assert not crit.needs_reference
+        loss, _ = crit(jnp.asarray([-4.0]), jnp.asarray([-6.0]), None, None,
+                       jnp.asarray([10]), jnp.asarray([12]))
+        assert np.isfinite(float(loss))
+
+
+def make_pref_dataset(n=32, seq=12):
+    """chosen continuations use token 7, rejected use token 9 — learnable."""
+    rng = np.random.default_rng(0)
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            prompt = rng.integers(20, 40, size=4).astype(np.int32)
+
+            def row(tok):
+                resp = np.full(seq - 4, tok, dtype=np.int32)
+                ids = np.concatenate([prompt, resp])
+                labels = np.concatenate([np.full(4, -100, np.int32), resp])
+                return ids, labels
+
+            ci, cl = row(7)
+            ri, rl = row(9)
+            return {"chosen_input_ids": ci, "chosen_labels": cl,
+                    "rejected_input_ids": ri, "rejected_labels": rl}
+
+    return DS()
+
+
+class TestDPOTrainer:
+    def test_dpo_learns_preference(self, tmp_path):
+        model = tiny_model()
+        args = TrainingArguments(output_dir=str(tmp_path), max_steps=8, per_device_train_batch_size=2,
+                                 learning_rate=5e-4, logging_steps=4, save_strategy="no")
+        trainer = DPOTrainer(model=model, args=args, train_dataset=make_pref_dataset(), beta=0.5)
+        out = trainer.train()
+        assert np.isfinite(out.training_loss)
+        # after training, p(chosen token) should beat p(rejected token)
+        ids = jnp.asarray([[25, 30, 22, 35]], jnp.int32)
+        logits = trainer.model.apply(trainer.train_state.params, input_ids=ids).logits
+        last = np.asarray(logits[0, -1])
+        assert last[7] > last[9], (last[7], last[9])
+
+    def test_simpo_no_reference(self, tmp_path):
+        model = tiny_model()
+        args = TrainingArguments(output_dir=str(tmp_path), max_steps=3, per_device_train_batch_size=2,
+                                 learning_rate=5e-4, save_strategy="no")
+        trainer = DPOTrainer(model=model, args=args, train_dataset=make_pref_dataset(),
+                             loss_type="simpo")
+        assert trainer.ref_params is None
+        out = trainer.train()
+        assert np.isfinite(out.training_loss)
+
+
+class TestRewardTrainer:
+    def test_rm_learns_ranking(self, tmp_path):
+        model = tiny_model(cls=LlamaForSequenceClassification, num_labels=1)
+        rng = np.random.default_rng(0)
+
+        class DS:
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                base = rng.integers(20, 40, size=8).astype(np.int32)
+                chosen = np.concatenate([base, [7, 7]]).astype(np.int32)
+                rejected = np.concatenate([base, [9, 9]]).astype(np.int32)
+                return {"chosen_input_ids": chosen, "rejected_input_ids": rejected}
+
+        args = TrainingArguments(output_dir=str(tmp_path), max_steps=8, per_device_train_batch_size=2,
+                                 learning_rate=1e-3, logging_steps=4, save_strategy="no")
+        trainer = RewardTrainer(model=model, args=args, train_dataset=DS())
+        out = trainer.train()
+        assert np.isfinite(out.training_loss)
+        chosen = jnp.asarray([np.concatenate([np.arange(20, 28), [7, 7]])], jnp.int32)
+        rejected = jnp.asarray([np.concatenate([np.arange(20, 28), [9, 9]])], jnp.int32)
+        rc = float(trainer.model.apply(trainer.train_state.params, input_ids=chosen).logits[0, 0])
+        rr = float(trainer.model.apply(trainer.train_state.params, input_ids=rejected).logits[0, 0])
+        assert rc > rr, (rc, rr)
+
+
+class TestRunDPO:
+    def test_entry_point(self, tmp_path, monkeypatch):
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        sys.path.insert(0, os.path.join(repo, "llm", "alignment", "dpo"))
+        import run_dpo
+
+        from tokenizers import Tokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+        model_dir = tmp_path / "model"
+        tiny_model().save_pretrained(str(model_dir))
+        vocab = {"<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3}
+        for i, w in enumerate("yes no maybe good bad fine great awful ok sure".split()):
+            vocab[w] = i + 4
+        t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", eos_token="</s>",
+                            unk_token="<unk>").save_pretrained(str(model_dir))
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        with open(data_dir / "train.json", "w") as f:
+            for _ in range(16):
+                f.write(json.dumps({"src": "maybe ok", "chosen": "good great", "rejected": "bad awful"}) + "\n")
+        cfg = {
+            "model_name_or_path": str(model_dir),
+            "dataset_name_or_path": str(data_dir),
+            "output_dir": str(tmp_path / "out"),
+            "max_length": 16,
+            "max_prompt_length": 8,
+            "per_device_train_batch_size": 1,
+            "max_steps": 2,
+            "save_strategy": "no",
+            "do_train": True,
+            "dtype": "float32",
+        }
+        p = tmp_path / "dpo.json"
+        p.write_text(json.dumps(cfg))
+        monkeypatch.setattr(sys, "argv", ["run_dpo.py", str(p)])
+        trainer = run_dpo.main()
+        assert trainer.state.global_step == 2
