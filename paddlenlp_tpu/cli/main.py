@@ -1,0 +1,76 @@
+"""``paddlenlp_tpu`` CLI (reference: paddlenlp/cli/main.py — download/convert/
+server/install subcommands; offline build drops download)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("paddlenlp_tpu", description="TPU-native NLP toolkit CLI")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_ver = sub.add_parser("version", help="print version + environment")
+
+    p_conv = sub.add_parser("convert", help="convert a torch safetensors checkpoint dir in place-compatible format")
+    p_conv.add_argument("--model", required=True, help="HF checkpoint dir")
+    p_conv.add_argument("--output", required=True, help="output dir")
+    p_conv.add_argument("--model_class", default="AutoModelForCausalLM")
+
+    p_srv = sub.add_parser("server", help="launch the streaming chat server (llm/predict/flask_server.py)")
+    p_srv.add_argument("--model", required=True)
+    p_srv.add_argument("--port", type=int, default=8011)
+    p_srv.add_argument("--dtype", default="bfloat16")
+
+    p_pred = sub.add_parser("predict", help="run the predictor on a prompt")
+    p_pred.add_argument("--model", required=True)
+    p_pred.add_argument("--prompt", required=True)
+    p_pred.add_argument("--max_length", type=int, default=64)
+    p_pred.add_argument("--dtype", default="bfloat16")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        import jax
+
+        from .. import __version__
+
+        print(json.dumps({
+            "paddlenlp_tpu": __version__,
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+        }, indent=2))
+    elif args.command == "convert":
+        import paddlenlp_tpu.transformers as T
+
+        cls = getattr(T, args.model_class)
+        model = cls.from_pretrained(args.model)
+        model.save_pretrained(args.output)
+        print(f"converted -> {args.output}")
+    elif args.command == "server":
+        import os
+        import runpy
+
+        sys.argv = ["flask_server.py", "--model_name_or_path", args.model,
+                    "--dtype", args.dtype, "--port", str(args.port)]
+        root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        server_py = os.path.join(root, "llm", "predict", "flask_server.py")
+        if not os.path.isfile(server_py):
+            parser.error("`server` needs the repo checkout (llm/predict/flask_server.py not found "
+                         f"relative to {root}); run it from the source tree")
+        runpy.run_path(server_py, run_name="__main__")
+    elif args.command == "predict":
+        from ..taskflow import Taskflow
+
+        flow = Taskflow("text_generation", task_path=args.model,
+                        max_new_tokens=args.max_length, dtype=args.dtype)
+        print(json.dumps(flow(args.prompt), ensure_ascii=False, indent=2))
+
+
+if __name__ == "__main__":
+    main()

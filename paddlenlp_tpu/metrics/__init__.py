@@ -1,0 +1,5 @@
+from .bleu import BLEU  # noqa: F401
+from .classification import AccuracyAndF1, MultiLabelsMetric  # noqa: F401
+from .distinct import Distinct  # noqa: F401
+from .perplexity import Perplexity  # noqa: F401
+from .rouge import Rouge1, Rouge2, RougeL  # noqa: F401

@@ -1,0 +1,61 @@
+"""BLEU (reference: paddlenlp/metrics/bleu.py). Corpus BLEU with uniform n-gram
+weights and brevity penalty; accumulator API (add_inst/score) like the reference."""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import List, Sequence
+
+__all__ = ["BLEU"]
+
+
+def _ngrams(tokens: Sequence, n: int) -> Counter:
+    return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+
+
+class BLEU:
+    def __init__(self, n_size: int = 4):
+        self.n_size = n_size
+        self.reset()
+
+    def reset(self):
+        self.match = [0] * self.n_size
+        self.candi = [0] * self.n_size
+        self.cand_len = 0
+        self.ref_len = 0
+
+    def add_inst(self, cand: Sequence, ref_list: List[Sequence]):
+        for n in range(1, self.n_size + 1):
+            cand_counts = _ngrams(cand, n)
+            max_ref = Counter()
+            for ref in ref_list:
+                for gram, cnt in _ngrams(ref, n).items():
+                    max_ref[gram] = max(max_ref[gram], cnt)
+            clipped = sum(min(cnt, max_ref.get(gram, 0)) for gram, cnt in cand_counts.items())
+            self.match[n - 1] += clipped
+            self.candi[n - 1] += max(sum(cand_counts.values()), 0)
+        self.cand_len += len(cand)
+        # closest reference length
+        self.ref_len += min((abs(len(r) - len(cand)), len(r)) for r in ref_list)[1]
+
+    def score(self) -> float:
+        if self.cand_len == 0:
+            return 0.0
+        precisions = []
+        for m, c in zip(self.match, self.candi):
+            if c == 0:
+                precisions.append(0.0)
+            elif m == 0:
+                precisions.append(1e-12)
+            else:
+                precisions.append(m / c)
+        if min(precisions) <= 0:
+            geo = 0.0
+        else:
+            geo = math.exp(sum(math.log(p) for p in precisions) / self.n_size)
+        bp = 1.0 if self.cand_len > self.ref_len else math.exp(1 - self.ref_len / max(self.cand_len, 1))
+        return bp * geo
+
+    def accumulate(self):
+        return self.score()

@@ -1,0 +1,69 @@
+"""Classification metrics (reference: paddlenlp/metrics/glue.py AccuracyAndF1 etc.)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AccuracyAndF1", "MultiLabelsMetric"]
+
+
+class AccuracyAndF1:
+    """Binary/micro accuracy + F1 accumulator (GLUE-style)."""
+
+    def __init__(self, pos_label: int = 1):
+        self.pos_label = pos_label
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.fn = self.correct = self.total = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        if preds.ndim and preds.dtype.kind == "f":
+            preds = preds.round().astype(int)
+        self.correct += int((preds == labels).sum())
+        self.total += len(labels)
+        self.tp += int(((preds == self.pos_label) & (labels == self.pos_label)).sum())
+        self.fp += int(((preds == self.pos_label) & (labels != self.pos_label)).sum())
+        self.fn += int(((preds != self.pos_label) & (labels == self.pos_label)).sum())
+
+    def accumulate(self):
+        acc = self.correct / max(self.total, 1)
+        prec = self.tp / max(self.tp + self.fp, 1)
+        rec = self.tp / max(self.tp + self.fn, 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return {"accuracy": acc, "precision": prec, "recall": rec, "f1": f1,
+                "acc_and_f1": (acc + f1) / 2}
+
+
+class MultiLabelsMetric:
+    """Macro/micro P/R/F1 over multi-class predictions."""
+
+    def __init__(self, num_labels: int):
+        self.num_labels = num_labels
+        self.reset()
+
+    def reset(self):
+        self.tp = np.zeros(self.num_labels, np.int64)
+        self.fp = np.zeros(self.num_labels, np.int64)
+        self.fn = np.zeros(self.num_labels, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        for c in range(self.num_labels):
+            self.tp[c] += int(((preds == c) & (labels == c)).sum())
+            self.fp[c] += int(((preds == c) & (labels != c)).sum())
+            self.fn[c] += int(((preds != c) & (labels == c)).sum())
+
+    def accumulate(self, average: str = "macro"):
+        prec = self.tp / np.maximum(self.tp + self.fp, 1)
+        rec = self.tp / np.maximum(self.tp + self.fn, 1)
+        f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+        if average == "macro":
+            return {"precision": float(prec.mean()), "recall": float(rec.mean()), "f1": float(f1.mean())}
+        tp, fp, fn = self.tp.sum(), self.fp.sum(), self.fn.sum()
+        p = tp / max(tp + fp, 1)
+        r = tp / max(tp + fn, 1)
+        return {"precision": float(p), "recall": float(r), "f1": float(2 * p * r / max(p + r, 1e-12))}

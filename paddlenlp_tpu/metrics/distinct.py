@@ -1,0 +1,28 @@
+"""Distinct-n diversity metric (reference: paddlenlp/metrics/distinct.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+__all__ = ["Distinct"]
+
+
+class Distinct:
+    def __init__(self, n_size: int = 2):
+        self.n_size = n_size
+        self.reset()
+
+    def reset(self):
+        self.ngrams = set()
+        self.count = 0
+
+    def add_inst(self, tokens: Sequence):
+        for i in range(len(tokens) - self.n_size + 1):
+            self.ngrams.add(tuple(tokens[i : i + self.n_size]))
+            self.count += 1
+
+    def score(self) -> float:
+        return len(self.ngrams) / max(self.count, 1)
+
+    def accumulate(self):
+        return self.score()

@@ -1,0 +1,35 @@
+"""Perplexity over token-level cross entropy (reference: paddlenlp/metrics/perplexity.py)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["Perplexity"]
+
+
+class Perplexity:
+    def __init__(self):
+        self.total_ce = 0.0
+        self.total_tokens = 0
+
+    def update(self, logits: np.ndarray, labels: np.ndarray, ignore_index: int = -100):
+        """logits [B, T, V]; labels [B, T] (aligned)."""
+        logits = np.asarray(logits, dtype=np.float64)
+        labels = np.asarray(labels)
+        valid = labels != ignore_index
+        safe = np.where(valid, labels, 0)
+        lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) + logits.max(-1)
+        picked = np.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        ce = np.where(valid, lse - picked, 0.0)
+        self.total_ce += float(ce.sum())
+        self.total_tokens += int(valid.sum())
+
+    def accumulate(self) -> float:
+        if self.total_tokens == 0:
+            return float("inf")
+        return math.exp(self.total_ce / self.total_tokens)
+
+    def reset(self):
+        self.total_ce, self.total_tokens = 0.0, 0

@@ -1,0 +1,83 @@
+"""ROUGE-1/2/L (reference: paddlenlp/metrics/rouge.py)."""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Sequence
+
+__all__ = ["Rouge1", "Rouge2", "RougeL"]
+
+
+def _ngram_overlap(cand: Sequence, ref: Sequence, n: int) -> float:
+    c = Counter(tuple(cand[i : i + n]) for i in range(len(cand) - n + 1))
+    r = Counter(tuple(ref[i : i + n]) for i in range(len(ref) - n + 1))
+    overlap = sum(min(cnt, r.get(g, 0)) for g, cnt in c.items())
+    total_ref = max(sum(r.values()), 1)
+    return overlap / total_ref  # recall-oriented, reference convention
+
+
+class _RougeN:
+    n = 1
+
+    def __init__(self):
+        self.scores: List[float] = []
+
+    def add_inst(self, cand: Sequence, ref_list: List[Sequence]):
+        self.scores.append(max(_ngram_overlap(cand, ref, self.n) for ref in ref_list))
+
+    def score(self) -> float:
+        return sum(self.scores) / max(len(self.scores), 1)
+
+    def accumulate(self):
+        return self.score()
+
+    def reset(self):
+        self.scores = []
+
+
+class Rouge1(_RougeN):
+    n = 1
+
+
+class Rouge2(_RougeN):
+    n = 2
+
+
+def _lcs(a: Sequence, b: Sequence) -> int:
+    m, n = len(a), len(b)
+    dp = [0] * (n + 1)
+    for i in range(1, m + 1):
+        prev = 0
+        for j in range(1, n + 1):
+            tmp = dp[j]
+            dp[j] = prev + 1 if a[i - 1] == b[j - 1] else max(dp[j], dp[j - 1])
+            prev = tmp
+    return dp[n]
+
+
+class RougeL:
+    def __init__(self, gamma: float = 1.2):
+        self.gamma = gamma
+        self.inst_scores: List[float] = []
+
+    def add_inst(self, cand: Sequence, ref_list: List[Sequence]):
+        best = 0.0
+        for ref in ref_list:
+            lcs = _lcs(cand, ref)
+            prec = lcs / max(len(cand), 1)
+            rec = lcs / max(len(ref), 1)
+            if prec > 0 and rec > 0:
+                f = ((1 + self.gamma**2) * prec * rec) / (rec + self.gamma**2 * prec)
+            else:
+                f = 0.0
+            best = max(best, f)
+        self.inst_scores.append(best)
+
+    def score(self) -> float:
+        return sum(self.inst_scores) / max(len(self.inst_scores), 1)
+
+    def accumulate(self):
+        return self.score()
+
+    def reset(self):
+        self.inst_scores = []

@@ -55,17 +55,43 @@ def dot_product_attention(
     dropout_rng: Optional[jax.Array] = None,
     window: Optional[int] = None,
     positions: Optional[jnp.ndarray] = None,  # [B, T] or [T] ABSOLUTE positions (permuted layouts)
-    use_pallas: bool = False,
+    use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused attention; returns [B, T, n_heads, head_dim] in query dtype.
 
     ``positions``: when the sequence axis is physically permuted (context-parallel
     zigzag layout), index order != causal order; pass absolute positions and the
     causal/window mask is built from them instead of array indices.
+
+    ``use_pallas``: opt-in (True runs the Pallas flash kernel; interpret mode
+    off-TPU). NOT auto-enabled: pallas_call is opaque to GSPMD, so inside a
+    sharded jit it would block partitioning — the shard_map-wrapped variant is
+    the round-2 path to turning it on by default.
     """
     B, T, N, H = query.shape
     S = key.shape[1]
     scale = scale if scale is not None else H**-0.5
+
+    plain_causal = (
+        causal
+        and attention_mask is None
+        and segment_ids is None
+        and window is None
+        and positions is None
+        and dropout_rate == 0.0
+        and T == S  # self-attention, no KV cache
+    )
+    if use_pallas is None:
+        use_pallas = False  # opt-in; see docstring
+    if use_pallas and plain_causal:
+        try:
+            from .pallas.flash_attention import flash_attention as pallas_flash
+
+            return pallas_flash(query, key, value, scale, True)
+        except Exception as e:  # pallas unavailable/lowering failure: fall through
+            from ..utils.log import logger
+
+            logger.warning_once(f"pallas flash attention failed ({type(e).__name__}: {e}); using XLA path")
 
     mask = None
     if causal and positions is not None:
@@ -85,16 +111,6 @@ def dot_product_attention(
     if attention_mask is not None:
         pad = attention_mask[:, None, None, :].astype(jnp.bool_)
         mask = pad if mask is None else jnp.logical_and(mask, pad)
-
-    if use_pallas:
-        try:
-            from .pallas.flash_attention import flash_attention as pallas_flash
-
-            return pallas_flash(query, key, value, mask=mask, scale=scale)
-        except ImportError:
-            from ..utils.log import logger
-
-            logger.warning_once("pallas flash attention unavailable; using fused XLA attention")
 
     if dropout_rate == 0.0:
         try:

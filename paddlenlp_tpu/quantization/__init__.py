@@ -1,0 +1,2 @@
+from .quantization_config import QuantizationConfig  # noqa: F401
+from .quantization_utils import QuantizedModel, dequantize_leaf, quantize_params  # noqa: F401

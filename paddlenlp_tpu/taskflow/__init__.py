@@ -1,0 +1,1 @@
+from .taskflow import TASKS, Taskflow  # noqa: F401

@@ -1,0 +1,39 @@
+"""Task base (reference: paddlenlp/taskflow/task.py :529 — model resolution,
+batching, pre/post-processing hooks)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+__all__ = ["Task"]
+
+
+class Task:
+    def __init__(self, task: str, model: str, batch_size: int = 8, **kwargs):
+        self.task = task
+        self.model_name = model
+        self.batch_size = batch_size
+        self.kwargs = kwargs
+        self._construct()
+
+    def _construct(self):
+        raise NotImplementedError
+
+    def _preprocess(self, inputs) -> List[str]:
+        if isinstance(inputs, str):
+            return [inputs]
+        return list(inputs)
+
+    def _run_model(self, inputs: List[str]):
+        raise NotImplementedError
+
+    def _postprocess(self, outputs):
+        return outputs
+
+    def __call__(self, inputs, **kwargs):
+        texts = self._preprocess(inputs)
+        outs: List[Any] = []
+        for i in range(0, len(texts), self.batch_size):
+            outs.extend(self._run_model(texts[i : i + self.batch_size]))
+        results = self._postprocess(outs)
+        return results[0] if isinstance(inputs, str) else results

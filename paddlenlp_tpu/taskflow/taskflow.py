@@ -1,0 +1,54 @@
+"""Taskflow: one-line task inference facade.
+
+Counterpart of ``paddlenlp/taskflow/taskflow.py`` (``TASKS`` registry :48,
+``Taskflow`` facade :758, ``__call__`` :818). Zero-egress build: models resolve
+from a local ``task_path`` or the framework cache, not a download service.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..utils.log import logger
+from .task import Task
+
+__all__ = ["Taskflow", "TASKS"]
+
+TASKS: Dict[str, Dict[str, Any]] = {}
+
+
+def register_task(name: str, task_class, default_model: str = ""):
+    TASKS[name] = {"task_class": task_class, "default_model": default_model}
+
+
+def _populate():
+    if TASKS:
+        return
+    from .text_classification import TextClassificationTask
+    from .text_generation import TextGenerationTask
+    from .text_similarity import TextSimilarityTask
+
+    register_task("text_generation", TextGenerationTask)
+    register_task("text2text_generation", TextGenerationTask)
+    register_task("text_classification", TextClassificationTask)
+    register_task("sentiment_analysis", TextClassificationTask)
+    register_task("text_similarity", TextSimilarityTask)
+
+
+class Taskflow:
+    def __init__(self, task: str, model: str = None, task_path: str = None, **kwargs):
+        _populate()
+        if task not in TASKS:
+            raise ValueError(f"unknown task {task!r}; available: {sorted(TASKS)}")
+        entry = TASKS[task]
+        model = model or task_path or entry["default_model"]
+        if not model:
+            raise ValueError(f"task {task!r} needs `task_path` (local model dir) in this offline build")
+        self.task_name = task
+        self.task: Task = entry["task_class"](task=task, model=model, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self.task(*args, **kwargs)
+
+    def help(self):
+        print(self.task.__doc__ or f"task {self.task_name}")

@@ -1,0 +1,44 @@
+"""Text classification / sentiment task (reference: paddlenlp/taskflow/
+text_classification.py, sentiment_analysis.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .task import Task
+
+__all__ = ["TextClassificationTask"]
+
+
+class TextClassificationTask(Task):
+    """Taskflow("sentiment_analysis", task_path=<model dir>)(text) -> {label, score}."""
+
+    def _construct(self):
+        from ..transformers import AutoConfig, AutoModelForSequenceClassification, AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+        config = AutoConfig.from_pretrained(self.model_name)
+        self.model = AutoModelForSequenceClassification.from_pretrained(
+            self.model_name, config=config, dtype=self.kwargs.get("dtype", "float32")
+        )
+        id2label = getattr(config, "id2label", None)
+        self.id2label = {int(k): v for k, v in id2label.items()} if id2label else None
+
+    def _run_model(self, texts: List[str]):
+        enc = self.tokenizer(texts, padding=True, truncation=True,
+                             max_length=self.kwargs.get("max_length", 512), return_tensors="np")
+        logits = self.model(
+            input_ids=jnp.asarray(enc["input_ids"]),
+            attention_mask=jnp.asarray(enc["attention_mask"]),
+        ).logits
+        probs = np.asarray(jax.nn.softmax(logits.astype(jnp.float32), axis=-1))
+        out = []
+        for t, p in zip(texts, probs):
+            idx = int(p.argmax())
+            label = self.id2label[idx] if self.id2label else str(idx)
+            out.append({"text": t, "label": label, "score": float(p[idx])})
+        return out

@@ -1,0 +1,42 @@
+"""Text generation task (reference: paddlenlp/taskflow/text2text_generation.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .task import Task
+
+__all__ = ["TextGenerationTask"]
+
+
+class TextGenerationTask(Task):
+    """Taskflow("text_generation", task_path=<model dir>)(prompt) -> completion."""
+
+    def _construct(self):
+        from ..transformers import AutoModelForCausalLM, AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+        self.tokenizer.padding_side = "left"
+        self.model = AutoModelForCausalLM.from_pretrained(
+            self.model_name, dtype=self.kwargs.get("dtype", "float32")
+        )
+        self.max_new_tokens = self.kwargs.get("max_new_tokens", 64)
+        self.do_sample = self.kwargs.get("do_sample", False)
+
+    def _run_model(self, texts: List[str]):
+        if self.tokenizer.chat_template and self.kwargs.get("apply_chat_template", False):
+            texts = [self.tokenizer.apply_chat_template([{"role": "user", "content": t}]) for t in texts]
+        enc = self.tokenizer(texts, padding=True, padding_side="left", return_tensors="np")
+        out, _ = self.model.generate(
+            jnp.asarray(enc["input_ids"]),
+            attention_mask=jnp.asarray(enc["attention_mask"]),
+            max_new_tokens=self.max_new_tokens,
+            do_sample=self.do_sample,
+            top_p=self.kwargs.get("top_p", 0.9),
+            temperature=self.kwargs.get("temperature", 1.0),
+        )
+        return [{"text": t, "answer": self.tokenizer.decode(np.asarray(o), skip_special_tokens=True)}
+                for t, o in zip(texts, out)]

@@ -1,0 +1,46 @@
+"""Text similarity task (reference: paddlenlp/taskflow/text_similarity.py):
+cosine similarity of mean-pooled encoder states."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .task import Task
+
+__all__ = ["TextSimilarityTask"]
+
+
+class TextSimilarityTask(Task):
+    def _construct(self):
+        from ..transformers import AutoModel, AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+        self.model = AutoModel.from_pretrained(self.model_name, dtype=self.kwargs.get("dtype", "float32"))
+
+    def _preprocess(self, inputs):
+        if isinstance(inputs, str):
+            raise ValueError("text_similarity takes a (text1, text2) pair or a list of pairs, not a string")
+        if isinstance(inputs, (list, tuple)) and inputs and isinstance(inputs[0], (list, tuple)):
+            return [tuple(p) for p in inputs]
+        return [tuple(inputs)]
+
+    def _embed(self, texts: List[str]) -> np.ndarray:
+        enc = self.tokenizer(list(texts), padding=True, truncation=True, max_length=256, return_tensors="np")
+        out = self.model(input_ids=jnp.asarray(enc["input_ids"]),
+                         attention_mask=jnp.asarray(enc["attention_mask"]))
+        h = np.asarray(out.last_hidden_state, dtype=np.float32)
+        mask = np.asarray(enc["attention_mask"])[..., None]
+        return (h * mask).sum(1) / np.maximum(mask.sum(1), 1)
+
+    def _run_model(self, pairs: List[Tuple[str, str]]):
+        a = self._embed([p[0] for p in pairs])
+        b = self._embed([p[1] for p in pairs])
+        sim = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1) + 1e-9)
+        return [{"text1": p[0], "text2": p[1], "similarity": float(s)} for p, s in zip(pairs, sim)]
+
+    def __call__(self, inputs, **kwargs):
+        pairs = self._preprocess(inputs)
+        return self._run_model(pairs)

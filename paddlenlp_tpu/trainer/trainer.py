@@ -45,6 +45,7 @@ from .trainer_callback import (
     TrainerControl,
     TrainerState,
 )
+from .timer import Timers
 from .trainer_utils import (
     PREFIX_CHECKPOINT_DIR,
     IntervalStrategy,
@@ -111,6 +112,7 @@ class Trainer:
         self._labels_preshifted = self.mesh.shape.get("cp", 1) > 1 and criterion is None
         callbacks = DEFAULT_CALLBACKS + (callbacks or [])
         self.callback_handler = CallbackHandler(callbacks, self.model, self.tokenizer)
+        self.timers = Timers()  # reference trainer/plugins/timer.py phase buckets
         set_seed(args.seed)
         self.control = self.callback_handler.on_init_end(self.args, self.state, self.control)
 
@@ -469,13 +471,25 @@ class Trainer:
                 if self.state.global_step > 0 and not args.ignore_data_skip:
                     steps_to_skip = self.state.global_step % steps_per_epoch
                 train_dataloader.set_epoch(epoch)
+                self.timers("read-data").start()
                 for step_in_epoch, host_batch in enumerate(train_dataloader):
                     if steps_to_skip > 0:
                         steps_to_skip -= 1
                         continue
                     self.control = self.callback_handler.on_step_begin(args, self.state, self.control)
                     batch = self._device_put_batch(host_batch, accum)
+                    self.timers("read-data").stop()
+                    self.timers("forward-backward-optimizer").start()
                     self.train_state, metrics = self._train_step_fn(self.train_state, batch, dropout_rng)
+                    # block only when THIS step will log (should_log is set later, in
+                    # on_step_end) so the phase breakdown reflects device time
+                    will_log = (
+                        args.logging_strategy == IntervalStrategy.STEPS
+                        and (self.state.global_step + 1) % args.logging_steps == 0
+                    )
+                    self.timers("forward-backward-optimizer").stop(
+                        block_on=metrics["loss"] if will_log else None
+                    )
                     last_metrics = metrics
                     self._interval_losses.append(metrics["loss"])
                     self.state.global_step += 1
@@ -487,6 +501,10 @@ class Trainer:
                     self._maybe_log_save_evaluate(last_metrics, train_start, tokens_seen)
                     if self.control.should_training_stop or self.state.global_step >= max_steps:
                         break
+                    self.timers("read-data").start()
+                t_rd = self.timers("read-data")
+                if t_rd._started is not None:
+                    t_rd.stop()
                 epoch += 1
                 self.control = self.callback_handler.on_epoch_end(args, self.state, self.control)
                 self._maybe_log_save_evaluate(last_metrics, train_start, tokens_seen)
@@ -541,6 +559,7 @@ class Trainer:
                 )
             )
             self.state.log_history.append(logs)
+            self.timers.log(["read-data", "forward-backward-optimizer"], normalizer=max(len(interval), 1))
             self.control = self.callback_handler.on_log(args, self.state, self.control, logs=logs)
         if self.control.should_evaluate:
             metrics_out = self.evaluate()

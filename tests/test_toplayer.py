@@ -1,0 +1,169 @@
+"""Top layer: metrics, quantization, taskflow, CLI."""
+
+import json
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.metrics import BLEU, AccuracyAndF1, Distinct, Perplexity, Rouge1, RougeL
+
+
+class TestMetrics:
+    def test_bleu_perfect_and_zero(self):
+        b = BLEU(2)
+        b.add_inst(list("abcd"), [list("abcd")])
+        assert b.score() == pytest.approx(1.0)
+        b2 = BLEU(2)
+        b2.add_inst(list("abcd"), [list("wxyz")])
+        assert b2.score() < 1e-4
+
+    def test_rouge(self):
+        r1 = Rouge1()
+        r1.add_inst(["the", "cat", "sat"], [["the", "cat", "ran"]])
+        assert r1.score() == pytest.approx(2 / 3)
+        rl = RougeL()
+        rl.add_inst(["a", "b", "c", "d"], [["a", "b", "x", "d"]])
+        assert 0 < rl.score() <= 1
+
+    def test_perplexity_uniform(self):
+        V = 8
+        p = Perplexity()
+        logits = np.zeros((1, 5, V))
+        labels = np.array([[1, 2, 3, -100, 4]])
+        p.update(logits, labels)
+        assert p.accumulate() == pytest.approx(V, rel=1e-4)
+
+    def test_accuracy_f1(self):
+        m = AccuracyAndF1()
+        m.update([1, 0, 1, 1], [1, 0, 0, 1])
+        out = m.accumulate()
+        assert out["accuracy"] == pytest.approx(0.75)
+        assert out["f1"] == pytest.approx(2 * (2 / 3) * 1.0 / ((2 / 3) + 1.0))
+
+    def test_distinct(self):
+        d = Distinct(2)
+        d.add_inst(["a", "b", "a", "b"])
+        assert d.score() == pytest.approx(2 / 3)
+
+
+class TestQuantization:
+    def _model(self):
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64)
+        return LlamaForCausalLM.from_config(cfg, seed=0)
+
+    def test_wint8_roundtrip_close(self):
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+
+        model = self._model()
+        ids = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        ref = model(input_ids=ids).logits
+        qm = QuantizedModel(model, QuantizationConfig(weight_quantize_algo="wint8"))
+        out = qm(input_ids=ids).logits
+        # int8 weight-only: logits close, not exact
+        corr = np.corrcoef(np.asarray(ref).ravel(), np.asarray(out).ravel())[0, 1]
+        assert corr > 0.999, corr
+        assert np.asarray(ref).argmax(-1).tolist() == np.asarray(out).argmax(-1).tolist()
+
+    def test_wint4_and_footprint(self):
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+
+        model = self._model()
+        base_bytes = sum(np.asarray(x).nbytes for x in __import__("jax").tree.leaves(model.params))
+        qm = QuantizedModel(model, QuantizationConfig(weight_quantize_algo="wint4"))
+        assert qm.memory_footprint() < base_bytes * 0.6
+        out = qm(input_ids=jnp.asarray([[5, 6, 7]], jnp.int32)).logits
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_unknown_algo_raises(self):
+        from paddlenlp_tpu.quantization import QuantizationConfig
+
+        with pytest.raises(ValueError, match="unsupported"):
+            QuantizationConfig(weight_quantize_algo="a8w8c8")
+
+    def test_quantized_generate(self):
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+
+        qm = QuantizedModel(self._model(), QuantizationConfig(weight_quantize_algo="wint8"))
+        out, _ = qm.generate(jnp.asarray([[5, 6, 7]], jnp.int32), max_new_tokens=4, do_sample=False)
+        assert out.shape == (1, 4)
+
+
+@pytest.fixture(scope="module")
+def hub_dir(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from paddlenlp_tpu.transformers import (
+        BertConfig, BertForSequenceClassification, LlamaConfig, LlamaForCausalLM, PretrainedTokenizer,
+    )
+
+    root = tmp_path_factory.mktemp("taskflow-hub")
+    vocab = {"<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3}
+    for i, w in enumerate("good bad great awful fine movie film nice happy sad".split()):
+        vocab[w] = i + 4
+    t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    tok = PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", eos_token="</s>", unk_token="<unk>")
+
+    gen_dir = root / "gen"
+    LlamaForCausalLM.from_config(
+        LlamaConfig(vocab_size=32, hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+                    num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64,
+                    eos_token_id=2, pad_token_id=0), seed=0
+    ).save_pretrained(str(gen_dir))
+    tok.save_pretrained(str(gen_dir))
+
+    cls_dir = root / "cls"
+    cfg = BertConfig(vocab_size=32, hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+                     intermediate_size=64, max_position_embeddings=64, num_labels=2,
+                     id2label={"0": "negative", "1": "positive"})
+    BertForSequenceClassification.from_config(cfg, seed=0).save_pretrained(str(cls_dir))
+    tok.save_pretrained(str(cls_dir))
+    return {"gen": str(gen_dir), "cls": str(cls_dir)}
+
+
+class TestTaskflow:
+    def test_text_generation(self, hub_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("text_generation", task_path=hub_dir["gen"], max_new_tokens=4, dtype="float32")
+        out = flow("good movie")
+        assert "answer" in out and isinstance(out["answer"], str)
+
+    def test_sentiment(self, hub_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("sentiment_analysis", task_path=hub_dir["cls"], dtype="float32")
+        out = flow(["good great nice", "bad awful sad"])
+        assert len(out) == 2
+        assert out[0]["label"] in ("negative", "positive")
+        assert 0 <= out[0]["score"] <= 1
+
+    def test_unknown_task(self):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        with pytest.raises(ValueError, match="unknown task"):
+            Taskflow("time_travel")
+
+
+class TestCLI:
+    def test_version(self, capsys):
+        from paddlenlp_tpu.cli import main
+
+        main(["version"])
+        out = json.loads(capsys.readouterr().out)
+        assert "paddlenlp_tpu" in out and "jax" in out
+
+    def test_predict(self, hub_dir, capsys):
+        from paddlenlp_tpu.cli import main
+
+        main(["predict", "--model", hub_dir["gen"], "--prompt", "good", "--max_length", "3",
+              "--dtype", "float32"])
+        out = json.loads(capsys.readouterr().out)
+        assert "answer" in out
