@@ -32,7 +32,8 @@ __all__ = ["flash_attention"]
 NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, scale, block_q, block_kv, causal):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, scale, block_q, block_kv,
+               causal, kv_len):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -56,16 +57,21 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
         k = k_ref[0].astype(jnp.float32)  # [block_kv, H]
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [block_q, block_kv]
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = cols < kv_len  # mask block padding when S % block_kv != 0
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+            valid = valid & (cols <= rows)
+        s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scratch[...]  # [block_q, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scratch[...] + jnp.sum(p, axis=-1, keepdims=True)
+        # zero padded V rows: p is 0 there, but 0 * garbage (block padding) = NaN
+        v_row_valid = (k_start + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)) < kv_len
+        v = jnp.where(v_row_valid, v, 0.0)
         acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot(p, v)
         m_scratch[...] = m_new
         l_scratch[...] = l_new
@@ -88,7 +94,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
     grid = (B * N, pl.cdiv(T, block_q), pl.cdiv(S, block_kv))
 
     kernel = functools.partial(
-        _fa_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal
+        _fa_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal, kv_len=S
     )
     out = pl.pallas_call(
         kernel,
@@ -132,6 +138,11 @@ def flash_attention(
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"causal flash_attention requires T == S (got T={q.shape[1]}, S={k.shape[1]}); "
+            "cross-length causal (KV cache) goes through the XLA dispatcher path"
+        )
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
     return _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret)

@@ -9,3 +9,4 @@ from .partition import (  # noqa: F401
     sharding_tree,
     spec_tree_from_rules,
 )
+from .launch import init_distributed, local_batch_to_global  # noqa: F401

