@@ -136,6 +136,14 @@ class InferenceEngine:
         while self.waiting and len(self.running) < self.max_batch_size:
             req = self.waiting[0]
             prompt_len = len(req.prompt_ids)
+            # a request that can NEVER fit must fail fast, not spin has_work() forever
+            need = self.mgr.blocks_needed(prompt_len + req.sampling.max_new_tokens)
+            if need > self.mgr.max_blocks_per_seq or need > self.mgr.total_usable_blocks:
+                self.waiting.popleft()
+                req.done = True
+                logger.warning(f"req {req.req_id}: needs {need} KV blocks (> capacity); rejected")
+                finished.append(req)
+                continue
             # reserve prompt + 1 so the first decode never immediately preempts
             if not self.mgr.can_allocate(prompt_len + 1):
                 break

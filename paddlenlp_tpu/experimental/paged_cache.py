@@ -92,6 +92,7 @@ class BlockManager:
     def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.total_usable_blocks = num_blocks - 1
         self.free: List[int] = list(range(1, num_blocks))  # block 0 = sentinel
         self.tables: Dict[int, List[int]] = {}
         self.lengths: Dict[int, int] = {}

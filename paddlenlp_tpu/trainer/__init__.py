@@ -21,3 +21,4 @@ from .trainer_utils import (  # noqa: F401
 from .training_args import TrainingArguments  # noqa: F401
 from .timer import RuntimeTimer, Timers  # noqa: F401
 from .trainer_seq2seq import Seq2SeqTrainer  # noqa: F401
+from .integrations import JsonlLoggerCallback, TensorBoardCallback  # noqa: F401

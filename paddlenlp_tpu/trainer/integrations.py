@@ -1,0 +1,99 @@
+"""Logging integrations (reference: paddlenlp/trainer/integrations.py —
+``VisualDLCallback`` :78, ``TensorBoardCallback`` :162, ``WandbCallback``;
+selected via ``report_to``). Zero-dependency core: a JSONL metrics writer that
+any dashboard can tail; TensorBoard/W&B writers attach when their packages exist.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from ..utils.import_utils import is_package_available
+from ..utils.log import logger
+from .trainer_callback import TrainerCallback
+
+__all__ = ["JsonlLoggerCallback", "TensorBoardCallback", "get_reporting_callbacks"]
+
+
+class JsonlLoggerCallback(TrainerCallback):
+    """Appends one JSON object per log event to <output_dir>/metrics.jsonl."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._fh = None
+
+    def _ensure(self, args):
+        if self._fh is None:
+            path = self._path or os.path.join(args.output_dir, "metrics.jsonl")
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a")
+        return self._fh
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if logs is None or not state.is_world_process_zero:
+            return
+        fh = self._ensure(args)
+        fh.write(json.dumps({"ts": time.time(), "step": state.global_step, **logs}, default=str) + "\n")
+        fh.flush()
+
+    def on_train_end(self, args, state, control, **kwargs):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardCallback(TrainerCallback):
+    """Scalar writer over tensorboardX/torch.utils.tensorboard when available."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._log_dir = log_dir
+        self._writer = None
+
+    def _ensure(self, args):
+        if self._writer is None:
+            writer_cls = None
+            if is_package_available("tensorboardX"):
+                from tensorboardX import SummaryWriter as writer_cls  # noqa: N813
+            elif is_package_available("torch.utils.tensorboard"):
+                from torch.utils.tensorboard import SummaryWriter as writer_cls  # noqa: N813
+            if writer_cls is None:
+                logger.warning_once("tensorboard writer unavailable; install tensorboardX")
+                return None
+            self._writer = writer_cls(self._log_dir or os.path.join(args.output_dir, "runs"))
+        return self._writer
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if logs is None or not state.is_world_process_zero:
+            return
+        writer = self._ensure(args)
+        if writer is None:
+            return
+        for k, v in logs.items():
+            if isinstance(v, (int, float)):
+                writer.add_scalar(k, v, state.global_step)
+        writer.flush()
+
+    def on_train_end(self, args, state, control, **kwargs):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+def get_reporting_callbacks(report_to) -> list:
+    """Map TrainingArguments.report_to names to callback instances."""
+    if not report_to:
+        return []
+    if isinstance(report_to, str):
+        report_to = [report_to]
+    out = []
+    for name in report_to:
+        if name in ("jsonl", "json", "all"):
+            out.append(JsonlLoggerCallback())
+        if name in ("tensorboard", "visualdl", "all"):
+            out.append(TensorBoardCallback())
+        if name == "none":
+            continue
+    return out

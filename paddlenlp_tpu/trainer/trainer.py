@@ -110,7 +110,9 @@ class Trainer:
         # reorder (a post-permutation causal shift would be wrong); both the train
         # and eval steps then compute the loss with shift=False.
         self._labels_preshifted = self.mesh.shape.get("cp", 1) > 1 and criterion is None
-        callbacks = DEFAULT_CALLBACKS + (callbacks or [])
+        from .integrations import get_reporting_callbacks
+
+        callbacks = DEFAULT_CALLBACKS + get_reporting_callbacks(args.report_to) + (callbacks or [])
         self.callback_handler = CallbackHandler(callbacks, self.model, self.tokenizer)
         self.timers = Timers()  # reference trainer/plugins/timer.py phase buckets
         set_seed(args.seed)

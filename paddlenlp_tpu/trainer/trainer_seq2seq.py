@@ -17,6 +17,18 @@ from .trainer_utils import PredictionOutput, speed_metrics
 __all__ = ["Seq2SeqTrainer"]
 
 
+def _left_repack(ids: np.ndarray, mask: np.ndarray):
+    """Move each row's valid tokens to the right edge (left padding)."""
+    out_ids = np.zeros_like(ids)
+    out_mask = np.zeros_like(mask)
+    for i in range(len(ids)):
+        valid = ids[i][mask[i] == 1]
+        if len(valid):
+            out_ids[i, -len(valid):] = valid
+            out_mask[i, -len(valid):] = 1
+    return out_ids, out_mask
+
+
 class Seq2SeqTrainer(Trainer):
     def __init__(self, *args, gen_kwargs: Optional[dict] = None, predict_with_generate: bool = True, **kwargs):
         super().__init__(*args, **kwargs)
@@ -33,9 +45,12 @@ class Seq2SeqTrainer(Trainer):
         preds: List[np.ndarray] = []
         labels: List[np.ndarray] = []
         for host_batch in dataloader:
-            ids = jnp.asarray(host_batch["input_ids"])
-            mask = jnp.asarray(host_batch.get("attention_mask", np.ones_like(host_batch["input_ids"])))
-            out, _ = self.model.generate(ids, attention_mask=mask, params=params, **self.gen_kwargs)
+            ids = np.asarray(host_batch["input_ids"])
+            mask = np.asarray(host_batch.get("attention_mask", np.ones_like(ids)))
+            # batched decode needs LEFT padding; eval collators right-pad, so repack
+            ids, mask = _left_repack(ids, mask)
+            out, _ = self.model.generate(jnp.asarray(ids), attention_mask=jnp.asarray(mask),
+                                         params=params, **self.gen_kwargs)
             preds.extend(np.asarray(out))
             if "labels" in host_batch:
                 labels.extend(np.asarray(host_batch["labels"]))

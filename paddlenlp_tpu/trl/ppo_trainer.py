@@ -11,8 +11,8 @@ TPU-native:
 - the update is the clipped-surrogate PPO objective over token log-probs with a
   KL penalty against the frozen reference;
 - the baseline is group-relative advantage normalization (GRPO-style, the
-  value-model-free formulation) by default; passing ``value_model`` switches to a
-  learned per-sequence value baseline trained jointly with an MSE loss.
+  value-model-free formulation); a jointly-trained value baseline is the round-2
+  extension.
 """
 
 from __future__ import annotations
@@ -36,12 +36,12 @@ __all__ = ["PPOTrainer", "PPOConfig"]
 class PPOConfig:
     num_rollouts_per_prompt: int = 4  # the "group" for the group-relative baseline
     max_new_tokens: int = 32
+    max_prompt_length: int = 512  # prompts are truncated to this; sizes the KV pool
     temperature: float = 1.0
     top_p: float = 1.0
     clip_ratio: float = 0.2
     kl_coef: float = 0.05
     ppo_epochs: int = 1
-    vf_coef: float = 0.5
     normalize_advantages: bool = True
 
 
@@ -55,7 +55,6 @@ class PPOTrainer(Trainer):
         ref_model=None,
         reward_model=None,
         reward_fn: Optional[Callable[[np.ndarray, np.ndarray], float]] = None,
-        value_model=None,
         ppo_config: Optional[PPOConfig] = None,
         **kwargs,
     ):
@@ -65,7 +64,6 @@ class PPOTrainer(Trainer):
             raise ValueError("PPOTrainer needs reward_model or reward_fn")
         self.reward_model = reward_model
         self.reward_fn = reward_fn
-        self.value_model = value_model
         self.ref_params = (ref_model.params if ref_model is not None
                            else jax.tree.map(jnp.copy, model.params))
         self._engine_kwargs = dict(
@@ -78,7 +76,7 @@ class PPOTrainer(Trainer):
 
     def _engine_blocks_needed(self):
         c = self.ppo_config
-        per_seq = (c.max_new_tokens + 512) // 16 + 2
+        per_seq = (c.max_new_tokens + c.max_prompt_length) // 16 + 2
         return per_seq * self.args.per_device_train_batch_size * c.num_rollouts_per_prompt
 
     # ------------------------------------------------------------------ rollout
@@ -95,6 +93,7 @@ class PPOTrainer(Trainer):
             engine = self._engine
             reqs = []
             for p in prompts:
+                p = p[-c.max_prompt_length :]  # cap: sizes were derived from this
                 for g in range(c.num_rollouts_per_prompt):
                     reqs.append((p, SamplingParams(max_new_tokens=c.max_new_tokens, do_sample=True,
                                                    temperature=c.temperature, top_p=c.top_p,
@@ -110,23 +109,26 @@ class PPOTrainer(Trainer):
             raise ValueError("PPO rollout requires use_scan_layers models (paged engine)")
 
         rows, labels = [], []
-        group_prompt = []
         for (p, _), o in zip(reqs, outs):
             rows.append(np.concatenate([p, np.asarray(o, np.int32)]))
             labels.append(np.concatenate([np.full(len(p), -100, np.int32), np.asarray(o, np.int32)]))
-            group_prompt.append(len(p))
         max_len = max(len(r) for r in rows)
         ids_arr = np.zeros((len(rows), max_len), np.int32)
         lab_arr = np.full((len(rows), max_len), -100, np.int32)
+        mask_arr = np.zeros((len(rows), max_len), np.int32)
         for i, (r, l) in enumerate(zip(rows, labels)):
             ids_arr[i, : len(r)] = r
             lab_arr[i, : len(l)] = l
-        return {"input_ids": ids_arr, "labels": lab_arr}
+            mask_arr[i, : len(r)] = 1
+        return {"input_ids": ids_arr, "labels": lab_arr, "attention_mask": mask_arr}
 
-    def _score(self, ids: np.ndarray, labels: np.ndarray) -> np.ndarray:
+    def _score(self, ids: np.ndarray, labels: np.ndarray, attention_mask: np.ndarray) -> np.ndarray:
         if self.reward_fn is not None:
             return np.asarray([self.reward_fn(ids[i], labels[i]) for i in range(len(ids))], np.float32)
-        logits = self.reward_model(input_ids=jnp.asarray(ids)).logits
+        # attention_mask is required: the seq-cls reward head pools at the LAST
+        # VALID token, not a right-pad position
+        logits = self.reward_model(input_ids=jnp.asarray(ids),
+                                   attention_mask=jnp.asarray(attention_mask)).logits
         return np.asarray(logits[..., 0], np.float32).reshape(-1)
 
     # ------------------------------------------------------------------ update
@@ -135,6 +137,7 @@ class PPOTrainer(Trainer):
 
         def loss_fn(params):
             out = self.model.module.apply({"params": params}, input_ids=batch["input_ids"][:, :-1],
+                                          attention_mask=batch["attention_mask"][:, :-1],
                                           deterministic=True)
             logits = out.logits if hasattr(out, "logits") else out[0]
             labels = batch["labels"][:, 1:]
@@ -174,25 +177,22 @@ class PPOTrainer(Trainer):
             prompts = [next(prompts_iter) for _ in range(args.per_device_train_batch_size)]
             self.model.params = self.train_state.params  # engine rolls out with CURRENT policy
             batch = self.rollout(prompts)
-            rewards = self._score(batch["input_ids"], batch["labels"])
+            rewards = self._score(batch["input_ids"], batch["labels"], batch["attention_mask"])
 
             G = c.num_rollouts_per_prompt
             grouped = rewards.reshape(-1, G)
-            if self.value_model is not None:
-                values = np.asarray(self.value_model(input_ids=jnp.asarray(batch["input_ids"])).logits[..., 0],
-                                    np.float32).reshape(-1)
-                adv = rewards - values
-            else:  # group-relative (GRPO) baseline
-                adv = (grouped - grouped.mean(-1, keepdims=True)).reshape(-1)
+            # group-relative (GRPO) baseline
+            adv = (grouped - grouped.mean(-1, keepdims=True)).reshape(-1)
             if c.normalize_advantages and adv.std() > 1e-6:
                 adv = adv / (adv.std() + 1e-6)
 
             # old/ref logps computed ONCE per rollout round (invariant across epochs)
             labels_dev = jnp.asarray(batch["labels"][:, 1:])
             ids_dev = jnp.asarray(batch["input_ids"][:, :-1])
-            out = self.model.apply(self.train_state.params, input_ids=ids_dev)
+            mask_dev = jnp.asarray(batch["attention_mask"][:, :-1])
+            out = self.model.apply(self.train_state.params, input_ids=ids_dev, attention_mask=mask_dev)
             old_logps = jax.lax.stop_gradient(sequence_logps(out.logits, labels_dev))
-            ref_out = self.model.apply(self.ref_params, input_ids=ids_dev)
+            ref_out = self.model.apply(self.ref_params, input_ids=ids_dev, attention_mask=mask_dev)
             ref_logps = jax.lax.stop_gradient(sequence_logps(ref_out.logits, labels_dev))
             dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
             for _ in range(c.ppo_epochs):

@@ -18,7 +18,10 @@ __all__ = [
 
 @lru_cache(maxsize=None)
 def is_package_available(name: str) -> bool:
-    return importlib.util.find_spec(name) is not None
+    try:
+        return importlib.util.find_spec(name) is not None
+    except ModuleNotFoundError:  # dotted name whose parent isn't installed
+        return False
 
 
 def is_tokenizers_available() -> bool:

@@ -327,3 +327,15 @@ class TestContextParallel:
             t.train()
             results[name] = per_step
         np.testing.assert_allclose(results["ref"], results["cp"], atol=2e-4)
+
+
+class TestIntegrations:
+    def test_jsonl_report_to(self, tmp_path):
+        args = make_args(tmp_path, max_steps=4, logging_steps=2)
+        args.report_to = ["jsonl"]
+        t = Trainer(model=tiny_model(), args=args, train_dataset=ToyLMDataset())
+        t.train()
+        path = os.path.join(str(tmp_path), "metrics.jsonl")
+        assert os.path.isfile(path)
+        rows = [json.loads(l) for l in open(path)]
+        assert len(rows) == 2 and all("loss" in r and "step" in r for r in rows)
