@@ -45,7 +45,12 @@ class LogitsProcessorList(list):
 
 
 def _valid_counts(ids_buf: jnp.ndarray, cur_len, vocab_size: int) -> jnp.ndarray:
-    """[B, vocab] counts of each token in the valid prefix (one-hot scatter-sum)."""
+    """[B, vocab] counts of each token in the valid prefix (one-hot scatter-sum).
+
+    Callers exclude positions (e.g. left-pad prompt slots) by setting them to an
+    out-of-range sentinel id (>= vocab_size): ``one_hot`` maps those to all-zero
+    rows, so they never contribute to the counts.
+    """
     B, L = ids_buf.shape
     valid = (jnp.arange(L)[None, :] < cur_len).astype(jnp.int32)
     onehot = jax.nn.one_hot(ids_buf, vocab_size, dtype=jnp.int32)
@@ -53,14 +58,17 @@ def _valid_counts(ids_buf: jnp.ndarray, cur_len, vocab_size: int) -> jnp.ndarray
 
 
 class MinLengthLogitsProcessor(LogitsProcessor):
-    def __init__(self, min_length: int, eos_token_id: int, prompt_len: int = 0):
+    def __init__(self, min_length: int, eos_token_id, prompt_len: int = 0):
         self.min_length = min_length
-        self.eos_token_id = eos_token_id
+        ids = eos_token_id if isinstance(eos_token_id, (list, tuple)) else [eos_token_id]
+        self.eos_token_ids = tuple(int(i) for i in ids)
         self.prompt_len = prompt_len
 
     def __call__(self, ids_buf, logits, cur_len):
         block = (cur_len - self.prompt_len) < self.min_length
-        eos_mask = jnp.zeros_like(logits).at[:, self.eos_token_id].set(NEG_INF)
+        eos_mask = jnp.zeros_like(logits)
+        for eos in self.eos_token_ids:
+            eos_mask = eos_mask.at[:, eos].set(NEG_INF)
         return jnp.where(block, logits + eos_mask, logits)
 
 

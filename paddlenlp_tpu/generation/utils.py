@@ -47,7 +47,7 @@ class GenerationMixin:
         if g.min_new_tokens or g.min_length:
             min_new = g.min_new_tokens if g.min_new_tokens else g.min_length
             if g.eos_token_id is not None:
-                procs.append(MinLengthLogitsProcessor(min_new, _first(g.eos_token_id), prompt_len))
+                procs.append(MinLengthLogitsProcessor(min_new, g.eos_token_id, prompt_len))
         if g.repetition_penalty and g.repetition_penalty != 1.0:
             procs.append(RepetitionPenaltyLogitsProcessor(g.repetition_penalty))
         if g.presence_penalty:
@@ -186,9 +186,12 @@ class GenerationMixin:
             finished = jnp.zeros((B,), jnp.bool_)
 
             def sample_token(logits, ids_buf, cur_len, key, finished):
-                logits = procs(ids_buf, logits, cur_len)
+                # Left-pad prompt slots must not feed repetition/ngram processors:
+                # replace them with an out-of-range sentinel (one_hot drops it).
+                proc_ids = jnp.where(pad_mask > 0, ids_buf, logits.shape[-1])
+                logits = procs(proc_ids, logits, cur_len)
                 if do_sample:
-                    logits = warpers(ids_buf, logits, cur_len)
+                    logits = warpers(proc_ids, logits, cur_len)
                     key, sub = jax.random.split(key)
                     nxt = jax.random.categorical(sub, logits, axis=-1)
                 else:
@@ -234,9 +237,3 @@ class GenerationMixin:
         fn = jax.jit(decode)
         cache[cache_key] = fn
         return fn
-
-
-def _first(x):
-    if isinstance(x, (list, tuple)):
-        return x[0]
-    return x

@@ -72,8 +72,10 @@ class DPOCriterion:
             elif self.loss_type == "ipo":
                 loss = (margin / beta - 1.0 / (2.0 * beta)) ** 2
             else:  # kto_pair
-                chosen_kl = jnp.clip(jnp.mean(reference_chosen_logps - policy_chosen_logps), 0.0)
-                rejected_kl = jnp.clip(jnp.mean(reference_rejected_logps - policy_rejected_logps), 0.0)
+                # KL baselines are E[policy - reference] clipped at 0 (the KTO
+                # paper's estimate of the policy's drift from the reference).
+                chosen_kl = jnp.clip(jnp.mean(policy_chosen_logps - reference_chosen_logps), 0.0)
+                rejected_kl = jnp.clip(jnp.mean(policy_rejected_logps - reference_rejected_logps), 0.0)
                 loss = jnp.concatenate(
                     [
                         1.0 - jax.nn.sigmoid(beta * ((policy_chosen_logps - reference_chosen_logps) - rejected_kl)),
@@ -93,8 +95,8 @@ class DPOCriterion:
             assert chosen_lengths is not None
             pc = policy_chosen_logps / jnp.maximum(chosen_lengths, 1)
             pr = policy_rejected_logps / jnp.maximum(rejected_lengths, 1)
-            log_odds = (pc - pr) - (jnp.log1p(-jnp.clip(jnp.exp(pc), a_max=1 - 1e-6))
-                                    - jnp.log1p(-jnp.clip(jnp.exp(pr), a_max=1 - 1e-6)))
+            log_odds = (pc - pr) - (jnp.log1p(-jnp.clip(jnp.exp(pc), max=1 - 1e-6))
+                                    - jnp.log1p(-jnp.clip(jnp.exp(pr), max=1 - 1e-6)))
             loss = -jax.nn.log_sigmoid(beta * log_odds)
             chosen_rewards, rejected_rewards = pc, pr
         else:

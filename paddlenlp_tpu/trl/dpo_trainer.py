@@ -20,6 +20,12 @@ from ..trainer.trainer import Trainer
 from ..utils.log import logger
 from .dpo_criterion import DPOCriterion, sequence_logps
 
+def _copy_aliased(params, policy_params):
+    """jnp.copy only the leaves of ``params`` that alias ``policy_params`` buffers."""
+    policy_ids = {id(x) for x in jax.tree.leaves(policy_params)}
+    return jax.tree.map(lambda x: jnp.copy(x) if id(x) in policy_ids else x, params)
+
+
 __all__ = ["DPOTrainer"]
 
 
@@ -30,13 +36,12 @@ class DPOTrainer(Trainer):
         super().__init__(model=model, **kwargs)
         self.ref_params = None
         if self.dpo_criterion.needs_reference:
-            if ref_model is not None:
-                self.ref_params = ref_model.params
-            else:
-                # frozen DEEP copy of the starting policy (standard DPO init).
-                # A real buffer copy is required: the jitted train step donates the
-                # policy params, which would delete aliased reference buffers.
-                self.ref_params = jax.tree.map(jnp.copy, model.params)
+            src = ref_model.params if ref_model is not None else model.params
+            # Copy exactly the buffers that alias the policy params: the jitted
+            # train step donates those, which would delete a shared reference.
+            # A distinct ref_model keeps its original buffers (no HBM doubling).
+            self.ref_params = _copy_aliased(src, model.params)
+            if ref_model is None:
                 logger.info("DPO: using a frozen copy of the policy as the reference model")
 
     def compute_loss(self, params, inputs: Dict[str, Any], dropout_rng=None):

@@ -64,8 +64,13 @@ class PPOTrainer(Trainer):
             raise ValueError("PPOTrainer needs reward_model or reward_fn")
         self.reward_model = reward_model
         self.reward_fn = reward_fn
-        self.ref_params = (ref_model.params if ref_model is not None
-                           else jax.tree.map(jnp.copy, model.params))
+        # Copy exactly the buffers that alias the policy (donation-safety
+        # without doubling a distinct reference model's HBM footprint).
+        from .dpo_trainer import _copy_aliased
+
+        self.ref_params = _copy_aliased(
+            ref_model.params if ref_model is not None else model.params, model.params
+        )
         self._engine_kwargs = dict(
             max_batch_size=self.args.per_device_train_batch_size * self.ppo_config.num_rollouts_per_prompt,
             block_size=16,

@@ -116,6 +116,34 @@ class TestWarpers:
             bigrams.add(bg)
 
 
+class TestProcessorFixes:
+    def test_min_length_blocks_all_eos_ids(self):
+        from paddlenlp_tpu.generation import MinLengthLogitsProcessor
+
+        proc = MinLengthLogitsProcessor(4, [2, 5], prompt_len=0)
+        logits = jnp.zeros((1, 8))
+        out = proc(jnp.zeros((1, 8), jnp.int32), logits, jnp.asarray(1))
+        assert out[0, 2] < -1e8 and out[0, 5] < -1e8
+        assert out[0, 3] == 0.0
+
+    def test_valid_counts_sentinel_excluded(self):
+        from paddlenlp_tpu.generation.logits_process import _valid_counts
+
+        ids = jnp.asarray([[8, 1, 1, 3]], jnp.int32)  # 8 == vocab_size sentinel
+        counts = _valid_counts(ids, jnp.asarray(4), 8)
+        assert int(counts[0, 1]) == 2 and int(counts.sum()) == 3
+
+    def test_left_pad_parity_with_penalties(self, model):
+        """Pad slots must not feed the penalty counts: a left-padded row decodes
+        identically to the same row unpadded even with frequency penalty on."""
+        kw = dict(max_new_tokens=4, do_sample=False, frequency_penalty=0.5, repetition_penalty=1.3)
+        single, _ = model.generate(jnp.array([[5, 6, 7]], jnp.int32), **kw)
+        batch_ids = jnp.array([[0, 0, 5, 6, 7], [11, 12, 13, 14, 15]], jnp.int32)
+        mask = jnp.array([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]], jnp.int32)
+        batched, _ = model.generate(batch_ids, attention_mask=mask, **kw)
+        np.testing.assert_array_equal(np.asarray(batched[0]), np.asarray(single[0]))
+
+
 class TestGenerationConfig:
     def test_save_load(self, tmp_path):
         g = GenerationConfig(max_new_tokens=32, do_sample=True, top_p=0.9, eos_token_id=2)

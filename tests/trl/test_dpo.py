@@ -41,6 +41,17 @@ class TestCriterion:
         assert np.isfinite(float(loss))
         assert 0.0 <= float(metrics["rewards_accuracy"]) <= 1.0
 
+    def test_kto_pair_kl_direction(self):
+        """KL baselines are clip(mean(policy - reference), 0): with the policy
+        drifted up on chosen only, chosen_kl > 0 must pull the rejected term's
+        sigmoid argument positive, so mean loss dips below the 0.5 fixed point
+        (the old sign-flipped form left it exactly at 0.5)."""
+        crit = DPOCriterion(beta=1.0, loss_type="kto_pair")
+        at_ref, _ = crit(jnp.asarray([-5.0]), jnp.asarray([-5.0]), jnp.asarray([-5.0]), jnp.asarray([-5.0]))
+        np.testing.assert_allclose(float(at_ref), 0.5, rtol=1e-6)
+        drifted, _ = crit(jnp.asarray([-3.0]), jnp.asarray([-5.0]), jnp.asarray([-5.0]), jnp.asarray([-5.0]))
+        assert float(drifted) < 0.5 - 1e-3, float(drifted)
+
     @pytest.mark.parametrize("loss_type", ["simpo", "orpo"])
     def test_ref_free_losses(self, loss_type):
         crit = DPOCriterion(loss_type=loss_type)
