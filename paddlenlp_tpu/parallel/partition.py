@@ -41,6 +41,7 @@ __all__ = [
     "sharding_tree",
     "shard_params",
     "shard_constraint",
+    "logical_axis_size",
     "batch_spec",
     "param_path_tree",
 ]
@@ -237,6 +238,13 @@ def _current_mesh():
         return None if m.empty else m
     except Exception:
         return None
+
+
+def logical_axis_size(name: str, mesh: Optional[Mesh] = None, rules=None) -> int:
+    """Product of the mesh-axis sizes a logical axis currently maps to (1 off-mesh)."""
+    mesh = mesh if mesh is not None else _current_mesh()
+    rules = rules or active_logical_rules()
+    return _axes_size(mesh, rules.get(name))
 
 
 def batch_spec(extra_dims: int = 1) -> PartitionSpec:

@@ -174,3 +174,17 @@ def has_length(dataset) -> bool:
         return len(dataset) is not None
     except TypeError:
         return False
+
+
+def copy_aliased_params(params, policy_params):
+    """jnp.copy only the leaves of ``params`` that alias ``policy_params`` buffers.
+
+    Donation safety for frozen reference copies (DPO/PPO): the jitted train step
+    donates the policy buffers; any leaf shared with them must be a real copy,
+    while distinct buffers are kept as-is (no HBM doubling).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    policy_ids = {id(x) for x in jax.tree.leaves(policy_params)}
+    return jax.tree.map(lambda x: jnp.copy(x) if id(x) in policy_ids else x, params)

@@ -22,6 +22,7 @@ from ..model_outputs import (
     SequenceClassifierOutput,
     TokenClassifierOutput,
 )
+from ..llama.modeling import VocabEmbed
 from ..model_utils import PretrainedModel
 from .configuration import BertConfig
 
@@ -55,8 +56,8 @@ class BertEmbeddings(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         init = nn.initializers.normal(cfg.initializer_range)
-        words = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
-                         embedding_init=init, name="word_embeddings")(input_ids)
+        words = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                           embedding_init=init, name="word_embeddings")(input_ids)
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
                        param_dtype=self.param_dtype, embedding_init=init, name="position_embeddings")(position_ids)
         types = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,

@@ -18,6 +18,7 @@ from flax import linen as nn
 from ...ops.flash_attention import dot_product_attention
 from ...parallel.partition import P, shard_constraint
 from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import VocabEmbed
 from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
 from ..model_utils import PretrainedModel
 from .configuration import GPTConfig
@@ -134,9 +135,11 @@ class GPTModule(nn.Module):
         cfg = self.config
         B, T = input_ids.shape if input_ids is not None else inputs_embeds.shape[:2]
         if inputs_embeds is None:
-            wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
-                           embedding_init=nn.initializers.normal(cfg.initializer_range), name="wte")
-            inputs_embeds = wte(input_ids)
+            # VocabEmbed: vocab-sharded lookup as an iota one-hot matmul under tp
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="wte")(input_ids)
         offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
         if position_ids is None:
             position_ids = jnp.arange(T)[None, :] + offset

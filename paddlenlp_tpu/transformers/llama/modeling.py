@@ -25,7 +25,7 @@ TPU-first redesign:
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +36,7 @@ from jax.ad_checkpoint import checkpoint_name
 from ...ops.cross_entropy import cross_entropy_with_ignore
 from ...ops.flash_attention import dot_product_attention
 from ...ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
-from ...parallel.partition import P, shard_constraint
+from ...parallel.partition import P, logical_axis_size, shard_constraint
 from ..cache_utils import KVCache, update_layer_kv
 from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast, SequenceClassifierOutput
 from ..model_utils import PretrainedModel
@@ -98,6 +98,39 @@ def _dense(features, use_bias, config, dtype, param_dtype, name):
         kernel_init=nn.initializers.normal(config.initializer_range),
         name=name,
     )
+
+
+class VocabEmbed(nn.Module):
+    """Token embedding with a vocab-parallel lookup.
+
+    When the ``vocab`` logical axis is sharded (tp>1), a plain gather makes GSPMD
+    all-gather the full table every step ("involuntary full rematerialization" in
+    the compile log). Instead, contract a one-hot of the ids against the table:
+    the iota-compare one-hot fuses into the dot operand (never materialized in
+    HBM), the contraction stays vocab-sharded (local matmul + psum over tp), and
+    the backward is the matching scatter-matmul. This is the TPU analogue of the
+    reference's fleet ``VocabParallelEmbedding`` (llama/modeling.py:1440 embed
+    path) — masked local lookup + all-reduce, here expressed MXU-natively.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    embedding_init: Any = nn.initializers.normal(0.02)
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param(
+            "embedding", self.embedding_init, (self.num_embeddings, self.features), self.param_dtype
+        )
+        # one-hot path only when the table is actually vocab-sharded (divisible);
+        # otherwise resolve_spec replicates it and a gather is strictly cheaper
+        if logical_axis_size("vocab") > 1 and self.num_embeddings % logical_axis_size("vocab") == 0:
+            onehot = jax.nn.one_hot(ids, self.num_embeddings, dtype=self.dtype)
+            onehot = shard_constraint(onehot, P("batch", "act_seq", "act_vocab"))
+            return onehot @ table.astype(self.dtype)
+        return jnp.take(table.astype(self.dtype), ids, axis=0)
 
 
 class LlamaMLP(nn.Module):
@@ -318,15 +351,14 @@ class LlamaModule(nn.Module):
     ):
         cfg = self.config
         if inputs_embeds is None:
-            embed = nn.Embed(
+            inputs_embeds = VocabEmbed(
                 cfg.vocab_size,
                 cfg.hidden_size,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 embedding_init=nn.initializers.normal(cfg.initializer_range),
                 name="embed_tokens",
-            )
-            inputs_embeds = embed(input_ids)
+            )(input_ids)
         if getattr(cfg, "scale_embeddings", False):  # gemma: h *= sqrt(hidden)
             inputs_embeds = inputs_embeds * jnp.asarray(cfg.hidden_size**0.5, dtype=inputs_embeds.dtype)
         h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))

@@ -17,14 +17,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..trainer.trainer import Trainer
+from ..trainer.trainer_utils import copy_aliased_params
 from ..utils.log import logger
 from .dpo_criterion import DPOCriterion, sequence_logps
-
-def _copy_aliased(params, policy_params):
-    """jnp.copy only the leaves of ``params`` that alias ``policy_params`` buffers."""
-    policy_ids = {id(x) for x in jax.tree.leaves(policy_params)}
-    return jax.tree.map(lambda x: jnp.copy(x) if id(x) in policy_ids else x, params)
-
 
 __all__ = ["DPOTrainer"]
 
@@ -40,7 +35,7 @@ class DPOTrainer(Trainer):
             # Copy exactly the buffers that alias the policy params: the jitted
             # train step donates those, which would delete a shared reference.
             # A distinct ref_model keeps its original buffers (no HBM doubling).
-            self.ref_params = _copy_aliased(src, model.params)
+            self.ref_params = copy_aliased_params(src, model.params)
             if ref_model is None:
                 logger.info("DPO: using a frozen copy of the policy as the reference model")
 

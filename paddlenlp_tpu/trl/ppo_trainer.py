@@ -26,6 +26,7 @@ import numpy as np
 
 from ..experimental import InferenceEngine, SamplingParams
 from ..trainer.trainer import Trainer
+from ..trainer.trainer_utils import copy_aliased_params
 from ..utils.log import logger
 from .dpo_criterion import sequence_logps
 
@@ -66,9 +67,7 @@ class PPOTrainer(Trainer):
         self.reward_fn = reward_fn
         # Copy exactly the buffers that alias the policy (donation-safety
         # without doubling a distinct reference model's HBM footprint).
-        from .dpo_trainer import _copy_aliased
-
-        self.ref_params = _copy_aliased(
+        self.ref_params = copy_aliased_params(
             ref_model.params if ref_model is not None else model.params, model.params
         )
         self._engine_kwargs = dict(
