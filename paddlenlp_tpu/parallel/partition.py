@@ -59,6 +59,7 @@ DEFAULT_LOGICAL_RULES: Dict[str, Any] = {
     "expert": ("dp", "fsdp"),  # expert parallel rides the data axes (reference: use_expert_parallel)
     "norm": None,
     "layers": None,  # becomes "pp" when the stacked-layer pipeline path is active
+    "stage": "pp",  # pipeline stage axis of the [S, L/S, ...] param view / state
     # ---- activation axes ----
     "batch": ("dp", "fsdp"),
     "seq": ("sep", "cp"),

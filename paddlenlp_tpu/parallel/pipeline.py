@@ -1,0 +1,141 @@
+"""Pipeline parallelism — GSPMD spatial microbatch pipeline over the ``pp`` axis.
+
+Counterpart of the reference's pipeline stack: per-model ``modeling_pp.py``
+networks built from ``LayerDesc``/``SharedLayerDesc`` (e.g.
+``paddlenlp/transformers/llama/modeling_pp.py:296``), the fleet 1F1B/interleave
+runtime (``paddlenlp/trainer/trainer.py:2246`` ``training_pipeline_step``), and
+the pp knobs (``training_args.py:1112-1170``).
+
+TPU-native redesign — no second network definition, no schedule runtime:
+
+- the scanned decoder stack's [L, ...] params are VIEWED as [S, L/S, ...] with
+  the stage axis sharded over the mesh's ``pp`` axis (each pp rank holds its
+  contiguous block of layers);
+- every "tick" runs ALL stages in parallel (``vmap`` over the stage axis), each
+  stage scanning its local layers over its current microbatch;
+- between ticks, activations shift one stage forward; the stage-sharded shift
+  (slice + concat on a pp-sharded dim) is lowered by GSPMD to
+  ``collective-permute`` — the reference's P2P send/recv;
+- stage 0 injects a fresh microbatch each tick, the last stage's outputs are
+  collected after the (S-1)-tick fill.
+
+Differentiating through the tick loop reverses it, yielding the backward
+pipeline automatically (ppermute transposes to the opposite ring); per-layer
+rematerialization keeps live activations at stage boundaries only. Fill/drain
+bubble is (S-1)/(M+S-1) per direction — 1F1B's throughput shape for M >> S.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .partition import _current_mesh
+
+__all__ = ["spatial_pipeline", "stage_view"]
+
+
+def _stage_constraint(x):
+    """Constrain ONLY dim 0 onto the pp axis; every other dim stays UNCONSTRAINED
+    (an omitted/None trailing dim in a PartitionSpec means REPLICATED, which would
+    all-gather tp/fsdp-sharded params and dp-sharded activations every tick)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = _current_mesh()
+    if mesh is None or mesh.shape.get("pp", 1) <= 1:
+        return x
+    spec = PartitionSpec("pp", *([PartitionSpec.UNCONSTRAINED] * (x.ndim - 1)))
+    if isinstance(mesh, Mesh):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def stage_view(stacked_params: Any, n_stages: int) -> Any:
+    """View stacked [L, ...] params as [S, L/S, ...], stage axis pp-sharded."""
+
+    def split(x):
+        L = x.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(f"num layers {L} not divisible by pipeline stages {n_stages}")
+        x = x.reshape((n_stages, L // n_stages) + x.shape[1:])
+        return _stage_constraint(x)
+
+    return jax.tree.map(split, stacked_params)
+
+
+def spatial_pipeline(
+    layer_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    stream: Any,
+    n_stages: int,
+) -> Any:
+    """Run ``layer_fn`` over all L layers of every microbatch, pipelined.
+
+    Args:
+      layer_fn: ``(layer_params, state) -> state`` — one decoder layer applied to
+        one microbatch's state pytree (activations + anything that must travel
+        with them: masks, positions, aux accumulators).
+      stacked_params: pytree of [L, ...] leaves (the scanned decoder stack).
+      stream: pytree of [M, ...] leaves — M microbatches of initial state.
+      n_stages: S; must equal the mesh's pp-axis size and divide L.
+
+    Returns the final-layer state for every microbatch, a pytree of [M, ...].
+    """
+    S = n_stages
+    params_S = stage_view(stacked_params, S)
+    M = jax.tree.leaves(stream)[0].shape[0]
+
+    def stage_fn(stage_params, state):
+        def body(carry, lp):
+            return layer_fn(lp, carry), None
+
+        state, _ = jax.lax.scan(body, state, stage_params)
+        return state
+
+    vstages = jax.vmap(stage_fn)
+
+    def constrain_state(state):
+        # dim 0 is the stage axis; inner dims stay UNCONSTRAINED so the layer
+        # body's batch/seq shardings propagate through vmap untouched.
+        return jax.tree.map(_stage_constraint, state)
+
+    zeros_state = jax.tree.map(lambda x: jnp.zeros((S,) + x.shape[1:], x.dtype), stream)
+    zeros_out = jax.tree.map(jnp.zeros_like, stream)
+
+    def tick(carry, t):
+        prev_out, outputs = carry
+        # inject: stage 0 reads microbatch t (clamped during drain — the clamped
+        # duplicates never reach the collected outputs, so they carry no gradient)
+        inj = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, jnp.minimum(t, M - 1), axis=0, keepdims=False),
+            stream,
+        )
+        # shift: new_state[0] = injected, new_state[s] = prev_out[s-1].
+        # Expressed as a cyclic roll of the stage-sharded state (GSPMD lowers it
+        # to one collective-permute) + a where-mask writing the replicated
+        # injection into slot 0 — a concat of mixed-sharding operands would
+        # instead force a replicate-repartition of the state every tick.
+        def shift(i, p):
+            rolled = jnp.roll(p, 1, axis=0)
+            stage_idx = jnp.arange(S).reshape((S,) + (1,) * (p.ndim - 1))
+            return jnp.where(stage_idx == 0, i[None].astype(p.dtype), rolled)
+
+        state = jax.tree.map(shift, inj, prev_out)
+        state = constrain_state(state)
+        out = vstages(params_S, state)
+        out = constrain_state(out)
+        # collect the last stage's result at index t-(S-1). For t < S-1 the clip
+        # writes warm-up garbage at index 0, overwritten by the valid write at
+        # t = S-1 (ascending scan order guarantees the valid write lands last).
+        idx = jnp.clip(t - (S - 1), 0)
+        outputs = jax.tree.map(
+            lambda o, v: jax.lax.dynamic_update_index_in_dim(o, v[-1].astype(o.dtype), idx, axis=0),
+            outputs,
+            out,
+        )
+        return (out, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (zeros_state, zeros_out), jnp.arange(M + S - 1))
+    return outputs

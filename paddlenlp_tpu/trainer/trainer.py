@@ -209,13 +209,16 @@ class Trainer:
         params = self.model.params
         fsdp = self.mesh.shape.get("fsdp", 1)
         stage = self.args.sharding_stage
+        overrides = {}
+        if self.mesh.shape.get("pp", 1) > 1:
+            overrides["layers"] = "pp"  # stacked [L] decoder params split across stages
         if stage in (1, 2) and fsdp > 1:
-            params = self._shard_params(params, logical_overrides={"embed": None})
+            params = self._shard_params(params, logical_overrides={"embed": None, **overrides})
             opt_shardings = self._zero1_opt_shardings(params)
             with use_mesh(self.mesh):
                 opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
         else:
-            params = self._shard_params(params)
+            params = self._shard_params(params, logical_overrides=overrides)
             with use_mesh(self.mesh):
                 opt_state = jax.jit(self.optimizer.init)(params)  # shardings follow params
         return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
@@ -242,9 +245,63 @@ class Trainer:
         return loss
 
     # ------------------------------------------------------------------ train step
+    def _use_pipeline(self) -> bool:
+        """Pipelined train step: pp>1, model exposes ``pipelined_loss``, and
+        ``compute_loss`` is not overridden (subclass losses fall back to the
+        plain GSPMD path, which remains correct under a pp-sharded layer stack)."""
+        if self.mesh.shape.get("pp", 1) <= 1:
+            return False
+        if not hasattr(self.model, "pipelined_loss"):
+            logger.warning_once(
+                "pp>1 but the model has no pipelined_loss; running the un-pipelined "
+                "GSPMD path (layer params gathered stage-by-stage)"
+            )
+            return False
+        cfg = getattr(self.model, "config", None)
+        if not getattr(cfg, "use_scan_layers", False):
+            logger.warning_once(
+                "pp>1 requires use_scan_layers=True (stacked [L] params); running "
+                "the un-pipelined GSPMD path"
+            )
+            return False
+        if type(self).compute_loss is not Trainer.compute_loss:
+            logger.warning_once(
+                "pp>1 with an overridden compute_loss: the microbatch pipeline only "
+                "drives the built-in causal-LM loss; running the un-pipelined path"
+            )
+            return False
+        for attr in ("attention_dropout", "hidden_dropout", "resid_pdrop", "embd_pdrop", "attn_pdrop"):
+            if getattr(cfg, attr, 0.0):
+                logger.warning_once(
+                    f"pp>1 pipeline path runs deterministically: config.{attr}="
+                    f"{getattr(cfg, attr)} is IGNORED (dropout is not threaded "
+                    "through the microbatch pipeline)"
+                )
+        return True
+
     def _build_train_step(self):
         optimizer = self.optimizer
         accum = self.args.gradient_accumulation_steps
+        if self._use_pipeline():
+            pp = self.mesh.shape["pp"]
+            shift = not self._labels_preshifted
+
+            def pipeline_train_step(state: TrainState, batch, dropout_rng):
+                import optax
+
+                def loss_fn(params):
+                    return self.model.pipelined_loss(
+                        params, batch, n_stages=pp, criterion=self.criterion, shift=shift
+                    )
+
+                loss, grads = jax.value_and_grad(loss_fn)(state.params)
+                grad_norm = optax.global_norm(grads)
+                updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+                params = optax.apply_updates(state.params, updates)
+                new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+                return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+            return jax.jit(pipeline_train_step, donate_argnums=(0,))
 
         def loss_for_micro(params, micro, rng):
             return self.compute_loss(params, micro, dropout_rng=rng)
@@ -323,7 +380,7 @@ class Trainer:
             drop_last=False,
         )
 
-    def _device_put_batch(self, batch: Dict[str, np.ndarray], accum: int):
+    def _device_put_batch(self, batch: Dict[str, np.ndarray], accum: int, micro_axis: bool = False):
         """Shard the host batch onto the mesh: [global_B, ...] -> batch axes (dp,fsdp);
         with accumulation, reshape to [accum, global_B/accum, ...] first.
 
@@ -364,7 +421,7 @@ class Trainer:
 
         def put(x):
             x = np.asarray(x)
-            if accum > 1:
+            if accum > 1 or micro_axis:
                 x = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
                 spec = P(None, ("dp", "fsdp"))
             else:
@@ -479,7 +536,7 @@ class Trainer:
                         steps_to_skip -= 1
                         continue
                     self.control = self.callback_handler.on_step_begin(args, self.state, self.control)
-                    batch = self._device_put_batch(host_batch, accum)
+                    batch = self._device_put_batch(host_batch, accum, micro_axis=self._use_pipeline())
                     self.timers("read-data").stop()
                     self.timers("forward-backward-optimizer").start()
                     self.train_state, metrics = self._train_step_fn(self.train_state, batch, dropout_rng)

@@ -33,7 +33,7 @@ import numpy as np
 from flax import linen as nn
 from jax.ad_checkpoint import checkpoint_name
 
-from ...ops.cross_entropy import cross_entropy_with_ignore
+from ...ops.cross_entropy import causal_lm_loss, cross_entropy_with_ignore
 from ...ops.flash_attention import dot_product_attention
 from ...ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
 from ...parallel.partition import P, logical_axis_size, shard_constraint
@@ -523,6 +523,83 @@ class LlamaModel(LlamaPretrainedModel):
 class LlamaForCausalLM(LlamaPretrainedModel):
     module_class = LlamaForCausalLMModule
     _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+    def pipelined_loss(self, params, batch, *, n_stages: int, criterion=None, shift: bool = True):
+        """Causal-LM loss with the decoder trunk run as a pp-stage pipeline.
+
+        The Trainer calls this instead of ``compute_loss`` when the mesh has
+        pp>1 (reference ``training_pipeline_step`` trainer.py:2246 +
+        ``LlamaForCausalLMPipe`` modeling_pp.py:296 — here the SAME network/
+        params pipeline themselves; no second model class). ``batch`` tensors
+        are [M, mb, ...] with M = microbatch count (the grad-accum axis).
+        Embedding/head run outside the pipeline, replicated over pp (they are
+        a small fraction of trunk FLOPs); shared-embedding gradients therefore
+        need no special handling — AD sums both uses.
+        """
+        from ...parallel.pipeline import spatial_pipeline
+
+        cfg = self.config
+        module = self.module
+        if not getattr(cfg, "use_scan_layers", False):
+            raise ValueError("pipeline parallelism requires use_scan_layers=True (stacked [L] params)")
+        dtype, pdtype = module.dtype, module.param_dtype
+        ids = batch["input_ids"]
+        labels = batch["labels"]
+        M, mb, T = ids.shape
+        mp = params["model"]
+
+        h = VocabEmbed(
+            cfg.vocab_size, cfg.hidden_size, dtype=dtype, param_dtype=pdtype,
+        ).apply({"params": mp["embed_tokens"]}, ids.reshape(M * mb, T))
+        if getattr(cfg, "scale_embeddings", False):
+            h = h * jnp.asarray(cfg.hidden_size**0.5, dtype=h.dtype)
+        h = h.reshape(M, mb, T, cfg.hidden_size)
+        h = shard_constraint(h, P(None, "batch", "act_seq", None))
+
+        mask = batch.get("attention_mask")
+        pos = batch.get("position_ids")
+        seg = batch.get("segment_ids")
+        layer_cls = type(module).base_module_cls.decoder_layer_cls
+        base_layer = layer_cls(cfg, dtype, pdtype)
+
+        def layer_fn(lp, state):
+            hh, m_, p_, s_, aux = state
+            (hh, _, aux), _ = base_layer.apply(
+                {"params": lp}, (hh, jnp.zeros((), jnp.int32), aux), None, m_, p_, s_, True
+            )
+            return (hh, m_, p_, s_, aux)
+
+        if getattr(cfg, "recompute", False):
+            layer_fn = jax.checkpoint(
+                layer_fn, policy=_remat_policy(getattr(cfg, "recompute_granularity", "full"))
+            )
+        stream = (h, mask, pos, seg, jnp.zeros((M,), jnp.float32))
+        h_out, _, _, _, aux = spatial_pipeline(layer_fn, mp["layers"], stream, n_stages)
+        aux = aux / cfg.num_hidden_layers  # HF convention (LlamaModule does the same)
+
+        norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                            unit_offset=bool(getattr(cfg, "rms_norm_add_unit_offset", False)))
+
+        def head_loss(total, xs):
+            h_mb, labels_mb, aux_mb = xs
+            hn = norm.apply({"params": mp["norm"]}, h_mb)
+            if cfg.tie_word_embeddings:
+                logits = hn @ mp["embed_tokens"]["embedding"].T.astype(dtype)
+            else:
+                import flax.linen as fnn
+
+                logits = fnn.Dense(cfg.vocab_size, use_bias=False, dtype=dtype, param_dtype=pdtype).apply(
+                    {"params": params["lm_head"]}, hn
+                )
+            logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+            if criterion is not None:
+                loss = criterion(logits, labels_mb)
+            else:
+                loss = causal_lm_loss(logits, labels_mb, shift=shift)
+            return total + loss + aux_mb, None
+
+        total, _ = jax.lax.scan(head_loss, jnp.zeros((), jnp.float32), (h_out, labels, aux))
+        return total / M
 
     def get_model_flops(self, batch_size: int, seq_length: int) -> float:
         cfg = self.config
