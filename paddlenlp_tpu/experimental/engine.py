@@ -3,16 +3,21 @@
 Counterpart of the reference's dynamic-insertion serving loop:
 ``GenerationBlockInferenceModel.sample`` per-token loop
 (experimental/transformers/generation_utils.py:403) + the ``step_paddle`` block
-scheduler (csrc/gpu/step.cu:316 — dispatch/free/preempt/recover). Host-side
-scheduler + two jitted device programs (bucketed prefill, fixed-shape decode):
+scheduler (csrc/gpu/step.cu:316 — dispatch/free/preempt/recover) + the on-GPU
+sampling/penalty/stop ops (top_p_sampling_reject.cu, token_penalty_multi_scores.cu,
+stop_generation_multi_ends.cu, update_inputs.cu). Host-side scheduler + two jitted
+device programs:
 
-- admission: waiting requests prefill one-at-a-time into freshly allocated block
-  tables (prompt lengths bucketed to powers of two to bound retraces);
-- decode: ALL running sequences advance one token per step in a single fixed
-  [max_batch_size] jit — empty slots point at the sentinel block and are masked;
+- admission: waiting requests prefill in BATCHES grouped by power-of-two padded
+  prompt length; the first token is sampled on device inside the prefill jit;
+- decode: ALL slots advance up to ``decode_steps`` tokens in ONE jit —
+  sampling, repetition/presence/frequency penalties, eos and length stops all
+  run on device; the host round-trip carries int32 ids + flags only (the
+  reference avoids per-token host sync the same way, with CUDA ops);
 - preemption: on block exhaustion the youngest sequence is evicted and requeued
   with prompt+generated as its new prompt (recompute-style recovery, the
-  ``is_block_step``/recover list of step.cu);
+  ``is_block_step``/recover list of step.cu). Sampling keys are
+  (seed, absolute position), so a recomputed sequence resamples identically;
 - streaming: per-request callbacks fire as tokens land (the reference pushes
   tokens over a SysV message queue to the serving process; in-process callbacks
   replace the IPC hop).
@@ -45,6 +50,9 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = 0
     seed: int = 0
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
 
 @dataclasses.dataclass
@@ -55,13 +63,22 @@ class Request:
     output_ids: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     stream_cb: Optional[Callable[[int, bool], None]] = None
-    _rng: Optional[np.random.Generator] = None
     arrival_t: float = 0.0
     first_token_t: Optional[float] = None
+    base_prompt_len: int = 0  # original prompt length (preemption grows prompt_ids)
 
     @property
     def total_len(self) -> int:
         return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def gen_offset(self) -> int:
+        """Tokens already regenerated into prompt_ids by a preemption-requeue."""
+        return len(self.prompt_ids) - self.base_prompt_len
+
+    @property
+    def remaining_new(self) -> int:
+        return self.sampling.max_new_tokens - self.gen_offset - len(self.output_ids)
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -82,20 +99,27 @@ class InferenceEngine:
         max_blocks_per_seq: int = 64,
         eos_token_id: Optional[int] = None,
         dtype=jnp.float32,
+        decode_steps: int = 8,
     ):
         self.model = model
         self.tokenizer = tokenizer
-        self.infer = PagedInferenceModel(model, block_size, num_blocks, max_blocks_per_seq, dtype=dtype)
+        eos = eos_token_id if eos_token_id is not None else getattr(model.config, "eos_token_id", None)
+        self.eos_ids = set(eos) if isinstance(eos, (list, tuple)) else ({eos} if eos is not None else set())
+        self.infer = PagedInferenceModel(
+            model, block_size, num_blocks, max_blocks_per_seq, dtype=dtype,
+            decode_steps=decode_steps, eos_ids=self.eos_ids,
+        )
         self.pool = init_paged_pool(model.config, num_blocks, block_size,
                                     dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32)
         self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq)
         self.max_batch_size = max_batch_size
-        eos = eos_token_id if eos_token_id is not None else getattr(model.config, "eos_token_id", None)
-        self.eos_ids = set(eos) if isinstance(eos, (list, tuple)) else ({eos} if eos is not None else set())
+        self.decode_steps = decode_steps
         self.waiting: deque[Request] = deque()
-        self.running: Dict[int, Request] = {}  # seq_id == req_id
+        self.slots: List[Optional[Request]] = [None] * max_batch_size
         self._next_id = itertools.count()
-        self._last_token: Dict[int, int] = {}
+        self._last_token = np.zeros(max_batch_size, np.int32)
+        # device-resident per-slot token counts feeding the penalty kernels
+        self.counts = jnp.zeros((max_batch_size, model.config.vocab_size), jnp.int32)
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -106,14 +130,14 @@ class InferenceEngine:
             prompt_ids=np.asarray(prompt_ids, dtype=np.int32).reshape(-1),
             sampling=sampling,
             stream_cb=stream_cb,
-            _rng=np.random.default_rng(sampling.seed),
             arrival_t=time.time(),
         )
+        req.base_prompt_len = len(req.prompt_ids)
         self.waiting.append(req)
         return req.req_id
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting) or any(r is not None for r in self.slots)
 
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
         """Submit a batch and run to completion (convenience API)."""
@@ -132,12 +156,37 @@ class InferenceEngine:
         self._decode_running(finished)
         return finished
 
+    def _samp_arrays(self, reqs: List[Optional[Request]]):
+        """Per-slot sampling parameter arrays for the device kernels."""
+        n = len(reqs)
+        get = lambda f, d: np.asarray(
+            [getattr(r.sampling, f) if r is not None else d for r in reqs]
+        )
+        return dict(
+            seeds=jnp.asarray(get("seed", 0), jnp.int32),
+            temperature=jnp.asarray(get("temperature", 1.0), jnp.float32),
+            top_k=jnp.asarray(get("top_k", 0), jnp.int32),
+            top_p=jnp.asarray(get("top_p", 1.0), jnp.float32),
+            do_sample=jnp.asarray(get("do_sample", False), bool),
+            repetition_penalty=jnp.asarray(get("repetition_penalty", 1.0), jnp.float32),
+            presence_penalty=jnp.asarray(get("presence_penalty", 0.0), jnp.float32),
+            frequency_penalty=jnp.asarray(get("frequency_penalty", 0.0), jnp.float32),
+        )
+
+    def _free_slot_indices(self) -> List[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
     def _admit(self, finished: List[Request]):
-        while self.waiting and len(self.running) < self.max_batch_size:
+        free = self._free_slot_indices()
+        admitted: List[tuple] = []  # (slot, req)
+        while self.waiting and free:
             req = self.waiting[0]
             prompt_len = len(req.prompt_ids)
-            # a request that can NEVER fit must fail fast, not spin has_work() forever
-            need = self.mgr.blocks_needed(prompt_len + req.sampling.max_new_tokens)
+            # a request that can NEVER fit must fail fast, not spin has_work()
+            # forever. remaining_new (not max_new_tokens) so a preempted request
+            # whose generated tokens were folded into the prompt is not
+            # over-counted and spuriously rejected on re-admission.
+            need = self.mgr.blocks_needed(prompt_len + req.remaining_new)
             if need > self.mgr.max_blocks_per_seq or need > self.mgr.total_usable_blocks:
                 self.waiting.popleft()
                 req.done = True
@@ -149,88 +198,107 @@ class InferenceEngine:
                 break
             self.waiting.popleft()
             self.mgr.allocate(req.req_id, prompt_len)
-            table = jnp.asarray(self.mgr.table_array(req.req_id))
-            padded = _bucket(prompt_len)
-            ids = np.zeros((1, padded), np.int32)
-            ids[0, :prompt_len] = req.prompt_ids
-            logits, self.pool = self.infer.prefill(
-                self.model.params, self.pool, jnp.asarray(ids), table, jnp.asarray(prompt_len)
+            admitted.append((free.pop(0), req))
+
+        # batch prefills, grouped by padded prompt length (bounded retraces)
+        by_bucket: Dict[int, List[tuple]] = {}
+        for slot, req in admitted:
+            by_bucket.setdefault(_bucket(len(req.prompt_ids)), []).append((slot, req))
+        for padded, group in by_bucket.items():
+            n = _bucket(len(group), minimum=1)
+            ids = np.zeros((n, padded), np.int32)
+            tables = np.zeros((n, self.mgr.max_blocks_per_seq), np.int32)
+            lens = np.zeros(n, np.int32)
+            reqs: List[Optional[Request]] = [None] * n
+            for j, (slot, req) in enumerate(group):
+                ids[j, : len(req.prompt_ids)] = req.prompt_ids
+                tables[j] = self.mgr.table_array(req.req_id)
+                lens[j] = len(req.prompt_ids)
+                reqs[j] = req
+            tokens, counts_rows, self.pool = self.infer.prefill(
+                self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
+                jnp.asarray(lens), self._samp_arrays(reqs),
             )
-            tok = self._sample(req, np.asarray(logits[0]))
-            self._emit(req, tok)
-            if req.done:
-                self.mgr.free_seq(req.req_id)
-                finished.append(req)
-            else:
-                self.running[req.req_id] = req
-                self._last_token[req.req_id] = tok
+            tokens = np.asarray(tokens)
+            slot_idx = [slot for slot, _ in group]
+            self.counts = self.counts.at[jnp.asarray(slot_idx)].set(counts_rows[: len(group)])
+            for j, (slot, req) in enumerate(group):
+                tok = int(tokens[j])
+                self._emit(req, tok)
+                if req.done:
+                    self.mgr.free_seq(req.req_id)
+                    finished.append(req)
+                else:
+                    self.slots[slot] = req
+                    self._last_token[slot] = tok
 
     def _decode_running(self, finished: List[Request]):
-        if not self.running:
+        if not any(r is not None for r in self.slots):
             return
-        # grow tables; preempt (recompute-requeue) youngest on exhaustion
-        for req_id in sorted(self.running, reverse=True):
-            req = self.running[req_id]
-            if self.mgr.extend(req_id, 1) is None:
-                logger.warning(f"req {req_id}: KV blocks exhausted; preempting (recompute)")
-                self.mgr.free_seq(req_id)
-                del self.running[req_id]
+        steps = self.decode_steps
+        # grow tables for up to `steps` tokens; preempt (recompute-requeue)
+        # youngest on exhaustion. Surplus is shrunk back after the device call.
+        start_len: Dict[int, int] = {}
+        active = [s for s in range(len(self.slots)) if self.slots[s] is not None]
+        for slot in sorted(active, key=lambda s: -self.slots[s].req_id):
+            req = self.slots[slot]
+            needed = min(steps, req.remaining_new)
+            start_len[req.req_id] = self.mgr.lengths[req.req_id]
+            if self.mgr.extend(req.req_id, max(needed, 1)) is None:
+                logger.warning(f"req {req.req_id}: KV blocks exhausted; preempting (recompute)")
+                self.mgr.free_seq(req.req_id)
+                self.slots[slot] = None
+                start_len.pop(req.req_id, None)
                 req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
                 req.output_ids = []
                 self.waiting.appendleft(req)
 
-        if not self.running:
+        if not any(r is not None for r in self.slots):
             return
         B = self.max_batch_size
-        tokens = np.zeros(B, np.int32)
+        tokens = np.array(self._last_token, np.int32)
         tables = np.zeros((B, self.mgr.max_blocks_per_seq), np.int32)
         ctx = np.zeros(B, np.int32)
-        slots = list(self.running.values())
-        for i, req in enumerate(slots):
-            tokens[i] = self._last_token[req.req_id]
+        done0 = np.ones(B, bool)
+        remaining = np.zeros(B, np.int32)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
             tables[i] = self.mgr.table_array(req.req_id)
             ctx[i] = req.total_len - 1  # position of the token being fed
-        logits, self.pool = self.infer.decode(
-            self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables), jnp.asarray(ctx)
+            done0[i] = False
+            remaining[i] = req.remaining_new
+        toks, valid, _, _, self.counts, self.pool = self.infer.decode(
+            self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
+            jnp.asarray(ctx), jnp.asarray(done0), jnp.asarray(remaining),
+            self.counts, self._samp_arrays(self.slots),
         )
-        logits_np = np.asarray(logits)
-        for i, req in enumerate(slots):
-            tok = self._sample(req, logits_np[i])
-            self._emit(req, tok)
+        # ONE host transfer of ids + validity flags (no logits)
+        toks = np.asarray(toks)  # [steps, B]
+        valid = np.asarray(valid)
+        for s in range(toks.shape[0]):
+            for i, req in enumerate(self.slots):
+                if req is None or req.done or not valid[s, i]:
+                    continue
+                self._emit(req, int(toks[s, i]))
+                self._last_token[i] = int(toks[s, i])
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
             if req.done:
                 self.mgr.free_seq(req.req_id)
-                del self.running[req.req_id]
-                self._last_token.pop(req.req_id, None)
+                self.slots[i] = None
                 finished.append(req)
-            else:
-                self._last_token[req.req_id] = tok
-
-    # ------------------------------------------------------------------ sampling
-    def _sample(self, req: Request, logits: np.ndarray) -> int:
-        s = req.sampling
-        if not s.do_sample:
-            return int(np.argmax(logits))
-        logits = logits.astype(np.float64) / max(s.temperature, 1e-6)
-        if s.top_k and s.top_k > 0:
-            kth = np.partition(logits, -s.top_k)[-s.top_k]
-            logits = np.where(logits < kth, -np.inf, logits)
-        probs = np.exp(logits - logits.max())
-        probs /= probs.sum()
-        if s.top_p < 1.0:
-            order = np.argsort(probs)[::-1]
-            csum = np.cumsum(probs[order])
-            cutoff = np.searchsorted(csum, s.top_p) + 1
-            mask = np.zeros_like(probs)
-            mask[order[:cutoff]] = probs[order[:cutoff]]
-            probs = mask / mask.sum()
-        return int(req._rng.choice(len(probs), p=probs))
+            elif req.req_id in start_len:
+                # return speculative blocks past the tokens actually produced
+                self.mgr.shrink(req.req_id, req.total_len)
 
     def _emit(self, req: Request, tok: int):
         if req.first_token_t is None:
             req.first_token_t = time.time()
         req.output_ids.append(tok)
         is_eos = tok in self.eos_ids
-        hit_max = len(req.output_ids) >= req.sampling.max_new_tokens
+        hit_max = req.gen_offset + len(req.output_ids) >= req.sampling.max_new_tokens
         req.done = is_eos or hit_max
         if req.stream_cb is not None:
             req.stream_cb(tok, req.done)

@@ -23,7 +23,63 @@ import numpy as np
 from ..ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
 from .paged_cache import PagedKVPool, gather_kv, write_kv_block
 
-__all__ = ["PagedInferenceModel"]
+__all__ = ["PagedInferenceModel", "sample_tokens"]
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] fp32
+    *,
+    positions: jnp.ndarray,  # [B] absolute position of the token being sampled
+    seeds: jnp.ndarray,  # [B] int32 per-slot seeds
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (<=0: off)
+    top_p: jnp.ndarray,  # [B]
+    do_sample: jnp.ndarray,  # [B] bool
+    counts: Optional[jnp.ndarray] = None,  # [B, V] token counts (prompt+generated)
+    repetition_penalty: Optional[jnp.ndarray] = None,  # [B]
+    presence_penalty: Optional[jnp.ndarray] = None,  # [B]
+    frequency_penalty: Optional[jnp.ndarray] = None,  # [B]
+) -> jnp.ndarray:
+    """Fully on-device sampling: penalties + temperature + top-k/top-p + draw.
+
+    Counterpart of the reference's in-kernel sampling path
+    (``csrc/gpu/sample_kernels/top_p_sampling_reject.cu``,
+    ``csrc/gpu/token_penalty_multi_scores.cu``): one [B,V] sort serves both
+    top-k and top-p, the draw is a per-row categorical, and randomness is keyed
+    on (seed, absolute position) so a preempted-and-recomputed sequence
+    resamples identical tokens. Host never sees logits — only ids.
+    """
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    if counts is not None:
+        seen = counts > 0
+        rp = repetition_penalty[:, None]
+        logits = jnp.where(seen, jnp.where(logits > 0, logits / rp, logits * rp), logits)
+        logits = logits - seen.astype(jnp.float32) * presence_penalty[:, None]
+        logits = logits - counts.astype(jnp.float32) * frequency_penalty[:, None]
+    greedy = jnp.argmax(logits, axis=-1)
+
+    warped = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    order = jnp.argsort(-warped, axis=-1)
+    sorted_logits = jnp.take_along_axis(warped, order, axis=-1)
+    ranks = jnp.arange(V)[None, :]
+    # top-k first, RENORMALIZE, then the nucleus cutoff over the renormalized
+    # distribution — the composition the host sampler / warper chain defines
+    keep_k = jnp.where(top_k[:, None] > 0, ranks < top_k[:, None], True)
+    k_masked = jnp.where(keep_k, sorted_logits, -jnp.inf)
+    probs = jax.nn.softmax(k_masked, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = keep_k & ((csum - probs) < top_p[:, None])
+    keep |= ranks == 0  # top-1 always kept
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+
+    def draw(seed, pos, row):
+        key = jax.random.fold_in(jax.random.key(seed), pos)
+        return jax.random.categorical(key, row)
+
+    picked = jax.vmap(draw)(seeds, positions, masked)
+    sampled = jnp.take_along_axis(order, picked[:, None], axis=-1)[:, 0]
+    return jnp.where(do_sample, sampled, greedy).astype(jnp.int32)
 
 
 def _rms(x, scale, eps):
@@ -37,7 +93,7 @@ class PagedInferenceModel:
     (llama/qwen2/mistral: config-driven biases + GQA + rope)."""
 
     def __init__(self, model, block_size: int = 16, num_blocks: int = 512, max_blocks_per_seq: int = 64,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, decode_steps: int = 8, eos_ids=()):
         self.model = model
         self.config = model.config
         if "layers" not in model.params.get("model", {}):
@@ -46,14 +102,17 @@ class PagedInferenceModel:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.decode_steps = decode_steps
+        # [-1] sentinel when no eos: never matches a sampled id
+        self.eos_arr = jnp.asarray(sorted(eos_ids) or [-1], jnp.int32)
         cfg = self.config
         self.eps = cfg.rms_norm_eps
         self.n_heads = cfg.num_attention_heads
         self.n_kv = cfg.num_key_value_heads
         self.head_dim = cfg.head_dim
         self.inv_freq = jnp.asarray(rope_frequencies(self.head_dim, cfg.rope_theta, cfg.rope_scaling))
-        self._prefill = jax.jit(self._prefill_impl)
-        self._decode = jax.jit(self._decode_impl)
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
 
     # ------------------------------------------------------------------ forward core
     def _attend(self, q, k, v, q_positions, kv_len_mask):
@@ -138,36 +197,73 @@ class PagedInferenceModel:
         return logits.astype(jnp.float32), new_pool
 
     # ------------------------------------------------------------------ entry points
-    def _prefill_impl(self, params, pool_kv, input_ids, block_table, prompt_len):
-        """One sequence [1, T_pad]; valid prefix length = prompt_len."""
-        T = input_ids.shape[1]
-        positions = jnp.arange(T)[None, :]
-        S = block_table.shape[0] * self.block_size
-        kv_len_mask = jnp.arange(S)[None, :] < prompt_len
-        logits, new_pool = self._forward(
-            params, pool_kv, input_ids, block_table[None], positions,
-            kv_len_mask, jnp.zeros((1,), jnp.int32),
-            jnp.asarray([prompt_len - 1]),  # last VALID token (input may be padded)
-        )
-        return logits, new_pool
+    def _prefill_impl(self, params, pool_kv, input_ids, block_tables, prompt_lens, samp):
+        """Batched prefill: [n, T_pad] sequences; samples the first token on device.
 
-    def _decode_impl(self, params, pool_kv, tokens, block_tables, context_lens):
-        """tokens [B] (the next input token per seq, at position context_lens)."""
-        B = tokens.shape[0]
-        positions = context_lens[:, None]
+        Returns (tokens [n], counts [n, V] incl. prompt + sampled token, new pool).
+        """
+        n, T = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (n, T))
         S = block_tables.shape[1] * self.block_size
-        kv_len_mask = jnp.arange(S)[None, :] <= context_lens[:, None]
+        kv_len_mask = jnp.arange(S)[None, :] < prompt_lens[:, None]
         logits, new_pool = self._forward(
-            params, pool_kv, tokens[:, None], block_tables, positions,
-            kv_len_mask, context_lens,
-            jnp.zeros((B,), jnp.int32),
+            params, pool_kv, input_ids, block_tables, positions,
+            kv_len_mask, jnp.zeros((n,), jnp.int32),
+            jnp.maximum(prompt_lens - 1, 0),  # last VALID token (input may be padded)
         )
-        return logits, new_pool
+        V = logits.shape[-1]
+        valid = (jnp.arange(T)[None, :] < prompt_lens[:, None]).astype(jnp.int32)
+        counts = (jax.nn.one_hot(input_ids, V, dtype=jnp.int32) * valid[..., None]).sum(axis=1)
+        tokens = sample_tokens(logits, positions=prompt_lens, counts=counts, **samp)
+        counts = counts + jax.nn.one_hot(tokens, V, dtype=jnp.int32)
+        return tokens, counts, new_pool
 
-    def prefill(self, params, pool: PagedKVPool, input_ids, block_table, prompt_len) -> Tuple[jnp.ndarray, PagedKVPool]:
-        logits, kv = self._prefill(params, pool.kv, input_ids, block_table, prompt_len)
-        return logits, PagedKVPool(kv=kv)
+    def _decode_impl(self, params, pool_kv, tokens, block_tables, context_lens, done0,
+                     remaining, counts, samp):
+        """Multi-step decode: advance every slot up to ``decode_steps`` tokens in ONE
+        jit — the host round-trip carries ids and flags only (the reference's whole
+        per-token op chain ``update_inputs.cu``/``stop_generation_multi_ends.cu``/
+        sampling runs in here). Finished rows freeze: ctx stops advancing and their
+        KV slot is rewritten in place, never read again.
 
-    def decode(self, params, pool: PagedKVPool, tokens, block_tables, context_lens) -> Tuple[jnp.ndarray, PagedKVPool]:
-        logits, kv = self._decode(params, pool.kv, tokens, block_tables, context_lens)
-        return logits, PagedKVPool(kv=kv)
+        Returns (tokens [steps, B], valid [steps, B], done, ctx, counts, pool).
+        """
+        B = tokens.shape[0]
+        S = block_tables.shape[1] * self.block_size
+        eos = self.eos_arr
+
+        def one(carry, _):
+            pool_kv, tok, ctx, done, counts, n_out = carry
+            kv_mask = jnp.arange(S)[None, :] <= ctx[:, None]
+            logits, pool_kv = self._forward(
+                params, pool_kv, tok[:, None], block_tables, ctx[:, None],
+                kv_mask, ctx, jnp.zeros((B,), jnp.int32),
+            )
+            nxt = sample_tokens(logits, positions=ctx + 1, counts=counts, **samp)
+            emit = ~done
+            hit_eos = (nxt[:, None] == eos[None, :]).any(axis=-1)
+            newly_done = emit & (hit_eos | (n_out + 1 >= remaining))
+            nxt = jnp.where(done, tok, nxt)
+            counts = counts + jax.nn.one_hot(nxt, counts.shape[-1], dtype=counts.dtype) * emit[:, None]
+            ctx = jnp.where(done, ctx, ctx + 1)
+            n_out = n_out + emit
+            done = done | newly_done
+            return (pool_kv, nxt, ctx, done, counts, n_out), (nxt, emit)
+
+        init = (pool_kv, tokens, context_lens, done0, counts,
+                jnp.zeros((B,), jnp.int32))
+        (pool_kv, _, ctx, done, counts, _), (toks, valid) = jax.lax.scan(
+            one, init, None, length=self.decode_steps
+        )
+        return toks, valid, done, ctx, counts, pool_kv
+
+    def prefill(self, params, pool: PagedKVPool, input_ids, block_tables, prompt_lens, samp):
+        tokens, counts, kv = self._prefill(params, pool.kv, input_ids, block_tables, prompt_lens, samp)
+        return tokens, counts, PagedKVPool(kv=kv)
+
+    def decode(self, params, pool: PagedKVPool, tokens, block_tables, context_lens, done0,
+               remaining, counts, samp):
+        toks, valid, done, ctx, counts, kv = self._decode(
+            params, pool.kv, tokens, block_tables, context_lens, done0, remaining, counts, samp
+        )
+        return toks, valid, done, ctx, counts, PagedKVPool(kv=kv)

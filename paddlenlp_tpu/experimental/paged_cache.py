@@ -134,6 +134,18 @@ class BlockManager:
         self.lengths[seq_id] = new_len
         return new_blocks
 
+    def shrink(self, seq_id: int, new_len: int):
+        """Release blocks beyond ``new_len`` tokens (undo speculative multi-step
+        extension after a sequence finished early)."""
+        if seq_id not in self.tables:
+            return
+        keep = max(self.blocks_needed(new_len), 1)
+        blocks = self.tables[seq_id]
+        if keep < len(blocks):
+            self.free.extend(blocks[keep:])
+            del blocks[keep:]
+        self.lengths[seq_id] = new_len
+
     def free_seq(self, seq_id: int):
         blocks = self.tables.pop(seq_id, [])
         self.lengths.pop(seq_id, None)

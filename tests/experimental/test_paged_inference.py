@@ -100,6 +100,53 @@ class TestPagedParity:
         assert got[-1][1] is True and not any(d for _, d in got[:-1])
 
 
+class TestDeviceSampling:
+    def test_sample_tokens_top_k1_is_greedy(self):
+        from paddlenlp_tpu.experimental.inference_model import sample_tokens
+
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(3, 16)), jnp.float32)
+        kw = dict(positions=jnp.zeros(3, jnp.int32), seeds=jnp.arange(3, dtype=jnp.int32),
+                  temperature=jnp.ones(3), top_k=jnp.full(3, 1, jnp.int32), top_p=jnp.ones(3),
+                  do_sample=jnp.ones(3, bool))
+        toks = sample_tokens(logits, **kw)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(jnp.argmax(logits, -1)))
+
+    def test_sample_tokens_penalties_shift_argmax(self):
+        from paddlenlp_tpu.experimental.inference_model import sample_tokens
+
+        logits = jnp.asarray([[2.0, 1.9, 0.0, -1.0]], jnp.float32)
+        counts = jnp.asarray([[3, 0, 0, 0]], jnp.int32)  # token 0 heavily repeated
+        kw = dict(positions=jnp.zeros(1, jnp.int32), seeds=jnp.zeros(1, jnp.int32),
+                  temperature=jnp.ones(1), top_k=jnp.zeros(1, jnp.int32), top_p=jnp.ones(1),
+                  do_sample=jnp.zeros(1, bool), counts=counts,
+                  repetition_penalty=jnp.asarray([2.0]), presence_penalty=jnp.asarray([0.5]),
+                  frequency_penalty=jnp.asarray([0.1]))
+        tok = sample_tokens(logits, **kw)
+        assert int(tok[0]) == 1  # penalized 2.0/2 - 0.5 - 0.3 < 1.9
+
+    def test_engine_repetition_penalty_changes_greedy(self, model):
+        prompt = [5, 6, 5, 6, 5, 6]
+        eng = InferenceEngine(model, max_batch_size=1, block_size=4, num_blocks=64, max_blocks_per_seq=16)
+        plain = eng.generate([prompt], SamplingParams(max_new_tokens=8))
+        eng2 = InferenceEngine(model, max_batch_size=1, block_size=4, num_blocks=64, max_blocks_per_seq=16)
+        pen = eng2.generate([prompt], SamplingParams(max_new_tokens=8, repetition_penalty=5.0,
+                                                     presence_penalty=1.0))
+        assert len(pen[0]) == 8
+        # a strong penalty must perturb the greedy continuation of a looping prompt
+        assert plain[0] != pen[0], (plain, pen)
+        # and the penalized run must not emit the same token twice in a row
+        assert all(a != b for a, b in zip(pen[0], pen[0][1:])), pen[0]
+
+    def test_multistep_single_host_iteration(self, model):
+        """decode_steps=8 finishes an 8-token request in one engine.step()."""
+        eng = InferenceEngine(model, max_batch_size=2, block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, decode_steps=8)
+        eng.add_request([5, 6, 7], SamplingParams(max_new_tokens=8))
+        finished = eng.step()
+        assert len(finished) == 1 and len(finished[0].output_ids) == 8
+        assert not eng.has_work()
+
+
 class TestPreemption:
     def test_preempt_and_recover(self, model):
         """Tiny pool forces preemption; the preempted request must still finish
