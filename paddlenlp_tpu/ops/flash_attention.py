@@ -63,31 +63,34 @@ def dot_product_attention(
     zigzag layout), index order != causal order; pass absolute positions and the
     causal/window mask is built from them instead of array indices.
 
-    ``use_pallas``: opt-in (True runs the Pallas flash kernel; interpret mode
-    off-TPU). NOT auto-enabled: pallas_call is opaque to GSPMD, so inside a
-    sharded jit it would block partitioning — the shard_map-wrapped variant is
-    the round-2 path to turning it on by default.
+    ``use_pallas``: None (default) enables the Pallas flash kernel automatically
+    on TPU for eligible shapes (causal self-attention, optional segment_ids /
+    sliding window, no dropout/padding-mask/cache). Inside a sharded jit the
+    kernel runs under a ``shard_map`` over the batch/head mesh axes so it
+    composes with GSPMD (pallas_call alone is opaque to the partitioner).
+    Pass False to force the XLA path, True to force Pallas (interpret off-TPU).
     """
     B, T, N, H = query.shape
     S = key.shape[1]
+    K = key.shape[2]
     scale = scale if scale is not None else H**-0.5
 
-    plain_causal = (
+    pallas_eligible = (
         causal
         and attention_mask is None
-        and segment_ids is None
-        and window is None
         and positions is None
         and dropout_rate == 0.0
         and T == S  # self-attention, no KV cache
+        and (isinstance(q_offset, int) and q_offset == 0)
+        and N % K == 0
     )
     if use_pallas is None:
-        use_pallas = False  # opt-in; see docstring
-    if use_pallas and plain_causal:
+        use_pallas = jax.default_backend() == "tpu"  # default ON for TPU
+    if use_pallas and pallas_eligible:
         try:
-            from .pallas.flash_attention import flash_attention as pallas_flash
-
-            return pallas_flash(query, key, value, scale, True)
+            out = _pallas_dispatch(query, key, value, segment_ids, scale, window)
+            if out is not None:
+                return out
         except Exception as e:  # pallas unavailable/lowering failure: fall through
             from ..utils.log import logger
 
@@ -120,6 +123,63 @@ def dot_product_attention(
 
             logger.warning_once("jax.nn.dot_product_attention signature mismatch; using math attention")
     return _math_attention(query, key, value, mask, scale, dropout_rate, dropout_rng)
+
+
+def _pallas_dispatch(query, key, value, segment_ids, scale, window):
+    """Run the Pallas kernel directly (off-mesh) or under a shard_map over the
+    batch/head mesh axes (the GSPMD composition the reference gets from fleet's
+    per-rank kernel launches). Returns None when the active sharding cannot be
+    expressed (fall back to the XLA path)."""
+    from jax.sharding import Mesh, PartitionSpec as PS
+
+    from ..parallel.partition import _current_mesh
+    from .pallas.flash_attention import flash_attention as pallas_flash
+
+    B, T, N, H = query.shape
+    K = key.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and not (T % 128 == 0 and H % 64 == 0):
+        # Mosaic tiling gate: compile errors surface at the ENCLOSING jit's
+        # compile, outside our try/except — so unsupported shapes must be
+        # rejected here, not discovered as a crash.
+        return None
+    mesh = _current_mesh()
+    if mesh is None:
+        return pallas_flash(query, key, value, segment_ids, scale, True, window)
+    live = lambda axes: tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+    if not live(("dp", "fsdp", "tp", "sep", "cp")):
+        return pallas_flash(query, key, value, segment_ids, scale, True, window)
+    if not isinstance(mesh, Mesh):
+        return None  # AbstractMesh (AOT/topology): let the XLA path partition
+    if live(("cp",)):  # seq would be sharded; ring/XLA paths own that case
+        return None
+    batch_ax = live(("dp", "fsdp"))
+    head_ax = live(("tp", "sep"))
+    nb, nh = 1, 1
+    for a in batch_ax:
+        nb *= mesh.shape[a]
+    for a in head_ax:
+        nh *= mesh.shape[a]
+    if B % nb or N % nh or K % nh or (N // nh) % max(K // nh, 1):
+        return None
+    qkv_spec = PS(batch_ax or None, None, head_ax or None, None)
+    fn = functools.partial(pallas_flash, scale=scale, causal=True, window=window)
+    if segment_ids is None:
+        return jax.shard_map(
+            lambda q, k, v: fn(q, k, v, None),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(query, key, value)
+    seg_spec = PS(batch_ax or None, None)
+    return jax.shard_map(
+        lambda q, k, v, s: fn(q, k, v, s),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(query, key, value, segment_ids)
 
 
 def _math_attention(query, key, value, mask, scale, dropout_rate=0.0, dropout_rng=None):

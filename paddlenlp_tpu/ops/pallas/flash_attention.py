@@ -1,20 +1,25 @@
-"""Pallas TPU flash attention (causal, GQA-aware).
+"""Pallas TPU flash attention (causal, GQA-aware), forward AND backward.
 
-Counterpart of the reference's attention custom ops (csrc/gpu/append_attention.cu
-and FlashAttention-2 dispatch in llama/fusion_ops.py:147): an O(T) -memory fused
-attention kernel tiled for the MXU, written in Pallas.
+Counterpart of the reference's attention custom ops (csrc/gpu/append_attention.cu,
+FlashAttention-2 dispatch in llama/fusion_ops.py:147, flash_attn_bwd.cc) and of
+FlashMask packed-batch semantics (fusion_ops.py:223-238) via ``segment_ids``:
+an O(T)-memory fused attention kernel family tiled for the MXU.
 
 Structure (classic flash-attention-2 schedule):
-- grid = (batch*heads, T/block_q, S/block_kv); the kv axis is innermost and
-  sequential ("arbitrary"), carrying VMEM scratch accumulators (m, l, acc);
-- fully-future blocks are skipped under causal masking (@pl.when);
+- forward: grid = (batch*heads, T/block_q, S/block_kv); the kv axis is innermost
+  and sequential ("arbitrary"), carrying VMEM scratch accumulators (m, l, acc);
+  emits the per-row logsumexp L = m + log(l) as a residual for the backward;
+- fully-invisible blocks are skipped under causal/window masking (@pl.when);
 - GQA maps query-head blocks onto shared kv heads in the BlockSpec index maps —
   no materialized repeat;
-- backward: custom_vjp recomputes through the XLA math-attention path (a Pallas
-  bwd kernel is the planned follow-up); forward-only consumers (inference)
-  never pay for it.
+- backward: two kernels re-streaming K/V — dq (kv innermost) and dk/dv
+  (q innermost), with p recomputed from the saved logsumexp and
+  delta = rowsum(dO*O) precomputed by XLA. dk/dv are produced per QUERY head and
+  group-summed outside the kernel (simple, race-free GQA handling);
+- ``segment_ids`` restricts attention to same-segment tokens (ZeroPadding packed
+  batches); ``window`` adds the mistral sliding-window lower bound.
 
-Off-TPU (tests), the kernel runs in Pallas interpret mode.
+Off-TPU (tests), the kernels run in Pallas interpret mode.
 """
 
 from __future__ import annotations
@@ -24,6 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -32,8 +38,40 @@ __all__ = ["flash_attention"]
 NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *, scale, block_q, block_kv,
-               causal, kv_len):
+def _visible(s_shape, q_start, k_start, causal, window, q_len, kv_len, seg_q, seg_k):
+    """Element-level visibility mask for one [block_q, block_kv] tile."""
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
+    valid = (cols < kv_len) & (rows < q_len)
+    if causal:
+        valid &= cols <= rows
+    if window is not None:
+        valid &= cols > rows - window
+    if seg_q is not None:
+        valid &= seg_q[:, None] == seg_k[None, :]
+    return valid
+
+
+def _zero_oob(x, start, limit):
+    """Zero rows past ``limit`` (Pallas pads partial edge blocks with garbage —
+    even p=0 coefficients turn garbage into NaN via 0*NaN)."""
+    idx = start + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(idx < limit, x, 0.0)
+
+
+def _block_runs(q_start, k_start, block_q, block_kv, causal, window):
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+    if window is not None:
+        run = jnp.logical_and(run, k_start + block_kv - 1 > q_start - window) if causal else run
+    return run
+
+
+# ---------------------------------------------------------------- forward
+def _fa_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
+               m_scratch, l_scratch, acc_scratch, *,
+               scale, block_q, block_kv, causal, window, q_len, kv_len, use_segments):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -46,66 +84,79 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
 
     q_start = qi * block_q
     k_start = ki * block_kv
-
-    run = True
-    if causal:
-        run = k_start <= q_start + block_q - 1  # any col in this kv block can be visible
+    run = _block_runs(q_start, k_start, block_q, block_kv, causal, window)
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [block_q, H]
-        k = k_ref[0].astype(jnp.float32)  # [block_kv, H]
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [block_q, block_kv]
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = cols < kv_len  # mask block padding when S % block_kv != 0
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            valid = valid & (cols <= rows)
+        q = _zero_oob(q_ref[0].astype(jnp.float32), q_start, q_len)
+        k = _zero_oob(k_ref[0].astype(jnp.float32), k_start, kv_len)
+        v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+        seg_q = sq_ref[0] if use_segments else None
+        seg_k = sk_ref[0] if use_segments else None
+        valid = _visible(s.shape, q_start, k_start, causal, window, q_len, kv_len, seg_q, seg_k)
         s = jnp.where(valid, s, NEG_INF)
-        m_prev = m_scratch[...]  # [block_q, 1]
+        m_prev = m_scratch[...]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)  # exp(NEG-NEG)=1 on fully-masked rows
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scratch[...] + jnp.sum(p, axis=-1, keepdims=True)
-        # zero padded V rows: p is 0 there, but 0 * garbage (block padding) = NaN
-        v_row_valid = (k_start + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)) < kv_len
-        v = jnp.where(v_row_valid, v, 0.0)
         acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot(p, v)
         m_scratch[...] = m_new
         l_scratch[...] = l_new
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        o_ref[0] = (acc_scratch[...] / jnp.maximum(l_scratch[...], 1e-37)).astype(o_ref.dtype)
+        l = jnp.maximum(l_scratch[...], 1e-37)
+        o_ref[0] = (acc_scratch[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scratch[...] + jnp.log(l))[:, 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
+def _fold(x):  # [B, T, N, H] -> [B*N, T, H]
+    B, T, N, H = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * N, T, H)
+
+
+def _flash_fwd(q, k, v, segments, scale, causal, window, block_q, block_kv, interpret):
     B, T, N, H = q.shape
+    if causal and T != k.shape[1]:
+        raise ValueError(
+            f"causal flash_attention requires T == S (got T={T}, S={k.shape[1]}); "
+            "cross-length causal (KV cache) goes through the XLA dispatcher path"
+        )
     S, K = k.shape[1], k.shape[2]
     group = N // K
-    # fold (batch, heads): q' [B*N, T, H]; k'/v' [B*K, S, H]
-    qf = q.transpose(0, 2, 1, 3).reshape(B * N, T, H)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * K, S, H)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * K, S, H)
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    use_seg = segments is not None
+    seg = segments if use_seg else jnp.zeros((B, T), jnp.int32)
     block_q = min(block_q, T)
     block_kv = min(block_kv, S)
     grid = (B * N, pl.cdiv(T, block_q), pl.cdiv(S, block_kv))
 
     kernel = functools.partial(
-        _fa_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal, kv_len=S
+        _fa_kernel, scale=scale, block_q=block_q, block_kv=block_kv,
+        causal=causal, window=window, q_len=T, kv_len=S, use_segments=use_seg,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
             pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g=group: (bn // g, ki, 0)),
             pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g=group: (bn // g, ki, 0)),
+            pl.BlockSpec((1, block_q), lambda bn, qi, ki, n=N: (bn // n, qi)),
+            pl.BlockSpec((1, block_kv), lambda bn, qi, ki, n=N: (bn // n, ki)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bn, qi, ki: (bn, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
+            jax.ShapeDtypeStruct((B * N, T), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # m
             pltpu.VMEM((block_q, 1), jnp.float32),  # l
@@ -113,51 +164,199 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
         ],
         compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, N, T, H).transpose(0, 2, 1, 3)
+    )(qf, kf, vf, seg, seg)
+    return out.reshape(B, N, T, H).transpose(0, 2, 1, 3), lse
 
 
-def _math_reference(q, k, v, scale, causal):
-    from ..flash_attention import _math_attention, make_causal_mask
+# ---------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+                   dq_ref, dq_scratch, *,
+                   scale, block_q, block_kv, causal, window, q_len, kv_len, use_segments):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    B, T = q.shape[:2]
-    S = k.shape[1]
-    mask = jnp.broadcast_to(make_causal_mask(T, S), (B, 1, T, S)) if causal else None
-    return _math_attention(q, k, v, mask, scale)
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_kv
+    run = _block_runs(q_start, k_start, block_q, block_kv, causal, window)
+
+    @pl.when(run)
+    def _compute():
+        q = _zero_oob(q_ref[0].astype(jnp.float32), q_start, q_len)
+        k = _zero_oob(k_ref[0].astype(jnp.float32), k_start, kv_len)
+        v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
+        do = _zero_oob(do_ref[0].astype(jnp.float32), q_start, q_len)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+        seg_q = sq_ref[0] if use_segments else None
+        seg_k = sk_ref[0] if use_segments else None
+        valid = _visible(s.shape, q_start, k_start, causal, window, q_len, kv_len, seg_q, seg_k)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [bq, bkv]
+        ds = p * (dp - delta) * scale
+        dq_scratch[...] += jax.lax.dot(ds, k)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[...].astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
+                    dk_ref, dv_ref, dk_scratch, dv_scratch, *,
+                    scale, block_q, block_kv, causal, window, q_len, kv_len, use_segments):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_kv
+    run = _block_runs(q_start, k_start, block_q, block_kv, causal, window)
+
+    @pl.when(run)
+    def _compute():
+        q = _zero_oob(q_ref[0].astype(jnp.float32), q_start, q_len)
+        k = _zero_oob(k_ref[0].astype(jnp.float32), k_start, kv_len)
+        v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
+        do = _zero_oob(do_ref[0].astype(jnp.float32), q_start, q_len)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+        seg_q = sq_ref[0] if use_segments else None
+        seg_k = sk_ref[0] if use_segments else None
+        valid = _visible(s.shape, q_start, k_start, causal, window, q_len, kv_len, seg_q, seg_k)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # [bq, bkv]
+        dv_scratch[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))  # p^T @ do
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * scale
+        dk_scratch[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))  # ds^T @ q
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, block_kv, interpret):
+    B, T, N, H = q.shape
+    S, K = k.shape[1], k.shape[2]
+    group = N // K
+    qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(g)
+    of = _fold(out)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)  # [B*N, T]
+    use_seg = segments is not None
+    seg = segments if use_seg else jnp.zeros((B, T), jnp.int32)
+    block_q = min(block_q, T)
+    block_kv = min(block_kv, S)
+    n_q, n_k = pl.cdiv(T, block_q), pl.cdiv(S, block_kv)
+
+    common = dict(scale=scale, block_q=block_q, block_kv=block_kv, causal=causal,
+                  window=window, q_len=T, kv_len=S, use_segments=use_seg)
+    params = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * N, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g_=group: (bn // g_, ki, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g_=group: (bn // g_, ki, 0)),
+            pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bn, qi, ki: (bn, qi)),
+            pl.BlockSpec((1, block_q), lambda bn, qi, ki: (bn, qi)),
+            pl.BlockSpec((1, block_q), lambda bn, qi, ki, n=N: (bn // n, qi)),
+            pl.BlockSpec((1, block_kv), lambda bn, qi, ki, n=N: (bn // n, ki)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, H), jnp.float32)],
+        compiler_params=params,
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta, seg, seg)
+
+    dk_p, dv_p = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B * N, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, H), lambda bn, ki, qi: (bn, qi, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi, g_=group: (bn // g_, ki, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi, g_=group: (bn // g_, ki, 0)),
+            pl.BlockSpec((1, block_q, H), lambda bn, ki, qi: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bn, ki, qi: (bn, qi)),
+            pl.BlockSpec((1, block_q), lambda bn, ki, qi: (bn, qi)),
+            pl.BlockSpec((1, block_q), lambda bn, ki, qi, n=N: (bn // n, qi)),
+            pl.BlockSpec((1, block_kv), lambda bn, ki, qi, n=N: (bn // n, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi: (bn, ki, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi: (bn, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, S, H), jnp.float32),
+            jax.ShapeDtypeStruct((B * N, S, H), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, H), jnp.float32),
+            pltpu.VMEM((block_kv, H), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta, seg, seg)
+
+    dq = dq.reshape(B, N, T, H).transpose(0, 2, 1, 3)
+    # per-query-head dk/dv -> group-sum onto the K kv heads
+    dk = dk_p.reshape(B, K, group, S, H).sum(axis=2).transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv_p.reshape(B, K, group, S, H).sum(axis=2).transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public api
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def flash_attention(
     q: jnp.ndarray,  # [B, T, N, H]
     k: jnp.ndarray,  # [B, S, K, H]
     v: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, T] packed-batch segments
     scale: Optional[float] = None,
     causal: bool = True,
+    window: Optional[int] = None,
     block_q: int = 128,
     block_kv: int = 128,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if causal and q.shape[1] != k.shape[1]:
-        raise ValueError(
-            f"causal flash_attention requires T == S (got T={q.shape[1]}, S={k.shape[1]}); "
-            "cross-length causal (KV cache) goes through the XLA dispatcher path"
-        )
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret)
+    out, _ = _flash_fwd(q, k, v, segment_ids, scale, causal, window, block_q, block_kv, interpret)
+    return out
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
-    out = flash_attention(q, k, v, scale, causal, block_q, block_kv, interpret)
-    return out, (q, k, v)
-
-
-def _bwd(scale, causal, block_q, block_kv, interpret, residuals, g):
-    q, k, v = residuals
+def _fwd(q, k, v, segment_ids, scale, causal, window, block_q, block_kv, interpret):
     scale_v = scale if scale is not None else q.shape[-1] ** -0.5
-    _, vjp = jax.vjp(lambda q, k, v: _math_reference(q, k, v, scale_v, causal), q, k, v)
-    return vjp(g)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    out, lse = _flash_fwd(q, k, v, segment_ids, scale_v, causal, window, block_q, block_kv, interpret)
+    return out, (q, k, v, segment_ids, out, lse)
+
+
+def _bwd(scale, causal, window, block_q, block_kv, interpret, residuals, g):
+    q, k, v, segment_ids, out, lse = residuals
+    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    dq, dk, dv = _flash_bwd(q, k, v, segment_ids, out, lse, g,
+                            scale_v, causal, window, block_q, block_kv, interpret)
+    dseg = None if segment_ids is None else np.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 flash_attention.defvjp(_fwd, _bwd)
