@@ -93,7 +93,7 @@ class PagedInferenceModel:
     (llama/qwen2/mistral: config-driven biases + GQA + rope)."""
 
     def __init__(self, model, block_size: int = 16, num_blocks: int = 512, max_blocks_per_seq: int = 64,
-                 dtype=jnp.bfloat16, decode_steps: int = 8, eos_ids=()):
+                 dtype=jnp.bfloat16, decode_steps: int = 8, eos_ids=(), use_paged_kernel=None):
         self.model = model
         self.config = model.config
         if "layers" not in model.params.get("model", {}):
@@ -103,6 +103,16 @@ class PagedInferenceModel:
         self.num_blocks = num_blocks
         self.max_blocks_per_seq = max_blocks_per_seq
         self.decode_steps = decode_steps
+        # Pallas paged decode kernel: default-on for TPU when the tile shapes
+        # are Mosaic-safe (compile errors would surface at the enclosing jit's
+        # compile, uncatchable here); the XLA gather path stays the fallback.
+        if use_paged_kernel is None:
+            use_paged_kernel = (
+                jax.default_backend() == "tpu"
+                and self.config.head_dim % 64 == 0
+                and block_size % 8 == 0
+            )
+        self.use_paged_kernel = use_paged_kernel
         # [-1] sentinel when no eos: never matches a sampled id
         self.eos_arr = jnp.asarray(sorted(eos_ids) or [-1], jnp.int32)
         cfg = self.config
@@ -161,8 +171,17 @@ class PagedInferenceModel:
             range(B),
             pool_layer,
         )
-        k_all, v_all = gather_kv(pool_layer, block_tables)
-        attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
+        if T == 1 and self.use_paged_kernel:
+            # fused block-table walk + attend: the Pallas decode kernel streams
+            # addressed KV blocks instead of materializing the gathered cache
+            from ..ops.pallas.paged_attention import paged_decode_attention
+
+            attn_out = paged_decode_attention(
+                q[:, 0], pool_layer[0], pool_layer[1], block_tables, q_positions[:, 0],
+            )[:, None]
+        else:
+            k_all, v_all = gather_kv(pool_layer, block_tables)
+            attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
         attn_out = attn_out.reshape(B, T, self.n_heads * self.head_dim)
         o = attn_out @ attn["o_proj"]["kernel"].astype(self.dtype)
         if "bias" in attn["o_proj"]:

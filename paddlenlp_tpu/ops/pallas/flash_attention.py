@@ -126,6 +126,8 @@ def _flash_fwd(q, k, v, segments, scale, causal, window, block_q, block_kv, inte
             f"causal flash_attention requires T == S (got T={T}, S={k.shape[1]}); "
             "cross-length causal (KV cache) goes through the XLA dispatcher path"
         )
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal=True (matches the XLA dispatcher)")
     S, K = k.shape[1], k.shape[2]
     group = N // K
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
@@ -191,7 +193,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_r
         v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
         do = _zero_oob(do_ref[0].astype(jnp.float32), q_start, q_len)
         lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        # delta rows past q_len are Pallas edge-block garbage; p=0 there cannot
+        # save ds (0 * NaN = NaN), and dkv's column reduction would spread it
+        row_idx = q_start + jax.lax.iota(jnp.int32, block_q)
+        delta = jnp.where(row_idx < q_len, delta_ref[0], 0.0)[:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         seg_q = sq_ref[0] if use_segments else None
         seg_k = sk_ref[0] if use_segments else None
@@ -229,7 +234,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_
         v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
         do = _zero_oob(do_ref[0].astype(jnp.float32), q_start, q_len)
         lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        # delta rows past q_len are Pallas edge-block garbage; p=0 there cannot
+        # save ds (0 * NaN = NaN), and dkv's column reduction would spread it
+        row_idx = q_start + jax.lax.iota(jnp.int32, block_q)
+        delta = jnp.where(row_idx < q_len, delta_ref[0], 0.0)[:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         seg_q = sq_ref[0] if use_segments else None
         seg_k = sk_ref[0] if use_segments else None

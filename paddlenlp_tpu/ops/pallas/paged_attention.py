@@ -1,0 +1,115 @@
+"""Pallas TPU paged-attention decode kernel.
+
+Counterpart of the reference's block-attention decode op
+(``csrc/gpu/append_attention.cu:801`` + ``csrc/gpu/append_attn/*.cuh``): one
+fused kernel walks each sequence's block table, streams the addressed KV blocks
+HBM->VMEM, and runs the online-softmax attention — no [B, max_blocks*bs, K, H]
+gathered copy of the cache ever materializes (the XLA fallback's cost).
+
+Design:
+- grid = (B, K, max_blocks); the block axis is innermost and sequential,
+  carrying (m, l, acc) VMEM scratch per (group, H) query tile;
+- the block table and per-sequence context lengths ride scalar prefetch
+  (``pltpu.PrefetchScalarGridSpec``): the KV BlockSpec index map reads
+  ``tables[b, j]`` to aim the DMA at the right pool block — the table gather
+  IS the address computation, exactly like the CUDA kernel's block walk;
+- blocks past the context length are skipped (@pl.when), tail slots inside the
+  last block are masked;
+- GQA: queries fold to [B, K, group, H]; each grid cell attends its kv head's
+  whole query group.
+
+Off-TPU (tests), the kernel runs in Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_decode_attention"]
+
+NEG_INF = -1e30
+
+
+def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
+            m_s, l_s, acc_s, *, bs, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    ctx = ctx_ref[b]
+
+    @pl.when(j * bs <= ctx)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [group, H]
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [bs, H]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [group, bs]
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = pos <= ctx
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_s[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_s[...] = alpha * l_s[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jax.lax.dot(p, v)
+        m_s[...] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_s[...] / jnp.maximum(l_s[...], 1e-37)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, N, H] one query token per sequence
+    pool_k: jnp.ndarray,  # [num_blocks, bs, K, H]
+    pool_v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] int32 (position of the current token)
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    B, N, H = q.shape
+    nb, bs, K, _ = pool_k.shape
+    group = N // K
+    max_blocks = block_tables.shape[1]
+    scale = scale if scale is not None else H**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+
+    qf = q.reshape(B, K, group, H)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
+            pl.BlockSpec((1, bs, 1, H), lambda b, kh, j, t, c: (t[b, j], 0, kh, 0)),
+            pl.BlockSpec((1, bs, 1, H), lambda b, kh, j, t, c: (t[b, j], 0, kh, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),  # m
+            pltpu.VMEM((group, 1), jnp.float32),  # l
+            pltpu.VMEM((group, H), jnp.float32),  # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, bs=bs, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K, group, H), q.dtype),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), qf, pool_k, pool_v)
+    return out.reshape(B, N, H)
