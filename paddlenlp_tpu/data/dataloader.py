@@ -29,7 +29,21 @@ class DistributedBatchSampler:
         drop_last: bool = True,
         seed: int = 0,
         consumed_samples: int = 0,
+        num_shards: int = 1,
+        shard_id: int = 0,
+        shard_span: int = 1,
     ):
+        """``batch_size`` is the GLOBAL batch, split into ``num_shards`` row
+        groups (the mesh's data-shard groups, dp x fsdp); this sampler yields the
+        contiguous slice covering groups ``[shard_id, shard_id + shard_span)`` —
+        the multihost replacement for the reference's broadcast dataloader
+        (dist_dataloader.py:41): every process loads exactly the rows its
+        addressable devices will hold (identical rows on processes that share a
+        data shard, e.g. tp spanning hosts). A final partial batch is padded by
+        wrapping to the epoch start (reference DistributedBatchSampler
+        complete-the-batch semantics) so every shard stays consistent."""
+        if batch_size % max(num_shards, 1) != 0:
+            raise ValueError(f"global batch {batch_size} not divisible by {num_shards} data shards")
         self.dataset_len = dataset_len
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -37,6 +51,9 @@ class DistributedBatchSampler:
         self.seed = seed
         self.epoch = 0
         self.consumed_samples = consumed_samples
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.shard_span = shard_span
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -59,8 +76,17 @@ class DistributedBatchSampler:
         order = order[start:]
         n = len(order)
         end = n - n % self.batch_size if self.drop_last else n
+        local = self.batch_size // self.num_shards
         for i in range(0, end, self.batch_size):
-            yield order[i : i + self.batch_size].tolist()
+            batch = order[i : i + self.batch_size]
+            if len(batch) < self.batch_size and self.num_shards > 1:
+                # pad the final partial batch by wrapping so every shard slices
+                # a consistent full-size batch (duplicates, not drops)
+                pad = np.resize(order, self.batch_size - len(batch))
+                batch = np.concatenate([batch, pad])
+            if self.num_shards > 1:
+                batch = batch[self.shard_id * local : (self.shard_id + self.shard_span) * local]
+            yield batch.tolist()
 
 
 class DataLoader:
@@ -75,13 +101,22 @@ class DataLoader:
         drop_last: bool = False,
         seed: int = 0,
         sampler: Optional[DistributedBatchSampler] = None,
+        num_shards: int = 1,
+        shard_id: int = 0,
+        shard_span: int = 1,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or _stack_collate
         if sampler is None and _has_len(dataset):
             sampler = DistributedBatchSampler(
-                len(dataset), batch_size, shuffle=shuffle, drop_last=drop_last, seed=seed
+                len(dataset), batch_size, shuffle=shuffle, drop_last=drop_last, seed=seed,
+                num_shards=num_shards, shard_id=shard_id, shard_span=shard_span,
+            )
+        elif sampler is None and num_shards > 1:
+            raise ValueError(
+                "iterable (length-less) datasets are not shardable across processes; "
+                "pre-shard the stream per host or use a map-style dataset"
             )
         self.batch_sampler = sampler
 

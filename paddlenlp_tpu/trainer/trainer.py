@@ -355,29 +355,55 @@ class Trainer:
         return jax.jit(eval_step)
 
     # ------------------------------------------------------------------ data
+    def _data_shard_geometry(self):
+        """(num_groups, first_group, span): which of the D = dp x fsdp data-shard
+        row groups THIS process's addressable devices cover. Devices are
+        data-shard-major in the mesh axis order, so a process owns a contiguous
+        group range; processes sharing one group (tp/pp spanning hosts) feed
+        identical rows — the single-controller equivalent of the reference's
+        broadcast over mp/pp groups (dist_dataloader.py:135-205)."""
+        D = self.args.dataset_world_size
+        if jax.process_count() <= 1:
+            return 1, 0, 1
+        W = jax.device_count()
+        C = jax.local_device_count()
+        rep = max(W // D, 1)  # devices per data-shard group
+        p = jax.process_index()
+        g0 = (p * C) // rep
+        g1 = (p * C + C - 1) // rep
+        return D, g0, g1 - g0 + 1
+
     def get_train_dataloader(self):
         from ..data.dataloader import DataLoader
 
         args = self.args
+        num_shards, shard_id, span = self._data_shard_geometry()
         return DataLoader(
             self.train_dataset,
-            batch_size=args.per_device_train_batch_size * args.gradient_accumulation_steps * args.dataset_world_size,
+            batch_size=args.global_train_batch_size,
             collate_fn=self.data_collator,
             shuffle=True,
             drop_last=args.dataloader_drop_last,
             seed=args.data_seed,
+            num_shards=num_shards,
+            shard_id=shard_id,
+            shard_span=span,
         )
 
     def get_eval_dataloader(self, eval_dataset=None):
         from ..data.dataloader import DataLoader
 
         dataset = eval_dataset if eval_dataset is not None else self.eval_dataset
+        num_shards, shard_id, span = self._data_shard_geometry()
         return DataLoader(
             dataset,
             batch_size=self.args.per_device_eval_batch_size * self.args.dataset_world_size,
             collate_fn=self.data_collator,
             shuffle=False,
-            drop_last=False,
+            drop_last=False,  # final partial batch wraps (pad-by-duplicate) on multihost
+            num_shards=num_shards,
+            shard_id=shard_id,
+            shard_span=span,
         )
 
     def _device_put_batch(self, batch: Dict[str, np.ndarray], accum: int, micro_axis: bool = False):
@@ -419,6 +445,8 @@ class Trainer:
             if "inputs_embeds" in batch:
                 batch["inputs_embeds"] = np.asarray(batch["inputs_embeds"])[:, order]
 
+        multihost = jax.process_count() > 1
+
         def put(x):
             x = np.asarray(x)
             if accum > 1 or micro_axis:
@@ -426,6 +454,13 @@ class Trainer:
                 spec = P(None, ("dp", "fsdp"))
             else:
                 spec = P(("dp", "fsdp"))
+            if multihost:
+                # each process holds only its shard of the global batch; assemble
+                # the global array from per-process rows (reference solves this
+                # with the broadcast dataloader, dist_dataloader.py:41)
+                from ..parallel.launch import local_batch_to_global
+
+                return local_batch_to_global(x, self.mesh, spec)
             return jax.device_put(x, NamedSharding(self.mesh, spec))
 
         return {k: put(v) for k, v in batch.items()}
@@ -636,6 +671,13 @@ class Trainer:
         start = time.time()
         losses, n_batches = [], 0
         all_logits, all_labels = [], []
+        run_metrics = self.compute_metrics is not None
+        if jax.process_count() > 1 and run_metrics:
+            logger.warning_once(
+                "multihost evaluate(): logits are device-sharded across processes; "
+                "running loss-only eval (compute_metrics skipped)"
+            )
+            run_metrics = False
         with use_mesh(self.mesh):
             for host_batch in dataloader:
                 host_batch, n_pad = self._pad_batch_to_shards(host_batch)
@@ -643,7 +685,7 @@ class Trainer:
                 out = self._eval_step_fn(params, batch)
                 if "loss" in out:
                     losses.append(float(out["loss"]))
-                if self.compute_metrics is not None:
+                if run_metrics:
                     logits = self._maybe_unsplit_seq(out["logits"])  # BEFORE any positional preprocessing
                     if self.preprocess_logits_for_metrics is not None:
                         logits = self.preprocess_logits_for_metrics(logits, host_batch.get("labels"))
@@ -660,7 +702,7 @@ class Trainer:
                 metrics[f"{metric_key_prefix}_ppl"] = float(np.exp(np.mean(losses)))
             except OverflowError:
                 pass
-        if self.compute_metrics is not None and all_logits:
+        if run_metrics and all_logits:
             from .trainer_utils import EvalPrediction
 
             preds = np.concatenate(all_logits, axis=0)
@@ -678,6 +720,12 @@ class Trainer:
     def predict(self, test_dataset, ignore_keys=None, metric_key_prefix: str = "test"):
         from .trainer_utils import PredictionOutput
 
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "Trainer.predict gathers full logits, which span non-addressable "
+                "devices on multihost; run predict on a single host (or use "
+                "evaluate(), which is loss-only on multihost)"
+            )
         dataloader = self.get_eval_dataloader(test_dataset)
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()

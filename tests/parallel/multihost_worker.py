@@ -1,0 +1,77 @@
+"""Worker process for the 2-process multihost trainer test.
+
+Launched by tests/parallel/test_multihost.py with PDNLP_* env vars (the launch
+contract of parallel/launch.py); each process owns 4 virtual CPU devices, the
+global mesh is dp2 x fsdp2 x tp2 over 8 devices. Process 0 writes its per-step
+losses to the path in PDNLP_TEST_OUT.
+
+Counterpart of the reference's local-subprocess cluster simulator
+(tests/parallel_launch.py:171 TestMultipleGpus / run_n2c4). Import-safe: all
+jax/distributed setup happens only under __main__ (the test imports
+``make_dataset`` from this module).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def make_dataset(n=64, seq=16):
+    rng = np.random.default_rng(7)
+    rows = [rng.integers(0, 128, size=seq).astype(np.int32) for _ in range(n)]
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"input_ids": rows[i], "labels": rows[i].copy()}
+
+    return DS()
+
+
+def main():
+    import jax
+
+    from paddlenlp_tpu.trainer import Trainer, TrainingArguments
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+    )
+    model = LlamaForCausalLM.from_config(cfg, seed=0)
+    args = TrainingArguments(
+        output_dir=os.environ.get("PDNLP_TEST_DIR", "/tmp/mh_out"),
+        max_steps=3, per_device_train_batch_size=2, gradient_accumulation_steps=2,
+        learning_rate=1e-3, logging_steps=1, save_strategy="no",
+        tensor_parallel_degree=2, sharding="stage3", sharding_parallel_degree=2,
+        seed=0, data_seed=11,
+    )
+    trainer = Trainer(model=model, args=args, train_dataset=make_dataset())
+    trainer.train()
+    losses = [h["loss"] for h in trainer.state.log_history if "loss" in h]
+    if jax.process_index() == 0:
+        with open(os.environ["PDNLP_TEST_OUT"], "w") as f:
+            json.dump(losses, f)
+    print(f"worker {jax.process_index()} done: {losses}")
+
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    from paddlenlp_tpu.parallel.launch import init_distributed
+
+    assert init_distributed(), "multihost init failed"
+    main()

@@ -1,0 +1,78 @@
+"""2-process multihost training: spawn two jax.distributed CPU processes (4
+virtual devices each -> one 8-device global mesh) and require loss parity with
+the same config run single-process on 8 devices.
+
+Counterpart of the reference's subprocess cluster simulator
+(tests/parallel_launch.py:171, run_n2c4 two-simulated-nodes mode) +
+test_unified_checkpoint's loss checks."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+WORKER = os.path.join(REPO, "tests", "parallel", "multihost_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_loss_parity(tmp_path, eight_devices):
+    port = _free_port()
+    out_file = str(tmp_path / "losses.json")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            PDNLP_COORDINATOR=f"127.0.0.1:{port}",
+            PDNLP_NUM_PROCESSES="2",
+            PDNLP_PROCESS_ID=str(pid),
+            PDNLP_TEST_OUT=out_file,
+            PDNLP_TEST_DIR=str(tmp_path / f"w{pid}"),
+        )
+        procs.append(subprocess.Popen([sys.executable, WORKER], env=env,
+                                      stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    multi = json.load(open(out_file))
+
+    # single-process reference on the IN-PROCESS 8-device mesh, same config/data
+    from paddlenlp_tpu.trainer import Trainer, TrainingArguments
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+    from tests.parallel.multihost_worker import make_dataset
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+    )
+    model = LlamaForCausalLM.from_config(cfg, seed=0)
+    args = TrainingArguments(
+        output_dir=str(tmp_path / "single"), max_steps=3, per_device_train_batch_size=2,
+        gradient_accumulation_steps=2, learning_rate=1e-3, logging_steps=1, save_strategy="no",
+        tensor_parallel_degree=2, sharding="stage3", sharding_parallel_degree=2,
+        seed=0, data_seed=11,
+    )
+    trainer = Trainer(model=model, args=args, train_dataset=make_dataset())
+    trainer.train()
+    single = [h["loss"] for h in trainer.state.log_history if "loss" in h]
+    assert len(multi) == len(single) == 3
+    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-4)
