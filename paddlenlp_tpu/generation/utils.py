@@ -93,8 +93,9 @@ class GenerationMixin:
         logits_processors: Optional[LogitsProcessorList] = None,
         **kwargs,
     ):
-        """Returns (sequences, None): generated ids ([B, new_tokens] when
-        ``trunc_input``, reference behavior), scores reserved for beam search."""
+        """Returns (sequences, scores): generated ids ([B, new_tokens] when
+        ``trunc_input``, reference behavior); scores are the length-penalized
+        best-beam log-probs for beam search, None for greedy/sampling."""
         if generation_config is not None:
             kwargs = {**generation_config.to_dict(), **kwargs}
         g = self._resolve_generation_config(kwargs)
@@ -123,6 +124,30 @@ class GenerationMixin:
         eos_ids = tuple(g.eos_token_id) if isinstance(g.eos_token_id, (list, tuple)) else (
             (g.eos_token_id,) if g.eos_token_id is not None else ()
         )
+        use_beams = (g.num_beams or 1) > 1 or g.decode_strategy in ("beam_search", "group_beam_search")
+        if use_beams:
+            num_groups = g.num_beam_groups if g.decode_strategy == "group_beam_search" or g.num_beam_groups > 1 else 1
+            beam_decode = self._get_beam_decode_fn(
+                max_length=max_length,
+                prompt_len=T0,
+                pad_id=int(g.pad_token_id),
+                eos_ids=eos_ids,
+                num_beams=max(g.num_beams, num_groups),
+                num_groups=num_groups,
+                length_penalty=float(g.length_penalty if g.length_penalty is not None else 1.0),
+                diversity_penalty=float(getattr(g, "diversity_penalty", 0.0) or 0.0),
+                procs=procs,
+            )
+            if streamer is not None:
+                streamer.put(np.asarray(input_ids))
+            ids_buf, best_scores = beam_decode(params, input_ids, attention_mask)
+            if streamer is not None:
+                for t in range(T0, max_length):
+                    streamer.put(np.asarray(ids_buf[:, t]))
+                streamer.end()
+            if g.trunc_input:
+                return ids_buf[:, T0:], best_scores
+            return ids_buf, best_scores
         decode = self._get_decode_fn(
             max_length=max_length,
             prompt_len=T0,
@@ -146,6 +171,157 @@ class GenerationMixin:
         return ids_buf, None
 
     # ------------------------------------------------------------------
+    def _get_beam_decode_fn(self, *, max_length, prompt_len, pad_id, eos_ids, num_beams,
+                            num_groups, length_penalty, diversity_penalty, procs):
+        """Beam / group-beam search as ONE ``lax.while_loop`` over flat beam state
+        (reference ``generation/utils.py:1496`` beam_search, ``:1663``
+        group_beam_search — there a Python loop over a BeamHypotheses object;
+        here the hypotheses ARE the carry: [B*K] token buffers + per-beam
+        scores/finished/lengths, with the KV cache gather-reordered in place).
+
+        Finished beams are frozen by construction: their only candidate
+        continuation is ``pad`` at unchanged score, so selection keeps them
+        exactly when they remain top-K. Diverse groups subtract
+        ``diversity_penalty`` times the count of tokens already chosen by
+        earlier groups at the same step (Hamming diversity)."""
+        def _sig(ps):
+            return tuple((type(p).__name__, tuple(sorted(p.__dict__.items()))) for p in ps)
+
+        cache_key = ("beams", max_length, prompt_len, pad_id, eos_ids, num_beams, num_groups,
+                     length_penalty, diversity_penalty, _sig(procs))
+        cache = getattr(self, "_decode_cache", None)
+        if cache is None:
+            cache = self._decode_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        module = self.module
+        config = self.config
+        K, G = num_beams, num_groups
+        if K % G != 0:
+            raise ValueError(f"num_beams {K} must be divisible by num_beam_groups {G}")
+        gk = K // G
+        NEG = -1.0e9
+
+        def decode(params, input_ids, attention_mask):
+            from ..transformers.cache_utils import init_cache
+
+            B, T0 = input_ids.shape
+            BK = B * K
+            rep = lambda x: jnp.repeat(x, K, axis=0)  # [B, ...] -> [B*K, ...]
+            ids_buf = jnp.full((BK, max_length), pad_id, jnp.int32)
+            ids_buf = jax.lax.dynamic_update_slice(ids_buf, rep(input_ids), (0, 0))
+            pad_mask = jnp.concatenate(
+                [rep(attention_mask), jnp.ones((BK, max_length - T0), jnp.int32)], axis=1
+            )
+            kv = init_cache(config, BK, max_length,
+                            dtype=jnp.bfloat16 if module.dtype == jnp.bfloat16 else jnp.float32)
+            prompt_pos = jnp.clip(jnp.cumsum(rep(attention_mask), axis=1) - 1, 0)
+            out = module.apply({"params": params}, input_ids=rep(input_ids),
+                               attention_mask=pad_mask, position_ids=prompt_pos,
+                               cache=kv, deterministic=True)
+            kv = out.past_key_values
+            logits = out.logits[:, -1].astype(jnp.float32)  # [BK, V]
+            V = logits.shape[-1]
+
+            # beam 0 of each group starts live; the rest at -inf (identical prompts)
+            init_scores = jnp.full((B, K), NEG, jnp.float32)
+            init_scores = init_scores.at[:, ::gk].set(0.0) if G > 1 else init_scores.at[:, 0].set(0.0)
+            finished = jnp.zeros((B, K), jnp.bool_)
+            lengths = jnp.zeros((B, K), jnp.int32)  # generated-token counts
+
+            eos_arr = jnp.asarray(list(eos_ids) or [-1], jnp.int32)
+
+            def select(logits, scores, finished, lengths, cur_len, ids_buf):
+                """One beam-selection step over all groups; returns reorder index
+                [B, K] (global beam row per batch), next tokens, new state."""
+                proc_ids = jnp.where(pad_mask > 0, ids_buf, V)
+                logits = procs(proc_ids, logits, cur_len)
+                logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+                new_beam, new_tok, new_scores, new_fin, new_len = [], [], [], [], []
+                counts = jnp.zeros((B, V), jnp.float32)
+                for g in range(G):
+                    sl = slice(g * gk, (g + 1) * gk)
+                    lp = logp[:, sl] - diversity_penalty * counts[:, None, :]
+                    base = scores[:, sl]
+                    cand = base[:, :, None] + lp  # [B, gk, V]
+                    # finished beams: single pad candidate at unchanged score
+                    fin = finished[:, sl]
+                    pad_only = jnp.full((B, gk, V), NEG).at[:, :, pad_id].set(0.0) + base[:, :, None]
+                    cand = jnp.where(fin[:, :, None], pad_only, cand)
+                    flat = cand.reshape(B, gk * V)
+                    top_v, top_i = jax.lax.top_k(flat, gk)
+                    b_idx = top_i // V + g * gk  # global beam index within K
+                    t_idx = (top_i % V).astype(jnp.int32)
+                    sel_fin = jnp.take_along_axis(finished, b_idx, axis=1)
+                    sel_len = jnp.take_along_axis(lengths, b_idx, axis=1)
+                    hit_eos = (t_idx[..., None] == eos_arr[None, None, :]).any(-1)
+                    new_beam.append(b_idx)
+                    new_tok.append(t_idx)
+                    new_scores.append(top_v)
+                    new_fin.append(sel_fin | (hit_eos & ~sel_fin))
+                    new_len.append(jnp.where(sel_fin, sel_len, sel_len + 1))
+                    if G > 1:
+                        counts = counts + jax.nn.one_hot(t_idx, V, dtype=jnp.float32).sum(axis=1)
+                return (jnp.concatenate(new_beam, 1), jnp.concatenate(new_tok, 1),
+                        jnp.concatenate(new_scores, 1), jnp.concatenate(new_fin, 1),
+                        jnp.concatenate(new_len, 1))
+
+            L_layers = config.num_hidden_layers
+
+            def reorder(tree_or_buf, beam_idx):
+                """Gather beam rows by per-batch choice. ids_buf carries batch on
+                dim 0 ([B*K, L]); KVCache leaves on dim 1 ([layers, B*K, ...])."""
+                flat_idx = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+
+                def one(x):
+                    nd = getattr(x, "ndim", 0)
+                    if nd >= 2 and x.shape[0] == L_layers and x.shape[1] == BK:
+                        return x[:, flat_idx]
+                    if nd >= 1 and x.shape[0] == BK:
+                        return x[flat_idx]
+                    return x
+
+                return jax.tree.map(one, tree_or_buf)
+
+            def apply_step(state, logits):
+                ids_buf, kv, cur_len, scores, finished, lengths = state
+                beam_idx, tok, scores, finished, lengths = select(
+                    logits, scores, finished, lengths, cur_len, ids_buf
+                )
+                ids_buf = reorder(ids_buf, beam_idx)
+                kv = reorder(kv, beam_idx)
+                ids_buf = jax.lax.dynamic_update_slice(ids_buf, tok.reshape(BK, 1), (0, cur_len))
+                return ids_buf, kv, cur_len + 1, scores, finished, lengths
+
+            state = apply_step((ids_buf, kv, jnp.asarray(T0, jnp.int32), init_scores, finished, lengths), logits)
+
+            def cond(state):
+                _, _, cur_len, _, finished, _ = state
+                return (cur_len < max_length) & ~finished.all()
+
+            def body(state):
+                ids_buf, kv, cur_len, scores, finished, lengths = state
+                tok = jax.lax.dynamic_slice(ids_buf, (0, cur_len - 1), (BK, 1))
+                pos = jnp.sum(pad_mask * (jnp.arange(max_length)[None, :] < (cur_len - 1)), axis=1)
+                out = module.apply({"params": params}, input_ids=tok, attention_mask=pad_mask,
+                                   position_ids=pos[:, None], cache=kv, deterministic=True)
+                logits = out.logits[:, -1].astype(jnp.float32)
+                return apply_step((ids_buf, out.past_key_values, cur_len, scores, finished, lengths), logits)
+
+            if max_length > T0 + 1:
+                state = jax.lax.while_loop(cond, body, state)
+            ids_buf, _, _, scores, finished, lengths = state
+            # length-penalized final selection (reference BeamHypotheses.add)
+            norm = scores / jnp.maximum(lengths.astype(jnp.float32), 1.0) ** length_penalty
+            best = jnp.argmax(norm, axis=1)  # [B]
+            rows = jnp.arange(B) * K + best
+            return ids_buf.reshape(B * K, max_length)[rows], jnp.take_along_axis(norm, best[:, None], 1)[:, 0]
+
+        fn = jax.jit(decode)
+        cache[cache_key] = fn
+        return fn
+
     def _get_decode_fn(self, *, max_length, prompt_len, do_sample, pad_id, eos_ids, procs, warpers, forced_eos):
         def _sig(ps):
             return tuple((type(p).__name__, tuple(sorted(p.__dict__.items()))) for p in ps)

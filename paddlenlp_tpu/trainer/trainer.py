@@ -480,6 +480,11 @@ class Trainer:
         """Pad a partial (last) eval batch to a multiple of the data shards by
         repeating row 0 with labels=-100: the masked token-mean loss ignores the
         filler, and callers slice the filler rows off logits. Returns (batch, n_pad)."""
+        if jax.process_count() > 1:
+            # the sharded sampler already yields consistent full-size local
+            # slices (final partial batch wrap-padded identically on all
+            # processes); per-process padding here would desynchronize shards
+            return batch, 0
         n_shards = self.args.dataset_world_size
         any_val = next(iter(batch.values()))
         bsz = np.asarray(any_val).shape[0]

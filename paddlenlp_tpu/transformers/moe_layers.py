@@ -6,12 +6,20 @@ densely (every expert on every token, gathered by mask) and expresses expert
 parallelism as "exclude expert params from dp allreduce" (``use_expert_parallel``,
 trainer.py:1079-1085). TPU-native:
 
-- expert weights are ONE stacked tensor [E, ...] — a single einsum per projection
-  keeps the MXU busy instead of looping E small matmuls;
-- routing is top-k softmax with dense weighted combine (exact — no token dropping;
-  capacity-based dispatch is a later optimization);
+- expert weights are ONE stacked tensor [E, ...] — batched einsums keep the MXU
+  busy instead of looping E small matmuls;
+- routing is top-k softmax. Sparse dispatch (GShard/Switch style):
+  tokens scatter into per-expert capacity buffers [E, C, D]
+  (C = ceil(N*K/E) * capacity_factor), experts run batched matmuls over their
+  buffers only — ~E/K x fewer FLOPs than dense — and a weighted gather combines
+  the outputs; over-capacity assignments drop (the aux loss pushes the router
+  toward balance). ``config.moe_dispatch = "sparse"`` opts in (training-scale configs); the
+  DEFAULT stays the exact every-expert-on-every-token dense compute for parity
+  with pretrained checkpoints (the reference's mask-gather behavior,
+  qwen2_moe/modeling.py:686);
 - expert parallelism = the ``expert`` logical axis on the stacked dim (rides the
-  data axes per the reference's EP-over-dp design); GSPMD partitions the einsum;
+  data axes per the reference's EP-over-dp design); GSPMD partitions the
+  scatter/einsum/gather into the expert all-to-all;
 - the load-balancing aux loss (Switch/Mixtral style) is threaded through the layer
   carry so it survives ``lax.scan`` over layers.
 """
@@ -80,12 +88,36 @@ class MoEMLP(nn.Module):
         w_down_ = shard_constraint(w_down.astype(self.dtype), P("expert", "mlp", "embed"))
 
         xf = x.reshape(-1, D)
-        # dense expert compute: [N, E, F] — exact, no token dropping
-        g = jnp.einsum("nd,edf->nef", xf, w_gate_)
-        u = jnp.einsum("nd,edf->nef", xf, w_up_)
-        h = act(g) * u
-        expert_out = jnp.einsum("nef,efd->ned", h, w_down_)
-        out = jnp.einsum("ned,ne->nd", expert_out, combine.astype(expert_out.dtype))
+        N = xf.shape[0]
+        if getattr(cfg, "moe_dispatch", "dense") == "dense":
+            # exact dense compute: [N, E, F] — every expert on every token
+            g = jnp.einsum("nd,edf->nef", xf, w_gate_)
+            u = jnp.einsum("nd,edf->nef", xf, w_up_)
+            h = act(g) * u
+            expert_out = jnp.einsum("nef,efd->ned", h, w_down_)
+            out = jnp.einsum("ned,ne->nd", expert_out, combine.astype(expert_out.dtype))
+        else:
+            # sparse capacity dispatch: scatter tokens to [E, C, D] buffers
+            cf = float(getattr(cfg, "moe_capacity_factor", 2.0))
+            C = min(max(int(-(-N * K // E) * cf), 1), N)
+            sel = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)  # [N, K, E]
+            flat_sel = sel.reshape(N * K, E)
+            csum = jnp.cumsum(flat_sel, axis=0)
+            pos = ((csum - 1) * flat_sel).sum(-1)  # [N*K] slot within expert buffer
+            keep = pos < C
+            dest = jnp.where(keep, topk_idx.reshape(-1) * C + pos, E * C)  # OOB -> dropped
+            x_rep = jnp.broadcast_to(xf[:, None], (N, K, D)).reshape(N * K, D)
+            xe = jnp.zeros((E * C, D), self.dtype).at[dest].add(
+                x_rep.astype(self.dtype), mode="drop"
+            ).reshape(E, C, D)
+            xe = shard_constraint(xe, P("expert", None, None))
+            g = jnp.einsum("ecd,edf->ecf", xe, w_gate_)
+            u = jnp.einsum("ecd,edf->ecf", xe, w_up_)
+            y = jnp.einsum("ecf,efd->ecd", act(g) * u, w_down_)
+            y = shard_constraint(y, P("expert", None, None)).reshape(E * C, D)
+            w = (topk_probs.reshape(-1) * keep).astype(y.dtype)  # dropped -> weight 0
+            gathered = jnp.take(y, jnp.minimum(dest, E * C - 1), axis=0)
+            out = (gathered * w[:, None]).reshape(N, K, D).sum(axis=1)
 
         # optional qwen2-moe shared expert (+ sigmoid gate)
         if getattr(cfg, "shared_expert_intermediate_size", 0):

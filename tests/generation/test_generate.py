@@ -116,6 +116,64 @@ class TestWarpers:
             bigrams.add(bg)
 
 
+class TestBeamSearch:
+    def test_matches_naive_beam(self, model):
+        """while_loop beam search == re-forward-everything reference beam."""
+        prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+        out, scores = model.generate(prompt, max_new_tokens=5, num_beams=3, eos_token_id=None)
+
+        beams = [(list(np.asarray(prompt[0])), 0.0)]
+        for _ in range(5):
+            cand = []
+            for ids, sc in beams:
+                logits = model(input_ids=jnp.asarray([ids], jnp.int32)).logits[0, -1]
+                logp = np.asarray(jax.nn.log_softmax(logits.astype(jnp.float32)))
+                for t in np.argsort(logp)[::-1][:4]:
+                    cand.append((ids + [int(t)], sc + float(logp[t])))
+            cand.sort(key=lambda x: -x[1])
+            beams = cand[:3]
+        np.testing.assert_array_equal(np.asarray(out[0]), beams[0][0][3:])
+        np.testing.assert_allclose(float(scores[0]), beams[0][1] / 5.0, rtol=1e-5)
+
+    def test_beam_beats_greedy_score(self, model):
+        """Beam-3's sequence log-prob must be >= the greedy sequence's."""
+        prompt = jnp.asarray([[11, 12, 13]], jnp.int32)
+        greedy, _ = model.generate(prompt, max_new_tokens=6, do_sample=False, eos_token_id=None)
+        beam, beam_score = model.generate(prompt, max_new_tokens=6, num_beams=4, eos_token_id=None)
+
+        def seq_logp(gen):
+            ids = np.concatenate([np.asarray(prompt[0]), np.asarray(gen)])
+            logits = model(input_ids=jnp.asarray([ids[:-1]], jnp.int32)).logits[0].astype(jnp.float32)
+            lp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+            return sum(lp[2 + i, t] for i, t in enumerate(np.asarray(gen)))
+
+        assert seq_logp(beam[0]) >= seq_logp(greedy[0]) - 1e-4
+
+    def test_eos_freezes_beam(self, model):
+        """A beam that emits eos must continue with pad only."""
+        out, _ = model.generate(jnp.asarray([[5, 6, 7, 8]], jnp.int32), max_new_tokens=16,
+                                num_beams=2, eos_token_id=2)
+        toks = np.asarray(out[0])
+        if 2 in toks:
+            i = int(np.argmax(toks == 2))
+            assert (toks[i + 1:] == 0).all()
+
+    def test_group_beam_runs(self, model):
+        out, scores = model.generate(jnp.asarray([[5, 6, 7]], jnp.int32), max_new_tokens=5,
+                                     num_beams=4, num_beam_groups=2, diversity_penalty=1.0,
+                                     decode_strategy="group_beam_search", eos_token_id=None)
+        assert out.shape == (1, 5)
+        assert np.isfinite(float(scores[0]))
+
+    def test_batched_beams_isolated(self, model):
+        """Each batch row's beams must be independent."""
+        single, _ = model.generate(jnp.asarray([[5, 6, 7]], jnp.int32), max_new_tokens=4,
+                                   num_beams=3, eos_token_id=None)
+        batch, _ = model.generate(jnp.asarray([[5, 6, 7], [40, 41, 42]], jnp.int32),
+                                  max_new_tokens=4, num_beams=3, eos_token_id=None)
+        np.testing.assert_array_equal(np.asarray(batch[0]), np.asarray(single[0]))
+
+
 class TestProcessorFixes:
     def test_min_length_blocks_all_eos_ids(self):
         from paddlenlp_tpu.generation import MinLengthLogitsProcessor
