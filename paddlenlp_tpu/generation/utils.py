@@ -126,6 +126,11 @@ class GenerationMixin:
         )
         use_beams = (g.num_beams or 1) > 1 or g.decode_strategy in ("beam_search", "group_beam_search")
         if use_beams:
+            if g.do_sample:
+                logger.warning_once(
+                    "num_beams>1 runs deterministic beam search; do_sample/temperature/"
+                    "top_k/top_p are ignored (stochastic beam sampling is not implemented)"
+                )
             num_groups = g.num_beam_groups if g.decode_strategy == "group_beam_search" or g.num_beam_groups > 1 else 1
             beam_decode = self._get_beam_decode_fn(
                 max_length=max_length,

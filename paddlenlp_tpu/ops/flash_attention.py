@@ -41,6 +41,32 @@ def make_segment_mask(q_segments: jnp.ndarray, kv_segments: jnp.ndarray) -> jnp.
     return (q_segments[:, None, :, None] == kv_segments[:, None, None, :])
 
 
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """Closed-form ALiBi slopes (reference attention_strategies.py
+    AttentionWithLinearBias :24 / the bloom convention)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start**i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return jnp.asarray(pow2_slopes(n_heads), jnp.float32)
+    closest = 2 ** int(math.floor(math.log2(n_heads)))
+    slopes = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][: n_heads - closest]
+    return jnp.asarray(slopes + extra, jnp.float32)
+
+
+def alibi_bias(n_heads: int, q_len: int, kv_len: int, offset=0) -> jnp.ndarray:
+    """[1, n_heads, q_len, kv_len] additive bias: -slope * (q_pos - k_pos)."""
+    slopes = alibi_slopes(n_heads)
+    rows = jnp.arange(q_len)[:, None] + offset
+    cols = jnp.arange(kv_len)[None, :]
+    dist = (rows - cols).astype(jnp.float32)  # >= 0 within the causal region
+    return (-slopes[:, None, None] * dist)[None]
+
+
 def dot_product_attention(
     query: jnp.ndarray,  # [B, T, n_heads, head_dim]
     key: jnp.ndarray,  # [B, S, n_kv, head_dim]
@@ -56,6 +82,7 @@ def dot_product_attention(
     window: Optional[int] = None,
     positions: Optional[jnp.ndarray] = None,  # [B, T] or [T] ABSOLUTE positions (permuted layouts)
     use_pallas: Optional[bool] = None,
+    use_alibi: bool = False,  # additive -slope*(q_pos-k_pos) bias (bloom/baichuan-13b)
 ) -> jnp.ndarray:
     """Fused attention; returns [B, T, n_heads, head_dim] in query dtype.
 
@@ -80,6 +107,7 @@ def dot_product_attention(
         and attention_mask is None
         and positions is None
         and dropout_rate == 0.0
+        and not use_alibi
         and T == S  # self-attention, no KV cache
         and (isinstance(q_offset, int) and q_offset == 0)
         and N % K == 0
@@ -115,14 +143,26 @@ def dot_product_attention(
         pad = attention_mask[:, None, None, :].astype(jnp.bool_)
         mask = pad if mask is None else jnp.logical_and(mask, pad)
 
+    bias = None
+    if use_alibi:
+        if positions is not None:
+            # permuted layouts (cp zigzag): distances from ABSOLUTE positions
+            pos = positions if positions.ndim == 2 else positions[None, :]
+            pos = jnp.broadcast_to(pos, (B, S)).astype(jnp.float32)
+            q_pos = pos[:, -T:] if T != S else pos
+            dist = q_pos[:, None, :, None] - pos[:, None, None, :]
+            bias = -alibi_slopes(N)[None, :, None, None] * dist
+        else:
+            bias = jnp.broadcast_to(alibi_bias(N, T, S, q_offset), (B, N, T, S))
+
     if dropout_rate == 0.0:
         try:
-            return jax.nn.dot_product_attention(query, key, value, mask=mask, scale=scale)
+            return jax.nn.dot_product_attention(query, key, value, bias=bias, mask=mask, scale=scale)
         except TypeError:  # API-signature drift across jax versions only
             from ..utils.log import logger
 
             logger.warning_once("jax.nn.dot_product_attention signature mismatch; using math attention")
-    return _math_attention(query, key, value, mask, scale, dropout_rate, dropout_rng)
+    return _math_attention(query, key, value, mask, scale, dropout_rate, dropout_rng, bias=bias)
 
 
 def _pallas_dispatch(query, key, value, segment_ids, scale, window):
@@ -182,7 +222,7 @@ def _pallas_dispatch(query, key, value, segment_ids, scale, window):
     )(query, key, value, segment_ids)
 
 
-def _math_attention(query, key, value, mask, scale, dropout_rate=0.0, dropout_rng=None):
+def _math_attention(query, key, value, mask, scale, dropout_rate=0.0, dropout_rng=None, bias=None):
     B, T, N, H = query.shape
     S = key.shape[1]
     K = key.shape[2]
@@ -191,6 +231,8 @@ def _math_attention(query, key, value, mask, scale, dropout_rate=0.0, dropout_rn
         key = jnp.repeat(key, rep, axis=2)
         value = jnp.repeat(value, rep, axis=2)
     logits = jnp.einsum("btnh,bsnh->bnts", query.astype(jnp.float32), key.astype(jnp.float32)) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if mask is not None:
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)

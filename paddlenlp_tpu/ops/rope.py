@@ -14,7 +14,32 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["rope_frequencies", "apply_rotary_pos_emb", "rotate_half"]
+__all__ = ["rope_frequencies", "apply_rotary_pos_emb", "rotate_half", "apply_rotary_partial_interleaved"]
+
+
+def apply_rotary_partial_interleaved(
+    q: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    k: jnp.ndarray,  # [B, T, n_kv, head_dim]
+    position_ids: jnp.ndarray,  # [B, T] or [T]
+    rotary_dim: int,
+    base: float = 10000.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """ChatGLM2/GPT-J-style rotary: the FIRST ``rotary_dim`` dims rotate as
+    interleaved (x_{2i}, x_{2i+1}) pairs; the remaining dims pass through."""
+    pos = position_ids if position_ids.ndim == 2 else position_ids[None, :]
+    inv = 1.0 / (base ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    freqs = pos[..., None].astype(jnp.float32) * inv[None, None, :]  # [B, T, r/2]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    sin = jnp.sin(freqs)[:, :, None, :]
+
+    def rot(x):
+        xr, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+        xr = xr.astype(jnp.float32).reshape(xr.shape[:-1] + (rotary_dim // 2, 2))
+        x0, x1 = xr[..., 0], xr[..., 1]
+        o = jnp.stack([x0 * cos - x1 * sin, x1 * cos + x0 * sin], axis=-1)
+        return jnp.concatenate([o.reshape(o.shape[:-2] + (rotary_dim,)).astype(x.dtype), rest], axis=-1)
+
+    return rot(q), rot(k)
 
 
 def rope_frequencies(

@@ -183,7 +183,9 @@ def spec_tree_from_rules(
             if pat.search(path):
                 # scanned-layer stacks carry a leading [L] axis not present in the
                 # per-layer rule: prepend the `layers` logical axis (maps to pp).
-                if shape is not None and len(shape) == len(spec) + 1 and "/layers/" in f"/{path}":
+                if shape is not None and len(shape) == len(spec) + 1 and (
+                    "/layers/" in f"/{path}" or "/h/" in f"/{path}"
+                ):
                     spec = PartitionSpec("layers", *spec)
                 return resolve_spec(spec, mesh, logical_rules, shape)
         return PartitionSpec()

@@ -42,6 +42,11 @@ from .model_outputs import (  # noqa: F401
     ModelOutput,
 )
 from .model_utils import PretrainedModel  # noqa: F401
+from .chatglm_v2 import ChatGLMv2Config, ChatGLMv2ForCausalLM, ChatGLMv2Model  # noqa: F401
+from .baichuan import BaichuanConfig, BaichuanForCausalLM, BaichuanModel  # noqa: F401
+from .bloom import BloomConfig, BloomForCausalLM, BloomModel  # noqa: F401
+from .opt import OPTConfig, OPTForCausalLM, OPTModel  # noqa: F401
+from .qwen import QWenConfig, QWenForCausalLM, QWenModel  # noqa: F401
 from .qwen2 import Qwen2Config, Qwen2ForCausalLM, Qwen2ForSequenceClassification, Qwen2Model  # noqa: F401
 from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM, Qwen2MoeModel  # noqa: F401
 from .tokenizer_utils import BatchEncoding, PretrainedTokenizer  # noqa: F401

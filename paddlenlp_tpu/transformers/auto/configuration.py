@@ -25,11 +25,17 @@ def _populate():
     from ..llama.configuration import LlamaConfig
     from ..mistral.configuration import MistralConfig
     from ..mixtral.configuration import MixtralConfig
+    from ..baichuan.configuration import BaichuanConfig
+    from ..chatglm_v2.configuration import ChatGLMv2Config
+    from ..bloom.configuration import BloomConfig
+    from ..opt.configuration import OPTConfig
+    from ..qwen.configuration import QWenConfig
     from ..qwen2.configuration import Qwen2Config
     from ..qwen2_moe.configuration import Qwen2MoeConfig
 
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
-                ErnieConfig, MixtralConfig, Qwen2MoeConfig):
+                ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
+                OPTConfig, QWenConfig, ChatGLMv2Config):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -45,6 +45,23 @@ def _populate_models():
     register_model("gpt", "causal_lm", gpt.GPTForCausalLM)
     register_model("gpt2", "base", gpt.GPTModel)
     register_model("gpt2", "causal_lm", gpt.GPTForCausalLM)
+    from ..baichuan import modeling as baichuan
+    from ..bloom import modeling as bloom
+    from ..opt import modeling as opt
+    from ..qwen import modeling as qwen
+
+    from ..chatglm_v2 import modeling as chatglm_v2
+
+    register_model("chatglm_v2", "base", chatglm_v2.ChatGLMv2Model)
+    register_model("chatglm_v2", "causal_lm", chatglm_v2.ChatGLMv2ForCausalLM)
+    register_model("baichuan", "base", baichuan.BaichuanModel)
+    register_model("baichuan", "causal_lm", baichuan.BaichuanForCausalLM)
+    register_model("bloom", "base", bloom.BloomModel)
+    register_model("bloom", "causal_lm", bloom.BloomForCausalLM)
+    register_model("opt", "base", opt.OPTModel)
+    register_model("opt", "causal_lm", opt.OPTForCausalLM)
+    register_model("qwen", "base", qwen.QWenModel)
+    register_model("qwen", "causal_lm", qwen.QWenForCausalLM)
     register_model("qwen2", "base", qwen2.Qwen2Model)
     register_model("qwen2", "causal_lm", qwen2.Qwen2ForCausalLM)
     register_model("qwen2", "sequence_classification", qwen2.Qwen2ForSequenceClassification)

@@ -1,0 +1,7 @@
+from .configuration import BaichuanConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    BaichuanForCausalLM,
+    BaichuanModel,
+    BaichuanPretrainedModel,
+    BaichuanPretrainingCriterion,
+)

@@ -1,0 +1,47 @@
+"""Baichuan configuration (reference: paddlenlp/transformers — Baichuan/Baichuan2;
+HF BaichuanForCausalLM). 7B uses RoPE; 13B uses ALiBi (``use_alibi=True``)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["BaichuanConfig"]
+
+
+class BaichuanConfig(PretrainedConfig):
+    model_type = "baichuan"
+
+    def __init__(
+        self,
+        vocab_size: int = 125696,
+        hidden_size: int = 4096,
+        intermediate_size: int = 11008,
+        num_hidden_layers: int = 32,
+        num_attention_heads: int = 32,
+        hidden_act: str = "silu",
+        max_position_embeddings: int = 4096,
+        initializer_range: float = 0.02,
+        rms_norm_eps: float = 1e-6,
+        rope_theta: float = 10000.0,
+        use_alibi: bool = False,  # True for the 13B (ALiBi, no rope)
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_attention_heads  # MHA
+        self.head_dim = hidden_size // num_attention_heads
+        self.hidden_act = hidden_act
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.rope_scaling = None
+        self.use_alibi = use_alibi
+        self.attention_bias = False
+        self.attention_out_bias = False
+        self.mlp_bias = False
+        kwargs.setdefault("tie_word_embeddings", False)
+        super().__init__(**kwargs)

@@ -1,0 +1,62 @@
+"""Baichuan / Baichuan2, TPU-native.
+
+Counterpart of the reference Baichuan support (HF ``BaichuanForCausalLM``).
+Baichuan IS the LLaMA computation graph with a fused ``W_pack`` qkv projection
+(7B: RoPE; 13B: ALiBi via ``config.use_alibi`` — the shared llama attention
+handles both). The only model-specific code is the checkpoint mapping that
+splits ``W_pack`` into q/k/v; our own saved checkpoints use the split keys and
+load through the mechanical fallback.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..conversion_utils import StackedLayerMapping, StateDictNameMapping, auto_name_mappings
+from ..llama.modeling import (
+    LlamaForCausalLMModule,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from .configuration import BaichuanConfig
+
+__all__ = ["BaichuanModel", "BaichuanForCausalLM", "BaichuanPretrainedModel", "BaichuanPretrainingCriterion"]
+
+
+class BaichuanPretrainedModel(LlamaPretrainedModel):
+    config_class = BaichuanConfig
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        mappings = auto_name_mappings(flat_shapes)
+        D = config.hidden_size
+        idx = {"q_proj": 0, "k_proj": 1, "v_proj": 2}
+        out = []
+        for m in mappings:
+            hit = re.search(r"self_attn/(q_proj|k_proj|v_proj)/kernel$", m.target_name)
+            if not hit:
+                out.append(m)
+                continue
+            i = idx[hit.group(1)]
+            fn = (lambda i: lambda a: np.ascontiguousarray(a[i * D:(i + 1) * D].T))(i)
+            src = m.source_name.replace(f"{hit.group(1)}.weight", "W_pack.weight")
+            if isinstance(m, StackedLayerMapping):
+                out.append(StackedLayerMapping(src, m.target_name, dims=m.dims, fn=fn))
+            else:
+                out.append(StateDictNameMapping(src, m.target_name, fn=fn))
+        return out
+
+
+class BaichuanModel(BaichuanPretrainedModel):
+    module_class = LlamaModule
+
+
+class BaichuanForCausalLM(BaichuanPretrainedModel):
+    module_class = LlamaForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+BaichuanPretrainingCriterion = LlamaPretrainingCriterion

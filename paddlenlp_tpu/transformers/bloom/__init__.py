@@ -1,0 +1,7 @@
+from .configuration import BloomConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    BloomForCausalLM,
+    BloomModel,
+    BloomPretrainedModel,
+    BloomPretrainingCriterion,
+)

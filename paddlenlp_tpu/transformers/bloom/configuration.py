@@ -1,0 +1,43 @@
+"""BLOOM configuration (reference: paddlenlp/transformers/bloom/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["BloomConfig"]
+
+
+class BloomConfig(PretrainedConfig):
+    model_type = "bloom"
+    attribute_map = {"n_embed": "hidden_size", "n_layer": "num_hidden_layers",
+                     "n_head": "num_attention_heads", "num_heads": "num_attention_heads"}
+
+    def __init__(
+        self,
+        vocab_size: int = 250880,
+        hidden_size: int = 4096,
+        num_hidden_layers: int = 30,
+        num_attention_heads: int = 32,
+        layer_norm_epsilon: float = 1e-5,
+        initializer_range: float = 0.02,
+        apply_residual_connection_post_layernorm: bool = False,
+        hidden_dropout: float = 0.0,
+        attention_dropout: float = 0.0,
+        max_position_embeddings: int = 2048,  # unused (ALiBi); kept for harness parity
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_attention_heads
+        self.head_dim = hidden_size // num_attention_heads
+        self.intermediate_size = 4 * hidden_size
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.apply_residual_connection_post_layernorm = apply_residual_connection_post_layernorm
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.max_position_embeddings = max_position_embeddings
+        kwargs.setdefault("tie_word_embeddings", True)
+        super().__init__(**kwargs)

@@ -1,0 +1,7 @@
+from .configuration import ChatGLMv2Config  # noqa: F401
+from .modeling import (  # noqa: F401
+    ChatGLMv2ForCausalLM,
+    ChatGLMv2Model,
+    ChatGLMv2PretrainedModel,
+    ChatGLMv2PretrainingCriterion,
+)

@@ -1,0 +1,47 @@
+"""ChatGLM2/3 configuration (reference: paddlenlp/transformers/chatglm_v2/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["ChatGLMv2Config"]
+
+
+class ChatGLMv2Config(PretrainedConfig):
+    model_type = "chatglm_v2"
+    attribute_map = {"num_layers": "num_hidden_layers", "ffn_hidden_size": "intermediate_size",
+                     "padded_vocab_size": "vocab_size", "seq_length": "max_position_embeddings"}
+
+    def __init__(
+        self,
+        vocab_size: int = 65024,
+        hidden_size: int = 4096,
+        intermediate_size: int = 13696,
+        num_hidden_layers: int = 28,
+        num_attention_heads: int = 32,
+        multi_query_group_num: int = 2,
+        kv_channels: int = 128,
+        max_position_embeddings: int = 32768,
+        layernorm_epsilon: float = 1e-5,
+        initializer_range: float = 0.02,
+        add_qkv_bias: bool = True,
+        rope_ratio: float = 1.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = multi_query_group_num
+        self.multi_query_group_num = multi_query_group_num
+        self.head_dim = kv_channels
+        self.kv_channels = kv_channels
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = layernorm_epsilon
+        self.initializer_range = initializer_range
+        self.add_qkv_bias = add_qkv_bias
+        self.rope_ratio = rope_ratio
+        self.rope_theta = 10000.0 * rope_ratio
+        kwargs.setdefault("tie_word_embeddings", False)
+        super().__init__(**kwargs)

@@ -1,0 +1,237 @@
+"""ChatGLM2/3, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/chatglm_v2/modeling.py``. Distinctives vs
+the llama skeleton: partial INTERLEAVED rotary over the first half of each head
+(GPT-J pairing), grouped-query attention via ``multi_query_group_num``, a fused
+``query_key_value`` projection ([n*hd + 2*g*hd] rows, qkv bias), fused
+``dense_h_to_4h`` SwiGLU ([2F] split-then-gate), RMSNorm, untied ``output_layer``
+head. Module names mirror HF chatglm2 keys
+(``transformer.encoder.layers.{i}.self_attention.query_key_value`` ...) so the
+checkpoint mapping is mechanical; the precomputed ``rotary_pos_emb.inv_freq``
+buffer in HF checkpoints is ignored (computed closed-form here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import apply_rotary_partial_interleaved
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import LlamaRMSNorm, VocabEmbed, _maybe_remat
+from ..llama.modeling import LlamaPretrainingCriterion as ChatGLMv2PretrainingCriterion
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import ChatGLMv2Config
+
+__all__ = ["ChatGLMv2Model", "ChatGLMv2ForCausalLM", "ChatGLMv2PretrainedModel",
+           "ChatGLMv2PretrainingCriterion"]
+
+
+def _dense(features, cfg, dtype, param_dtype, name, use_bias=False):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+class GLMAttention(nn.Module):
+    config: ChatGLMv2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, layer_kv, offset, position_ids, deterministic):
+        cfg = self.config
+        B, T, D = x.shape
+        n, g, hd = cfg.num_attention_heads, cfg.multi_query_group_num, cfg.head_dim
+        fused = _dense(n * hd + 2 * g * hd, cfg, self.dtype, self.param_dtype,
+                       "query_key_value", use_bias=cfg.add_qkv_bias)(x)
+        q = fused[..., : n * hd].reshape(B, T, n, hd)
+        k = fused[..., n * hd : n * hd + g * hd].reshape(B, T, g, hd)
+        v = fused[..., n * hd + g * hd :].reshape(B, T, g, hd)
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :] + (offset if layer_kv is not None else 0)
+        q, k = apply_rotary_partial_interleaved(q, k, position_ids, hd // 2, base=cfg.rope_theta)
+        q_offset = 0
+        new_kv = None
+        if layer_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(layer_kv[0], layer_kv[1], k, v, offset)
+            new_kv = (k, v)
+        out = dot_product_attention(q, k, v, attention_mask=attention_mask, segment_ids=segment_ids,
+                                    causal=True, q_offset=q_offset).reshape(B, T, n * hd)
+        return _dense(D, cfg, self.dtype, self.param_dtype, "dense")(out), new_kv
+
+
+class GLMBlock(nn.Module):
+    """Scan-compatible: carry = (h, offset, aux)."""
+
+    config: ChatGLMv2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_kv, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        x = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="input_layernorm")(h)
+        attn = GLMAttention(cfg, self.dtype, self.param_dtype, name="self_attention")
+        attn_out, new_kv = attn(x, attention_mask, segment_ids, layer_kv, offset, position_ids, deterministic)
+        h = h + attn_out
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        x = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="post_attention_layernorm")(h)
+        mlp = _dense(2 * cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "mlp_dense_h_to_4h")(x)
+        g0, g1 = jnp.split(mlp, 2, axis=-1)
+        x = nn.silu(g0) * g1
+        x = shard_constraint(x, P("batch", "seq", "act_mlp"))
+        x = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "mlp_dense_4h_to_h")(x)
+        h = h + x
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_kv
+
+
+class GLMTransformer(nn.Module):
+    config: ChatGLMv2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask, position_ids, segment_ids, cache, deterministic,
+                 input_len):
+        cfg = self.config
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        layer_cls = _maybe_remat(GLMBlock, cfg)
+        aux = jnp.zeros((), jnp.float32)
+        use_scan = getattr(cfg, "use_scan_layers", False)
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
+            )
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
+                (h, offset, aux), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                cache = KVCache(keys=new_kv[0], values=new_kv[1], offset=offset + input_len)
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.num_hidden_layers):
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values),
+                                offset=offset + input_len)
+        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="final_layernorm")(h)
+        return h, cache, aux
+
+
+class ChatGLMv2Module(nn.Module):
+    config: ChatGLMv2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[KVCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        if inputs_embeds is None:
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="embedding_word_embeddings")(input_ids)
+        h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+        T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+        h, cache, aux = GLMTransformer(cfg, self.dtype, self.param_dtype, name="encoder")(
+            h, attention_mask, position_ids, segment_ids, cache, deterministic, T
+        )
+        if not return_dict:
+            return (h, cache, None)
+        return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
+                                       hidden_states=None, aux_loss=aux)
+
+
+class ChatGLMv2ForCausalLMModule(nn.Module):
+    config: ChatGLMv2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = ChatGLMv2Module(cfg, self.dtype, self.param_dtype, name="transformer")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        logits = _dense(cfg.vocab_size, cfg, self.dtype, self.param_dtype, "output_layer")(h)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states, aux_loss=outputs.aux_loss)
+
+
+class ChatGLMv2PretrainedModel(PretrainedModel):
+    config_class = ChatGLMv2Config
+    base_model_prefix = "transformer"
+    _keys_to_ignore_on_load_unexpected = [r"rotary_pos_emb"]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import auto_name_mappings
+
+        mappings = auto_name_mappings(flat_shapes)
+        for m in mappings:
+            # flat underscore module names -> HF dotted scopes
+            for ours, hf in (("embedding_word_embeddings", "embedding.word_embeddings"),
+                             ("mlp_dense_h_to_4h", "mlp.dense_h_to_4h"),
+                             ("mlp_dense_4h_to_h", "mlp.dense_4h_to_h")):
+                if isinstance(m.source_name, str):
+                    new = m.source_name.replace(ours, hf)
+                    if hasattr(m, "source_template"):
+                        m.source_template = new
+                    else:
+                        m.source_name = new
+        return mappings
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"query_key_value/kernel$", P("embed", "heads")),
+            (r"query_key_value/bias$", P("heads")),
+            (r"self_attention/dense/kernel$", P("heads", "embed")),
+            (r"dense_h_to_4h/kernel$", P("embed", "mlp")),
+            (r"dense_4h_to_h/kernel$", P("mlp", "embed")),
+            (r"output_layer/kernel$", P("embed", "vocab")),
+            (r"layernorm/scale$", P()),
+        ]
+
+
+class ChatGLMv2Model(ChatGLMv2PretrainedModel):
+    module_class = ChatGLMv2Module
+
+
+class ChatGLMv2ForCausalLM(ChatGLMv2PretrainedModel):
+    module_class = ChatGLMv2ForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"output_layer"]

@@ -66,6 +66,7 @@ class StackedLayerMapping:
     n_layers: int = 0  # legacy single-dim spelling
     action: Optional[str] = None  # applied per slice
     dims: Optional[tuple] = None
+    fn: Optional[Callable] = None  # per-slice transform (e.g. fused-qkv split); NOT invertible
 
     def __post_init__(self):
         if self.dims is None:
@@ -89,13 +90,17 @@ class StackedLayerMapping:
             arr = get_source(name)
             if arr is None:
                 return None
-            if self.action == "transpose":
+            if self.fn is not None:
+                arr = self.fn(np.asarray(arr))
+            elif self.action == "transpose":
                 arr = np.ascontiguousarray(np.asarray(arr).T)
             slices.append(np.asarray(arr))
         stacked = np.stack(slices, axis=0)
         return stacked.reshape(tuple(self.dims) + stacked.shape[1:])
 
     def reverse_unstack(self, array: np.ndarray) -> Dict[str, np.ndarray]:
+        if self.fn is not None:
+            raise ValueError(f"custom conversion for {self.target_name} is not invertible")
         out = {}
         flat = array.reshape((-1,) + array.shape[len(self.dims):])
         for j, idx in enumerate(self._indices()):
@@ -167,12 +172,13 @@ def auto_name_mappings(
             continue
         leaf = flat_shapes[path]
         ndim = len(getattr(leaf, "shape", ()))
-        stacked = "/layers/" in f"/{path}"
+        seg = next((s for s in ("layers", "h") if f"/{s}/" in f"/{path}"), None)
+        stacked = seg is not None
         action = "transpose" if path.endswith("/kernel") else None
         if action == "transpose" and ndim - (1 if stacked else 0) != 2:
             action = None  # conv kernels etc. handled by explicit overrides
         if stacked:
-            hf_key = target_to_hf_key(path.replace("/layers/", "/layers_0/", 1)).replace("layers.0.", "layers.{}.", 1)
+            hf_key = target_to_hf_key(path.replace(f"/{seg}/", f"/{seg}_0/", 1)).replace(f"{seg}.0.", seg + ".{}.", 1)
             if hf_prefix and not hf_key.startswith(hf_prefix + "."):
                 hf_key = hf_prefix + "." + hf_key
             n_layers = getattr(leaf, "shape", (0,))[0]

@@ -195,9 +195,11 @@ class LlamaAttention(nn.Module):
 
         if position_ids is None:
             position_ids = jnp.arange(T)[None, :] + (offset if kv is not None else 0)
-        inv_freq = jnp.asarray(rope_frequencies(head_dim, cfg.rope_theta, cfg.rope_scaling))
-        cos, sin = rope_tables(position_ids, inv_freq)
-        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        use_alibi = bool(getattr(cfg, "use_alibi", False))
+        if not use_alibi:
+            inv_freq = jnp.asarray(rope_frequencies(head_dim, cfg.rope_theta, cfg.rope_scaling))
+            cos, sin = rope_tables(position_ids, inv_freq)
+            q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
         q_offset = 0
         new_kv = None
@@ -228,6 +230,7 @@ class LlamaAttention(nn.Module):
             and attention_mask is None
             and segment_ids is None
             and dropout_rate == 0.0
+            and not use_alibi
             and getattr(cfg, "sliding_window", None) is None
         ):
             from ...ops.ring_attention import ring_self_attention
@@ -246,6 +249,7 @@ class LlamaAttention(nn.Module):
                 dropout_rng=dropout_rng,
                 window=getattr(cfg, "sliding_window", None),
                 positions=position_ids if (cp_active and kv is None) else None,
+                use_alibi=use_alibi,
             )
         attn_out = checkpoint_name(attn_out, "core_attn")
         attn_out = attn_out.reshape(B, T, n_heads * head_dim)

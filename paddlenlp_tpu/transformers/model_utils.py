@@ -213,28 +213,40 @@ class PretrainedModel(GenerationMixin):
             sf = key_to_file.get(key)
             return sf.get_tensor(key) if sf is not None else None
 
+        def _load_one(path, m):
+            if isinstance(m, StackedLayerMapping):
+                return m.apply_stack(get_source)
+            src_key = m.source_name if m else path
+            if src_key not in key_to_file:
+                return None
+            return m.apply(get_source(src_key)) if m else get_source(src_key)
+
         flat_params: Dict[str, jax.Array] = {}
         missing: List[str] = []
+        fallback_sources: set = set()
         for path, shape_struct in flat_shapes.items():
-            m = mappings.get(path)
-            if isinstance(m, StackedLayerMapping):
-                arr = m.apply_stack(get_source)
-                if arr is None:
-                    missing.append(path)
-                    continue
-            else:
-                src_key = m.source_name if m else path
-                if src_key not in key_to_file:
-                    missing.append(path)
-                    continue
-                arr = m.apply(get_source(src_key)) if m else get_source(src_key)
+            arr = _load_one(path, mappings.get(path))
+            if arr is None:
+                # second chance via the mechanical mapping: a model whose HF
+                # layout fuses tensors (e.g. qkv) still loads OUR saved
+                # checkpoints, which use the split auto-derived keys
+                fallback = auto_name_mappings({path: shape_struct})[0]
+                arr = _load_one(path, fallback)
+                if arr is not None:
+                    if isinstance(fallback, StackedLayerMapping):
+                        fallback_sources.update(fallback.source_names())
+                    else:
+                        fallback_sources.add(fallback.source_name)
+            if arr is None:
+                missing.append(path)
+                continue
             if tuple(arr.shape) != tuple(shape_struct.shape):
                 raise ValueError(f"shape mismatch for {path}: ckpt {arr.shape} vs model {shape_struct.shape}")
             arr = _cast_np(arr, param_dtype)
             sharding = shardings_flat.get(path)
             flat_params[path] = jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr)
 
-        expected_sources = set()
+        expected_sources = set(fallback_sources)
         for m in mappings.values():
             if isinstance(m, StackedLayerMapping):
                 expected_sources.update(m.source_names())
@@ -284,6 +296,10 @@ class PretrainedModel(GenerationMixin):
         for path, leaf in flat.items():
             arr = np.asarray(jax.device_get(leaf))
             m = mappings.get(path)
+            if m is not None and getattr(m, "fn", None) is not None:
+                # non-invertible source transform (fused-qkv split): save under
+                # the mechanical split keys instead — from_pretrained accepts both
+                m = auto_name_mappings({path: leaf})[0]
             if isinstance(m, StackedLayerMapping):
                 tensors.update(m.reverse_unstack(arr))
             else:

@@ -1,0 +1,7 @@
+from .configuration import OPTConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    OPTForCausalLM,
+    OPTModel,
+    OPTPretrainedModel,
+    OPTPretrainingCriterion,
+)

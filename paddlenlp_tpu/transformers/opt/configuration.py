@@ -1,0 +1,43 @@
+"""OPT configuration (reference: paddlenlp/transformers/opt/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["OPTConfig"]
+
+
+class OPTConfig(PretrainedConfig):
+    model_type = "opt"
+    attribute_map = {"ffn_dim": "intermediate_size", "num_layers": "num_hidden_layers"}
+
+    def __init__(
+        self,
+        vocab_size: int = 50272,
+        hidden_size: int = 768,
+        intermediate_size: int = 3072,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        activation_function: str = "relu",
+        max_position_embeddings: int = 2048,
+        initializer_range: float = 0.02,
+        do_layer_norm_before: bool = True,
+        dropout: float = 0.0,
+        attention_dropout: float = 0.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_attention_heads
+        self.head_dim = hidden_size // num_attention_heads
+        self.hidden_act = activation_function
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.do_layer_norm_before = do_layer_norm_before
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        kwargs.setdefault("tie_word_embeddings", True)
+        super().__init__(**kwargs)

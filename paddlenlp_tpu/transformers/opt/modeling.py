@@ -1,0 +1,233 @@
+"""OPT, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/opt/modeling.py``. Distinctives vs the
+llama skeleton: learned position embeddings with OPT's +2 index offset, LayerNorm
+with bias, relu MLP (fc1/fc2 with bias), pre-LN (``do_layer_norm_before``), tied
+LM head. Module names mirror HF opt keys
+(``model.decoder.layers.{i}.self_attn.q_proj`` ...) so the checkpoint mapping is
+fully mechanical and invertible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import ACT2FN, VocabEmbed, _maybe_remat
+from ..llama.modeling import LlamaPretrainingCriterion as OPTPretrainingCriterion
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import OPTConfig
+
+__all__ = ["OPTModel", "OPTForCausalLM", "OPTPretrainedModel", "OPTPretrainingCriterion"]
+
+POSITION_OFFSET = 2  # OPT reserves the first two learned-position rows
+
+
+def _ln(cfg, dtype, param_dtype, name):
+    return nn.LayerNorm(epsilon=1e-5, dtype=dtype, param_dtype=param_dtype, name=name)
+
+
+def _dense(features, cfg, dtype, param_dtype, name):
+    return nn.Dense(features, use_bias=True, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+class OPTAttention(nn.Module):
+    config: OPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, layer_kv, offset, deterministic):
+        cfg = self.config
+        B, T, D = x.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "q_proj")(x).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "k_proj")(x).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "v_proj")(x).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+        q_offset = 0
+        new_kv = None
+        if layer_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(layer_kv[0], layer_kv[1], k, v, offset)
+            new_kv = (k, v)
+        drop = cfg.attention_dropout if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        out = dot_product_attention(q, k, v, attention_mask=attention_mask, segment_ids=segment_ids,
+                                    causal=True, q_offset=q_offset, dropout_rate=drop,
+                                    dropout_rng=rng).reshape(B, T, D)
+        return _dense(D, cfg, self.dtype, self.param_dtype, "out_proj")(out), new_kv
+
+
+class OPTDecoderLayer(nn.Module):
+    """Scan-compatible: carry = (h, offset, aux)."""
+
+    config: OPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_kv, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        residual = h
+        x = _ln(cfg, self.dtype, self.param_dtype, "self_attn_layer_norm")(h) \
+            if cfg.do_layer_norm_before else h
+        attn = OPTAttention(cfg, self.dtype, self.param_dtype, name="self_attn")
+        attn_out, new_kv = attn(x, attention_mask, segment_ids, layer_kv, offset, deterministic)
+        h = residual + attn_out
+        if not cfg.do_layer_norm_before:
+            h = _ln(cfg, self.dtype, self.param_dtype, "self_attn_layer_norm")(h)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        residual = h
+        x = _ln(cfg, self.dtype, self.param_dtype, "final_layer_norm")(h) \
+            if cfg.do_layer_norm_before else h
+        x = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "fc1")(x)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = shard_constraint(x, P("batch", "seq", "act_mlp"))
+        x = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "fc2")(x)
+        h = residual + x
+        if not cfg.do_layer_norm_before:
+            h = _ln(cfg, self.dtype, self.param_dtype, "final_layer_norm")(h)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_kv
+
+
+class OPTDecoderModule(nn.Module):
+    config: OPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[KVCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        B, T = input_ids.shape if input_ids is not None else inputs_embeds.shape[:2]
+        if inputs_embeds is None:
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="embed_tokens")(input_ids)
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :] + offset
+        pos_embed = nn.Embed(cfg.max_position_embeddings + POSITION_OFFSET, cfg.hidden_size,
+                             dtype=self.dtype, param_dtype=self.param_dtype,
+                             embedding_init=nn.initializers.normal(cfg.initializer_range),
+                             name="embed_positions")
+        h = inputs_embeds + pos_embed(position_ids + POSITION_OFFSET)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        layer_cls = _maybe_remat(OPTDecoderLayer, cfg)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+        aux = jnp.zeros((), jnp.float32)
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
+            )
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
+                (h, offset, aux), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                cache = KVCache(keys=new_kv[0], values=new_kv[1], offset=offset + T)
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        if cfg.do_layer_norm_before:
+            h = _ln(cfg, self.dtype, self.param_dtype, "final_layer_norm")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
+                                       hidden_states=tuple(all_hidden) if all_hidden else None,
+                                       aux_loss=aux)
+
+
+class OPTModule(nn.Module):
+    config: OPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        return OPTDecoderModule(self.config, self.dtype, self.param_dtype, name="decoder")(*args, **kwargs)
+
+
+class OPTForCausalLMModule(nn.Module):
+    config: OPTConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = OPTModule(cfg, self.dtype, self.param_dtype, name="model")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        embedding = self.get_variable("params", "model")["decoder"]["embed_tokens"]["embedding"]
+        logits = h @ embedding.T.astype(self.dtype)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states, aux_loss=outputs.aux_loss)
+
+
+class OPTPretrainedModel(PretrainedModel):
+    config_class = OPTConfig
+    base_model_prefix = "model"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"embed_tokens/embedding$", P("vocab", "embed")),
+            (r"embed_positions/embedding$", P(None, "embed")),
+            (r"self_attn/(q_proj|k_proj|v_proj)/kernel$", P("embed", "heads")),
+            (r"self_attn/(q_proj|k_proj|v_proj)/bias$", P("heads")),
+            (r"self_attn/out_proj/kernel$", P("heads", "embed")),
+            (r"fc1/kernel$", P("embed", "mlp")),
+            (r"fc1/bias$", P("mlp")),
+            (r"fc2/kernel$", P("mlp", "embed")),
+            (r"(layer_norm|final_layer_norm)/(scale|bias)$", P()),
+        ]
+
+
+class OPTModel(OPTPretrainedModel):
+    module_class = OPTModule
+
+
+class OPTForCausalLM(OPTPretrainedModel):
+    module_class = OPTForCausalLMModule

@@ -1,0 +1,7 @@
+from .configuration import QWenConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    QWenForCausalLM,
+    QWenModel,
+    QWenPretrainedModel,
+    QWenPretrainingCriterion,
+)

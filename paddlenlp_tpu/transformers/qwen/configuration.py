@@ -1,0 +1,53 @@
+"""Qwen (v1) configuration (reference: paddlenlp/transformers/qwen; HF QWenLMHeadModel).
+
+HF's ``intermediate_size`` is 2x the actual ffn width (the torch module halves
+it for w1/w2); ``ffn_hidden`` below is the real per-projection width.
+"""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["QWenConfig"]
+
+
+class QWenConfig(PretrainedConfig):
+    model_type = "qwen"
+
+    def __init__(
+        self,
+        vocab_size: int = 151936,
+        hidden_size: int = 4096,
+        intermediate_size: int = 22016,
+        num_hidden_layers: int = 32,
+        num_attention_heads: int = 32,
+        hidden_act: str = "silu",
+        max_position_embeddings: int = 8192,
+        initializer_range: float = 0.02,
+        layer_norm_epsilon: float = 1e-6,
+        rotary_emb_base: float = 10000.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_attention_heads  # MHA
+        self.head_dim = hidden_size // num_attention_heads
+        self.hidden_act = hidden_act
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.rms_norm_eps = layer_norm_epsilon
+        self.rope_theta = rotary_emb_base
+        self.rope_scaling = None
+        # qwen1: fused qkv with bias; o_proj / mlp without
+        self.attention_bias = True
+        self.attention_out_bias = False
+        self.mlp_bias = False
+        kwargs.setdefault("tie_word_embeddings", False)
+        super().__init__(**kwargs)
+
+    @property
+    def ffn_hidden(self) -> int:
+        return self.intermediate_size // 2

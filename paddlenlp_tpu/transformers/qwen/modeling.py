@@ -1,0 +1,117 @@
+"""Qwen (v1), TPU-native.
+
+Counterpart of ``paddlenlp/transformers/qwen/modeling.py`` (HF QWenLMHeadModel).
+Qwen1 is the LLaMA computation graph with qkv bias, SwiGLU at width
+``intermediate_size // 2`` (w2 is the gate, w1 the up projection), and a fused
+``c_attn`` qkv in the HF checkpoint layout. The blocks reuse the llama linen
+modules (class-attribute overrides); the checkpoint mapping renames the
+transformer.h.* keys and splits ``c_attn``.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..conversion_utils import StackedLayerMapping, StateDictNameMapping, auto_name_mappings
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..llama.modeling import (
+    LlamaDecoderLayer,
+    _dense,
+    LlamaForCausalLMModule,
+    LlamaMLP,
+    LlamaModule,
+    LlamaPretrainedModel,
+    LlamaPretrainingCriterion,
+)
+from .configuration import QWenConfig
+
+__all__ = ["QWenModel", "QWenForCausalLM", "QWenPretrainedModel", "QWenPretrainingCriterion"]
+
+
+class QWenMLP(LlamaMLP):
+    """SwiGLU at half the HF-reported intermediate size (w2 gate / w1 up)."""
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        F = cfg.ffn_hidden
+        gate = _dense(F, False, cfg, self.dtype, self.param_dtype, "gate_proj")(x)
+        up = _dense(F, False, cfg, self.dtype, self.param_dtype, "up_proj")(x)
+        h = nn.silu(gate) * up
+        h = shard_constraint(h, P("batch", "seq", "act_mlp"))
+        return _dense(cfg.hidden_size, False, cfg, self.dtype, self.param_dtype, "down_proj")(h)
+
+
+class QWenDecoderLayer(LlamaDecoderLayer):
+    mlp_cls = QWenMLP
+
+
+class QWenModule(LlamaModule):
+    decoder_layer_cls = QWenDecoderLayer
+
+
+class QWenForCausalLMModule(LlamaForCausalLMModule):
+    base_module_cls = QWenModule
+
+
+class QWenPretrainedModel(LlamaPretrainedModel):
+    config_class = QWenConfig
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        D = config.hidden_size
+        idx = {"q_proj": 0, "k_proj": 1, "v_proj": 2}
+
+        def rename(src: str) -> str:
+            src = src.replace("model.", "transformer.", 1)
+            src = src.replace("transformer.layers.", "transformer.h.")
+            src = src.replace("embed_tokens", "wte")
+            src = src.replace("input_layernorm", "ln_1")
+            src = src.replace("post_attention_layernorm", "ln_2")
+            src = src.replace("self_attn.o_proj", "attn.c_proj")
+            src = src.replace("mlp.gate_proj", "mlp.w2")
+            src = src.replace("mlp.up_proj", "mlp.w1")
+            src = src.replace("mlp.down_proj", "mlp.c_proj")
+            src = src.replace("transformer.norm.", "transformer.ln_f.")
+            return src
+
+        out = []
+        for m in auto_name_mappings(flat_shapes):
+            t = m.target_name
+            hit = re.search(r"self_attn/(q_proj|k_proj|v_proj)/(kernel|bias)$", t)
+            if hit:
+                i, kind = idx[hit.group(1)], hit.group(2)
+                if kind == "kernel":
+                    fn = (lambda i: lambda a: np.ascontiguousarray(a[i * D:(i + 1) * D].T))(i)
+                else:
+                    fn = (lambda i: lambda a: np.ascontiguousarray(a[i * D:(i + 1) * D]))(i)
+                src = rename(m.source_name)
+                src = re.sub(r"attn\.(q_proj|k_proj|v_proj)|self_attn\.(q_proj|k_proj|v_proj)",
+                             "attn.c_attn", src)
+                if isinstance(m, StackedLayerMapping):
+                    out.append(StackedLayerMapping(src, t, dims=m.dims, fn=fn))
+                else:
+                    out.append(StateDictNameMapping(src, t, fn=fn))
+                continue
+            if isinstance(m, StackedLayerMapping):
+                m.source_template = rename(m.source_template)
+                out.append(m)
+            else:
+                out.append(StateDictNameMapping(rename(m.source_name), t, m.action, m.fn))
+        return out
+
+
+class QWenModel(QWenPretrainedModel):
+    module_class = QWenModule
+
+
+class QWenForCausalLM(QWenPretrainedModel):
+    module_class = QWenForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+
+QWenPretrainingCriterion = LlamaPretrainingCriterion

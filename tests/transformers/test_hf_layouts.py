@@ -1,0 +1,110 @@
+"""HF checkpoint-layout loading for fused/renamed architectures: a checkpoint in
+the TRUE HF key layout (fused W_pack / c_attn, transformer.h.* renames) must load
+and reproduce the logits of the originating model, and our own saved checkpoints
+must round-trip through the mechanical fallback keys."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from safetensors.numpy import save_file
+
+from paddlenlp_tpu.transformers import (
+    BaichuanConfig,
+    BaichuanForCausalLM,
+    QWenConfig,
+    QWenForCausalLM,
+)
+from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+TINY = dict(vocab_size=96, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64)
+
+
+def _write_ckpt(tmp_path, config, tensors):
+    d = tmp_path / "hf"
+    d.mkdir()
+    config.save_pretrained(str(d))
+    save_file({k: np.ascontiguousarray(v) for k, v in tensors.items()},
+              os.path.join(str(d), "model.safetensors"), metadata={"format": "np"})
+    return str(d)
+
+
+class TestBaichuanWPack:
+    def test_fused_wpack_loads(self, tmp_path):
+        model = BaichuanForCausalLM.from_config(BaichuanConfig(intermediate_size=112, **TINY), seed=0)
+        ids = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        ref = model(input_ids=ids).logits
+        flat = {k: np.asarray(v) for k, v in flatten_params(model.params).items()}
+        D = 64
+        tensors = {}
+        for i in range(2):
+            qkv = [flat[f"model/layers/self_attn/{p}_proj/kernel"][i].T for p in "qkv"]
+            tensors[f"model.layers.{i}.self_attn.W_pack.weight"] = np.concatenate(qkv, axis=0)
+        for path, arr in flat.items():
+            if "/self_attn/q_proj" in path or "/self_attn/k_proj" in path or "/self_attn/v_proj" in path:
+                continue
+            if "/layers/" in path:
+                for i in range(2):
+                    key = ("model.layers.%d." % i) + path.split("/layers/")[1].replace("/", ".")
+                    key = key.replace(".kernel", ".weight").replace(".scale", ".weight")
+                    a = arr[i]
+                    tensors[key] = a.T if path.endswith("kernel") else a
+            else:
+                key = path.replace("/", ".").replace(".kernel", ".weight") \
+                          .replace(".scale", ".weight").replace(".embedding", ".weight")
+                tensors[key] = arr.T if path.endswith("kernel") else arr
+        d = _write_ckpt(tmp_path, model.config, tensors)
+        loaded = BaichuanForCausalLM.from_pretrained(d)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(loaded(input_ids=ids).logits), atol=1e-5)
+
+    def test_own_checkpoint_roundtrip(self, tmp_path):
+        model = BaichuanForCausalLM.from_config(BaichuanConfig(intermediate_size=112, **TINY), seed=1)
+        ids = jnp.asarray([[5, 6, 7]], jnp.int32)
+        model.save_pretrained(str(tmp_path / "own"))
+        loaded = BaichuanForCausalLM.from_pretrained(str(tmp_path / "own"))
+        np.testing.assert_allclose(np.asarray(model(input_ids=ids).logits),
+                                   np.asarray(loaded(input_ids=ids).logits), atol=1e-5)
+
+
+class TestQWenCAttn:
+    def test_fused_c_attn_loads(self, tmp_path):
+        model = QWenForCausalLM.from_config(QWenConfig(intermediate_size=224, **TINY), seed=0)
+        ids = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        ref = model(input_ids=ids).logits
+        flat = {k: np.asarray(v) for k, v in flatten_params(model.params).items()}
+        rename = {
+            "input_layernorm": "ln_1", "post_attention_layernorm": "ln_2",
+            "self_attn.o_proj": "attn.c_proj", "mlp.gate_proj": "mlp.w2",
+            "mlp.up_proj": "mlp.w1", "mlp.down_proj": "mlp.c_proj",
+        }
+        tensors = {}
+        for i in range(2):
+            qkv_w = [flat[f"model/layers/self_attn/{p}_proj/kernel"][i].T for p in "qkv"]
+            qkv_b = [flat[f"model/layers/self_attn/{p}_proj/bias"][i] for p in "qkv"]
+            tensors[f"transformer.h.{i}.attn.c_attn.weight"] = np.concatenate(qkv_w, axis=0)
+            tensors[f"transformer.h.{i}.attn.c_attn.bias"] = np.concatenate(qkv_b, axis=0)
+        for path, arr in flat.items():
+            if "/self_attn/q_proj" in path or "/self_attn/k_proj" in path or "/self_attn/v_proj" in path:
+                continue
+            if "/layers/" in path:
+                for i in range(2):
+                    sub = path.split("/layers/")[1].replace("/", ".")
+                    for a, b in rename.items():
+                        sub = sub.replace(a, b)
+                    key = f"transformer.h.{i}." + sub
+                    key = key.replace(".kernel", ".weight").replace(".scale", ".weight")
+                    tensors[key] = arr[i].T if path.endswith("kernel") else arr[i]
+            elif path == "model/embed_tokens/embedding":
+                tensors["transformer.wte.weight"] = arr
+            elif path == "model/norm/scale":
+                tensors["transformer.ln_f.weight"] = arr
+            elif path == "lm_head/kernel":
+                tensors["lm_head.weight"] = arr.T
+            else:
+                raise AssertionError(f"unmapped {path}")
+        d = _write_ckpt(tmp_path, model.config, tensors)
+        loaded = QWenForCausalLM.from_pretrained(d)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(loaded(input_ids=ids).logits), atol=1e-5)

@@ -10,7 +10,17 @@ import pytest
 
 from paddlenlp_tpu.parallel import MeshConfig, create_mesh, use_mesh
 from paddlenlp_tpu.transformers import (
+    BaichuanConfig,
+    BaichuanForCausalLM,
     BertConfig,
+    BloomConfig,
+    BloomForCausalLM,
+    ChatGLMv2Config,
+    ChatGLMv2ForCausalLM,
+    OPTConfig,
+    OPTForCausalLM,
+    QWenConfig,
+    QWenForCausalLM,
     BertForMaskedLM,
     BertForSequenceClassification,
     ErnieConfig,
@@ -44,6 +54,15 @@ CAUSAL_CASES = {
     "gemma": (GemmaForCausalLM, lambda: GemmaConfig(vocab_size=96, intermediate_size=112,
                                                     num_key_value_heads=2, head_dim=16, **TINY)),
     "gpt": (GPTForCausalLM, lambda: GPTConfig(vocab_size=96, **TINY)),
+    "baichuan": (BaichuanForCausalLM, lambda: BaichuanConfig(vocab_size=96, intermediate_size=112, **TINY)),
+    "baichuan_alibi": (BaichuanForCausalLM, lambda: BaichuanConfig(vocab_size=96, intermediate_size=112,
+                                                                   use_alibi=True, **TINY)),
+    "qwen": (QWenForCausalLM, lambda: QWenConfig(vocab_size=96, intermediate_size=224, **TINY)),
+    "bloom": (BloomForCausalLM, lambda: BloomConfig(vocab_size=96, **TINY)),
+    "opt": (OPTForCausalLM, lambda: OPTConfig(vocab_size=96, intermediate_size=128, **TINY)),
+    "chatglm_v2": (ChatGLMv2ForCausalLM, lambda: ChatGLMv2Config(vocab_size=96, intermediate_size=112,
+                                                                 multi_query_group_num=2, kv_channels=16,
+                                                                 **TINY)),
     "mixtral": (MixtralForCausalLM, lambda: MixtralConfig(vocab_size=96, intermediate_size=80,
                                                           num_key_value_heads=2, num_local_experts=4,
                                                           num_experts_per_tok=2, **TINY)),
