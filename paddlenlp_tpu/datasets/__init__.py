@@ -3,3 +3,10 @@ from .zero_padding_dataset import (  # noqa: F401
     ZeroPaddingMapDataset,
     greedy_pack,
 )
+from .dataset import (  # noqa: F401
+    DATASET_REGISTRY,
+    IterDataset,
+    MapDataset,
+    load_dataset,
+    register_dataset,
+)

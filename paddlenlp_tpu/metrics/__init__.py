@@ -3,3 +3,5 @@ from .classification import AccuracyAndF1, MultiLabelsMetric  # noqa: F401
 from .distinct import Distinct  # noqa: F401
 from .perplexity import Perplexity  # noqa: F401
 from .rouge import Rouge1, Rouge2, RougeL  # noqa: F401
+from .glue import Mcc, PearsonAndSpearman  # noqa: F401
+from .squad import compute_exact, compute_f1, squad_evaluate  # noqa: F401

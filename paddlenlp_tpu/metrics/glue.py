@@ -1,0 +1,63 @@
+"""GLUE/CLUE-style metrics (reference: paddlenlp/metrics/glue.py —
+AccuracyAndF1, Mcc, PearsonAndSpearman)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["AccuracyAndF1", "Mcc", "PearsonAndSpearman"]
+
+from .classification import AccuracyAndF1  # noqa: E402,F401 — shared accumulator
+
+
+class Mcc:
+    """Matthews correlation coefficient (CoLA)."""
+
+    def __init__(self):
+        self.preds, self.labels = [], []
+
+    def reset(self):
+        self.preds, self.labels = [], []
+
+    def update(self, preds, labels):
+        self.preds.append(np.asarray(preds).reshape(-1))
+        self.labels.append(np.asarray(labels).reshape(-1))
+
+    def accumulate(self):
+        p = np.concatenate(self.preds)
+        l = np.concatenate(self.labels)
+        tp = float(((p == 1) & (l == 1)).sum())
+        tn = float(((p == 0) & (l == 0)).sum())
+        fp = float(((p == 1) & (l == 0)).sum())
+        fn = float(((p == 0) & (l == 1)).sum())
+        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return {"mcc": (tp * tn - fp * fn) / denom if denom else 0.0}
+
+
+class PearsonAndSpearman:
+    """Regression correlation (STS-B)."""
+
+    def __init__(self):
+        self.preds, self.labels = [], []
+
+    def reset(self):
+        self.preds, self.labels = [], []
+
+    def update(self, preds, labels):
+        self.preds.append(np.asarray(preds, np.float64).reshape(-1))
+        self.labels.append(np.asarray(labels, np.float64).reshape(-1))
+
+    @staticmethod
+    def _pearson(a, b):
+        a, b = a - a.mean(), b - b.mean()
+        d = np.sqrt((a**2).sum() * (b**2).sum())
+        return float((a * b).sum() / d) if d else 0.0
+
+    def accumulate(self):
+        p = np.concatenate(self.preds)
+        l = np.concatenate(self.labels)
+        pear = self._pearson(p, l)
+        rp = np.argsort(np.argsort(p)).astype(np.float64)
+        rl = np.argsort(np.argsort(l)).astype(np.float64)
+        spear = self._pearson(rp, rl)
+        return {"pearson": pear, "spearman": spear, "corr": (pear + spear) / 2}

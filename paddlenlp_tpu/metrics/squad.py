@@ -1,0 +1,51 @@
+"""SQuAD-style span-QA evaluation (reference: paddlenlp/metrics/squad.py —
+squad_evaluate: exact match + token-level F1 over normalized answers)."""
+
+from __future__ import annotations
+
+import collections
+import re
+import string
+from typing import Dict, List
+
+__all__ = ["squad_evaluate", "compute_exact", "compute_f1"]
+
+
+def _normalize(text: str) -> str:
+    text = text.lower()
+    text = "".join(ch for ch in text if ch not in set(string.punctuation))
+    text = re.sub(r"\b(a|an|the)\b", " ", text)
+    return " ".join(text.split())
+
+
+def compute_exact(a_gold: str, a_pred: str) -> int:
+    return int(_normalize(a_gold) == _normalize(a_pred))
+
+
+def compute_f1(a_gold: str, a_pred: str) -> float:
+    gold = _normalize(a_gold).split()
+    pred = _normalize(a_pred).split()
+    if not gold or not pred:
+        return float(gold == pred)
+    common = collections.Counter(gold) & collections.Counter(pred)
+    overlap = sum(common.values())
+    if overlap == 0:
+        return 0.0
+    precision = overlap / len(pred)
+    recall = overlap / len(gold)
+    return 2 * precision * recall / (precision + recall)
+
+
+def squad_evaluate(examples: List[Dict], preds: Dict[str, str]) -> Dict[str, float]:
+    """examples: [{"id", "answers": [str, ...]}]; preds: {id: answer_text}."""
+    em = f1 = 0.0
+    for ex in examples:
+        pid = ex["id"]
+        pred = preds.get(pid, "")
+        answers = ex.get("answers") or [""]
+        if isinstance(answers, dict):  # HF format {"text": [...]}
+            answers = answers.get("text") or [""]
+        em += max(compute_exact(a, pred) for a in answers)
+        f1 += max(compute_f1(a, pred) for a in answers)
+    n = max(len(examples), 1)
+    return {"exact": 100.0 * em / n, "f1": 100.0 * f1 / n, "total": len(examples)}

@@ -1,0 +1,2 @@
+from .adamwdl import adamwdl, layerwise_lr_decay_mask  # noqa: F401
+from .ema import ExponentialMovingAverage, ema  # noqa: F401

@@ -1,0 +1,73 @@
+"""AdamW with layer-wise learning-rate decay (reference:
+``paddlenlp/ops/optimizer/adamwdl.py`` — AdamWDL, the BERT/ELECTRA finetuning
+staple: lr(layer) = base_lr * decay^(n_layers - layer)).
+
+optax-native: one ``optax.multi_transform`` over per-depth scale groups; the
+depth of a param is parsed from its path (``layers_<i>`` / ``layers``-stacked /
+``h_<i>`` segments; embeddings get depth -1, heads get n_layers).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+import optax
+
+__all__ = ["adamwdl", "layerwise_lr_decay_mask"]
+
+_DEPTH_RE = re.compile(r"(?:layers?|h|blocks?)_(\d+)\b")
+
+
+def _param_depth(path: str, n_layers: int) -> int:
+    m = _DEPTH_RE.search(path)
+    if m:
+        return int(m.group(1))
+    if any(k in path for k in ("embed", "wte", "wpe", "word_embeddings", "position_embeddings")):
+        return -1
+    if "/layers/" in f"/{path}" or "/h/" in f"/{path}":
+        return -2  # scanned stack: one shared tensor spans all depths
+    return n_layers  # head / final norm
+
+
+def layerwise_lr_decay_mask(params, n_layers: int) -> dict:
+    """pytree of depth labels matching ``params`` (for multi_transform)."""
+    from ...transformers.conversion_utils import flatten_params, unflatten_params
+
+    flat = flatten_params(params)
+    return unflatten_params({p: str(_param_depth(p, n_layers)) for p in flat})
+
+
+def adamwdl(
+    learning_rate,
+    n_layers: int,
+    layerwise_decay: float = 0.8,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+    wd_mask: Optional[Callable] = None,
+) -> optax.GradientTransformation:
+    """AdamW where depth d gets lr scale ``layerwise_decay^(n_layers - d)``.
+
+    A scanned [L]-stacked param (depth label -2) cannot vary lr across its own
+    leading axis with a scalar scale; it receives the mean scale (exact per-layer
+    scaling needs the unrolled layout).
+    """
+    def tx_for(scale: float):
+        return optax.chain(
+            optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                        weight_decay=weight_decay, mask=wd_mask),
+            optax.scale(scale),
+        )
+
+    scales = {str(d): layerwise_decay ** (n_layers - d) for d in range(n_layers)}
+    scales[str(-1)] = layerwise_decay ** (n_layers + 1)
+    scales[str(n_layers)] = 1.0
+    scales[str(-2)] = sum(layerwise_decay ** (n_layers - d) for d in range(n_layers)) / n_layers
+
+    def label_fn(params):
+        return layerwise_lr_decay_mask(params, n_layers)
+
+    return optax.multi_transform({k: tx_for(v) for k, v in scales.items()}, label_fn)

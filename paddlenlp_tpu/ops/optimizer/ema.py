@@ -1,0 +1,53 @@
+"""Exponential moving average of params (reference:
+``paddlenlp/ops/optimizer/ema.py``). Functional: ``ema()`` is an optax-style
+state transform; ``ExponentialMovingAverage`` is the stateful facade the
+reference exposes (update/apply/restore)."""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ema", "ExponentialMovingAverage"]
+
+
+class EMAState(NamedTuple):
+    shadow: Any
+    count: jnp.ndarray
+
+
+def ema(decay: float = 0.999, debias: bool = True):
+    """Returns (init_fn, update_fn): shadow = decay*shadow + (1-decay)*params."""
+
+    def init(params):
+        return EMAState(shadow=jax.tree.map(jnp.asarray, params), count=jnp.zeros((), jnp.int32))
+
+    def update(params, state: EMAState) -> EMAState:
+        count = state.count + 1
+        d = jnp.minimum(decay, (1.0 + count) / (10.0 + count)) if debias else decay
+        shadow = jax.tree.map(lambda s, p: s * d + p.astype(s.dtype) * (1.0 - d),
+                              state.shadow, params)
+        return EMAState(shadow=shadow, count=count)
+
+    return init, update
+
+
+class ExponentialMovingAverage:
+    def __init__(self, params, decay: float = 0.999, debias: bool = True):
+        self._init, self._update = ema(decay, debias)
+        self.state = self._init(params)
+        self._backup = None
+
+    def update(self, params):
+        self.state = jax.jit(self._update)(params, self.state)
+
+    def apply(self, params):
+        """Return EMA params (callers swap them in for eval)."""
+        self._backup = params
+        return self.state.shadow
+
+    def restore(self):
+        params, self._backup = self._backup, None
+        return params

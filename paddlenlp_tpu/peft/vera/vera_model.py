@@ -1,0 +1,198 @@
+"""VeRA: Vector-based Random-matrix Adaptation, TPU-native.
+
+Counterpart of ``paddlenlp/peft/vera/`` (``VeRAModel``). One pair of FROZEN
+random low-rank bases (A [in, r], B [r, out]) is SHARED by every adapted kernel
+of the same shape; only per-layer scaling vectors train:
+
+    W' = W + (A * d) @ (B * b)      d [r] (init ``d_initial``), b [out] (init 0)
+
+~10-100x fewer trainable params than LoRA at the same rank. Same facade design
+as LoRAModel: no module surgery — the forward functionally merges the update
+before the unchanged base module applies; scanned [L] stacks carry the vectors
+per layer while the bases stay unstacked.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...transformers.conversion_utils import flatten_params, unflatten_params
+from ...utils.log import logger
+from ...utils.safetensors_io import SafeFile, save_file
+from .vera_config import DEFAULT_TARGETS, VeRAConfig
+
+__all__ = ["VeRAModel"]
+
+VERA_WEIGHTS_NAME = "vera_model.safetensors"
+SHARED_KEY = "vera_shared"
+
+
+def _merge_vera(params: dict) -> dict:
+    shared = params.get(SHARED_KEY, {})
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {k: walk(v) for k, v in node.items() if k != SHARED_KEY}
+        if "kernel" in out and "vera_d" in out and "vera_b" in out:
+            k = out["kernel"]
+            in_dim, out_dim = k.shape[-2], k.shape[-1]
+            base = shared[f"{in_dim}x{out_dim}"]
+            a = base["A"].astype(jnp.float32)  # [in, r]
+            b = base["B"].astype(jnp.float32)  # [r, out]
+            d = out["vera_d"].astype(jnp.float32)  # [..., r]
+            bv = out["vera_b"].astype(jnp.float32)  # [..., out]
+            # per-layer leading axes broadcast against the shared bases
+            delta = (a * d[..., None, :]) @ b * bv[..., None, :]
+            out = dict(out)
+            out["kernel"] = (k.astype(jnp.float32) + delta).astype(k.dtype)
+        return out
+
+    merged = walk(params)
+    merged[SHARED_KEY] = shared  # keep tree structure stable for jit
+    return merged
+
+
+class _VeRAMergedModule:
+    def __init__(self, base_module):
+        self._base = base_module
+        self.dtype = getattr(base_module, "dtype", jnp.float32)
+
+    def apply(self, variables, *args, **kwargs):
+        params = variables["params"] if "params" in variables else variables
+        merged = {k: v for k, v in _merge_vera(params).items() if k != SHARED_KEY}
+        return self._base.apply({"params": merged}, *args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._base, item)
+
+
+class VeRAModel:
+    """Wraps a PretrainedModel; quacks like one (module/params/config/generate)."""
+
+    def __init__(self, model, vera_config: Optional[VeRAConfig] = None, params: Optional[dict] = None):
+        self.model = model
+        self.vera_config = vera_config or VeRAConfig()
+        self.config = model.config
+        self.dtype = model.dtype
+        self.generation_config = model.generation_config
+        patterns = self.vera_config.target_modules or DEFAULT_TARGETS
+        self._target_res = [re.compile(p if p.endswith("$") or "/" in p else rf"\b{p}\b") for p in patterns]
+        self.params = params if params is not None else self._init_vera_params(model.params)
+        self.module = _VeRAMergedModule(model.module)
+        self.mesh = model.mesh
+        self._jit_cache: Dict[Any, Any] = {}
+
+    def _matches(self, kernel_path: str) -> bool:
+        module_path = kernel_path.rsplit("/", 1)[0]
+        return any(p.search(module_path) or p.search(kernel_path) for p in self._target_res)
+
+    def _init_vera_params(self, base_params: dict) -> dict:
+        cfg = self.vera_config
+        rng = np.random.default_rng(cfg.seed)
+        flat = flatten_params(base_params)
+        out = dict(flat)
+        shared: Dict[str, np.ndarray] = {}
+        added = 0
+        for path, leaf in flat.items():
+            if not path.endswith("/kernel") or getattr(leaf, "ndim", 0) < 2 or not self._matches(path):
+                continue
+            in_dim, out_dim = leaf.shape[-2], leaf.shape[-1]
+            lead = leaf.shape[:-2]
+            key = f"{in_dim}x{out_dim}"
+            if f"{SHARED_KEY}/{key}/A" not in shared:
+                shared[f"{SHARED_KEY}/{key}/A"] = (
+                    rng.standard_normal((in_dim, cfg.r)).astype(np.float32) / np.sqrt(in_dim)
+                )
+                shared[f"{SHARED_KEY}/{key}/B"] = (
+                    rng.standard_normal((cfg.r, out_dim)).astype(np.float32) / np.sqrt(cfg.r)
+                )
+            prefix = path.rsplit("/", 1)[0]
+            out[prefix + "/vera_d"] = jnp.full(lead + (cfg.r,), cfg.d_initial, jnp.float32)
+            out[prefix + "/vera_b"] = jnp.zeros(lead + (out_dim,), jnp.float32)
+            added += 1
+        if added == 0:
+            raise ValueError(f"no modules matched VeRA target patterns {cfg.target_modules}")
+        out.update({k: jnp.asarray(v) for k, v in shared.items()})
+        logger.info(f"VeRA: {added} kernels adapted (r={cfg.r}, {len(shared) // 2} shared basis pairs)")
+        return unflatten_params(out)
+
+    # ------------------------------------------------------------------ training glue
+    def trainable_mask(self) -> dict:
+        flat = flatten_params(self.params)
+        mask = {p: ("/vera_d" in p or "/vera_b" in p) for p in flat}
+        return unflatten_params(mask)
+
+    def print_trainable_parameters(self):
+        flat = flatten_params(self.params)
+        total = sum(int(np.prod(v.shape)) for v in flat.values())
+        trainable = sum(int(np.prod(v.shape)) for p, v in flat.items()
+                        if "/vera_d" in p or "/vera_b" in p)
+        logger.info(f"trainable params: {trainable:,} / {total:,} ({100 * trainable / total:.4f}%)")
+
+    # ------------------------------------------------------------------ facade
+    def __call__(self, *args, **kwargs):
+        params = kwargs.pop("params", None)
+        orig_params, orig_module = self.model.params, self.model.module
+        self.model.params = params if params is not None else self.params
+        self.model.module = self.module
+        try:
+            return self.model(*args, **kwargs)
+        finally:
+            self.model.params = orig_params
+            self.model.module = orig_module
+
+    def apply(self, params, *args, **kwargs):
+        return self.module.apply({"params": params}, *args, **kwargs)
+
+    def generate(self, *args, **kwargs):
+        kwargs.setdefault("params", self.params)
+        orig_module = self.model.module
+        self.model.module = self.module
+        try:
+            return self.model.generate(*args, **kwargs)
+        finally:
+            self.model.module = orig_module
+
+    def num_parameters(self, params=None):
+        return self.model.num_parameters(params if params is not None else self.params)
+
+    def get_model_flops(self, *a, **kw):
+        return self.model.get_model_flops(*a, **kw)
+
+    def get_partition_rules_instance(self):
+        from ...parallel.partition import P
+
+        base = list(type(self.model).get_partition_rules(self.config))
+        # vectors are tiny: replicate; shared bases follow the kernel dims loosely
+        return base + [(r"vera_(d|b)$", P()), (rf"{SHARED_KEY}/.*/(A|B)$", P())]
+
+    # ------------------------------------------------------------------ save/load
+    def save_pretrained(self, save_directory: str, **kw):
+        os.makedirs(save_directory, exist_ok=True)
+        self.vera_config.save_pretrained(save_directory)
+        flat = flatten_params(self.params)
+        tensors = {p: np.asarray(jax.device_get(v)) for p, v in flat.items()
+                   if "/vera_" in p or p.startswith(SHARED_KEY + "/")}
+        save_file(tensors, os.path.join(save_directory, VERA_WEIGHTS_NAME), metadata={"format": "np"})
+        logger.info(f"VeRA adapters saved to {save_directory}")
+
+    @classmethod
+    def from_pretrained(cls, model, vera_path: str) -> "VeRAModel":
+        config = VeRAConfig.from_pretrained(vera_path)
+        obj = cls(model, config)
+        flat = flatten_params(obj.params)
+        with SafeFile(os.path.join(vera_path, VERA_WEIGHTS_NAME)) as sf:
+            for key in sf.keys():
+                if key not in flat:
+                    logger.warning(f"adapter key {key} not in model; skipping")
+                    continue
+                flat[key] = jnp.asarray(sf.get_tensor(key))
+        obj.params = unflatten_params(flat)
+        return obj

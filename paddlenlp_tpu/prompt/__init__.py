@@ -1,0 +1,7 @@
+from .prompt_trainer import (  # noqa: F401
+    PromptModelForClassification,
+    PromptTrainer,
+    SoftPromptModelForCausalLM,
+)
+from .template import ManualTemplate  # noqa: F401
+from .verbalizer import ManualVerbalizer  # noqa: F401

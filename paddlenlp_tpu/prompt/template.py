@@ -1,0 +1,49 @@
+"""Prompt templates (reference: paddlenlp/prompt/template.py — ManualTemplate /
+SoftTemplate over PET-style format strings)."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+__all__ = ["ManualTemplate"]
+
+
+class ManualTemplate:
+    """Hard-text template: ``"{'text': 'text_a'} It was {'mask'}."`` or a plain
+    python format string with named fields + ``{mask}``."""
+
+    def __init__(self, template: str, tokenizer, max_length: int = 128):
+        self.template = template
+        self.tokenizer = tokenizer
+        self.max_length = max_length
+        if tokenizer.mask_token is None:
+            raise ValueError("template requires a tokenizer with a mask token")
+
+    def render(self, example: Dict) -> str:
+        text = self.template
+        # PET-style {'text': 'field'} and {'mask'} pieces
+        def sub(m):
+            body = m.group(1)
+            if "mask" in body:
+                return self.tokenizer.mask_token
+            f = re.search(r"'text'\s*:\s*'(\w+)'", body)
+            if f:
+                return str(example[f.group(1)])
+            return m.group(0)
+
+        text = re.sub(r"\{([^{}]*)\}", lambda m: sub(m) if ("'" in m.group(1) or m.group(1) == "mask")
+                      else str(example.get(m.group(1), m.group(0))), text)
+        return text
+
+    def __call__(self, example: Dict) -> Dict:
+        enc = self.tokenizer(self.render(example), max_length=self.max_length, truncation=True)
+        ids = enc["input_ids"]
+        mask_positions = [i for i, t in enumerate(ids) if t == self.tokenizer.mask_token_id]
+        if not mask_positions:
+            raise ValueError(f"template produced no mask token: {self.render(example)!r}")
+        out = {"input_ids": ids, "attention_mask": enc.get("attention_mask", [1] * len(ids)),
+               "mask_position": mask_positions[0]}
+        if "label" in example:
+            out["label"] = example["label"]
+        return out

@@ -1,0 +1,39 @@
+"""Verbalizers (reference: paddlenlp/prompt/verbalizer.py — ManualVerbalizer:
+label -> label words -> vocab logits aggregation)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ManualVerbalizer"]
+
+
+class ManualVerbalizer:
+    """Maps each class label to one or more label words; class score = mean of
+    the (first-token) vocab logits of its words at the mask position."""
+
+    def __init__(self, label_words: Dict, tokenizer):
+        self.labels = sorted(label_words)
+        self.tokenizer = tokenizer
+        self.word_ids: List[List[int]] = []
+        for label in self.labels:
+            words = label_words[label]
+            words = [words] if isinstance(words, str) else list(words)
+            ids = []
+            for w in words:
+                toks = tokenizer(w, add_special_tokens=False)["input_ids"]
+                if not toks:
+                    raise ValueError(f"label word {w!r} tokenizes to nothing")
+                ids.append(toks[0])
+            self.word_ids.append(ids)
+
+    def label_index(self, label) -> int:
+        return self.labels.index(label)
+
+    def process_logits(self, mask_logits: jnp.ndarray) -> jnp.ndarray:
+        """[B, vocab] logits at the mask position -> [B, n_labels] class scores."""
+        cols = [jnp.mean(mask_logits[:, jnp.asarray(ids)], axis=-1) for ids in self.word_ids]
+        return jnp.stack(cols, axis=-1)

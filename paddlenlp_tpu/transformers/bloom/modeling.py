@@ -187,8 +187,14 @@ class BloomForCausalLMModule(nn.Module):
             deterministic, output_hidden_states, True,
         )
         h = outputs.last_hidden_state
-        embedding = self.get_variable("params", "transformer")["word_embeddings"]["embedding"]
-        logits = h @ embedding.T.astype(self.dtype)
+        if cfg.tie_word_embeddings:
+            embedding = self.get_variable("params", "transformer")["word_embeddings"]["embedding"]
+            logits = h @ embedding.T.astype(self.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.initializer_range),
+                              name="lm_head")(h)
         logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
         if not return_dict:
             return (logits, outputs.past_key_values)
@@ -220,3 +226,4 @@ class BloomModel(BloomPretrainedModel):
 
 class BloomForCausalLM(BloomPretrainedModel):
     module_class = BloomForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]

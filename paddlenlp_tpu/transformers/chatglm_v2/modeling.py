@@ -105,12 +105,13 @@ class GLMTransformer(nn.Module):
 
     @nn.compact
     def __call__(self, h, attention_mask, position_ids, segment_ids, cache, deterministic,
-                 input_len):
+                 input_len, output_hidden_states=False):
         cfg = self.config
         offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
         layer_cls = _maybe_remat(GLMBlock, cfg)
         aux = jnp.zeros((), jnp.float32)
-        use_scan = getattr(cfg, "use_scan_layers", False)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
         if use_scan:
             scan_kv = (cache.keys, cache.values) if cache is not None else None
             ScanStack = nn.scan(
@@ -128,6 +129,8 @@ class GLMTransformer(nn.Module):
         else:
             new_keys, new_values = [], []
             for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
                 layer_kv = cache.layer(i) if cache is not None else None
                 (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
                     (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
@@ -139,7 +142,9 @@ class GLMTransformer(nn.Module):
                 cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values),
                                 offset=offset + input_len)
         h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="final_layernorm")(h)
-        return h, cache, aux
+        if output_hidden_states:
+            all_hidden.append(h)
+        return h, cache, aux, tuple(all_hidden) if all_hidden else None
 
 
 class ChatGLMv2Module(nn.Module):
@@ -159,13 +164,14 @@ class ChatGLMv2Module(nn.Module):
                                        name="embedding_word_embeddings")(input_ids)
         h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
         T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
-        h, cache, aux = GLMTransformer(cfg, self.dtype, self.param_dtype, name="encoder")(
-            h, attention_mask, position_ids, segment_ids, cache, deterministic, T
+        h, cache, aux, all_hidden = GLMTransformer(cfg, self.dtype, self.param_dtype, name="encoder")(
+            h, attention_mask, position_ids, segment_ids, cache, deterministic, T,
+            output_hidden_states,
         )
         if not return_dict:
-            return (h, cache, None)
+            return (h, cache, all_hidden)
         return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
-                                       hidden_states=None, aux_loss=aux)
+                                       hidden_states=all_hidden, aux_loss=aux)
 
 
 class ChatGLMv2ForCausalLMModule(nn.Module):
@@ -202,6 +208,10 @@ class ChatGLMv2PretrainedModel(PretrainedModel):
 
         mappings = auto_name_mappings(flat_shapes)
         for m in mappings:
+            # HF stores the untied head under the transformer scope
+            if m.source_name == "output_layer.weight":
+                m.source_name = "transformer.output_layer.weight"
+
             # flat underscore module names -> HF dotted scopes
             for ours, hf in (("embedding_word_embeddings", "embedding.word_embeddings"),
                              ("mlp_dense_h_to_4h", "mlp.dense_h_to_4h"),

@@ -197,8 +197,14 @@ class OPTForCausalLMModule(nn.Module):
             deterministic, output_hidden_states, True,
         )
         h = outputs.last_hidden_state
-        embedding = self.get_variable("params", "model")["decoder"]["embed_tokens"]["embedding"]
-        logits = h @ embedding.T.astype(self.dtype)
+        if cfg.tie_word_embeddings:
+            embedding = self.get_variable("params", "model")["decoder"]["embed_tokens"]["embedding"]
+            logits = h @ embedding.T.astype(self.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.initializer_range),
+                              name="lm_head")(h)
         logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
         if not return_dict:
             return (logits, outputs.past_key_values)
@@ -231,3 +237,4 @@ class OPTModel(OPTPretrainedModel):
 
 class OPTForCausalLM(OPTPretrainedModel):
     module_class = OPTForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]

@@ -183,3 +183,55 @@ class TestZeroPadding:
         ).logits
         sep_a = model(input_ids=jnp.asarray(a["input_ids"][None])).logits
         np.testing.assert_allclose(np.asarray(out[0, :4]), np.asarray(sep_a[0]), atol=2e-5)
+
+
+class TestLoadDataset:
+    def test_local_files_and_splits(self, tmp_path):
+        import json
+
+        from paddlenlp_tpu.datasets import load_dataset
+
+        d = tmp_path / "corpus"
+        d.mkdir()
+        (d / "train.jsonl").write_text("\n".join(json.dumps({"text": f"t{i}"}) for i in range(4)))
+        (d / "dev.json").write_text(json.dumps([{"text": "v0"}, {"text": "v1"}]))
+        (d / "test.tsv").write_text("text\tlabel\na\t1\nb\t0\n")
+        train, dev, test = load_dataset(str(d), splits=("train", "dev", "test"))
+        assert len(train) == 4 and train[0]["text"] == "t0"
+        assert len(dev) == 2 and dev[1]["text"] == "v1"
+        assert len(test) == 2 and test[0] == {"text": "a", "label": "1"}
+
+    def test_map_filter_shuffle(self, tmp_path):
+        from paddlenlp_tpu.datasets import MapDataset
+
+        ds = MapDataset([{"x": i} for i in range(10)])
+        ds.map(lambda r: {"x": r["x"] * 2}).filter(lambda r: r["x"] >= 8)
+        assert sorted(r["x"] for r in ds) == [8, 10, 12, 14, 16, 18]
+        lazy = ds.map(lambda r: {"x": r["x"] + 1}, lazy=True)
+        assert lazy[0]["x"] == ds[0]["x"] + 1
+
+    def test_registry_builder(self):
+        from paddlenlp_tpu.datasets import load_dataset, register_dataset
+
+        @register_dataset("unit_test_corpus")
+        def build(split, name=None, **kw):
+            return [{"split": split, "i": i} for i in range(3)]
+
+        ds = load_dataset("unit_test_corpus", splits="dev")
+        assert len(ds) == 3 and ds[0]["split"] == "dev"
+
+    def test_missing_named_dataset_errors(self):
+        import pytest
+
+        from paddlenlp_tpu.datasets import load_dataset
+
+        with pytest.raises(FileNotFoundError, match="register_dataset"):
+            load_dataset("no_such_dataset_xyz")
+
+    def test_iter_dataset_streaming(self):
+        from paddlenlp_tpu.datasets import IterDataset
+
+        ds = IterDataset(lambda: ({"x": i} for i in range(6)))
+        ds.map(lambda r: {"x": r["x"] * 10}).filter(lambda r: r["x"] >= 30)
+        assert [r["x"] for r in ds] == [30, 40, 50]
+        assert [r["x"] for r in ds] == [30, 40, 50]  # re-iterable

@@ -1,0 +1,105 @@
+"""AdamWDL layer-wise decay, EMA, GLUE/squad metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TestAdamWDL:
+    def test_depth_scaling(self):
+        import optax
+
+        from paddlenlp_tpu.ops.optimizer import adamwdl
+
+        params = {"embed": {"kernel": jnp.ones((4, 4))},
+                  "layers_0": {"kernel": jnp.ones((4, 4))},
+                  "layers_3": {"kernel": jnp.ones((4, 4))},
+                  "head": {"kernel": jnp.ones((4, 4))}}
+        tx = adamwdl(1e-2, n_layers=4, layerwise_decay=0.5, weight_decay=0.0)
+        state = tx.init(params)
+        grads = jax.tree.map(jnp.ones_like, params)
+        updates, _ = tx.update(grads, state, params)
+        u = {k: float(jnp.abs(v["kernel"]).mean()) for k, v in updates.items()}
+        assert u["head"] > u["layers_3"] > u["layers_0"] > u["embed"]
+        np.testing.assert_allclose(u["layers_3"] / u["head"], 0.5, rtol=1e-3)
+
+    def test_trains_a_model(self, tmp_path):
+        from paddlenlp_tpu.ops.optimizer import adamwdl
+        from paddlenlp_tpu.trainer import Trainer, TrainingArguments
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64,
+                          use_scan_layers=False)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        rows = [np.random.default_rng(5).integers(0, 64, 12).astype(np.int32) for _ in range(64)]
+
+        class DS:
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                return {"input_ids": rows[i], "labels": rows[i].copy()}
+
+        args = TrainingArguments(output_dir=str(tmp_path), max_steps=4, per_device_train_batch_size=4,
+                                 learning_rate=5e-3, logging_steps=1, save_strategy="no")
+        tx = adamwdl(5e-3, n_layers=2, layerwise_decay=0.8)
+        trainer = Trainer(model=model, args=args, train_dataset=DS(), optimizers=(tx, None))
+        trainer.train()
+        losses = [h["loss"] for h in trainer.state.log_history if "loss" in h]
+        assert losses[-1] < losses[0], losses
+
+
+class TestEMA:
+    def test_shadow_tracks(self):
+        from paddlenlp_tpu.ops.optimizer import ExponentialMovingAverage
+
+        params = {"w": jnp.zeros(3)}
+        ema = ExponentialMovingAverage(params, decay=0.5, debias=False)
+        ema.update({"w": jnp.ones(3)})
+        np.testing.assert_allclose(np.asarray(ema.state.shadow["w"]), 0.5)
+        ema.update({"w": jnp.ones(3)})
+        np.testing.assert_allclose(np.asarray(ema.state.shadow["w"]), 0.75)
+        live = {"w": jnp.full(3, 9.0)}
+        shadow = ema.apply(live)
+        np.testing.assert_allclose(np.asarray(shadow["w"]), 0.75)
+        assert ema.restore() is live
+
+
+class TestGlueMetrics:
+    def test_accuracy_f1(self):
+        from paddlenlp_tpu.metrics import AccuracyAndF1
+
+        m = AccuracyAndF1()
+        m.update([1, 0, 1, 1], [1, 0, 0, 1])
+        out = m.accumulate()
+        np.testing.assert_allclose(out["accuracy"], 0.75)
+        np.testing.assert_allclose(out["f1"], 2 * (2 / 3) * 1.0 / (2 / 3 + 1.0))
+
+    def test_mcc_perfect(self):
+        from paddlenlp_tpu.metrics import Mcc
+
+        m = Mcc()
+        m.update([1, 0, 1, 0], [1, 0, 1, 0])
+        np.testing.assert_allclose(m.accumulate()["mcc"], 1.0)
+
+    def test_pearson_spearman(self):
+        from paddlenlp_tpu.metrics import PearsonAndSpearman
+
+        m = PearsonAndSpearman()
+        m.update([1.0, 2.0, 3.0, 4.0], [2.0, 4.0, 6.0, 8.0])
+        out = m.accumulate()
+        np.testing.assert_allclose(out["pearson"], 1.0, atol=1e-9)
+        np.testing.assert_allclose(out["spearman"], 1.0, atol=1e-9)
+
+
+class TestSquad:
+    def test_em_f1(self):
+        from paddlenlp_tpu.metrics import squad_evaluate
+
+        examples = [{"id": "a", "answers": ["the cat sat"]},
+                    {"id": "b", "answers": ["blue", "navy blue"]}]
+        preds = {"a": "The cat sat.", "b": "dark navy blue"}
+        out = squad_evaluate(examples, preds)
+        assert out["exact"] == 50.0
+        assert 50.0 < out["f1"] <= 100.0
