@@ -1,0 +1,128 @@
+"""GPTQ post-training quantization.
+
+Counterpart of the reference's GPTQ flow (``llm/utils/quant.py`` +
+``llm/config/llama/gptq_argument.json``; CUDA GEMMs in
+``csrc/gpu/int8_gemm_with_cutlass``). Two pieces:
+
+- ``gptq_quantize``: the OBQ/GPTQ algorithm itself — column-by-column absmax
+  quantization of W with Cholesky-based error compensation from the calibration
+  Hessian H = X^T X (Frantar et al.). Pure numpy (runs offline on host).
+- ``collect_hessians`` / ``apply_gptq``: calibration driver — records every
+  targeted Dense layer's INPUTS via ``flax.linen.intercept_methods`` over a few
+  forward batches, accumulates per-kernel Hessians (scan-stacked [L] kernels get
+  per-layer Hessians), then rewrites the params with GPTQ-quantized +
+  dequantized weights (serve them as-is, or pass through ``quantize_params``
+  for int storage — GPTQ chooses the VALUES, the storage format is orthogonal).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..transformers.conversion_utils import flatten_params, unflatten_params
+from ..utils.log import logger
+
+__all__ = ["gptq_quantize", "collect_hessians", "apply_gptq"]
+
+
+def gptq_quantize(
+    w: np.ndarray,  # [in, out] (flax orientation; contraction axis first)
+    hessian: np.ndarray,  # [in, in] = X^T X from calibration
+    bits: int = 4,
+    group_size: int = -1,  # scale granularity along the in axis (-1: per-column)
+    percdamp: float = 0.01,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (w_q dequantized, int codes). Error from quantizing input-row i is
+    propagated into the not-yet-quantized rows via the inverse-Hessian column."""
+    w = np.asarray(w, np.float64).copy()
+    n_in, n_out = w.shape
+    H = np.asarray(hessian, np.float64).copy()
+    dead = np.diag(H) == 0
+    H[dead, dead] = 1.0
+    w[dead, :] = 0.0
+    damp = percdamp * np.mean(np.diag(H))
+    H[np.diag_indices(n_in)] += damp
+    # upper-triangular factor U with inv(H) = U^T U (the rows U[i, i:] carry the
+    # compensation coefficients; a lower factor would zero them out)
+    Hinv = np.linalg.cholesky(np.linalg.inv(H)).T
+
+    qmax = 2 ** (bits - 1) - 1
+    codes = np.zeros_like(w, dtype=np.int8)
+    scales = np.zeros((1 if group_size == -1 else -(-n_in // group_size), n_out), np.float64)
+    if group_size == -1:
+        scales[0] = np.abs(w).max(axis=0) / qmax
+    for i in range(n_in):
+        g = 0 if group_size == -1 else i // group_size
+        if group_size != -1 and i % group_size == 0:
+            end = min(i + group_size, n_in)
+            scales[g] = np.abs(w[i:end]).max(axis=0) / qmax
+        s = np.maximum(scales[g], 1e-12)
+        q = np.clip(np.round(w[i] / s), -qmax - 1, qmax)
+        codes[i] = q.astype(np.int8)
+        dq = q * s
+        err = (w[i] - dq) / Hinv[i, i]
+        if i + 1 < n_in:
+            w[i + 1:] -= np.outer(Hinv[i, i + 1:], err)
+        w[i] = dq
+    return w.astype(np.float32), codes
+
+
+def collect_hessians(model, batches: List[Dict], target_suffix: str = "/kernel",
+                     match=None) -> Dict[str, np.ndarray]:
+    """Run calibration batches eagerly, accumulating H = sum_i x_i x_i^T per
+    matched Dense kernel (keyed by flat param path).
+
+    Requires the UNROLLED layer layout (``use_scan_layers=False``): nn.scan
+    traces its body once, so per-layer inputs are not observable — reload the
+    checkpoint with ``use_scan_layers=False`` for calibration (checkpoints are
+    layout-independent)."""
+    import flax.linen as nn
+
+    flat = dict(flatten_params(model.params))
+    targets = {p for p, v in flat.items()
+               if p.endswith(target_suffix) and getattr(v, "ndim", 0) >= 2}
+    if match is not None:
+        targets = {p for p in targets if match(p)}
+    stacked = [p for p in targets if getattr(flat[p], "ndim", 0) == 3]
+    if stacked:
+        raise ValueError(
+            "GPTQ calibration needs the unrolled layer layout: reload with "
+            f"use_scan_layers=False (stacked kernels: {stacked[:3]}...)"
+        )
+    hessians: Dict[str, np.ndarray] = {}
+
+    def interceptor(next_fn, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            path = "/".join(str(p) for p in mod.path) + "/kernel"
+            if path in targets:
+                x = np.asarray(jax.device_get(args[0]), np.float32).reshape(-1, args[0].shape[-1])
+                h = x.T @ x
+                hessians[path] = hessians.get(path, 0.0) + h
+        return next_fn(*args, **kwargs)
+
+    for batch in batches:
+        with nn.intercept_methods(interceptor):
+            model.module.apply({"params": model.params}, deterministic=True, **batch)
+    return hessians
+
+
+def apply_gptq(model, batches: List[Dict], bits: int = 4, group_size: int = -1,
+               match=None) -> dict:
+    """GPTQ-calibrate + rewrite: returns a params tree whose matched kernels are
+    replaced with their GPTQ-dequantized values (pass to quantize_params for int
+    storage)."""
+    hessians = collect_hessians(model, batches, match=match)
+    flat = dict(flatten_params(model.params))
+    n = 0
+    for path, H in hessians.items():
+        w = np.asarray(jax.device_get(flat[path]))
+        out = gptq_quantize(w, H, bits, group_size)[0]
+        flat[path] = jnp.asarray(out, flat[path].dtype)
+        n += 1
+    logger.info(f"GPTQ: rewrote {n} kernels at {bits} bits (group_size={group_size})")
+    return unflatten_params(flat)

@@ -1,0 +1,52 @@
+"""Fill-mask task (reference: paddlenlp/taskflow/fill_mask.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .task import Task
+
+__all__ = ["FillMaskTask"]
+
+
+class FillMaskTask(Task):
+    """Taskflow("fill_mask", task_path=<bert-mlm dir>)("The [MASK] sat") -> top-k words."""
+
+    def _construct(self):
+        from ..transformers import AutoTokenizer
+        from ..transformers.auto.modeling import AutoModelForMaskedLM
+
+        self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+        self.model = AutoModelForMaskedLM.from_pretrained(self.model_name)
+        self.top_k = self.kwargs.get("top_k", 5)
+        if self.tokenizer.mask_token is None:
+            raise ValueError("fill_mask needs a tokenizer with a mask token")
+
+    def _run_model(self, texts: List[str]):
+        out = []
+        for text in texts:
+            enc = self.tokenizer([text], return_tensors="np")
+            ids = jnp.asarray(enc["input_ids"])
+            logits = self.model(input_ids=ids, attention_mask=jnp.asarray(enc["attention_mask"])).logits
+            positions = np.where(np.asarray(ids[0]) == self.tokenizer.mask_token_id)[0]
+            if len(positions) == 0:
+                raise ValueError(f"no {self.tokenizer.mask_token} in input: {text!r}")
+            per_mask = []
+            for pos in positions:
+                lg = np.asarray(logits[0, pos], np.float32)
+                probs = np.exp(lg - lg.max())
+                probs /= probs.sum()
+                top = np.argsort(-lg)[: self.top_k]
+                per_mask.append([
+                    {"token": self.tokenizer.decode([int(t)]).strip(), "score": float(probs[t])}
+                    for t in top
+                ])
+            entry = {"text": text, "candidates": per_mask[0]}
+            if len(per_mask) > 1:
+                entry["candidates_per_mask"] = per_mask
+            out.append(entry)
+        return out

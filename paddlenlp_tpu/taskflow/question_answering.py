@@ -1,0 +1,36 @@
+"""Generative question answering + summarization tasks (reference:
+paddlenlp/taskflow/question_answering.py, text_summarization.py) — prompt
+wrappers over TextGenerationTask (one copy of the generation plumbing)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .text_generation import TextGenerationTask
+
+__all__ = ["QuestionAnsweringTask", "SummarizationTask"]
+
+
+class _PromptedGenerationTask(TextGenerationTask):
+    prompt_template = "{text}"
+    answer_key = "answer"
+
+    def _run_model(self, texts: List[str]):
+        prompts = [type(self).prompt_template.format(text=t) for t in texts]
+        results = super()._run_model(prompts)
+        return [{"text": t, type(self).answer_key: r["answer"]}
+                for t, r in zip(texts, results)]
+
+
+class QuestionAnsweringTask(_PromptedGenerationTask):
+    """Taskflow("question_answering", task_path=...)("question") -> answer."""
+
+    prompt_template = "Question: {text}\nAnswer:"
+    answer_key = "answer"
+
+
+class SummarizationTask(_PromptedGenerationTask):
+    """Taskflow("text_summarization", task_path=...)("document") -> summary."""
+
+    prompt_template = "Summarize: {text}\nSummary:"
+    answer_key = "summary"

@@ -34,6 +34,14 @@ def _populate():
     register_task("sentiment_analysis", TextClassificationTask)
     register_task("text_similarity", TextSimilarityTask)
 
+    from .fill_mask import FillMaskTask
+    from .question_answering import QuestionAnsweringTask, SummarizationTask
+
+    register_task("fill_mask", FillMaskTask)
+    register_task("question_answering", QuestionAnsweringTask)
+    register_task("text_summarization", SummarizationTask)
+    register_task("chat", TextGenerationTask)
+
 
 class Taskflow:
     def __init__(self, task: str, model: str = None, task_path: str = None, **kwargs):

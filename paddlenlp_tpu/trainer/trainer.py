@@ -575,6 +575,15 @@ class Trainer:
                     if steps_to_skip > 0:
                         steps_to_skip -= 1
                         continue
+                    self.state.data_step += 1
+                    if args.skip_data_intervals and any(
+                        lo <= self.state.data_step <= hi for lo, hi in args.skip_data_intervals
+                    ):
+                        # hop over loss-spiking data regions (reference
+                        # skip_data_intervals, training_args.py:882): the interval
+                        # is in DATA steps — those batches are consumed untrained
+                        self.state.consumed_samples += args.global_train_batch_size
+                        continue
                     self.control = self.callback_handler.on_step_begin(args, self.state, self.control)
                     batch = self._device_put_batch(host_batch, accum, micro_axis=self._use_pipeline())
                     self.timers("read-data").stop()
@@ -806,6 +815,14 @@ class Trainer:
             if path != (self.state.best_model_checkpoint or ""):
                 logger.info(f"rotating old checkpoint {path}")
                 shutil.rmtree(path, ignore_errors=True)
+
+    def compress(self, strategy: str = "ptq", output_dir: Optional[str] = None, **kwargs):
+        """Post-training compression (reference Trainer.compress,
+        trainer_compress.py): PTQ weight-only (optionally GPTQ-calibrated) or
+        dynabert-style ffn width pruning; exports to ``output_dir``."""
+        from .trainer_compress import compress as _compress
+
+        return _compress(self, strategy=strategy, output_dir=output_dir, **kwargs)
 
     def log(self, logs: Dict[str, float]):
         self.state.log_history.append(logs)

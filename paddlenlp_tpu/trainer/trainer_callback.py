@@ -37,6 +37,7 @@ class TrainerState:
     best_model_checkpoint: Optional[str] = None
     is_world_process_zero: bool = True
     consumed_samples: int = 0
+    data_step: int = 0  # yielded-batch counter (skip_data_intervals indexing; resume-safe)
     trial_params: Optional[Dict[str, Any]] = None
 
     def save_to_json(self, json_path: str):

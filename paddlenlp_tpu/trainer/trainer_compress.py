@@ -1,0 +1,128 @@
+"""Post-training compression.
+
+Counterpart of ``paddlenlp/trainer/trainer_compress.py`` (42k chars:
+dynabert width/depth pruning + PTQ/QAT + embedding quant behind
+``Trainer.compress()``). TPU-native scope:
+
+- ``compress(strategy="ptq")``: weight-only int8/int4 PTQ, optionally
+  GPTQ-error-compensated against calibration batches from the eval dataset,
+  exported as a quantized checkpoint directory (qweight/scales leaves).
+- ``compress(strategy="prune")``: magnitude-based structured WIDTH pruning of
+  the ffn intermediate dimension (the dynabert axis) by ``width_mult``,
+  rewriting gate/up/down kernels to the kept columns and exporting a smaller
+  model + patched config.
+
+Both are offline transforms over the unsharded logical checkpoint — no
+training-loop integration needed for the PTQ path (QAT = finetune the
+dequantized result with the normal Trainer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..transformers.conversion_utils import flatten_params, unflatten_params
+from ..utils.log import logger
+
+__all__ = ["compress"]
+
+
+def compress(trainer, strategy: str = "ptq", output_dir: Optional[str] = None, **kwargs):
+    """Entry point mirroring ``Trainer.compress()``; see module docstring."""
+    output_dir = output_dir or os.path.join(trainer.args.output_dir, f"compress_{strategy}")
+    if strategy == "ptq":
+        return _ptq(trainer, output_dir, **kwargs)
+    if strategy == "prune":
+        return _prune_width(trainer, output_dir, **kwargs)
+    raise ValueError(f"unknown compression strategy {strategy!r} (ptq | prune)")
+
+
+def _ptq(trainer, output_dir: str, bits: int = 8, use_gptq: bool = False,
+         n_calib_batches: int = 4, match=None):
+    from ..quantization import QuantizationConfig, quantize_params
+
+    model = trainer.model
+    params = trainer.train_state.params if trainer.train_state is not None else model.params
+    if use_gptq:
+        from ..quantization.gptq import apply_gptq
+
+        dataset = trainer.eval_dataset or trainer.train_dataset
+        if dataset is None:
+            raise ValueError("GPTQ calibration needs an eval or train dataset")
+        batches = []
+        for i in range(min(n_calib_batches, len(dataset))):
+            row = dataset[i]
+            batches.append({"input_ids": jnp.asarray(np.asarray(row["input_ids"])[None], jnp.int32)})
+        orig = model.params
+        model.params = params
+        try:
+            params = apply_gptq(model, batches, bits=bits, match=match)
+        finally:
+            model.params = orig
+    algo = "weight_only_int8" if bits == 8 else "weight_only_int4"
+    qparams = quantize_params(params, QuantizationConfig(weight_quantize_algo=algo))
+    model.save_pretrained(output_dir, params=params)  # fp reference
+    _save_q(qparams, output_dir)
+    logger.info(f"PTQ({'gptq+' if use_gptq else ''}wint{bits}) exported to {output_dir}")
+    return output_dir
+
+
+def _save_q(qparams: dict, output_dir: str):
+    from ..utils.safetensors_io import save_file
+
+    flat = flatten_params(qparams)
+    tensors = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
+    save_file(tensors, os.path.join(output_dir, "model_quant.safetensors"), metadata={"format": "np"})
+
+
+def _prune_width(trainer, output_dir: str, width_mult: float = 0.75):
+    """Keep the top-|width_mult| ffn columns by L2 magnitude of the down
+    projection rows (the dynabert importance proxy), per layer."""
+    model = trainer.model
+    params = trainer.train_state.params if trainer.train_state is not None else model.params
+    flat = dict(flatten_params(params))
+    cfg = model.config
+    new_f = int(cfg.intermediate_size * width_mult)
+    pruned = 0
+    prefixes = sorted({p.rsplit("/", 1)[0].rsplit("/", 1)[0] for p in flat
+                       if p.endswith("down_proj/kernel")})
+    for prefix in prefixes:
+        down = np.asarray(flat[f"{prefix}/down_proj/kernel"])
+        imp = np.linalg.norm(down, axis=-1)  # [..., F]
+        if down.ndim == 3:  # scanned [L, F, D]: per-layer top-k
+            keep = np.argsort(-imp, axis=-1)[:, :new_f]
+            keep = np.sort(keep, axis=-1)
+            take_f = lambda a, ax: np.take_along_axis(
+                a, keep[..., None] if ax == -2 else keep[:, None, :], axis=ax)
+            flat[f"{prefix}/down_proj/kernel"] = jnp.asarray(take_f(down, -2))
+            for name in ("gate_proj", "up_proj"):
+                k = np.asarray(flat[f"{prefix}/{name}/kernel"])  # [L, D, F]
+                flat[f"{prefix}/{name}/kernel"] = jnp.asarray(take_f(k, -1))
+        else:
+            keep = np.sort(np.argsort(-imp)[:new_f])
+            flat[f"{prefix}/down_proj/kernel"] = jnp.asarray(down[keep, :])
+            for name in ("gate_proj", "up_proj"):
+                k = np.asarray(flat[f"{prefix}/{name}/kernel"])
+                flat[f"{prefix}/{name}/kernel"] = jnp.asarray(k[:, keep])
+        pruned += 1
+    if pruned == 0:
+        raise ValueError("no gate/up/down ffn kernels found to prune (llama-family only)")
+    # export with a patched config COPY; the live trainer model keeps its
+    # full-width params + config consistent
+    import copy
+
+    pruned_cfg = copy.deepcopy(cfg)
+    pruned_cfg.intermediate_size = new_f
+    orig_cfg = model.config
+    model.config = pruned_cfg
+    try:
+        model.save_pretrained(output_dir, params=unflatten_params(flat))
+    finally:
+        model.config = orig_cfg
+    logger.info(f"width-pruned {pruned} ffn stacks to {new_f} ({width_mult:.0%}); exported {output_dir}")
+    return output_dir

@@ -105,6 +105,16 @@ class TrainingArguments:
     recompute_granularity: str = "full"
     use_scan_layers: bool = True
 
+    # ---- reference per-axis config strings (training_args.py:645-705). The
+    # fleet comm-overlap toggles they carry are obsolete under GSPMD (XLA
+    # schedules collective overlap); recognized options warn, unknown ones
+    # raise instead of silently dropping a requested behavior. ----
+    tensor_parallel_config: str = ""
+    pipeline_parallel_config: str = ""
+    sharding_parallel_config: str = ""
+    sequence_parallel_config: str = ""
+    hybrid_parallel_topo_order: str = ""
+
     # ---- checkpointing ----
     unified_checkpoint: bool = True
     async_save: bool = False
@@ -130,6 +140,43 @@ class TrainingArguments:
                 self.sharding_stage = int(s[5:])
         if self.sharding_parallel_degree == -1 and self.sharding_stage > 0:
             self.sharding_parallel_degree = 0  # resolved against device count in mesh()
+        _KNOWN_OBSOLETE = {
+            # fleet comm/overlap scheduling knobs: GSPMD/XLA owns these decisions
+            "enable_mp_async_allreduce", "enable_mp_skip_c_identity",
+            "enable_mp_fused_linear_param_grad_add", "enable_delay_scale_loss",
+            "enable_dp_comm_overlap", "enable_sharding_comm_overlap",
+            "enable_release_grads", "enable_overlap_p2p_comm", "enable_clear_every_step_cache",
+            "disable_partial_send_recv", "enable_timer", "enable_stage1_tensor_fusion",
+            "enable_stage1_overlap", "enable_stage2_overlap", "split_param",
+            "disable_p2p_cache_shape", "best_unbalanced_scheduler",
+            "enable_allreduce_avg_in_gradinent_scale", "gradient_sync_after_accumulate",
+        }
+        for fieldname in ("tensor_parallel_config", "pipeline_parallel_config",
+                          "sharding_parallel_config", "sequence_parallel_config"):
+            raw = getattr(self, fieldname) or ""
+            opts = raw.replace(",", " ").split()
+            for o in opts:
+                if o in _KNOWN_OBSOLETE:
+                    logger.warning_once(
+                        f"{fieldname}: option {o!r} is a fleet scheduling knob; obsolete "
+                        "under GSPMD (XLA schedules comm overlap) — ignored"
+                    )
+                else:
+                    raise ValueError(
+                        f"{fieldname}: unsupported option {o!r} (supported-but-obsolete "
+                        f"fleet options are ignored with a warning; anything else is an error)"
+                    )
+        if self.hybrid_parallel_topo_order:
+            if self.hybrid_parallel_topo_order not in ("pp_first", "sharding_first"):
+                raise ValueError(
+                    f"hybrid_parallel_topo_order={self.hybrid_parallel_topo_order!r}: "
+                    "expected 'pp_first' or 'sharding_first'"
+                )
+            logger.warning_once(
+                "hybrid_parallel_topo_order is fixed by the mesh axis order "
+                "(dp, fsdp, pp, sep, cp, tp — ICI-locality ordered); the knob is accepted "
+                "for config compatibility and ignored"
+            )
         self._mesh = None
 
     # ------------------------------------------------------------------ topology

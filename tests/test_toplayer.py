@@ -167,3 +167,40 @@ class TestCLI:
               "--dtype", "float32"])
         out = json.loads(capsys.readouterr().out)
         assert "answer" in out
+
+
+class TestTaskflowBreadth:
+    def _mlm_dir(self, tmp_path):
+        from tokenizers import Tokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        from paddlenlp_tpu.transformers import BertConfig, BertForMaskedLM, PretrainedTokenizer
+
+        d = str(tmp_path / "mlm")
+        vocab = {"<pad>": 0, "maskword": 1, "<unk>": 2}
+        for i, w in enumerate("the cat sat mat dog ran good bad".split()):
+            vocab[w] = i + 3
+        t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", mask_token="maskword",
+                            unk_token="<unk>").save_pretrained(d)
+        BertForMaskedLM.from_config(
+            BertConfig(vocab_size=16, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=32), seed=0).save_pretrained(d)
+        return d
+
+    def test_fill_mask(self, tmp_path):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        tf = Taskflow("fill_mask", task_path=self._mlm_dir(tmp_path), top_k=3)
+        out = tf("the maskword sat")
+        assert len(out["candidates"]) == 3
+        assert all(0 <= c["score"] <= 1 for c in out["candidates"])
+
+    def test_question_answering_and_summarization_registered(self):
+        from paddlenlp_tpu.taskflow.taskflow import TASKS, _populate
+
+        _populate()
+        for name in ("fill_mask", "question_answering", "text_summarization", "chat"):
+            assert name in TASKS, name

@@ -1,0 +1,86 @@
+"""Compression trainer: PTQ export (plain + GPTQ-calibrated) and ffn width
+pruning; plus TrainingArguments config-string knob handling and
+skip_data_intervals."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.trainer import Trainer, TrainingArguments
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+
+def tiny(scan=True):
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64,
+                      use_scan_layers=scan)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def dataset(n=64):
+    rows = [np.random.default_rng(0).integers(0, 64, 12).astype(np.int32) for _ in range(n)]
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"input_ids": rows[i], "labels": rows[i].copy()}
+
+    return DS()
+
+
+class TestCompress:
+    def test_ptq_export(self, tmp_path):
+        trainer = Trainer(model=tiny(), args=TrainingArguments(output_dir=str(tmp_path)),
+                          train_dataset=dataset())
+        out = trainer.compress(strategy="ptq", bits=8)
+        assert os.path.exists(os.path.join(out, "model_quant.safetensors"))
+        assert os.path.exists(os.path.join(out, "model.safetensors"))
+
+    def test_ptq_gptq_calibrated(self, tmp_path):
+        trainer = Trainer(model=tiny(scan=False), args=TrainingArguments(output_dir=str(tmp_path)),
+                          train_dataset=dataset())
+        out = trainer.compress(strategy="ptq", bits=8, use_gptq=True, n_calib_batches=2,
+                               match=lambda p: "mlp" in p)
+        assert os.path.exists(os.path.join(out, "model_quant.safetensors"))
+
+    def test_width_prune(self, tmp_path):
+        model = tiny()
+        trainer = Trainer(model=model, args=TrainingArguments(output_dir=str(tmp_path)),
+                          train_dataset=dataset())
+        out = trainer.compress(strategy="prune", width_mult=0.5)
+        reloaded = LlamaForCausalLM.from_pretrained(out)
+        assert reloaded.config.intermediate_size == 32
+        logits = reloaded(input_ids=jnp.asarray([[5, 6, 7]], jnp.int32)).logits
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestArgKnobs:
+    def test_obsolete_fleet_options_warn(self, tmp_path):
+        args = TrainingArguments(output_dir=str(tmp_path),
+                                 tensor_parallel_config="enable_mp_async_allreduce",
+                                 pipeline_parallel_config="enable_release_grads enable_timer",
+                                 hybrid_parallel_topo_order="pp_first")
+        assert args.tensor_parallel_config  # accepted, not dropped
+
+    def test_unknown_option_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="unsupported option"):
+            TrainingArguments(output_dir=str(tmp_path),
+                              sharding_parallel_config="definitely_not_a_thing")
+
+    def test_bad_topo_order_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="hybrid_parallel_topo_order"):
+            TrainingArguments(output_dir=str(tmp_path), hybrid_parallel_topo_order="mp_first")
+
+    def test_skip_data_intervals(self, tmp_path):
+        args = TrainingArguments(output_dir=str(tmp_path), max_steps=3,
+                                 per_device_train_batch_size=2, logging_steps=1,
+                                 save_strategy="no", skip_data_intervals=[[1, 2]])
+        trainer = Trainer(model=tiny(), args=args, train_dataset=dataset())
+        out = trainer.train()
+        # data steps 1-2 skipped untrained but consumed
+        assert out.global_step == 3
+        assert trainer.state.consumed_samples == 5 * args.global_train_batch_size
