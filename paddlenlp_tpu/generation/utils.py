@@ -38,6 +38,11 @@ from .logits_process import (
 __all__ = ["GenerationMixin"]
 
 
+def _procs_sig(ps):
+    """Hashable signature of a processor list (decode-fn cache key component)."""
+    return tuple((type(p).__name__, tuple(sorted(p.__dict__.items()))) for p in ps)
+
+
 class GenerationMixin:
     """Mixed into ``PretrainedModel``; relies on self.{module,params,config}."""
 
@@ -106,11 +111,17 @@ class GenerationMixin:
             attention_mask = jnp.ones((B, T0), dtype=jnp.int32)
         else:
             attention_mask = jnp.asarray(attention_mask, dtype=jnp.int32)
-            tail = np.asarray(attention_mask[:, -1])
-            if (tail == 0).any():
-                logger.warning_once(
-                    "right-padded prompts detected in generate(); use tokenizer padding_side='left' for batched decode"
-                )
+
+        if getattr(self.config, "is_encoder_decoder", False):
+            # encoder inputs are correctly RIGHT-padded; no repack warning applies
+            return self._generate_seq2seq(params, input_ids, attention_mask, g, seed, streamer,
+                                          logits_processors)
+
+        tail = np.asarray(attention_mask[:, -1])
+        if (tail == 0).any():
+            logger.warning_once(
+                "right-padded prompts detected in generate(); use tokenizer padding_side='left' for batched decode"
+            )
 
         if g.max_new_tokens is not None:
             max_length = T0 + int(g.max_new_tokens)
@@ -175,6 +186,125 @@ class GenerationMixin:
             return ids_buf[:, T0:], None
         return ids_buf, None
 
+    # ------------------------------------------------------------------ seq2seq
+    def _generate_seq2seq(self, params, input_ids, attention_mask, g, seed, streamer, extra_procs):
+        """Encoder-decoder decode: encode ONCE, precompute cross-attention K/V,
+        then one ``lax.while_loop`` over the decoder (t5/bart). The decoder
+        "prompt" is the single ``decoder_start_token_id`` slot; returned ids
+        exclude it (new tokens only, matching ``trunc_input`` semantics)."""
+        cfg = self.config
+        max_new = int(g.max_new_tokens if g.max_new_tokens is not None else g.max_length)
+        max_length = max_new + 1  # slot 0 = decoder_start token
+        if (g.num_beams or 1) > 1 or g.decode_strategy in ("beam_search", "group_beam_search"):
+            logger.warning_once(
+                "beam search for encoder-decoder models is not implemented yet; using "
+                + ("sampling" if g.do_sample else "greedy")
+            )
+        procs = self.get_logits_processors(g, prompt_len=1)
+        # HF seq2seq conventions (bart): force BOS at the first generated slot,
+        # force EOS at the length cap
+        # an EXPLICIT forced_*=None in generate kwargs disables the config default
+        forced_bos = g.__dict__.get("forced_bos_token_id", getattr(cfg, "forced_bos_token_id", None))
+        if forced_bos is not None:
+            from .logits_process import ForcedBOSTokenLogitsProcessor
+
+            procs.append(ForcedBOSTokenLogitsProcessor(int(forced_bos)))
+        forced_eos = g.__dict__.get("forced_eos_token_id", getattr(cfg, "forced_eos_token_id", None))
+        if forced_eos is not None:
+            procs.append(ForcedEOSTokenLogitsProcessor(max_length, int(forced_eos)))
+        if extra_procs:
+            procs.extend(extra_procs)
+        warpers = self.get_logits_warpers(g) if g.do_sample else LogitsProcessorList()
+        eos_ids = tuple(g.eos_token_id) if isinstance(g.eos_token_id, (list, tuple)) else (
+            (g.eos_token_id,) if g.eos_token_id is not None else ()
+        )
+        start_id = getattr(g, "decoder_start_token_id", None)
+        if start_id is None:
+            start_id = getattr(cfg, "decoder_start_token_id", None)
+        if start_id is None:
+            start_id = g.pad_token_id
+        decode = self._get_seq2seq_decode_fn(
+            max_length=max_length, start_id=int(start_id), do_sample=bool(g.do_sample),
+            pad_id=int(g.pad_token_id), eos_ids=eos_ids, procs=procs, warpers=warpers,
+        )
+        key = jax.random.key(seed)
+        ids_buf, _ = decode(params, input_ids, attention_mask, key)
+        if streamer is not None:
+            for t in range(1, max_length):
+                streamer.put(np.asarray(ids_buf[:, t]))
+            streamer.end()
+        return ids_buf[:, 1:], None
+
+    def _get_seq2seq_decode_fn(self, *, max_length, start_id, do_sample, pad_id, eos_ids, procs, warpers):
+        cache_key = ("seq2seq", max_length, start_id, do_sample, pad_id, eos_ids, _procs_sig(procs), _procs_sig(warpers))
+        cache = getattr(self, "_decode_cache", None)
+        if cache is None:
+            cache = self._decode_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
+
+        module = self.module
+        config = self.config
+
+        def decode(params, enc_ids, enc_mask, key):
+            from ..transformers.cache_utils import KVCache
+
+            B = enc_ids.shape[0]
+            enc_h = module.apply({"params": params}, enc_ids, enc_mask, method="encode")
+            cross = module.apply({"params": params}, enc_h, method="init_cross_kv")
+            n_layers = getattr(config, "num_decoder_layers", None) or config.num_hidden_layers
+            n_kv = getattr(config, "num_key_value_heads", config.num_attention_heads)
+            head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
+            kv_dtype = jnp.bfloat16 if module.dtype == jnp.bfloat16 else jnp.float32
+            shape = (n_layers, B, max_length, n_kv, head_dim)
+            kv = KVCache(keys=jnp.zeros(shape, kv_dtype), values=jnp.zeros(shape, kv_dtype),
+                         offset=jnp.zeros((), jnp.int32))
+            ids_buf = jnp.full((B, max_length), pad_id, jnp.int32)
+            ids_buf = ids_buf.at[:, 0].set(start_id)
+            finished = jnp.zeros((B,), jnp.bool_)
+
+            def sample_token(logits, ids_buf, cur_len, key, finished):
+                V = logits.shape[-1]
+                written = jnp.arange(max_length)[None, :] < cur_len
+                proc_ids = jnp.where(written, ids_buf, V)  # sentinel for unwritten slots
+                logits = procs(proc_ids, logits, cur_len)
+                if do_sample:
+                    logits = warpers(proc_ids, logits, cur_len)
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, logits, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = jnp.where(finished, pad_id, nxt).astype(jnp.int32)
+                newly = jnp.zeros_like(finished)
+                for e in eos_ids:
+                    newly = newly | (nxt == e)
+                return nxt, key, finished | newly
+
+            def cond(state):
+                _, _, cur_len, _, finished = state
+                return (cur_len < max_length) & ~finished.all()
+
+            def body(state):
+                ids_buf, kv, cur_len, key, finished = state
+                tok = jax.lax.dynamic_slice(ids_buf, (0, cur_len - 1), (B, 1))
+                out = module.apply(
+                    {"params": params}, tok, enc_h,
+                    encoder_attention_mask=enc_mask, cache=kv, cross_kvs=cross, method="decode",
+                )
+                logits = out.logits[:, -1].astype(jnp.float32)
+                nxt, key, finished = sample_token(logits, ids_buf, cur_len, key, finished)
+                ids_buf = jax.lax.dynamic_update_slice(ids_buf, nxt[:, None], (0, cur_len))
+                return (ids_buf, out.past_key_values, cur_len + 1, key, finished)
+
+            state = (ids_buf, kv, jnp.asarray(1, jnp.int32), key, finished)
+            state = jax.lax.while_loop(cond, body, state)
+            ids_buf, _, cur_len, _, _ = state
+            return ids_buf, cur_len
+
+        fn = jax.jit(decode)
+        cache[cache_key] = fn
+        return fn
+
     # ------------------------------------------------------------------
     def _get_beam_decode_fn(self, *, max_length, prompt_len, pad_id, eos_ids, num_beams,
                             num_groups, length_penalty, diversity_penalty, procs):
@@ -189,11 +319,8 @@ class GenerationMixin:
         exactly when they remain top-K. Diverse groups subtract
         ``diversity_penalty`` times the count of tokens already chosen by
         earlier groups at the same step (Hamming diversity)."""
-        def _sig(ps):
-            return tuple((type(p).__name__, tuple(sorted(p.__dict__.items()))) for p in ps)
-
         cache_key = ("beams", max_length, prompt_len, pad_id, eos_ids, num_beams, num_groups,
-                     length_penalty, diversity_penalty, _sig(procs))
+                     length_penalty, diversity_penalty, _procs_sig(procs))
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
@@ -328,10 +455,7 @@ class GenerationMixin:
         return fn
 
     def _get_decode_fn(self, *, max_length, prompt_len, do_sample, pad_id, eos_ids, procs, warpers, forced_eos):
-        def _sig(ps):
-            return tuple((type(p).__name__, tuple(sorted(p.__dict__.items()))) for p in ps)
-
-        cache_key = (max_length, prompt_len, do_sample, pad_id, eos_ids, _sig(procs), _sig(warpers))
+        cache_key = (max_length, prompt_len, do_sample, pad_id, eos_ids, _procs_sig(procs), _procs_sig(warpers))
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}

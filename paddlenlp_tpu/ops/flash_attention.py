@@ -83,6 +83,7 @@ def dot_product_attention(
     positions: Optional[jnp.ndarray] = None,  # [B, T] or [T] ABSOLUTE positions (permuted layouts)
     use_pallas: Optional[bool] = None,
     use_alibi: bool = False,  # additive -slope*(q_pos-k_pos) bias (bloom/baichuan-13b)
+    bias: Optional[jnp.ndarray] = None,  # [B|1, N|1, T, S] additive bias (t5 relative positions)
 ) -> jnp.ndarray:
     """Fused attention; returns [B, T, n_heads, head_dim] in query dtype.
 
@@ -104,6 +105,7 @@ def dot_product_attention(
 
     pallas_eligible = (
         causal
+        and bias is None
         and attention_mask is None
         and positions is None
         and dropout_rate == 0.0
@@ -143,7 +145,6 @@ def dot_product_attention(
         pad = attention_mask[:, None, None, :].astype(jnp.bool_)
         mask = pad if mask is None else jnp.logical_and(mask, pad)
 
-    bias = None
     if use_alibi:
         if positions is not None:
             # permuted layouts (cp zigzag): distances from ABSOLUTE positions
@@ -151,9 +152,11 @@ def dot_product_attention(
             pos = jnp.broadcast_to(pos, (B, S)).astype(jnp.float32)
             q_pos = pos[:, -T:] if T != S else pos
             dist = q_pos[:, None, :, None] - pos[:, None, None, :]
-            bias = -alibi_slopes(N)[None, :, None, None] * dist
+            ab = -alibi_slopes(N)[None, :, None, None] * dist
+            bias = ab if bias is None else bias + ab
         else:
-            bias = jnp.broadcast_to(alibi_bias(N, T, S, q_offset), (B, N, T, S))
+            ab = jnp.broadcast_to(alibi_bias(N, T, S, q_offset), (B, N, T, S))
+            bias = ab if bias is None else bias + ab
 
     if dropout_rate == 0.0:
         try:

@@ -16,12 +16,18 @@ class TextGenerationTask(Task):
     """Taskflow("text_generation", task_path=<model dir>)(prompt) -> completion."""
 
     def _construct(self):
-        from ..transformers import AutoModelForCausalLM, AutoTokenizer
+        from ..transformers import AutoConfig, AutoModelForCausalLM, AutoTokenizer
+        from ..transformers.auto import AutoModelForSeq2SeqLM
 
         self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
-        self.tokenizer.padding_side = "left"
-        self.model = AutoModelForCausalLM.from_pretrained(
-            self.model_name, dtype=self.kwargs.get("dtype", "float32")
+        config = AutoConfig.from_pretrained(self.model_name)
+        # seq2seq checkpoints (t5/bart) keep right padding (encoder side);
+        # decoder-only batched decode needs left padding
+        self.is_encoder_decoder = bool(getattr(config, "is_encoder_decoder", False))
+        auto_cls = AutoModelForSeq2SeqLM if self.is_encoder_decoder else AutoModelForCausalLM
+        self.tokenizer.padding_side = "right" if self.is_encoder_decoder else "left"
+        self.model = auto_cls.from_pretrained(
+            self.model_name, config=config, dtype=self.kwargs.get("dtype", "float32")
         )
         self.max_new_tokens = self.kwargs.get("max_new_tokens", 64)
         self.do_sample = self.kwargs.get("do_sample", False)
@@ -29,7 +35,8 @@ class TextGenerationTask(Task):
     def _run_model(self, texts: List[str]):
         if self.tokenizer.chat_template and self.kwargs.get("apply_chat_template", False):
             texts = [self.tokenizer.apply_chat_template([{"role": "user", "content": t}]) for t in texts]
-        enc = self.tokenizer(texts, padding=True, padding_side="left", return_tensors="np")
+        enc = self.tokenizer(texts, padding=True, padding_side=self.tokenizer.padding_side,
+                             return_tensors="np")
         out, _ = self.model.generate(
             jnp.asarray(enc["input_ids"]),
             attention_mask=jnp.asarray(enc["attention_mask"]),

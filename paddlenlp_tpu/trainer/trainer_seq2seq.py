@@ -1,7 +1,10 @@
-"""Seq2seq trainer: generation-based evaluation.
+"""Seq2seq trainer: teacher-forced encoder-decoder loss + generation-based eval.
 
 Counterpart of ``paddlenlp/trainer/trainer_seq2seq.py`` (predict/evaluate through
-``model.generate`` instead of teacher-forced logits).
+``model.generate`` instead of teacher-forced logits). For encoder-decoder models
+(t5/bart) ``compute_loss`` builds ``decoder_input_ids`` by shifting labels right
+and computes UNSHIFTED cross-entropy (labels already align 1:1 with decoder
+positions) — the causal-LM shift in the base Trainer would be off by one.
 """
 
 from __future__ import annotations
@@ -11,6 +14,7 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.cross_entropy import cross_entropy_with_ignore
 from .trainer import Trainer
 from .trainer_utils import PredictionOutput, speed_metrics
 
@@ -35,6 +39,36 @@ class Seq2SeqTrainer(Trainer):
         self.gen_kwargs = gen_kwargs or {"max_new_tokens": 64, "do_sample": False}
         self.predict_with_generate = predict_with_generate
 
+    def compute_loss(self, params, inputs, dropout_rng=None):
+        if not getattr(self.model.config, "is_encoder_decoder", False):
+            return super().compute_loss(params, inputs, dropout_rng)
+        return self.model.compute_seq2seq_loss(params, inputs, dropout_rng=dropout_rng,
+                                               criterion=self.criterion)
+
+    def _build_eval_step(self):
+        """Teacher-forced eval for encoder-decoder models: decoder_input_ids from
+        shifted labels + UNSHIFTED CE (the base Trainer's causal shift would be
+        off by one); still returns logits for compute_metrics."""
+        if not getattr(self.model.config, "is_encoder_decoder", False):
+            return super()._build_eval_step()
+        import jax
+
+        def eval_step(params, batch):
+            inputs = dict(batch)
+            labels = inputs.pop("labels", None)
+            if labels is not None and "decoder_input_ids" not in inputs:
+                inputs["decoder_input_ids"] = self.model.prepare_decoder_input_ids_from_labels(labels)
+            out = self.model.module.apply({"params": params}, **inputs, deterministic=True)
+            if labels is None:
+                return {"logits": out.logits}
+            if self.criterion is not None:
+                loss = self.criterion(out.logits, labels)
+            else:
+                loss, _ = cross_entropy_with_ignore(out.logits, labels)
+            return {"loss": loss, "logits": out.logits}
+
+        return jax.jit(eval_step)
+
     def generate_and_score(self, test_dataset, metric_key_prefix: str = "test") -> PredictionOutput:
         """Batch generate over the dataset; compute_metrics sees token sequences."""
         import time
@@ -44,11 +78,14 @@ class Seq2SeqTrainer(Trainer):
         params = self.train_state.params if self.train_state is not None else self.model.params
         preds: List[np.ndarray] = []
         labels: List[np.ndarray] = []
+        encdec = getattr(self.model.config, "is_encoder_decoder", False)
         for host_batch in dataloader:
             ids = np.asarray(host_batch["input_ids"])
             mask = np.asarray(host_batch.get("attention_mask", np.ones_like(ids)))
-            # batched decode needs LEFT padding; eval collators right-pad, so repack
-            ids, mask = _left_repack(ids, mask)
+            if not encdec:
+                # batched DECODER prompts need LEFT padding; eval collators
+                # right-pad, so repack (encoder inputs keep right padding)
+                ids, mask = _left_repack(ids, mask)
             out, _ = self.model.generate(jnp.asarray(ids), attention_mask=jnp.asarray(mask),
                                          params=params, **self.gen_kwargs)
             preds.extend(np.asarray(out))

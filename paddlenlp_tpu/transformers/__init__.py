@@ -49,4 +49,15 @@ from .opt import OPTConfig, OPTForCausalLM, OPTModel  # noqa: F401
 from .qwen import QWenConfig, QWenForCausalLM, QWenModel  # noqa: F401
 from .qwen2 import Qwen2Config, Qwen2ForCausalLM, Qwen2ForSequenceClassification, Qwen2Model  # noqa: F401
 from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM, Qwen2MoeModel  # noqa: F401
+from .bart import (  # noqa: F401
+    BartConfig,
+    BartForConditionalGeneration,
+    BartModel,
+)
+from .t5 import (  # noqa: F401
+    T5Config,
+    T5EncoderModel,
+    T5ForConditionalGeneration,
+    T5Model,
+)
 from .tokenizer_utils import BatchEncoding, PretrainedTokenizer  # noqa: F401

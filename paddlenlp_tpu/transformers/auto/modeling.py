@@ -14,6 +14,8 @@ __all__ = [
     "AutoModelForCausalLM",
     "AutoModelForSequenceClassification",
     "AutoModelForMaskedLM",
+    "AutoModelForSeq2SeqLM",
+    "AutoModelForConditionalGeneration",
     "AutoModelForCausalLMPipe",
 ]
 
@@ -79,6 +81,14 @@ def _populate_models():
     register_model("ernie", "token_classification", ernie.ErnieForTokenClassification)
     register_model("mixtral", "causal_lm", mixtral.MixtralForCausalLM)
     register_model("qwen2_moe", "causal_lm", qwen2_moe.Qwen2MoeForCausalLM)
+    from ..t5 import modeling as t5
+
+    register_model("t5", "base", t5.T5Model)
+    register_model("t5", "seq2seq_lm", t5.T5ForConditionalGeneration)
+    from ..bart import modeling as bart
+
+    register_model("bart", "base", bart.BartModel)
+    register_model("bart", "seq2seq_lm", bart.BartForConditionalGeneration)
 
 
 class _AutoBase:
@@ -127,6 +137,14 @@ class AutoModelForTokenClassification(_AutoBase):
 
 class AutoModelForMaskedLM(_AutoBase):
     task = "masked_lm"
+
+
+class AutoModelForSeq2SeqLM(_AutoBase):
+    task = "seq2seq_lm"
+
+
+class AutoModelForConditionalGeneration(_AutoBase):
+    task = "seq2seq_lm"
 
 
 # The reference exposes AutoModelForCausalLMPipe for pipeline-parallel runs

@@ -1,0 +1,6 @@
+from .configuration import BartConfig
+from .modeling import (
+    BartForConditionalGeneration,
+    BartModel,
+    BartPretrainedModel,
+)

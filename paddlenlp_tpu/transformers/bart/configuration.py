@@ -1,0 +1,65 @@
+"""BART configuration (reference: paddlenlp/transformers/bart/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["BartConfig"]
+
+
+class BartConfig(PretrainedConfig):
+    model_type = "bart"
+    attribute_map = {
+        "hidden_size": "d_model",
+        "num_hidden_layers": "encoder_layers",
+        "num_decoder_layers": "decoder_layers",
+        "num_attention_heads": "decoder_attention_heads",
+        "num_key_value_heads": "decoder_attention_heads",
+        "intermediate_size": "decoder_ffn_dim",
+        "hidden_act": "activation_function",
+    }
+
+    def __init__(
+        self,
+        vocab_size: int = 50265,
+        d_model: int = 768,
+        encoder_layers: int = 6,
+        decoder_layers: int = 6,
+        encoder_attention_heads: int = 12,
+        decoder_attention_heads: int = 12,
+        encoder_ffn_dim: int = 3072,
+        decoder_ffn_dim: int = 3072,
+        max_position_embeddings: int = 1024,
+        activation_function: str = "gelu",
+        dropout: float = 0.1,
+        attention_dropout: float = 0.0,
+        activation_dropout: float = 0.0,
+        init_std: float = 0.02,
+        scale_embedding: bool = False,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.encoder_layers = encoder_layers
+        self.decoder_layers = decoder_layers
+        self.encoder_attention_heads = encoder_attention_heads
+        self.decoder_attention_heads = decoder_attention_heads
+        self.encoder_ffn_dim = encoder_ffn_dim
+        self.decoder_ffn_dim = decoder_ffn_dim
+        self.max_position_embeddings = max_position_embeddings
+        self.activation_function = activation_function
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.activation_dropout = activation_dropout
+        self.init_std = init_std
+        self.initializer_range = init_std
+        self.scale_embedding = scale_embedding
+        kwargs.setdefault("pad_token_id", 1)
+        kwargs.setdefault("bos_token_id", 0)
+        kwargs.setdefault("eos_token_id", 2)
+        kwargs.setdefault("decoder_start_token_id", 2)  # bart decodes from eos
+        kwargs.setdefault("forced_eos_token_id", 2)
+        kwargs.setdefault("is_encoder_decoder", True)
+        kwargs.setdefault("tie_word_embeddings", True)
+        kwargs.setdefault("use_scan_layers", False)
+        super().__init__(**kwargs)

@@ -1,0 +1,321 @@
+"""BART encoder-decoder family, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/bart/modeling.py`` (1407 LoC):
+``BartLearnedPositionalEmbedding`` (+2 offset), ``BartAttention`` (biased q/k/v/out,
+sqrt(d) scaling), ``BartEncoderLayer``/``BartDecoderLayer`` (post-LN residuals),
+``BartEncoder``/``BartDecoder`` (layernorm_embedding), ``BartForConditionalGeneration``
+(tied head + ``final_logits_bias``).
+
+Same TPU-first shape as t5/modeling.py: strategy-free linen net + partition rules,
+static-shape self-attn KVCache, cross-attention K/V precomputed once
+(``encode`` / ``init_cross_kv`` / ``decode`` apply-methods feed the shared
+``lax.while_loop`` seq2seq decode in generation/utils.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import ACT2FN, VocabEmbed
+from ..model_outputs import Seq2SeqLMOutput, Seq2SeqModelOutput
+from ..model_utils import PretrainedModel
+from ..seq2seq_utils import Seq2SeqLMMixin, module_dropout as _dropout
+from .configuration import BartConfig
+
+__all__ = ["BartModel", "BartForConditionalGeneration", "BartPretrainedModel"]
+
+
+class BartAttention(nn.Module):
+    """Standard scaled MHA with biases (reference BartAttention)."""
+
+    config: BartConfig
+    n_heads: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    causal: bool = False
+
+    def setup(self):
+        cfg = self.config
+        mk = lambda: nn.Dense(cfg.d_model, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.init_std))
+        self.q_proj, self.k_proj, self.v_proj, self.out_proj = mk(), mk(), mk(), mk()
+
+    def _split(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.n_heads, self.config.d_model // self.n_heads)
+
+    def compute_kv(self, states):
+        k = shard_constraint(self._split(self.k_proj(states)), P("batch", None, "act_kv_heads", None))
+        v = shard_constraint(self._split(self.v_proj(states)), P("batch", None, "act_kv_heads", None))
+        return k, v
+
+    def __call__(self, hidden_states, attention_mask=None, kv_states=None, precomputed_kv=None,
+                 cache_kv: Optional[Tuple] = None, offset=0, deterministic: bool = True):
+        cfg = self.config
+        B, T, _ = hidden_states.shape
+        q = shard_constraint(self._split(self.q_proj(hidden_states)), P("batch", "act_seq_attn", "act_heads", None))
+        if precomputed_kv is not None:
+            k, v = precomputed_kv
+        else:
+            k, v = self.compute_kv(kv_states if kv_states is not None else hidden_states)
+        new_kv = None
+        q_offset = 0
+        if cache_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(cache_kv[0], cache_kv[1], k, v, offset)
+            new_kv = (k, v)
+        rate = cfg.attention_dropout if not deterministic else 0.0
+        rng = self.make_rng("dropout") if rate > 0 else None
+        out = dot_product_attention(
+            q, k, v, attention_mask=attention_mask, causal=self.causal, q_offset=q_offset,
+            dropout_rate=rate, dropout_rng=rng,
+        )
+        return self.out_proj(out.reshape(B, T, cfg.d_model)), new_kv
+
+
+class BartEncoderLayer(nn.Module):
+    """Post-LN: h = LN(h + sublayer(h)) (reference BartEncoderLayer)."""
+
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        ln = lambda: nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+        dense = lambda feats: nn.Dense(feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+                                       kernel_init=nn.initializers.normal(cfg.init_std))
+        self.self_attn = BartAttention(cfg, cfg.encoder_attention_heads, self.dtype, self.param_dtype, causal=False)
+        self.self_attn_layer_norm = ln()
+        self.fc1 = dense(cfg.encoder_ffn_dim)
+        self.fc2 = dense(cfg.d_model)
+        self.final_layer_norm = ln()
+
+    def __call__(self, h, attention_mask=None, deterministic: bool = True):
+        cfg = self.config
+        attn, _ = self.self_attn(h, attention_mask, deterministic=deterministic)
+        h = self.self_attn_layer_norm(h + _dropout(self, attn, cfg.dropout, deterministic))
+        ff = ACT2FN[cfg.activation_function](self.fc1(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dropout(self, ff, cfg.activation_dropout, deterministic)
+        ff = self.fc2(ff)
+        h = self.final_layer_norm(h + _dropout(self, ff, cfg.dropout, deterministic))
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class BartDecoderLayer(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        ln = lambda: nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+        dense = lambda feats: nn.Dense(feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+                                       kernel_init=nn.initializers.normal(cfg.init_std))
+        self.self_attn = BartAttention(cfg, cfg.decoder_attention_heads, self.dtype, self.param_dtype, causal=True)
+        self.self_attn_layer_norm = ln()
+        self.encoder_attn = BartAttention(cfg, cfg.decoder_attention_heads, self.dtype, self.param_dtype, causal=False)
+        self.encoder_attn_layer_norm = ln()
+        self.fc1 = dense(cfg.decoder_ffn_dim)
+        self.fc2 = dense(cfg.d_model)
+        self.final_layer_norm = ln()
+
+    def __call__(self, h, attention_mask=None, encoder_hidden_states=None, encoder_attention_mask=None,
+                 cross_kv=None, cache_kv=None, offset=0, deterministic: bool = True):
+        cfg = self.config
+        attn, new_kv = self.self_attn(h, attention_mask, cache_kv=cache_kv, offset=offset,
+                                      deterministic=deterministic)
+        h = self.self_attn_layer_norm(h + _dropout(self, attn, cfg.dropout, deterministic))
+        cross, _ = self.encoder_attn(h, encoder_attention_mask, kv_states=encoder_hidden_states,
+                                     precomputed_kv=cross_kv, deterministic=deterministic)
+        h = self.encoder_attn_layer_norm(h + _dropout(self, cross, cfg.dropout, deterministic))
+        ff = ACT2FN[cfg.activation_function](self.fc1(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dropout(self, ff, cfg.activation_dropout, deterministic)
+        ff = self.fc2(ff)
+        h = self.final_layer_norm(h + _dropout(self, ff, cfg.dropout, deterministic))
+        return shard_constraint(h, P("batch", "act_seq", "act_embed")), new_kv
+
+
+class BartEncoder(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        # HF learned positional embedding carries a +2 offset baked into the table
+        self.embed_positions = nn.Embed(cfg.max_position_embeddings + 2, cfg.d_model, dtype=self.dtype,
+                                        param_dtype=self.param_dtype,
+                                        embedding_init=nn.initializers.normal(cfg.init_std))
+        self.layernorm_embedding = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+        self.layers = [BartEncoderLayer(cfg, self.dtype, self.param_dtype) for _ in range(cfg.encoder_layers)]
+
+    def __call__(self, inputs_embeds, attention_mask=None, deterministic: bool = True):
+        cfg = self.config
+        T = inputs_embeds.shape[1]
+        scale = cfg.d_model**0.5 if cfg.scale_embedding else 1.0
+        h = inputs_embeds * scale + self.embed_positions(jnp.arange(T)[None, :] + 2)
+        h = self.layernorm_embedding(h)
+        h = _dropout(self, h, cfg.dropout, deterministic)
+        for layer in self.layers:
+            h = layer(h, attention_mask, deterministic)
+        return h
+
+
+class BartDecoder(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.embed_positions = nn.Embed(cfg.max_position_embeddings + 2, cfg.d_model, dtype=self.dtype,
+                                        param_dtype=self.param_dtype,
+                                        embedding_init=nn.initializers.normal(cfg.init_std))
+        self.layernorm_embedding = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+        self.layers = [BartDecoderLayer(cfg, self.dtype, self.param_dtype) for _ in range(cfg.decoder_layers)]
+
+    def init_cross_kv(self, encoder_hidden_states):
+        ks, vs = [], []
+        for layer in self.layers:
+            k, v = layer.encoder_attn.compute_kv(encoder_hidden_states)
+            ks.append(k)
+            vs.append(v)
+        return jnp.stack(ks), jnp.stack(vs)
+
+    def __call__(self, inputs_embeds, attention_mask=None, encoder_hidden_states=None,
+                 encoder_attention_mask=None, cache: Optional[KVCache] = None, cross_kvs=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        T = inputs_embeds.shape[1]
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        scale = cfg.d_model**0.5 if cfg.scale_embedding else 1.0
+        pos = jnp.arange(T)[None, :] + offset + 2
+        h = inputs_embeds * scale + self.embed_positions(pos)
+        h = self.layernorm_embedding(h)
+        h = _dropout(self, h, cfg.dropout, deterministic)
+        new_keys, new_values = [], []
+        for i, layer in enumerate(self.layers):
+            cache_kv = (cache.keys[i], cache.values[i]) if cache is not None else None
+            cross_kv = (cross_kvs[0][i], cross_kvs[1][i]) if cross_kvs is not None else None
+            h, kv = layer(h, attention_mask, encoder_hidden_states, encoder_attention_mask,
+                          cross_kv, cache_kv, offset, deterministic)
+            if kv is not None:
+                new_keys.append(kv[0])
+                new_values.append(kv[1])
+        new_cache = None
+        if cache is not None:
+            new_cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        return h, new_cache
+
+
+class BartModule(nn.Module):
+    config: BartConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    with_lm_head: bool = True
+
+    def setup(self):
+        cfg = self.config
+        self.shared = VocabEmbed(cfg.vocab_size, cfg.d_model, dtype=self.dtype, param_dtype=self.param_dtype,
+                                 embedding_init=nn.initializers.normal(cfg.init_std))
+        self.encoder = BartEncoder(cfg, self.dtype, self.param_dtype)
+        self.decoder = BartDecoder(cfg, self.dtype, self.param_dtype)
+        if self.with_lm_head:
+            self.final_logits_bias = self.param("final_logits_bias", nn.initializers.zeros,
+                                                (1, cfg.vocab_size), self.param_dtype)
+
+    def encode(self, input_ids, attention_mask=None, deterministic: bool = True):
+        return self.encoder(self.shared(input_ids), attention_mask, deterministic)
+
+    def init_cross_kv(self, encoder_hidden_states):
+        return self.decoder.init_cross_kv(encoder_hidden_states)
+
+    def decode(self, decoder_input_ids, encoder_hidden_states, encoder_attention_mask=None,
+               decoder_attention_mask=None, cache: Optional[KVCache] = None, cross_kvs=None,
+               deterministic: bool = True):
+        h, new_cache = self.decoder(self.shared(decoder_input_ids), decoder_attention_mask,
+                                    encoder_hidden_states, encoder_attention_mask, cache, cross_kvs,
+                                    deterministic)
+        if not self.with_lm_head:
+            return Seq2SeqModelOutput(last_hidden_state=h, past_key_values=new_cache,
+                                      encoder_last_hidden_state=encoder_hidden_states)
+        table = self.get_variable("params", "shared")["embedding"]
+        logits = h @ table.T.astype(self.dtype) + self.final_logits_bias.astype(self.dtype)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        return Seq2SeqLMOutput(logits=logits, past_key_values=new_cache,
+                               encoder_last_hidden_state=encoder_hidden_states)
+
+    def __call__(self, input_ids=None, attention_mask=None, decoder_input_ids=None,
+                 decoder_attention_mask=None, cache: Optional[KVCache] = None,
+                 deterministic: bool = True, output_hidden_states: bool = False,
+                 return_dict: bool = True):
+        enc_h = self.encode(input_ids, attention_mask, deterministic)
+        return self.decode(decoder_input_ids, enc_h, attention_mask, decoder_attention_mask,
+                           cache, None, deterministic)
+
+
+class BartModelModule(BartModule):
+    with_lm_head: bool = False
+
+
+class BartPretrainedModel(PretrainedModel):
+    config_class = BartConfig
+    base_model_prefix = "model"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32),
+                "decoder_input_ids": jnp.zeros((1, 4), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"shared/embedding$", P("vocab", "embed")),
+            (r"embed_positions/embedding$", P(None, "embed")),
+            (r"(self_attn|encoder_attn)/(q_proj|k_proj|v_proj)/kernel$", P("embed", "heads")),
+            (r"(self_attn|encoder_attn)/(q_proj|k_proj|v_proj)/bias$", P("heads")),
+            (r"(self_attn|encoder_attn)/out_proj/kernel$", P("heads", "embed")),
+            (r"fc1/kernel$", P("embed", "mlp")),
+            (r"fc1/bias$", P("mlp")),
+            (r"fc2/kernel$", P("mlp", "embed")),
+            (r"(layer_norm|layernorm_embedding)/(scale|bias)$", P()),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        """encoder/layers_0/self_attn/q_proj/kernel -> model.encoder.layers.0.self_attn.q_proj.weight;
+        shared/final_logits_bias keep HF's top-level names."""
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\blayers_(\d+)\b", r"layers.\1", path).replace("/", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            if key != "final_logits_bias":
+                key = "model." + key
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class BartModel(BartPretrainedModel):
+    module_class = BartModelModule
+    _keys_to_ignore_on_load_unexpected = [r"embed_tokens\.weight", r"lm_head", r"final_logits_bias"]
+
+
+class BartForConditionalGeneration(BartPretrainedModel, Seq2SeqLMMixin):
+    module_class = BartModule
+    _keys_to_ignore_on_load_missing = [r"final_logits_bias"]
+    _keys_to_ignore_on_load_unexpected = [r"embed_tokens\.weight", r"lm_head"]

@@ -25,6 +25,7 @@ __all__ = [
     "QuestionAnsweringModelOutput",
     "MoECausalLMOutputWithPast",
     "Seq2SeqLMOutput",
+    "Seq2SeqModelOutput",
 ]
 
 
@@ -134,6 +135,14 @@ class QuestionAnsweringModelOutput(ModelOutput):
     end_logits: Any = None
     hidden_states: Optional[Tuple] = None
     attentions: Optional[Tuple] = None
+
+
+class Seq2SeqModelOutput(ModelOutput):
+    last_hidden_state: Any = None
+    past_key_values: Any = None
+    decoder_hidden_states: Optional[Tuple] = None
+    encoder_last_hidden_state: Any = None
+    encoder_hidden_states: Optional[Tuple] = None
 
 
 class Seq2SeqLMOutput(ModelOutput):

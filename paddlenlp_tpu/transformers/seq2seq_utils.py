@@ -1,0 +1,57 @@
+"""Shared encoder-decoder plumbing for t5/bart (and future seq2seq families).
+
+One copy of label shifting + teacher-forced loss (the reference duplicates this
+per model in ``paddlenlp/transformers/{t5,bart}/modeling.py`` forward paths).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.cross_entropy import cross_entropy_with_ignore
+
+__all__ = ["shift_tokens_right", "module_dropout", "Seq2SeqLMMixin"]
+
+
+def shift_tokens_right(labels, pad_token_id: int, decoder_start_token_id: int):
+    """labels -> decoder_input_ids (reference t5/modeling.py _shift_right)."""
+    labels = jnp.asarray(labels)
+    start = jnp.full(labels.shape[:-1] + (1,), decoder_start_token_id, labels.dtype)
+    shifted = jnp.concatenate([start, labels[..., :-1]], axis=-1)
+    return jnp.where(shifted == -100, pad_token_id, shifted)
+
+
+def module_dropout(module, x, rate: float, deterministic: bool):
+    """Functional dropout for setup-style linen modules (nn.Dropout submodules
+    can't be constructed inside non-compact methods)."""
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(module.make_rng("dropout"), keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class Seq2SeqLMMixin:
+    """Teacher-forced loss API for *ForConditionalGeneration facades.
+    Relies on self.{config,module,params} (PretrainedModel)."""
+
+    def prepare_decoder_input_ids_from_labels(self, labels):
+        return shift_tokens_right(labels, self.config.pad_token_id, self.config.decoder_start_token_id)
+
+    def compute_seq2seq_loss(self, params, batch, dropout_rng=None, deterministic: bool = False,
+                             criterion=None):
+        """CE over decoder positions: labels align 1:1 with decoder_input_ids
+        (NO causal shift — decoder_input_ids already starts with decoder_start)."""
+        inputs = dict(batch)
+        labels = inputs.pop("labels", None)
+        if labels is None:
+            raise ValueError("seq2seq loss requires `labels` in the batch")
+        if "decoder_input_ids" not in inputs:
+            inputs["decoder_input_ids"] = self.prepare_decoder_input_ids_from_labels(labels)
+        rngs = {"dropout": dropout_rng} if dropout_rng is not None else {}
+        out = self.module.apply({"params": params}, **inputs, deterministic=deterministic, rngs=rngs)
+        if criterion is not None:
+            return criterion(out.logits, labels)
+        loss, _ = cross_entropy_with_ignore(out.logits, labels)
+        return loss

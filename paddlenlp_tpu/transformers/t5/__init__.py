@@ -1,0 +1,7 @@
+from .configuration import T5Config
+from .modeling import (
+    T5EncoderModel,
+    T5ForConditionalGeneration,
+    T5Model,
+    T5PretrainedModel,
+)

@@ -1,0 +1,71 @@
+"""T5 configuration (reference: paddlenlp/transformers/t5/configuration.py).
+
+HF-canonical field names (``d_model``/``num_layers``/``num_heads``...) with
+``attribute_map`` aliases onto the generic names the rest of the framework uses
+(``hidden_size``/``num_hidden_layers``/...), so trainer/cache/partition plumbing
+works unmodified.
+"""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["T5Config"]
+
+
+class T5Config(PretrainedConfig):
+    model_type = "t5"
+    attribute_map = {
+        "hidden_size": "d_model",
+        "num_hidden_layers": "num_layers",
+        "num_attention_heads": "num_heads",
+        "num_key_value_heads": "num_heads",
+        "head_dim": "d_kv",
+        "intermediate_size": "d_ff",
+    }
+
+    def __init__(
+        self,
+        vocab_size: int = 32128,
+        d_model: int = 512,
+        d_kv: int = 64,
+        d_ff: int = 2048,
+        num_layers: int = 6,
+        num_decoder_layers: int = None,
+        num_heads: int = 8,
+        relative_attention_num_buckets: int = 32,
+        relative_attention_max_distance: int = 128,
+        dropout_rate: float = 0.1,
+        layer_norm_epsilon: float = 1e-6,
+        initializer_factor: float = 1.0,
+        feed_forward_proj: str = "relu",
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.d_kv = d_kv
+        self.d_ff = d_ff
+        self.num_layers = num_layers
+        self.num_decoder_layers = num_decoder_layers if num_decoder_layers is not None else num_layers
+        self.num_heads = num_heads
+        self.relative_attention_num_buckets = relative_attention_num_buckets
+        self.relative_attention_max_distance = relative_attention_max_distance
+        self.dropout_rate = dropout_rate
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_factor = initializer_factor
+        self.feed_forward_proj = feed_forward_proj
+        # derived (plain attributes, not properties: HF config.json re-serializes them)
+        kwargs.pop("is_gated_act", None)
+        kwargs.pop("dense_act_fn", None)
+        self.is_gated_act = feed_forward_proj.startswith("gated-")
+        act = feed_forward_proj.split("-")[-1]
+        self.dense_act_fn = {"gelu": "gelu_new"}.get(act, act)
+        # initializer_range used by generic _dense(); T5 scales per-matrix below
+        self.initializer_range = initializer_factor * 1.0
+        kwargs.setdefault("pad_token_id", 0)
+        kwargs.setdefault("eos_token_id", 1)
+        kwargs.setdefault("decoder_start_token_id", 0)
+        kwargs.setdefault("is_encoder_decoder", True)
+        kwargs.setdefault("tie_word_embeddings", True)
+        kwargs.setdefault("use_scan_layers", False)  # seq2seq stacks run unrolled
+        super().__init__(**kwargs)

@@ -1,0 +1,441 @@
+"""T5 encoder-decoder family, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/t5/modeling.py`` (1890 LoC): ``T5LayerNorm``
+:40 (RMS, no bias), ``T5DenseReluDense``/``T5DenseGatedGeluDense`` :70-215,
+``T5Attention`` :219 (relative position buckets :260, NO sqrt(d) scaling),
+``T5LayerSelfAttention`` :441, ``T5LayerCrossAttention`` :474, ``T5Block`` :507,
+``T5Stack`` :780, ``T5ForConditionalGeneration`` (tied head rescale d_model**-0.5).
+
+TPU-first redesign:
+- ONE strategy-free linen network; tp/fsdp/sp via partition rules + activation
+  constraints, exactly like the decoder-only families.
+- The relative-position-bias embedding lives at STACK level (HF stores it under
+  block 0 only — ``encoder.block.0.layer.0.SelfAttention.relative_attention_bias``);
+  the name mapping translates. The bias is computed once per forward and shared by
+  every block, matching HF semantics without recomputing per layer.
+- Incremental decoding: static-shape self-attn ``KVCache`` + cross-attention K/V
+  precomputed ONCE from the encoder output (``init_cross_kv``) — the reference
+  recomputes projections through its dynamic ``use_cache`` dict. ``encode`` /
+  ``decode`` / ``init_cross_kv`` are linen apply-methods so the generate loop is
+  one ``lax.while_loop`` (``generation/utils.py`` seq2seq path).
+- Seq2seq stacks run unrolled (``use_scan_layers=False``): typical depths (8-24)
+  compile fast, and the block-0-only bias param would break scan homogeneity.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import ACT2FN, LlamaRMSNorm, VocabEmbed
+from ..model_outputs import BaseModelOutput, Seq2SeqLMOutput, Seq2SeqModelOutput
+from ..model_utils import PretrainedModel
+from ..seq2seq_utils import Seq2SeqLMMixin, module_dropout as _dropout, shift_tokens_right
+from .configuration import T5Config
+
+__all__ = [
+    "T5Model",
+    "T5EncoderModel",
+    "T5ForConditionalGeneration",
+    "T5PretrainedModel",
+    "shift_tokens_right",
+]
+
+
+def relative_position_bucket(relative_position, *, bidirectional: bool, num_buckets: int, max_distance: int):
+    """Bucketize mem_pos - query_pos (reference t5/modeling.py:260-306): half the
+    buckets exact small offsets, half log-spaced out to ``max_distance``."""
+    rel = relative_position
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (rel > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    log_ratio = jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact) / np.log(max_distance / max_exact)
+    large = max_exact + (log_ratio * (num_buckets - max_exact)).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+class T5Attention(nn.Module):
+    """q/k/v/o without bias, NO sqrt(d) query scaling (reference :219-440)."""
+
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    causal: bool = False
+
+    def setup(self):
+        cfg = self.config
+        inner = cfg.num_heads * cfg.d_kv
+        factor = cfg.initializer_factor
+        mk = lambda feats, std: nn.Dense(feats, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+                                         kernel_init=nn.initializers.normal(std))
+        self.q = mk(inner, factor * (cfg.d_model * cfg.d_kv) ** -0.5)
+        self.k = mk(inner, factor * cfg.d_model**-0.5)
+        self.v = mk(inner, factor * cfg.d_model**-0.5)
+        self.o = mk(cfg.d_model, factor * inner**-0.5)
+
+    def _split(self, x):
+        B, T, _ = x.shape
+        return x.reshape(B, T, self.config.num_heads, self.config.d_kv)
+
+    def compute_kv(self, states):
+        """Project key/value source states -> ([B, S, n, h], [B, S, n, h]).
+        Exposed so cross-attention K/V can be computed once per encoder pass."""
+        k = shard_constraint(self._split(self.k(states)), P("batch", None, "act_kv_heads", None))
+        v = shard_constraint(self._split(self.v(states)), P("batch", None, "act_kv_heads", None))
+        return k, v
+
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,  # [B, S_kv] padding mask over the key side
+        position_bias=None,  # [1, n, T, S_kv] additive bias
+        kv_states=None,  # cross-attention source (encoder hidden)
+        precomputed_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # one layer's KVCache slice
+        offset=0,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        B, T, _ = hidden_states.shape
+        q = shard_constraint(self._split(self.q(hidden_states)), P("batch", "act_seq_attn", "act_heads", None))
+        if precomputed_kv is not None:
+            k, v = precomputed_kv
+        else:
+            k, v = self.compute_kv(kv_states if kv_states is not None else hidden_states)
+        new_kv = None
+        q_offset = 0
+        if cache_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(cache_kv[0], cache_kv[1], k, v, offset)
+            new_kv = (k, v)
+        rate = cfg.dropout_rate if not deterministic else 0.0
+        rng = self.make_rng("dropout") if rate > 0 else None
+        out = dot_product_attention(
+            q, k, v,
+            attention_mask=attention_mask,
+            causal=self.causal,
+            q_offset=q_offset,
+            scale=1.0,  # T5: no sqrt(d) scaling — folded into init
+            bias=position_bias,
+            dropout_rate=rate,
+            dropout_rng=rng,
+        )
+        out = self.o(out.reshape(B, T, cfg.num_heads * cfg.d_kv))
+        return out, new_kv
+
+
+class T5DenseActDense(nn.Module):
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        factor = cfg.initializer_factor
+        self.wi = nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+                           kernel_init=nn.initializers.normal(factor * cfg.d_model**-0.5))
+        self.wo = nn.Dense(cfg.d_model, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+                           kernel_init=nn.initializers.normal(factor * cfg.d_ff**-0.5))
+
+    def __call__(self, x, deterministic: bool = True):
+        h = ACT2FN[self.config.dense_act_fn](self.wi(x))
+        h = shard_constraint(h, P("batch", "seq", "act_mlp"))
+        h = _dropout(self, h, self.config.dropout_rate, deterministic)
+        return self.wo(h)
+
+
+class T5DenseGatedActDense(nn.Module):
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        factor = cfg.initializer_factor
+        mk = lambda feats, std: nn.Dense(feats, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype,
+                                         kernel_init=nn.initializers.normal(std))
+        self.wi_0 = mk(cfg.d_ff, factor * cfg.d_model**-0.5)
+        self.wi_1 = mk(cfg.d_ff, factor * cfg.d_model**-0.5)
+        self.wo = mk(cfg.d_model, factor * cfg.d_ff**-0.5)
+
+    def __call__(self, x, deterministic: bool = True):
+        h = ACT2FN[self.config.dense_act_fn](self.wi_0(x)) * self.wi_1(x)
+        h = shard_constraint(h, P("batch", "seq", "act_mlp"))
+        h = _dropout(self, h, self.config.dropout_rate, deterministic)
+        return self.wo(h)
+
+
+class T5Block(nn.Module):
+    """Pre-LN residual block: self-attn [+ cross-attn] + ff (reference :507)."""
+
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    is_decoder: bool = False
+
+    def setup(self):
+        cfg = self.config
+        norm = lambda: LlamaRMSNorm(cfg.d_model, cfg.layer_norm_epsilon, param_dtype=self.param_dtype)
+        ff_cls = T5DenseGatedActDense if cfg.is_gated_act else T5DenseActDense
+        self.layer_0_layer_norm = norm()
+        self.layer_0_SelfAttention = T5Attention(cfg, self.dtype, self.param_dtype, causal=self.is_decoder)
+        if self.is_decoder:
+            self.layer_1_layer_norm = norm()
+            self.layer_1_EncDecAttention = T5Attention(cfg, self.dtype, self.param_dtype, causal=False)
+            self.layer_2_layer_norm = norm()
+            self.layer_2_DenseReluDense = ff_cls(cfg, self.dtype, self.param_dtype)
+        else:
+            self.layer_1_layer_norm = norm()
+            self.layer_1_DenseReluDense = ff_cls(cfg, self.dtype, self.param_dtype)
+
+    def __call__(self, h, attention_mask=None, position_bias=None, encoder_hidden_states=None,
+                 encoder_attention_mask=None, cross_kv=None, cache_kv=None, offset=0,
+                 deterministic: bool = True):
+        cfg = self.config
+        attn, new_kv = self.layer_0_SelfAttention(
+            self.layer_0_layer_norm(h), attention_mask, position_bias,
+            cache_kv=cache_kv, offset=offset, deterministic=deterministic,
+        )
+        h = h + _dropout(self, attn, cfg.dropout_rate, deterministic)
+        if self.is_decoder:
+            cross, _ = self.layer_1_EncDecAttention(
+                self.layer_1_layer_norm(h), encoder_attention_mask, None,
+                kv_states=encoder_hidden_states, precomputed_kv=cross_kv, deterministic=deterministic,
+            )
+            h = h + _dropout(self, cross, cfg.dropout_rate, deterministic)
+            ff = self.layer_2_DenseReluDense(self.layer_2_layer_norm(h), deterministic)
+        else:
+            ff = self.layer_1_DenseReluDense(self.layer_1_layer_norm(h), deterministic)
+        h = h + _dropout(self, ff, cfg.dropout_rate, deterministic)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return h, new_kv
+
+
+class T5Stack(nn.Module):
+    """N blocks + final RMS norm; owns the relative-position-bias table
+    (reference ``T5Stack`` :780 — there per-block with ``has_relative_attention_bias``
+    on block 0 only; hoisted here, same parameters)."""
+
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    is_decoder: bool = False
+
+    def setup(self):
+        cfg = self.config
+        n = cfg.num_decoder_layers if self.is_decoder else cfg.num_layers
+        self.block = [T5Block(cfg, self.dtype, self.param_dtype, is_decoder=self.is_decoder)
+                      for _ in range(n)]
+        self.final_layer_norm = LlamaRMSNorm(cfg.d_model, cfg.layer_norm_epsilon, param_dtype=self.param_dtype)
+        self.relative_attention_bias = nn.Embed(
+            cfg.relative_attention_num_buckets, cfg.num_heads, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            embedding_init=nn.initializers.normal(cfg.initializer_factor * cfg.d_model**-0.5),
+        )
+
+    def compute_bias(self, query_positions, key_length):
+        """[1, n_heads, T, K] additive attention bias (reference :308-321)."""
+        cfg = self.config
+        mem = jnp.arange(key_length)
+        rel = mem[None, :] - query_positions[:, None]  # [T, K]
+        buckets = relative_position_bucket(
+            rel, bidirectional=not self.is_decoder,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance,
+        )
+        values = self.relative_attention_bias(buckets)  # [T, K, n]
+        return values.transpose(2, 0, 1)[None].astype(self.dtype)
+
+    def init_cross_kv(self, encoder_hidden_states):
+        """Stacked cross-attention K/V: ([L, B, S, n, h], [L, B, S, n, h])."""
+        ks, vs = [], []
+        for blk in self.block:
+            k, v = blk.layer_1_EncDecAttention.compute_kv(encoder_hidden_states)
+            ks.append(k)
+            vs.append(v)
+        return jnp.stack(ks), jnp.stack(vs)
+
+    def __call__(self, hidden, attention_mask=None, encoder_hidden_states=None,
+                 encoder_attention_mask=None, cache: Optional[KVCache] = None, cross_kvs=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, T, _ = hidden.shape
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        key_len = cache.keys.shape[2] if cache is not None else T
+        pos_bias = self.compute_bias(jnp.arange(T) + offset, key_len)
+        h = _dropout(self, hidden, cfg.dropout_rate, deterministic)
+        new_keys, new_values = [], []
+        for i, blk in enumerate(self.block):
+            cache_kv = (cache.keys[i], cache.values[i]) if cache is not None else None
+            cross_kv = (cross_kvs[0][i], cross_kvs[1][i]) if cross_kvs is not None else None
+            h, kv = blk(h, attention_mask, pos_bias, encoder_hidden_states, encoder_attention_mask,
+                        cross_kv, cache_kv, offset, deterministic)
+            if kv is not None:
+                new_keys.append(kv[0])
+                new_values.append(kv[1])
+        new_cache = None
+        if cache is not None:
+            new_cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        h = self.final_layer_norm(h)
+        h = _dropout(self, h, cfg.dropout_rate, deterministic)
+        return h, new_cache
+
+
+class T5Module(nn.Module):
+    """shared embed + encoder stack + decoder stack [+ lm head]."""
+
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    with_lm_head: bool = True
+
+    def setup(self):
+        cfg = self.config
+        self.shared = VocabEmbed(cfg.vocab_size, cfg.d_model, dtype=self.dtype, param_dtype=self.param_dtype,
+                                 embedding_init=nn.initializers.normal(cfg.initializer_factor))
+        self.encoder = T5Stack(cfg, self.dtype, self.param_dtype, is_decoder=False)
+        self.decoder = T5Stack(cfg, self.dtype, self.param_dtype, is_decoder=True)
+        if self.with_lm_head and not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype,
+                                    param_dtype=self.param_dtype,
+                                    kernel_init=nn.initializers.normal(cfg.initializer_factor * cfg.d_model**-0.5))
+
+    # ---- apply-methods used by the generation loop -----------------------
+    def encode(self, input_ids, attention_mask=None, deterministic: bool = True):
+        h = self.shared(input_ids)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        h, _ = self.encoder(h, attention_mask, deterministic=deterministic)
+        return h
+
+    def init_cross_kv(self, encoder_hidden_states):
+        return self.decoder.init_cross_kv(encoder_hidden_states)
+
+    def decode(self, decoder_input_ids, encoder_hidden_states, encoder_attention_mask=None,
+               decoder_attention_mask=None, cache: Optional[KVCache] = None, cross_kvs=None,
+               deterministic: bool = True):
+        h = self.shared(decoder_input_ids)
+        h, new_cache = self.decoder(h, decoder_attention_mask, encoder_hidden_states,
+                                    encoder_attention_mask, cache, cross_kvs, deterministic)
+        if not self.with_lm_head:
+            return Seq2SeqModelOutput(last_hidden_state=h, past_key_values=new_cache,
+                                      encoder_last_hidden_state=encoder_hidden_states)
+        logits = self._lm_logits(h)
+        return Seq2SeqLMOutput(logits=logits, past_key_values=new_cache,
+                               encoder_last_hidden_state=encoder_hidden_states)
+
+    def _lm_logits(self, h):
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            # HF: rescale hidden by d_model**-0.5 before the tied projection
+            h = h * (cfg.d_model**-0.5)
+            table = self.get_variable("params", "shared")["embedding"]
+            logits = h @ table.T.astype(self.dtype)
+        else:
+            logits = self.lm_head(h)
+        return shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+
+    def __call__(self, input_ids=None, attention_mask=None, decoder_input_ids=None,
+                 decoder_attention_mask=None, cache: Optional[KVCache] = None,
+                 deterministic: bool = True, output_hidden_states: bool = False,
+                 return_dict: bool = True):
+        enc_h = self.encode(input_ids, attention_mask, deterministic)
+        return self.decode(decoder_input_ids, enc_h, attention_mask, decoder_attention_mask,
+                           cache, None, deterministic)
+
+
+class T5ModelModule(T5Module):
+    with_lm_head: bool = False
+
+
+class T5EncoderModule(nn.Module):
+    config: T5Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.shared = VocabEmbed(cfg.vocab_size, cfg.d_model, dtype=self.dtype, param_dtype=self.param_dtype,
+                                 embedding_init=nn.initializers.normal(cfg.initializer_factor))
+        self.encoder = T5Stack(cfg, self.dtype, self.param_dtype, is_decoder=False)
+
+    def __call__(self, input_ids=None, attention_mask=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        h = self.shared(input_ids)
+        h, _ = self.encoder(h, attention_mask, deterministic=deterministic)
+        return BaseModelOutput(last_hidden_state=h)
+
+
+class T5PretrainedModel(PretrainedModel):
+    config_class = T5Config
+    base_model_prefix = "transformer"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32),
+                "decoder_input_ids": jnp.zeros((1, 4), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"shared/embedding$", P("vocab", "embed")),
+            (r"relative_attention_bias/embedding$", P(None, "heads")),
+            (r"(SelfAttention|EncDecAttention)/(q|k|v)/kernel$", P("embed", "heads")),
+            (r"(SelfAttention|EncDecAttention)/o/kernel$", P("heads", "embed")),
+            (r"DenseReluDense/(wi|wi_0|wi_1)/kernel$", P("embed", "mlp")),
+            (r"DenseReluDense/wo/kernel$", P("mlp", "embed")),
+            (r"lm_head/kernel$", P("embed", "vocab")),
+            (r"layer_norm/scale$", P()),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        """block_3/layer_0_SelfAttention/q/kernel -> encoder.block.3.layer.0.SelfAttention.q.weight;
+        stack-level relative_attention_bias -> HF's block-0 location."""
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bblock_(\d+)\b", r"block.\1", path)
+            key = re.sub(r"\blayer_(\d)_", r"layer.\1.", key)
+            key = key.replace("/", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            for stack in ("encoder", "decoder"):
+                key = key.replace(f"{stack}.relative_attention_bias",
+                                  f"{stack}.block.0.layer.0.SelfAttention.relative_attention_bias")
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class T5Model(T5PretrainedModel):
+    module_class = T5ModelModule
+    _keys_to_ignore_on_load_unexpected = [r"embed_tokens\.weight", r"lm_head"]
+
+
+class T5EncoderModel(T5PretrainedModel):
+    module_class = T5EncoderModule
+    _keys_to_ignore_on_load_unexpected = [r"decoder\.", r"embed_tokens\.weight", r"lm_head"]
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+
+class T5ForConditionalGeneration(T5PretrainedModel, Seq2SeqLMMixin):
+    module_class = T5Module
+    _keys_to_ignore_on_load_unexpected = [r"embed_tokens\.weight"]

@@ -1,0 +1,64 @@
+"""Seq2SeqTrainer end-to-end: teacher-forced T5 finetune (loss decreases) and
+generation-based eval via compute_metrics — the reference's
+tests/trainer/test_seq2seq_trainer pattern at tiny scale."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddlenlp_tpu.trainer import Seq2SeqTrainer, TrainingArguments
+from paddlenlp_tpu.transformers import T5Config, T5ForConditionalGeneration
+
+
+def tiny_t5(seed=0):
+    cfg = T5Config(vocab_size=64, d_model=48, d_kv=12, d_ff=96, num_layers=2,
+                   num_heads=4, dropout_rate=0.0)
+    return T5ForConditionalGeneration.from_config(cfg, seed=seed)
+
+
+class ToySeq2SeqDataset:
+    """Copy task: target = source tokens (learnable at tiny scale)."""
+
+    def __init__(self, n=48, src_len=8, tgt_len=8, vocab=64, seed=0):
+        rng = np.random.default_rng(seed)
+        base = rng.integers(3, vocab, size=(6, src_len))
+        self.src = base[rng.integers(0, 6, size=n)]
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, i):
+        src = self.src[i].astype(np.int32)
+        return {"input_ids": src, "labels": src.copy()}
+
+
+def test_seq2seq_finetune_loss_decreases(tmp_path):
+    model = tiny_t5()
+    args = TrainingArguments(output_dir=str(tmp_path), per_device_train_batch_size=4,
+                             learning_rate=3e-3, max_steps=12, logging_steps=4,
+                             save_strategy="no", seed=0)
+    trainer = Seq2SeqTrainer(model=model, args=args, train_dataset=ToySeq2SeqDataset(),
+                             predict_with_generate=False)
+    out = trainer.train()
+    first = trainer.state.log_history[0]["loss"]
+    assert out.training_loss < first, (out.training_loss, first)
+
+
+def test_seq2seq_generate_eval(tmp_path):
+    model = tiny_t5()
+    args = TrainingArguments(output_dir=str(tmp_path), per_device_train_batch_size=4,
+                             per_device_eval_batch_size=4, max_steps=2, save_strategy="no", seed=0)
+
+    def exact_match(pred):
+        preds = np.asarray(pred.predictions)
+        labels = np.asarray(pred.label_ids)
+        n = min(preds.shape[-1], labels.shape[-1])
+        return {"exact": float((preds[:, :n] == labels[:, :n]).all(-1).mean())}
+
+    trainer = Seq2SeqTrainer(model=model, args=args, train_dataset=ToySeq2SeqDataset(),
+                             eval_dataset=ToySeq2SeqDataset(n=8),
+                             compute_metrics=exact_match,
+                             gen_kwargs={"max_new_tokens": 8, "do_sample": False})
+    metrics = trainer.evaluate()
+    assert "eval_exact" in metrics
+    assert 0.0 <= metrics["eval_exact"] <= 1.0
