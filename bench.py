@@ -21,6 +21,7 @@ vs_baseline = our_MFU / 0.525.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -82,10 +83,13 @@ def run_bench(tiny: bool) -> None:
     from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
     from paddlenlp_tpu.utils.env import device_peak_flops
 
+    use_flash = "--no-flash" not in sys.argv
+
     if tiny:
         config = LlamaConfig(
             vocab_size=512, hidden_size=128, intermediate_size=256, num_hidden_layers=2,
             num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=512,
+            use_flash_attention=use_flash,
         )
         batch, seq_len, steps = 2, 256, 3
     else:
@@ -96,8 +100,12 @@ def run_bench(tiny: bool) -> None:
             vocab_size=32000, hidden_size=1024, intermediate_size=2816, num_hidden_layers=24,
             num_attention_heads=16, num_key_value_heads=16, max_position_embeddings=4096,
             recompute=True, recompute_granularity="core_attn",
+            use_flash_attention=use_flash,
         )
         batch, seq_len, steps = 8, 2048, 10
+
+    from paddlenlp_tpu.ops.cross_entropy import fused_linear_cross_entropy
+    from paddlenlp_tpu.transformers.llama.modeling import LlamaModule
 
     model = LlamaForCausalLM(config, dtype=jnp.bfloat16, param_dtype=jnp.float32)
     params = model.init_weights(seed=0)
@@ -106,15 +114,18 @@ def run_bench(tiny: bool) -> None:
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
     opt_state = jax.jit(tx.init)(params)
 
-    def loss_fn(params, ids):
-        logits = model.module.apply({"params": params}, input_ids=ids[:, :-1], deterministic=True).logits
-        logits = logits.astype(jnp.float32)
-        labels = ids[:, 1:]
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        return (lse - picked).mean()
+    backbone = LlamaModule(config, dtype=jnp.bfloat16, param_dtype=jnp.float32)
 
-    @jax.jit
+    def loss_fn(params, ids):
+        # backbone-only forward + fused head/CE: full [B,T,V] logits never
+        # materialize (the 16GB-HBM cliff at B8/T2048/V32k)
+        h = backbone.apply(
+            {"params": params["model"]}, input_ids=ids[:, :-1], deterministic=True
+        ).last_hidden_state
+        loss, _ = fused_linear_cross_entropy(h, params["lm_head"]["kernel"], ids[:, 1:])
+        return loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, ids):
         loss, grads = jax.value_and_grad(loss_fn)(params, ids)
         updates, opt_state = tx.update(grads, opt_state, params)

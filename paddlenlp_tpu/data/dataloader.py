@@ -54,6 +54,7 @@ class DistributedBatchSampler:
         self.num_shards = num_shards
         self.shard_id = shard_id
         self.shard_span = shard_span
+        self.filler_rows: List[int] = []  # local rows that are wrap-pad duplicates
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -79,11 +80,16 @@ class DistributedBatchSampler:
         local = self.batch_size // self.num_shards
         for i in range(0, end, self.batch_size):
             batch = order[i : i + self.batch_size]
+            self.filler_rows = []
             if len(batch) < self.batch_size and self.num_shards > 1:
                 # pad the final partial batch by wrapping so every shard slices
-                # a consistent full-size batch (duplicates, not drops)
-                pad = np.resize(order, self.batch_size - len(batch))
+                # a consistent full-size batch (duplicates, not drops); record
+                # which LOCAL rows are filler so the loader can mask their labels
+                n_real = len(batch)
+                pad = np.resize(order, self.batch_size - n_real)
                 batch = np.concatenate([batch, pad])
+                lo, hi = self.shard_id * local, (self.shard_id + self.shard_span) * local
+                self.filler_rows = [j - lo for j in range(max(n_real, lo), hi)]
             if self.num_shards > 1:
                 batch = batch[self.shard_id * local : (self.shard_id + self.shard_span) * local]
             yield batch.tolist()
@@ -132,7 +138,15 @@ class DataLoader:
     def __iter__(self):
         if self.batch_sampler is not None:
             for idx_batch in self.batch_sampler:
-                yield self.collate_fn([self.dataset[i] for i in idx_batch])
+                batch = self.collate_fn([self.dataset[i] for i in idx_batch])
+                filler = getattr(self.batch_sampler, "filler_rows", [])
+                if filler and isinstance(batch, dict) and "labels" in batch:
+                    # wrap-padded duplicate rows must not count toward eval
+                    # loss/perplexity — mask them like single-host filler
+                    labels = np.array(batch["labels"], copy=True)
+                    labels[filler] = -100
+                    batch["labels"] = labels
+                yield batch
         else:
             buf = []
             for sample in self.dataset:

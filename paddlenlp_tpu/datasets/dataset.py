@@ -86,13 +86,37 @@ class _LazyMapDataset(MapDataset):
         self.fn = fn
 
     def __len__(self):
-        return len(self.base)
+        return len(self.data) if "data" in self.__dict__ else len(self.base)
 
     def __getitem__(self, idx):
+        if "data" in self.__dict__:
+            return self.data[idx]
         return self.fn(self.base[idx])
 
     def __iter__(self):
+        if "data" in self.__dict__:
+            return iter(self.data)
         return (self.fn(x) for x in self.base)
+
+    def _materialize(self) -> None:
+        """Eager transforms chained after a lazy map (filter/shuffle/eager map)
+        operate on self.data — realize it once, then behave like MapDataset."""
+        if "data" not in self.__dict__:
+            self.data = [self.fn(x) for x in self.base]
+
+    def map(self, fn: Callable, lazy: bool = False) -> "MapDataset":
+        if lazy:
+            return _LazyMapDataset(self, fn)
+        self._materialize()
+        return MapDataset.map(self, fn)
+
+    def filter(self, fn: Callable) -> "MapDataset":
+        self._materialize()
+        return MapDataset.filter(self, fn)
+
+    def shuffle(self, seed: int = 0) -> "MapDataset":
+        self._materialize()
+        return MapDataset.shuffle(self, seed)
 
 
 class IterDataset:

@@ -5,7 +5,9 @@ Counterpart of the reference's block-attention machinery: the CUDA block pool in
 allocator ``csrc/gpu/step.cu`` (op ``step_paddle`` :316 — free/dispatch blocks,
 preempt + recover). TPU-native split:
 
-- device side: ONE pool tensor ``[L, 2, num_blocks, block_size, n_kv, H]``;
+- device side: ONE pool tensor ``[L, 2, num_blocks, n_kv, block_size, H]``
+  (kv-head-major so a Pallas BlockSpec can DMA one head's ``[block_size, H]``
+  tile — the last two dims must be TPU-tileable);
   prefill/decode scatter new K/V into table-addressed slots
   (``lax`` scatter via ``.at[]``) and attention gathers whole block rows — static
   shapes, jit-compiled once;
@@ -29,7 +31,7 @@ __all__ = ["PagedKVPool", "BlockManager", "init_paged_pool", "write_kv_block", "
 
 @dataclasses.dataclass
 class PagedKVPool:
-    """Device-side pool: kv [L, 2, num_blocks, block_size, n_kv, head_dim]."""
+    """Device-side pool: kv [L, 2, num_blocks, n_kv, block_size, head_dim]."""
 
     kv: jnp.ndarray
 
@@ -39,7 +41,7 @@ class PagedKVPool:
 
     @property
     def block_size(self) -> int:
-        return self.kv.shape[3]
+        return self.kv.shape[4]
 
 
 jax.tree_util.register_dataclass(PagedKVPool, data_fields=["kv"], meta_fields=[])
@@ -48,7 +50,7 @@ jax.tree_util.register_dataclass(PagedKVPool, data_fields=["kv"], meta_fields=[]
 def init_paged_pool(config, num_blocks: int, block_size: int = 16, dtype=jnp.bfloat16) -> PagedKVPool:
     n_kv = getattr(config, "num_key_value_heads", config.num_attention_heads)
     head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
-    shape = (config.num_hidden_layers, 2, num_blocks, block_size, n_kv, head_dim)
+    shape = (config.num_hidden_layers, 2, num_blocks, n_kv, block_size, head_dim)
     return PagedKVPool(kv=jnp.zeros(shape, dtype=dtype))
 
 
@@ -56,31 +58,35 @@ def write_kv_block(pool_layer: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    block_table: jnp.ndarray, start_pos) -> jnp.ndarray:
     """Scatter new tokens' K/V into the pool (one layer).
 
-    pool_layer [2, num_blocks, bs, K, H]; k/v [T, K, H] for ONE sequence;
+    pool_layer [2, num_blocks, K, bs, H]; k/v [T, K, H] for ONE sequence;
     block_table [max_blocks]; start_pos scalar — token i lands at logical position
     start_pos+i -> (block_table[(start_pos+i)//bs], (start_pos+i)%bs).
     """
     T = k.shape[0]
-    bs = pool_layer.shape[2]
+    bs = pool_layer.shape[3]
     pos = start_pos + jnp.arange(T)
     blocks = block_table[pos // bs]
     offs = pos % bs
-    pool_layer = pool_layer.at[0, blocks, offs].set(k.astype(pool_layer.dtype))
-    pool_layer = pool_layer.at[1, blocks, offs].set(v.astype(pool_layer.dtype))
+    # advanced indices (blocks, offs) split by the kv-head slice: result rows
+    # are [T, K, H], matching k/v
+    pool_layer = pool_layer.at[0, blocks, :, offs].set(k.astype(pool_layer.dtype))
+    pool_layer = pool_layer.at[1, blocks, :, offs].set(v.astype(pool_layer.dtype))
     return pool_layer
 
 
 def gather_kv(pool_layer: jnp.ndarray, block_tables: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather per-sequence K/V views (one layer).
 
-    pool_layer [2, num_blocks, bs, K, H]; block_tables [B, max_blocks] ->
+    pool_layer [2, num_blocks, K, bs, H]; block_tables [B, max_blocks] ->
     (k, v) each [B, max_blocks*bs, K, H]. Out-of-range table entries must point at
     a zeroed sentinel block; masking by context length happens in attention.
     """
-    k = pool_layer[0][block_tables]  # [B, max_blocks, bs, K, H]
+    k = pool_layer[0][block_tables]  # [B, max_blocks, K, bs, H]
     v = pool_layer[1][block_tables]
-    B, M, bs, K, H = k.shape
-    return k.reshape(B, M * bs, K, H), v.reshape(B, M * bs, K, H)
+    B, M, K, bs, H = k.shape
+    k = k.transpose(0, 1, 3, 2, 4).reshape(B, M * bs, K, H)
+    v = v.transpose(0, 1, 3, 2, 4).reshape(B, M * bs, K, H)
+    return k, v
 
 
 class BlockManager:

@@ -399,22 +399,22 @@ class GenerationMixin:
                         jnp.concatenate(new_scores, 1), jnp.concatenate(new_fin, 1),
                         jnp.concatenate(new_len, 1))
 
-            L_layers = config.num_hidden_layers
+            def _flat_idx(beam_idx):
+                return (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
 
-            def reorder(tree_or_buf, beam_idx):
-                """Gather beam rows by per-batch choice. ids_buf carries batch on
-                dim 0 ([B*K, L]); KVCache leaves on dim 1 ([layers, B*K, ...])."""
-                flat_idx = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+            def reorder(buf, beam_idx):
+                """Gather beam rows of ids_buf ([B*K, L], batch on dim 0)."""
+                return buf[_flat_idx(beam_idx)]
 
-                def one(x):
-                    nd = getattr(x, "ndim", 0)
-                    if nd >= 2 and x.shape[0] == L_layers and x.shape[1] == BK:
-                        return x[:, flat_idx]
-                    if nd >= 1 and x.shape[0] == BK:
-                        return x[flat_idx]
-                    return x
+            def reorder_kv(kv, beam_idx):
+                """Gather KVCache beams BY FIELD — keys/values carry batch on
+                axis 1 ([layers, B*K, ...]); offset is a scalar. Explicit fields
+                instead of shape sniffing: a leaf whose dims coincide with
+                (num_layers, B*K) must not be mis-gathered."""
+                from ..transformers.cache_utils import KVCache
 
-                return jax.tree.map(one, tree_or_buf)
+                idx = _flat_idx(beam_idx)
+                return KVCache(keys=kv.keys[:, idx], values=kv.values[:, idx], offset=kv.offset)
 
             def apply_step(state, logits):
                 ids_buf, kv, cur_len, scores, finished, lengths = state
@@ -422,7 +422,7 @@ class GenerationMixin:
                     logits, scores, finished, lengths, cur_len, ids_buf
                 )
                 ids_buf = reorder(ids_buf, beam_idx)
-                kv = reorder(kv, beam_idx)
+                kv = reorder_kv(kv, beam_idx)
                 ids_buf = jax.lax.dynamic_update_slice(ids_buf, tok.reshape(BK, 1), (0, cur_len))
                 return ids_buf, kv, cur_len + 1, scores, finished, lengths
 

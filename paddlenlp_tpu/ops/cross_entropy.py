@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["cross_entropy_with_ignore", "causal_lm_loss"]
+__all__ = ["cross_entropy_with_ignore", "causal_lm_loss", "fused_linear_cross_entropy"]
 
 IGNORE_INDEX = -100
 
@@ -35,6 +35,52 @@ def cross_entropy_with_ignore(
     n_valid = valid.sum()
     loss = token_loss.sum() / jnp.maximum(n_valid, 1)
     return loss, n_valid
+
+
+def fused_linear_cross_entropy(
+    hidden: jnp.ndarray,  # [B, T, H] last hidden states (bf16 fine)
+    weight: jnp.ndarray,  # [H, V] lm_head kernel (or embed.T when tied)
+    labels: jnp.ndarray,  # [B, T] targets aligned with hidden
+    ignore_index: int = IGNORE_INDEX,
+    chunk: int = 512,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Token-mean CE of ``lm_head(hidden)`` without materializing [B, T, V] logits.
+
+    The reference's memory answer to the head is fused parallel CE
+    (llama/modeling.py:1777 + tensor_parallel_utils.py); on TPU the [B,T,V]
+    fp32 logits + softmax temporaries are the HBM cliff (≈2 GB per copy at
+    B8/T2k/V32k), so we scan over token chunks and checkpoint each chunk:
+    forward AND backward peak at [B, chunk, V], and the head matmul still runs
+    chunk-batched on the MXU. Returns (loss, n_valid).
+    """
+    B, T, H = hidden.shape
+    pad = (-T) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=ignore_index)
+    nc = (T + pad) // chunk
+    hs = hidden.reshape(B, nc, chunk, H).swapaxes(0, 1)  # [nc, B, chunk, H]
+    ls = labels.reshape(B, nc, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_loss(h, l):
+        logits = (h @ weight.astype(h.dtype)).astype(jnp.float32)
+        valid = l != ignore_index
+        safe = jnp.where(valid, l, 0)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        token_loss = jnp.where(valid, lse - picked, 0.0)
+        return token_loss.sum(), valid.sum()
+
+    def body(carry, xs):
+        s, n = carry
+        ds, dn = chunk_loss(*xs)
+        return (s + ds, n + dn), None
+
+    (total, n_valid), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (hs, ls)
+    )
+    return total / jnp.maximum(n_valid, 1), n_valid
 
 
 def causal_lm_loss(

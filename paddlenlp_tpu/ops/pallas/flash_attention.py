@@ -39,7 +39,11 @@ NEG_INF = -1e30
 
 
 def _visible(s_shape, q_start, k_start, causal, window, q_len, kv_len, seg_q, seg_k):
-    """Element-level visibility mask for one [block_q, block_kv] tile."""
+    """Element-level visibility mask for one [block_q, block_kv] tile.
+
+    ``seg_q`` is [block_q, 1] and ``seg_k`` is [1, block_kv] (the trailing/leading
+    unit dims come from the TPU-tileable [B, T, 1] / [B, 1, S] segment layouts).
+    """
     rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
     valid = (cols < kv_len) & (rows < q_len)
@@ -48,7 +52,7 @@ def _visible(s_shape, q_start, k_start, causal, window, q_len, kv_len, seg_q, se
     if window is not None:
         valid &= cols > rows - window
     if seg_q is not None:
-        valid &= seg_q[:, None] == seg_k[None, :]
+        valid &= seg_q == seg_k
     return valid
 
 
@@ -111,7 +115,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_scratch[...], 1e-37)
         o_ref[0] = (acc_scratch[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scratch[...] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_scratch[...] + jnp.log(l)  # [block_q, 1]
 
 
 def _fold(x):  # [B, T, N, H] -> [B*N, T, H]
@@ -133,6 +137,10 @@ def _flash_fwd(q, k, v, segments, scale, causal, window, block_q, block_kv, inte
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     use_seg = segments is not None
     seg = segments if use_seg else jnp.zeros((B, T), jnp.int32)
+    # TPU tiling requires the last two block dims divisible by (8, 128) or equal
+    # to the array dims — per-row 1D data rides a trailing/middle unit dim.
+    seg_q3 = seg[:, :, None]  # [B, T, 1] -> block (1, block_q, 1)
+    seg_k3 = seg[:, None, :]  # [B, 1, S] -> block (1, 1, block_kv)
     block_q = min(block_q, T)
     block_kv = min(block_kv, S)
     grid = (B * N, pl.cdiv(T, block_q), pl.cdiv(S, block_kv))
@@ -148,16 +156,16 @@ def _flash_fwd(q, k, v, segments, scale, causal, window, block_q, block_kv, inte
             pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
             pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g=group: (bn // g, ki, 0)),
             pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g=group: (bn // g, ki, 0)),
-            pl.BlockSpec((1, block_q), lambda bn, qi, ki, n=N: (bn // n, qi)),
-            pl.BlockSpec((1, block_kv), lambda bn, qi, ki, n=N: (bn // n, ki)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, qi, ki, n=N: (bn // n, qi, 0)),
+            pl.BlockSpec((1, 1, block_kv), lambda bn, qi, ki, n=N: (bn // n, 0, ki)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bn, qi, ki: (bn, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, qi, ki: (bn, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
-            jax.ShapeDtypeStruct((B * N, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * N, T, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # m
@@ -166,8 +174,8 @@ def _flash_fwd(q, k, v, segments, scale, causal, window, block_q, block_kv, inte
         ],
         compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qf, kf, vf, seg, seg)
-    return out.reshape(B, N, T, H).transpose(0, 2, 1, 3), lse
+    )(qf, kf, vf, seg_q3, seg_k3)
+    return out.reshape(B, N, T, H).transpose(0, 2, 1, 3), lse[..., 0]
 
 
 # ---------------------------------------------------------------- backward
@@ -192,11 +200,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_r
         k = _zero_oob(k_ref[0].astype(jnp.float32), k_start, kv_len)
         v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
         do = _zero_oob(do_ref[0].astype(jnp.float32), q_start, q_len)
-        lse = lse_ref[0][:, None]
+        lse = lse_ref[0]  # [block_q, 1]
         # delta rows past q_len are Pallas edge-block garbage; p=0 there cannot
         # save ds (0 * NaN = NaN), and dkv's column reduction would spread it
-        row_idx = q_start + jax.lax.iota(jnp.int32, block_q)
-        delta = jnp.where(row_idx < q_len, delta_ref[0], 0.0)[:, None]
+        row_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        delta = jnp.where(row_idx < q_len, delta_ref[0], 0.0)  # [block_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         seg_q = sq_ref[0] if use_segments else None
         seg_k = sk_ref[0] if use_segments else None
@@ -233,11 +241,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_
         k = _zero_oob(k_ref[0].astype(jnp.float32), k_start, kv_len)
         v = _zero_oob(v_ref[0].astype(jnp.float32), k_start, kv_len)
         do = _zero_oob(do_ref[0].astype(jnp.float32), q_start, q_len)
-        lse = lse_ref[0][:, None]
+        lse = lse_ref[0]  # [block_q, 1]
         # delta rows past q_len are Pallas edge-block garbage; p=0 there cannot
         # save ds (0 * NaN = NaN), and dkv's column reduction would spread it
-        row_idx = q_start + jax.lax.iota(jnp.int32, block_q)
-        delta = jnp.where(row_idx < q_len, delta_ref[0], 0.0)[:, None]
+        row_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        delta = jnp.where(row_idx < q_len, delta_ref[0], 0.0)  # [block_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         seg_q = sq_ref[0] if use_segments else None
         seg_k = sk_ref[0] if use_segments else None
@@ -260,9 +268,13 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
     group = N // K
     qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(g)
     of = _fold(out)
-    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)  # [B*N, T]
+    # [B*N, T, 1]: trailing unit dim keeps the block TPU-tileable (see _flash_fwd)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1, keepdims=True)
+    lse3 = lse[..., None]
     use_seg = segments is not None
     seg = segments if use_seg else jnp.zeros((B, T), jnp.int32)
+    seg_q3 = seg[:, :, None]  # [B, T, 1]
+    seg_k3 = seg[:, None, :]  # [B, 1, S]
     block_q = min(block_q, T)
     block_kv = min(block_kv, S)
     n_q, n_k = pl.cdiv(T, block_q), pl.cdiv(S, block_kv)
@@ -279,17 +291,17 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
             pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g_=group: (bn // g_, ki, 0)),
             pl.BlockSpec((1, block_kv, H), lambda bn, qi, ki, g_=group: (bn // g_, ki, 0)),
             pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bn, qi, ki: (bn, qi)),
-            pl.BlockSpec((1, block_q), lambda bn, qi, ki: (bn, qi)),
-            pl.BlockSpec((1, block_q), lambda bn, qi, ki, n=N: (bn // n, qi)),
-            pl.BlockSpec((1, block_kv), lambda bn, qi, ki, n=N: (bn // n, ki)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, qi, ki: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, qi, ki: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, qi, ki, n=N: (bn // n, qi, 0)),
+            pl.BlockSpec((1, 1, block_kv), lambda bn, qi, ki, n=N: (bn // n, 0, ki)),
         ],
         out_specs=pl.BlockSpec((1, block_q, H), lambda bn, qi, ki: (bn, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, H), jnp.float32)],
         compiler_params=params,
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta, seg, seg)
+    )(qf, kf, vf, dof, lse3, delta, seg_q3, seg_k3)
 
     dk_p, dv_p = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -299,10 +311,10 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
             pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi, g_=group: (bn // g_, ki, 0)),
             pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi, g_=group: (bn // g_, ki, 0)),
             pl.BlockSpec((1, block_q, H), lambda bn, ki, qi: (bn, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bn, ki, qi: (bn, qi)),
-            pl.BlockSpec((1, block_q), lambda bn, ki, qi: (bn, qi)),
-            pl.BlockSpec((1, block_q), lambda bn, ki, qi, n=N: (bn // n, qi)),
-            pl.BlockSpec((1, block_kv), lambda bn, ki, qi, n=N: (bn // n, ki)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, ki, qi: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, ki, qi: (bn, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bn, ki, qi, n=N: (bn // n, qi, 0)),
+            pl.BlockSpec((1, 1, block_kv), lambda bn, ki, qi, n=N: (bn // n, 0, ki)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi: (bn, ki, 0)),
@@ -318,7 +330,7 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
         ],
         compiler_params=params,
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta, seg, seg)
+    )(qf, kf, vf, dof, lse3, delta, seg_q3, seg_k3)
 
     dq = dq.reshape(B, N, T, H).transpose(0, 2, 1, 3)
     # per-query-head dk/dv -> group-sum onto the K kv heads

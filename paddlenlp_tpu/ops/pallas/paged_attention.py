@@ -53,8 +53,8 @@ def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * bs <= ctx)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # [group, H]
-        k = k_ref[0, :, 0].astype(jnp.float32)  # [bs, H]
-        v = v_ref[0, :, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)  # [bs, H]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [group, bs]
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         valid = pos <= ctx
@@ -74,7 +74,7 @@ def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, N, H] one query token per sequence
-    pool_k: jnp.ndarray,  # [num_blocks, bs, K, H]
+    pool_k: jnp.ndarray,  # [num_blocks, K, bs, H] (kv-head-major: TPU-tileable DMA)
     pool_v: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
     context_lens: jnp.ndarray,  # [B] int32 (position of the current token)
@@ -82,7 +82,7 @@ def paged_decode_attention(
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     B, N, H = q.shape
-    nb, bs, K, _ = pool_k.shape
+    nb, K, bs, _ = pool_k.shape
     group = N // K
     max_blocks = block_tables.shape[1]
     scale = scale if scale is not None else H**-0.5
@@ -95,8 +95,8 @@ def paged_decode_attention(
         grid=(B, K, max_blocks),
         in_specs=[
             pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
-            pl.BlockSpec((1, bs, 1, H), lambda b, kh, j, t, c: (t[b, j], 0, kh, 0)),
-            pl.BlockSpec((1, bs, 1, H), lambda b, kh, j, t, c: (t[b, j], 0, kh, 0)),
+            pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0)),
+            pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
         scratch_shapes=[

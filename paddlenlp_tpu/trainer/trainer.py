@@ -365,12 +365,25 @@ class Trainer:
         D = self.args.dataset_world_size
         if jax.process_count() <= 1:
             return 1, 0, 1
-        W = jax.device_count()
-        C = jax.local_device_count()
-        rep = max(W // D, 1)  # devices per data-shard group
+        # Derive ownership from the ACTUAL batch sharding: mesh_utils may permute
+        # devices for ICI topology, so index arithmetic over process-contiguous
+        # devices would mis-assign rows. devices_indices_map on a [D]-aval tells
+        # us exactly which row groups this process's devices hold.
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(("dp", "fsdp")))
+        imap = sharding.devices_indices_map((D,))
         p = jax.process_index()
-        g0 = (p * C) // rep
-        g1 = (p * C + C - 1) // rep
+        groups = sorted(
+            {(idx[0].start or 0) for dev, idx in imap.items() if dev.process_index == p}
+        )
+        g0, g1 = groups[0], groups[-1]
+        if groups != list(range(g0, g1 + 1)):
+            raise RuntimeError(
+                f"process {p} owns non-contiguous data-shard groups {groups} under the "
+                "mesh's device permutation; contiguous per-process batch rows cannot be "
+                "assembled — reorder the mesh axes or use a replicated dataloader"
+            )
         return D, g0, g1 - g0 + 1
 
     def get_train_dataloader(self):

@@ -24,6 +24,7 @@ from .ernie import (  # noqa: F401
     ErnieForTokenClassification,
     ErnieModel,
 )
+from .deepseek_v2 import DeepseekV2Config, DeepseekV2ForCausalLM, DeepseekV2Model  # noqa: F401
 from .gemma import GemmaConfig, GemmaForCausalLM, GemmaModel  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import (  # noqa: F401

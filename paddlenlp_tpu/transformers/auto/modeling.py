@@ -81,6 +81,10 @@ def _populate_models():
     register_model("ernie", "token_classification", ernie.ErnieForTokenClassification)
     register_model("mixtral", "causal_lm", mixtral.MixtralForCausalLM)
     register_model("qwen2_moe", "causal_lm", qwen2_moe.Qwen2MoeForCausalLM)
+    from ..deepseek_v2 import modeling as deepseek_v2
+
+    register_model("deepseek_v2", "base", deepseek_v2.DeepseekV2Model)
+    register_model("deepseek_v2", "causal_lm", deepseek_v2.DeepseekV2ForCausalLM)
     from ..t5 import modeling as t5
 
     register_model("t5", "base", t5.T5Model)

@@ -270,8 +270,14 @@ class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
-    mlp_cls = LlamaMLP  # class attr, not a dataclass field (subclass-overridable)
+    mlp_cls = LlamaMLP  # class attrs, not dataclass fields (subclass-overridable)
     mlp_name = "mlp"
+    attn_cls = LlamaAttention
+
+    def _mlp_module(self):
+        """Build this layer's MLP; deepseek-style archs override to pick dense
+        vs MoE per layer index (first_k_dense_replace / moe_layer_freq)."""
+        return type(self).mlp_cls(self.config, self.dtype, self.param_dtype, name=type(self).mlp_name)
 
     @nn.compact
     def __call__(
@@ -289,7 +295,7 @@ class LlamaDecoderLayer(nn.Module):
         residual = hidden_states
         h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, unit_offset=unit_offset,
                          name="input_layernorm")(hidden_states)
-        attn_out, new_kv = LlamaAttention(cfg, self.dtype, self.param_dtype, name="self_attn")(
+        attn_out, new_kv = type(self).attn_cls(cfg, self.dtype, self.param_dtype, name="self_attn")(
             h, attention_mask, position_ids, segment_ids, layer_kv, offset, deterministic
         )
         h = residual + attn_out
@@ -297,7 +303,7 @@ class LlamaDecoderLayer(nn.Module):
         residual = h
         h2 = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, unit_offset=unit_offset,
                           name="post_attention_layernorm")(h)
-        h2 = type(self).mlp_cls(cfg, self.dtype, self.param_dtype, name=type(self).mlp_name)(h2)
+        h2 = self._mlp_module()(h2)
         if isinstance(h2, tuple):  # MoE MLPs return (out, aux_loss)
             h2, layer_aux = h2
             aux = aux + layer_aux

@@ -210,6 +210,43 @@ class TestLoadDataset:
         lazy = ds.map(lambda r: {"x": r["x"] + 1}, lazy=True)
         assert lazy[0]["x"] == ds[0]["x"] + 1
 
+    def test_lazy_map_chains_eager_transforms(self):
+        from paddlenlp_tpu.datasets import MapDataset
+
+        base = MapDataset([{"x": i} for i in range(6)])
+        lazy = base.map(lambda r: {"x": r["x"] * 2}, lazy=True)
+        got = lazy.filter(lambda r: r["x"] >= 4)
+        assert sorted(r["x"] for r in got) == [4, 6, 8, 10]
+        shuffled = base.map(lambda r: {"x": r["x"]}, lazy=True).shuffle(seed=3)
+        assert sorted(r["x"] for r in shuffled) == list(range(6))
+        double_lazy = base.map(lambda r: {"x": r["x"] + 1}, lazy=True).map(
+            lambda r: {"x": r["x"] * 10}, lazy=True
+        )
+        assert double_lazy[1]["x"] == (1 + 1) * 10
+        eager_after = base.map(lambda r: {"x": r["x"]}, lazy=True).map(lambda r: {"x": -r["x"]})
+        assert [r["x"] for r in eager_after] == [0, -1, -2, -3, -4, -5]
+
+    def test_multihost_sampler_marks_filler_rows(self):
+        import numpy as np
+
+        from paddlenlp_tpu.data.dataloader import DataLoader
+
+        ds = [{"labels": np.full((4,), i, np.int64)} for i in range(10)]
+        # 10 rows, global batch 8, 2 shards: batch 2 is partial (2 real rows)
+        loaders = [
+            DataLoader(ds, batch_size=8, shuffle=False, drop_last=False,
+                       num_shards=2, shard_id=s, shard_span=1)
+            for s in (0, 1)
+        ]
+        b0 = list(loaders[0])
+        b1 = list(loaders[1])
+        assert len(b0) == len(b1) == 2
+        # final batch: global rows 8..9 real, 10..15 wrap-filler
+        # shard 0 holds rows 8,9,(10,11 filler); shard 1 all filler
+        assert (b0[1]["labels"][:2] >= 0).all()
+        assert (b0[1]["labels"][2:] == -100).all()
+        assert (b1[1]["labels"] == -100).all()
+
     def test_registry_builder(self):
         from paddlenlp_tpu.datasets import load_dataset, register_dataset
 

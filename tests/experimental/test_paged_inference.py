@@ -155,14 +155,17 @@ class TestPagedKernel:
         rng = np.random.default_rng(1)
         B, N, K, H, nb, bs, mb = 2, 4, 2, 64, 12, 8, 4
         q = jnp.asarray(rng.standard_normal((B, N, H)), jnp.float32)
-        pk = jnp.asarray(rng.standard_normal((nb, bs, K, H)), jnp.float32)
-        pv = jnp.asarray(rng.standard_normal((nb, bs, K, H)), jnp.float32)
+        pk = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        pv = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
         tables = jnp.asarray(rng.permutation(np.arange(1, nb))[: B * mb].reshape(B, mb), jnp.int32)
         ctx = jnp.asarray([7, 22], jnp.int32)
         out = paged_decode_attention(q, pk, pv, tables, ctx, interpret=True)
 
-        k_all = jnp.repeat(pk[tables].reshape(B, mb * bs, K, H), N // K, axis=2)
-        v_all = jnp.repeat(pv[tables].reshape(B, mb * bs, K, H), N // K, axis=2)
+        def flat(pool):  # [nb,K,bs,H] gathered -> [B, mb*bs, K, H]
+            return pool[tables].transpose(0, 1, 3, 2, 4).reshape(B, mb * bs, K, H)
+
+        k_all = jnp.repeat(flat(pk), N // K, axis=2)
+        v_all = jnp.repeat(flat(pv), N // K, axis=2)
         s = jnp.einsum("bnh,bsnh->bns", q, k_all) * H**-0.5
         mask = jnp.arange(mb * bs)[None, :] <= ctx[:, None]
         ref = jnp.einsum("bns,bsnh->bnh",

@@ -108,3 +108,40 @@ class TestQWenCAttn:
         d = _write_ckpt(tmp_path, model.config, tensors)
         loaded = QWenForCausalLM.from_pretrained(d)
         np.testing.assert_allclose(np.asarray(ref), np.asarray(loaded(input_ids=ids).logits), atol=1e-5)
+
+
+class TestDeepseekV2HFLayout:
+    def test_hf_expert_and_mla_keys_load(self, tmp_path):
+        """A TRUE HF-layout deepseek_v2 checkpoint (per-expert mlp.experts.{e}.*
+        keys, MLA q_a/q_b/kv_a/kv_b projections, torch [out,in] kernels) must
+        load and reproduce the originating logits."""
+        from paddlenlp_tpu.transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+        cfg = DeepseekV2Config(
+            intermediate_size=112, moe_intermediate_size=48,
+            q_lora_rank=24, kv_lora_rank=16, qk_rope_head_dim=8, qk_nope_head_dim=8,
+            v_head_dim=16, n_routed_experts=4, n_shared_experts=1, num_experts_per_tok=2,
+            first_k_dense_replace=1, **TINY)
+        model = DeepseekV2ForCausalLM.from_config(cfg, seed=0)
+        # perturb so same-seed re-init cannot silently pass
+        model.params = jax.tree.map(lambda x: x * 1.25, model.params)
+        ids = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        ref = model(input_ids=ids).logits
+        flat = {k: np.asarray(v) for k, v in flatten_params(model.params).items()}
+        tensors = {}
+        for path, arr in flat.items():
+            tail = path.rsplit("/", 1)[-1]
+            if "/mlp/" in path and "/shared_experts/" not in path and tail in (
+                "gate_proj", "up_proj", "down_proj") and arr.ndim == 3:
+                i = path.split("/layers_")[1].split("/")[0]
+                for e in range(arr.shape[0]):
+                    tensors[f"model.layers.{i}.mlp.experts.{e}.{tail}.weight"] = arr[e].T
+                continue
+            key = path.replace("/layers_", "/layers.").replace("/", ".")
+            key = key.replace(".kernel", ".weight").replace(".scale", ".weight") \
+                     .replace(".embedding", ".weight")
+            tensors[key] = arr.T if path.endswith("/kernel") else arr
+        d = _write_ckpt(tmp_path, cfg, tensors)
+        loaded = DeepseekV2ForCausalLM.from_pretrained(d)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(loaded(input_ids=ids).logits),
+                                   atol=1e-5)

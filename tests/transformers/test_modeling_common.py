@@ -11,6 +11,8 @@ import pytest
 from paddlenlp_tpu.parallel import MeshConfig, create_mesh, use_mesh
 from paddlenlp_tpu.transformers import (
     BaichuanConfig,
+    DeepseekV2Config,
+    DeepseekV2ForCausalLM,
     BaichuanForCausalLM,
     BertConfig,
     BloomConfig,
@@ -70,6 +72,17 @@ CAUSAL_CASES = {
                                                               num_key_value_heads=2, num_experts=4,
                                                               num_experts_per_tok=2, moe_intermediate_size=48,
                                                               shared_expert_intermediate_size=64, **TINY)),
+    # MLA: low-rank q/kv, rope on a 8-dim slice, dense layer 0 + grouped MoE after
+    "deepseek_v2": (DeepseekV2ForCausalLM, lambda: DeepseekV2Config(
+        vocab_size=96, intermediate_size=112, moe_intermediate_size=48,
+        q_lora_rank=24, kv_lora_rank=16, qk_rope_head_dim=8, qk_nope_head_dim=8, v_head_dim=16,
+        n_routed_experts=4, n_shared_experts=1, num_experts_per_tok=2,
+        topk_method="group_limited_greedy", n_group=2, topk_group=1,
+        first_k_dense_replace=1, routed_scaling_factor=1.0, norm_topk_prob=True,
+        rope_scaling={"type": "yarn", "factor": 2.0, "original_max_position_embeddings": 32,
+                      "mscale": 0.707, "mscale_all_dim": 0.707,
+                      "beta_fast": 32, "beta_slow": 1},
+        **TINY)),
 }
 
 ENCODER_CASES = {
