@@ -107,12 +107,17 @@ def run_bench(tiny: bool) -> None:
     from paddlenlp_tpu.ops.cross_entropy import fused_linear_cross_entropy
     from paddlenlp_tpu.transformers.llama.modeling import LlamaModule
 
+    def mark(msg):
+        print(f"[bench] {time.time():.0f} {msg}", file=sys.stderr, flush=True)
+
+    mark("init weights")
     model = LlamaForCausalLM(config, dtype=jnp.bfloat16, param_dtype=jnp.float32)
     params = model.init_weights(seed=0)
     n_params = model.num_parameters()
 
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
     opt_state = jax.jit(tx.init)(params)
+    mark(f"params ready n={n_params}")
 
     backbone = LlamaModule(config, dtype=jnp.bfloat16, param_dtype=jnp.float32)
 
@@ -136,14 +141,17 @@ def run_bench(tiny: bool) -> None:
     ids = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq_len + 1)), dtype=jnp.int32)
 
     # warmup / compile
+    mark("compiling train_step")
     params, opt_state, loss = train_step(params, opt_state, ids)
     jax.block_until_ready(loss)
+    mark("compiled; timing")
 
     t0 = time.time()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, ids)
     jax.block_until_ready(loss)
     dt = time.time() - t0
+    mark(f"done dt={dt:.2f}s")
 
     tokens = batch * seq_len * steps
     tok_per_sec = tokens / dt

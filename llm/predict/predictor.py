@@ -43,6 +43,10 @@ class PredictorArgument:
     block_size: int = 16
     num_kv_blocks: int = 1024
     max_blocks_per_seq: int = 128
+    cachekv_int8_type: Optional[str] = field(
+        default=None,
+        metadata={"help": "quantize the paged KV cache: 'dynamic' (int8) or 'fp8' "
+                          "(reference predictor.py:775-791 cachekv_int8 knob)"})
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -116,6 +120,7 @@ class BlockPredictor(BasePredictor):
             num_blocks=args.num_kv_blocks,
             max_blocks_per_seq=args.max_blocks_per_seq,
             dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+            kv_cache_quant=self._kv_quant(args.cachekv_int8_type),
         )
         self._sampling = SamplingParams(
             max_new_tokens=args.max_length,
@@ -124,6 +129,18 @@ class BlockPredictor(BasePredictor):
             top_k=args.top_k,
             temperature=args.temperature,
         )
+
+    @staticmethod
+    def _kv_quant(cachekv_int8_type):
+        if cachekv_int8_type is None:
+            return None
+        mapping = {"dynamic": "int8", "int8": "int8", "fp8": "fp8"}
+        if cachekv_int8_type not in mapping:
+            raise ValueError(
+                f"cachekv_int8_type={cachekv_int8_type!r} unsupported; pick from "
+                f"{sorted(mapping)} (the reference's 'static' calibrated scales are "
+                "not implemented — dynamic per-token scales quantize at write time)")
+        return mapping[cachekv_int8_type]
 
     def predict(self, texts: List[str]) -> List[str]:
         prompts = [self.tokenizer.encode(t)[-self.args.src_length:] for t in texts]

@@ -100,6 +100,7 @@ class InferenceEngine:
         eos_token_id: Optional[int] = None,
         dtype=jnp.float32,
         decode_steps: int = 8,
+        kv_cache_quant: Optional[str] = None,  # None | "int8" | "fp8" (cachekv_int8 knob)
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -110,7 +111,8 @@ class InferenceEngine:
             decode_steps=decode_steps, eos_ids=self.eos_ids,
         )
         self.pool = init_paged_pool(model.config, num_blocks, block_size,
-                                    dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32)
+                                    dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32,
+                                    quant=kv_cache_quant)
         self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq)
         self.max_batch_size = max_batch_size
         self.decode_steps = decode_steps

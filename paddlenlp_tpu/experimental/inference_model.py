@@ -141,9 +141,13 @@ class PagedInferenceModel:
         return out.astype(q.dtype)
 
     def _layer(self, carry, scanned, block_tables, q_positions, kv_len_mask, write_pos):
-        """One decoder layer inside lax.scan: scanned = (layer_params, pool_layer)."""
+        """One decoder layer inside lax.scan: scanned = (layer_params, pool_layer
+        [, scale_layer] for quantized caches)."""
         h = carry
-        lp, pool_layer = scanned
+        if len(scanned) == 3:
+            lp, pool_layer, scale_layer = scanned
+        else:
+            (lp, pool_layer), scale_layer = scanned, None
         cfg = self.config
         B, T, D = h.shape
 
@@ -162,25 +166,27 @@ class PagedInferenceModel:
         cos, sin = rope_tables(q_positions, self.inv_freq)
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
-        # scatter new K/V into the pool (vmapped over the batch)
-        def write_one(pool_l, k_i, v_i, table_i, start_i):
-            return write_kv_block(pool_l, k_i, v_i, table_i, start_i)
-
-        pool_layer = functools.reduce(
-            lambda pl, i: write_one(pl, k[i], v[i], block_tables[i], write_pos[i]),
-            range(B),
-            pool_layer,
-        )
+        # scatter new K/V into the pool (per sequence)
+        for i in range(B):
+            written = write_kv_block(pool_layer, k[i], v[i], block_tables[i],
+                                     write_pos[i], scale_layer)
+            if scale_layer is not None:
+                pool_layer, scale_layer = written
+            else:
+                pool_layer = written
         if T == 1 and self.use_paged_kernel:
             # fused block-table walk + attend: the Pallas decode kernel streams
             # addressed KV blocks instead of materializing the gathered cache
+            # (dequant rides in-kernel for int8/fp8 pools)
             from ..ops.pallas.paged_attention import paged_decode_attention
 
             attn_out = paged_decode_attention(
                 q[:, 0], pool_layer[0], pool_layer[1], block_tables, q_positions[:, 0],
+                k_scale=None if scale_layer is None else scale_layer[0],
+                v_scale=None if scale_layer is None else scale_layer[1],
             )[:, None]
         else:
-            k_all, v_all = gather_kv(pool_layer, block_tables)
+            k_all, v_all = gather_kv(pool_layer, block_tables, scale_layer)
             attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
         attn_out = attn_out.reshape(B, T, self.n_heads * self.head_dim)
         o = attn_out @ attn["o_proj"]["kernel"].astype(self.dtype)
@@ -193,10 +199,12 @@ class PagedInferenceModel:
         gate = x @ mlp["gate_proj"]["kernel"].astype(self.dtype)
         up = x @ mlp["up_proj"]["kernel"].astype(self.dtype)
         h = h + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(self.dtype)
+        if scale_layer is not None:
+            return h, (pool_layer, scale_layer)
         return h, pool_layer
 
-    def _forward(self, params, pool_kv, input_ids, block_tables, q_positions, kv_len_mask, write_pos, last_pos):
-        """input_ids [B,T]; returns (logits at last_pos [B,V], new pool kv [L,...])."""
+    def _forward(self, params, pool: PagedKVPool, input_ids, block_tables, q_positions, kv_len_mask, write_pos, last_pos):
+        """input_ids [B,T]; returns (logits at last_pos [B,V], new PagedKVPool)."""
         m = params["model"]
         embed = m["embed_tokens"]["embedding"]
         h = embed[input_ids].astype(self.dtype)
@@ -206,7 +214,12 @@ class PagedInferenceModel:
         def body(carry, scanned):
             return self._layer(carry, scanned, block_tables, q_positions, kv_len_mask, write_pos)
 
-        h, new_pool = jax.lax.scan(body, h, (m["layers"], pool_kv))
+        scanned = (m["layers"], pool.kv) if pool.scale is None else (m["layers"], pool.kv, pool.scale)
+        h, new_pool = jax.lax.scan(body, h, scanned)
+        if pool.scale is None:
+            new_pool = PagedKVPool(kv=new_pool)
+        else:
+            new_pool = PagedKVPool(kv=new_pool[0], scale=new_pool[1])
         h = _rms(h, m["norm"]["scale"], self.eps)
         last = h[jnp.arange(h.shape[0]), last_pos]
         if "lm_head" in params:
@@ -216,7 +229,7 @@ class PagedInferenceModel:
         return logits.astype(jnp.float32), new_pool
 
     # ------------------------------------------------------------------ entry points
-    def _prefill_impl(self, params, pool_kv, input_ids, block_tables, prompt_lens, samp):
+    def _prefill_impl(self, params, pool, input_ids, block_tables, prompt_lens, samp):
         """Batched prefill: [n, T_pad] sequences; samples the first token on device.
 
         Returns (tokens [n], counts [n, V] incl. prompt + sampled token, new pool).
@@ -226,7 +239,7 @@ class PagedInferenceModel:
         S = block_tables.shape[1] * self.block_size
         kv_len_mask = jnp.arange(S)[None, :] < prompt_lens[:, None]
         logits, new_pool = self._forward(
-            params, pool_kv, input_ids, block_tables, positions,
+            params, pool, input_ids, block_tables, positions,
             kv_len_mask, jnp.zeros((n,), jnp.int32),
             jnp.maximum(prompt_lens - 1, 0),  # last VALID token (input may be padded)
         )
@@ -237,7 +250,7 @@ class PagedInferenceModel:
         counts = counts + jax.nn.one_hot(tokens, V, dtype=jnp.int32)
         return tokens, counts, new_pool
 
-    def _decode_impl(self, params, pool_kv, tokens, block_tables, context_lens, done0,
+    def _decode_impl(self, params, pool, tokens, block_tables, context_lens, done0,
                      remaining, counts, samp):
         """Multi-step decode: advance every slot up to ``decode_steps`` tokens in ONE
         jit — the host round-trip carries ids and flags only (the reference's whole
@@ -252,10 +265,10 @@ class PagedInferenceModel:
         eos = self.eos_arr
 
         def one(carry, _):
-            pool_kv, tok, ctx, done, counts, n_out = carry
+            pool_c, tok, ctx, done, counts, n_out = carry
             kv_mask = jnp.arange(S)[None, :] <= ctx[:, None]
-            logits, pool_kv = self._forward(
-                params, pool_kv, tok[:, None], block_tables, ctx[:, None],
+            logits, pool_c = self._forward(
+                params, pool_c, tok[:, None], block_tables, ctx[:, None],
                 kv_mask, ctx, jnp.zeros((B,), jnp.int32),
             )
             nxt = sample_tokens(logits, positions=ctx + 1, counts=counts, **samp)
@@ -267,22 +280,20 @@ class PagedInferenceModel:
             ctx = jnp.where(done, ctx, ctx + 1)
             n_out = n_out + emit
             done = done | newly_done
-            return (pool_kv, nxt, ctx, done, counts, n_out), (nxt, emit)
+            return (pool_c, nxt, ctx, done, counts, n_out), (nxt, emit)
 
-        init = (pool_kv, tokens, context_lens, done0, counts,
+        init = (pool, tokens, context_lens, done0, counts,
                 jnp.zeros((B,), jnp.int32))
-        (pool_kv, _, ctx, done, counts, _), (toks, valid) = jax.lax.scan(
+        (pool, _, ctx, done, counts, _), (toks, valid) = jax.lax.scan(
             one, init, None, length=self.decode_steps
         )
-        return toks, valid, done, ctx, counts, pool_kv
+        return toks, valid, done, ctx, counts, pool
 
     def prefill(self, params, pool: PagedKVPool, input_ids, block_tables, prompt_lens, samp):
-        tokens, counts, kv = self._prefill(params, pool.kv, input_ids, block_tables, prompt_lens, samp)
-        return tokens, counts, PagedKVPool(kv=kv)
+        return self._prefill(params, pool, input_ids, block_tables, prompt_lens, samp)
 
     def decode(self, params, pool: PagedKVPool, tokens, block_tables, context_lens, done0,
                remaining, counts, samp):
-        toks, valid, done, ctx, counts, kv = self._decode(
-            params, pool.kv, tokens, block_tables, context_lens, done0, remaining, counts, samp
+        return self._decode(
+            params, pool, tokens, block_tables, context_lens, done0, remaining, counts, samp
         )
-        return toks, valid, done, ctx, counts, PagedKVPool(kv=kv)

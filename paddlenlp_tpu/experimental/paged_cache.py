@@ -31,9 +31,15 @@ __all__ = ["PagedKVPool", "BlockManager", "init_paged_pool", "write_kv_block", "
 
 @dataclasses.dataclass
 class PagedKVPool:
-    """Device-side pool: kv [L, 2, num_blocks, n_kv, block_size, head_dim]."""
+    """Device-side pool: kv [L, 2, num_blocks, n_kv, block_size, head_dim].
+
+    Quantized caches (the reference's c8/fp8 cache, ``csrc/gpu/append_attn/``
+    c8 impls + ``predictor.py:775-791`` cachekv_int8) store ``kv`` as int8 /
+    float8_e4m3 plus per-token-per-head ``scale`` [L, 2, nb, n_kv, bs, 1] —
+    dequant happens at the attention read (in-kernel for the Pallas path)."""
 
     kv: jnp.ndarray
+    scale: Optional[jnp.ndarray] = None
 
     @property
     def num_blocks(self) -> int:
@@ -43,47 +49,93 @@ class PagedKVPool:
     def block_size(self) -> int:
         return self.kv.shape[4]
 
+    @property
+    def quantized(self) -> bool:
+        return self.scale is not None
 
-jax.tree_util.register_dataclass(PagedKVPool, data_fields=["kv"], meta_fields=[])
+
+jax.tree_util.register_dataclass(PagedKVPool, data_fields=["kv", "scale"], meta_fields=[])
+
+_QMAX = {"int8": 127.0, "fp8": 448.0}  # float8_e4m3 max normal
 
 
-def init_paged_pool(config, num_blocks: int, block_size: int = 16, dtype=jnp.bfloat16) -> PagedKVPool:
+def init_paged_pool(config, num_blocks: int, block_size: int = 16, dtype=jnp.bfloat16,
+                    quant: Optional[str] = None) -> PagedKVPool:
     n_kv = getattr(config, "num_key_value_heads", config.num_attention_heads)
     head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
     shape = (config.num_hidden_layers, 2, num_blocks, n_kv, block_size, head_dim)
-    return PagedKVPool(kv=jnp.zeros(shape, dtype=dtype))
+    if quant is None:
+        return PagedKVPool(kv=jnp.zeros(shape, dtype=dtype))
+    if quant not in _QMAX:
+        raise ValueError(f"kv cache quant must be int8/fp8, got {quant!r}")
+    qdtype = jnp.int8 if quant == "int8" else jnp.float8_e4m3fn
+    return PagedKVPool(
+        kv=jnp.zeros(shape, dtype=qdtype),
+        scale=jnp.zeros(shape[:-1] + (1,), dtype=jnp.float32),
+    )
+
+
+def quantize_kv(x: jnp.ndarray, qdtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token-per-head symmetric quant over the head dim.
+
+    x [..., H] -> (q [..., H] in qdtype, scale [..., 1] fp32)."""
+    qmax = _QMAX["int8" if qdtype == jnp.int8 else "fp8"]
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-6) / qmax
+    q = x.astype(jnp.float32) / scale
+    if qdtype == jnp.int8:
+        q = jnp.clip(jnp.round(q), -127, 127)
+    return q.astype(qdtype), scale
 
 
 def write_kv_block(pool_layer: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   block_table: jnp.ndarray, start_pos) -> jnp.ndarray:
+                   block_table: jnp.ndarray, start_pos,
+                   scale_layer: Optional[jnp.ndarray] = None):
     """Scatter new tokens' K/V into the pool (one layer).
 
     pool_layer [2, num_blocks, K, bs, H]; k/v [T, K, H] for ONE sequence;
     block_table [max_blocks]; start_pos scalar — token i lands at logical position
     start_pos+i -> (block_table[(start_pos+i)//bs], (start_pos+i)%bs).
-    """
+    With ``scale_layer`` [2, num_blocks, K, bs, 1] the pool is quantized: K/V are
+    range-compressed per token+head on write. Returns pool_layer or
+    (pool_layer, scale_layer)."""
     T = k.shape[0]
     bs = pool_layer.shape[3]
     pos = start_pos + jnp.arange(T)
     blocks = block_table[pos // bs]
     offs = pos % bs
+    if scale_layer is not None:
+        k, ks = quantize_kv(k, pool_layer.dtype)
+        v, vs = quantize_kv(v, pool_layer.dtype)
+        scale_layer = scale_layer.at[0, blocks, :, offs].set(ks)
+        scale_layer = scale_layer.at[1, blocks, :, offs].set(vs)
     # advanced indices (blocks, offs) split by the kv-head slice: result rows
     # are [T, K, H], matching k/v
     pool_layer = pool_layer.at[0, blocks, :, offs].set(k.astype(pool_layer.dtype))
     pool_layer = pool_layer.at[1, blocks, :, offs].set(v.astype(pool_layer.dtype))
+    if scale_layer is not None:
+        return pool_layer, scale_layer
     return pool_layer
 
 
-def gather_kv(pool_layer: jnp.ndarray, block_tables: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def gather_kv(pool_layer: jnp.ndarray, block_tables: jnp.ndarray,
+              scale_layer: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Gather per-sequence K/V views (one layer).
 
     pool_layer [2, num_blocks, K, bs, H]; block_tables [B, max_blocks] ->
     (k, v) each [B, max_blocks*bs, K, H]. Out-of-range table entries must point at
     a zeroed sentinel block; masking by context length happens in attention.
-    """
+    Quantized pools dequantize on the gathered (per-sequence) view."""
     k = pool_layer[0][block_tables]  # [B, max_blocks, K, bs, H]
     v = pool_layer[1][block_tables]
     B, M, K, bs, H = k.shape
+    if scale_layer is not None:
+        ks = scale_layer[0][block_tables]  # [B, M, K, bs, 1]
+        vs = scale_layer[1][block_tables]
+        # dequantize to bf16: the quantized cache must not carry a LARGER
+        # working set than the bf16 pool it replaces
+        k = (k.astype(jnp.float32) * ks).astype(jnp.bfloat16)
+        v = (v.astype(jnp.float32) * vs).astype(jnp.bfloat16)
     k = k.transpose(0, 1, 3, 2, 4).reshape(B, M * bs, K, H)
     v = v.transpose(0, 1, 3, 2, 4).reshape(B, M * bs, K, H)
     return k, v

@@ -87,6 +87,14 @@ class GenerationMixin:
         return g
 
     # ------------------------------------------------------------------
+    def _init_decode_cache(self, batch_size: int, max_length: int):
+        """Decode-cache factory — KVCache by default; attention-free archs
+        (mamba) override with their own state pytree."""
+        from ..transformers.cache_utils import init_cache
+
+        dtype = jnp.bfloat16 if self.module.dtype == jnp.bfloat16 else jnp.float32
+        return init_cache(self.config, batch_size, max_length, dtype=dtype)
+
     def generate(
         self,
         input_ids,
@@ -336,8 +344,6 @@ class GenerationMixin:
         NEG = -1.0e9
 
         def decode(params, input_ids, attention_mask):
-            from ..transformers.cache_utils import init_cache
-
             B, T0 = input_ids.shape
             BK = B * K
             rep = lambda x: jnp.repeat(x, K, axis=0)  # [B, ...] -> [B*K, ...]
@@ -346,8 +352,7 @@ class GenerationMixin:
             pad_mask = jnp.concatenate(
                 [rep(attention_mask), jnp.ones((BK, max_length - T0), jnp.int32)], axis=1
             )
-            kv = init_cache(config, BK, max_length,
-                            dtype=jnp.bfloat16 if module.dtype == jnp.bfloat16 else jnp.float32)
+            kv = self._init_decode_cache(BK, max_length)
             prompt_pos = jnp.clip(jnp.cumsum(rep(attention_mask), axis=1) - 1, 0)
             out = module.apply({"params": params}, input_ids=rep(input_ids),
                                attention_mask=pad_mask, position_ids=prompt_pos,
@@ -407,14 +412,21 @@ class GenerationMixin:
                 return buf[_flat_idx(beam_idx)]
 
             def reorder_kv(kv, beam_idx):
-                """Gather KVCache beams BY FIELD — keys/values carry batch on
-                axis 1 ([layers, B*K, ...]); offset is a scalar. Explicit fields
-                instead of shape sniffing: a leaf whose dims coincide with
-                (num_layers, B*K) must not be mis-gathered."""
+                """Gather cache beams BY FIELD — batch rides axis 1 of every
+                state array ([layers, B*K, ...]); offset is a scalar. Explicit
+                per-type fields instead of shape sniffing: a leaf whose dims
+                coincide with (num_layers, B*K) must not be mis-gathered."""
                 from ..transformers.cache_utils import KVCache
 
                 idx = _flat_idx(beam_idx)
-                return KVCache(keys=kv.keys[:, idx], values=kv.values[:, idx], offset=kv.offset)
+                if isinstance(kv, KVCache):
+                    return KVCache(keys=kv.keys[:, idx], values=kv.values[:, idx], offset=kv.offset)
+                from ..transformers.mamba.modeling import MambaCache
+
+                if isinstance(kv, MambaCache):
+                    return MambaCache(conv_states=kv.conv_states[:, idx],
+                                      ssm_states=kv.ssm_states[:, idx], offset=kv.offset)
+                raise TypeError(f"beam search cannot reorder cache type {type(kv).__name__}")
 
             def apply_step(state, logits):
                 ids_buf, kv, cur_len, scores, finished, lengths = state
@@ -466,15 +478,13 @@ class GenerationMixin:
         config = self.config
 
         def decode(params, input_ids, attention_mask, key):
-            from ..transformers.cache_utils import init_cache
-
             B, T0 = input_ids.shape
             ids_buf = jnp.full((B, max_length), pad_id, dtype=jnp.int32)
             ids_buf = jax.lax.dynamic_update_slice(ids_buf, input_ids, (0, 0))
             pad_mask = jnp.concatenate(
                 [attention_mask, jnp.ones((B, max_length - T0), jnp.int32)], axis=1
             )
-            kv = init_cache(config, B, max_length, dtype=jnp.bfloat16 if module.dtype == jnp.bfloat16 else jnp.float32)
+            kv = self._init_decode_cache(B, max_length)
 
             # ---- prefill ----
             prompt_pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)

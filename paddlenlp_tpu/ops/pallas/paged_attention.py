@@ -36,8 +36,12 @@ __all__ = ["paged_decode_attention"]
 NEG_INF = -1e30
 
 
-def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
-            m_s, l_s, acc_s, *, bs, scale):
+def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, scale, use_kv_scale):
+    if use_kv_scale:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -55,6 +59,9 @@ def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)  # [group, H]
         k = k_ref[0, 0].astype(jnp.float32)  # [bs, H]
         v = v_ref[0, 0].astype(jnp.float32)
+        if use_kv_scale:  # int8/fp8 cache: dequant the streamed block in VMEM
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [group, bs]
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         valid = pos <= ctx
@@ -80,6 +87,8 @@ def paged_decode_attention(
     context_lens: jnp.ndarray,  # [B] int32 (position of the current token)
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # [num_blocks, K, bs, 1] quantized-pool scales
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     B, N, H = q.shape
     nb, K, bs, _ = pool_k.shape
@@ -88,16 +97,24 @@ def paged_decode_attention(
     scale = scale if scale is not None else H**-0.5
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
+    use_kv_scale = k_scale is not None
 
     qf = q.reshape(B, K, group, H)
+    kv_spec = pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0))
+    sc_spec = pl.BlockSpec((1, 1, bs, 1), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qf, pool_k, pool_v]
+    if use_kv_scale:
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, K, max_blocks),
-        in_specs=[
-            pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
-            pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0)),
-            pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((group, 1), jnp.float32),  # m
@@ -106,10 +123,10 @@ def paged_decode_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, scale=scale),
+        functools.partial(_kernel, bs=bs, scale=scale, use_kv_scale=use_kv_scale),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, group, H), q.dtype),
         compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), qf, pool_k, pool_v)
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), *operands)
     return out.reshape(B, N, H)

@@ -2,3 +2,4 @@ from .quantization_config import QuantizationConfig  # noqa: F401
 from .quantization_utils import QuantizedModel, dequantize_leaf, quantize_params  # noqa: F401
 from .gptq import apply_gptq, collect_hessians, gptq_quantize  # noqa: F401
 from .qlora import NF4_CODE, nf4_dequantize, nf4_quantize  # noqa: F401
+from .a8w8 import collect_act_scales, int8_linear  # noqa: F401

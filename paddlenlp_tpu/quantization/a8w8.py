@@ -1,0 +1,106 @@
+"""A8W8: int8 activations x int8 weights on the MXU.
+
+Counterpart of the reference's activation-quant serving path
+(``csrc/gpu/int8_gemm_with_cutlass/``, ``quant_int8.cu``, and the PTQ a8w8
+strategy in ``llm/utils/quant.py``). The TPU-native replacement for the CUTLASS
+int8 GEMM is plain ``lax.dot_general`` with int8 operands and
+``preferred_element_type=int32`` — XLA lowers it onto the MXU's native int8
+path (2x bf16 throughput) — with the dequant rescale fused onto the output:
+
+    y = (x_q @ w_q) * (a_scale ⊗ w_scale)
+
+- weights: symmetric per-out-channel int8 (the existing ``_quantize_array``);
+- activations: symmetric per-token dynamic scales by default (no calibration
+  needed), or a calibrated per-tensor static scale from ``collect_act_scales``
+  (absmax over calibration batches — the reference's PTQ observer).
+
+Scope: the unrolled layer layout (``use_scan_layers=False``), same constraint
+as GPTQ calibration — nn.scan hides per-layer Dense calls from interception.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..transformers.conversion_utils import flatten_params
+from ..utils.log import logger
+
+__all__ = ["int8_linear", "collect_act_scales", "a8w8_interceptor"]
+
+
+def int8_linear(
+    x: jnp.ndarray,  # [..., in] activations (bf16/fp32)
+    qweight: jnp.ndarray,  # [in, out] int8
+    w_scales: jnp.ndarray,  # [out] fp32 per-out-channel
+    bias: Optional[jnp.ndarray] = None,
+    act_scale: Optional[jnp.ndarray] = None,  # scalar static scale (calibrated)
+    out_dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """int8 x int8 -> int32 matmul with fused dequant rescale."""
+    x32 = x.astype(jnp.float32)
+    if act_scale is None:
+        a_scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / 127.0  # per token
+        a_scale = jnp.maximum(a_scale, 1e-8)
+    else:
+        a_scale = jnp.maximum(jnp.asarray(act_scale, jnp.float32), 1e-8)
+    x_q = jnp.clip(jnp.round(x32 / a_scale), -127, 127).astype(jnp.int8)
+    y = jax.lax.dot_general(
+        x_q, qweight,
+        (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = y.astype(jnp.float32) * a_scale * w_scales.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(out_dtype)
+
+
+def collect_act_scales(model, batches: List[Dict], match=None) -> Dict[str, float]:
+    """Calibration pass: per-Dense per-tensor activation absmax/127 (the PTQ
+    observer). Keys are flat kernel paths (``.../q_proj/kernel``)."""
+    flat = dict(flatten_params(model.params))
+    targets = {p for p, v in flat.items() if p.endswith("/kernel") and getattr(v, "ndim", 0) >= 2}
+    if match is not None:
+        targets = {p for p in targets if match(p)}
+    amax: Dict[str, float] = {}
+
+    def interceptor(next_fn, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            path = "/".join(str(p) for p in mod.path) + "/kernel"
+            if path in targets:
+                m = float(np.abs(np.asarray(jax.device_get(args[0]), np.float32)).max())
+                amax[path] = max(amax.get(path, 0.0), m)
+        return next_fn(*args, **kwargs)
+
+    for batch in batches:
+        with nn.intercept_methods(interceptor):
+            model.module.apply({"params": model.params}, deterministic=True, **batch)
+    return {p: m / 127.0 for p, m in amax.items()}
+
+
+def a8w8_interceptor(flat_params: Dict[str, jnp.ndarray], out_dtype,
+                     act_scales: Optional[Dict[str, float]] = None):
+    """Method interceptor: Dense modules whose kernel was int8-quantized run
+    through ``int8_linear`` instead of the fp matmul."""
+
+    def interceptor(next_fn, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            path = "/".join(str(p) for p in mod.path)
+            q = flat_params.get(path + "/qweight")
+            if q is not None:
+                return int8_linear(
+                    args[0], q, flat_params[path + "/scales"],
+                    bias=flat_params.get(path + "/bias"),
+                    act_scale=None if act_scales is None else act_scales.get(path + "/kernel"),
+                    out_dtype=out_dtype,
+                )
+        return next_fn(*args, **kwargs)
+
+    return interceptor

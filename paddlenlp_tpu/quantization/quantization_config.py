@@ -7,7 +7,7 @@ from typing import List, Optional
 
 __all__ = ["QuantizationConfig"]
 
-SUPPORTED_ALGOS = ("weight_only_int8", "wint8", "weight_only_int4", "wint4")
+SUPPORTED_ALGOS = ("weight_only_int8", "wint8", "weight_only_int4", "wint4", "a8w8")
 
 
 @dataclasses.dataclass
@@ -31,3 +31,7 @@ class QuantizationConfig:
     @property
     def is_weight_quantize(self) -> bool:
         return self.weight_quantize_algo is not None
+
+    @property
+    def is_activation_quantize(self) -> bool:
+        return self.weight_quantize_algo == "a8w8"

@@ -104,16 +104,29 @@ def _dequantize_tree(params: dict, bits: int, dtype) -> dict:
 
 
 class _QuantModule:
-    """Module shim: dequantize under jit (fused into consumers), then base apply."""
+    """Module shim. Weight-only: dequantize under jit (fused into consumers),
+    then base apply. a8w8: keep int8 leaves and intercept Dense calls into the
+    int8×int8 MXU matmul (activations quantized on the fly)."""
 
-    def __init__(self, base_module, bits: int, dtype):
+    def __init__(self, base_module, bits: int, dtype, activation_quant: bool = False,
+                 act_scales=None):
         self._base = base_module
         self._bits = bits
         self._dtype = dtype
+        self._act_quant = activation_quant
+        self._act_scales = act_scales
         self.dtype = getattr(base_module, "dtype", jnp.float32)
 
     def apply(self, variables, *args, **kwargs):
+        import flax.linen as nn
+
         params = variables["params"] if "params" in variables else variables
+        if self._act_quant:
+            from .a8w8 import a8w8_interceptor
+
+            flat = dict(flatten_params(params))
+            with nn.intercept_methods(a8w8_interceptor(flat, self._dtype, self._act_scales)):
+                return self._base.apply({"params": params}, *args, **kwargs)
         deq = _dequantize_tree(params, self._bits, self._dtype)
         return self._base.apply({"params": deq}, *args, **kwargs)
 
@@ -124,14 +137,24 @@ class _QuantModule:
 class QuantizedModel:
     """Facade holding int-quantized params (reference QuantizationLinear model)."""
 
-    def __init__(self, model, config: Optional[QuantizationConfig] = None):
+    def __init__(self, model, config: Optional[QuantizationConfig] = None, act_scales=None):
         self.model = model
         self.quantization_config = config or QuantizationConfig(weight_quantize_algo="wint8")
         self.config = model.config
         self.dtype = model.dtype
         self.generation_config = model.generation_config
         self.params = quantize_params(model.params, self.quantization_config)
-        self.module = _QuantModule(model.module, self.quantization_config.bits, model.dtype)
+        act_quant = self.quantization_config.is_activation_quantize
+        if act_quant:
+            stacked = [p for p, v in flatten_params(self.params).items()
+                       if p.endswith("/qweight") and getattr(v, "ndim", 0) == 3]
+            if stacked:
+                raise ValueError(
+                    "a8w8 needs the unrolled layer layout (use_scan_layers=False): "
+                    f"scan-stacked kernels are opaque to Dense interception ({stacked[:2]}...)"
+                )
+        self.module = _QuantModule(model.module, self.quantization_config.bits, model.dtype,
+                                   activation_quant=act_quant, act_scales=act_scales)
         self.mesh = model.mesh
         self._jit_cache: Dict[Any, Any] = {}
 

@@ -39,7 +39,9 @@ def compress(trainer, strategy: str = "ptq", output_dir: Optional[str] = None, *
         return _ptq(trainer, output_dir, **kwargs)
     if strategy == "prune":
         return _prune_width(trainer, output_dir, **kwargs)
-    raise ValueError(f"unknown compression strategy {strategy!r} (ptq | prune)")
+    if strategy == "a8w8":
+        return _a8w8(trainer, output_dir, **kwargs)
+    raise ValueError(f"unknown compression strategy {strategy!r} (ptq | prune | a8w8)")
 
 
 def _ptq(trainer, output_dir: str, bits: int = 8, use_gptq: bool = False,
@@ -69,6 +71,44 @@ def _ptq(trainer, output_dir: str, bits: int = 8, use_gptq: bool = False,
     model.save_pretrained(output_dir, params=params)  # fp reference
     _save_q(qparams, output_dir)
     logger.info(f"PTQ({'gptq+' if use_gptq else ''}wint{bits}) exported to {output_dir}")
+    return output_dir
+
+
+def _a8w8(trainer, output_dir: str, n_calib_batches: int = 4, match=None,
+          static_act_scales: bool = True):
+    """Activation+weight int8 export (reference llm/utils/quant.py a8w8 PTQ):
+    calibrate per-tensor activation absmax observers, quantize weights int8,
+    save both plus the scale table. Serving loads them into QuantizedModel."""
+    import json
+
+    from ..quantization import QuantizationConfig, quantize_params
+    from ..quantization.a8w8 import collect_act_scales
+
+    model = trainer.model
+    params = trainer.train_state.params if trainer.train_state is not None else model.params
+    act_scales = None
+    if static_act_scales:
+        dataset = trainer.eval_dataset or trainer.train_dataset
+        if dataset is None:
+            raise ValueError("a8w8 calibration needs an eval or train dataset")
+        batches = []
+        for i in range(min(n_calib_batches, len(dataset))):
+            row = dataset[i]
+            batches.append({"input_ids": jnp.asarray(np.asarray(row["input_ids"])[None], jnp.int32)})
+        orig = model.params
+        model.params = params
+        try:
+            act_scales = collect_act_scales(model, batches, match=match)
+        finally:
+            model.params = orig
+    qparams = quantize_params(params, QuantizationConfig(weight_quantize_algo="a8w8"))
+    model.save_pretrained(output_dir, params=params)  # fp reference
+    _save_q(qparams, output_dir)
+    if act_scales is not None:
+        with open(os.path.join(output_dir, "act_scales.json"), "w") as f:
+            json.dump(act_scales, f)
+    logger.info(f"a8w8 exported to {output_dir} "
+                f"({'static' if act_scales else 'dynamic'} activation scales)")
     return output_dir
 
 

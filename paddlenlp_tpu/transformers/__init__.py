@@ -34,6 +34,7 @@ from .llama import (  # noqa: F401
     LlamaModel,
     LlamaPretrainingCriterion,
 )
+from .mamba import MambaConfig, MambaForCausalLM, MambaModel  # noqa: F401
 from .mistral import MistralConfig, MistralForCausalLM, MistralModel  # noqa: F401
 from .mixtral import MixtralConfig, MixtralForCausalLM, MixtralModel  # noqa: F401
 from .model_outputs import (  # noqa: F401

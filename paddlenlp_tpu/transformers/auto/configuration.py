@@ -34,11 +34,13 @@ def _populate():
     from ..qwen2_moe.configuration import Qwen2MoeConfig
     from ..bart.configuration import BartConfig
     from ..deepseek_v2.configuration import DeepseekV2Config
+    from ..mamba.configuration import MambaConfig
     from ..t5.configuration import T5Config
 
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
                 ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
-                OPTConfig, QWenConfig, ChatGLMv2Config, T5Config, BartConfig, DeepseekV2Config):
+                OPTConfig, QWenConfig, ChatGLMv2Config, T5Config, BartConfig, DeepseekV2Config,
+                MambaConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

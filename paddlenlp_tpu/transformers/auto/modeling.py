@@ -85,6 +85,10 @@ def _populate_models():
 
     register_model("deepseek_v2", "base", deepseek_v2.DeepseekV2Model)
     register_model("deepseek_v2", "causal_lm", deepseek_v2.DeepseekV2ForCausalLM)
+    from ..mamba import modeling as mamba
+
+    register_model("mamba", "base", mamba.MambaModel)
+    register_model("mamba", "causal_lm", mamba.MambaForCausalLM)
     from ..t5 import modeling as t5
 
     register_model("t5", "base", t5.T5Model)

@@ -33,6 +33,7 @@ class StateDictNameMapping:
     target_name: str  # our flat path, e.g. "model/layers_0/self_attn/q_proj/kernel"
     action: Optional[str] = None  # None | "transpose" | custom callable via `fn`
     fn: Optional[Callable[[np.ndarray], np.ndarray]] = None
+    fn_reverse: Optional[Callable[[np.ndarray], np.ndarray]] = None  # save-side inverse of fn
 
     def apply(self, array: np.ndarray) -> np.ndarray:
         if self.fn is not None:
@@ -44,6 +45,8 @@ class StateDictNameMapping:
     def reverse(self, array: np.ndarray) -> np.ndarray:
         if self.action == "transpose":
             return np.ascontiguousarray(array.T)
+        if self.fn_reverse is not None:
+            return self.fn_reverse(array)
         if self.fn is not None:
             raise ValueError(f"custom conversion for {self.target_name} is not invertible")
         return array

@@ -1,0 +1,7 @@
+from .configuration import MambaConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    MambaCache,
+    MambaForCausalLM,
+    MambaModel,
+    MambaPretrainedModel,
+)

@@ -1,0 +1,59 @@
+"""Mamba configuration (reference: paddlenlp/transformers/mamba/configuration.py)."""
+
+from __future__ import annotations
+
+import math
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["MambaConfig"]
+
+
+class MambaConfig(PretrainedConfig):
+    model_type = "mamba"
+
+    def __init__(
+        self,
+        vocab_size: int = 50280,
+        hidden_size: int = 768,
+        state_size: int = 16,
+        num_hidden_layers: int = 32,
+        layer_norm_epsilon: float = 1e-5,
+        expand: int = 2,
+        conv_kernel: int = 4,
+        use_bias: bool = False,
+        use_conv_bias: bool = True,
+        hidden_act: str = "silu",
+        initializer_range: float = 0.1,
+        time_step_rank="auto",
+        time_step_min: float = 0.001,
+        time_step_max: float = 0.1,
+        time_step_floor: float = 1e-4,
+        rescale_prenorm_residual: bool = False,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.state_size = state_size
+        self.num_hidden_layers = num_hidden_layers
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.expand = expand
+        self.conv_kernel = conv_kernel
+        self.intermediate_size = int(expand * hidden_size)
+        self.use_bias = use_bias
+        self.use_conv_bias = use_conv_bias
+        self.hidden_act = hidden_act
+        self.initializer_range = initializer_range
+        self.time_step_rank = (
+            math.ceil(hidden_size / 16) if time_step_rank == "auto" else int(time_step_rank)
+        )
+        self.time_step_min = time_step_min
+        self.time_step_max = time_step_max
+        self.time_step_floor = time_step_floor
+        self.rescale_prenorm_residual = rescale_prenorm_residual
+        # attention-free: keep cross-subsystem probes (MFU calc etc.) harmless
+        self.num_attention_heads = 1
+        self.rms_norm_eps = layer_norm_epsilon
+        kwargs.setdefault("tie_word_embeddings", True)
+        kwargs["use_scan_layers"] = False  # SSM block stack runs unrolled (round-3 scope)
+        super().__init__(**kwargs)

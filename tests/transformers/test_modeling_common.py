@@ -13,6 +13,8 @@ from paddlenlp_tpu.transformers import (
     BaichuanConfig,
     DeepseekV2Config,
     DeepseekV2ForCausalLM,
+    MambaConfig,
+    MambaForCausalLM,
     BaichuanForCausalLM,
     BertConfig,
     BloomConfig,
@@ -83,6 +85,11 @@ CAUSAL_CASES = {
                       "mscale": 0.707, "mscale_all_dim": 0.707,
                       "beta_fast": 32, "beta_slow": 1},
         **TINY)),
+    # attention-free SSM: associative-scan recurrence + conv/ssm state cache
+    "mamba": (MambaForCausalLM, lambda: MambaConfig(
+        vocab_size=96, hidden_size=64, num_hidden_layers=2, state_size=8,
+        conv_kernel=4, expand=2, time_step_rank=8, initializer_range=0.02,
+        max_position_embeddings=64)),
 }
 
 ENCODER_CASES = {

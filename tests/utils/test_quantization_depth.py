@@ -4,6 +4,7 @@ calibration-driven apply_gptq on a real (unrolled) model, QLoRA composition."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 
 class TestNF4:
@@ -114,3 +115,81 @@ class TestQLoRAComposition:
         trainer.train()
         losses = [h["loss"] for h in trainer.state.log_history if "loss" in h]
         assert losses[-1] < losses[0], losses
+
+
+class TestA8W8:
+    def _model(self):
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, use_scan_layers=False)
+        return LlamaForCausalLM.from_config(cfg, seed=0)
+
+    def test_int8_linear_matches_fp(self):
+        from paddlenlp_tpu.quantization import int8_linear
+        from paddlenlp_tpu.quantization.quantization_utils import _quantize_array
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+        w = rng.normal(size=(32, 48)).astype(np.float32) * 0.2
+        q, s = _quantize_array(w, 8)
+        y = int8_linear(x, jnp.asarray(q), jnp.asarray(s), out_dtype=jnp.float32)
+        ref = np.asarray(x) @ w
+        cos = float(np.sum(np.asarray(y) * ref) /
+                    (np.linalg.norm(y) * np.linalg.norm(ref) + 1e-9))
+        assert cos > 0.999, cos
+
+    def test_quantized_model_a8w8_quality(self):
+        """a8w8 forward must track the fp model (top-1 agreement on most
+        positions of a fixed input)."""
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+
+        model = self._model()
+        ids = jnp.asarray(np.arange(16)[None] % 90 + 3, jnp.int32)
+        ref = np.asarray(model(input_ids=ids).logits[0])
+        qm = QuantizedModel(model, QuantizationConfig(weight_quantize_algo="a8w8"))
+        got = np.asarray(qm(input_ids=ids).logits[0])
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree >= 0.8, agree
+        # and the logits correlate strongly
+        cos = float((ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9))
+        assert cos > 0.98, cos
+
+    def test_a8w8_rejects_scan_layout(self):
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, use_scan_layers=True)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        with pytest.raises(ValueError, match="use_scan_layers"):
+            QuantizedModel(model, QuantizationConfig(weight_quantize_algo="a8w8"))
+
+    def test_compress_a8w8_flow(self, tmp_path):
+        """Trainer.compress(strategy='a8w8') calibrates, exports, and the
+        static-scale model stays close to fp."""
+        import json
+        import os
+
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+        from paddlenlp_tpu.trainer import Trainer, TrainingArguments
+
+        model = self._model()
+        data = [{"input_ids": np.asarray([3, 4, 5, 6, 7, 8], np.int32),
+                 "labels": np.asarray([4, 5, 6, 7, 8, 9], np.int32)} for _ in range(4)]
+        args = TrainingArguments(output_dir=str(tmp_path), per_device_train_batch_size=2)
+        trainer = Trainer(model=model, args=args, train_dataset=data)
+        out = trainer.compress(strategy="a8w8", n_calib_batches=2)
+        assert os.path.exists(os.path.join(out, "act_scales.json"))
+        assert os.path.exists(os.path.join(out, "model_quant.safetensors"))
+        scales = json.load(open(os.path.join(out, "act_scales.json")))
+        assert scales and all(v > 0 for v in scales.values())
+        ids = jnp.asarray(np.arange(12)[None] % 90 + 3, jnp.int32)
+        ref = np.asarray(model(input_ids=ids).logits[0])
+        qm = QuantizedModel(model, QuantizationConfig(weight_quantize_algo="a8w8"),
+                            act_scales=scales)
+        got = np.asarray(qm(input_ids=ids).logits[0])
+        cos = float((ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9))
+        assert cos > 0.97, cos
