@@ -1,0 +1,104 @@
+"""Reward-model training entry point (reference: /root/reference/llm/alignment/rm/).
+
+Data: jsonl rows {"src": prompt, "chosen": ..., "rejected": ...} — the same
+preference format as DPO; the reward model is a sequence-classification head
+(num_labels=1) trained with the pairwise Bradley-Terry loss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+
+import numpy as np
+
+from paddlenlp_tpu.trainer import PdArgumentParser, TrainingArguments
+from paddlenlp_tpu.transformers import AutoConfig, AutoTokenizer, LlmMetaConfig
+from paddlenlp_tpu.transformers.auto.modeling import AutoModelForSequenceClassification
+from paddlenlp_tpu.trl import RewardTrainer
+from paddlenlp_tpu.utils.log import logger
+
+
+@dataclass
+class ModelArguments:
+    model_name_or_path: str = "facebook/llama-7b"
+    dtype: str = "bfloat16"
+
+
+@dataclass
+class RMArguments:
+    dataset_name_or_path: str = "data"
+    max_length: int = 1024
+    max_prompt_length: int = 512
+
+
+def load_pairwise_dataset(path: str, tokenizer, rm_args: RMArguments):
+    rows = []
+    max_len = rm_args.max_length
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            r = json.loads(line)
+            prompt = tokenizer.encode(str(r["src"]))[: rm_args.max_prompt_length]
+            eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
+
+            def build(resp):
+                resp_ids = (tokenizer.encode(str(resp)) + eos)[: max_len - len(prompt)]
+                ids = np.asarray(prompt + resp_ids, dtype=np.int32)
+                pad = max_len - len(ids)
+                mask = np.concatenate([np.ones(len(ids), np.int32), np.zeros(pad, np.int32)])
+                return np.pad(ids, (0, pad)), mask
+
+            ci, cm = build(r["chosen"])
+            ri, rm_ = build(r["rejected"])
+            rows.append({"chosen_input_ids": ci, "chosen_attention_mask": cm,
+                         "rejected_input_ids": ri, "rejected_attention_mask": rm_})
+    return rows
+
+
+class ListDataset:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+
+def main():
+    parser = PdArgumentParser((ModelArguments, RMArguments, TrainingArguments))
+    model_args, rm_args, training_args = parser.parse_args_into_dataclasses()
+
+    tokenizer = AutoTokenizer.from_pretrained(model_args.model_name_or_path)
+    config = AutoConfig.from_pretrained(model_args.model_name_or_path)
+    config.num_labels = 1
+    LlmMetaConfig.set_llm_config(config, training_args)
+    model = AutoModelForSequenceClassification.from_pretrained(
+        model_args.model_name_or_path, config=config, dtype=model_args.dtype, param_dtype="float32"
+    )
+    rows = load_pairwise_dataset(
+        os.path.join(rm_args.dataset_name_or_path, "train.json"), tokenizer, rm_args
+    )
+    trainer = RewardTrainer(
+        model=model,
+        args=training_args,
+        train_dataset=ListDataset(rows),
+        tokenizer=tokenizer,
+    )
+    if training_args.do_train:
+        result = trainer.train(resume_from_checkpoint=training_args.resume_from_checkpoint)
+        trainer.save_model()
+        logger.info(f"rm done: {result.metrics}")
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

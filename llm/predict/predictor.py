@@ -47,6 +47,12 @@ class PredictorArgument:
         default=None,
         metadata={"help": "quantize the paged KV cache: 'dynamic' (int8) or 'fp8' "
                           "(reference predictor.py:775-791 cachekv_int8 knob)"})
+    speculate_method: Optional[str] = field(
+        default=None,
+        metadata={"help": "speculative decoding: 'ngram' (prompt-lookup drafts verified "
+                          "in one batched forward; greedy requests only — the reference's "
+                          "csrc/gpu/append_attn speculative write path)"})
+    speculate_max_draft_tokens: int = 4
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -112,6 +118,8 @@ class BlockPredictor(BasePredictor):
 
         from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
 
+        if args.speculate_method not in (None, "ngram"):
+            raise ValueError(f"speculate_method={args.speculate_method!r} unsupported (only 'ngram')")
         self.engine = InferenceEngine(
             self.model,
             tokenizer=self.tokenizer,
@@ -121,6 +129,8 @@ class BlockPredictor(BasePredictor):
             max_blocks_per_seq=args.max_blocks_per_seq,
             dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
             kv_cache_quant=self._kv_quant(args.cachekv_int8_type),
+            use_speculative=args.speculate_method == "ngram",
+            spec_draft_len=args.speculate_max_draft_tokens,
         )
         self._sampling = SamplingParams(
             max_new_tokens=args.max_length,

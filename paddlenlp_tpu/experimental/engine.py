@@ -101,6 +101,9 @@ class InferenceEngine:
         dtype=jnp.float32,
         decode_steps: int = 8,
         kv_cache_quant: Optional[str] = None,  # None | "int8" | "fp8" (cachekv_int8 knob)
+        use_speculative: bool = False,
+        spec_draft_len: int = 4,
+        spec_ngram: int = 2,
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -122,6 +125,11 @@ class InferenceEngine:
         self._last_token = np.zeros(max_batch_size, np.int32)
         # device-resident per-slot token counts feeding the penalty kernels
         self.counts = jnp.zeros((max_batch_size, model.config.vocab_size), jnp.int32)
+        # speculative decoding (n-gram prompt-lookup proposer + batched verify)
+        self.use_speculative = use_speculative
+        self.spec_draft_len = spec_draft_len
+        self.spec_ngram = spec_ngram
+        self.spec_stats = {"verify_steps": 0, "tokens_emitted": 0}
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -234,9 +242,119 @@ class InferenceEngine:
                     self.slots[slot] = req
                     self._last_token[slot] = tok
 
+    # ------------------------------------------------------------------ speculative
+    def _spec_eligible(self) -> bool:
+        """Speculative decoding verifies greedily — only sound when every active
+        request is greedy with penalties off (the reference's speculative path
+        has the same restriction: draft acceptance must be deterministic)."""
+        for r in self.slots:
+            if r is None:
+                continue
+            s = r.sampling
+            if s.do_sample or s.repetition_penalty != 1.0 or s.presence_penalty != 0.0 \
+                    or s.frequency_penalty != 0.0:
+                return False
+        return True
+
+    def _propose_drafts(self, req: Request) -> np.ndarray:
+        """Prompt-lookup (n-gram) proposer: find the most recent earlier
+        occurrence of the sequence's final n-gram and propose the tokens that
+        followed it. Draft-model-free — the proposer the reference pairs with
+        its speculative write ops for repetitive/extractive workloads."""
+        k = min(self.spec_draft_len, max(req.remaining_new - 1, 0))
+        n = self.spec_ngram
+        if k == 0:
+            return np.zeros(0, np.int32)
+        hist = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
+        if len(hist) <= n:
+            return np.zeros(0, np.int32)
+        pat = hist[-n:]
+        windows = np.lib.stride_tricks.sliding_window_view(hist, n)
+        starts = np.nonzero((windows == pat).all(axis=1))[0]
+        starts = starts[starts < len(hist) - n]  # exclude the suffix itself
+        if len(starts) == 0:
+            return np.zeros(0, np.int32)
+        s = int(starts[-1])
+        return hist[s + n : s + n + k].astype(np.int32)
+
+    def _preempt(self, slot: int):
+        """Evict + requeue with prompt+generated as the new prompt (recompute
+        recovery, the step.cu is_block_step/recover list)."""
+        req = self.slots[slot]
+        logger.warning(f"req {req.req_id}: KV blocks exhausted; preempting (recompute)")
+        self.mgr.free_seq(req.req_id)
+        self.slots[slot] = None
+        req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
+        req.output_ids = []
+        self.waiting.appendleft(req)
+
+    def _decode_spec(self, finished: List[Request], drafts: List[np.ndarray]):
+        """One speculative iteration: verify the proposed drafts for the whole
+        batch in ONE [B, K+1] forward, accept the longest matching prefix plus
+        the model's bonus token (1..K+1 tokens per sequence per forward)."""
+        K = self.spec_draft_len
+        # reserve capacity for all K+1 optimistic KV writes; preempt on OOM
+        active = [s for s in range(len(self.slots)) if self.slots[s] is not None]
+        for slot in sorted(active, key=lambda s: -self.slots[s].req_id):
+            req = self.slots[slot]
+            grow = req.total_len + K - self.mgr.lengths[req.req_id]
+            if grow > 0 and self.mgr.extend(req.req_id, grow) is None:
+                self._preempt(slot)
+        if not any(r is not None for r in self.slots):
+            return
+
+        B = self.max_batch_size
+        tokens = np.zeros((B, K + 1), np.int32)
+        tables = np.zeros((B, self.mgr.max_blocks_per_seq), np.int32)
+        start = np.zeros(B, np.int32)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                drafts[i] = np.zeros(0, np.int32)
+                continue
+            d = drafts[i]
+            tokens[i, 0] = self._last_token[i]
+            tokens[i, 1 : 1 + len(d)] = d
+            tables[i] = self.mgr.table_array(req.req_id)
+            start[i] = req.total_len - 1  # position of the token being fed
+        targets, self.pool = self.infer.verify(
+            self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
+            jnp.asarray(start),
+        )
+        targets = np.asarray(targets)  # [B, K+1]
+        self.spec_stats["verify_steps"] += 1
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            d = drafts[i]
+            n_acc = 0
+            while n_acc < len(d) and targets[i, n_acc] == d[n_acc]:
+                n_acc += 1
+            emitted = list(d[:n_acc]) + [int(targets[i, n_acc])]
+            for tok in emitted:
+                self._emit(req, int(tok))
+                self._last_token[i] = int(tok)
+                self.spec_stats["tokens_emitted"] += 1
+                if req.done:
+                    break
+            if req.done:
+                self.mgr.free_seq(req.req_id)
+                self.slots[i] = None
+                finished.append(req)
+            else:
+                # release the optimistic blocks past the accepted tokens
+                self.mgr.shrink(req.req_id, req.total_len)
+
     def _decode_running(self, finished: List[Request]):
         if not any(r is not None for r in self.slots):
             return
+        if self.use_speculative and self._spec_eligible():
+            # propose first: when NO slot has a draft, a verify forward would
+            # emit 1 token/seq for (K+1)x the compute — use the multi-step
+            # decode instead and only pay for verification when drafts exist
+            drafts = [np.zeros(0, np.int32) if r is None else self._propose_drafts(r)
+                      for r in self.slots]
+            if any(len(d) for d in drafts):
+                return self._decode_spec(finished, drafts)
         steps = self.decode_steps
         # grow tables for up to `steps` tokens; preempt (recompute-requeue)
         # youngest on exhaustion. Surplus is shrunk back after the device call.
@@ -247,13 +365,8 @@ class InferenceEngine:
             needed = min(steps, req.remaining_new)
             start_len[req.req_id] = self.mgr.lengths[req.req_id]
             if self.mgr.extend(req.req_id, max(needed, 1)) is None:
-                logger.warning(f"req {req.req_id}: KV blocks exhausted; preempting (recompute)")
-                self.mgr.free_seq(req.req_id)
-                self.slots[slot] = None
                 start_len.pop(req.req_id, None)
-                req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
-                req.output_ids = []
-                self.waiting.appendleft(req)
+                self._preempt(slot)
 
         if not any(r is not None for r in self.slots):
             return

@@ -13,7 +13,6 @@ whole prefill/decode step is a single jit.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -123,6 +122,7 @@ class PagedInferenceModel:
         self.inv_freq = jnp.asarray(rope_frequencies(self.head_dim, cfg.rope_theta, cfg.rope_scaling))
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._verify = jax.jit(self._verify_impl, donate_argnums=(1,))
 
     # ------------------------------------------------------------------ forward core
     def _attend(self, q, k, v, q_positions, kv_len_mask):
@@ -204,7 +204,10 @@ class PagedInferenceModel:
         return h, pool_layer
 
     def _forward(self, params, pool: PagedKVPool, input_ids, block_tables, q_positions, kv_len_mask, write_pos, last_pos):
-        """input_ids [B,T]; returns (logits at last_pos [B,V], new PagedKVPool)."""
+        """input_ids [B,T]; returns (logits at last_pos [B,V], new PagedKVPool).
+
+        ``last_pos=None`` returns full-sequence logits [B,T,V] (the speculative
+        verify step needs the model's prediction after EVERY draft position)."""
         m = params["model"]
         embed = m["embed_tokens"]["embedding"]
         h = embed[input_ids].astype(self.dtype)
@@ -221,7 +224,7 @@ class PagedInferenceModel:
         else:
             new_pool = PagedKVPool(kv=new_pool[0], scale=new_pool[1])
         h = _rms(h, m["norm"]["scale"], self.eps)
-        last = h[jnp.arange(h.shape[0]), last_pos]
+        last = h if last_pos is None else h[jnp.arange(h.shape[0]), last_pos]
         if "lm_head" in params:
             logits = last @ params["lm_head"]["kernel"].astype(self.dtype)
         else:
@@ -288,6 +291,35 @@ class PagedInferenceModel:
             one, init, None, length=self.decode_steps
         )
         return toks, valid, done, ctx, counts, pool
+
+    def _verify_impl(self, params, pool, tokens, block_tables, start_pos):
+        """Speculative-decoding verify: one forward over ``[last_token, d_1..d_K]``.
+
+        Counterpart of the reference's speculative write path
+        (``csrc/gpu/append_attn/`` speculative decoding ops): the draft tokens
+        are scored in a single [B, K+1] forward over the paged cache and the
+        host accepts the longest matching prefix. KV for every fed position is
+        written optimistically; rejected positions need no rollback — they are
+        masked by absolute position until the next step overwrites them
+        in place (the same property the reference's block cache relies on).
+
+        tokens [B, K+1] (row = last accepted token then drafts, 0-padded);
+        start_pos [B] absolute position of tokens[:, 0]. Returns
+        (greedy targets [B, K+1], new pool) — targets[:, i] is the model's
+        next-token prediction after consuming tokens[:, i].
+        """
+        B, T = tokens.shape
+        positions = start_pos[:, None] + jnp.arange(T)[None, :]
+        S = block_tables.shape[1] * self.block_size
+        kv_len_mask = jnp.arange(S)[None, :] <= (start_pos[:, None] + T - 1)
+        logits, new_pool = self._forward(
+            params, pool, tokens, block_tables, positions, kv_len_mask,
+            start_pos, last_pos=None,
+        )
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pool
+
+    def verify(self, params, pool: PagedKVPool, tokens, block_tables, start_pos):
+        return self._verify(params, pool, tokens, block_tables, start_pos)
 
     def prefill(self, params, pool: PagedKVPool, input_ids, block_tables, prompt_lens, samp):
         return self._prefill(params, pool, input_ids, block_tables, prompt_lens, samp)

@@ -93,7 +93,7 @@ class MambaMixer(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, layer_cache=None, offset=0, pad_mask=None):
+    def __call__(self, x, layer_cache=None, pad_mask=None):
         cfg = self.config
         B_, T, _ = x.shape
         Di, N, K, R = cfg.intermediate_size, cfg.state_size, cfg.conv_kernel, cfg.time_step_rank
@@ -223,7 +223,7 @@ class MambaModule(nn.Module):
                              name=f"layers_{i}_norm")(h)
             out, (c_i, s_i) = MambaMixer(cfg, self.dtype, self.param_dtype,
                                          name=f"layers_{i}_mixer")(
-                x, cache.layer(i) if cache is not None else None, offset, pad_mask)
+                x, cache.layer(i) if cache is not None else None, pad_mask)
             h = residual + out
             if c_i is not None:
                 new_conv.append(c_i)

@@ -1,4 +1,4 @@
-"""PPO trainer: policy / reference / reward (+ optional value baseline).
+"""PPO trainer: policy / reference / reward / value quartet.
 
 Counterpart of ``/root/reference/llm/alignment/ppo/ppo_trainer.py`` (1802 LoC:
 policy/value/ref/reward quartet, rollout via the experimental fused inference
@@ -7,18 +7,20 @@ TPU-native:
 
 - rollout runs through the SAME paged continuous-batching ``InferenceEngine`` the
   serving stack uses (the reference's design, minus the weight-sync IPC: policy
-  params are handed to the engine directly each rollout round);
-- the update is the clipped-surrogate PPO objective over token log-probs with a
-  KL penalty against the frozen reference;
-- the baseline is group-relative advantage normalization (GRPO-style, the
-  value-model-free formulation); a jointly-trained value baseline is the round-2
-  extension.
+  params are handed to the engine directly each rollout round); non-scan models
+  fall back to ``model.generate``;
+- the update is the TOKEN-LEVEL clipped-surrogate PPO objective (per-token
+  ratios, the reference's formulation) with an entropy bonus;
+- two baselines: group-relative advantage normalization (GRPO-style,
+  value-model-free, the default) or a jointly-trained value model with GAE —
+  per-token KL penalty folded into rewards, terminal reward at the last
+  response token, clipped value loss (``use_value_model=True``).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +30,6 @@ from ..experimental import InferenceEngine, SamplingParams
 from ..trainer.trainer import Trainer
 from ..trainer.trainer_utils import copy_aliased_params
 from ..utils.log import logger
-from .dpo_criterion import sequence_logps
 
 __all__ = ["PPOTrainer", "PPOConfig"]
 
@@ -44,6 +45,52 @@ class PPOConfig:
     kl_coef: float = 0.05
     ppo_epochs: int = 1
     normalize_advantages: bool = True
+    entropy_coef: float = 0.0
+    # value-model (reference quartet) mode
+    use_value_model: bool = False
+    gamma: float = 1.0
+    gae_lambda: float = 0.95
+    value_clip: float = 0.2
+    vf_coef: float = 0.5
+    value_lr: float = 1e-5
+
+
+def token_logps(logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int = -100
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token log p(label). logits [B,T,V], labels [B,T] (aligned).
+    Returns (logps [B,T] zeroed at invalid, valid mask [B,T])."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, tok, 0.0), valid
+
+
+def gae_advantages(rewards: jnp.ndarray, values: jnp.ndarray, mask: jnp.ndarray,
+                   gamma: float, lam: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generalized advantage estimation over right-padded token rows.
+
+    rewards/values/mask [B,T]; the scan runs REVERSED over time so the first
+    valid token from the right sees v_next=0 (episode boundary). Returns
+    (advantages, returns), both zeroed outside the mask.
+    """
+
+    def step(carry, xs):
+        adv_next, v_next = carry
+        r, v, m = xs
+        delta = r + gamma * v_next - v
+        adv = delta + gamma * lam * adv_next
+        carry = (jnp.where(m, adv, adv_next), jnp.where(m, v, v_next))
+        return carry, jnp.where(m, adv, 0.0)
+
+    B, T = rewards.shape
+    init = (jnp.zeros(B), jnp.zeros(B))
+    xs = (rewards.T, values.T, mask.T.astype(bool))
+    _, adv_t = jax.lax.scan(step, init, xs, reverse=True)
+    adv = adv_t.T
+    returns = jnp.where(mask.astype(bool), adv + values, 0.0)
+    return adv, returns
 
 
 class PPOTrainer(Trainer):
@@ -56,6 +103,7 @@ class PPOTrainer(Trainer):
         ref_model=None,
         reward_model=None,
         reward_fn: Optional[Callable[[np.ndarray, np.ndarray], float]] = None,
+        value_model=None,
         ppo_config: Optional[PPOConfig] = None,
         **kwargs,
     ):
@@ -76,6 +124,8 @@ class PPOTrainer(Trainer):
             num_blocks=max(512, 4 * self._engine_blocks_needed()),
             max_blocks_per_seq=256,
         )
+        if self.ppo_config.use_value_model:
+            self._init_value_model(value_model)
         self._ppo_update = jax.jit(self._ppo_update_impl, donate_argnums=(0,))
 
     def _engine_blocks_needed(self):
@@ -83,11 +133,65 @@ class PPOTrainer(Trainer):
         per_seq = (c.max_new_tokens + c.max_prompt_length) // 16 + 2
         return per_seq * self.args.per_device_train_batch_size * c.num_rollouts_per_prompt
 
+    # ------------------------------------------------------------------ value model
+    def _init_value_model(self, value_model):
+        """The reference trains a separate value model (quartet member #2),
+        typically initialized from the reward/policy weights. Here: the policy's
+        backbone architecture + a fresh scalar head, params deep-copied so
+        policy-update donation can never free a shared buffer."""
+        import optax
+
+        src = value_model if value_model is not None else self.model
+        bb_cls = type(src.module).base_module_cls
+        self._value_backbone = bb_cls(src.config, src.module.dtype, src.module.param_dtype)
+        hidden = src.config.hidden_size
+        head = jax.random.normal(jax.random.key(7), (hidden, 1), jnp.float32) * 0.01
+        self.value_params = {
+            "model": jax.tree_util.tree_map(jnp.array, src.params["model"]),
+            "value_head": {"kernel": head},
+        }
+        self._value_tx = optax.adamw(self.ppo_config.value_lr)
+        self.value_opt_state = jax.jit(self._value_tx.init)(self.value_params)
+        self._value_update = jax.jit(self._value_update_impl, donate_argnums=(0, 1))
+        self._value_forward = jax.jit(self._values_impl)
+
+    def _values_impl(self, vparams, ids, mask):
+        h = self._value_backbone.apply(
+            {"params": vparams["model"]}, input_ids=ids, attention_mask=mask,
+            deterministic=True,
+        ).last_hidden_state
+        return (h.astype(jnp.float32) @ vparams["value_head"]["kernel"])[..., 0]
+
+    def _value_update_impl(self, vparams, opt_state, batch, old_values, returns, valid):
+        import optax
+
+        c = self.ppo_config
+
+        def loss_fn(vp):
+            v = self._values_impl(vp, batch["input_ids"][:, :-1], batch["attention_mask"][:, :-1])
+            v_clip = old_values + jnp.clip(v - old_values, -c.value_clip, c.value_clip)
+            per_tok = jnp.maximum(jnp.square(v - returns), jnp.square(v_clip - returns))
+            denom = jnp.maximum(valid.sum(), 1)
+            return c.vf_coef * 0.5 * jnp.where(valid, per_tok, 0.0).sum() / denom
+
+        loss, grads = jax.value_and_grad(loss_fn)(vparams)
+        updates, opt_state = self._value_tx.update(grads, opt_state, vparams)
+        vparams = optax.apply_updates(vparams, updates)
+        return vparams, opt_state, loss
+
     # ------------------------------------------------------------------ rollout
     def rollout(self, prompts: List[np.ndarray]) -> Dict[str, np.ndarray]:
-        """Sample G responses per prompt via the paged engine; right-pad into one
-        batch with labels masking the prompts."""
+        """Sample G responses per prompt; right-pad into one batch with labels
+        masking the prompts. Scan-layout models roll out through the paged
+        engine; unrolled models fall back to ``model.generate``."""
         c = self.ppo_config
+        reqs = []
+        for p in prompts:
+            p = p[-c.max_prompt_length :]  # cap: sizes were derived from this
+            for g in range(c.num_rollouts_per_prompt):
+                reqs.append((p, SamplingParams(max_new_tokens=c.max_new_tokens, do_sample=True,
+                                               temperature=c.temperature, top_p=c.top_p,
+                                               seed=int(self.state.global_step * 9973 + len(reqs)))))
         if getattr(self.model.config, "use_scan_layers", True):
             # ONE engine across rounds: its jitted prefill/decode stay compiled; the
             # policy params flow in via self.model.params each rollout
@@ -95,14 +199,6 @@ class PPOTrainer(Trainer):
                 self._engine = InferenceEngine(self.model, eos_token_id=self.model.config.eos_token_id,
                                                dtype=jnp.float32, **self._engine_kwargs)
             engine = self._engine
-            reqs = []
-            for p in prompts:
-                p = p[-c.max_prompt_length :]  # cap: sizes were derived from this
-                for g in range(c.num_rollouts_per_prompt):
-                    reqs.append((p, SamplingParams(max_new_tokens=c.max_new_tokens, do_sample=True,
-                                                   temperature=c.temperature, top_p=c.top_p,
-                                                   seed=int(self.state.global_step * 9973 + len(reqs)))))
-            outs = []
             ids = [engine.add_request(p, s) for p, s in reqs]
             results = {}
             while engine.has_work():
@@ -110,7 +206,27 @@ class PPOTrainer(Trainer):
                     results[r.req_id] = r.output_ids
             outs = [results[i] for i in ids]
         else:
-            raise ValueError("PPO rollout requires use_scan_layers models (paged engine)")
+            # generate() fallback: left-pad each prompt group into one batch
+            maxp = max(len(p) for p, _ in reqs)
+            ids_in = np.zeros((len(reqs), maxp), np.int32)
+            mask_in = np.zeros((len(reqs), maxp), np.int32)
+            for i, (p, _) in enumerate(reqs):
+                ids_in[i, maxp - len(p):] = p
+                mask_in[i, maxp - len(p):] = 1
+            seq, _ = self.model.generate(
+                jnp.asarray(ids_in), attention_mask=jnp.asarray(mask_in),
+                max_new_tokens=c.max_new_tokens, do_sample=True,
+                temperature=c.temperature, top_p=c.top_p,
+                seed=int(self.state.global_step * 9973),
+            )
+            seq = np.asarray(seq)
+            eos = self.model.config.eos_token_id
+            outs = []
+            for i in range(len(reqs)):
+                o = list(seq[i])
+                if eos is not None and eos in o:
+                    o = o[: o.index(eos) + 1]
+                outs.append(o)
 
         rows, labels = [], []
         for (p, _), o in zip(reqs, outs):
@@ -137,6 +253,9 @@ class PPOTrainer(Trainer):
 
     # ------------------------------------------------------------------ update
     def _ppo_update_impl(self, train_state, batch, old_logps, ref_logps, advantages):
+        """Token-level clipped-surrogate update (reference ppo_trainer.py loss):
+        ``advantages`` is [B,T-1] — GAE in value-model mode, the sequence-level
+        group-relative advantage broadcast over response tokens otherwise."""
         c = self.ppo_config
 
         def loss_fn(params):
@@ -145,14 +264,23 @@ class PPOTrainer(Trainer):
                                           deterministic=True)
             logits = out.logits if hasattr(out, "logits") else out[0]
             labels = batch["labels"][:, 1:]
-            logps = sequence_logps(logits, labels)
-            lengths = jnp.maximum((labels != -100).sum(-1), 1)
-            ratio = jnp.exp((logps - old_logps) / lengths)  # length-normalized ratio
+            logps, valid = token_logps(logits, labels)
+            denom = jnp.maximum(valid.sum(), 1)
+            ratio = jnp.exp(logps - old_logps)  # per-token ratios
             unclipped = ratio * advantages
             clipped = jnp.clip(ratio, 1 - c.clip_ratio, 1 + c.clip_ratio) * advantages
-            pg_loss = -jnp.minimum(unclipped, clipped).mean()
-            kl = ((logps - ref_logps) / lengths).mean()
-            return pg_loss + c.kl_coef * kl
+            pg_loss = -jnp.where(valid, jnp.minimum(unclipped, clipped), 0.0).sum() / denom
+            loss = pg_loss
+            if not c.use_value_model:
+                # KL penalty in the loss (GRPO formulation); in value-model mode
+                # the KL is already folded into the GAE rewards
+                kl = jnp.where(valid, logps - ref_logps, 0.0).sum() / denom
+                loss = loss + c.kl_coef * kl
+            if c.entropy_coef:
+                p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+                ent = -(p * jnp.log(jnp.clip(p, 1e-9))).sum(-1)
+                loss = loss - c.entropy_coef * jnp.where(valid, ent, 0.0).sum() / denom
+            return loss
 
         import optax
 
@@ -183,30 +311,60 @@ class PPOTrainer(Trainer):
             batch = self.rollout(prompts)
             rewards = self._score(batch["input_ids"], batch["labels"], batch["attention_mask"])
 
-            G = c.num_rollouts_per_prompt
-            grouped = rewards.reshape(-1, G)
-            # group-relative (GRPO) baseline
-            adv = (grouped - grouped.mean(-1, keepdims=True)).reshape(-1)
-            if c.normalize_advantages and adv.std() > 1e-6:
-                adv = adv / (adv.std() + 1e-6)
-
             # old/ref logps computed ONCE per rollout round (invariant across epochs)
             labels_dev = jnp.asarray(batch["labels"][:, 1:])
             ids_dev = jnp.asarray(batch["input_ids"][:, :-1])
             mask_dev = jnp.asarray(batch["attention_mask"][:, :-1])
             out = self.model.apply(self.train_state.params, input_ids=ids_dev, attention_mask=mask_dev)
-            old_logps = jax.lax.stop_gradient(sequence_logps(out.logits, labels_dev))
+            old_logps, valid = token_logps(out.logits, labels_dev)
+            old_logps = jax.lax.stop_gradient(old_logps)
             ref_out = self.model.apply(self.ref_params, input_ids=ids_dev, attention_mask=mask_dev)
-            ref_logps = jax.lax.stop_gradient(sequence_logps(ref_out.logits, labels_dev))
+            ref_logps = jax.lax.stop_gradient(token_logps(ref_out.logits, labels_dev)[0])
+
+            if c.use_value_model:
+                old_values = jax.lax.stop_gradient(
+                    self._value_forward(self.value_params, ids_dev, mask_dev))
+                # per-token rewards: KL penalty everywhere + terminal score at
+                # the LAST response token (reference reward shaping)
+                validf = valid.astype(jnp.float32)
+                rev_cum = jnp.cumsum(validf[:, ::-1], axis=1)[:, ::-1]
+                is_last = valid & (rev_cum == 1)
+                tok_rewards = -c.kl_coef * (old_logps - ref_logps) * validf
+                tok_rewards = tok_rewards + is_last * jnp.asarray(rewards)[:, None]
+                adv, returns = gae_advantages(tok_rewards, old_values * validf, validf,
+                                              c.gamma, c.gae_lambda)
+            else:
+                G = c.num_rollouts_per_prompt
+                grouped = rewards.reshape(-1, G)
+                # group-relative (GRPO) baseline, broadcast over response tokens
+                seq_adv = (grouped - grouped.mean(-1, keepdims=True)).reshape(-1)
+                adv = jnp.asarray(seq_adv)[:, None] * valid
+                returns = old_values = None
+
+            if c.normalize_advantages:
+                validf = valid.astype(jnp.float32)
+                n = jnp.maximum(validf.sum(), 1)
+                mean = (adv * validf).sum() / n
+                var = (jnp.square(adv - mean) * validf).sum() / n
+                adv = jnp.where(valid, (adv - mean) / jnp.sqrt(var + 1e-8), 0.0)
+
             dev_batch = {k: jnp.asarray(v) for k, v in batch.items()}
             for _ in range(c.ppo_epochs):
                 self.train_state, metrics = self._ppo_update(
-                    self.train_state, dev_batch, old_logps, ref_logps, jnp.asarray(adv)
+                    self.train_state, dev_batch, old_logps, ref_logps, adv
                 )
+                if c.use_value_model:
+                    self.value_params, self.value_opt_state, vloss = self._value_update(
+                        self.value_params, self.value_opt_state, dev_batch,
+                        old_values, returns, valid,
+                    )
             last_loss = float(metrics["loss"])
             self.state.global_step += 1
-            logger.info(f"ppo step {self.state.global_step}/{max_steps}: reward_mean={rewards.mean():.4f} "
-                        f"loss={last_loss:.4f}")
+            msg = (f"ppo step {self.state.global_step}/{max_steps}: "
+                   f"reward_mean={rewards.mean():.4f} loss={last_loss:.4f}")
+            if c.use_value_model:
+                msg += f" value_loss={float(vloss):.4f}"
+            logger.info(msg)
         self.model.params = self.train_state.params
         return TrainOutput(self.state.global_step, last_loss, {"reward_mean": float(rewards.mean())})
 
