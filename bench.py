@@ -34,7 +34,7 @@ PROBE_TIMEOUT_S = float(os.environ.get("PDNLP_BENCH_PROBE_TIMEOUT", 180))
 RUN_TIMEOUT_S = float(os.environ.get("PDNLP_BENCH_RUN_TIMEOUT", 1500))
 
 
-def _fail(reason: str) -> None:
+def _fail(reason: str, extra: dict | None = None) -> None:
     print(
         json.dumps(
             {
@@ -42,6 +42,7 @@ def _fail(reason: str) -> None:
                 "value": 0.0,
                 "unit": UNIT,
                 "vs_baseline": 0.0,
+                **(extra or {}),
                 "error": reason[:2000],
             }
         )
@@ -96,10 +97,15 @@ def run_bench(tiny: bool) -> None:
         # scan-stacked layers (the default) keep the HLO small: one traced layer
         # body regardless of depth — large unrolled compiles once wedged the
         # axon relay, scan avoids that class of failure entirely.
+        # recompute_granularity="full": the v5e-lite chip has 16 GB HBM and the
+        # scanned backward stashes at core_attn granularity (~20 × [24,B,T,·]
+        # bf16 buffers) blow past it; full remat saves only layer boundaries.
+        # MFU is still accounted on the useful 6N FLOPs, so remat overhead
+        # shows up as (honestly) lower reported MFU.
         config = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816, num_hidden_layers=24,
             num_attention_heads=16, num_key_value_heads=16, max_position_embeddings=4096,
-            recompute=True, recompute_granularity="core_attn",
+            recompute=True, recompute_granularity="full",
             use_flash_attention=use_flash,
         )
         batch, seq_len, steps = 8, 2048, 10
@@ -140,16 +146,18 @@ def run_bench(tiny: bool) -> None:
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq_len + 1)), dtype=jnp.int32)
 
-    # warmup / compile
+    # warmup / compile. NOTE: the axon relay's block_until_ready returns
+    # before execution completes (measured: 10 full steps "finished" in 10ms);
+    # only an actual value transfer (float()) is a reliable fence.
     mark("compiling train_step")
     params, opt_state, loss = train_step(params, opt_state, ids)
-    jax.block_until_ready(loss)
+    float(loss)
     mark("compiled; timing")
 
     t0 = time.time()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, ids)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.time() - t0
     mark(f"done dt={dt:.2f}s")
 
@@ -175,13 +183,14 @@ def run_bench(tiny: bool) -> None:
     print(json.dumps(result))
 
 
-def _spawn(argv: list[str], timeout: float) -> tuple[int, str, str]:
+def _spawn(argv: list[str], timeout: float, env: dict | None = None) -> tuple[int, str, str]:
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *argv],
             capture_output=True,
             text=True,
             timeout=timeout,
+            env={**os.environ, **(env or {})},
         )
         return proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
@@ -190,8 +199,29 @@ def _spawn(argv: list[str], timeout: float) -> tuple[int, str, str]:
         return -1, out, err + f"\n[timeout after {timeout}s]"
 
 
+def _json_line(out: str) -> str:
+    for candidate in reversed(out.strip().splitlines()):
+        if candidate.startswith("{"):
+            return candidate
+    return ""
+
+
+def _cpu_diag() -> float:
+    """Tiny CPU-path run: a trendable tokens/sec number for every round, even
+    when the TPU tunnel is wedged (VERDICT r2: two rounds logged no signal)."""
+    rc, out, _ = _spawn(["--run", "--tiny"], 600, env={"JAX_PLATFORMS": "cpu"})
+    line = _json_line(out)
+    if rc == 0 and line:
+        try:
+            return float(json.loads(line).get("tokens_per_second_per_chip", 0.0))
+        except (ValueError, KeyError):
+            return 0.0
+    return 0.0
+
+
 def main() -> None:
     tiny = "--tiny" in sys.argv
+    extra = {"cpu_tokens_per_sec": _cpu_diag()}
 
     # 1. backend probe, one retry with backoff
     for attempt in range(2):
@@ -202,21 +232,22 @@ def main() -> None:
             time.sleep(10)
     else:
         tail = "\n".join((out.strip().splitlines() + err.strip().splitlines())[-6:])
-        _fail(f"backend probe failed rc={rc}: {tail}")
+        _fail(f"backend probe failed rc={rc}: {tail}", extra)
 
     # 2. real benchmark
     argv = ["--run"] + (["--tiny"] if tiny else [])
     rc, out, err = _spawn(argv, RUN_TIMEOUT_S)
-    line = ""
-    for candidate in reversed(out.strip().splitlines()):
-        if candidate.startswith("{"):
-            line = candidate
-            break
+    line = _json_line(out)
     if rc == 0 and line:
-        print(line)
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            _fail(f"bench subprocess printed unparseable result line: {line[:500]}", extra)
+        rec.update(extra)
+        print(json.dumps(rec))
         return
     tail = "\n".join((out.strip().splitlines() + err.strip().splitlines())[-8:])
-    _fail(f"bench run failed rc={rc}: {tail}")
+    _fail(f"bench run failed rc={rc}: {tail}", extra)
 
 
 if __name__ == "__main__":

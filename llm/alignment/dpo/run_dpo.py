@@ -12,8 +12,9 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-import numpy as np
+from data_utils import ListDataset, load_preference_rows
 
 from paddlenlp_tpu.trainer import PdArgumentParser, TrainingArguments
 from paddlenlp_tpu.transformers import AutoConfig, AutoModelForCausalLM, AutoTokenizer, LlmMetaConfig
@@ -40,42 +41,6 @@ class DPOArguments:
     sft_loss_ratio: float = 0.0
 
 
-def load_preference_dataset(path: str, tokenizer, dpo_args: DPOArguments):
-    rows = []
-    max_len = dpo_args.max_length
-    with open(path) as f:
-        for line in f:
-            if not line.strip():
-                continue
-            r = json.loads(line)
-            prompt = tokenizer.encode(str(r["src"]))[: dpo_args.max_prompt_length]
-            eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
-
-            def build(resp):
-                resp_ids = (tokenizer.encode(str(resp)) + eos)[: max_len - len(prompt)]
-                ids = np.asarray(prompt + resp_ids, dtype=np.int32)
-                labels = np.asarray([-100] * len(prompt) + resp_ids, dtype=np.int32)
-                pad = max_len - len(ids)
-                return (np.pad(ids, (0, pad)), np.pad(labels, (0, pad), constant_values=-100))
-
-            ci, cl = build(r["chosen"])
-            ri, rl = build(r["rejected"])
-            rows.append({"chosen_input_ids": ci, "chosen_labels": cl,
-                         "rejected_input_ids": ri, "rejected_labels": rl})
-    return rows
-
-
-class ListDataset:
-    def __init__(self, rows):
-        self.rows = rows
-
-    def __len__(self):
-        return len(self.rows)
-
-    def __getitem__(self, i):
-        return self.rows[i]
-
-
 def main():
     parser = PdArgumentParser((ModelArguments, DPOArguments, TrainingArguments))
     model_args, dpo_args, training_args = parser.parse_args_into_dataclasses()
@@ -92,8 +57,9 @@ def main():
             model_args.ref_model_name_or_path, dtype=model_args.dtype, param_dtype="float32"
         )
 
-    rows = load_preference_dataset(
-        os.path.join(dpo_args.dataset_name_or_path, "train.json"), tokenizer, dpo_args
+    rows = load_preference_rows(
+        os.path.join(dpo_args.dataset_name_or_path, "train.json"), tokenizer,
+        dpo_args.max_length, dpo_args.max_prompt_length, mode="dpo",
     )
     criterion = DPOCriterion(
         beta=dpo_args.beta,

@@ -14,8 +14,11 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import numpy as np
+
+from data_utils import ListDataset
 
 from paddlenlp_tpu.trainer import PdArgumentParser, TrainingArguments
 from paddlenlp_tpu.transformers import AutoConfig, AutoModelForCausalLM, AutoTokenizer, LlmMetaConfig
@@ -63,17 +66,6 @@ def load_prompt_dataset(path: str, tokenizer, ppo_args: PPOArguments):
             ids = tokenizer.encode(str(r["src"]))[: ppo_args.max_prompt_length]
             rows.append({"input_ids": np.asarray(ids, np.int32)})
     return rows
-
-
-class ListDataset:
-    def __init__(self, rows):
-        self.rows = rows
-
-    def __len__(self):
-        return len(self.rows)
-
-    def __getitem__(self, i):
-        return self.rows[i]
 
 
 def main():

@@ -14,8 +14,9 @@ from dataclasses import dataclass
 from typing import Optional
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-import numpy as np
+from data_utils import ListDataset, load_preference_rows
 
 from paddlenlp_tpu.trainer import PdArgumentParser, TrainingArguments
 from paddlenlp_tpu.transformers import AutoConfig, AutoTokenizer, LlmMetaConfig
@@ -37,42 +38,6 @@ class RMArguments:
     max_prompt_length: int = 512
 
 
-def load_pairwise_dataset(path: str, tokenizer, rm_args: RMArguments):
-    rows = []
-    max_len = rm_args.max_length
-    with open(path) as f:
-        for line in f:
-            if not line.strip():
-                continue
-            r = json.loads(line)
-            prompt = tokenizer.encode(str(r["src"]))[: rm_args.max_prompt_length]
-            eos = [tokenizer.eos_token_id] if tokenizer.eos_token_id is not None else []
-
-            def build(resp):
-                resp_ids = (tokenizer.encode(str(resp)) + eos)[: max_len - len(prompt)]
-                ids = np.asarray(prompt + resp_ids, dtype=np.int32)
-                pad = max_len - len(ids)
-                mask = np.concatenate([np.ones(len(ids), np.int32), np.zeros(pad, np.int32)])
-                return np.pad(ids, (0, pad)), mask
-
-            ci, cm = build(r["chosen"])
-            ri, rm_ = build(r["rejected"])
-            rows.append({"chosen_input_ids": ci, "chosen_attention_mask": cm,
-                         "rejected_input_ids": ri, "rejected_attention_mask": rm_})
-    return rows
-
-
-class ListDataset:
-    def __init__(self, rows):
-        self.rows = rows
-
-    def __len__(self):
-        return len(self.rows)
-
-    def __getitem__(self, i):
-        return self.rows[i]
-
-
 def main():
     parser = PdArgumentParser((ModelArguments, RMArguments, TrainingArguments))
     model_args, rm_args, training_args = parser.parse_args_into_dataclasses()
@@ -84,8 +49,9 @@ def main():
     model = AutoModelForSequenceClassification.from_pretrained(
         model_args.model_name_or_path, config=config, dtype=model_args.dtype, param_dtype="float32"
     )
-    rows = load_pairwise_dataset(
-        os.path.join(rm_args.dataset_name_or_path, "train.json"), tokenizer, rm_args
+    rows = load_preference_rows(
+        os.path.join(rm_args.dataset_name_or_path, "train.json"), tokenizer,
+        rm_args.max_length, rm_args.max_prompt_length, mode="rm",
     )
     trainer = RewardTrainer(
         model=model,

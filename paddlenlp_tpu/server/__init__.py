@@ -1,0 +1,5 @@
+from .handlers import ClsPostHandler, CustomModelHandler, TaskflowHandler, TokenClsModelHandler
+from .server import SimpleServer
+
+__all__ = ["SimpleServer", "CustomModelHandler", "ClsPostHandler", "TokenClsModelHandler",
+           "TaskflowHandler"]

@@ -1,0 +1,87 @@
+"""Request handlers for SimpleServer.
+
+Counterpart of ``paddlenlp/server/handlers/`` (BaseModelHandler /
+CustomModelHandler / ClsPostHandler / TokenClsModelHandler / TaskflowHandler):
+``process`` classmethods that turn a JSON request body into model/taskflow
+calls. Requests follow the reference wire format::
+
+    POST /models/<name>   {"data": {"text": [...]}, "parameters": {...}}
+    POST /taskflow/<name> {"data": {"text": [...]}, "parameters": {...}}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["CustomModelHandler", "ClsPostHandler", "TokenClsModelHandler", "TaskflowHandler"]
+
+
+class CustomModelHandler:
+    """Generic encoder forward: tokenize data["text"] (optionally paired with
+    data["text_pair"]), run the model, return logits row-lists."""
+
+    @classmethod
+    def process(cls, model, tokenizer, data: Optional[Dict[str, Any]],
+                parameters: Dict[str, Any]):
+        import jax.numpy as jnp
+
+        if not data or "text" not in data:
+            return {}
+        texts = data["text"]
+        if isinstance(texts, str):
+            texts = [texts]
+        pairs = data.get("text_pair")
+        if isinstance(pairs, str):
+            pairs = [pairs]
+        max_seq_len = int(parameters.get("max_seq_len", 512))
+        enc = tokenizer(texts, text_pair=pairs, padding=True, truncation=True,
+                        max_length=max_seq_len)
+        out = model(input_ids=jnp.asarray(enc["input_ids"], jnp.int32),
+                    attention_mask=jnp.asarray(enc["attention_mask"], jnp.int32))
+        logits = np.asarray(out.logits if hasattr(out, "logits") else out[0], np.float32)
+        return {"logits": logits.tolist()}
+
+
+class ClsPostHandler:
+    """argmax over sequence-level logits -> label (id2label from parameters
+    or the model config)."""
+
+    @classmethod
+    def process(cls, output: Dict[str, Any], parameters: Dict[str, Any], model=None):
+        if "logits" not in output:
+            return output
+        logits = np.asarray(output["logits"], np.float32)
+        pred = logits.argmax(-1)
+        id2label = parameters.get("id2label") or getattr(getattr(model, "config", None), "id2label", None)
+        labels: List[Any] = [
+            (id2label.get(str(int(p))) or id2label.get(int(p)) or int(p)) if id2label else int(p)
+            for p in pred
+        ]
+        return {"label": labels, "logits": output["logits"]}
+
+
+class TokenClsModelHandler(CustomModelHandler):
+    """Token-level logits (the reference's token_model_handler): returns the
+    per-token argmax alongside the logits."""
+
+    @classmethod
+    def process(cls, model, tokenizer, data, parameters):
+        out = super().process(model, tokenizer, data, parameters)
+        if "logits" in out:
+            out["token_label_ids"] = np.asarray(out["logits"]).argmax(-1).tolist()
+        return out
+
+
+class TaskflowHandler:
+    """data["text"] through the taskflow; parameters["schema"] re-targets UIE."""
+
+    @classmethod
+    def process(cls, task, data: Optional[Dict[str, Any]], parameters: Dict[str, Any]):
+        if not data or "text" not in data:
+            return {}
+        if "schema" in parameters and hasattr(task.task, "set_schema"):
+            task.task.set_schema(parameters["schema"])
+        kwargs = {k: v for k, v in parameters.items() if k != "schema"}
+        return task(data["text"], **kwargs)

@@ -1,0 +1,120 @@
+"""SimpleServer: lightweight REST serving of models and taskflows.
+
+Counterpart of ``paddlenlp/server/server.py`` (``SimpleServer`` :23,
+``register`` :35, ``register_taskflow`` :55) + its HttpRouter/Model/Taskflow
+managers — collapsed onto the stdlib ``ThreadingHTTPServer`` (the framework
+has no FastAPI dependency; the LLM SSE server in ``llm/predict/flask_server.py``
+uses the same base). Routes mirror the reference::
+
+    POST /models/<task_name>    — registered model + tokenizer + handlers
+    POST /taskflow/<task_name>  — registered Taskflow
+    GET  /health                — liveness
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.log import logger
+from .handlers import ClsPostHandler, CustomModelHandler, TaskflowHandler
+
+__all__ = ["SimpleServer"]
+
+
+class SimpleServer:
+    def __init__(self):
+        self._routes: Dict[str, Callable[[Any, Dict[str, Any]], Any]] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------------ register
+    def register(self, task_name: str, model_path: str, tokenizer_name: Optional[str] = None,
+                 model_handler=None, post_handler=None, model=None, tokenizer=None):
+        """Serve a transformers model at POST /models/<task_name>.
+
+        ``model``/``tokenizer`` instances may be passed directly (tests);
+        otherwise they load from ``model_path`` via the Auto classes.
+        """
+        from ..transformers import AutoTokenizer
+        from ..transformers.auto.modeling import AutoModelForSequenceClassification
+
+        model_handler = model_handler or CustomModelHandler
+        post_handler = post_handler or ClsPostHandler
+        if model is None:
+            model = AutoModelForSequenceClassification.from_pretrained(model_path)
+        if tokenizer is None:
+            tokenizer = AutoTokenizer.from_pretrained(tokenizer_name or model_path)
+
+        def route(data, parameters):
+            out = model_handler.process(model, tokenizer, data, parameters)
+            return post_handler.process(out, parameters, model=model)
+
+        self._routes[f"/models/{task_name}"] = route
+
+    def register_taskflow(self, task_name: str, task, taskflow_handler=None):
+        """Serve one or more Taskflow instances at POST /taskflow/<task_name>."""
+        handler = taskflow_handler or TaskflowHandler
+        tasks = task if isinstance(task, (list, tuple)) else [task]
+
+        def route(data, parameters):
+            results = [handler.process(t, data, parameters) for t in tasks]
+            return results[0] if len(results) == 1 else results
+
+        self._routes[f"/taskflow/{task_name}"] = route
+
+    # ------------------------------------------------------------------ serve
+    def _make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
+        routes = self._routes
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("server: " + fmt % args)
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "ok", "routes": sorted(routes)})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                fn = routes.get(self.path)
+                if fn is None:
+                    self._send(404, {"error": f"no route {self.path}", "routes": sorted(routes)})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    result = fn(body.get("data"), body.get("parameters") or {})
+                    self._send(200, {"result": result})
+                except Exception as e:  # surfaced to the client, not swallowed
+                    logger.warning(f"server error on {self.path}: {e}")
+                    self._send(500, {"error": str(e)})
+
+        return ThreadingHTTPServer((host, port), Handler)
+
+    def run(self, host: str = "0.0.0.0", port: int = 8189):
+        self._httpd = self._make_httpd(host, port)
+        logger.info(f"SimpleServer on {host}:{port} routes={sorted(self._routes)}")
+        self._httpd.serve_forever()
+
+    def start_in_thread(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Non-blocking start (tests); returns the bound port."""
+        self._httpd = self._make_httpd(host, port)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self._httpd.server_address[1]
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -35,12 +35,14 @@ def _populate():
     register_task("text_similarity", TextSimilarityTask)
 
     from .fill_mask import FillMaskTask
+    from .information_extraction import UIETask
     from .question_answering import QuestionAnsweringTask, SummarizationTask
 
     register_task("fill_mask", FillMaskTask)
     register_task("question_answering", QuestionAnsweringTask)
     register_task("text_summarization", SummarizationTask)
     register_task("chat", TextGenerationTask)
+    register_task("information_extraction", UIETask)
 
 
 class Taskflow:

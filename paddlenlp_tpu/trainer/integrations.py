@@ -15,7 +15,7 @@ from ..utils.import_utils import is_package_available
 from ..utils.log import logger
 from .trainer_callback import TrainerCallback
 
-__all__ = ["JsonlLoggerCallback", "TensorBoardCallback", "get_reporting_callbacks"]
+__all__ = ["JsonlLoggerCallback", "TensorBoardCallback", "WandbCallback", "get_reporting_callbacks"]
 
 
 class JsonlLoggerCallback(TrainerCallback):
@@ -82,6 +82,50 @@ class TensorBoardCallback(TrainerCallback):
             self._writer = None
 
 
+class WandbCallback(TrainerCallback):
+    """Weights & Biases reporter (reference integrations.py WandbCallback).
+    Run config from TrainingArguments; project/name via WANDB_PROJECT/WANDB_NAME
+    env vars (the wandb convention). No-op with a one-time warning when the
+    wandb package is absent."""
+
+    def __init__(self):
+        self._run = None
+        self._unavailable = False
+
+    def _ensure(self, args):
+        if self._run is not None or self._unavailable:
+            return self._run
+        if not is_package_available("wandb"):
+            logger.warning_once("report_to=wandb but the wandb package is not installed; skipping")
+            self._unavailable = True
+            return None
+        import wandb
+
+        self._run = wandb.init(
+            project=os.environ.get("WANDB_PROJECT", "paddlenlp_tpu"),
+            name=os.environ.get("WANDB_NAME") or None,
+            dir=args.output_dir,
+            config={k: v for k, v in vars(args).items()
+                    if isinstance(v, (int, float, str, bool, type(None)))},
+            resume="allow",
+        )
+        return self._run
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if logs is None or not state.is_world_process_zero:
+            return
+        run = self._ensure(args)
+        if run is None:
+            return
+        run.log({k: v for k, v in logs.items() if isinstance(v, (int, float))},
+                step=state.global_step)
+
+    def on_train_end(self, args, state, control, **kwargs):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
 def get_reporting_callbacks(report_to) -> list:
     """Map TrainingArguments.report_to names to callback instances."""
     if not report_to:
@@ -94,6 +138,8 @@ def get_reporting_callbacks(report_to) -> list:
             out.append(JsonlLoggerCallback())
         if name in ("tensorboard", "visualdl", "all"):
             out.append(TensorBoardCallback())
+        if name in ("wandb", "all"):
+            out.append(WandbCallback())
         if name == "none":
             continue
     return out

@@ -616,6 +616,12 @@ class Trainer:
                     self.state.global_step += 1
                     self.state.epoch = self.state.global_step / steps_per_epoch
                     self.state.consumed_samples += args.global_train_batch_size
+                    if args.profiler_options:
+                        # jax.profiler trace over the configured step window
+                        # (reference utils/profiler.py:88 add_profiler_step)
+                        from ..utils.profiler import add_profiler_step
+
+                        add_profiler_step(args.profiler_options, self.state.global_step)
                     if "input_ids" in host_batch:
                         tokens_seen += int(np.prod(np.asarray(host_batch["input_ids"]).shape))
                     self.control = self.callback_handler.on_step_end(args, self.state, self.control)

@@ -85,6 +85,10 @@ class TrainingArguments:
 
     run_name: Optional[str] = None
     report_to: Optional[List[str]] = None
+    profiler_options: Optional[str] = field(
+        default=None,
+        metadata={"help": 'jax.profiler trace window, e.g. "batch_range=[10,20];profile_path=./prof" '
+                          "(reference utils/profiler.py ProfilerOptions)"})
     disable_tqdm: bool = False
 
     # ---- parallelism (reference degrees, training_args.py:539-705) ----

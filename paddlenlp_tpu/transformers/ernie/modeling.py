@@ -19,7 +19,7 @@ from ..bert.modeling import (
 from .configuration import ErnieConfig
 
 __all__ = ["ErnieModel", "ErnieForMaskedLM", "ErnieForSequenceClassification",
-           "ErnieForTokenClassification", "ErniePretrainedModel"]
+           "ErnieForTokenClassification", "ErniePretrainedModel", "UIE"]
 
 
 class ErniePretrainedModel(BertPretrainedModel):
@@ -58,4 +58,32 @@ class ErnieForSequenceClassification(ErniePretrainedModel):
 class ErnieForTokenClassification(ErniePretrainedModel):
     module_class = BertForTokenClassificationModule
     _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"cls\.", r"pooler", r"position_ids"]
+
+
+class UIEModule(nn.Module):
+    """ERNIE backbone + start/end pointer heads for Universal Information
+    Extraction (reference: paddlenlp/transformers/ernie/modeling.py:1222 ``UIE``
+    — linear_start/linear_end + sigmoid over every position)."""
+
+    config: ErnieConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        h = BertModule(self.config, self.dtype, self.param_dtype, add_pooling_layer=False,
+                       name="bert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        ).last_hidden_state
+        dense = lambda name: nn.Dense(1, dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+        start_prob = nn.sigmoid(dense("linear_start")(h).astype(jnp.float32))[..., 0]
+        end_prob = nn.sigmoid(dense("linear_end")(h).astype(jnp.float32))[..., 0]
+        return start_prob, end_prob
+
+
+class UIE(ErniePretrainedModel):
+    module_class = UIEModule
+    _keys_to_ignore_on_load_missing = [r"linear_start", r"linear_end"]
     _keys_to_ignore_on_load_unexpected = [r"cls\.", r"pooler", r"position_ids"]

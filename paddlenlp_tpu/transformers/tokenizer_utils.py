@@ -248,6 +248,7 @@ class PretrainedTokenizer(ChatTemplateMixin):
         add_special_tokens: bool = True,
         return_attention_mask: bool = True,
         return_token_type_ids: bool = False,
+        return_offsets_mapping: bool = False,
         padding_side: Optional[str] = None,
         return_tensors: Optional[str] = None,
         **kwargs,
@@ -265,6 +266,7 @@ class PretrainedTokenizer(ChatTemplateMixin):
         encodings = self._tokenizer.encode_batch(inputs, add_special_tokens=add_special_tokens)
         ids = [e.ids for e in encodings]
         type_ids = [e.type_ids for e in encodings]
+        offsets = [list(e.offsets) for e in encodings] if return_offsets_mapping else None
         masks = [[1] * len(i) for i in ids]
 
         if padding:
@@ -281,16 +283,22 @@ class PretrainedTokenizer(ChatTemplateMixin):
                         ids[k] = [pad_id] * deficit + ids[k]
                         masks[k] = [0] * deficit + masks[k]
                         type_ids[k] = [0] * deficit + type_ids[k]
+                        if offsets is not None:
+                            offsets[k] = [(0, 0)] * deficit + offsets[k]
                     else:
                         ids[k] = ids[k] + [pad_id] * deficit
                         masks[k] = masks[k] + [0] * deficit
                         type_ids[k] = type_ids[k] + [0] * deficit
+                        if offsets is not None:
+                            offsets[k] = offsets[k] + [(0, 0)] * deficit
 
         out = {"input_ids": ids}
         if return_attention_mask:
             out["attention_mask"] = masks
         if return_token_type_ids:
             out["token_type_ids"] = type_ids
+        if return_offsets_mapping:
+            out["offset_mapping"] = offsets
         if single and return_tensors is None:
             out = {k: v[0] for k, v in out.items()}
         enc = BatchEncoding(out)

@@ -216,7 +216,7 @@ class PPOTrainer(Trainer):
             seq, _ = self.model.generate(
                 jnp.asarray(ids_in), attention_mask=jnp.asarray(mask_in),
                 max_new_tokens=c.max_new_tokens, do_sample=True,
-                temperature=c.temperature, top_p=c.top_p,
+                temperature=c.temperature, top_p=c.top_p, top_k=0,
                 seed=int(self.state.global_step * 9973),
             )
             seq = np.asarray(seq)

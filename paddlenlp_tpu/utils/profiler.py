@@ -1,0 +1,96 @@
+"""Step-windowed device profiling.
+
+Counterpart of ``paddlenlp/utils/profiler.py`` (``ProfilerOptions`` :28,
+``add_profiler_step`` :88 — timeline export controlled by the
+``--profiler_options`` launch flag). TPU-native: the window drives
+``jax.profiler.start_trace``/``stop_trace``, producing an XPlane/TensorBoard
+trace of the XLA device timeline.
+
+Options string: ``key=value`` pairs separated by ``;``, e.g.
+``batch_range=[10,20];profile_path=./profile_out`` — the trace covers steps
+[start, end) of ``batch_range``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from .log import logger
+
+__all__ = ["ProfilerOptions", "ProfilerStepper", "add_profiler_step"]
+
+
+@dataclasses.dataclass
+class ProfilerOptions:
+    batch_range: Tuple[int, int] = (10, 12)
+    profile_path: str = "profile_out"
+
+    @classmethod
+    def parse(cls, options: str) -> "ProfilerOptions":
+        out = cls()
+        for item in (options or "").split(";"):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(f"profiler option {item!r} is not key=value")
+            k, v = (s.strip() for s in item.split("=", 1))
+            if k == "batch_range":
+                nums = [int(x) for x in v.strip("[]() ").replace(",", " ").split()]
+                if len(nums) != 2 or nums[0] < 0 or nums[1] <= nums[0]:
+                    raise ValueError(f"batch_range must be [start, end) with end>start>=0, got {v!r}")
+                out.batch_range = (nums[0], nums[1])
+            elif k == "profile_path":
+                out.profile_path = v
+            else:
+                logger.warning(f"ignoring unknown profiler option {k!r}")
+        return out
+
+
+class ProfilerStepper:
+    """Call ``step(global_step)`` once per train step; traces the configured
+    window exactly once."""
+
+    def __init__(self, options: ProfilerOptions):
+        self.options = options
+        self._active = False
+        self._done = False
+
+    def step(self, global_step: int):
+        import jax
+
+        start, end = self.options.batch_range
+        if self._done:
+            return
+        if not self._active and global_step >= start and global_step < end:
+            jax.profiler.start_trace(self.options.profile_path)
+            self._active = True
+            logger.info(f"profiler: tracing steps [{global_step}, {end}) -> {self.options.profile_path}")
+        elif self._active and global_step >= end:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            logger.info(f"profiler: trace written to {self.options.profile_path}")
+
+    def close(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+
+_GLOBAL: Optional[ProfilerStepper] = None
+
+
+def add_profiler_step(options: Optional[str], global_step: int):
+    """Stateless entry mirroring the reference's add_profiler_step: feed the
+    step counter; start/stop happen at the window edges."""
+    global _GLOBAL
+    if not options:
+        return
+    if _GLOBAL is None:
+        _GLOBAL = ProfilerStepper(ProfilerOptions.parse(options))
+    _GLOBAL.step(global_step)

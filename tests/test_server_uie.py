@@ -1,0 +1,152 @@
+"""UIE information-extraction taskflow + SimpleServer REST round-trips
+(reference: paddlenlp/taskflow/information_extraction.py, paddlenlp/server/)."""
+
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def uie_dir(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from paddlenlp_tpu.transformers import PretrainedTokenizer
+    from paddlenlp_tpu.transformers.ernie.configuration import ErnieConfig
+    from paddlenlp_tpu.transformers.ernie.modeling import UIE
+
+    root = tmp_path_factory.mktemp("uie")
+    vocab = {"<pad>": 0, "<unk>": 1}
+    for i, w in enumerate("alice works at acme corp person company of the".split()):
+        vocab[w] = i + 2
+    t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    t.pre_tokenizer = Whitespace()
+    PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", unk_token="<unk>").save_pretrained(str(root))
+    cfg = ErnieConfig(vocab_size=16, hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+                      intermediate_size=64, max_position_embeddings=64)
+    UIE.from_config(cfg, seed=0).save_pretrained(str(root))
+    return str(root)
+
+
+def _force_heads(task_model, fire: bool):
+    """Pin the pointer heads: kernel=0, bias=+/-10 -> prob ~ 1 or ~ 0."""
+    b = 10.0 if fire else -10.0
+    p = dict(task_model.params)
+    for head in ("linear_start", "linear_end"):
+        h = dict(p[head])
+        h["kernel"] = jnp.zeros_like(h["kernel"])
+        h["bias"] = jnp.full_like(h["bias"], b)
+        p[head] = h
+    task_model.params = p
+
+
+class TestUIETask:
+    def test_all_fire_extracts_every_text_token(self, uie_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("information_extraction", task_path=uie_dir, schema="person")
+        _force_heads(flow.task._model, fire=True)
+        text = "alice works at acme"
+        out = flow(text)
+        assert set(out) == {"person"}
+        spans = out["person"]
+        # every TEXT token (never the prompt) extracted as a single-token span
+        assert [s["text"] for s in spans] == text.split()
+        for s in spans:
+            assert text[s["start"]:s["end"]] == s["text"]
+            assert 0.99 < s["probability"] <= 1.0
+
+    def test_no_fire_returns_empty(self, uie_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("information_extraction", task_path=uie_dir, schema=["person", "company"])
+        _force_heads(flow.task._model, fire=False)
+        out = flow(["alice works at acme", "acme corp"])
+        assert out == [{}, {}]
+
+    def test_nested_schema_attaches_relations(self, uie_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("information_extraction", task_path=uie_dir,
+                        schema={"person": ["company"]})
+        _force_heads(flow.task._model, fire=True)
+        out = flow("alice works")
+        assert "person" in out
+        for span in out["person"]:
+            assert "relations" in span
+            assert "company" in span["relations"]
+            assert all(r["text"] for r in span["relations"]["company"])
+
+    def test_schema_required(self, uie_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("information_extraction", task_path=uie_dir)
+        with pytest.raises(ValueError, match="schema"):
+            flow("alice")
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+class TestSimpleServer:
+    def test_taskflow_and_model_routes(self, uie_dir, tmp_path):
+        from tokenizers import Tokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        from paddlenlp_tpu.server import SimpleServer
+        from paddlenlp_tpu.taskflow import Taskflow
+        from paddlenlp_tpu.transformers import BertConfig, BertForSequenceClassification, PretrainedTokenizer
+
+        flow = Taskflow("information_extraction", task_path=uie_dir, schema="person")
+        _force_heads(flow.task._model, fire=True)
+
+        cls_dir = tmp_path / "cls"
+        vocab = {"<pad>": 0, "<unk>": 1, "good": 2, "bad": 3}
+        t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        tok = PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", unk_token="<unk>")
+        cfg = BertConfig(vocab_size=8, hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+                         intermediate_size=64, max_position_embeddings=32, num_labels=2,
+                         id2label={"0": "negative", "1": "positive"})
+        cls_model = BertForSequenceClassification.from_config(cfg, seed=0)
+
+        server = SimpleServer()
+        server.register_taskflow("uie", flow)
+        server.register("cls", model_path=str(cls_dir), model=cls_model, tokenizer=tok)
+        port = server.start_in_thread()
+        try:
+            # health
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/health") as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            assert "/taskflow/uie" in health["routes"] and "/models/cls" in health["routes"]
+
+            # taskflow route, schema re-target via parameters
+            out = _post(port, "/taskflow/uie",
+                        {"data": {"text": "alice works"}, "parameters": {"schema": "company"}})
+            assert "company" in out["result"]
+
+            # model route with labels
+            out = _post(port, "/models/cls", {"data": {"text": ["good good", "bad bad"]}})
+            res = out["result"]
+            assert len(res["label"]) == 2
+            assert all(l in ("negative", "positive") for l in res["label"])
+            assert np.asarray(res["logits"]).shape == (2, 2)
+
+            # unknown route -> 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, "/models/nope", {})
+            assert e.value.code == 404
+        finally:
+            server.shutdown()

@@ -339,3 +339,38 @@ class TestIntegrations:
         assert os.path.isfile(path)
         rows = [json.loads(l) for l in open(path)]
         assert len(rows) == 2 and all("loss" in r and "step" in r for r in rows)
+
+    def test_wandb_absent_is_graceful(self, tmp_path):
+        """report_to=wandb without the package must warn once and train fine."""
+        args = make_args(tmp_path, max_steps=2, logging_steps=1)
+        args.report_to = ["wandb"]
+        t = Trainer(model=tiny_model(), args=args, train_dataset=ToyLMDataset())
+        out = t.train()
+        assert np.isfinite(out.training_loss)
+
+    def test_profiler_options_writes_trace(self, tmp_path):
+        """--profiler_options drives jax.profiler over the step window
+        (reference utils/profiler.py add_profiler_step)."""
+        import paddlenlp_tpu.utils.profiler as prof
+
+        prof._GLOBAL = None  # isolate from other tests
+        trace_dir = str(tmp_path / "trace")
+        args = make_args(tmp_path, max_steps=4)
+        args.profiler_options = f"batch_range=[1,3];profile_path={trace_dir}"
+        t = Trainer(model=tiny_model(), args=args, train_dataset=ToyLMDataset())
+        t.train()
+        # jax writes <dir>/plugins/profile/<ts>/*.xplane.pb
+        hits = []
+        for root, _, files in os.walk(trace_dir):
+            hits += [f for f in files if f.endswith(".xplane.pb")]
+        assert hits, f"no xplane trace under {trace_dir}"
+
+    def test_profiler_options_parse_errors(self):
+        from paddlenlp_tpu.utils.profiler import ProfilerOptions
+
+        with pytest.raises(ValueError, match="key=value"):
+            ProfilerOptions.parse("batch_range")
+        with pytest.raises(ValueError, match="batch_range"):
+            ProfilerOptions.parse("batch_range=[5,2]")
+        opts = ProfilerOptions.parse("batch_range=[1, 3];profile_path=/x/y")
+        assert opts.batch_range == (1, 3) and opts.profile_path == "/x/y"
