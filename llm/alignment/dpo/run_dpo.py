@@ -61,6 +61,15 @@ def main():
         os.path.join(dpo_args.dataset_name_or_path, "train.json"), tokenizer,
         dpo_args.max_length, dpo_args.max_prompt_length, mode="dpo",
     )
+    eval_dataset = None
+    dev_path = os.path.join(dpo_args.dataset_name_or_path, "dev.json")
+    if os.path.isfile(dev_path):
+        eval_dataset = ListDataset(load_preference_rows(
+            dev_path, tokenizer, dpo_args.max_length, dpo_args.max_prompt_length, mode="dpo"))
+    elif training_args.do_eval or training_args.evaluation_strategy != "no":
+        logger.warning(f"no dev.json under {dpo_args.dataset_name_or_path}; disabling evaluation")
+        training_args.do_eval = False
+        training_args.evaluation_strategy = "no"
     criterion = DPOCriterion(
         beta=dpo_args.beta,
         loss_type=dpo_args.loss_type,
@@ -74,6 +83,7 @@ def main():
         dpo_criterion=criterion,
         args=training_args,
         train_dataset=ListDataset(rows),
+        eval_dataset=eval_dataset,
         tokenizer=tokenizer,
     )
     if training_args.do_train:

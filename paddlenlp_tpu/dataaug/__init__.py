@@ -1,0 +1,3 @@
+from .word import WordDelete, WordInsert, WordSubstitute, WordSwap
+
+__all__ = ["WordSubstitute", "WordInsert", "WordSwap", "WordDelete"]

@@ -1,0 +1,118 @@
+"""Word-level data augmentation.
+
+Counterpart of ``paddlenlp/dataaug/word.py`` (``WordSubstitute`` :29,
+``WordInsert`` :313, ``WordSwap`` :516, ``WordDelete`` :582). Zero-egress
+build: substitution/insertion draw from a user-supplied synonym table (the
+reference's embedding/WordNet sources are download-backed); swap/delete are
+source-free. All augmenters are seeded and deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["WordSubstitute", "WordInsert", "WordSwap", "WordDelete"]
+
+
+class BaseAugment:
+    def __init__(self, create_n: int = 1, aug_n: Optional[int] = None,
+                 aug_percent: float = 0.1, seed: int = 0):
+        self.create_n = create_n
+        self.aug_n = aug_n
+        self.aug_percent = aug_percent
+        self.rng = np.random.default_rng(seed)
+
+    def _tokenize(self, text: str) -> List[str]:
+        return text.split()
+
+    def _n_for(self, tokens: List[str]) -> int:
+        if self.aug_n is not None:
+            return min(self.aug_n, max(len(tokens), 1))
+        return max(1, int(len(tokens) * self.aug_percent))
+
+    def _augment_once(self, tokens: List[str]) -> Optional[List[str]]:
+        raise NotImplementedError
+
+    def augment(self, text):
+        """str -> List[str] of create_n variants; List[str] -> list per input."""
+        if isinstance(text, list):
+            return [self.augment(t) for t in text]
+        tokens = self._tokenize(text)
+        out = []
+        for _ in range(self.create_n * 4):  # retry budget for degenerate inputs
+            if len(out) >= self.create_n:
+                break
+            aug = self._augment_once(list(tokens))
+            if aug is not None:
+                cand = " ".join(aug)
+                if cand != text and cand not in out:
+                    out.append(cand)
+        return out
+
+    def __call__(self, text):
+        return self.augment(text)
+
+
+class WordSubstitute(BaseAugment):
+    """Replace words using a synonym table {"word": ["syn1", ...]}."""
+
+    def __init__(self, aug_type: str = "custom", custom_file_or_dict=None, **kw):
+        super().__init__(**kw)
+        if isinstance(custom_file_or_dict, dict):
+            self.table: Dict[str, List[str]] = custom_file_or_dict
+        elif isinstance(custom_file_or_dict, str):
+            import json
+
+            with open(custom_file_or_dict, encoding="utf-8") as f:
+                self.table = json.load(f)
+        else:
+            raise ValueError("WordSubstitute needs a synonym dict or a json file path "
+                             "(this build has no download-backed synonym sources)")
+
+    def _augment_once(self, tokens):
+        cands = [i for i, t in enumerate(tokens) if t in self.table and self.table[t]]
+        if not cands:
+            return None
+        n = min(self._n_for(tokens), len(cands))
+        for i in self.rng.choice(cands, size=n, replace=False):
+            tokens[i] = str(self.rng.choice(self.table[tokens[i]]))
+        return tokens
+
+
+class WordInsert(WordSubstitute):
+    """Insert a synonym next to a known word."""
+
+    def _augment_once(self, tokens):
+        cands = [i for i, t in enumerate(tokens) if t in self.table and self.table[t]]
+        if not cands:
+            return None
+        n = min(self._n_for(tokens), len(cands))
+        for i in sorted(self.rng.choice(cands, size=n, replace=False), reverse=True):
+            tokens.insert(i + 1, str(self.rng.choice(self.table[tokens[i]])))
+        return tokens
+
+
+class WordSwap(BaseAugment):
+    """Swap adjacent word pairs."""
+
+    def _augment_once(self, tokens):
+        if len(tokens) < 2:
+            return None
+        n = self._n_for(tokens)
+        for _ in range(n):
+            i = int(self.rng.integers(0, len(tokens) - 1))
+            tokens[i], tokens[i + 1] = tokens[i + 1], tokens[i]
+        return tokens
+
+
+class WordDelete(BaseAugment):
+    """Delete random words."""
+
+    def _augment_once(self, tokens):
+        if len(tokens) < 2:
+            return None
+        n = min(self._n_for(tokens), len(tokens) - 1)
+        drop = set(self.rng.choice(len(tokens), size=n, replace=False).tolist())
+        return [t for i, t in enumerate(tokens) if i not in drop]

@@ -1,0 +1,3 @@
+from .token_embedding import TokenEmbedding
+
+__all__ = ["TokenEmbedding"]

@@ -1,0 +1,89 @@
+"""Pretrained word-vector lookup.
+
+Counterpart of ``paddlenlp/embeddings/token_embedding.py`` (``TokenEmbedding``
+:40 — load word vectors, ``search`` :217, ``cosine_sim`` :318). Zero-egress
+build: vectors load from a local ``.npz``/word2vec-text file instead of the
+download hub; unknown words get either a zero vector or a seeded normal one.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["TokenEmbedding"]
+
+PAD_TOKEN = "[PAD]"
+UNK_TOKEN = "[UNK]"
+
+
+class TokenEmbedding:
+    def __init__(self, embedding_path: Optional[str] = None, *, vocab: Optional[List[str]] = None,
+                 matrix: Optional[np.ndarray] = None, unknown_token: str = UNK_TOKEN,
+                 extended_vocab: Optional[List[str]] = None, trainable: bool = True, seed: int = 0):
+        if embedding_path is not None:
+            vocab, matrix = self._load(embedding_path)
+        if vocab is None or matrix is None:
+            raise ValueError("TokenEmbedding needs embedding_path or (vocab, matrix)")
+        self.unknown_token = unknown_token
+        words = list(vocab)
+        vecs = [np.asarray(matrix, np.float32)]
+        dim = vecs[0].shape[1]
+        rng = np.random.default_rng(seed)
+        if unknown_token not in words:
+            words.append(unknown_token)
+            vecs.append(rng.normal(scale=0.02, size=(1, dim)).astype(np.float32))
+        if PAD_TOKEN not in words:
+            words.append(PAD_TOKEN)
+            vecs.append(np.zeros((1, dim), np.float32))
+        for w in extended_vocab or []:
+            if w not in words:
+                words.append(w)
+                vecs.append(rng.normal(scale=0.02, size=(1, dim)).astype(np.float32))
+        self.vocab: Dict[str, int] = {w: i for i, w in enumerate(words)}
+        self.idx_to_token = words
+        self.weight = np.concatenate(vecs, axis=0)
+        self.trainable = trainable
+
+    @staticmethod
+    def _load(path: str):
+        if path.endswith(".npz"):
+            data = np.load(path, allow_pickle=True)
+            return list(data["vocab"]), np.asarray(data["embedding"], np.float32)
+        # word2vec text format: "word v1 v2 ..." (optional "N D" header line)
+        vocab, rows = [], []
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                parts = line.rstrip("\n").split(" ")
+                if i == 0 and len(parts) == 2 and all(p.isdigit() for p in parts):
+                    continue
+                vocab.append(parts[0])
+                rows.append(np.asarray(parts[1:], np.float32))
+        return vocab, np.stack(rows)
+
+    # ------------------------------------------------------------------ api
+    def get_idx_from_word(self, word: str) -> int:
+        return self.vocab.get(word, self.vocab[self.unknown_token])
+
+    def search(self, words) -> np.ndarray:
+        """Vectors for a word or list of words [N, D]."""
+        if isinstance(words, str):
+            words = [words]
+        idx = [self.get_idx_from_word(w) for w in words]
+        return self.weight[idx]
+
+    def dot(self, word_a: str, word_b: str) -> float:
+        va, vb = self.search(word_a)[0], self.search(word_b)[0]
+        return float(va @ vb)
+
+    def cosine_sim(self, word_a: str, word_b: str) -> float:
+        va, vb = self.search(word_a)[0], self.search(word_b)[0]
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom > 0 else 0.0
+
+    def __call__(self, ids):
+        """Embedding lookup as a jnp op (ids int array) — usable inside jit."""
+        import jax.numpy as jnp
+
+        return jnp.take(jnp.asarray(self.weight), jnp.asarray(ids), axis=0)

@@ -1,0 +1,142 @@
+"""AutoNLP-lite: hyperparameter search over the Trainer.
+
+Counterpart of ``paddlenlp/experimental/autonlp/``
+(``AutoTrainerForTextClassification`` text_classification.py:52 — ray-tune HPO
+over model/lr/batch candidates, best-trial export). This build has no ray; the
+search is an in-process sequential random/grid search — same API surface
+(``train`` / ``predict`` / ``export`` / ``visualize``), deterministic seeding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..trainer import Trainer, TrainingArguments
+from ..utils.log import logger
+
+__all__ = ["AutoTrainerForTextClassification"]
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    candidate: Dict[str, Any]
+    metrics: Dict[str, float]
+    output_dir: str
+
+
+class AutoTrainerForTextClassification:
+    """Random/grid search over (model, lr, batch size, epochs) candidates.
+
+    train_dataset/eval_dataset yield {"input_ids", ["attention_mask"], "labels"};
+    metric_for_best_model keys into evaluate()'s output (default eval_loss,
+    minimized; any other metric is maximized, the HF convention).
+    """
+
+    def __init__(
+        self,
+        train_dataset,
+        eval_dataset,
+        *,
+        model_candidates: Optional[List[Dict[str, Any]]] = None,
+        model_factory: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        metric_for_best_model: str = "eval_loss",
+        compute_metrics: Optional[Callable] = None,
+        output_dir: str = "autonlp_output",
+        seed: int = 0,
+    ):
+        if model_factory is None:
+            raise ValueError("model_factory (candidate-dict -> fresh model) is required")
+        self.train_dataset = train_dataset
+        self.eval_dataset = eval_dataset
+        self.model_factory = model_factory
+        self.model_candidates = model_candidates or [
+            {"learning_rate": 3e-5}, {"learning_rate": 1e-4}, {"learning_rate": 3e-4},
+        ]
+        self.metric = metric_for_best_model
+        self.compute_metrics = compute_metrics
+        self.output_dir = output_dir
+        self.seed = seed
+        self.trials: List[TrialResult] = []
+
+    # ------------------------------------------------------------------ search
+    def train(self, num_models: Optional[int] = None, max_steps: int = 50,
+              per_device_train_batch_size: int = 8, **train_kwargs) -> TrialResult:
+        """Run up to ``num_models`` candidates (all by default); returns the best."""
+        rng = np.random.default_rng(self.seed)
+        cands = list(self.model_candidates)
+        if num_models is not None and num_models < len(cands):
+            idx = rng.choice(len(cands), size=num_models, replace=False)
+            cands = [cands[i] for i in sorted(idx)]
+        for i, cand in enumerate(cands):
+            trial_id = f"trial_{i}"
+            out = os.path.join(self.output_dir, trial_id)
+            args = TrainingArguments(
+                output_dir=out,
+                max_steps=int(cand.get("max_steps", max_steps)),
+                learning_rate=float(cand.get("learning_rate", 3e-5)),
+                per_device_train_batch_size=int(cand.get("per_device_train_batch_size",
+                                                         per_device_train_batch_size)),
+                save_strategy="no",
+                seed=self.seed,
+                **train_kwargs,
+            )
+            model = self.model_factory(cand)
+            trainer = Trainer(model=model, args=args, train_dataset=self.train_dataset,
+                              eval_dataset=self.eval_dataset, compute_metrics=self.compute_metrics)
+            t0 = time.time()
+            trainer.train()
+            metrics = trainer.evaluate()
+            metrics["train_runtime"] = time.time() - t0
+            trainer.save_model(out)
+            self.trials.append(TrialResult(trial_id, cand, metrics, out))
+            logger.info(f"autonlp {trial_id}: {cand} -> {self.metric}={metrics.get(self.metric)}")
+        return self.best_trial
+
+    @property
+    def best_trial(self) -> TrialResult:
+        if not self.trials:
+            raise RuntimeError("no trials ran; call train() first")
+        minimize = self.metric.endswith("loss")
+        key = lambda t: t.metrics.get(self.metric, float("inf") if minimize else float("-inf"))
+        return min(self.trials, key=key) if minimize else max(self.trials, key=key)
+
+    # ------------------------------------------------------------------ results
+    def predict(self, test_dataset, trial_id: Optional[str] = None):
+        trial = self._get_trial(trial_id)
+        model = type(self.model_factory(trial.candidate)).from_pretrained(trial.output_dir)
+        args = TrainingArguments(output_dir=trial.output_dir, save_strategy="no")
+        trainer = Trainer(model=model, args=args, compute_metrics=self.compute_metrics)
+        return trainer.predict(test_dataset)
+
+    def export(self, export_path: str, trial_id: Optional[str] = None) -> str:
+        """Copy the chosen trial's saved model to ``export_path``."""
+        import shutil
+
+        trial = self._get_trial(trial_id)
+        os.makedirs(export_path, exist_ok=True)
+        for name in os.listdir(trial.output_dir):
+            src = os.path.join(trial.output_dir, name)
+            if os.path.isfile(src):
+                shutil.copy2(src, export_path)
+        return export_path
+
+    def visualize(self) -> List[Dict[str, Any]]:
+        """Leaderboard rows (the reference prints the ray-tune table)."""
+        rows = [{"trial_id": t.trial_id, **t.candidate, self.metric: t.metrics.get(self.metric)}
+                for t in self.trials]
+        minimize = self.metric.endswith("loss")
+        return sorted(rows, key=lambda r: r[self.metric] or 0, reverse=not minimize)
+
+    def _get_trial(self, trial_id: Optional[str]) -> TrialResult:
+        if trial_id is None:
+            return self.best_trial
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        raise ValueError(f"unknown trial {trial_id!r}; ran {[t.trial_id for t in self.trials]}")

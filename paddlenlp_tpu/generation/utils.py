@@ -87,6 +87,14 @@ class GenerationMixin:
         return g
 
     # ------------------------------------------------------------------
+    def _gen_position_ids(self, pos, prompt_mask, *, prefill: bool):
+        """Model hook: transform the loop's 1D position ids into the model's
+        position scheme. ``pos`` [B,T] (prefill) or [B,1] (step: count of real
+        tokens before the current one); ``prompt_mask`` [B,T0] is the ORIGINAL
+        prompt attention mask. Default: identity (plain causal positions);
+        chatglm overrides with the GLM (position, block_position) pair."""
+        return pos
+
     def _init_decode_cache(self, batch_size: int, max_length: int):
         """Decode-cache factory — KVCache by default; attention-free archs
         (mamba) override with their own state pytree."""
@@ -354,6 +362,7 @@ class GenerationMixin:
             )
             kv = self._init_decode_cache(BK, max_length)
             prompt_pos = jnp.clip(jnp.cumsum(rep(attention_mask), axis=1) - 1, 0)
+            prompt_pos = self._gen_position_ids(prompt_pos, rep(attention_mask), prefill=True)
             out = module.apply({"params": params}, input_ids=rep(input_ids),
                                attention_mask=pad_mask, position_ids=prompt_pos,
                                cache=kv, deterministic=True)
@@ -448,8 +457,9 @@ class GenerationMixin:
                 ids_buf, kv, cur_len, scores, finished, lengths = state
                 tok = jax.lax.dynamic_slice(ids_buf, (0, cur_len - 1), (BK, 1))
                 pos = jnp.sum(pad_mask * (jnp.arange(max_length)[None, :] < (cur_len - 1)), axis=1)
+                step_pos = self._gen_position_ids(pos[:, None], pad_mask[:, :T0], prefill=False)
                 out = module.apply({"params": params}, input_ids=tok, attention_mask=pad_mask,
-                                   position_ids=pos[:, None], cache=kv, deterministic=True)
+                                   position_ids=step_pos, cache=kv, deterministic=True)
                 logits = out.logits[:, -1].astype(jnp.float32)
                 return apply_step((ids_buf, out.past_key_values, cur_len, scores, finished, lengths), logits)
 
@@ -488,6 +498,7 @@ class GenerationMixin:
 
             # ---- prefill ----
             prompt_pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+            prompt_pos = self._gen_position_ids(prompt_pos, attention_mask, prefill=True)
             out = module.apply(
                 {"params": params},
                 input_ids=input_ids,
@@ -529,11 +540,12 @@ class GenerationMixin:
                 ids_buf, kv, cur_len, key, finished = state
                 tok = jax.lax.dynamic_slice(ids_buf, (0, cur_len - 1), (B, 1))
                 pos = jnp.sum(pad_mask * (jnp.arange(max_length)[None, :] < (cur_len - 1)), axis=1)
+                step_pos = self._gen_position_ids(pos[:, None], pad_mask[:, :T0], prefill=False)
                 out = module.apply(
                     {"params": params},
                     input_ids=tok,
                     attention_mask=pad_mask,
-                    position_ids=pos[:, None],
+                    position_ids=step_pos,
                     cache=kv,
                     deterministic=True,
                 )

@@ -1,0 +1,3 @@
+from .crf import LinearChainCrf, LinearChainCrfLoss, ViterbiDecoder, viterbi_decode
+
+__all__ = ["LinearChainCrf", "LinearChainCrfLoss", "ViterbiDecoder", "viterbi_decode"]

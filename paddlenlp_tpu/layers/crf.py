@@ -1,0 +1,137 @@
+"""Linear-chain CRF: forward-algorithm loss + Viterbi decoding.
+
+Counterpart of ``paddlenlp/layers/crf.py`` (``LinearChainCrf`` :31,
+``LinearChainCrfLoss``, ``ViterbiDecoder``). TPU-native: the forward recursion
+and Viterbi maximization are ``lax.scan`` over time with [B, N, N] score
+tensors — static shapes, jit-safe, batched; lengths mask the recursion instead
+of dynamic slicing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["LinearChainCrf", "LinearChainCrfLoss", "ViterbiDecoder", "viterbi_decode"]
+
+
+def _forward_alg(emissions, transitions, lengths, start_scores, stop_scores):
+    """log Z per sequence. emissions [B,T,N]; transitions [N,N] (from->to)."""
+    B, T, N = emissions.shape
+    alpha0 = emissions[:, 0] + start_scores  # [B, N]
+
+    def step(alpha, xs):
+        emit_t, t = xs  # [B, N], scalar
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i, j]) + emit[j]
+        scores = alpha[:, :, None] + transitions[None]  # [B, N, N]
+        new = jax.nn.logsumexp(scores, axis=1) + emit_t
+        keep = (t < lengths)[:, None]
+        return jnp.where(keep, new, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha0, (emissions[:, 1:].transpose(1, 0, 2), ts))
+    return jax.nn.logsumexp(alpha + stop_scores, axis=-1)  # [B]
+
+
+def _gold_score(emissions, tags, transitions, lengths, start_scores, stop_scores):
+    B, T, N = emissions.shape
+    idx_b = jnp.arange(B)
+    emit = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]  # [B, T]
+    t_mask = jnp.arange(T)[None, :] < lengths[:, None]
+    emit_total = jnp.where(t_mask, emit, 0.0).sum(-1)
+    trans = transitions[tags[:, :-1], tags[:, 1:]]  # [B, T-1]
+    trans_mask = jnp.arange(1, T)[None, :] < lengths[:, None]
+    trans_total = jnp.where(trans_mask, trans, 0.0).sum(-1)
+    last = jnp.take_along_axis(tags, (lengths - 1)[:, None], axis=1)[:, 0]
+    return emit_total + trans_total + start_scores[tags[:, 0]] + stop_scores[last]
+
+
+def viterbi_decode(emissions: jnp.ndarray, transitions: jnp.ndarray, lengths: jnp.ndarray,
+                   start_scores: Optional[jnp.ndarray] = None,
+                   stop_scores: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Best path per sequence. Returns (scores [B], paths [B, T])."""
+    B, T, N = emissions.shape
+    start = start_scores if start_scores is not None else jnp.zeros(N)
+    stop = stop_scores if stop_scores is not None else jnp.zeros(N)
+    alpha0 = emissions[:, 0] + start
+
+    def step(alpha, xs):
+        emit_t, t = xs
+        scores = alpha[:, :, None] + transitions[None]  # [B, from, to]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new = jnp.max(scores, axis=1) + emit_t
+        keep = (t < lengths)[:, None]
+        return jnp.where(keep, new, alpha), jnp.where(keep, best_prev, -1)
+
+    ts = jnp.arange(1, T)
+    alpha, back = jax.lax.scan(step, alpha0, (emissions[:, 1:].transpose(1, 0, 2), ts))
+    final = alpha + stop
+    best_last = jnp.argmax(final, axis=-1)  # [B]
+    best_score = jnp.max(final, axis=-1)
+
+    def backtrack(carry, bp_t):
+        # reverse over back[t]: bp_t [B, N]; -1 rows (past length) keep the tag
+        tag = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        tag = jnp.where(prev >= 0, prev, tag)
+        return tag, tag
+
+    _, rev_tags = jax.lax.scan(backtrack, best_last, back, reverse=True)
+    paths = jnp.concatenate([rev_tags.transpose(1, 0), best_last[:, None]], axis=1)  # [B, T]
+    return best_score, paths
+
+
+class LinearChainCrf(nn.Module):
+    """Transition table module; ``with_start_stop_tag`` adds learned start/stop rows."""
+
+    num_labels: int
+    with_start_stop_tag: bool = True
+
+    @nn.compact
+    def __call__(self, emissions, lengths, tags=None):
+        """Negative log-likelihood per sequence when ``tags`` given, else
+        (viterbi_scores, viterbi_paths)."""
+        N = self.num_labels
+        transitions = self.param("transitions", nn.initializers.normal(0.1), (N, N))
+        if self.with_start_stop_tag:
+            start = self.param("start_scores", nn.initializers.normal(0.1), (N,))
+            stop = self.param("stop_scores", nn.initializers.normal(0.1), (N,))
+        else:
+            start = jnp.zeros(N)
+            stop = jnp.zeros(N)
+        emissions = emissions.astype(jnp.float32)
+        if tags is not None:
+            logZ = _forward_alg(emissions, transitions, lengths, start, stop)
+            gold = _gold_score(emissions, tags, transitions, lengths, start, stop)
+            return logZ - gold  # NLL [B]
+        return viterbi_decode(emissions, transitions, lengths, start, stop)
+
+
+class LinearChainCrfLoss(nn.Module):
+    """Mean NLL over the batch (reference LinearChainCrfLoss)."""
+
+    num_labels: int
+    with_start_stop_tag: bool = True
+
+    @nn.compact
+    def __call__(self, emissions, lengths, tags):
+        nll = LinearChainCrf(self.num_labels, self.with_start_stop_tag, name="crf")(
+            emissions, lengths, tags)
+        return nll.mean()
+
+
+class ViterbiDecoder:
+    """Standalone decoder over a fixed transition table (reference ViterbiDecoder)."""
+
+    def __init__(self, transitions, with_start_stop_tag: bool = False,
+                 start_scores=None, stop_scores=None):
+        self.transitions = jnp.asarray(transitions, jnp.float32)
+        self.start_scores = None if start_scores is None else jnp.asarray(start_scores)
+        self.stop_scores = None if stop_scores is None else jnp.asarray(stop_scores)
+
+    def __call__(self, emissions, lengths):
+        return viterbi_decode(jnp.asarray(emissions, jnp.float32), self.transitions,
+                              jnp.asarray(lengths), self.start_scores, self.stop_scores)

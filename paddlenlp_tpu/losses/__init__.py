@@ -1,0 +1,3 @@
+from .rdrop import RDropLoss
+
+__all__ = ["RDropLoss"]

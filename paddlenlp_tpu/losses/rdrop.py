@@ -1,0 +1,42 @@
+"""R-Drop consistency loss (reference: paddlenlp/losses/rdrop.py ``RDropLoss``
+:22 — symmetric KL between two stochastic forward passes, arXiv:2106.14448)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RDropLoss"]
+
+
+class RDropLoss:
+    """loss = (KL(p||q) + KL(q||p)) / 2 over logits of two dropout passes."""
+
+    def __init__(self, reduction: str = "none"):
+        if reduction not in ("sum", "mean", "none", "batchmean"):
+            raise ValueError(
+                f"'reduction' should be 'sum', 'mean', 'batchmean', or 'none', got {reduction!r}")
+        self.reduction = reduction
+
+    def __call__(self, p: jnp.ndarray, q: jnp.ndarray,
+                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        p = p.astype(jnp.float32)
+        q = q.astype(jnp.float32)
+        p_logp = jax.nn.log_softmax(p, axis=-1)
+        q_logp = jax.nn.log_softmax(q, axis=-1)
+        p_prob = jnp.exp(p_logp)
+        q_prob = jnp.exp(q_logp)
+        kl_pq = (p_prob * (p_logp - q_logp)).sum(-1)
+        kl_qp = (q_prob * (q_logp - p_logp)).sum(-1)
+        loss = (kl_pq + kl_qp) / 2.0
+        if pad_mask is not None:
+            loss = loss * pad_mask.astype(loss.dtype)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "batchmean":
+            return loss.sum() / loss.shape[0]
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss

@@ -1,0 +1,3 @@
+from .encoder import BoWEncoder, CNNEncoder, GRUEncoder, LSTMEncoder, RNNEncoder
+
+__all__ = ["BoWEncoder", "CNNEncoder", "GRUEncoder", "LSTMEncoder", "RNNEncoder"]

@@ -1,0 +1,132 @@
+"""Classical sequence-to-vector encoders.
+
+Counterpart of ``paddlenlp/seq2vec/encoder.py`` (``BoWEncoder`` :23,
+``CNNEncoder`` :125, ``GRUEncoder`` :292, ``LSTMEncoder`` :477, ``RNNEncoder``
+:661 — the legacy text-classification building blocks). TPU-native: recurrent
+encoders unroll with ``flax.linen`` RNN cells under ``lax.scan``; conv windows
+are shifted adds (kernels are tiny).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["BoWEncoder", "CNNEncoder", "GRUEncoder", "LSTMEncoder", "RNNEncoder"]
+
+
+def _mask3(mask, like):
+    return mask[..., None].astype(like.dtype)
+
+
+class BoWEncoder(nn.Module):
+    """Sum of embeddings (masked)."""
+
+    emb_dim: int
+
+    def __call__(self, inputs, mask: Optional[jnp.ndarray] = None):
+        if mask is not None:
+            inputs = inputs * _mask3(mask, inputs)
+        return inputs.sum(axis=1)
+
+    def get_output_dim(self) -> int:
+        return self.emb_dim
+
+
+class CNNEncoder(nn.Module):
+    """Parallel 1D convs (one per ngram size) + max-pool, concatenated."""
+
+    emb_dim: int
+    num_filter: int = 128
+    ngram_filter_sizes: Sequence[int] = (2, 3, 4, 5)
+
+    @nn.compact
+    def __call__(self, inputs, mask: Optional[jnp.ndarray] = None):
+        if mask is not None:
+            inputs = inputs * _mask3(mask, inputs)
+        B, T, D = inputs.shape
+        outs = []
+        for k in self.ngram_filter_sizes:
+            w = self.param(f"conv_{k}_kernel", nn.initializers.lecun_normal(),
+                           (k, D, self.num_filter))
+            b = self.param(f"conv_{k}_bias", nn.initializers.zeros, (self.num_filter,))
+            n_win = T - k + 1
+            if n_win <= 0:
+                outs.append(jnp.zeros((B, self.num_filter), inputs.dtype))
+                continue
+            conv = sum(inputs[:, j : j + n_win] @ w[j] for j in range(k)) + b
+            outs.append(jnp.tanh(conv).max(axis=1))
+        return jnp.concatenate(outs, axis=-1)
+
+    def get_output_dim(self) -> int:
+        return self.num_filter * len(self.ngram_filter_sizes)
+
+
+class _RecurrentEncoder(nn.Module):
+    """Shared driver over ``nn.RNN`` (native seq-length masking + reverse);
+    subclasses pick the cell."""
+
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    direction: str = "forward"  # forward | bidirect
+    pooling_type: Optional[str] = None  # None (last state) | sum | max | mean
+
+    def _cell(self, name):
+        raise NotImplementedError
+
+    @nn.compact
+    def __call__(self, inputs, mask: Optional[jnp.ndarray] = None):
+        B, T, _ = inputs.shape
+        lengths = mask.sum(-1) if mask is not None else jnp.full((B,), T, jnp.int32)
+        h = inputs
+        last_states = []
+        for layer in range(self.num_layers):
+            rnn_f = nn.RNN(self._cell(f"l{layer}_fwd"), name=f"l{layer}_fwd_rnn")
+            carry_f, ys_f = rnn_f(h, seq_lengths=lengths, return_carry=True)
+            if self.direction == "bidirect":
+                rnn_b = nn.RNN(self._cell(f"l{layer}_bwd"), name=f"l{layer}_bwd_rnn")
+                carry_b, ys_b = rnn_b(h, seq_lengths=lengths, return_carry=True, reverse=True,
+                                      keep_order=True)
+                h = jnp.concatenate([ys_f, ys_b], axis=-1)
+                last_states.append((carry_f, carry_b))
+            else:
+                h = ys_f
+                last_states.append((carry_f,))
+        if self.pooling_type is None:
+            finals = []
+            for c in last_states[-1]:
+                hidden = c[1] if isinstance(c, tuple) and len(c) == 2 else c
+                finals.append(hidden)
+            return jnp.concatenate(finals, axis=-1)
+        if mask is not None:
+            h = h * _mask3(mask, h)
+        if self.pooling_type == "sum":
+            return h.sum(axis=1)
+        if self.pooling_type == "max":
+            return jnp.where(_mask3(mask, h) > 0, h, -jnp.inf).max(axis=1) if mask is not None else h.max(axis=1)
+        if self.pooling_type == "mean":
+            denom = mask.sum(-1, keepdims=True).astype(h.dtype) if mask is not None else h.shape[1]
+            return h.sum(axis=1) / jnp.maximum(denom, 1)
+        raise ValueError(f"pooling_type must be None|sum|max|mean, got {self.pooling_type!r}")
+
+    def get_output_dim(self) -> int:
+        return self.hidden_size * (2 if self.direction == "bidirect" else 1)
+
+
+class LSTMEncoder(_RecurrentEncoder):
+    def _cell(self, name):
+        return nn.OptimizedLSTMCell(self.hidden_size, name=name)
+
+
+class GRUEncoder(_RecurrentEncoder):
+    def _cell(self, name):
+        return nn.GRUCell(self.hidden_size, name=name)
+
+
+class RNNEncoder(_RecurrentEncoder):
+    def _cell(self, name):
+        return nn.SimpleCell(self.hidden_size, name=name)

@@ -113,25 +113,33 @@ class UIETask(Task):
 
     def _extract_level(self, texts: List[str], schema: Dict[str, Any],
                        prompt_prefix: Optional[List[str]] = None) -> List[Dict[str, list]]:
-        """One schema level for all texts; recurses into relation children."""
+        """One schema level for all texts: ALL (prompt, text) pairs of the level
+        run in ONE batched forward, and each relation level batches across every
+        parent span (no per-span single-row dispatches)."""
         out: List[Dict[str, list]] = [{} for _ in texts]
-        for name, children in schema.items():
-            if prompt_prefix is None:
-                prompts = [name] * len(texts)
-            else:
-                prompts = [f"{p}的{name}" for p in prompt_prefix]
-            span_lists = self._extract_spans(prompts, texts)
-            for i, spans in enumerate(span_lists):
-                if not spans:
-                    continue
-                if children:
-                    for span in spans:
-                        rel_texts = [texts[i]]
-                        rel = self._extract_level(rel_texts, children,
-                                                  prompt_prefix=[span["text"]])[0]
-                        if rel:
-                            span["relations"] = rel
+        names = list(schema)
+        prompts, pair_texts, meta = [], [], []
+        for name in names:
+            for i, t in enumerate(texts):
+                prompts.append(name if prompt_prefix is None else f"{prompt_prefix[i]}的{name}")
+                pair_texts.append(t)
+                meta.append((i, name))
+        for (i, name), spans in zip(meta, self._extract_spans(prompts, pair_texts)):
+            if spans:
                 out[i][name] = spans
+        for name, children in schema.items():
+            if not children:
+                continue
+            parents = [(i, span) for i in range(len(texts)) for span in out[i].get(name, [])]
+            if not parents:
+                continue
+            rel_results = self._extract_level(
+                [texts[i] for i, _ in parents], children,
+                prompt_prefix=[span["text"] for _, span in parents],
+            )
+            for (i, span), rel in zip(parents, rel_results):
+                if rel:
+                    span["relations"] = rel
         return out
 
     def __call__(self, inputs, schema=None, **kwargs):

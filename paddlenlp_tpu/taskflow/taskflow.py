@@ -38,11 +38,16 @@ def _populate():
     from .information_extraction import UIETask
     from .question_answering import QuestionAnsweringTask, SummarizationTask
 
+    from .token_classification import NERTask, POSTaggingTask, WordSegmentationTask
+
     register_task("fill_mask", FillMaskTask)
     register_task("question_answering", QuestionAnsweringTask)
     register_task("text_summarization", SummarizationTask)
     register_task("chat", TextGenerationTask)
     register_task("information_extraction", UIETask)
+    register_task("ner", NERTask)
+    register_task("word_segmentation", WordSegmentationTask)
+    register_task("pos_tagging", POSTaggingTask)
 
 
 class Taskflow:

@@ -1,0 +1,91 @@
+"""Token-level tasks: NER, word segmentation, POS tagging (reference:
+paddlenlp/taskflow/named_entity_recognition.py, word_segmentation.py,
+pos_tagging.py — all drive a token-classification head; here one implementation
+with per-task postprocessing over the tag scheme)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .task import Task
+
+__all__ = ["TokenClassificationTask", "NERTask", "WordSegmentationTask", "POSTaggingTask"]
+
+
+class TokenClassificationTask(Task):
+    """Taskflow("ner", task_path=<model dir>)(text) -> [(token_text, label), ...].
+
+    Labels follow a BIO-style scheme when the model's id2label does (`B-X`/`I-X`
+    merge into one span of label X); plain per-token labels otherwise.
+    """
+
+    def _construct(self):
+        from ..transformers import AutoConfig, AutoTokenizer
+        from ..transformers.auto.modeling import AutoModelForTokenClassification
+
+        self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+        config = AutoConfig.from_pretrained(self.model_name)
+        self.model = AutoModelForTokenClassification.from_pretrained(
+            self.model_name, config=config, dtype=self.kwargs.get("dtype", "float32")
+        )
+        id2label = getattr(config, "id2label", None)
+        self.id2label = {int(k): v for k, v in id2label.items()} if id2label else {}
+
+    def _run_model(self, texts: List[str]):
+        enc = self.tokenizer(
+            texts, padding=True, truncation=True,
+            max_length=self.kwargs.get("max_length", 512),
+            return_offsets_mapping=True,
+        )
+        ids = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc["attention_mask"], np.int32)
+        logits = self.model(input_ids=jnp.asarray(ids), attention_mask=jnp.asarray(mask)).logits
+        pred = np.asarray(logits.argmax(-1))
+        out = []
+        for i, text in enumerate(texts):
+            offs = enc["offset_mapping"][i]
+            tags = []
+            for j in range(ids.shape[1]):
+                if not mask[i, j] or tuple(offs[j]) == (0, 0):
+                    continue
+                label = self.id2label.get(int(pred[i, j]), str(int(pred[i, j])))
+                cs, ce = offs[j]
+                tags.append({"token": text[cs:ce], "start": int(cs), "end": int(ce), "label": label})
+            out.append({"text": text, "tags": self._merge(tags, text)})
+        return out
+
+    def _merge(self, tags, text):
+        """Merge BIO continuation tokens into spans; pass through otherwise."""
+        merged = []
+        for t in tags:
+            label = t["label"]
+            cont = label.startswith("I-") or label == "I"
+            base = label[2:] if label[:2] in ("B-", "I-") else label
+            if cont and merged and merged[-1]["label"] == base and merged[-1]["end"] <= t["start"]:
+                merged[-1]["end"] = t["end"]
+                merged[-1]["token"] = text[merged[-1]["start"]:t["end"]]
+            else:
+                merged.append({"token": t["token"], "start": t["start"], "end": t["end"], "label": base})
+        return merged
+
+
+class NERTask(TokenClassificationTask):
+    """Taskflow("ner", ...) — entity spans with their types."""
+
+
+class WordSegmentationTask(TokenClassificationTask):
+    """Taskflow("word_segmentation", ...) -> list of segmented words."""
+
+    def _postprocess(self, outputs):
+        return [[t["token"] for t in row["tags"]] for row in outputs]
+
+
+class POSTaggingTask(TokenClassificationTask):
+    """Taskflow("pos_tagging", ...) -> [(word, pos), ...]."""
+
+    def _postprocess(self, outputs):
+        return [[(t["token"], t["label"]) for t in row["tags"]] for row in outputs]

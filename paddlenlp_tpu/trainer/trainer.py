@@ -103,6 +103,7 @@ class Trainer:
         self.state = TrainerState()
         self.control = TrainerControl()
         self.train_state: Optional[TrainState] = None
+        self._profiler = None
         self._train_step_fn = None
         self._eval_step_fn = None
         self.mesh = args.mesh()
@@ -270,14 +271,13 @@ class Trainer:
                 "drives the built-in causal-LM loss; running the un-pipelined path"
             )
             return False
-        for attr in ("attention_dropout", "hidden_dropout", "resid_pdrop", "embd_pdrop", "attn_pdrop"):
-            if getattr(cfg, attr, 0.0):
-                logger.warning_once(
-                    f"pp>1 pipeline path runs deterministically: config.{attr}="
-                    f"{getattr(cfg, attr)} is IGNORED (dropout is not threaded "
-                    "through the microbatch pipeline)"
-                )
         return True
+
+    def _model_has_dropout(self) -> bool:
+        cfg = self.model.config
+        return any(getattr(cfg, attr, 0.0) for attr in
+                   ("attention_dropout", "hidden_dropout", "resid_pdrop", "embd_pdrop",
+                    "attn_pdrop", "hidden_dropout_prob", "attention_probs_dropout_prob"))
 
     def _build_train_step(self):
         optimizer = self.optimizer
@@ -285,13 +285,19 @@ class Trainer:
         if self._use_pipeline():
             pp = self.mesh.shape["pp"]
             shift = not self._labels_preshifted
+            has_dropout = self._model_has_dropout()
 
             def pipeline_train_step(state: TrainState, batch, dropout_rng):
                 import optax
 
+                # dropout rng threaded per (step, microbatch, layer) through the
+                # pipeline state; None keeps the deterministic path bit-stable
+                rng = jax.random.fold_in(dropout_rng, state.step) if has_dropout else None
+
                 def loss_fn(params):
                     return self.model.pipelined_loss(
-                        params, batch, n_stages=pp, criterion=self.criterion, shift=shift
+                        params, batch, n_stages=pp, criterion=self.criterion, shift=shift,
+                        dropout_rng=rng,
                     )
 
                 loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -619,9 +625,12 @@ class Trainer:
                     if args.profiler_options:
                         # jax.profiler trace over the configured step window
                         # (reference utils/profiler.py:88 add_profiler_step)
-                        from ..utils.profiler import add_profiler_step
+                        if self._profiler is None:
+                            from ..utils.profiler import ProfilerOptions, ProfilerStepper
 
-                        add_profiler_step(args.profiler_options, self.state.global_step)
+                            self._profiler = ProfilerStepper(
+                                ProfilerOptions.parse(args.profiler_options))
+                        self._profiler.step(self.state.global_step)
                     if "input_ids" in host_batch:
                         tokens_seen += int(np.prod(np.asarray(host_batch["input_ids"]).shape))
                     self.control = self.callback_handler.on_step_end(args, self.state, self.control)
@@ -648,6 +657,10 @@ class Trainer:
             model_flops=self._total_flops(tokens_seen),
         )
         metrics["train_loss"] = final_loss
+        if self._profiler is not None:
+            # flush an open trace even when training ended inside the window
+            self._profiler.close()
+            self._profiler = None
         self.control = self.callback_handler.on_train_end(args, self.state, self.control)
         self.model.params = self.train_state.params
         return TrainOutput(self.state.global_step, final_loss, metrics)
@@ -705,12 +718,7 @@ class Trainer:
         losses, n_batches = [], 0
         all_logits, all_labels = [], []
         run_metrics = self.compute_metrics is not None
-        if jax.process_count() > 1 and run_metrics:
-            logger.warning_once(
-                "multihost evaluate(): logits are device-sharded across processes; "
-                "running loss-only eval (compute_metrics skipped)"
-            )
-            run_metrics = False
+        multihost = jax.process_count() > 1
         with use_mesh(self.mesh):
             for host_batch in dataloader:
                 host_batch, n_pad = self._pad_batch_to_shards(host_batch)
@@ -720,12 +728,19 @@ class Trainer:
                     losses.append(float(out["loss"]))
                 if run_metrics:
                     logits = self._maybe_unsplit_seq(out["logits"])  # BEFORE any positional preprocessing
-                    if self.preprocess_logits_for_metrics is not None:
-                        logits = self.preprocess_logits_for_metrics(logits, host_batch.get("labels"))
-                    arr = np.asarray(jax.device_get(logits))
+                    logits = self._reduce_eval_logits(logits, batch, host_batch, len(dataloader))
+                    if multihost:
+                        # gather the device-sharded global batch to every host
+                        # (reference trainer.py:2911 evaluation_loop gathers
+                        # across ranks); the gathered labels come from the
+                        # device batch — the sampler already masked any
+                        # wrap-padded filler rows to -100
+                        arr, lab = self._allgather_eval(logits, batch)
+                    else:
+                        arr = np.asarray(jax.device_get(logits))
+                        lab = np.asarray(host_batch["labels"]) if "labels" in host_batch else None
                     all_logits.append(arr[: arr.shape[0] - n_pad] if n_pad else arr)
-                    if "labels" in host_batch:
-                        lab = np.asarray(host_batch["labels"])
+                    if lab is not None:
                         all_labels.append(lab[: lab.shape[0] - n_pad] if n_pad else lab)
                 n_batches += 1
         metrics = {}
@@ -750,15 +765,39 @@ class Trainer:
         self.state.log_history.append(dict(metrics))
         return metrics
 
+    def _reduce_eval_logits(self, logits, batch, host_batch, n_batches: int = 1):
+        """preprocess_logits_for_metrics if given; otherwise, when accumulating
+        the full eval's logits would exceed ``eval_logits_host_bytes_limit`` of
+        host RAM, reduce to device-side argmax ids (the reference's
+        eval_accumulation pressure valve). The reduction is size-gated and
+        loudly logged — small evals keep full logits."""
+        if self.preprocess_logits_for_metrics is not None:
+            labels = batch.get("labels") if jax.process_count() > 1 else host_batch.get("labels")
+            return self.preprocess_logits_for_metrics(logits, labels)
+        limit = getattr(self.args, "eval_logits_host_bytes_limit", 2 << 30)
+        if getattr(logits, "ndim", 0) == 3 and limit and logits.size * 4 * n_batches > limit:
+            logger.warning_once(
+                f"accumulating eval logits would need ~{logits.size * 4 * n_batches / 1e9:.1f} GB "
+                f"host RAM (> eval_logits_host_bytes_limit={limit}); reducing to argmax token ids "
+                "on device — pass preprocess_logits_for_metrics or raise the limit to override"
+            )
+            return jnp.argmax(logits, axis=-1)
+        return logits
+
+    def _allgather_eval(self, logits, batch):
+        """Multihost: replicate the global (sharded) eval outputs onto every host."""
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(multihost_utils.process_allgather(logits, tiled=True))
+        lab = None
+        if "labels" in batch:
+            lab = np.asarray(multihost_utils.process_allgather(batch["labels"], tiled=True))
+        return arr, lab
+
     def predict(self, test_dataset, ignore_keys=None, metric_key_prefix: str = "test"):
         from .trainer_utils import PredictionOutput
 
-        if jax.process_count() > 1:
-            raise RuntimeError(
-                "Trainer.predict gathers full logits, which span non-addressable "
-                "devices on multihost; run predict on a single host (or use "
-                "evaluate(), which is loss-only on multihost)"
-            )
+        multihost = jax.process_count() > 1
         dataloader = self.get_eval_dataloader(test_dataset)
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
@@ -769,10 +808,15 @@ class Trainer:
                 host_batch, n_pad = self._pad_batch_to_shards(host_batch)
                 batch = self._device_put_batch(host_batch, accum=1)
                 out = self._eval_step_fn(params, batch)
-                arr = np.asarray(jax.device_get(self._maybe_unsplit_seq(out["logits"])))
+                logits = self._reduce_eval_logits(self._maybe_unsplit_seq(out["logits"]), batch,
+                                                  host_batch, len(dataloader))
+                if multihost:
+                    arr, lab = self._allgather_eval(logits, batch)
+                else:
+                    arr = np.asarray(jax.device_get(logits))
+                    lab = np.asarray(host_batch["labels"]) if "labels" in host_batch else None
                 logits_all.append(arr[: arr.shape[0] - n_pad] if n_pad else arr)
-                if "labels" in host_batch:
-                    lab = np.asarray(host_batch["labels"])
+                if lab is not None:
                     labels_all.append(lab[: lab.shape[0] - n_pad] if n_pad else lab)
         preds = np.concatenate(logits_all, axis=0) if logits_all else None
         labels = np.concatenate(labels_all, axis=0) if labels_all else None

@@ -150,8 +150,25 @@ def _prune_width(trainer, output_dir: str, width_mult: float = 0.75):
                 k = np.asarray(flat[f"{prefix}/{name}/kernel"])
                 flat[f"{prefix}/{name}/kernel"] = jnp.asarray(k[:, keep])
         pruned += 1
+    # bert/ernie-style encoders: intermediate_dense [D,F] -> output_dense [F,D]
+    # (the architectures dynabert actually targets in the reference)
+    enc_prefixes = sorted({p.rsplit("/", 2)[0] for p in flat
+                           if p.endswith("output_dense/kernel")
+                           and f"{p.rsplit('/', 2)[0]}/intermediate_dense/kernel" in flat})
+    for prefix in enc_prefixes:
+        out_k = np.asarray(flat[f"{prefix}/output_dense/kernel"])  # [F, D]
+        imp = np.linalg.norm(out_k, axis=-1)
+        keep = np.sort(np.argsort(-imp)[:new_f])
+        flat[f"{prefix}/output_dense/kernel"] = jnp.asarray(out_k[keep, :])
+        flat[f"{prefix}/intermediate_dense/kernel"] = jnp.asarray(
+            np.asarray(flat[f"{prefix}/intermediate_dense/kernel"])[:, keep])
+        bias_key = f"{prefix}/intermediate_dense/bias"
+        if bias_key in flat:
+            flat[bias_key] = jnp.asarray(np.asarray(flat[bias_key])[keep])
+        pruned += 1
     if pruned == 0:
-        raise ValueError("no gate/up/down ffn kernels found to prune (llama-family only)")
+        raise ValueError("no prunable ffn kernels found (expected llama-style "
+                         "gate/up/down or bert-style intermediate/output dense)")
     # export with a patched config COPY; the live trainer model keeps its
     # full-width params + config consistent
     import copy

@@ -85,6 +85,10 @@ class TrainingArguments:
 
     run_name: Optional[str] = None
     report_to: Optional[List[str]] = None
+    eval_logits_host_bytes_limit: int = field(
+        default=2 << 30,
+        metadata={"help": "evaluate()/predict() reduce logits to device-side argmax ids when the "
+                          "full accumulation would exceed this many host bytes (0 disables)"})
     profiler_options: Optional[str] = field(
         default=None,
         metadata={"help": 'jax.profiler trace window, e.g. "batch_range=[10,20];profile_path=./prof" '

@@ -35,6 +35,10 @@ from .llama import (  # noqa: F401
     LlamaPretrainingCriterion,
 )
 from .mamba import MambaConfig, MambaForCausalLM, MambaModel  # noqa: F401
+from .rw import RWConfig, RWForCausalLM, RWModel  # noqa: F401
+from .chatglm import ChatGLMConfig, ChatGLMForCausalLM, ChatGLMModel  # noqa: F401
+from .yuan import YuanConfig, YuanForCausalLM, YuanModel  # noqa: F401
+from .jamba import JambaConfig, JambaForCausalLM, JambaModel  # noqa: F401
 from .mistral import MistralConfig, MistralForCausalLM, MistralModel  # noqa: F401
 from .mixtral import MixtralConfig, MixtralForCausalLM, MixtralModel  # noqa: F401
 from .model_outputs import (  # noqa: F401

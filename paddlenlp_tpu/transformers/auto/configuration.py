@@ -35,12 +35,16 @@ def _populate():
     from ..bart.configuration import BartConfig
     from ..deepseek_v2.configuration import DeepseekV2Config
     from ..mamba.configuration import MambaConfig
+    from ..rw.configuration import RWConfig
+    from ..chatglm.configuration import ChatGLMConfig
+    from ..yuan.configuration import YuanConfig
+    from ..jamba.configuration import JambaConfig
     from ..t5.configuration import T5Config
 
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
                 ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
                 OPTConfig, QWenConfig, ChatGLMv2Config, T5Config, BartConfig, DeepseekV2Config,
-                MambaConfig):
+                MambaConfig, RWConfig, ChatGLMConfig, YuanConfig, JambaConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

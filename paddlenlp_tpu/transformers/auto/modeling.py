@@ -89,6 +89,24 @@ def _populate_models():
 
     register_model("mamba", "base", mamba.MambaModel)
     register_model("mamba", "causal_lm", mamba.MambaForCausalLM)
+    from ..rw import modeling as rw
+
+    register_model("rw", "base", rw.RWModel)
+    register_model("rw", "causal_lm", rw.RWForCausalLM)
+    register_model("falcon", "base", rw.RWModel)
+    register_model("falcon", "causal_lm", rw.RWForCausalLM)
+    from ..chatglm import modeling as chatglm
+
+    register_model("chatglm", "base", chatglm.ChatGLMModel)
+    register_model("chatglm", "causal_lm", chatglm.ChatGLMForCausalLM)
+    from ..yuan import modeling as yuan
+
+    register_model("yuan", "base", yuan.YuanModel)
+    register_model("yuan", "causal_lm", yuan.YuanForCausalLM)
+    from ..jamba import modeling as jamba
+
+    register_model("jamba", "base", jamba.JambaModel)
+    register_model("jamba", "causal_lm", jamba.JambaForCausalLM)
     from ..t5 import modeling as t5
 
     register_model("t5", "base", t5.T5Model)

@@ -1,0 +1,4 @@
+from .configuration import ChatGLMConfig
+from .modeling import ChatGLMForCausalLM, ChatGLMModel, ChatGLMPretrainedModel
+
+__all__ = ["ChatGLMConfig", "ChatGLMModel", "ChatGLMForCausalLM", "ChatGLMPretrainedModel"]

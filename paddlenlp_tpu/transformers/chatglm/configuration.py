@@ -1,0 +1,55 @@
+"""ChatGLM (v1) configuration (reference: paddlenlp/transformers/chatglm/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["ChatGLMConfig"]
+
+
+class ChatGLMConfig(PretrainedConfig):
+    model_type = "chatglm"
+    attribute_map = {"num_layers": "num_hidden_layers", "layernorm_epsilon": "layer_norm_epsilon",
+                     "inner_hidden_size": "intermediate_size",
+                     "max_sequence_length": "max_position_embeddings"}
+
+    def __init__(
+        self,
+        vocab_size: int = 130528,
+        hidden_size: int = 4096,
+        num_hidden_layers: int = 28,
+        num_attention_heads: int = 32,
+        intermediate_size: int = 16384,
+        layer_norm_epsilon: float = 1e-5,
+        initializer_range: float = 0.02,
+        position_encoding_2d: bool = True,
+        generation_2d_positions: bool = True,
+        activation: str = "gelu",
+        attention_scale: bool = True,
+        max_position_embeddings: int = 2048,
+        rope_theta: float = 10000.0,
+        bos_token_id: int = 130004,
+        eos_token_id: int = 130005,
+        gmask_token_id: int = 130001,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.position_encoding_2d = position_encoding_2d
+        # generate() builds GLM (position, block) pairs: position frozen at the
+        # prompt's last index, block counting 1,2,... over generated tokens
+        # (the chatglm-6b inference convention). Off: plain causal 1D ids.
+        self.generation_2d_positions = generation_2d_positions
+        self.activation = activation
+        self.attention_scale = attention_scale
+        self.max_position_embeddings = max_position_embeddings
+        self.rope_theta = rope_theta
+        self.head_dim = hidden_size // num_attention_heads
+        self.gmask_token_id = gmask_token_id
+        super().__init__(bos_token_id=bos_token_id, eos_token_id=eos_token_id, **kwargs)

@@ -1,0 +1,296 @@
+"""ChatGLM v1 (GLM-6B), TPU-native.
+
+Counterpart of ``paddlenlp/transformers/chatglm/modeling.py`` (``ChatGLMAttention``
+:158 with the 2D rotary ``_core_attention`` :207, ``ChatGLMBlock`` :348 with the
+``alpha = sqrt(2L)`` post-LN residual scaling, ``ChatGLMStack`` :434).
+Distinctives vs the llama skeleton:
+
+- fused qkv [3D] laid out per head as [n, 3, hd] (split of the per-head 3*hd
+  block into thirds — the GLM checkpoint layout);
+- **2D rotary**: the head dim halves carry two independent rotary encodings —
+  first half by absolute position, second half by "block position" (GLM's
+  position/block-position pair); ``position_ids`` may be [B, 2, T], a plain
+  [B, T] (block ids default to 0), or None;
+- post-LN residuals scaled by ``alpha = (2 * num_layers) ** 0.5``:
+  ``h = alpha * ln(x) + sublayer(ln(x))`` (GLM-130B deepnorm-style);
+- gelu (or geglu) MLP, biases everywhere; separate LM head.
+
+The reference's attention_scale coefficient (q scaled down by layer id, scores
+scaled back up) is an fp16 range trick that cancels exactly; attention here
+computes the standard fp32-softmax product. GLM's bidirectional-prefix mask is
+supplied via ``attention_mask`` when needed (the default is causal).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import rope_frequencies
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import VocabEmbed, _maybe_remat
+from ..llama.modeling import LlamaPretrainingCriterion as ChatGLMPretrainingCriterion
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import ChatGLMConfig
+
+__all__ = ["ChatGLMModel", "ChatGLMForCausalLM", "ChatGLMPretrainedModel",
+           "ChatGLMPretrainingCriterion"]
+
+
+def _ln(cfg, dtype, param_dtype, name):
+    return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype, param_dtype=param_dtype, name=name)
+
+
+def _dense(features, cfg, dtype, param_dtype, name, use_bias=True):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _rope_half(x, positions, inv_freq):
+    """Standard rotate-half rotary over ONE half-head-dim slice.
+    x [B,T,N,hd/2]; positions [B,T]."""
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,hd/4]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)[:, :, None, :]  # [B,T,1,hd/2]
+    cos, sin = jnp.cos(emb), jnp.sin(emb)
+    return (x.astype(jnp.float32) * cos + _rotate_half(x.astype(jnp.float32)) * sin).astype(x.dtype)
+
+
+class ChatGLMAttention(nn.Module):
+    config: ChatGLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, layer_kv, offset, position_ids, deterministic):
+        cfg = self.config
+        B, T, D = x.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        fused = _dense(3 * D, cfg, self.dtype, self.param_dtype, "query_key_value")(x)
+        fused = fused.reshape(B, T, n, 3, hd)
+        q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+
+        base_offset = offset if layer_kv is not None else 0
+        if position_ids is None:
+            pos = jnp.arange(T)[None, :] + base_offset
+            block = jnp.zeros_like(pos)
+        elif position_ids.ndim == 3:  # [B, 2, T] (position, block_position)
+            pos, block = position_ids[:, 0], position_ids[:, 1]
+        else:
+            pos, block = position_ids, jnp.zeros_like(position_ids)
+        # rotary dim per 2D component is hd/2 (reference RotaryEmbedding(hd // 2))
+        inv_freq = jnp.asarray(rope_frequencies(hd // 2, cfg.rope_theta, None))
+        q1, q2 = jnp.split(q, 2, axis=-1)
+        k1, k2 = jnp.split(k, 2, axis=-1)
+        if cfg.position_encoding_2d:
+            q = jnp.concatenate([_rope_half(q1, pos, inv_freq), _rope_half(q2, block, inv_freq)], axis=-1)
+            k = jnp.concatenate([_rope_half(k1, pos, inv_freq), _rope_half(k2, block, inv_freq)], axis=-1)
+        else:
+            q = jnp.concatenate([_rope_half(q1, pos, inv_freq), q2], axis=-1)
+            k = jnp.concatenate([_rope_half(k1, pos, inv_freq), k2], axis=-1)
+
+        q_offset = 0
+        new_kv = None
+        if layer_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(layer_kv[0], layer_kv[1], k, v, offset)
+            new_kv = (k, v)
+        out = dot_product_attention(
+            q, k, v, attention_mask=attention_mask, segment_ids=segment_ids, causal=True,
+            q_offset=q_offset,
+        ).reshape(B, T, D)
+        return _dense(D, cfg, self.dtype, self.param_dtype, "dense")(out), new_kv
+
+
+class ChatGLMBlock(nn.Module):
+    """Scan-compatible block: carry = (h, offset, aux). Post-LN with the GLM
+    ``alpha`` residual scaling."""
+
+    config: ChatGLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_kv, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        alpha = (2 * cfg.num_hidden_layers) ** 0.5
+        ln1 = _ln(cfg, self.dtype, self.param_dtype, "input_layernorm")(h)
+        attn = ChatGLMAttention(cfg, self.dtype, self.param_dtype, name="attention")
+        attn_out, new_kv = attn(ln1, attention_mask, segment_ids, layer_kv, offset,
+                                position_ids, deterministic)
+        h = alpha * ln1 + attn_out
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        ln2 = _ln(cfg, self.dtype, self.param_dtype, "post_attention_layernorm")(h)
+        x = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "mlp_dense_h_to_4h")(ln2)
+        if cfg.activation == "geglu":
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            x = x1 * nn.gelu(x2)
+        else:
+            x = nn.gelu(x)
+        x = shard_constraint(x, P("batch", "seq", "act_mlp"))
+        x = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "mlp_dense_4h_to_h")(x)
+        h = alpha * ln2 + x
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_kv
+
+
+class ChatGLMModule(nn.Module):
+    config: ChatGLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[KVCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        if inputs_embeds is None:
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="word_embeddings")(input_ids)
+        h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        layer_cls = _maybe_remat(ChatGLMBlock, cfg)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+        aux = jnp.zeros((), jnp.float32)
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
+            )
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
+                (h, offset, aux), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=new_kv[0], values=new_kv[1], offset=offset + T)
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        h = _ln(cfg, self.dtype, self.param_dtype, "final_layernorm")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
+                                       hidden_states=tuple(all_hidden) if all_hidden else None,
+                                       aux_loss=aux)
+
+
+class ChatGLMForCausalLMModule(nn.Module):
+    config: ChatGLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = ChatGLMModule(cfg, self.dtype, self.param_dtype, name="transformer")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        if cfg.tie_word_embeddings:
+            embedding = self.get_variable("params", "transformer")["word_embeddings"]["embedding"]
+            logits = h @ embedding.T.astype(self.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.initializer_range),
+                              name="lm_head")(h)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states, aux_loss=outputs.aux_loss)
+
+
+class ChatGLMPretrainedModel(PretrainedModel):
+    config_class = ChatGLMConfig
+    base_model_prefix = "transformer"
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        mappings = super()._get_name_mappings(config, flat_shapes)
+        for m in mappings:
+            # flat underscore module names -> HF dotted scopes
+            for ours, hf in (("mlp_dense_h_to_4h", "mlp.dense_h_to_4h"),
+                             ("mlp_dense_4h_to_h", "mlp.dense_4h_to_h")):
+                if hasattr(m, "source_template"):
+                    m.source_template = m.source_template.replace(ours, hf)
+                else:
+                    m.source_name = m.source_name.replace(ours, hf)
+        return mappings
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"query_key_value/kernel$", P("embed", "heads")),
+            (r"query_key_value/bias$", P("heads")),
+            (r"attention/dense/kernel$", P("heads", "embed")),
+            (r"mlp_dense_h_to_4h/kernel$", P("embed", "mlp")),
+            (r"mlp_dense_h_to_4h/bias$", P("mlp")),
+            (r"mlp_dense_4h_to_h/kernel$", P("mlp", "embed")),
+            (r"(layernorm|final_layernorm)/(scale|bias)$", P()),
+            (r"lm_head/kernel$", P("embed", "vocab")),
+        ]
+
+
+class ChatGLMModel(ChatGLMPretrainedModel):
+    module_class = ChatGLMModule
+
+
+class ChatGLMForCausalLM(ChatGLMPretrainedModel):
+    module_class = ChatGLMForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+    def _gen_position_ids(self, pos, prompt_mask, *, prefill: bool):
+        """GLM-6B inference convention (reference chatglm
+        ``prepare_inputs_for_generation``): context tokens use (arange, 0);
+        every generated token keeps ``position`` frozen at the prompt's last
+        index while ``block_position`` counts 1, 2, ... — assumes the prompt
+        ends with [gMASK][bos] as chatglm prompts do."""
+        if not getattr(self.config, "generation_2d_positions", True):
+            return pos
+        if prefill:
+            return jnp.stack([pos, jnp.zeros_like(pos)], axis=1)  # [B, 2, T]
+        prompt_real = prompt_mask.sum(-1)  # [B]
+        position = (prompt_real - 1)[:, None]
+        block = pos[:, 0][:, None] - prompt_real[:, None] + 1
+        return jnp.stack([position, block], axis=1)  # [B, 2, 1]

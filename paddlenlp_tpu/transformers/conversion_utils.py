@@ -69,7 +69,8 @@ class StackedLayerMapping:
     n_layers: int = 0  # legacy single-dim spelling
     action: Optional[str] = None  # applied per slice
     dims: Optional[tuple] = None
-    fn: Optional[Callable] = None  # per-slice transform (e.g. fused-qkv split); NOT invertible
+    fn: Optional[Callable] = None  # per-slice transform (e.g. fused-qkv split)
+    fn_reverse: Optional[Callable] = None  # per-slice save-side inverse of fn
 
     def __post_init__(self):
         if self.dims is None:
@@ -102,13 +103,15 @@ class StackedLayerMapping:
         return stacked.reshape(tuple(self.dims) + stacked.shape[1:])
 
     def reverse_unstack(self, array: np.ndarray) -> Dict[str, np.ndarray]:
-        if self.fn is not None:
+        if self.fn is not None and self.fn_reverse is None:
             raise ValueError(f"custom conversion for {self.target_name} is not invertible")
         out = {}
         flat = array.reshape((-1,) + array.shape[len(self.dims):])
         for j, idx in enumerate(self._indices()):
             a = flat[j]
-            if self.action == "transpose":
+            if self.fn_reverse is not None:
+                a = self.fn_reverse(a)
+            elif self.action == "transpose":
                 a = np.ascontiguousarray(a.T)
             out[self.source_template.format(*idx)] = a
         return out

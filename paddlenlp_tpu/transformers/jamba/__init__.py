@@ -1,0 +1,4 @@
+from .configuration import JambaConfig
+from .modeling import JambaCache, JambaForCausalLM, JambaModel, JambaPretrainedModel
+
+__all__ = ["JambaConfig", "JambaModel", "JambaForCausalLM", "JambaPretrainedModel", "JambaCache"]

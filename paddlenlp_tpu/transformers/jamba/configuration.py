@@ -1,0 +1,86 @@
+"""Jamba configuration (reference: paddlenlp/transformers/jamba/configuration.py)."""
+
+from __future__ import annotations
+
+import math
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["JambaConfig"]
+
+
+class JambaConfig(PretrainedConfig):
+    model_type = "jamba"
+
+    def __init__(
+        self,
+        vocab_size: int = 65536,
+        hidden_size: int = 4096,
+        intermediate_size: int = 14336,
+        num_hidden_layers: int = 32,
+        num_attention_heads: int = 32,
+        num_key_value_heads: int = 8,
+        hidden_act: str = "silu",
+        rms_norm_eps: float = 1e-6,
+        initializer_range: float = 0.02,
+        max_position_embeddings: int = 262144,
+        num_experts_per_tok: int = 2,
+        num_experts: int = 16,
+        expert_layer_period: int = 2,
+        expert_layer_offset: int = 1,
+        attn_layer_period: int = 8,
+        attn_layer_offset: int = 4,
+        router_aux_loss_coef: float = 0.001,
+        mamba_d_state: int = 16,
+        mamba_d_conv: int = 4,
+        mamba_expand: int = 2,
+        mamba_dt_rank="auto",
+        mamba_conv_bias: bool = True,
+        mamba_proj_bias: bool = False,
+        attention_dropout: float = 0.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads
+        self.hidden_act = hidden_act
+        self.rms_norm_eps = rms_norm_eps
+        self.initializer_range = initializer_range
+        self.max_position_embeddings = max_position_embeddings
+        self.num_experts_per_tok = num_experts_per_tok
+        self.num_experts = num_experts
+        self.expert_layer_period = expert_layer_period
+        self.expert_layer_offset = expert_layer_offset
+        self.attn_layer_period = attn_layer_period
+        self.attn_layer_offset = attn_layer_offset
+        self.router_aux_loss_coef = router_aux_loss_coef
+        self.mamba_d_state = mamba_d_state
+        self.mamba_d_conv = mamba_d_conv
+        self.mamba_expand = mamba_expand
+        self.mamba_dt_rank = math.ceil(hidden_size / 16) if mamba_dt_rank == "auto" else mamba_dt_rank
+        self.mamba_conv_bias = mamba_conv_bias
+        self.mamba_proj_bias = mamba_proj_bias
+        self.attention_dropout = attention_dropout
+        self.head_dim = hidden_size // num_attention_heads
+        # MoEMLP adapter fields (shared stacked-expert block, moe_layers.py)
+        self.num_local_experts = num_experts
+        self.moe_intermediate_size = intermediate_size
+        self.norm_topk_prob = False  # jamba keeps raw softmax weights on the top-k
+        kwargs.setdefault("tie_word_embeddings", False)
+        # heterogeneous layer stack: lax.scan over layers is structurally
+        # impossible; the module raises if this is forced on
+        kwargs.setdefault("use_scan_layers", False)
+        super().__init__(**kwargs)
+
+    @property
+    def layers_block_type(self):
+        return ["attention" if i % self.attn_layer_period == self.attn_layer_offset else "mamba"
+                for i in range(self.num_hidden_layers)]
+
+    @property
+    def layers_num_experts(self):
+        return [self.num_experts if i % self.expert_layer_period == self.expert_layer_offset else 1
+                for i in range(self.num_hidden_layers)]

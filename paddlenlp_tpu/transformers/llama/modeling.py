@@ -534,7 +534,8 @@ class LlamaForCausalLM(LlamaPretrainedModel):
     module_class = LlamaForCausalLMModule
     _keys_to_ignore_on_load_missing = [r"lm_head"]
 
-    def pipelined_loss(self, params, batch, *, n_stages: int, criterion=None, shift: bool = True):
+    def pipelined_loss(self, params, batch, *, n_stages: int, criterion=None, shift: bool = True,
+                       dropout_rng=None):
         """Causal-LM loss with the decoder trunk run as a pp-stage pipeline.
 
         The Trainer calls this instead of ``compute_loss`` when the mesh has
@@ -573,18 +574,27 @@ class LlamaForCausalLM(LlamaPretrainedModel):
         base_layer = layer_cls(cfg, dtype, pdtype)
 
         def layer_fn(lp, state):
-            hh, m_, p_, s_, aux = state
+            hh, m_, p_, s_, aux, mb_i, layer_i = state
+            if dropout_rng is None:
+                rngs, det = {}, True
+            else:
+                # unique stream per (microbatch, layer): the microbatch id rides
+                # the pipeline state, the layer counter increments per tick
+                rngs = {"dropout": jax.random.fold_in(jax.random.fold_in(dropout_rng, mb_i), layer_i)}
+                det = False
             (hh, _, aux), _ = base_layer.apply(
-                {"params": lp}, (hh, jnp.zeros((), jnp.int32), aux), None, m_, p_, s_, True
+                {"params": lp}, (hh, jnp.zeros((), jnp.int32), aux), None, m_, p_, s_, det,
+                rngs=rngs,
             )
-            return (hh, m_, p_, s_, aux)
+            return (hh, m_, p_, s_, aux, mb_i, layer_i + 1)
 
         if getattr(cfg, "recompute", False):
             layer_fn = jax.checkpoint(
                 layer_fn, policy=_remat_policy(getattr(cfg, "recompute_granularity", "full"))
             )
-        stream = (h, mask, pos, seg, jnp.zeros((M,), jnp.float32))
-        h_out, _, _, _, aux = spatial_pipeline(layer_fn, mp["layers"], stream, n_stages)
+        stream = (h, mask, pos, seg, jnp.zeros((M,), jnp.float32),
+                  jnp.arange(M, dtype=jnp.int32), jnp.zeros((M,), jnp.int32))
+        h_out, _, _, _, aux, _, _ = spatial_pipeline(layer_fn, mp["layers"], stream, n_stages)
         aux = aux / cfg.num_hidden_layers  # HF convention (LlamaModule does the same)
 
         norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps,

@@ -91,6 +91,7 @@ class MambaMixer(nn.Module):
     config: MambaConfig
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    norm_selection: bool = False  # jamba: RMSNorm on dt/B/C before dt_proj
 
     @nn.compact
     def __call__(self, x, layer_cache=None, pad_mask=None):
@@ -138,6 +139,12 @@ class MambaMixer(nn.Module):
 
         sel = dense(R + 2 * N, False, "x_proj")(u)  # [B, T, R + 2N]
         dt, Bsel, Csel = sel[..., :R], sel[..., R : R + N], sel[..., R + N :]
+        if self.norm_selection:
+            # jamba stabilization (reference jamba/modeling.py:643-699)
+            eps = cfg.layer_norm_epsilon
+            dt = MambaRMSNorm(R, eps, name="dt_layernorm")(dt)
+            Bsel = MambaRMSNorm(N, eps, name="b_layernorm")(Bsel)
+            Csel = MambaRMSNorm(N, eps, name="c_layernorm")(Csel)
         dt = dense(Di, True, "dt_proj")(dt)  # [B, T, Di]
         dt = jax.nn.softplus(dt.astype(jnp.float32))
         if pad_mask is not None:

@@ -1,0 +1,4 @@
+from .configuration import RWConfig
+from .modeling import RWForCausalLM, RWModel, RWPretrainedModel
+
+__all__ = ["RWConfig", "RWModel", "RWForCausalLM", "RWPretrainedModel"]

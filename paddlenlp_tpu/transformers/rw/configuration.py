@@ -1,0 +1,58 @@
+"""RW (falcon) configuration (reference: paddlenlp/transformers/rw/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["RWConfig"]
+
+
+class RWConfig(PretrainedConfig):
+    model_type = "rw"
+    attribute_map = {"n_layer": "num_hidden_layers", "n_head": "num_attention_heads",
+                     "n_embed": "hidden_size"}
+
+    def __init__(
+        self,
+        vocab_size: int = 65024,
+        hidden_size: int = 4544,
+        num_hidden_layers: int = 32,
+        num_attention_heads: int = 71,
+        layer_norm_epsilon: float = 1e-5,
+        initializer_range: float = 0.02,
+        hidden_dropout: float = 0.0,
+        attention_dropout: float = 0.0,
+        multi_query: bool = True,
+        n_head_kv=None,
+        bias: bool = False,
+        alibi: bool = False,
+        parallel_attn: bool = True,
+        apply_residual_connection_post_layernorm: bool = False,
+        max_position_embeddings: int = 2048,
+        rope_theta: float = 10000.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.multi_query = multi_query
+        self.bias = bias
+        self.alibi = alibi
+        self.parallel_attn = parallel_attn
+        self.apply_residual_connection_post_layernorm = apply_residual_connection_post_layernorm
+        self.max_position_embeddings = max_position_embeddings
+        self.rope_theta = rope_theta
+        self.head_dim = hidden_size // num_attention_heads
+        self.num_key_value_heads = 1 if multi_query else (n_head_kv or num_attention_heads)
+        self.intermediate_size = 4 * hidden_size
+        kwargs.setdefault("tie_word_embeddings", True)
+        super().__init__(**kwargs)
+
+    @property
+    def rotary(self) -> bool:
+        return not self.alibi

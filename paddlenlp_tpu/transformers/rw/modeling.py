@@ -1,0 +1,256 @@
+"""RW (falcon), TPU-native.
+
+Counterpart of ``paddlenlp/transformers/rw/modeling.py`` (``Attention`` :135
+with the fused ``query_key_value`` projection and ``_split_heads`` :166,
+``DecoderLayer`` :372 with the ``parallel_attn`` single-layernorm block,
+``RWForCausalLM`` :788). Distinctives vs the llama skeleton:
+
+- fused qkv whose layout depends on ``multi_query``: MHA interleaves per head
+  as [n, 3, hd] (bloom-style); MQ packs all q heads then ONE k and ONE v head
+  as [n+2, hd] (falcon-7b);
+- rotary (NeoX halves) when ``alibi=False``, ALiBi bias otherwise (falcon-rw);
+- ``parallel_attn``: one input layernorm feeds BOTH attention and MLP, the
+  residual adds attn_out + mlp_out in one step (falcon-7b); the sequential
+  bloom-like block otherwise;
+- gelu MLP at 4x width, biases per ``config.bias``; tied LM head.
+
+Module names mirror HF falcon keys (``transformer.h.{i}.self_attention.
+query_key_value`` ...) so the checkpoint mapping is mechanical and invertible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import VocabEmbed, _maybe_remat
+from ..llama.modeling import LlamaPretrainingCriterion as RWPretrainingCriterion
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import RWConfig
+
+__all__ = ["RWModel", "RWForCausalLM", "RWPretrainedModel", "RWPretrainingCriterion"]
+
+
+def _ln(cfg, dtype, param_dtype, name):
+    return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=dtype, param_dtype=param_dtype, name=name)
+
+
+def _dense(features, cfg, dtype, param_dtype, name, use_bias):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+class RWAttention(nn.Module):
+    config: RWConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, layer_kv, offset, position_ids, deterministic):
+        cfg = self.config
+        B, T, D = x.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        if cfg.multi_query:
+            fused = _dense(D + 2 * hd, cfg, self.dtype, self.param_dtype,
+                           "query_key_value", cfg.bias)(x)
+            fused = fused.reshape(B, T, n + 2, hd)
+            q, k, v = fused[..., :-2, :], fused[..., -2:-1, :], fused[..., -1:, :]
+        else:
+            fused = _dense(3 * D, cfg, self.dtype, self.param_dtype,
+                           "query_key_value", cfg.bias)(x)
+            fused = fused.reshape(B, T, n, 3, hd)
+            q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+        if cfg.rotary:
+            if position_ids is None:
+                position_ids = jnp.arange(T)[None, :] + (offset if layer_kv is not None else 0)
+            inv_freq = jnp.asarray(rope_frequencies(hd, cfg.rope_theta, None))
+            cos, sin = rope_tables(position_ids, inv_freq)
+            q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        q_offset = 0
+        new_kv = None
+        if layer_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(layer_kv[0], layer_kv[1], k, v, offset)
+            new_kv = (k, v)
+        drop = cfg.attention_dropout if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        out = dot_product_attention(
+            q, k, v, attention_mask=attention_mask, segment_ids=segment_ids, causal=True,
+            q_offset=q_offset, dropout_rate=drop, dropout_rng=rng, use_alibi=cfg.alibi,
+        ).reshape(B, T, n * hd)
+        return _dense(D, cfg, self.dtype, self.param_dtype, "dense", cfg.bias)(out), new_kv
+
+
+class RWMLP(nn.Module):
+    config: RWConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype,
+                   "dense_h_to_4h", cfg.bias)(x)
+        h = nn.gelu(h)
+        h = shard_constraint(h, P("batch", "seq", "act_mlp"))
+        return _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                      "dense_4h_to_h", cfg.bias)(h)
+
+
+class RWBlock(nn.Module):
+    """Scan-compatible block: carry = (h, offset, aux)."""
+
+    config: RWConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_kv, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        ln1 = _ln(cfg, self.dtype, self.param_dtype, "input_layernorm")(h)
+        residual = ln1 if cfg.apply_residual_connection_post_layernorm else h
+        attn = RWAttention(cfg, self.dtype, self.param_dtype, name="self_attention")
+        attn_out, new_kv = attn(ln1, attention_mask, segment_ids, layer_kv, offset,
+                                position_ids, deterministic)
+        if cfg.parallel_attn:
+            # falcon-7b: mlp reads the SAME layernorm output; one residual add
+            h = residual + attn_out + RWMLP(cfg, self.dtype, self.param_dtype, name="mlp")(ln1)
+        else:
+            h = residual + attn_out
+            h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+            ln2 = _ln(cfg, self.dtype, self.param_dtype, "post_attention_layernorm")(h)
+            residual = ln2 if cfg.apply_residual_connection_post_layernorm else h
+            h = residual + RWMLP(cfg, self.dtype, self.param_dtype, name="mlp")(ln2)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_kv
+
+
+class RWModule(nn.Module):
+    config: RWConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[KVCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        if inputs_embeds is None:
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="word_embeddings")(input_ids)
+        h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        layer_cls = _maybe_remat(RWBlock, cfg)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+        aux = jnp.zeros((), jnp.float32)
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
+            )
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="h")(
+                (h, offset, aux), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=new_kv[0], values=new_kv[1], offset=offset + T)
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"h_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        h = _ln(cfg, self.dtype, self.param_dtype, "ln_f")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
+                                       hidden_states=tuple(all_hidden) if all_hidden else None,
+                                       aux_loss=aux)
+
+
+class RWForCausalLMModule(nn.Module):
+    config: RWConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = RWModule(cfg, self.dtype, self.param_dtype, name="transformer")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        if cfg.tie_word_embeddings:
+            embedding = self.get_variable("params", "transformer")["word_embeddings"]["embedding"]
+            logits = h @ embedding.T.astype(self.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.initializer_range),
+                              name="lm_head")(h)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states, aux_loss=outputs.aux_loss)
+
+
+class RWPretrainedModel(PretrainedModel):
+    config_class = RWConfig
+    base_model_prefix = "transformer"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"query_key_value/kernel$", P("embed", "heads")),
+            (r"query_key_value/bias$", P("heads")),
+            (r"self_attention/dense/kernel$", P("heads", "embed")),
+            (r"dense_h_to_4h/kernel$", P("embed", "mlp")),
+            (r"dense_h_to_4h/bias$", P("mlp")),
+            (r"dense_4h_to_h/kernel$", P("mlp", "embed")),
+            (r"(layernorm|ln_f)/(scale|bias)$", P()),
+        ]
+
+
+class RWModel(RWPretrainedModel):
+    module_class = RWModule
+
+
+class RWForCausalLM(RWPretrainedModel):
+    module_class = RWForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]

@@ -1,0 +1,4 @@
+from .configuration import YuanConfig
+from .modeling import YuanCache, YuanForCausalLM, YuanModel, YuanPretrainedModel
+
+__all__ = ["YuanConfig", "YuanModel", "YuanForCausalLM", "YuanPretrainedModel", "YuanCache"]

@@ -1,0 +1,45 @@
+"""Yuan-2 configuration (reference: paddlenlp/transformers/yuan/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["YuanConfig"]
+
+
+class YuanConfig(PretrainedConfig):
+    model_type = "yuan"
+
+    def __init__(
+        self,
+        vocab_size: int = 135040,
+        hidden_size: int = 2048,
+        intermediate_size: int = 8192,
+        num_hidden_layers: int = 24,
+        num_attention_heads: int = 32,
+        num_key_value_heads=None,
+        hidden_act: str = "silu",
+        rms_norm_eps: float = 1e-6,
+        initializer_range: float = 0.02,
+        max_position_embeddings: int = 8192,
+        rope_theta: float = 10000.0,
+        use_loss_mask: bool = False,
+        attention_dropout: float = 0.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.hidden_act = hidden_act
+        self.rms_norm_eps = rms_norm_eps
+        self.initializer_range = initializer_range
+        self.max_position_embeddings = max_position_embeddings
+        self.rope_theta = rope_theta
+        self.use_loss_mask = use_loss_mask
+        self.attention_dropout = attention_dropout
+        self.head_dim = hidden_size // num_attention_heads
+        kwargs.setdefault("tie_word_embeddings", False)
+        super().__init__(**kwargs)

@@ -1,0 +1,337 @@
+"""Yuan-2, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/yuan/modeling.py`` (``LocalizedFiltering``
+:78 — the Mega-EMA-derived causal-conv gate, ``YuanAttention`` with q/k from the
+LF output and v from the raw hidden states, ``YuanDecoderLayer`` :728).
+Distinctives vs the llama skeleton:
+
+- **Localized Filtering (lf_gate)** before q/k: two kernel-2 causal convs over
+  the sequence (D -> D/2 -> D) + RMSNorm(conv_out + residual). Expressed as
+  shifted dense matmuls (the kernel is 2 taps — two [D, D'] GEMMs beat a conv
+  lowering on the MXU); decode carries the last TWO raw hidden states per layer
+  (the reference's ``before_hidden_states`` memory) in a ``YuanCache``;
+- v is projected from the RAW (pre-LF) hidden states;
+- everything else is llama: RMSNorm pre-LN, rotary, GQA-capable q/k/v/o,
+  silu gate/up/down MLP, untied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import apply_rotary_pos_emb, rope_frequencies, rope_tables
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import update_layer_kv
+from ..llama.modeling import LlamaRMSNorm, VocabEmbed, _maybe_remat
+from ..llama.modeling import LlamaPretrainingCriterion as YuanPretrainingCriterion
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import YuanConfig
+
+__all__ = ["YuanModel", "YuanForCausalLM", "YuanPretrainedModel", "YuanCache",
+           "YuanPretrainingCriterion"]
+
+
+@dataclasses.dataclass
+class YuanCache:
+    """KV cache + per-layer LF memory.
+
+    keys/values [L, B, S, K, H]; lf_states [L, B, 2, D] — the raw hidden inputs
+    at absolute positions offset-2 and offset-1 (zeros before sequence start);
+    offset scalar."""
+
+    keys: jnp.ndarray
+    values: jnp.ndarray
+    lf_states: jnp.ndarray
+    offset: jnp.ndarray
+
+    def layer(self, i: int):
+        return (self.keys[i], self.values[i], self.lf_states[i])
+
+
+jax.tree_util.register_dataclass(
+    YuanCache, data_fields=["keys", "values", "lf_states", "offset"], meta_fields=[]
+)
+
+
+def _dense(features, cfg, dtype, param_dtype, name, use_bias=False):
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+class LocalizedFiltering(nn.Module):
+    """x [B,T,D], lf_state [B,2,D], offset -> (filtered [B,T,D], new_state).
+
+    conv taps stored as [2, in, out] (tap 0 = previous token); HF conv weights
+    [out, in, 2, 1] map via a custom fn (see YuanPretrainedModel)."""
+
+    config: YuanConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, lf_state, offset):
+        cfg = self.config
+        D = cfg.hidden_size
+        Dh = D // 2
+        init = nn.initializers.normal(cfg.initializer_range)
+        w1 = self.param("conv1_kernel", init, (2, D, Dh), self.param_dtype).astype(self.dtype)
+        b1 = self.param("conv1_bias", nn.initializers.zeros, (Dh,), self.param_dtype).astype(self.dtype)
+        w2 = self.param("conv2_kernel", init, (2, Dh, D), self.param_dtype).astype(self.dtype)
+        b2 = self.param("conv2_bias", nn.initializers.zeros, (D,), self.param_dtype).astype(self.dtype)
+
+        B, T, _ = x.shape
+        ext = jnp.concatenate([lf_state.astype(x.dtype), x], axis=1)  # [B, T+2, D]
+        # o1[j] = conv1 output at absolute position offset + j - 1
+        o1 = ext[:, :-1] @ w1[0] + ext[:, 1:] @ w1[1] + b1  # [B, T+1, Dh]
+        pos1 = offset + jnp.arange(T + 1) - 1
+        # zero (not bias) before sequence start — the train-path zero padding
+        o1 = jnp.where((pos1 >= 0)[None, :, None], o1, 0.0)
+        o2 = o1[:, :-1] @ w2[0] + o1[:, 1:] @ w2[1] + b2  # [B, T, D]
+        out = LlamaRMSNorm(D, cfg.rms_norm_eps, name="output_layernorm")(o2 + x)
+        return out, ext[:, -2:]
+
+
+class YuanAttention(nn.Module):
+    config: YuanConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, layer_cache, offset, position_ids, deterministic):
+        cfg = self.config
+        B, T, D = x.shape
+        n, kvn, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        has_cache = layer_cache is not None
+        lf_state = layer_cache[2] if has_cache else jnp.zeros((B, 2, D), x.dtype)
+        cache_offset = offset if has_cache else jnp.zeros((), jnp.int32)
+
+        # v from the RAW hidden states; q/k from the localized-filtering output
+        v = _dense(kvn * hd, cfg, self.dtype, self.param_dtype, "v_proj")(x).reshape(B, T, kvn, hd)
+        lf = LocalizedFiltering(cfg, self.dtype, self.param_dtype, name="lf_gate")
+        xf, new_lf_state = lf(x, lf_state, cache_offset)
+        q = _dense(n * hd, cfg, self.dtype, self.param_dtype, "q_proj")(xf).reshape(B, T, n, hd)
+        k = _dense(kvn * hd, cfg, self.dtype, self.param_dtype, "k_proj")(xf).reshape(B, T, kvn, hd)
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", "act_seq_attn", "act_kv_heads", None))
+
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :] + (offset if has_cache else 0)
+        inv_freq = jnp.asarray(rope_frequencies(hd, cfg.rope_theta, None))
+        cos, sin = rope_tables(position_ids, inv_freq)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+        q_offset = 0
+        new_cache = None
+        if has_cache:
+            q_offset = offset
+            k, v = update_layer_kv(layer_cache[0], layer_cache[1], k, v, offset)
+            new_cache = (k, v, new_lf_state)
+        drop = cfg.attention_dropout if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        out = dot_product_attention(
+            q, k, v, attention_mask=attention_mask, segment_ids=segment_ids, causal=True,
+            q_offset=q_offset, dropout_rate=drop, dropout_rng=rng,
+        ).reshape(B, T, n * hd)
+        return _dense(D, cfg, self.dtype, self.param_dtype, "o_proj")(out), new_cache
+
+
+class YuanDecoderLayer(nn.Module):
+    """Scan-compatible: carry = (h, offset, aux)."""
+
+    config: YuanConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_cache, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        x = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="input_layernorm")(h)
+        attn = YuanAttention(cfg, self.dtype, self.param_dtype, name="self_attn")
+        attn_out, new_cache = attn(x, attention_mask, segment_ids, layer_cache, offset,
+                                   position_ids, deterministic)
+        h = h + attn_out
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        x = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="post_attention_layernorm")(h)
+        gate = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "mlp_gate_proj")(x)
+        up = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "mlp_up_proj")(x)
+        y = nn.silu(gate) * up
+        y = shard_constraint(y, P("batch", "seq", "act_mlp"))
+        h = h + _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "mlp_down_proj")(y)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_cache
+
+
+class YuanModule(nn.Module):
+    config: YuanConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[YuanCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        if inputs_embeds is None:
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="embed_tokens")(input_ids)
+        h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        layer_cls = _maybe_remat(YuanDecoderLayer, cfg)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+        aux = jnp.zeros((), jnp.float32)
+        if use_scan:
+            scan_cache = (cache.keys, cache.values, cache.lf_states) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.num_hidden_layers,
+            )
+            (h, _, aux), new_cache = ScanStack(cfg, self.dtype, self.param_dtype, name="layers")(
+                (h, offset, aux), scan_cache, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = YuanCache(keys=new_cache[0], values=new_cache[1],
+                                  lf_states=new_cache[2], offset=offset + T)
+        else:
+            new_k, new_v, new_lf = [], [], []
+            for i in range(cfg.num_hidden_layers):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_cache = cache.layer(i) if cache is not None else None
+                (h, _, aux), c_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"layers_{i}")(
+                    (h, offset, aux), layer_cache, attention_mask, position_ids, segment_ids, deterministic
+                )
+                if c_i is not None:
+                    new_k.append(c_i[0])
+                    new_v.append(c_i[1])
+                    new_lf.append(c_i[2])
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = YuanCache(keys=jnp.stack(new_k), values=jnp.stack(new_v),
+                                  lf_states=jnp.stack(new_lf), offset=offset + T)
+        h = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps, name="norm")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
+                                       hidden_states=tuple(all_hidden) if all_hidden else None,
+                                       aux_loss=aux)
+
+
+class YuanForCausalLMModule(nn.Module):
+    config: YuanConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = YuanModule(cfg, self.dtype, self.param_dtype, name="model")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        if cfg.tie_word_embeddings:
+            embedding = self.get_variable("params", "model")["embed_tokens"]["embedding"]
+            logits = h @ embedding.T.astype(self.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype,
+                              param_dtype=self.param_dtype,
+                              kernel_init=nn.initializers.normal(cfg.initializer_range),
+                              name="lm_head")(h)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states, aux_loss=outputs.aux_loss)
+
+
+class YuanPretrainedModel(PretrainedModel):
+    config_class = YuanConfig
+    base_model_prefix = "model"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"embed_tokens/embedding$", P("vocab", "embed")),
+            (r"(q_proj|k_proj|v_proj)/kernel$", P("embed", "heads")),
+            (r"o_proj/kernel$", P("heads", "embed")),
+            (r"lf_gate/conv\d_kernel$", P()),
+            (r"mlp_(gate|up)_proj/kernel$", P("embed", "mlp")),
+            (r"mlp_down_proj/kernel$", P("mlp", "embed")),
+            (r"(layernorm|norm)/scale$", P()),
+            (r"lm_head/kernel$", P("embed", "vocab")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        """Mechanical mappings + (a) flat underscore scopes -> HF dotted scopes,
+        (b) the lf_gate conv tensors: HF stores Conv2D weights [out, in, 2, 1];
+        we store [2, in, out] tap-major."""
+        mappings = super()._get_name_mappings(config, flat_shapes)
+
+        def conv_fwd(w):
+            return np.ascontiguousarray(np.squeeze(np.asarray(w), axis=-1).transpose(2, 1, 0))
+
+        def conv_rev(w):
+            return np.ascontiguousarray(np.asarray(w).transpose(2, 1, 0)[..., None])
+
+        renames = (("mlp_gate_proj", "mlp.gate_proj"), ("mlp_up_proj", "mlp.up_proj"),
+                   ("mlp_down_proj", "mlp.down_proj"),
+                   ("conv1_kernel", "conv1.weight"), ("conv2_kernel", "conv2.weight"),
+                   ("conv1_bias", "conv1.bias"), ("conv2_bias", "conv2.bias"))
+
+        def rename(key):
+            for ours, hf in renames:
+                key = key.replace(ours, hf)
+            return key
+
+        for m in mappings:
+            if hasattr(m, "source_template"):
+                m.source_template = rename(m.source_template)
+            else:
+                m.source_name = rename(m.source_name)
+            if m.target_name.endswith(("conv1_kernel", "conv2_kernel")):
+                m.action = None
+                m.fn, m.fn_reverse = conv_fwd, conv_rev
+        return mappings
+
+
+class YuanModel(YuanPretrainedModel):
+    module_class = YuanModule
+
+
+class YuanForCausalLM(YuanPretrainedModel):
+    module_class = YuanForCausalLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+
+    def _init_decode_cache(self, batch_size: int, max_length: int):
+        cfg = self.config
+        dtype = jnp.bfloat16 if self.module.dtype == jnp.bfloat16 else jnp.float32
+        shape = (cfg.num_hidden_layers, batch_size, max_length,
+                 cfg.num_key_value_heads, cfg.head_dim)
+        return YuanCache(
+            keys=jnp.zeros(shape, dtype), values=jnp.zeros(shape, dtype),
+            lf_states=jnp.zeros((cfg.num_hidden_layers, batch_size, 2, cfg.hidden_size), dtype),
+            offset=jnp.zeros((), jnp.int32),
+        )

@@ -32,6 +32,14 @@ def make_dataset(n=64, seq=16):
     return DS()
 
 
+def metric_fn(p):
+    """Deterministic checksum over REAL rows only (filler rows — wrap-padded on
+    multihost, row-0 repeats single-host — carry all-(-100) labels) so the two
+    paths compare over the identical sample set."""
+    real = (np.asarray(p.label_ids) != -100).any(-1)
+    return {"pred_checksum": float(np.asarray(p.predictions, np.float64)[real].mean())}
+
+
 def main():
     import jax
 
@@ -52,12 +60,22 @@ def main():
         tensor_parallel_degree=2, sharding="stage3", sharding_parallel_degree=2,
         seed=0, data_seed=11,
     )
-    trainer = Trainer(model=model, args=args, train_dataset=make_dataset())
+    trainer = Trainer(model=model, args=args, train_dataset=make_dataset(),
+                      eval_dataset=make_dataset(n=20), compute_metrics=metric_fn)
     trainer.train()
     losses = [h["loss"] for h in trainer.state.log_history if "loss" in h]
+    # multihost evaluate()/predict() gather metrics across processes
+    # (reference trainer.py:2911 evaluation_loop gathers across ranks)
+    eval_metrics = trainer.evaluate()
+    pred = trainer.predict(make_dataset(n=20))
+    real = (np.asarray(pred.label_ids) != -100).any(-1)
+    pred_mean = float(np.asarray(pred.predictions, np.float64)[real].mean())
     if jax.process_index() == 0:
         with open(os.environ["PDNLP_TEST_OUT"], "w") as f:
-            json.dump(losses, f)
+            json.dump({"losses": losses,
+                       "eval_checksum": eval_metrics["eval_pred_checksum"],
+                       "eval_loss": eval_metrics["eval_loss"],
+                       "pred_mean": pred_mean}, f)
     print(f"worker {jax.process_index()} done: {losses}")
 
 

@@ -58,7 +58,7 @@ def test_two_process_loss_parity(tmp_path, eight_devices):
     # single-process reference on the IN-PROCESS 8-device mesh, same config/data
     from paddlenlp_tpu.trainer import Trainer, TrainingArguments
     from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
-    from tests.parallel.multihost_worker import make_dataset
+    from tests.parallel.multihost_worker import make_dataset, metric_fn
 
     cfg = LlamaConfig(
         vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
@@ -71,8 +71,21 @@ def test_two_process_loss_parity(tmp_path, eight_devices):
         tensor_parallel_degree=2, sharding="stage3", sharding_parallel_degree=2,
         seed=0, data_seed=11,
     )
-    trainer = Trainer(model=model, args=args, train_dataset=make_dataset())
+    trainer = Trainer(model=model, args=args, train_dataset=make_dataset(),
+                      eval_dataset=make_dataset(n=20), compute_metrics=metric_fn)
     trainer.train()
     single = [h["loss"] for h in trainer.state.log_history if "loss" in h]
-    assert len(multi) == len(single) == 3
-    np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-4)
+    assert len(multi["losses"]) == len(single) == 3
+    np.testing.assert_allclose(multi["losses"], single, rtol=1e-4, atol=1e-4)
+
+    # eval metrics + predict must now be gathered on multihost and agree with
+    # the single-process values (the multihost path gathers the device-sharded
+    # logits; the single-process path reads them off-device directly)
+    eval_metrics = trainer.evaluate()
+    np.testing.assert_allclose(multi["eval_loss"], eval_metrics["eval_loss"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(multi["eval_checksum"], eval_metrics["eval_pred_checksum"],
+                               rtol=1e-4, atol=1e-5)
+    pred = trainer.predict(make_dataset(n=20))
+    real = (np.asarray(pred.label_ids) != -100).any(-1)
+    pred_mean = float(np.asarray(pred.predictions, np.float64)[real].mean())
+    np.testing.assert_allclose(multi["pred_mean"], pred_mean, rtol=1e-4, atol=1e-5)

@@ -110,3 +110,31 @@ class TestPipelineTrainerParity:
         piped = _run(tmp_path, "pp2", pp=2, tp=2, mbs=4)
         assert len(base) == len(piped) >= 2
         np.testing.assert_allclose(base, piped, rtol=2e-4, atol=2e-4)
+
+
+class TestPipelineDropout:
+    def test_dropout_threads_through_pipeline(self, eight_devices):
+        """With attention_dropout on, the pipelined loss must (a) be stochastic
+        across rng keys, (b) be reproducible for the same key, and (c) match the
+        deterministic loss when the rng is withheld — i.e. dropout actually
+        reaches the layers instead of being silently ignored (round-2 weak item)."""
+        cfg = LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=4,
+            num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+            attention_dropout=0.5, use_scan_layers=True,
+        )
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        rng = np.random.default_rng(3)
+        ids = jnp.asarray(rng.integers(0, 128, size=(2, 2, 16)), jnp.int32)  # [M, mb, T]
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        mesh = create_mesh(MeshConfig(pp=2, tp=2, fsdp=2))
+        with use_mesh(mesh):
+            fn = jax.jit(lambda p, key: model.pipelined_loss(p, batch, n_stages=2, dropout_rng=key))
+            det_fn = jax.jit(lambda p: model.pipelined_loss(p, batch, n_stages=2, dropout_rng=None))
+            l1 = float(fn(model.params, jax.random.key(0)))
+            l1_again = float(fn(model.params, jax.random.key(0)))
+            l2 = float(fn(model.params, jax.random.key(1)))
+            det = float(det_fn(model.params))
+        assert l1 == l1_again  # same key -> bit-stable
+        assert l1 != l2, "dropout rng has no effect in the pipeline"
+        assert det not in (l1, l2) and np.isfinite(det)

@@ -1,0 +1,249 @@
+"""Legacy-scope libraries: CRF, RDrop, seq2vec encoders, TokenEmbedding,
+dataaug, AutoNLP-lite (reference: paddlenlp/layers, losses, seq2vec,
+embeddings, dataaug, experimental/autonlp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestCRF:
+    def _setup(self, B=3, T=5, N=4, seed=0):
+        rng = np.random.default_rng(seed)
+        emissions = jnp.asarray(rng.normal(size=(B, T, N)), jnp.float32)
+        lengths = jnp.asarray([5, 3, 4], jnp.int32)
+        tags = jnp.asarray(rng.integers(0, N, size=(B, T)), jnp.int32)
+        return emissions, lengths, tags
+
+    def test_nll_matches_bruteforce(self):
+        """Forward-algorithm log Z == brute-force enumeration over all paths."""
+        import itertools
+
+        from paddlenlp_tpu.layers import LinearChainCrf
+
+        B, T, N = 2, 4, 3
+        rng = np.random.default_rng(1)
+        emissions = jnp.asarray(rng.normal(size=(B, T, N)), jnp.float32)
+        lengths = jnp.asarray([4, 2], jnp.int32)
+        tags = jnp.asarray(rng.integers(0, N, size=(B, T)), jnp.int32)
+        crf = LinearChainCrf(num_labels=N)
+        params = crf.init(jax.random.key(0), emissions, lengths, tags)
+        nll = crf.apply(params, emissions, lengths, tags)
+
+        trans = np.asarray(params["params"]["transitions"])
+        start = np.asarray(params["params"]["start_scores"])
+        stop = np.asarray(params["params"]["stop_scores"])
+        em = np.asarray(emissions)
+        for b in range(B):
+            L = int(lengths[b])
+            scores = []
+            for path in itertools.product(range(N), repeat=L):
+                s = start[path[0]] + em[b, 0, path[0]] + stop[path[-1]]
+                for t in range(1, L):
+                    s += trans[path[t - 1], path[t]] + em[b, t, path[t]]
+                scores.append(s)
+            logZ = np.logaddexp.reduce(scores)
+            gold_path = tuple(int(x) for x in np.asarray(tags[b])[:L])
+            gold = start[gold_path[0]] + em[b, 0, gold_path[0]] + stop[gold_path[-1]]
+            for t in range(1, L):
+                gold += trans[gold_path[t - 1], gold_path[t]] + em[b, t, gold_path[t]]
+            np.testing.assert_allclose(float(nll[b]), logZ - gold, rtol=1e-4, atol=1e-4)
+
+    def test_viterbi_matches_bruteforce(self):
+        import itertools
+
+        from paddlenlp_tpu.layers import viterbi_decode
+
+        B, T, N = 2, 4, 3
+        rng = np.random.default_rng(2)
+        emissions = jnp.asarray(rng.normal(size=(B, T, N)), jnp.float32)
+        trans = jnp.asarray(rng.normal(size=(N, N)), jnp.float32)
+        lengths = jnp.asarray([4, 3], jnp.int32)
+        scores, paths = viterbi_decode(emissions, trans, lengths)
+        em, tr = np.asarray(emissions), np.asarray(trans)
+        for b in range(B):
+            L = int(lengths[b])
+            best, best_path = -np.inf, None
+            for path in itertools.product(range(N), repeat=L):
+                s = em[b, 0, path[0]] + sum(tr[path[t - 1], path[t]] + em[b, t, path[t]]
+                                            for t in range(1, L))
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(float(scores[b]), best, rtol=1e-5)
+            assert tuple(int(x) for x in np.asarray(paths[b])[:L]) == best_path
+
+    def test_crf_loss_trains(self):
+        """CRF NLL decreases under gradient descent on a learnable pattern."""
+        from paddlenlp_tpu.layers import LinearChainCrfLoss
+
+        emissions, lengths, tags = self._setup()
+        loss_mod = LinearChainCrfLoss(num_labels=4)
+        params = loss_mod.init(jax.random.key(0), emissions, lengths, tags)
+        loss_fn = lambda p: loss_mod.apply(p, emissions, lengths, tags)
+        l0 = float(loss_fn(params))
+        for _ in range(20):
+            grads = jax.grad(loss_fn)(params)
+            params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+        assert float(loss_fn(params)) < l0
+
+
+class TestRDrop:
+    def test_zero_for_identical(self):
+        from paddlenlp_tpu.losses import RDropLoss
+
+        p = jnp.asarray(np.random.default_rng(0).normal(size=(4, 8)), jnp.float32)
+        loss = RDropLoss(reduction="mean")(p, p)
+        np.testing.assert_allclose(float(loss), 0.0, atol=1e-6)
+
+    def test_positive_and_symmetric(self):
+        from paddlenlp_tpu.losses import RDropLoss
+
+        rng = np.random.default_rng(1)
+        p = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        crit = RDropLoss(reduction="mean")
+        assert float(crit(p, q)) > 0
+        np.testing.assert_allclose(float(crit(p, q)), float(crit(q, p)), rtol=1e-6)
+
+    def test_bad_reduction(self):
+        from paddlenlp_tpu.losses import RDropLoss
+
+        with pytest.raises(ValueError):
+            RDropLoss(reduction="avg")
+
+
+class TestSeq2Vec:
+    def _inputs(self, B=2, T=6, D=8):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+        mask = jnp.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.int32)
+        return x, mask
+
+    def test_bow_masked_sum(self):
+        from paddlenlp_tpu.seq2vec import BoWEncoder
+
+        x, mask = self._inputs()
+        out = BoWEncoder(emb_dim=8)(x, mask)
+        ref = np.asarray(x[0, :4]).sum(0)
+        np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-5)
+
+    def test_cnn_shapes(self):
+        from paddlenlp_tpu.seq2vec import CNNEncoder
+
+        x, mask = self._inputs()
+        enc = CNNEncoder(emb_dim=8, num_filter=16, ngram_filter_sizes=(2, 3))
+        params = enc.init(jax.random.key(0), x, mask)
+        out = enc.apply(params, x, mask)
+        assert out.shape == (2, 32)
+        assert np.isfinite(np.asarray(out)).all()
+
+    @pytest.mark.parametrize("cls_name", ["LSTMEncoder", "GRUEncoder", "RNNEncoder"])
+    def test_recurrent_encoders(self, cls_name):
+        import paddlenlp_tpu.seq2vec as s2v
+
+        x, mask = self._inputs()
+        enc = getattr(s2v, cls_name)(input_size=8, hidden_size=12, direction="bidirect",
+                                     pooling_type="mean")
+        params = enc.init(jax.random.key(0), x, mask)
+        out = enc.apply(params, x, mask)
+        assert out.shape == (2, 24)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_mask_freezes_padded_state(self):
+        """Last-state pooling must ignore pad positions: shorter sequence's
+        state equals running it without the padding."""
+        from paddlenlp_tpu.seq2vec import LSTMEncoder
+
+        x, mask = self._inputs()
+        enc = LSTMEncoder(input_size=8, hidden_size=6)
+        params = enc.init(jax.random.key(0), x, mask)
+        full = enc.apply(params, x, mask)
+        trimmed = enc.apply(params, x[:1, :4], jnp.ones((1, 4), jnp.int32))
+        np.testing.assert_allclose(np.asarray(full[0]), np.asarray(trimmed[0]), rtol=1e-5, atol=1e-6)
+
+
+class TestTokenEmbedding:
+    def test_search_and_sim(self, tmp_path):
+        from paddlenlp_tpu.embeddings import TokenEmbedding
+
+        vocab = ["king", "queen", "apple"]
+        mat = np.asarray([[1, 0, 0], [0.9, 0.1, 0], [0, 0, 1]], np.float32)
+        emb = TokenEmbedding(vocab=vocab, matrix=mat)
+        assert emb.search("king").shape == (1, 3)
+        assert emb.cosine_sim("king", "queen") > emb.cosine_sim("king", "apple")
+        # unknown word resolves to [UNK], not a crash
+        assert emb.search("zebra").shape == (1, 3)
+
+    def test_word2vec_text_load(self, tmp_path):
+        from paddlenlp_tpu.embeddings import TokenEmbedding
+
+        p = tmp_path / "vecs.txt"
+        p.write_text("2 3\nfoo 1.0 0.0 0.0\nbar 0.0 1.0 0.0\n")
+        emb = TokenEmbedding(str(p))
+        np.testing.assert_allclose(emb.search("foo")[0], [1, 0, 0])
+        ids = emb([emb.get_idx_from_word("bar")])
+        np.testing.assert_allclose(np.asarray(ids)[0], [0, 1, 0])
+
+
+class TestDataAug:
+    def test_substitute_and_insert(self):
+        from paddlenlp_tpu.dataaug import WordInsert, WordSubstitute
+
+        table = {"good": ["great", "fine"], "movie": ["film"]}
+        subst = WordSubstitute(custom_file_or_dict=table, create_n=2, aug_n=1, seed=0)
+        outs = subst("a good movie")
+        assert outs and all(o != "a good movie" for o in outs)
+        ins = WordInsert(custom_file_or_dict=table, create_n=1, aug_n=1, seed=0)
+        outs = ins("a good movie")
+        assert outs and len(outs[0].split()) == 4
+
+    def test_swap_delete(self):
+        from paddlenlp_tpu.dataaug import WordDelete, WordSwap
+
+        assert WordSwap(create_n=1, seed=1)("a b c d")[0] != "a b c d"
+        out = WordDelete(create_n=1, aug_n=2, seed=1)("a b c d")[0]
+        assert len(out.split()) == 2
+
+    def test_requires_table(self):
+        from paddlenlp_tpu.dataaug import WordSubstitute
+
+        with pytest.raises(ValueError):
+            WordSubstitute()
+
+
+class TestAutoNLP:
+    def test_search_picks_better_lr(self, tmp_path):
+        from paddlenlp_tpu.experimental.autonlp import AutoTrainerForTextClassification
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        rng = np.random.default_rng(0)
+        rows = [rng.integers(2, 60, 12).astype(np.int32) for _ in range(32)]
+
+        class DS:
+            def __len__(self):
+                return len(rows)
+
+            def __getitem__(self, i):
+                return {"input_ids": rows[i], "labels": rows[i].copy()}
+
+        def factory(cand):
+            cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                              num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+                              max_position_embeddings=32)
+            return LlamaForCausalLM.from_config(cfg, seed=0)
+
+        auto = AutoTrainerForTextClassification(
+            DS(), DS(), model_factory=factory, output_dir=str(tmp_path),
+            model_candidates=[{"learning_rate": 1e-6}, {"learning_rate": 5e-3}],
+        )
+        best = auto.train(max_steps=8, per_device_train_batch_size=4)
+        assert len(auto.trials) == 2
+        # the larger lr must fit the toy data far better over 8 steps
+        assert best.candidate["learning_rate"] == 5e-3
+        board = auto.visualize()
+        assert board[0]["trial_id"] == best.trial_id
+        export = auto.export(str(tmp_path / "best"))
+        import os
+
+        assert os.path.isfile(os.path.join(export, "model.safetensors"))

@@ -98,6 +98,49 @@ def _post(port, path, payload):
         return json.loads(r.read())
 
 
+class TestTokenClassificationTasks:
+    @pytest.fixture(scope="class")
+    def ner_dir(self, tmp_path_factory):
+        from tokenizers import Tokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+        from paddlenlp_tpu.transformers.ernie.configuration import ErnieConfig
+        from paddlenlp_tpu.transformers.ernie.modeling import ErnieForTokenClassification
+
+        root = tmp_path_factory.mktemp("ner")
+        vocab = {"<pad>": 0, "<unk>": 1}
+        for i, w in enumerate("alice visited paris yesterday bob".split()):
+            vocab[w] = i + 2
+        t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", unk_token="<unk>").save_pretrained(str(root))
+        cfg = ErnieConfig(vocab_size=16, hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+                          intermediate_size=64, max_position_embeddings=64, num_labels=5,
+                          id2label={"0": "O", "1": "B-PER", "2": "I-PER", "3": "B-LOC", "4": "I-LOC"})
+        ErnieForTokenClassification.from_config(cfg, seed=0).save_pretrained(str(root))
+        return str(root)
+
+    def test_ner_spans(self, ner_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        flow = Taskflow("ner", task_path=ner_dir)
+        out = flow("alice visited paris")
+        assert out["text"] == "alice visited paris"
+        for tag in out["tags"]:
+            assert out["text"][tag["start"]:tag["end"]] == tag["token"]
+            assert tag["label"] in ("O", "PER", "LOC")
+
+    def test_word_segmentation_and_pos(self, ner_dir):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        words = Taskflow("word_segmentation", task_path=ner_dir)("alice visited paris")
+        assert all(isinstance(w, str) for w in words)
+        pos = Taskflow("pos_tagging", task_path=ner_dir)("alice visited paris")
+        assert all(isinstance(w, str) and isinstance(l, str) for w, l in pos)
+
+
 class TestSimpleServer:
     def test_taskflow_and_model_routes(self, uie_dir, tmp_path):
         from tokenizers import Tokenizer

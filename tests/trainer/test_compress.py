@@ -57,6 +57,23 @@ class TestCompress:
         logits = reloaded(input_ids=jnp.asarray([[5, 6, 7]], jnp.int32)).logits
         assert np.isfinite(np.asarray(logits)).all()
 
+    def test_width_prune_bert(self, tmp_path):
+        """dynabert's actual target archs (bert/ernie encoders) must prune too
+        (round-2 weak item: compression was llama-family-only)."""
+        from paddlenlp_tpu.transformers import BertConfig, BertForSequenceClassification
+
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=64,
+                         max_position_embeddings=64, num_labels=2)
+        model = BertForSequenceClassification.from_config(cfg, seed=0)
+        trainer = Trainer(model=model, args=TrainingArguments(output_dir=str(tmp_path)),
+                          train_dataset=dataset())
+        out = trainer.compress(strategy="prune", width_mult=0.5)
+        reloaded = BertForSequenceClassification.from_pretrained(out)
+        assert reloaded.config.intermediate_size == 32
+        logits = reloaded(input_ids=jnp.asarray([[5, 6, 7]], jnp.int32)).logits
+        assert np.isfinite(np.asarray(logits)).all()
+
 
 class TestArgKnobs:
     def test_obsolete_fleet_options_warn(self, tmp_path):

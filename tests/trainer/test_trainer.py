@@ -351,15 +351,25 @@ class TestIntegrations:
     def test_profiler_options_writes_trace(self, tmp_path):
         """--profiler_options drives jax.profiler over the step window
         (reference utils/profiler.py add_profiler_step)."""
-        import paddlenlp_tpu.utils.profiler as prof
-
-        prof._GLOBAL = None  # isolate from other tests
         trace_dir = str(tmp_path / "trace")
         args = make_args(tmp_path, max_steps=4)
         args.profiler_options = f"batch_range=[1,3];profile_path={trace_dir}"
         t = Trainer(model=tiny_model(), args=args, train_dataset=ToyLMDataset())
         t.train()
         # jax writes <dir>/plugins/profile/<ts>/*.xplane.pb
+        hits = []
+        for root, _, files in os.walk(trace_dir):
+            hits += [f for f in files if f.endswith(".xplane.pb")]
+        assert hits, f"no xplane trace under {trace_dir}"
+
+    def test_profiler_window_open_at_train_end_still_flushes(self, tmp_path):
+        """Training ending inside the batch_range window must still stop the
+        trace and write the xplane (and not wedge the process profiler)."""
+        trace_dir = str(tmp_path / "trace2")
+        args = make_args(tmp_path, max_steps=2)
+        args.profiler_options = f"batch_range=[1,10];profile_path={trace_dir}"
+        t = Trainer(model=tiny_model(), args=args, train_dataset=ToyLMDataset())
+        t.train()
         hits = []
         for root, _, files in os.walk(trace_dir):
             hits += [f for f in files if f.endswith(".xplane.pb")]

@@ -43,6 +43,14 @@ from paddlenlp_tpu.transformers import (
     Qwen2ForCausalLM,
     Qwen2MoeConfig,
     Qwen2MoeForCausalLM,
+    RWConfig,
+    RWForCausalLM,
+    ChatGLMConfig,
+    ChatGLMForCausalLM,
+    YuanConfig,
+    YuanForCausalLM,
+    JambaConfig,
+    JambaForCausalLM,
 )
 
 TINY = dict(hidden_size=64, num_hidden_layers=2, num_attention_heads=4, max_position_embeddings=64,
@@ -85,6 +93,25 @@ CAUSAL_CASES = {
                       "mscale": 0.707, "mscale_all_dim": 0.707,
                       "beta_fast": 32, "beta_slow": 1},
         **TINY)),
+    # hybrid: NoPE attention at layer 1, mamba elsewhere; MoE ffn on odd layers
+    "jamba": (JambaForCausalLM, lambda: JambaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        initializer_range=0.02, num_experts=4, num_experts_per_tok=2,
+        attn_layer_period=4, attn_layer_offset=1, expert_layer_period=2, expert_layer_offset=1,
+        mamba_d_state=8, mamba_d_conv=4, mamba_expand=2, mamba_dt_rank=8)),
+    # localized-filtering gate (two causal convs) ahead of q/k; v from raw hiddens
+    "yuan": (YuanForCausalLM, lambda: YuanConfig(vocab_size=96, intermediate_size=112,
+                                                 num_key_value_heads=2, **TINY)),
+    # GLM v1: 2D rotary halves, alpha-scaled post-LN residuals, per-head-thirds fused qkv
+    "chatglm": (ChatGLMForCausalLM, lambda: ChatGLMConfig(vocab_size=96, intermediate_size=128,
+                                                          bos_token_id=None, eos_token_id=None,
+                                                          generation_2d_positions=False, **TINY)),
+    # falcon-7b shape: MQ fused qkv + parallel_attn + rotary; rw-1b shape: MHA + alibi
+    "rw_falcon": (RWForCausalLM, lambda: RWConfig(vocab_size=96, multi_query=True,
+                                                  parallel_attn=True, bias=False, **TINY)),
+    "rw_alibi": (RWForCausalLM, lambda: RWConfig(vocab_size=96, multi_query=False,
+                                                 parallel_attn=False, bias=True, alibi=True, **TINY)),
     # attention-free SSM: associative-scan recurrence + conv/ssm state cache
     "mamba": (MambaForCausalLM, lambda: MambaConfig(
         vocab_size=96, hidden_size=64, num_hidden_layers=2, state_size=8,
