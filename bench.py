@@ -6,10 +6,13 @@ failure record (value 0, "error" field) is emitted instead of a traceback.
 
 Architecture: the top-level process never imports jax. It (1) probes the
 backend with a tiny matmul in a subprocess under a hard timeout (a hung TPU
-tunnel cannot block `subprocess.run(timeout=...)`), retrying once, then
-(2) runs the real benchmark in a second subprocess under its own timeout and
-relays the JSON line. jax's `block_until_ready` on a wedged backend hangs
-uninterruptibly in-process; process isolation is the only reliable watchdog.
+tunnel cannot block `subprocess.run(timeout=...)`), retrying across the whole
+PROBE_BUDGET_S window since tunnel outages are transient, then (2) runs the
+real benchmark in a second subprocess under its own timeout (one mid-run
+retry) and relays the JSON line. jax's `block_until_ready` on a wedged backend
+hangs uninterruptibly in-process; process isolation is the only reliable
+watchdog. Successful real-TPU measurements persist to BENCH_LASTGOOD.json and
+are embedded (labeled stale) in any later failure record.
 
 Baseline: the reference's published LLaMA-7B pretrain number — 3754.73
 tokens/card/sec on A100-80G (llm/docs/pretrain.rst:188, BASELINE.md), which is
@@ -30,23 +33,53 @@ import time
 
 METRIC = "llama350m_pretrain_mfu"
 UNIT = "model_flops_utilization (vs A100 llama7b baseline MFU 0.525)"
-PROBE_TIMEOUT_S = float(os.environ.get("PDNLP_BENCH_PROBE_TIMEOUT", 180))
+PROBE_TIMEOUT_S = float(os.environ.get("PDNLP_BENCH_PROBE_TIMEOUT", 75))
+# Total wall budget for the probe phase: attempts are spread across this window
+# (VERDICT r3: 2 probes ~190s apart lost a tunnel that came back 40 min later).
+PROBE_BUDGET_S = float(os.environ.get("PDNLP_BENCH_PROBE_BUDGET", 1500))
+PROBE_RETRY_SLEEP_S = float(os.environ.get("PDNLP_BENCH_PROBE_SLEEP", 90))
 RUN_TIMEOUT_S = float(os.environ.get("PDNLP_BENCH_RUN_TIMEOUT", 1500))
+LASTGOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LASTGOOD.json")
+
+
+def _read_last_good() -> dict | None:
+    """Last real TPU measurement, persisted across rounds (BENCH_LASTGOOD.json).
+
+    A transient tunnel wedge at bench time must not erase real data: the record
+    is embedded (clearly labeled stale) in failure output; the round value
+    stays 0.0."""
+    try:
+        with open(LASTGOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_last_good(rec: dict) -> None:
+    import datetime
+
+    keep = {k: rec[k] for k in ("metric", "value", "tokens_per_second_per_chip", "device") if k in rec}
+    keep["measured_at"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    try:
+        with open(LASTGOOD_PATH, "w") as f:
+            json.dump(keep, f)
+    except OSError:
+        pass
 
 
 def _fail(reason: str, extra: dict | None = None) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": UNIT,
-                "vs_baseline": 0.0,
-                **(extra or {}),
-                "error": reason[:2000],
-            }
-        )
-    )
+    last_good = _read_last_good()
+    record = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        **(extra or {}),
+        "error": reason[:2000],
+    }
+    if last_good:
+        record["stale_last_good"] = {**last_good, "stale": True}
+    print(json.dumps(record))
     sys.exit(1)
 
 
@@ -207,8 +240,9 @@ def _json_line(out: str) -> str:
 
 
 def _cpu_diag() -> float:
-    """Tiny CPU-path run: a trendable tokens/sec number for every round, even
-    when the TPU tunnel is wedged (VERDICT r2: two rounds logged no signal)."""
+    """Tiny CPU-path run, invoked only on failure paths: a trendable
+    tokens/sec number for rounds where the TPU tunnel is wedged (VERDICT r2:
+    two rounds logged no signal; ADVICE r3: don't pay its latency on success)."""
     rc, out, _ = _spawn(["--run", "--tiny"], 600, env={"JAX_PLATFORMS": "cpu"})
     line = _json_line(out)
     if rc == 0 and line:
@@ -221,33 +255,59 @@ def _cpu_diag() -> float:
 
 def main() -> None:
     tiny = "--tiny" in sys.argv
-    extra = {"cpu_tokens_per_sec": _cpu_diag()}
 
-    # 1. backend probe, one retry with backoff
-    for attempt in range(2):
+    # 1. backend probe: keep retrying across the whole probe budget — tunnel
+    #    outages are transient (r3: wedged at 14:25Z, bench ran at 16:45Z).
+    t_start = time.time()
+    attempt = 0
+    probe_ok = False
+    rc, out, err = -1, "", "no probe attempt ran (PROBE_BUDGET_S <= 0?)"
+    while time.time() - t_start < PROBE_BUDGET_S:
+        attempt += 1
         rc, out, err = _spawn(["--probe"], PROBE_TIMEOUT_S)
         if rc == 0:
+            probe_ok = True
             break
-        if attempt == 0:
-            time.sleep(10)
-    else:
+        remaining = PROBE_BUDGET_S - (time.time() - t_start)
+        print(
+            f"[bench] probe attempt {attempt} failed rc={rc}; {remaining:.0f}s of budget left",
+            file=sys.stderr, flush=True,
+        )
+        if remaining > PROBE_RETRY_SLEEP_S:
+            time.sleep(PROBE_RETRY_SLEEP_S)
+        else:
+            break
+    if not probe_ok:
+        extra = {"probe_attempts": attempt, "cpu_tokens_per_sec": _cpu_diag()}
         tail = "\n".join((out.strip().splitlines() + err.strip().splitlines())[-6:])
         _fail(f"backend probe failed rc={rc}: {tail}", extra)
 
-    # 2. real benchmark
+    # 2. real benchmark, one retry if the tunnel wedges mid-run
     argv = ["--run"] + (["--tiny"] if tiny else [])
-    rc, out, err = _spawn(argv, RUN_TIMEOUT_S)
-    line = _json_line(out)
-    if rc == 0 and line:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            _fail(f"bench subprocess printed unparseable result line: {line[:500]}", extra)
-        rec.update(extra)
-        print(json.dumps(rec))
-        return
+    for run_attempt in range(2):
+        rc, out, err = _spawn(argv, RUN_TIMEOUT_S)
+        line = _json_line(out)
+        if rc == 0 and line:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if run_attempt == 0:
+                    time.sleep(30)
+                    continue
+                _fail(
+                    f"bench subprocess printed unparseable result line: {line[:500]}",
+                    {"cpu_tokens_per_sec": _cpu_diag()},
+                )
+            if rec.get("value", 0) > 0 and "cpu" not in rec.get("device", "").lower():
+                # only real-TPU measurements become the stale-fallback record
+                _write_last_good(rec)
+            print(json.dumps(rec))
+            return
+        if run_attempt == 0:
+            print(f"[bench] run attempt 1 failed rc={rc}; retrying once", file=sys.stderr, flush=True)
+            time.sleep(30)
     tail = "\n".join((out.strip().splitlines() + err.strip().splitlines())[-8:])
-    _fail(f"bench run failed rc={rc}: {tail}", extra)
+    _fail(f"bench run failed rc={rc}: {tail}", {"cpu_tokens_per_sec": _cpu_diag()})
 
 
 if __name__ == "__main__":

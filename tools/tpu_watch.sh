@@ -1,0 +1,26 @@
+#!/bin/bash
+# Background TPU tunnel watcher. Probes the axon backend every ~3 minutes and
+# records the latest status in tools/tpu_status.json so the builder can poll
+# cheaply. Appends history to tools/tpu_watch.log.
+cd /root/repo
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  out=$(timeout 75 python - <<'EOF' 2>&1
+import jax
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+y = (x @ x).sum()
+print("LIVE", ds[0].platform, float(y))
+EOF
+)
+  rc=$?
+  if [ $rc -eq 0 ] && echo "$out" | grep -q LIVE; then
+    status=live
+  else
+    status=down
+  fi
+  echo "{\"ts\": \"$ts\", \"status\": \"$status\", \"rc\": $rc}" > tools/tpu_status.json
+  echo "$ts $status rc=$rc" >> tools/tpu_watch.log
+  sleep 150
+done
