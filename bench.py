@@ -130,16 +130,19 @@ def run_bench(tiny: bool) -> None:
         # scan-stacked layers (the default) keep the HLO small: one traced layer
         # body regardless of depth — large unrolled compiles once wedged the
         # axon relay, scan avoids that class of failure entirely.
-        # recompute_granularity="full": the v5e-lite chip has 16 GB HBM and the
-        # scanned backward stashes at core_attn granularity (~20 × [24,B,T,·]
-        # bf16 buffers) blow past it; full remat saves only layer boundaries.
-        # MFU is still accounted on the useful 6N FLOPs, so remat overhead
-        # shows up as (honestly) lower reported MFU.
+        # recompute_granularity: the v5e-lite chip has 16 GB HBM. "full" remat
+        # (save only layer boundaries) is the safe default; the save_only_*
+        # tiers (save_core_attn / save_qkv_attn / save_attn_mlp) keep a few
+        # named activations to cut backward recompute — sweepable via
+        # PDNLP_BENCH_REMAT (see --sweep). MFU is accounted on the useful 6N
+        # FLOPs, so remat overhead shows up as (honestly) lower reported MFU.
+        remat = os.environ.get("PDNLP_BENCH_REMAT", "full")
+        use_scan = os.environ.get("PDNLP_BENCH_SCAN", "1") != "0"
         config = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816, num_hidden_layers=24,
             num_attention_heads=16, num_key_value_heads=16, max_position_embeddings=4096,
-            recompute=True, recompute_granularity="full",
-            use_flash_attention=use_flash,
+            recompute=remat != "none", recompute_granularity=remat if remat != "none" else "full",
+            use_flash_attention=use_flash, use_scan_layers=use_scan,
         )
         batch, seq_len, steps = 8, 2048, 10
 
@@ -187,11 +190,16 @@ def run_bench(tiny: bool) -> None:
     float(loss)
     mark("compiled; timing")
 
+    trace_dir = os.environ.get("PDNLP_BENCH_TRACE", "")
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     t0 = time.time()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, ids)
     float(loss)
     dt = time.time() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
     mark(f"done dt={dt:.2f}s")
 
     tokens = batch * seq_len * steps
@@ -310,9 +318,80 @@ def main() -> None:
     _fail(f"bench run failed rc={rc}: {tail}", {"cpu_tokens_per_sec": _cpu_diag()})
 
 
+def sweep() -> None:
+    """Hardware tuning sweep: run the full bench across (remat, scan, flash
+    blocks) configs, appending each result to BENCH_SWEEP.jsonl. Resumable —
+    configs already recorded (ok or failed) are skipped. Budget-aware via
+    PDNLP_BENCH_SWEEP_BUDGET (default 3600 s)."""
+    budget = float(os.environ.get("PDNLP_BENCH_SWEEP_BUDGET", 3600))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_SWEEP.jsonl")
+    done = set()
+    if os.path.exists(path):
+        with open(path) as f:
+            for ln in f:
+                try:
+                    done.add(json.loads(ln)["config_key"])
+                except (ValueError, KeyError):
+                    pass
+
+    configs = []
+    # remat tiers first (biggest expected lever), default 128x128 blocks
+    for remat in ("save_attn_mlp", "save_qkv_attn", "save_core_attn", "save_dots", "full"):
+        configs.append({"remat": remat, "scan": "1", "bq": 128, "bkv": 128})
+    # flash tile sweep on the default remat
+    for bq, bkv in ((256, 256), (512, 512), (256, 512), (128, 512), (512, 256), (128, 1024)):
+        configs.append({"remat": "save_qkv_attn", "scan": "1", "bq": bq, "bkv": bkv})
+    # unrolled-layer comparison (VERDICT r3 1d: is scan blocking XLA overlap?)
+    configs.append({"remat": "save_qkv_attn", "scan": "0", "bq": 128, "bkv": 128})
+    configs.append({"remat": "none", "scan": "1", "bq": 128, "bkv": 128})
+
+    t0 = time.time()
+    for cfg in configs:
+        key = f"{cfg['remat']}|scan{cfg['scan']}|bq{cfg['bq']}|bkv{cfg['bkv']}"
+        if key in done:
+            continue
+        if time.time() - t0 > budget:
+            print(f"[sweep] budget exhausted; stopping before {key}", file=sys.stderr)
+            break
+        env = {
+            "PDNLP_BENCH_REMAT": cfg["remat"],
+            "PDNLP_BENCH_SCAN": cfg["scan"],
+            "PDNLP_FLASH_BLOCK_Q": str(cfg["bq"]),
+            "PDNLP_FLASH_BLOCK_KV": str(cfg["bkv"]),
+        }
+        print(f"[sweep] running {key}", file=sys.stderr, flush=True)
+        rc, out, err = _spawn(["--run"], min(RUN_TIMEOUT_S, 600), env=env)
+        line = _json_line(out)
+        rec = {"config_key": key, **cfg, "rc": rc, "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        if rc == 0 and line:
+            try:
+                rec.update(json.loads(line))
+            except ValueError:
+                rec["error"] = f"unparseable: {line[:200]}"
+        else:
+            rec["error"] = "\n".join((out.strip().splitlines() + err.strip().splitlines())[-4:])[:500]
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        val = rec.get("value", 0.0)
+        print(f"[sweep] {key} -> mfu={val} rc={rc}", file=sys.stderr, flush=True)
+    # summary: best config
+    best = None
+    with open(path) as f:
+        for ln in f:
+            try:
+                r = json.loads(ln)
+            except ValueError:
+                continue
+            if r.get("value", 0) > (best or {}).get("value", 0):
+                best = r
+    print(json.dumps({"sweep_best": best}))
+
+
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         probe()
+    elif "--sweep" in sys.argv:
+        sweep()
     elif "--run" in sys.argv:
         run_bench("--tiny" in sys.argv)
     else:

@@ -173,10 +173,26 @@ def _pallas_dispatch(query, key, value, segment_ids, scale, window):
     batch/head mesh axes (the GSPMD composition the reference gets from fleet's
     per-rank kernel launches). Returns None when the active sharding cannot be
     expressed (fall back to the XLA path)."""
+    import os
+
     from jax.sharding import Mesh, PartitionSpec as PS
 
     from ..parallel.partition import _current_mesh
-    from .pallas.flash_attention import flash_attention as pallas_flash
+    from .pallas.flash_attention import flash_attention as _pf
+
+    # hardware-sweepable tile sizes (tools/bench sweep; default 128x128).
+    # Invalid values fall back to the default rather than crashing at the
+    # ENCLOSING jit's compile (same contract as the shape gate below).
+    def _tile(env_name):
+        try:
+            b = int(os.environ.get(env_name, 128))
+        except ValueError:
+            return 128
+        return b if b >= 128 and b % 128 == 0 else 128
+
+    pallas_flash = functools.partial(
+        _pf, block_q=_tile("PDNLP_FLASH_BLOCK_Q"), block_kv=_tile("PDNLP_FLASH_BLOCK_KV")
+    )
 
     B, T, N, H = query.shape
     K = key.shape[2]

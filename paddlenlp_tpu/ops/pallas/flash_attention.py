@@ -14,8 +14,11 @@ Structure (classic flash-attention-2 schedule):
   no materialized repeat;
 - backward: two kernels re-streaming K/V — dq (kv innermost) and dk/dv
   (q innermost), with p recomputed from the saved logsumexp and
-  delta = rowsum(dO*O) precomputed by XLA. dk/dv are produced per QUERY head and
-  group-summed outside the kernel (simple, race-free GQA handling);
+  delta = rowsum(dO*O) precomputed by XLA. dk/dv accumulate per KV head INSIDE
+  the kernel: the grid batch axis is B*K and the innermost sequential axis
+  walks (query-head-in-group, q-block) pairs, so for an N/K = g GQA model the
+  dk/dv output traffic and K/V re-streaming drop by g× versus the per-query-head
+  scheme (outputs were [B*N, S, H] + an XLA group-sum pass; now [B*K, S, H]);
 - ``segment_ids`` restricts attention to same-segment tokens (ZeroPadding packed
   batches); ``window`` adds the mistral sliding-window lower bound.
 
@@ -221,12 +224,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_r
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_ref,
                     dk_ref, dv_ref, dk_scratch, dv_scratch, *,
-                    scale, block_q, block_kv, causal, window, q_len, kv_len, use_segments):
+                    scale, block_q, block_kv, causal, window, q_len, kv_len, use_segments,
+                    n_q):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    j = pl.program_id(2)  # walks (query-head-in-group, q-block) pairs
+    n_j = pl.num_programs(2)
+    qi = j % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scratch[...] = jnp.zeros_like(dk_scratch)
         dv_scratch[...] = jnp.zeros_like(dv_scratch)
@@ -256,7 +261,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sq_ref, sk_
         ds = p * (dp - delta) * scale
         dk_scratch[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))  # ds^T @ q
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(j == n_j - 1)
     def _finalize():
         dk_ref[0] = dk_scratch[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scratch[...].astype(dv_ref.dtype)
@@ -303,26 +308,30 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
         interpret=interpret,
     )(qf, kf, vf, dof, lse3, delta, seg_q3, seg_k3)
 
+    # dk/dv: grid batch axis is the B*K kv heads; the sequential axis walks the
+    # group*n_q (query-head-in-group, q-block) pairs so dk/dv for a kv head
+    # accumulate in VMEM across its whole query group (no outside group-sum).
+    qhead = lambda bk, j, g_=group, nq=n_q: bk * g_ + j // nq
     dk_p, dv_p = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(B * N, n_k, n_q),
+        functools.partial(_bwd_dkv_kernel, **common, n_q=n_q),
+        grid=(B * K, n_k, group * n_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, H), lambda bn, ki, qi: (bn, qi, 0)),
-            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi, g_=group: (bn // g_, ki, 0)),
-            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi, g_=group: (bn // g_, ki, 0)),
-            pl.BlockSpec((1, block_q, H), lambda bn, ki, qi: (bn, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bn, ki, qi: (bn, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bn, ki, qi: (bn, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bn, ki, qi, n=N: (bn // n, qi, 0)),
-            pl.BlockSpec((1, 1, block_kv), lambda bn, ki, qi, n=N: (bn // n, 0, ki)),
+            pl.BlockSpec((1, block_q, H), lambda bk, ki, j, nq=n_q: (qhead(bk, j), j % nq, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bk, ki, j: (bk, ki, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bk, ki, j: (bk, ki, 0)),
+            pl.BlockSpec((1, block_q, H), lambda bk, ki, j, nq=n_q: (qhead(bk, j), j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bk, ki, j, nq=n_q: (qhead(bk, j), j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bk, ki, j, nq=n_q: (qhead(bk, j), j % nq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bk, ki, j, kk=K, nq=n_q: (bk // kk, j % nq, 0)),
+            pl.BlockSpec((1, 1, block_kv), lambda bk, ki, j, kk=K: (bk // kk, 0, ki)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi: (bn, ki, 0)),
-            pl.BlockSpec((1, block_kv, H), lambda bn, ki, qi: (bn, ki, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bk, ki, j: (bk, ki, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda bk, ki, j: (bk, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * N, S, H), jnp.float32),
-            jax.ShapeDtypeStruct((B * N, S, H), jnp.float32),
+            jax.ShapeDtypeStruct((B * K, S, H), jnp.float32),
+            jax.ShapeDtypeStruct((B * K, S, H), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_kv, H), jnp.float32),
@@ -333,9 +342,8 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
     )(qf, kf, vf, dof, lse3, delta, seg_q3, seg_k3)
 
     dq = dq.reshape(B, N, T, H).transpose(0, 2, 1, 3)
-    # per-query-head dk/dv -> group-sum onto the K kv heads
-    dk = dk_p.reshape(B, K, group, S, H).sum(axis=2).transpose(0, 2, 1, 3).astype(k.dtype)
-    dv = dv_p.reshape(B, K, group, S, H).sum(axis=2).transpose(0, 2, 1, 3).astype(v.dtype)
+    dk = dk_p.reshape(B, K, S, H).transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv_p.reshape(B, K, S, H).transpose(0, 2, 1, 3).astype(v.dtype)
     return dq, dk, dv
 
 

@@ -14,8 +14,13 @@ path (2x bf16 throughput) — with the dequant rescale fused onto the output:
   needed), or a calibrated per-tensor static scale from ``collect_act_scales``
   (absmax over calibration batches — the reference's PTQ observer).
 
-Scope: the unrolled layer layout (``use_scan_layers=False``), same constraint
-as GPTQ calibration — nn.scan hides per-layer Dense calls from interception.
+Works in BOTH layer layouts: the interceptor reads ``qweight``/``scales`` from
+the intercepted Dense module's own variable scope, so under ``nn.scan`` (the
+default stacked [L] layout) it sees the per-layer slices nn.scan carves from
+the stacked quantized params — no flat-path lookup, no layout restriction.
+Only CALIBRATION (``collect_act_scales``, which must observe concrete
+per-layer activations) still needs the unrolled layout; dynamic per-token
+scales (the default) never calibrate.
 """
 
 from __future__ import annotations
@@ -62,7 +67,11 @@ def int8_linear(
 
 def collect_act_scales(model, batches: List[Dict], match=None) -> Dict[str, float]:
     """Calibration pass: per-Dense per-tensor activation absmax/127 (the PTQ
-    observer). Keys are flat kernel paths (``.../q_proj/kernel``)."""
+    observer). Keys are flat UNROLLED kernel paths (``.../q_proj/kernel``);
+    scan-layout models are observed through ``unrolled_twin``."""
+    from .quantization_utils import unrolled_twin
+
+    model = unrolled_twin(model)
     flat = dict(flatten_params(model.params))
     targets = {p for p, v in flat.items() if p.endswith("/kernel") and getattr(v, "ndim", 0) >= 2}
     if match is not None:
@@ -87,20 +96,70 @@ def collect_act_scales(model, batches: List[Dict], match=None) -> Dict[str, floa
 def a8w8_interceptor(flat_params: Dict[str, jnp.ndarray], out_dtype,
                      act_scales: Optional[Dict[str, float]] = None):
     """Method interceptor: Dense modules whose kernel was int8-quantized run
-    through ``int8_linear`` instead of the fp matmul."""
+    through ``int8_linear`` instead of the fp matmul.
+
+    Quantized leaves are read from the module's OWN variable scope
+    (``mod.variables``): under ``nn.scan`` those are the per-layer slices of
+    the stacked [L, in, out] qweight, so the stacked layout works transparently.
+    ``flat_params`` is kept only as a fallback for callers composing the
+    interceptor with modules applied on a different tree."""
 
     def interceptor(next_fn, args, kwargs, context):
         mod = context.module
         if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            scope = mod.variables.get("params", {})
             path = "/".join(str(p) for p in mod.path)
-            q = flat_params.get(path + "/qweight")
+            q = scope.get("qweight", flat_params.get(path + "/qweight"))
             if q is not None:
+                act = scope.get("act_scale")  # per-layer slice (fold_act_scales)
+                if act is None and act_scales is not None:
+                    act = act_scales.get(path + "/kernel")
                 return int8_linear(
-                    args[0], q, flat_params[path + "/scales"],
-                    bias=flat_params.get(path + "/bias"),
-                    act_scale=None if act_scales is None else act_scales.get(path + "/kernel"),
+                    args[0], q,
+                    scope.get("scales", flat_params.get(path + "/scales")),
+                    bias=scope.get("bias", flat_params.get(path + "/bias")),
+                    act_scale=act,
                     out_dtype=out_dtype,
                 )
         return next_fn(*args, **kwargs)
 
     return interceptor
+
+
+def fold_act_scales(params: dict, act_scales: Dict[str, float]) -> dict:
+    """Calibrated per-tensor activation scales (unrolled ``.../kernel`` keys)
+    -> ``act_scale`` leaves inside each quantized Dense scope. For the scan
+    layout the per-layer values stack along the leading axes, so nn.scan
+    slices the right layer's scale into the intercepted Dense."""
+    from ..transformers.conversion_utils import resolve_stacked_key, unflatten_params
+
+    flat = dict(flatten_params(params))
+    adds: Dict[str, jnp.ndarray] = {}
+    stacked: Dict[str, Dict[tuple, float]] = {}
+    for key, val in act_scales.items():
+        if not key.endswith("/kernel"):
+            continue
+        qkey = key[: -len("/kernel")] + "/qweight"
+        if qkey in flat:
+            adds[key[: -len("/kernel")] + "/act_scale"] = jnp.asarray(val, jnp.float32)
+            continue
+        hit = resolve_stacked_key(qkey, flat)
+        if hit is not None:
+            skey, idxs = hit
+            stacked.setdefault(skey, {})[idxs] = val
+    for skey, items in stacked.items():
+        lead = flat[skey].shape[:-2]
+        arr = np.zeros(lead, np.float32)
+        mask = np.zeros(lead, bool)
+        for idxs, val in items.items():
+            arr[idxs] = val
+            mask[idxs] = True
+        if not mask.all():
+            logger.warning(
+                f"act scales cover {int(mask.sum())}/{mask.size} slices of {skey}; "
+                "leaving that projection on dynamic per-token scales"
+            )
+            continue
+        adds[skey[: -len("/qweight")] + "/act_scale"] = jnp.asarray(arr)
+    flat.update(adds)
+    return unflatten_params(flat)

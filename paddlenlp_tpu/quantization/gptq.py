@@ -74,25 +74,21 @@ def gptq_quantize(
 def collect_hessians(model, batches: List[Dict], target_suffix: str = "/kernel",
                      match=None) -> Dict[str, np.ndarray]:
     """Run calibration batches eagerly, accumulating H = sum_i x_i x_i^T per
-    matched Dense kernel (keyed by flat param path).
+    matched Dense kernel (keyed by flat UNROLLED param path).
 
-    Requires the UNROLLED layer layout (``use_scan_layers=False``): nn.scan
-    traces its body once, so per-layer inputs are not observable — reload the
-    checkpoint with ``use_scan_layers=False`` for calibration (checkpoints are
-    layout-independent)."""
+    nn.scan traces its body once, so per-layer inputs are not observable in
+    the stacked layout — scan-layout models are calibrated through
+    ``unrolled_twin`` (same weights, per-layer slices) automatically."""
     import flax.linen as nn
 
+    from .quantization_utils import unrolled_twin
+
+    model = unrolled_twin(model)
     flat = dict(flatten_params(model.params))
     targets = {p for p, v in flat.items()
                if p.endswith(target_suffix) and getattr(v, "ndim", 0) >= 2}
     if match is not None:
         targets = {p for p in targets if match(p)}
-    stacked = [p for p in targets if getattr(flat[p], "ndim", 0) == 3]
-    if stacked:
-        raise ValueError(
-            "GPTQ calibration needs the unrolled layer layout: reload with "
-            f"use_scan_layers=False (stacked kernels: {stacked[:3]}...)"
-        )
     hessians: Dict[str, np.ndarray] = {}
 
     def interceptor(next_fn, args, kwargs, context):
@@ -113,16 +109,32 @@ def collect_hessians(model, batches: List[Dict], target_suffix: str = "/kernel",
 
 def apply_gptq(model, batches: List[Dict], bits: int = 4, group_size: int = -1,
                match=None) -> dict:
-    """GPTQ-calibrate + rewrite: returns a params tree whose matched kernels are
-    replaced with their GPTQ-dequantized values (pass to quantize_params for int
-    storage)."""
+    """GPTQ-calibrate + rewrite: returns a params tree (in the MODEL's layout,
+    stacked or unrolled) whose matched kernels are replaced with their
+    GPTQ-dequantized values (pass to quantize_params for int storage).
+
+    Hessians come back keyed by unrolled paths; for scan-layout models each
+    per-layer slice of a stacked [L, in, out] kernel is quantized with its own
+    layer's Hessian and written back in place."""
+    from ..transformers.conversion_utils import resolve_stacked_key
+
     hessians = collect_hessians(model, batches, match=match)
     flat = dict(flatten_params(model.params))
+    pending: Dict[str, np.ndarray] = {}  # stacked path -> mutable host copy
     n = 0
     for path, H in hessians.items():
-        w = np.asarray(jax.device_get(flat[path]))
-        out = gptq_quantize(w, H, bits, group_size)[0]
-        flat[path] = jnp.asarray(out, flat[path].dtype)
+        hit = resolve_stacked_key(path, flat) if path not in flat else None
+        if hit is None:
+            w = np.asarray(jax.device_get(flat[path]))
+            flat[path] = jnp.asarray(gptq_quantize(w, H, bits, group_size)[0], flat[path].dtype)
+        else:
+            key, idxs = hit
+            if key not in pending:
+                pending[key] = np.array(jax.device_get(flat[key]))
+            w = pending[key][idxs]
+            pending[key][idxs] = gptq_quantize(w, H, bits, group_size)[0]
         n += 1
+    for key, arr in pending.items():
+        flat[key] = jnp.asarray(arr, flat[key].dtype)
     logger.info(f"GPTQ: rewrote {n} kernels at {bits} bits (group_size={group_size})")
     return unflatten_params(flat)

@@ -25,7 +25,29 @@ from ..transformers.conversion_utils import flatten_params, unflatten_params
 from ..utils.log import logger
 from .quantization_config import QuantizationConfig
 
-__all__ = ["quantize_params", "dequantize_leaf", "QuantizedModel"]
+__all__ = ["quantize_params", "dequantize_leaf", "QuantizedModel", "unrolled_twin"]
+
+
+def unrolled_twin(model):
+    """A facade over the SAME weights with ``use_scan_layers=False``: the
+    stacked [L] params are sliced into per-layer leaves matching the unrolled
+    module's tree (both layouts share checkpoints, so this is exact).
+
+    Calibration flows (GPTQ hessians, a8w8 activation observers) need to SEE
+    each layer's concrete activations; nn.scan traces its body once, so they
+    run on this twin while quantization/serving stay in the scan layout."""
+    import copy
+
+    from ..transformers.conversion_utils import unstack_scan_params
+
+    if not getattr(model.config, "use_scan_layers", False):
+        return model
+    cfg = copy.deepcopy(model.config)
+    cfg.use_scan_layers = False
+    twin = type(model)(cfg, dtype=model.dtype, param_dtype=model.param_dtype)
+    shapes = flatten_params(twin.param_shapes)
+    twin.params = unstack_scan_params(model.params, list(shapes))
+    return twin
 
 DEFAULT_SKIP = [r"embed", r"lm_head", r"norm", r"score", r"wte", r"wpe"]
 
@@ -145,14 +167,6 @@ class QuantizedModel:
         self.generation_config = model.generation_config
         self.params = quantize_params(model.params, self.quantization_config)
         act_quant = self.quantization_config.is_activation_quantize
-        if act_quant:
-            stacked = [p for p, v in flatten_params(self.params).items()
-                       if p.endswith("/qweight") and getattr(v, "ndim", 0) == 3]
-            if stacked:
-                raise ValueError(
-                    "a8w8 needs the unrolled layer layout (use_scan_layers=False): "
-                    f"scan-stacked kernels are opaque to Dense interception ({stacked[:2]}...)"
-                )
         self.module = _QuantModule(model.module, self.quantization_config.bits, model.dtype,
                                    activation_quant=act_quant, act_scales=act_scales)
         self.mesh = model.mesh

@@ -768,20 +768,30 @@ class Trainer:
     def _reduce_eval_logits(self, logits, batch, host_batch, n_batches: int = 1):
         """preprocess_logits_for_metrics if given; otherwise, when accumulating
         the full eval's logits would exceed ``eval_logits_host_bytes_limit`` of
-        host RAM, reduce to device-side argmax ids (the reference's
-        eval_accumulation pressure valve). The reduction is size-gated and
-        loudly logged — small evals keep full logits."""
+        host RAM, refuse loudly (the reference's eval_accumulation pressure
+        valve). Silent argmax substitution changed the meaning of
+        compute_metrics inputs depending only on dataset size (ADVICE r3), so
+        the reduction now requires the explicit ``eval_reduce_logits_to_argmax``
+        opt-in."""
         if self.preprocess_logits_for_metrics is not None:
             labels = batch.get("labels") if jax.process_count() > 1 else host_batch.get("labels")
             return self.preprocess_logits_for_metrics(logits, labels)
         limit = getattr(self.args, "eval_logits_host_bytes_limit", 2 << 30)
         if getattr(logits, "ndim", 0) == 3 and limit and logits.size * 4 * n_batches > limit:
-            logger.warning_once(
-                f"accumulating eval logits would need ~{logits.size * 4 * n_batches / 1e9:.1f} GB "
-                f"host RAM (> eval_logits_host_bytes_limit={limit}); reducing to argmax token ids "
-                "on device — pass preprocess_logits_for_metrics or raise the limit to override"
+            need_gb = logits.size * 4 * n_batches / 1e9
+            if getattr(self.args, "eval_reduce_logits_to_argmax", False):
+                logger.warning_once(
+                    f"accumulating eval logits would need ~{need_gb:.1f} GB host RAM "
+                    f"(> eval_logits_host_bytes_limit={limit}); reducing to argmax token ids "
+                    "on device (eval_reduce_logits_to_argmax=True)"
+                )
+                return jnp.argmax(logits, axis=-1)
+            raise ValueError(
+                f"accumulating eval logits would need ~{need_gb:.1f} GB host RAM "
+                f"(> eval_logits_host_bytes_limit={limit}). Pass preprocess_logits_for_metrics "
+                "to reduce them yourself, raise eval_logits_host_bytes_limit, or set "
+                "eval_reduce_logits_to_argmax=True to accept [B, T] argmax ids."
             )
-            return jnp.argmax(logits, axis=-1)
         return logits
 
     def _allgather_eval(self, logits, batch):

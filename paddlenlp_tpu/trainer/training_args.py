@@ -87,8 +87,14 @@ class TrainingArguments:
     report_to: Optional[List[str]] = None
     eval_logits_host_bytes_limit: int = field(
         default=2 << 30,
-        metadata={"help": "evaluate()/predict() reduce logits to device-side argmax ids when the "
-                          "full accumulation would exceed this many host bytes (0 disables)"})
+        metadata={"help": "evaluate()/predict() refuse to accumulate full logits past this many "
+                          "host bytes (0 disables the check); pass preprocess_logits_for_metrics, "
+                          "raise the limit, or set eval_reduce_logits_to_argmax"})
+    eval_reduce_logits_to_argmax: bool = field(
+        default=False,
+        metadata={"help": "over the host-bytes limit, reduce eval logits to device-side argmax "
+                          "token ids instead of raising (compute_metrics then receives [B, T] ids "
+                          "rather than [B, T, V] logits)"})
     profiler_options: Optional[str] = field(
         default=None,
         metadata={"help": 'jax.profiler trace window, e.g. "batch_range=[10,20];profile_path=./prof" '

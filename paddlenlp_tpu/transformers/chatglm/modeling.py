@@ -282,15 +282,21 @@ class ChatGLMForCausalLM(ChatGLMPretrainedModel):
 
     def _gen_position_ids(self, pos, prompt_mask, *, prefill: bool):
         """GLM-6B inference convention (reference chatglm
-        ``prepare_inputs_for_generation``): context tokens use (arange, 0);
-        every generated token keeps ``position`` frozen at the prompt's last
-        index while ``block_position`` counts 1, 2, ... — assumes the prompt
-        ends with [gMASK][bos] as chatglm prompts do."""
+        ``prepare_inputs_for_generation`` / ``get_position_ids``): for a prompt
+        ending '...[gMASK][bos]' of real length L, context tokens up to gMASK
+        use (arange, 0); position freezes at the gMASK index L-2 from the bos
+        token on; bos is block 1 and generated tokens count blocks 2, 3, ...
+        (checkpoints were trained on this scheme — the off-by-one variant
+        shifts decode rotary embeddings, ADVICE r3)."""
         if not getattr(self.config, "generation_2d_positions", True):
             return pos
+        prompt_real = prompt_mask.sum(-1)  # [B] = L
+        mask_pos = jnp.maximum(prompt_real - 2, 0)  # gMASK index under [gMASK][bos]
         if prefill:
-            return jnp.stack([pos, jnp.zeros_like(pos)], axis=1)  # [B, 2, T]
-        prompt_real = prompt_mask.sum(-1)  # [B]
-        position = (prompt_real - 1)[:, None]
-        block = pos[:, 0][:, None] - prompt_real[:, None] + 1
+            is_bos = pos == (prompt_real[:, None] - 1)
+            position = jnp.where(is_bos, mask_pos[:, None], pos)
+            block = jnp.where(is_bos, 1, 0)
+            return jnp.stack([position, block], axis=1)  # [B, 2, T]
+        position = mask_pos[:, None]
+        block = pos[:, 0][:, None] - prompt_real[:, None] + 2  # first generated -> 2
         return jnp.stack([position, block], axis=1)  # [B, 2, 1]

@@ -166,7 +166,8 @@ class LlmMetaConfig:
         _MetaAttr("tensor_parallel_output", bool, True, "keep logits tp-sharded for fused loss"),
         _MetaAttr("use_flash_attention", bool, True, "use fused/Pallas flash attention"),
         _MetaAttr("recompute", bool, False, "activation rematerialization"),
-        _MetaAttr("recompute_granularity", str, "full", "full|full_attn|core_attn"),
+        _MetaAttr("recompute_granularity", str, "full",
+                  "full|full_attn|core_attn|save_core_attn|save_qkv_attn|save_attn_mlp|save_dots|offload_attn"),
         _MetaAttr("no_recompute_layers", list, None, "layer indices excluded from remat"),
         _MetaAttr("use_scan_layers", bool, True, "stack decoder layers with lax.scan"),
     ]

@@ -22,7 +22,8 @@ import numpy as np
 
 from ..utils.log import logger
 
-__all__ = ["StateDictNameMapping", "auto_name_mappings", "flatten_params", "unflatten_params"]
+__all__ = ["StateDictNameMapping", "auto_name_mappings", "flatten_params", "unflatten_params",
+           "resolve_stacked_key", "unstack_scan_params"]
 
 
 @dataclasses.dataclass
@@ -140,6 +141,56 @@ def unflatten_params(flat: Dict[str, object], sep: str = "/") -> dict:
             node = node.setdefault(k, {})
         node[keys[-1]] = leaf
     return out
+
+
+def resolve_stacked_key(path: str, flat_stacked: Dict[str, object]):
+    """Map an UNROLLED param path ('model/layers_3/.../kernel') onto its
+    scan-STACKED counterpart: returns (stacked_path, (3,)) — one index per
+    stacked leading axis, in nesting order (layer outer, expert inner), or
+    None when the path exists verbatim / can't be resolved.
+
+    Both layer layouts of a model share checkpoints (StackedLayerMapping);
+    this is the in-memory equivalence used by calibration flows (GPTQ,
+    a8w8 observers) that must run unrolled against stacked params."""
+    if path in flat_stacked:
+        return None
+    segs = path.split("/")
+    cand = [i for i, s in enumerate(segs) if re.fullmatch(r".+_\d+", s)]
+    import itertools
+
+    for r in range(1, len(cand) + 1):
+        for combo in itertools.combinations(cand, r):
+            segs2 = list(segs)
+            idxs = []
+            for i in combo:
+                base, n = segs2[i].rsplit("_", 1)
+                segs2[i] = base
+                idxs.append(int(n))
+            key = "/".join(segs2)
+            if key in flat_stacked:
+                return key, tuple(idxs)
+    return None
+
+
+def unstack_scan_params(stacked_params: dict, unrolled_paths) -> dict:
+    """Scan-stacked params -> the unrolled-layout tree covering
+    ``unrolled_paths`` (flat '/'-joined). Leaves are views/slices — no copy
+    for the unstacked ones."""
+    flat_s = flatten_params(stacked_params)
+    out: Dict[str, object] = {}
+    for path in unrolled_paths:
+        if path in flat_s:
+            out[path] = flat_s[path]
+            continue
+        hit = resolve_stacked_key(path, flat_s)
+        if hit is None:
+            raise KeyError(f"cannot resolve unrolled path {path!r} against the stacked tree")
+        key, idxs = hit
+        leaf = flat_s[key]
+        for ix in idxs:
+            leaf = leaf[ix]
+        out[path] = leaf
+    return unflatten_params(out)
 
 
 _LAYERS_RE = re.compile(r"\blayers_(\d+)\b")

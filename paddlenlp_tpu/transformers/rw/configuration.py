@@ -49,6 +49,11 @@ class RWConfig(PretrainedConfig):
         self.rope_theta = rope_theta
         self.head_dim = hidden_size // num_attention_heads
         self.num_key_value_heads = 1 if multi_query else (n_head_kv or num_attention_heads)
+        if num_attention_heads % self.num_key_value_heads != 0:
+            raise ValueError(
+                f"n_head_kv={self.num_key_value_heads} must divide "
+                f"num_attention_heads={num_attention_heads} (falcon-40b grouped layout)"
+            )
         self.intermediate_size = 4 * hidden_size
         kwargs.setdefault("tie_word_embeddings", True)
         super().__init__(**kwargs)

@@ -58,11 +58,22 @@ class RWAttention(nn.Module):
         cfg = self.config
         B, T, D = x.shape
         n, hd = cfg.num_attention_heads, cfg.head_dim
+        n_kv = cfg.num_key_value_heads
         if cfg.multi_query:
             fused = _dense(D + 2 * hd, cfg, self.dtype, self.param_dtype,
                            "query_key_value", cfg.bias)(x)
             fused = fused.reshape(B, T, n + 2, hd)
             q, k, v = fused[..., :-2, :], fused[..., -2:-1, :], fused[..., -1:, :]
+        elif n_kv != n:
+            # falcon-40b grouped-kv layout: [n_kv groups of (group q heads + 1 k
+            # + 1 v)] — reference rw _split_heads n_head_kv branch
+            group = n // n_kv
+            fused = _dense((n + 2 * n_kv) * hd, cfg, self.dtype, self.param_dtype,
+                           "query_key_value", cfg.bias)(x)
+            fused = fused.reshape(B, T, n_kv, group + 2, hd)
+            q = fused[..., :group, :].reshape(B, T, n, hd)
+            k = fused[..., group, :]  # [B, T, n_kv, hd]
+            v = fused[..., group + 1, :]
         else:
             fused = _dense(3 * D, cfg, self.dtype, self.param_dtype,
                            "query_key_value", cfg.bias)(x)

@@ -10,11 +10,14 @@ from paddlenlp_tpu.transformers import ChatGLMConfig, ChatGLMForCausalLM
 
 
 def _glm_positions(prompt_len: int, total_len: int) -> np.ndarray:
-    """[1, 2, total]: context (arange, 0); generated (prompt_len-1, 1..)."""
-    pos = np.concatenate([np.arange(prompt_len),
-                          np.full(total_len - prompt_len, prompt_len - 1)])
-    block = np.concatenate([np.zeros(prompt_len, np.int64),
-                            np.arange(1, total_len - prompt_len + 1)])
+    """[1, 2, total], reference get_position_ids scheme for '...[gMASK][bos]':
+    context (arange, 0) up to gMASK; bos and generated tokens freeze position
+    at the gMASK index prompt_len-2; bos is block 1, generated blocks 2, 3..."""
+    mask_pos = max(prompt_len - 2, 0)
+    pos = np.concatenate([np.arange(prompt_len - 1),
+                          np.full(total_len - prompt_len + 1, mask_pos)])
+    block = np.concatenate([np.zeros(prompt_len - 1, np.int64),
+                            np.arange(1, total_len - prompt_len + 2)])
     return np.stack([pos, block])[None]
 
 

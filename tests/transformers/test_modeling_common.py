@@ -112,6 +112,9 @@ CAUSAL_CASES = {
                                                   parallel_attn=True, bias=False, **TINY)),
     "rw_alibi": (RWForCausalLM, lambda: RWConfig(vocab_size=96, multi_query=False,
                                                  parallel_attn=False, bias=True, alibi=True, **TINY)),
+    # falcon-40b shape: grouped-kv fused qkv ([n_kv, group+2, hd] layout)
+    "rw_gqa": (RWForCausalLM, lambda: RWConfig(vocab_size=96, multi_query=False,
+                                               n_head_kv=2, parallel_attn=True, bias=False, **TINY)),
     # attention-free SSM: associative-scan recurrence + conv/ssm state cache
     "mamba": (MambaForCausalLM, lambda: MambaConfig(
         vocab_size=96, hidden_size=64, num_hidden_layers=2, state_size=8,

@@ -1,0 +1,53 @@
+"""Remat granularities (reference recompute_granularity, training_args.py):
+every policy must trace, train, and produce the same loss/grads — remat is a
+memory/compute tradeoff, never a numerics change."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+GRANULARITIES = [
+    "full", "full_attn", "core_attn",
+    "save_core_attn", "save_qkv_attn", "save_attn_mlp", "save_dots", "offload_attn",
+]
+
+
+def _loss_and_grad(gran, use_scan):
+    if gran == "offload_attn" and not hasattr(
+        jax.checkpoint_policies, "save_and_offload_only_these_names"
+    ):
+        pytest.skip("jax build lacks save_and_offload_only_these_names")
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+        recompute=True, recompute_granularity=gran, use_flash_attention=False,
+        use_scan_layers=use_scan,
+    )
+    m = LlamaForCausalLM(cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    params = m.init_weights(seed=0)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 32)), jnp.int32)
+
+    def loss_fn(p):
+        logits = m.apply(p, input_ids=ids).logits
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    gnorm = jax.jit(lambda g: jax.tree.reduce(jnp.add, jax.tree.map(lambda x: jnp.sum(x**2), g)))(grads)
+    return float(loss), float(gnorm)
+
+
+@pytest.mark.parametrize("use_scan", [True, False], ids=["scan", "unrolled"])
+def test_all_granularities_numerically_identical(use_scan):
+    base_loss, base_gnorm = _loss_and_grad("full", use_scan)
+    for gran in GRANULARITIES[1:]:
+        loss, gnorm = _loss_and_grad(gran, use_scan)
+        np.testing.assert_allclose(loss, base_loss, rtol=1e-6, err_msg=gran)
+        np.testing.assert_allclose(gnorm, base_gnorm, rtol=1e-4, err_msg=gran)
+
+
+def test_unknown_granularity_raises():
+    with pytest.raises(ValueError, match="recompute_granularity"):
+        _loss_and_grad("bogus", True)
