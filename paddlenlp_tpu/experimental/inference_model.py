@@ -120,9 +120,32 @@ class PagedInferenceModel:
         self.n_kv = cfg.num_key_value_heads
         self.head_dim = cfg.head_dim
         self.inv_freq = jnp.asarray(rope_frequencies(self.head_dim, cfg.rope_theta, cfg.rope_scaling))
+        # serving a QuantizedModel: its params carry qweight/scales leaves
+        # (stacked [L, ...] — lax.scan slices per layer); _mm dispatches per
+        # projection (reference int8_gemm_with_cutlass serving path)
+        self.quant_cfg = getattr(model, "quantization_config", None)
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._verify = jax.jit(self._verify_impl, donate_argnums=(1,))
+
+    def _mm(self, p, x):
+        """x @ kernel with quantized-leaf dispatch: a8w8 -> int8 x int8 MXU dot;
+        weight-only -> dequant fused into the matmul operand read."""
+        if "qweight" not in p:
+            y = x @ p["kernel"].astype(self.dtype)
+        elif self.quant_cfg is not None and self.quant_cfg.is_activation_quantize:
+            from ..quantization.a8w8 import int8_linear
+
+            return int8_linear(x, p["qweight"], p["scales"], bias=p.get("bias"),
+                               act_scale=p.get("act_scale"), out_dtype=self.dtype)
+        else:
+            from ..quantization.quantization_utils import dequantize_leaf
+
+            bits = self.quant_cfg.bits if self.quant_cfg is not None else 8
+            y = x @ dequantize_leaf(p["qweight"], p["scales"], bits, self.dtype)
+        if "bias" in p:
+            y = y + p["bias"].astype(self.dtype)
+        return y
 
     # ------------------------------------------------------------------ forward core
     def _attend(self, q, k, v, q_positions, kv_len_mask):
@@ -155,10 +178,7 @@ class PagedInferenceModel:
         attn = lp["self_attn"]
 
         def proj(p, x, heads):
-            y = x @ p["kernel"].astype(self.dtype)
-            if "bias" in p:
-                y = y + p["bias"].astype(self.dtype)
-            return y.reshape(B, T, heads, self.head_dim)
+            return self._mm(p, x).reshape(B, T, heads, self.head_dim)
 
         q = proj(attn["q_proj"], x, self.n_heads)
         k = proj(attn["k_proj"], x, self.n_kv)
@@ -189,16 +209,13 @@ class PagedInferenceModel:
             k_all, v_all = gather_kv(pool_layer, block_tables, scale_layer)
             attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
         attn_out = attn_out.reshape(B, T, self.n_heads * self.head_dim)
-        o = attn_out @ attn["o_proj"]["kernel"].astype(self.dtype)
-        if "bias" in attn["o_proj"]:
-            o = o + attn["o_proj"]["bias"].astype(self.dtype)
-        h = h + o
+        h = h + self._mm(attn["o_proj"], attn_out)
 
         x = _rms(h, lp["post_attention_layernorm"]["scale"], self.eps)
         mlp = lp["mlp"]
-        gate = x @ mlp["gate_proj"]["kernel"].astype(self.dtype)
-        up = x @ mlp["up_proj"]["kernel"].astype(self.dtype)
-        h = h + (jax.nn.silu(gate) * up) @ mlp["down_proj"]["kernel"].astype(self.dtype)
+        gate = self._mm(mlp["gate_proj"], x)
+        up = self._mm(mlp["up_proj"], x)
+        h = h + self._mm(mlp["down_proj"], jax.nn.silu(gate) * up)
         if scale_layer is not None:
             return h, (pool_layer, scale_layer)
         return h, pool_layer

@@ -167,6 +167,10 @@ class QuantizedModel:
         self.generation_config = model.generation_config
         self.params = quantize_params(model.params, self.quantization_config)
         act_quant = self.quantization_config.is_activation_quantize
+        if act_quant and act_scales:
+            from .a8w8 import fold_act_scales
+
+            self.params = fold_act_scales(self.params, act_scales)
         self.module = _QuantModule(model.module, self.quantization_config.bits, model.dtype,
                                    activation_quant=act_quant, act_scales=act_scales)
         self.mesh = model.mesh

@@ -8,6 +8,13 @@ from .auto import (  # noqa: F401
     AutoModelForTokenClassification,
     AutoTokenizer,
 )
+from .albert import (  # noqa: F401
+    AlbertConfig,
+    AlbertForMaskedLM,
+    AlbertForSequenceClassification,
+    AlbertForTokenClassification,
+    AlbertModel,
+)
 from .bert import (  # noqa: F401
     BertConfig,
     BertForMaskedLM,
@@ -17,6 +24,13 @@ from .bert import (  # noqa: F401
 )
 from .cache_utils import KVCache, init_cache  # noqa: F401
 from .configuration_utils import LlmMetaConfig, PretrainedConfig  # noqa: F401
+from .electra import (  # noqa: F401
+    ElectraConfig,
+    ElectraDiscriminator,
+    ElectraForSequenceClassification,
+    ElectraForTokenClassification,
+    ElectraModel,
+)
 from .ernie import (  # noqa: F401
     ErnieConfig,
     ErnieForMaskedLM,
@@ -35,6 +49,13 @@ from .llama import (  # noqa: F401
     LlamaPretrainingCriterion,
 )
 from .mamba import MambaConfig, MambaForCausalLM, MambaModel  # noqa: F401
+from .roberta import (  # noqa: F401
+    RobertaConfig,
+    RobertaForMaskedLM,
+    RobertaForSequenceClassification,
+    RobertaForTokenClassification,
+    RobertaModel,
+)
 from .rw import RWConfig, RWForCausalLM, RWModel  # noqa: F401
 from .chatglm import ChatGLMConfig, ChatGLMForCausalLM, ChatGLMModel  # noqa: F401
 from .yuan import YuanConfig, YuanForCausalLM, YuanModel  # noqa: F401

@@ -1,0 +1,8 @@
+from .configuration import AlbertConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    AlbertForMaskedLM,
+    AlbertForSequenceClassification,
+    AlbertForTokenClassification,
+    AlbertModel,
+    AlbertPretrainedModel,
+)

@@ -1,0 +1,57 @@
+"""ALBERT configuration (reference: paddlenlp/transformers/albert/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["AlbertConfig"]
+
+
+class AlbertConfig(PretrainedConfig):
+    model_type = "albert"
+    attribute_map = {"num_classes": "num_labels"}
+
+    def __init__(
+        self,
+        vocab_size: int = 30000,
+        embedding_size: int = 128,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_hidden_groups: int = 1,
+        num_attention_heads: int = 12,
+        intermediate_size: int = 3072,
+        inner_group_num: int = 1,
+        hidden_act: str = "gelu_new",
+        hidden_dropout_prob: float = 0.0,
+        attention_probs_dropout_prob: float = 0.0,
+        max_position_embeddings: int = 512,
+        type_vocab_size: int = 2,
+        initializer_range: float = 0.02,
+        layer_norm_eps: float = 1e-12,
+        classifier_dropout_prob: float = 0.1,
+        pad_token_id: int = 0,
+        **kwargs,
+    ):
+        if num_hidden_groups != 1 or inner_group_num != 1:
+            raise ValueError(
+                "only the published ALBERT shape (num_hidden_groups=1, inner_group_num=1) "
+                "is supported — every released checkpoint uses it"
+            )
+        self.vocab_size = vocab_size
+        self.embedding_size = embedding_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_hidden_groups = num_hidden_groups
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.inner_group_num = inner_group_num
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.classifier_dropout_prob = classifier_dropout_prob
+        self.head_dim = hidden_size // num_attention_heads
+        super().__init__(pad_token_id=pad_token_id, **kwargs)

@@ -1,0 +1,262 @@
+"""ALBERT, TPU-native (reference: paddlenlp/transformers/albert/modeling.py).
+
+ALBERT's two factorizations, expressed natively in flax:
+- embedding factorization: embeddings live at ``embedding_size`` and project up
+  through ``embedding_hidden_mapping_in``;
+- cross-layer parameter sharing: ONE ``AlbertLayer`` module instance is bound
+  once and CALLED ``num_hidden_layers`` times — flax reuses the same params, so
+  sharing is structural, not a weight-tying convention.
+Layer internals: post-LN attention (query/key/value/dense + LayerNorm) then
+ffn/ffn_output + full_layer_layer_norm, gelu_new. Checkpoint keys follow HF
+albert (``albert.encoder.albert_layer_groups.0.albert_layers.0...``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, BertPretrainedModel, VocabEmbed, _dense
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from .configuration import AlbertConfig
+
+__all__ = ["AlbertModel", "AlbertForMaskedLM", "AlbertForSequenceClassification",
+           "AlbertForTokenClassification", "AlbertPretrainedModel"]
+
+
+class AlbertEmbeddings(nn.Module):
+    config: AlbertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        init = nn.initializers.normal(cfg.initializer_range)
+        E = cfg.embedding_size
+        h = VocabEmbed(cfg.vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init, name="position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init, name="token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        return h
+
+
+class AlbertLayer(nn.Module):
+    """The ONE shared transformer block (HF albert_layer_groups.0.albert_layers.0)."""
+
+    config: AlbertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "attention_query")(h).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "attention_key")(h).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "attention_value")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        k = shard_constraint(k, P("batch", None, "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", None, "act_kv_heads", None))
+        drop = cfg.attention_probs_dropout_prob if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask, causal=False,
+                                     dropout_rate=drop, dropout_rng=rng).reshape(B, T, D)
+        attn = _dense(D, cfg, self.dtype, self.param_dtype, "attention_dense")(attn)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="attention_LayerNorm")(h + attn)
+        ff = _dense(cfg.intermediate_size, cfg, self.dtype, self.param_dtype, "ffn")(h)
+        ff = ACT2FN[cfg.hidden_act](ff)
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dense(D, cfg, self.dtype, self.param_dtype, "ffn_output")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                            name="full_layer_layer_norm")(h + ff)
+
+
+class AlbertModule(nn.Module):
+    config: AlbertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = AlbertEmbeddings(cfg, self.dtype, self.param_dtype, name="embeddings")(
+            input_ids, token_type_ids, position_ids, deterministic
+        )
+        h = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                   "embedding_hidden_mapping_in")(h)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        # ONE bound module, called num_hidden_layers times: params are shared
+        shared = AlbertLayer(cfg, self.dtype, self.param_dtype, name="albert_layer")
+        all_hidden = [] if output_hidden_states else None
+        for _ in range(cfg.num_hidden_layers):
+            if output_hidden_states:
+                all_hidden.append(h)
+            h = shared(h, attention_mask, deterministic)
+        if output_hidden_states:
+            all_hidden.append(h)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler")(h[:, 0]))
+        if not return_dict:
+            return (h, pooled)
+        return BaseModelOutputWithPoolingAndCrossAttentions(
+            last_hidden_state=h, pooler_output=pooled,
+            hidden_states=tuple(all_hidden) if all_hidden else None,
+        )
+
+
+class AlbertForMaskedLMModule(nn.Module):
+    config: AlbertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = AlbertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                               name="albert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic,
+            output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        h = _dense(cfg.embedding_size, cfg, self.dtype, self.param_dtype, "predictions_dense")(h)
+        h = ACT2FN[cfg.hidden_act](h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="predictions_LayerNorm")(h)
+        embedding = self.get_variable("params", "albert")["embeddings"]["word_embeddings"]["embedding"]
+        bias = self.param("predictions_bias", nn.initializers.zeros, (cfg.vocab_size,), self.param_dtype)
+        logits = h @ embedding.T.astype(self.dtype) + bias.astype(self.dtype)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits,)
+        return MaskedLMOutput(logits=logits, hidden_states=outputs.hidden_states)
+
+
+class AlbertForSequenceClassificationModule(nn.Module):
+    config: AlbertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = AlbertModule(cfg, self.dtype, self.param_dtype, name="albert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        pooled = outputs.pooler_output
+        if not deterministic and cfg.classifier_dropout_prob > 0:
+            pooled = nn.Dropout(cfg.classifier_dropout_prob)(pooled, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier")(pooled)
+        if not return_dict:
+            return (logits,)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class AlbertForTokenClassificationModule(nn.Module):
+    config: AlbertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = AlbertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                               name="albert")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        h = outputs.last_hidden_state
+        if not deterministic and cfg.classifier_dropout_prob > 0:
+            h = nn.Dropout(cfg.classifier_dropout_prob)(h, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier")(h)
+        if not return_dict:
+            return (logits,)
+        return TokenClassifierOutput(logits=logits)
+
+
+class AlbertPretrainedModel(BertPretrainedModel):
+    config_class = AlbertConfig
+    base_model_prefix = "albert"
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        shared_prefix = "encoder.albert_layer_groups.0.albert_layers.0"
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = path
+            key = key.replace("albert_layer/", shared_prefix.replace(".", "@") + "@")
+            key = key.replace("attention_query", "attention@query")
+            key = key.replace("attention_key", "attention@key")
+            key = key.replace("attention_value", "attention@value")
+            key = key.replace("attention_dense", "attention@dense")
+            key = key.replace("attention_LayerNorm", "attention@LayerNorm")
+            key = key.replace("embedding_hidden_mapping_in", "encoder@embedding_hidden_mapping_in")
+            key = key.replace("predictions_LayerNorm", "predictions@LayerNorm")
+            key = key.replace("predictions_dense", "predictions@dense")
+            key = key.replace("predictions_bias", "predictions@bias")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith(".kernel") or key.endswith(".scale") or key.endswith(".embedding"):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class AlbertModel(AlbertPretrainedModel):
+    module_class = AlbertModule
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+
+class AlbertForMaskedLM(AlbertPretrainedModel):
+    module_class = AlbertForMaskedLMModule
+    _keys_to_ignore_on_load_missing = [r"predictions"]
+    _keys_to_ignore_on_load_unexpected = [r"\.decoder\.", r"position_ids", r"pooler",
+                                          r"sop_classifier"]
+
+
+class AlbertForSequenceClassification(AlbertPretrainedModel):
+    module_class = AlbertForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"predictions", r"position_ids", r"sop_classifier"]
+
+
+class AlbertForTokenClassification(AlbertPretrainedModel):
+    module_class = AlbertForTokenClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"predictions", r"position_ids", r"pooler",
+                                          r"sop_classifier"]

@@ -18,7 +18,10 @@ def register_config(model_type: str, config_class: Type[PretrainedConfig]):
 def _populate():
     if CONFIG_MAPPING:
         return
+    from ..albert.configuration import AlbertConfig
     from ..bert.configuration import BertConfig
+    from ..electra.configuration import ElectraConfig
+    from ..roberta.configuration import RobertaConfig
     from ..ernie.configuration import ErnieConfig
     from ..gemma.configuration import GemmaConfig
     from ..gpt.configuration import GPTConfig
@@ -44,7 +47,8 @@ def _populate():
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
                 ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
                 OPTConfig, QWenConfig, ChatGLMv2Config, T5Config, BartConfig, DeepseekV2Config,
-                MambaConfig, RWConfig, ChatGLMConfig, YuanConfig, JambaConfig):
+                MambaConfig, RWConfig, ChatGLMConfig, YuanConfig, JambaConfig,
+                AlbertConfig, ElectraConfig, RobertaConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

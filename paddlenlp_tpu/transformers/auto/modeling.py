@@ -79,6 +79,21 @@ def _populate_models():
     register_model("ernie", "masked_lm", ernie.ErnieForMaskedLM)
     register_model("ernie", "sequence_classification", ernie.ErnieForSequenceClassification)
     register_model("ernie", "token_classification", ernie.ErnieForTokenClassification)
+    from ..albert import modeling as albert
+    from ..electra import modeling as electra
+    from ..roberta import modeling as roberta
+
+    register_model("roberta", "base", roberta.RobertaModel)
+    register_model("roberta", "masked_lm", roberta.RobertaForMaskedLM)
+    register_model("roberta", "sequence_classification", roberta.RobertaForSequenceClassification)
+    register_model("roberta", "token_classification", roberta.RobertaForTokenClassification)
+    register_model("electra", "base", electra.ElectraModel)
+    register_model("electra", "sequence_classification", electra.ElectraForSequenceClassification)
+    register_model("electra", "token_classification", electra.ElectraForTokenClassification)
+    register_model("albert", "base", albert.AlbertModel)
+    register_model("albert", "masked_lm", albert.AlbertForMaskedLM)
+    register_model("albert", "sequence_classification", albert.AlbertForSequenceClassification)
+    register_model("albert", "token_classification", albert.AlbertForTokenClassification)
     register_model("mixtral", "causal_lm", mixtral.MixtralForCausalLM)
     register_model("qwen2_moe", "causal_lm", qwen2_moe.Qwen2MoeForCausalLM)
     from ..deepseek_v2 import modeling as deepseek_v2

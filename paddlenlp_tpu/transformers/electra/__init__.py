@@ -1,0 +1,8 @@
+from .configuration import ElectraConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    ElectraDiscriminator,
+    ElectraForSequenceClassification,
+    ElectraForTokenClassification,
+    ElectraModel,
+    ElectraPretrainedModel,
+)

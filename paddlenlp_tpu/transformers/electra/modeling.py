@@ -1,0 +1,218 @@
+"""ELECTRA, TPU-native (reference: paddlenlp/transformers/electra/modeling.py).
+
+BERT encoder blocks (reused) with ELECTRA's deltas:
+- factorized embeddings at ``embedding_size`` + an ``embeddings_project``
+  linear up to ``hidden_size`` when they differ (the small/base configs);
+- no pooler, no MLM head on the discriminator; classification uses the
+  2-layer gelu head on token 0; ``discriminator_predictions``
+  (dense + gelu + dense_prediction) scores every position for the
+  replaced-token-detection objective.
+Checkpoint keys follow HF electra (``electra.encoder.layer.N...``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, BertLayer, BertPretrainedModel, VocabEmbed, _dense
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from .configuration import ElectraConfig
+
+__all__ = ["ElectraModel", "ElectraForSequenceClassification", "ElectraForTokenClassification",
+           "ElectraDiscriminator", "ElectraPretrainedModel"]
+
+
+class ElectraEmbeddings(nn.Module):
+    config: ElectraConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        init = nn.initializers.normal(cfg.initializer_range)
+        E = cfg.embedding_size
+        h = VocabEmbed(cfg.vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init, name="position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init, name="token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        return h
+
+
+class ElectraModule(nn.Module):
+    config: ElectraConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = ElectraEmbeddings(cfg, self.dtype, self.param_dtype, name="embeddings")(
+            input_ids, token_type_ids, position_ids, deterministic
+        )
+        if cfg.embedding_size != cfg.hidden_size:
+            h = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "embeddings_project")(h)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        all_hidden = [] if output_hidden_states else None
+        for i in range(cfg.num_hidden_layers):
+            if output_hidden_states:
+                all_hidden.append(h)
+            h = BertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic
+            )
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, None)
+        return BaseModelOutputWithPoolingAndCrossAttentions(
+            last_hidden_state=h, pooler_output=None,
+            hidden_states=tuple(all_hidden) if all_hidden else None,
+        )
+
+
+class ElectraForSequenceClassificationModule(nn.Module):
+    config: ElectraConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = ElectraModule(cfg, self.dtype, self.param_dtype, name="electra")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        dropout = cfg.classifier_dropout if cfg.classifier_dropout is not None else cfg.hidden_dropout_prob
+        x = outputs.last_hidden_state[:, 0]
+        if not deterministic and dropout > 0:
+            x = nn.Dropout(dropout)(x, deterministic=False)
+        x = ACT2FN["gelu"](_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                  "classifier_dense")(x))
+        if not deterministic and dropout > 0:
+            x = nn.Dropout(dropout)(x, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier_out_proj")(x)
+        if not return_dict:
+            return (logits,)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class ElectraForTokenClassificationModule(nn.Module):
+    config: ElectraConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = ElectraModule(cfg, self.dtype, self.param_dtype, name="electra")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        h = outputs.last_hidden_state
+        dropout = cfg.classifier_dropout if cfg.classifier_dropout is not None else cfg.hidden_dropout_prob
+        if not deterministic and dropout > 0:
+            h = nn.Dropout(dropout)(h, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier")(h)
+        if not return_dict:
+            return (logits,)
+        return TokenClassifierOutput(logits=logits)
+
+
+class ElectraDiscriminatorModule(nn.Module):
+    """Replaced-token-detection head: per-position binary logit (reference
+    ``ElectraDiscriminator``)."""
+
+    config: ElectraConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = ElectraModule(cfg, self.dtype, self.param_dtype, name="electra")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        h = outputs.last_hidden_state
+        h = ACT2FN["gelu"](_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                  "discriminator_predictions_dense")(h))
+        logits = _dense(1, cfg, self.dtype, self.param_dtype,
+                        "discriminator_predictions_dense_prediction")(h)[..., 0]
+        if not return_dict:
+            return (logits,)
+        return TokenClassifierOutput(logits=logits)
+
+
+class ElectraPretrainedModel(BertPretrainedModel):
+    config_class = ElectraConfig
+    base_model_prefix = "electra"
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = path
+            key = key.replace("encoder_layer_", "encoder@layer@")
+            key = key.replace("attention_self_", "attention@self@")
+            key = key.replace("attention_output_LayerNorm", "attention@output@LayerNorm")
+            key = key.replace("attention_output_dense", "attention@output@dense")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("discriminator_predictions_dense_prediction",
+                              "discriminator_predictions@dense_prediction")
+            key = key.replace("discriminator_predictions_dense", "discriminator_predictions@dense")
+            key = key.replace("classifier_dense", "classifier@dense")
+            key = key.replace("classifier_out_proj", "classifier@out_proj")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith(".kernel") or key.endswith(".scale") or key.endswith(".embedding"):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class ElectraModel(ElectraPretrainedModel):
+    module_class = ElectraModule
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+
+class ElectraForSequenceClassification(ElectraPretrainedModel):
+    module_class = ElectraForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"discriminator", r"generator", r"position_ids"]
+
+
+class ElectraForTokenClassification(ElectraPretrainedModel):
+    module_class = ElectraForTokenClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"discriminator", r"generator", r"position_ids"]
+
+
+class ElectraDiscriminator(ElectraPretrainedModel):
+    module_class = ElectraDiscriminatorModule
+    _keys_to_ignore_on_load_missing = [r"discriminator_predictions"]
+    _keys_to_ignore_on_load_unexpected = [r"generator", r"position_ids"]

@@ -209,7 +209,8 @@ class LlamaAttention(nn.Module):
             k, v = update_layer_kv(kv[0], kv[1], k, v, offset)
             new_kv = (k, v)
 
-        dropout_rate = cfg.attention_dropout if not deterministic else 0.0
+        # variant configs (qwen/baichuan) don't declare the field; no dropout then
+        dropout_rate = getattr(cfg, "attention_dropout", 0.0) if not deterministic else 0.0
         dropout_rng = self.make_rng("dropout") if dropout_rate > 0.0 else None
         q = checkpoint_name(q, "attn_qkv")
         k = checkpoint_name(k, "attn_qkv")

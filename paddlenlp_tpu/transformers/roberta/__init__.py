@@ -1,0 +1,8 @@
+from .configuration import RobertaConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    RobertaForMaskedLM,
+    RobertaForSequenceClassification,
+    RobertaForTokenClassification,
+    RobertaModel,
+    RobertaPretrainedModel,
+)

@@ -1,0 +1,251 @@
+"""RoBERTa, TPU-native (reference: paddlenlp/transformers/roberta/modeling.py).
+
+BERT encoder blocks (reused) with RoBERTa's deltas:
+- pad-aware position ids offset past ``padding_idx``
+  (``create_position_ids_from_input_ids``): position = cumsum(mask)*mask + pad;
+- no useful token types (type_vocab_size=1);
+- ``lm_head`` (dense + gelu + LayerNorm + tied decoder) instead of
+  ``cls.predictions``; classification via a 2-layer head on the <s> token
+  (``classifier.dense`` / ``classifier.out_proj``), no pooler.
+Checkpoint keys follow HF roberta (``roberta.encoder.layer.N...``, ``lm_head.*``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, BertLayer, BertPretrainedModel, VocabEmbed, _dense
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from .configuration import RobertaConfig
+
+__all__ = ["RobertaModel", "RobertaForMaskedLM", "RobertaForSequenceClassification",
+           "RobertaForTokenClassification", "RobertaPretrainedModel"]
+
+
+def create_position_ids_from_input_ids(input_ids, padding_idx):
+    """Non-pad tokens get positions padding_idx+1, padding_idx+2, ...; pads stay
+    at padding_idx (HF/fairseq convention the checkpoints were trained with)."""
+    mask = (input_ids != padding_idx).astype(jnp.int32)
+    return jnp.cumsum(mask, axis=1) * mask + padding_idx
+
+
+class RobertaEmbeddings(nn.Module):
+    config: RobertaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None, deterministic=True):
+        cfg = self.config
+        if position_ids is None:
+            position_ids = create_position_ids_from_input_ids(input_ids, cfg.pad_token_id)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        return h
+
+
+class RobertaModule(nn.Module):
+    config: RobertaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = RobertaEmbeddings(cfg, self.dtype, self.param_dtype, name="embeddings")(
+            input_ids, token_type_ids, position_ids, deterministic
+        )
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        all_hidden = [] if output_hidden_states else None
+        for i in range(cfg.num_hidden_layers):
+            if output_hidden_states:
+                all_hidden.append(h)
+            h = BertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic
+            )
+        if output_hidden_states:
+            all_hidden.append(h)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        if not return_dict:
+            return (h, pooled)
+        return BaseModelOutputWithPoolingAndCrossAttentions(
+            last_hidden_state=h, pooler_output=pooled,
+            hidden_states=tuple(all_hidden) if all_hidden else None,
+        )
+
+
+class RobertaForMaskedLMModule(nn.Module):
+    config: RobertaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = RobertaModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                                name="roberta")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic,
+            output_hidden_states, True,
+        )
+        h = outputs.last_hidden_state
+        h = _dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "lm_head_dense")(h)
+        h = ACT2FN["gelu"](h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="lm_head_layer_norm")(h)
+        embedding = self.get_variable("params", "roberta")["embeddings"]["word_embeddings"]["embedding"]
+        bias = self.param("lm_head_bias", nn.initializers.zeros, (cfg.vocab_size,), self.param_dtype)
+        logits = h @ embedding.T.astype(self.dtype) + bias.astype(self.dtype)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits,)
+        return MaskedLMOutput(logits=logits, hidden_states=outputs.hidden_states)
+
+
+class RobertaClassificationHead(nn.Module):
+    """dense -> tanh -> out_proj over the <s> token (reference roberta
+    ``RobertaClassificationHead``)."""
+
+    config: RobertaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, deterministic=True):
+        cfg = self.config
+        dropout = cfg.classifier_dropout if cfg.classifier_dropout is not None else cfg.hidden_dropout_prob
+        x = h[:, 0]
+        if not deterministic and dropout > 0:
+            x = nn.Dropout(dropout)(x, deterministic=False)
+        x = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype, "dense")(x))
+        if not deterministic and dropout > 0:
+            x = nn.Dropout(dropout)(x, deterministic=False)
+        return _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "out_proj")(x)
+
+
+class RobertaForSequenceClassificationModule(nn.Module):
+    config: RobertaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = RobertaModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                                name="roberta")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        logits = RobertaClassificationHead(cfg, self.dtype, self.param_dtype, name="classifier")(
+            outputs.last_hidden_state, deterministic
+        )
+        if not return_dict:
+            return (logits,)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class RobertaForTokenClassificationModule(nn.Module):
+    config: RobertaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = RobertaModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                                name="roberta")(
+            input_ids, attention_mask, token_type_ids, position_ids, deterministic, False, True
+        )
+        h = outputs.last_hidden_state
+        dropout = cfg.classifier_dropout if cfg.classifier_dropout is not None else cfg.hidden_dropout_prob
+        if not deterministic and dropout > 0:
+            h = nn.Dropout(dropout)(h, deterministic=False)
+        logits = _dense(cfg.num_labels, cfg, self.dtype, self.param_dtype, "classifier")(h)
+        if not return_dict:
+            return (logits,)
+        return TokenClassifierOutput(logits=logits)
+
+
+class RobertaPretrainedModel(BertPretrainedModel):
+    config_class = RobertaConfig
+    base_model_prefix = "roberta"
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = path
+            key = key.replace("encoder_layer_", "encoder@layer@")
+            key = key.replace("attention_self_", "attention@self@")
+            key = key.replace("attention_output_LayerNorm", "attention@output@LayerNorm")
+            key = key.replace("attention_output_dense", "attention@output@dense")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("lm_head_layer_norm", "lm_head@layer_norm")
+            key = key.replace("lm_head_dense", "lm_head@dense")
+            key = key.replace("lm_head_bias", "lm_head@bias")
+            key = key.replace("classifier/dense", "classifier/dense")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.startswith("lm_head."):
+                pass  # heads live at the top level in HF roberta
+            if key.endswith(".kernel") or key.endswith(".scale") or key.endswith(".embedding"):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class RobertaModel(RobertaPretrainedModel):
+    module_class = RobertaModule
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+
+class RobertaForMaskedLM(RobertaPretrainedModel):
+    module_class = RobertaForMaskedLMModule
+    _keys_to_ignore_on_load_missing = [r"lm_head"]
+    _keys_to_ignore_on_load_unexpected = [r"\.decoder\.", r"position_ids", r"pooler"]
+
+
+class RobertaForSequenceClassification(RobertaPretrainedModel):
+    module_class = RobertaForSequenceClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"lm_head", r"position_ids", r"pooler"]
+
+
+class RobertaForTokenClassification(RobertaPretrainedModel):
+    module_class = RobertaForTokenClassificationModule
+    _keys_to_ignore_on_load_missing = [r"classifier"]
+    _keys_to_ignore_on_load_unexpected = [r"lm_head", r"position_ids", r"pooler"]

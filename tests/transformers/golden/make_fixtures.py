@@ -1,0 +1,91 @@
+"""Generate golden conversion-fidelity fixtures (run offline, outputs committed).
+
+For each family: a tiny REAL torch/HF checkpoint (safetensors + config.json)
+plus the torch fp32 logits on fixed input ids. The paired test
+(``test_golden_parity.py``) loads the checkpoint through OUR ``from_pretrained``
+(torch-layout transposes, fused/stacked conversions) and asserts logits parity —
+the end-to-end conversion-fidelity check the reference does with
+``LogitComparer`` (paddlenlp/transformers/conversion_utils.py:927).
+
+Usage: python tests/transformers/golden/make_fixtures.py
+"""
+
+import json
+import os
+
+import numpy as np
+import torch
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+INPUT_IDS = np.arange(1, 17, dtype=np.int64)[None, :] % 250  # [1, 16]
+
+
+def _save(name, model, extra_cfg=None):
+    out = os.path.join(HERE, name)
+    os.makedirs(out, exist_ok=True)
+    model = model.eval()
+    with torch.no_grad():
+        logits = model(torch.from_numpy(INPUT_IDS)).logits.float().numpy()
+    model.save_pretrained(out, safe_serialization=True)
+    np.savez(os.path.join(out, "golden_logits.npz"), input_ids=INPUT_IDS, logits=logits)
+    # keep the fixture minimal: drop the generation config (not under test)
+    gen_cfg = os.path.join(out, "generation_config.json")
+    if os.path.exists(gen_cfg):
+        os.remove(gen_cfg)
+    size = sum(os.path.getsize(os.path.join(out, f)) for f in os.listdir(out))
+    print(f"{name}: {size/1e3:.0f} KB, logits {logits.shape}")
+
+
+def main():
+    torch.manual_seed(0)
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    _save("llama_tiny", LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=128,
+        tie_word_embeddings=False)))
+
+    torch.manual_seed(1)
+    _save("llama_gqa_tiny", LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+        tie_word_embeddings=False)))
+
+    torch.manual_seed(2)
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    _save("mixtral_tiny", MixtralForCausalLM(MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+        num_local_experts=4, num_experts_per_tok=2, tie_word_embeddings=False,
+        output_router_logits=False)))
+
+
+def encoders():
+    torch.manual_seed(3)
+    from transformers import RobertaConfig, RobertaForMaskedLM
+
+    _save("roberta_tiny", RobertaForMaskedLM(RobertaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=96, pad_token_id=1,
+        type_vocab_size=1, tie_word_embeddings=True)))
+
+    torch.manual_seed(4)
+    from transformers import ElectraConfig, ElectraForSequenceClassification
+
+    _save("electra_tiny", ElectraForSequenceClassification(ElectraConfig(
+        vocab_size=256, embedding_size=32, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, max_position_embeddings=96,
+        num_labels=3)))
+
+    torch.manual_seed(5)
+    from transformers import AlbertConfig, AlbertForMaskedLM
+
+    _save("albert_tiny", AlbertForMaskedLM(AlbertConfig(
+        vocab_size=256, embedding_size=32, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, max_position_embeddings=96)))
+
+
+if __name__ == "__main__":
+    main()
+    encoders()

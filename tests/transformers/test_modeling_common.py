@@ -10,6 +10,14 @@ import pytest
 
 from paddlenlp_tpu.parallel import MeshConfig, create_mesh, use_mesh
 from paddlenlp_tpu.transformers import (
+    AlbertConfig,
+    AlbertForMaskedLM,
+    AlbertForSequenceClassification,
+    ElectraConfig,
+    ElectraForSequenceClassification,
+    RobertaConfig,
+    RobertaForMaskedLM,
+    RobertaForSequenceClassification,
     BaichuanConfig,
     DeepseekV2Config,
     DeepseekV2ForCausalLM,
@@ -124,6 +132,16 @@ CAUSAL_CASES = {
 
 ENCODER_CASES = {
     "bert_mlm": (BertForMaskedLM, lambda: BertConfig(vocab_size=96, intermediate_size=128, **TINY)),
+    "roberta_mlm": (RobertaForMaskedLM, lambda: RobertaConfig(vocab_size=96, intermediate_size=128,
+                                                              pad_token_id=1, **TINY)),
+    "roberta_cls": (RobertaForSequenceClassification, lambda: RobertaConfig(
+        vocab_size=96, intermediate_size=128, pad_token_id=1, num_labels=3, **TINY)),
+    "electra_cls": (ElectraForSequenceClassification, lambda: ElectraConfig(
+        vocab_size=96, embedding_size=32, intermediate_size=128, num_labels=3, **TINY)),
+    "albert_mlm": (AlbertForMaskedLM, lambda: AlbertConfig(vocab_size=96, embedding_size=32,
+                                                           intermediate_size=128, **TINY)),
+    "albert_cls": (AlbertForSequenceClassification, lambda: AlbertConfig(
+        vocab_size=96, embedding_size=32, intermediate_size=128, num_labels=3, **TINY)),
     "bert_cls": (BertForSequenceClassification, lambda: BertConfig(vocab_size=96, intermediate_size=128,
                                                                    num_labels=3, **TINY)),
     "ernie_cls": (ErnieForSequenceClassification, lambda: ErnieConfig(vocab_size=96, intermediate_size=128,

@@ -68,17 +68,35 @@ class TestGPTQ:
         diff = np.abs(np.asarray(out) - np.asarray(ref)).max()
         assert 0 < diff < 0.5, diff
 
-    def test_scan_layout_rejected(self):
-        import pytest
-
-        from paddlenlp_tpu.quantization import collect_hessians
+    def test_scan_layout_matches_unrolled(self):
+        """apply_gptq on a scan-stacked model must produce the same rewritten
+        weights as the unrolled layout (layouts share checkpoints; calibration
+        rides the unrolled_twin)."""
+        from paddlenlp_tpu.quantization import apply_gptq
+        from paddlenlp_tpu.quantization.quantization_utils import unrolled_twin
         from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
 
-        cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
-                          num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64)
-        model = LlamaForCausalLM.from_config(cfg, seed=0)
-        with pytest.raises(ValueError, match="use_scan_layers=False"):
-            collect_hessians(model, [{"input_ids": jnp.ones((1, 4), jnp.int32)}])
+        kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                  num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64)
+        scan_model = LlamaForCausalLM.from_config(LlamaConfig(use_scan_layers=True, **kw), seed=0)
+        rng = np.random.default_rng(0)
+        batches = [{"input_ids": jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)} for _ in range(2)]
+        new_stacked = apply_gptq(scan_model, batches, bits=8, match=lambda p: "mlp" in p)
+
+        unrolled = unrolled_twin(scan_model)
+        new_unrolled = apply_gptq(unrolled, batches, bits=8, match=lambda p: "mlp" in p)
+        flat_s = flatten_params(new_stacked)
+        flat_u = flatten_params(new_unrolled)
+        for i in (0, 1):
+            np.testing.assert_allclose(
+                np.asarray(flat_s["model/layers/mlp/gate_proj/kernel"][i]),
+                np.asarray(flat_u[f"model/layers_{i}/mlp/gate_proj/kernel"]),
+                atol=1e-6,
+            )
+        # the rewrite changed the weights (gptq actually ran)
+        orig = flatten_params(scan_model.params)["model/layers/mlp/gate_proj/kernel"]
+        assert np.abs(np.asarray(flat_s["model/layers/mlp/gate_proj/kernel"]) - np.asarray(orig)).max() > 0
 
 
 class TestQLoRAComposition:
@@ -156,7 +174,10 @@ class TestA8W8:
         cos = float((ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9))
         assert cos > 0.98, cos
 
-    def test_a8w8_rejects_scan_layout(self):
+    def test_a8w8_scan_layout_quality(self):
+        """a8w8 under the DEFAULT stacked layout (nn.scan slices qweight/scales
+        into the intercepted Dense): outputs must track the fp model, and match
+        the unrolled a8w8 path."""
         from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
         from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
 
@@ -164,8 +185,40 @@ class TestA8W8:
                           num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
                           max_position_embeddings=64, use_scan_layers=True)
         model = LlamaForCausalLM.from_config(cfg, seed=0)
-        with pytest.raises(ValueError, match="use_scan_layers"):
-            QuantizedModel(model, QuantizationConfig(weight_quantize_algo="a8w8"))
+        ids = jnp.asarray(np.arange(16)[None] % 90 + 3, jnp.int32)
+        ref = np.asarray(model(input_ids=ids).logits[0])
+        qm = QuantizedModel(model, QuantizationConfig(weight_quantize_algo="a8w8"))
+        got = np.asarray(qm(input_ids=ids).logits[0])
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree >= 0.8, agree
+        cos = float((ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9))
+        assert cos > 0.98, cos
+
+    def test_a8w8_calibrated_scales_fold_into_scan(self):
+        """collect_act_scales on a scan model (via unrolled_twin) + fold into
+        stacked act_scale leaves -> static-scale a8w8 stays close to fp."""
+        from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel, collect_act_scales
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, use_scan_layers=True)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        batches = [{"input_ids": jnp.asarray(np.arange(12)[None] % 90 + 3, jnp.int32)}]
+        scales = collect_act_scales(model, batches)
+        assert scales and all(v > 0 for v in scales.values())
+        assert any("layers_0" in k for k in scales)  # observed per layer via the twin
+        qm = QuantizedModel(model, QuantizationConfig(weight_quantize_algo="a8w8"),
+                            act_scales=scales)
+        folded = flatten_params(qm.params)
+        stacked_scales = [v for p, v in folded.items() if p.endswith("/act_scale")]
+        assert stacked_scales and all(v.shape == (2,) for v in stacked_scales)
+        ids = batches[0]["input_ids"]
+        ref = np.asarray(model(input_ids=ids).logits[0])
+        got = np.asarray(qm(input_ids=ids).logits[0])
+        cos = float((ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9))
+        assert cos > 0.97, cos
 
     def test_compress_a8w8_flow(self, tmp_path):
         """Trainer.compress(strategy='a8w8') calibrates, exports, and the
