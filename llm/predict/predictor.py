@@ -58,6 +58,11 @@ class PredictorArgument:
     benchmark: bool = False
     apply_chat_template: bool = False
     lora_path: Optional[str] = None
+    weight_quantize_algo: Optional[str] = field(
+        default=None,
+        metadata={"help": "weight-only serving quantization: wint8 | wint4 | fp8 "
+                          "(fp8 = float8_e4m3fn weights + per-channel scales, the "
+                          "XLA-native twin of the reference's cutlass fp8 GEMM)"})
 
 
 class BasePredictor:
@@ -75,6 +80,11 @@ class BasePredictor:
                 from paddlenlp_tpu.peft import LoRAModel
 
                 model = LoRAModel.from_pretrained(model, args.lora_path).merge_and_unload()
+        if args.weight_quantize_algo:
+            from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
+
+            model = QuantizedModel(
+                model, QuantizationConfig(weight_quantize_algo=args.weight_quantize_algo))
         self.model = model
 
     def _preprocess(self, texts: List[str]):

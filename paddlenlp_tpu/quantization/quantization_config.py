@@ -7,7 +7,8 @@ from typing import List, Optional
 
 __all__ = ["QuantizationConfig"]
 
-SUPPORTED_ALGOS = ("weight_only_int8", "wint8", "weight_only_int4", "wint4", "a8w8")
+SUPPORTED_ALGOS = ("weight_only_int8", "wint8", "weight_only_int4", "wint4", "a8w8",
+                   "fp8", "weight_only_fp8")
 
 
 @dataclasses.dataclass
@@ -35,3 +36,7 @@ class QuantizationConfig:
     @property
     def is_activation_quantize(self) -> bool:
         return self.weight_quantize_algo == "a8w8"
+
+    @property
+    def is_fp8(self) -> bool:
+        return self.weight_quantize_algo in ("fp8", "weight_only_fp8")

@@ -71,7 +71,24 @@ def _quantize_array(w: np.ndarray, bits: int):
     return q, scales.squeeze(-2)  # [lead..., out]
 
 
+FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+
+def _quantize_array_fp8(w: np.ndarray):
+    """Per-output-channel (and per leading layer/expert slice) scaled cast to
+    float8_e4m3fn — the XLA-native counterpart of the reference's cutlass fp8
+    GEMM (csrc/gpu/fp8_gemm_with_cutlass/): HBM holds fp8 weights, the convert
+    is fused into the consuming matmul's operand read on TPU."""
+    w = np.asarray(w, dtype=np.float32)
+    absmax = np.abs(w).max(axis=-2, keepdims=True)
+    scales = (absmax / FP8_MAX).astype(np.float32)
+    q = (w / np.maximum(scales, 1e-12)).astype(jnp.float8_e4m3fn)
+    return q, scales.squeeze(-2)  # [lead..., out]
+
+
 def dequantize_leaf(qweight: jnp.ndarray, scales: jnp.ndarray, bits: int, dtype=jnp.bfloat16) -> jnp.ndarray:
+    if qweight.dtype == jnp.float8_e4m3fn:
+        return (qweight.astype(jnp.float32) * scales.astype(jnp.float32)[..., None, :]).astype(dtype)
     if bits == 4:
         lo = (qweight & 0x0F).astype(jnp.int8)
         lo = jnp.where(lo > 7, lo - 16, lo)  # sign-extend nibble
@@ -100,7 +117,10 @@ def quantize_params(params: dict, config: QuantizationConfig) -> dict:
         if not wanted:
             out[path] = leaf
             continue
-        q, scales = _quantize_array(np.asarray(jax.device_get(leaf)), bits)
+        if config.is_fp8:
+            q, scales = _quantize_array_fp8(np.asarray(jax.device_get(leaf)))
+        else:
+            q, scales = _quantize_array(np.asarray(jax.device_get(leaf)), bits)
         prefix = path.rsplit("/", 1)[0]
         out[prefix + "/qweight"] = jnp.asarray(q)
         out[prefix + "/scales"] = jnp.asarray(scales)
@@ -108,7 +128,8 @@ def quantize_params(params: dict, config: QuantizationConfig) -> dict:
     if n_quant == 0:
         logger.warning("quantize_params: no kernels matched; params unchanged")
     else:
-        logger.info(f"quantized {n_quant} kernels to int{bits} (weight-only)")
+        kind = "float8_e4m3" if config.is_fp8 else f"int{bits}"
+        logger.info(f"quantized {n_quant} kernels to {kind} (weight-only)")
     return unflatten_params(out)
 
 

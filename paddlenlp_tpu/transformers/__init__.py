@@ -87,4 +87,20 @@ from .t5 import (  # noqa: F401
     T5ForConditionalGeneration,
     T5Model,
 )
+from .mt5 import (  # noqa: F401
+    MT5Config,
+    MT5EncoderModel,
+    MT5ForConditionalGeneration,
+    MT5Model,
+)
+from .mbart import (  # noqa: F401
+    MBartConfig,
+    MBartForConditionalGeneration,
+    MBartModel,
+)
+from .pegasus import (  # noqa: F401
+    PegasusConfig,
+    PegasusForConditionalGeneration,
+    PegasusModel,
+)
 from .tokenizer_utils import BatchEncoding, PretrainedTokenizer  # noqa: F401

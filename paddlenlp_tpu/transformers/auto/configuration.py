@@ -43,12 +43,16 @@ def _populate():
     from ..yuan.configuration import YuanConfig
     from ..jamba.configuration import JambaConfig
     from ..t5.configuration import T5Config
+    from ..mt5.configuration import MT5Config
+    from ..mbart.configuration import MBartConfig
+    from ..pegasus.configuration import PegasusConfig
 
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
                 ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
                 OPTConfig, QWenConfig, ChatGLMv2Config, T5Config, BartConfig, DeepseekV2Config,
                 MambaConfig, RWConfig, ChatGLMConfig, YuanConfig, JambaConfig,
-                AlbertConfig, ElectraConfig, RobertaConfig):
+                AlbertConfig, ElectraConfig, RobertaConfig,
+                MT5Config, MBartConfig, PegasusConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -130,6 +130,18 @@ def _populate_models():
 
     register_model("bart", "base", bart.BartModel)
     register_model("bart", "seq2seq_lm", bart.BartForConditionalGeneration)
+    from ..mt5 import modeling as mt5
+
+    register_model("mt5", "base", mt5.MT5Model)
+    register_model("mt5", "seq2seq_lm", mt5.MT5ForConditionalGeneration)
+    from ..mbart import modeling as mbart
+
+    register_model("mbart", "base", mbart.MBartModel)
+    register_model("mbart", "seq2seq_lm", mbart.MBartForConditionalGeneration)
+    from ..pegasus import modeling as pegasus
+
+    register_model("pegasus", "base", pegasus.PegasusModel)
+    register_model("pegasus", "seq2seq_lm", pegasus.PegasusForConditionalGeneration)
 
 
 class _AutoBase:

@@ -36,6 +36,11 @@ class BartConfig(PretrainedConfig):
         activation_dropout: float = 0.0,
         init_std: float = 0.02,
         scale_embedding: bool = False,
+        normalize_before: bool = False,
+        normalize_embedding: bool = True,
+        add_final_layer_norm: bool = False,
+        static_position_embeddings: bool = False,
+        pos_embedding_offset: int = 2,
         **kwargs,
     ):
         self.vocab_size = vocab_size
@@ -54,6 +59,16 @@ class BartConfig(PretrainedConfig):
         self.init_std = init_std
         self.initializer_range = init_std
         self.scale_embedding = scale_embedding
+        # Architecture knobs distinguishing the BART-shaped family (one network,
+        # config-driven — the same pattern as the llama variants):
+        #   bart   : post-LN, learned +2-offset positions, embed-LN, no final LN
+        #   mbart  : pre-LN, learned +2-offset positions, embed-LN + final LN
+        #   pegasus: pre-LN, fixed sinusoidal positions, no embed-LN, final LN
+        self.normalize_before = normalize_before
+        self.normalize_embedding = normalize_embedding
+        self.add_final_layer_norm = add_final_layer_norm
+        self.static_position_embeddings = static_position_embeddings
+        self.pos_embedding_offset = pos_embedding_offset
         kwargs.setdefault("pad_token_id", 1)
         kwargs.setdefault("bos_token_id", 0)
         kwargs.setdefault("eos_token_id", 2)

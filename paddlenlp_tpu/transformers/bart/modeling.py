@@ -33,6 +33,30 @@ from .configuration import BartConfig
 __all__ = ["BartModel", "BartForConditionalGeneration", "BartPretrainedModel"]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _sinusoid_table_np(n_positions: int, dim: int):
+    import numpy as np
+
+    i = np.arange(dim // 2, dtype=np.float64)
+    angles = np.arange(n_positions, dtype=np.float64)[:, None] / np.power(10000.0, 2 * i / dim)[None, :]
+    table = np.concatenate([np.sin(angles), np.cos(angles)], axis=-1)
+    if dim % 2:  # odd dim: HF pads the sin half one wider
+        table = np.concatenate([table, np.zeros((n_positions, 1))], axis=-1)
+    return table.astype(np.float32)
+
+
+def sinusoidal_position_table(n_positions: int, dim: int) -> jnp.ndarray:
+    """Fixed (non-learned) position table, HF/pegasus layout: sin of the angle
+    vector in the first dim/2 columns, cos in the second half (NOT interleaved —
+    reference pegasus/modeling.py:101-123 documents the same layout). Only the
+    numpy table is cached — converting per call keeps traced values out of the
+    cache when invoked under jit."""
+    return jnp.asarray(_sinusoid_table_np(n_positions, dim))
+
+
 class BartAttention(nn.Module):
     """Standard scaled MHA with biases (reference BartAttention)."""
 
@@ -101,13 +125,20 @@ class BartEncoderLayer(nn.Module):
 
     def __call__(self, h, attention_mask=None, deterministic: bool = True):
         cfg = self.config
-        attn, _ = self.self_attn(h, attention_mask, deterministic=deterministic)
-        h = self.self_attn_layer_norm(h + _dropout(self, attn, cfg.dropout, deterministic))
-        ff = ACT2FN[cfg.activation_function](self.fc1(h))
+        pre = cfg.normalize_before
+        x = self.self_attn_layer_norm(h) if pre else h
+        attn, _ = self.self_attn(x, attention_mask, deterministic=deterministic)
+        h = h + _dropout(self, attn, cfg.dropout, deterministic)
+        if not pre:
+            h = self.self_attn_layer_norm(h)
+        x = self.final_layer_norm(h) if pre else h
+        ff = ACT2FN[cfg.activation_function](self.fc1(x))
         ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
         ff = _dropout(self, ff, cfg.activation_dropout, deterministic)
         ff = self.fc2(ff)
-        h = self.final_layer_norm(h + _dropout(self, ff, cfg.dropout, deterministic))
+        h = h + _dropout(self, ff, cfg.dropout, deterministic)
+        if not pre:
+            h = self.final_layer_norm(h)
         return shard_constraint(h, P("batch", "act_seq", "act_embed"))
 
 
@@ -132,17 +163,27 @@ class BartDecoderLayer(nn.Module):
     def __call__(self, h, attention_mask=None, encoder_hidden_states=None, encoder_attention_mask=None,
                  cross_kv=None, cache_kv=None, offset=0, deterministic: bool = True):
         cfg = self.config
-        attn, new_kv = self.self_attn(h, attention_mask, cache_kv=cache_kv, offset=offset,
+        pre = cfg.normalize_before
+        x = self.self_attn_layer_norm(h) if pre else h
+        attn, new_kv = self.self_attn(x, attention_mask, cache_kv=cache_kv, offset=offset,
                                       deterministic=deterministic)
-        h = self.self_attn_layer_norm(h + _dropout(self, attn, cfg.dropout, deterministic))
-        cross, _ = self.encoder_attn(h, encoder_attention_mask, kv_states=encoder_hidden_states,
+        h = h + _dropout(self, attn, cfg.dropout, deterministic)
+        if not pre:
+            h = self.self_attn_layer_norm(h)
+        x = self.encoder_attn_layer_norm(h) if pre else h
+        cross, _ = self.encoder_attn(x, encoder_attention_mask, kv_states=encoder_hidden_states,
                                      precomputed_kv=cross_kv, deterministic=deterministic)
-        h = self.encoder_attn_layer_norm(h + _dropout(self, cross, cfg.dropout, deterministic))
-        ff = ACT2FN[cfg.activation_function](self.fc1(h))
+        h = h + _dropout(self, cross, cfg.dropout, deterministic)
+        if not pre:
+            h = self.encoder_attn_layer_norm(h)
+        x = self.final_layer_norm(h) if pre else h
+        ff = ACT2FN[cfg.activation_function](self.fc1(x))
         ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
         ff = _dropout(self, ff, cfg.activation_dropout, deterministic)
         ff = self.fc2(ff)
-        h = self.final_layer_norm(h + _dropout(self, ff, cfg.dropout, deterministic))
+        h = h + _dropout(self, ff, cfg.dropout, deterministic)
+        if not pre:
+            h = self.final_layer_norm(h)
         return shard_constraint(h, P("batch", "act_seq", "act_embed")), new_kv
 
 
@@ -153,22 +194,36 @@ class BartEncoder(nn.Module):
 
     def setup(self):
         cfg = self.config
-        # HF learned positional embedding carries a +2 offset baked into the table
-        self.embed_positions = nn.Embed(cfg.max_position_embeddings + 2, cfg.d_model, dtype=self.dtype,
-                                        param_dtype=self.param_dtype,
-                                        embedding_init=nn.initializers.normal(cfg.init_std))
-        self.layernorm_embedding = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+        if not cfg.static_position_embeddings:
+            # HF learned positional embedding carries a +2 offset baked into the table
+            self.embed_positions = nn.Embed(
+                cfg.max_position_embeddings + cfg.pos_embedding_offset, cfg.d_model, dtype=self.dtype,
+                param_dtype=self.param_dtype, embedding_init=nn.initializers.normal(cfg.init_std))
+        if cfg.normalize_embedding:
+            self.layernorm_embedding = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
         self.layers = [BartEncoderLayer(cfg, self.dtype, self.param_dtype) for _ in range(cfg.encoder_layers)]
+        if cfg.add_final_layer_norm:
+            self.layer_norm = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+
+    def _positions(self, positions):
+        cfg = self.config
+        if cfg.static_position_embeddings:
+            table = sinusoidal_position_table(cfg.max_position_embeddings, cfg.d_model)
+            return table[positions].astype(self.dtype)
+        return self.embed_positions(positions + cfg.pos_embedding_offset)
 
     def __call__(self, inputs_embeds, attention_mask=None, deterministic: bool = True):
         cfg = self.config
         T = inputs_embeds.shape[1]
         scale = cfg.d_model**0.5 if cfg.scale_embedding else 1.0
-        h = inputs_embeds * scale + self.embed_positions(jnp.arange(T)[None, :] + 2)
-        h = self.layernorm_embedding(h)
+        h = inputs_embeds * scale + self._positions(jnp.arange(T)[None, :])
+        if cfg.normalize_embedding:
+            h = self.layernorm_embedding(h)
         h = _dropout(self, h, cfg.dropout, deterministic)
         for layer in self.layers:
             h = layer(h, attention_mask, deterministic)
+        if cfg.add_final_layer_norm:
+            h = self.layer_norm(h)
         return h
 
 
@@ -179,11 +234,22 @@ class BartDecoder(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.embed_positions = nn.Embed(cfg.max_position_embeddings + 2, cfg.d_model, dtype=self.dtype,
-                                        param_dtype=self.param_dtype,
-                                        embedding_init=nn.initializers.normal(cfg.init_std))
-        self.layernorm_embedding = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+        if not cfg.static_position_embeddings:
+            self.embed_positions = nn.Embed(
+                cfg.max_position_embeddings + cfg.pos_embedding_offset, cfg.d_model, dtype=self.dtype,
+                param_dtype=self.param_dtype, embedding_init=nn.initializers.normal(cfg.init_std))
+        if cfg.normalize_embedding:
+            self.layernorm_embedding = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
         self.layers = [BartDecoderLayer(cfg, self.dtype, self.param_dtype) for _ in range(cfg.decoder_layers)]
+        if cfg.add_final_layer_norm:
+            self.layer_norm = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, param_dtype=self.param_dtype)
+
+    def _positions(self, positions):
+        cfg = self.config
+        if cfg.static_position_embeddings:
+            table = sinusoidal_position_table(cfg.max_position_embeddings, cfg.d_model)
+            return table[positions].astype(self.dtype)
+        return self.embed_positions(positions + cfg.pos_embedding_offset)
 
     def init_cross_kv(self, encoder_hidden_states):
         ks, vs = [], []
@@ -200,9 +266,9 @@ class BartDecoder(nn.Module):
         T = inputs_embeds.shape[1]
         offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
         scale = cfg.d_model**0.5 if cfg.scale_embedding else 1.0
-        pos = jnp.arange(T)[None, :] + offset + 2
-        h = inputs_embeds * scale + self.embed_positions(pos)
-        h = self.layernorm_embedding(h)
+        h = inputs_embeds * scale + self._positions(jnp.arange(T)[None, :] + offset)
+        if cfg.normalize_embedding:
+            h = self.layernorm_embedding(h)
         h = _dropout(self, h, cfg.dropout, deterministic)
         new_keys, new_values = [], []
         for i, layer in enumerate(self.layers):
@@ -216,6 +282,8 @@ class BartDecoder(nn.Module):
         new_cache = None
         if cache is not None:
             new_cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values), offset=offset + T)
+        if cfg.add_final_layer_norm:
+            h = self.layer_norm(h)
         return h, new_cache
 
 

@@ -1,0 +1,7 @@
+from .configuration import MBartConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    MBartForConditionalGeneration,
+    MBartModel,
+    MBartPretrainedModel,
+    shift_tokens_right_mbart,
+)

@@ -1,0 +1,51 @@
+"""mBART configuration (reference: paddlenlp/transformers/mbart/configuration.py).
+
+Architecturally BART with pre-LN blocks, an embedding LayerNorm AND a final
+stack LayerNorm (reference mbart/modeling.py:148 ``normalize_before=True``,
+:151 ``nn.TransformerEncoder(..., nn.LayerNorm(d_model))``), multilingual
+250k vocab, and eos-rotating decoder input shift (:57-69).
+"""
+
+from __future__ import annotations
+
+from ..bart.configuration import BartConfig
+
+__all__ = ["MBartConfig"]
+
+
+class MBartConfig(BartConfig):
+    model_type = "mbart"
+
+    def __init__(
+        self,
+        vocab_size: int = 250027,
+        d_model: int = 1024,
+        encoder_layers: int = 12,
+        decoder_layers: int = 12,
+        encoder_attention_heads: int = 16,
+        decoder_attention_heads: int = 16,
+        encoder_ffn_dim: int = 4096,
+        decoder_ffn_dim: int = 4096,
+        activation_function: str = "gelu",
+        scale_embedding: bool = True,
+        **kwargs,
+    ):
+        kwargs.setdefault("pad_token_id", 1)
+        kwargs.setdefault("bos_token_id", 0)
+        kwargs.setdefault("eos_token_id", 2)
+        kwargs.setdefault("decoder_start_token_id", 2)
+        kwargs.setdefault("forced_eos_token_id", 2)
+        kwargs.update(normalize_before=True, normalize_embedding=True, add_final_layer_norm=True)
+        super().__init__(
+            vocab_size=vocab_size,
+            d_model=d_model,
+            encoder_layers=encoder_layers,
+            decoder_layers=decoder_layers,
+            encoder_attention_heads=encoder_attention_heads,
+            decoder_attention_heads=decoder_attention_heads,
+            encoder_ffn_dim=encoder_ffn_dim,
+            decoder_ffn_dim=decoder_ffn_dim,
+            activation_function=activation_function,
+            scale_embedding=scale_embedding,
+            **kwargs,
+        )

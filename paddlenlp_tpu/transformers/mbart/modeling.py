@@ -1,0 +1,42 @@
+"""mBART, TPU-native — thin delta over the config-driven BART network.
+
+Counterpart of ``paddlenlp/transformers/mbart/modeling.py`` (1190 LoC). All the
+architectural deltas (pre-LN, embed-LN + final stack LN, +2-offset learned
+positions, scaled embeddings) are config flags on the shared BART modules
+(``bart/modeling.py``); this file contributes only the multilingual input
+shift: mBART rotates the LAST non-pad token (eos / language id) to position 0
+instead of prepending a fixed decoder-start id (reference mbart/modeling.py:57-69).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..bart.modeling import BartForConditionalGeneration, BartModel, BartPretrainedModel
+from .configuration import MBartConfig
+
+__all__ = ["MBartModel", "MBartForConditionalGeneration", "MBartPretrainedModel",
+           "shift_tokens_right_mbart"]
+
+
+def shift_tokens_right_mbart(input_ids: jnp.ndarray, pad_token_id: int) -> jnp.ndarray:
+    """Rotate each row's final non-pad token (the language id in mBART convention)
+    to the front: [tok... eos lang pad...] -> [lang tok... eos pad...]."""
+    ids = jnp.where(input_ids == -100, pad_token_id, input_ids)
+    eos_idx = jnp.sum((ids != pad_token_id).astype(jnp.int32), axis=-1) - 1  # [B]
+    lang = jnp.take_along_axis(ids, eos_idx[:, None], axis=-1)  # [B, 1]
+    shifted = jnp.concatenate([lang, ids[:, :-1]], axis=-1)
+    return shifted
+
+
+class MBartPretrainedModel(BartPretrainedModel):
+    config_class = MBartConfig
+
+
+class MBartModel(MBartPretrainedModel, BartModel):
+    pass
+
+
+class MBartForConditionalGeneration(MBartPretrainedModel, BartForConditionalGeneration):
+    def prepare_decoder_input_ids_from_labels(self, labels):
+        return shift_tokens_right_mbart(labels, self.config.pad_token_id)

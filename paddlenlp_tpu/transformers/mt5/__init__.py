@@ -1,0 +1,7 @@
+from .configuration import MT5Config  # noqa: F401
+from .modeling import (  # noqa: F401
+    MT5EncoderModel,
+    MT5ForConditionalGeneration,
+    MT5Model,
+    MT5PretrainedModel,
+)

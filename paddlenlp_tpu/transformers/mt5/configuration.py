@@ -1,0 +1,38 @@
+"""mT5 configuration (reference: paddlenlp/transformers/mt5/configuration.py:88-108).
+
+Architecturally identical to T5; only the defaults differ: 250k multilingual
+vocab, gated-gelu FFN, untied lm head, d_ff 1024 / 6 heads at base scale.
+"""
+
+from __future__ import annotations
+
+from ..t5.configuration import T5Config
+
+__all__ = ["MT5Config"]
+
+
+class MT5Config(T5Config):
+    model_type = "mt5"
+
+    def __init__(
+        self,
+        vocab_size: int = 250112,
+        d_model: int = 512,
+        d_kv: int = 64,
+        d_ff: int = 1024,
+        num_layers: int = 8,
+        num_heads: int = 6,
+        feed_forward_proj: str = "gated-gelu",
+        **kwargs,
+    ):
+        kwargs.setdefault("tie_word_embeddings", False)
+        super().__init__(
+            vocab_size=vocab_size,
+            d_model=d_model,
+            d_kv=d_kv,
+            d_ff=d_ff,
+            num_layers=num_layers,
+            num_heads=num_heads,
+            feed_forward_proj=feed_forward_proj,
+            **kwargs,
+        )

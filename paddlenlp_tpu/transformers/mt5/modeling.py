@@ -1,0 +1,31 @@
+"""mT5, TPU-native — pure re-export of the T5 network under the mt5 config
+(reference paddlenlp/transformers/mt5/modeling.py is likewise a T5 clone with
+mT5 defaults; same one-network/config-driven collapse as mistral-on-llama)."""
+
+from __future__ import annotations
+
+from ..t5.modeling import (
+    T5EncoderModel,
+    T5ForConditionalGeneration,
+    T5Model,
+    T5PretrainedModel,
+)
+from .configuration import MT5Config
+
+__all__ = ["MT5Model", "MT5EncoderModel", "MT5ForConditionalGeneration", "MT5PretrainedModel"]
+
+
+class MT5PretrainedModel(T5PretrainedModel):
+    config_class = MT5Config
+
+
+class MT5Model(MT5PretrainedModel, T5Model):
+    pass
+
+
+class MT5EncoderModel(MT5PretrainedModel, T5EncoderModel):
+    pass
+
+
+class MT5ForConditionalGeneration(MT5PretrainedModel, T5ForConditionalGeneration):
+    pass

@@ -1,0 +1,6 @@
+from .configuration import PegasusConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    PegasusForConditionalGeneration,
+    PegasusModel,
+    PegasusPretrainedModel,
+)

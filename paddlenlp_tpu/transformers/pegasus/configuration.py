@@ -1,0 +1,55 @@
+"""Pegasus configuration (reference: paddlenlp/transformers/pegasus/configuration.py:88-121).
+
+BART-shaped with pre-LN blocks, FIXED sinusoidal positions (no learned table,
+no +2 offset — reference pegasus/modeling.py:101-123), no embedding LayerNorm,
+and a final stack LayerNorm (:155/:223); decodes from pad (id 0).
+"""
+
+from __future__ import annotations
+
+from ..bart.configuration import BartConfig
+
+__all__ = ["PegasusConfig"]
+
+
+class PegasusConfig(BartConfig):
+    model_type = "pegasus"
+
+    def __init__(
+        self,
+        vocab_size: int = 50000,
+        d_model: int = 768,
+        encoder_layers: int = 12,
+        decoder_layers: int = 12,
+        encoder_attention_heads: int = 12,
+        decoder_attention_heads: int = 12,
+        encoder_ffn_dim: int = 3072,
+        decoder_ffn_dim: int = 3072,
+        activation_function: str = "relu",
+        attention_dropout: float = 0.1,
+        activation_dropout: float = 0.1,
+        scale_embedding: bool = True,
+        **kwargs,
+    ):
+        kwargs.setdefault("pad_token_id", 0)
+        kwargs.setdefault("bos_token_id", 2)
+        kwargs.setdefault("eos_token_id", 1)
+        kwargs.setdefault("decoder_start_token_id", 0)
+        kwargs.setdefault("forced_eos_token_id", 1)
+        kwargs.update(normalize_before=True, normalize_embedding=False, add_final_layer_norm=True,
+                      static_position_embeddings=True, pos_embedding_offset=0)
+        super().__init__(
+            vocab_size=vocab_size,
+            d_model=d_model,
+            encoder_layers=encoder_layers,
+            decoder_layers=decoder_layers,
+            encoder_attention_heads=encoder_attention_heads,
+            decoder_attention_heads=decoder_attention_heads,
+            encoder_ffn_dim=encoder_ffn_dim,
+            decoder_ffn_dim=decoder_ffn_dim,
+            activation_function=activation_function,
+            attention_dropout=attention_dropout,
+            activation_dropout=activation_dropout,
+            scale_embedding=scale_embedding,
+            **kwargs,
+        )

@@ -1,0 +1,29 @@
+"""Pegasus, TPU-native — thin delta over the config-driven BART network.
+
+Counterpart of ``paddlenlp/transformers/pegasus/modeling.py`` (856 LoC). The
+sinusoidal table, pre-LN blocks, and final stack LN are config flags on the
+shared BART modules; HF checkpoints store the (deterministic) sinusoid table
+under ``embed_positions.weight`` — we recompute it instead, so those keys are
+ignored on load.
+"""
+
+from __future__ import annotations
+
+from ..bart.modeling import BartForConditionalGeneration, BartModel, BartPretrainedModel
+from .configuration import PegasusConfig
+
+__all__ = ["PegasusModel", "PegasusForConditionalGeneration", "PegasusPretrainedModel"]
+
+
+class PegasusPretrainedModel(BartPretrainedModel):
+    config_class = PegasusConfig
+
+
+class PegasusModel(PegasusPretrainedModel, BartModel):
+    _keys_to_ignore_on_load_unexpected = BartModel._keys_to_ignore_on_load_unexpected + [
+        r"embed_positions\.weight"]
+
+
+class PegasusForConditionalGeneration(PegasusPretrainedModel, BartForConditionalGeneration):
+    _keys_to_ignore_on_load_unexpected = (
+        BartForConditionalGeneration._keys_to_ignore_on_load_unexpected + [r"embed_positions\.weight"])

@@ -4,9 +4,10 @@ Counterpart of ``paddlenlp/transformers/tokenizer_utils_base.py`` (3498 LoC,
 ``PretrainedTokenizerBase`` :1264 encode/pad/truncate/batch APIs),
 ``tokenizer_utils.py`` (:886 slow tokenizer, ``ChatTemplateMixin`` :629) and
 ``tokenizer_utils_fast.py``. Design choice: ONE tokenizer class backed by the Rust
-``tokenizers`` runtime (the reference's "fast" path) — sentencepiece-only slow
-tokenizers are out of scope on this image (no sentencepiece wheel); HF
-``tokenizer.json`` artifacts cover the model zoo.
+``tokenizers`` runtime (the reference's "fast" path). Checkpoints shipping only a
+sentencepiece model (``spiece.model`` / ``tokenizer.model``) are converted on
+load by ``convert_slow_tokenizer.convert_spm_to_fast`` (pure-python ModelProto
+reader — no sentencepiece wheel needed).
 
 Batched decode on TPU wants LEFT padding; ``padding_side`` is configurable
 per-call and per-instance like the reference.
@@ -126,20 +127,57 @@ class PretrainedTokenizer(ChatTemplateMixin):
     def from_pretrained(cls, pretrained_model_name_or_path, **kwargs) -> "PretrainedTokenizer":
         model_dir = resolve_model_dir(pretrained_model_name_or_path)
         tok_file = os.path.join(model_dir, TOKENIZER_FILE)
+        tokenizer_object = None
+        spm_path = None
         if not os.path.isfile(tok_file):
-            tok_file = resolve_file(pretrained_model_name_or_path, TOKENIZER_FILE)
+            try:
+                tok_file = resolve_file(pretrained_model_name_or_path, TOKENIZER_FILE)
+            except (FileNotFoundError, OSError, ValueError):
+                # no authoritative tokenizer.json anywhere — fall back to a
+                # sentencepiece-only checkpoint (llama/t5/gemma lineage) and
+                # rebuild the fast tokenizer from the spm proto
+                for spm_name in ("spiece.model", "tokenizer.model", "sentencepiece.bpe.model"):
+                    cand = os.path.join(model_dir, spm_name)
+                    if os.path.isfile(cand):
+                        spm_path = cand
+                        break
+                if spm_path is None:
+                    raise
         config: Dict[str, Any] = {}
         cfg_path = os.path.join(model_dir, TOKENIZER_CONFIG_NAME)
         if os.path.isfile(cfg_path):
             with open(cfg_path) as f:
                 config = json.load(f)
         config.pop("tokenizer_class", None)
+        if spm_path is not None:
+            from .convert_slow_tokenizer import convert_spm_to_fast
+
+            # template hints: explicit add_bos_token/add_eos_token in
+            # tokenizer_config.json win; otherwise t5-lineage spiece.model and
+            # mbart-lineage sentencepiece.bpe.model append </s>, llama-lineage
+            # tokenizer.model prepends <s>
+            add_bos = config.get("add_bos_token")
+            add_eos = config.get("add_eos_token")
+            if add_bos is None and add_eos is None and not spm_path.endswith("tokenizer.model"):
+                add_bos, add_eos = False, True
+            tokenizer_object = convert_spm_to_fast(spm_path, add_bos=add_bos, add_eos=add_eos)
+            # language codes etc. live outside the spm vocab (mbart lineage) —
+            # graft them on from the configs' additional_special_tokens
+            extra = config.get("additional_special_tokens") or []
+            if extra:
+                from tokenizers import AddedToken
+
+                tokenizer_object.add_special_tokens(
+                    [AddedToken(t if isinstance(t, str) else t.get("content", ""),
+                                special=True, normalized=False) for t in extra])
         sp_path = os.path.join(model_dir, SPECIAL_TOKENS_MAP_FILE)
         if os.path.isfile(sp_path):
             with open(sp_path) as f:
                 for k, v in json.load(f).items():
                     config.setdefault(k, v)
         config.update(kwargs)
+        if tokenizer_object is not None:
+            return cls(tokenizer_object=tokenizer_object, **config)
         return cls(tokenizer_file=tok_file, **config)
 
     def save_pretrained(self, save_directory: str):

@@ -280,7 +280,7 @@ class TestQuantizedServing:
                               max_blocks_per_seq=16, **kw)
         return eng.generate([prompt], SamplingParams(max_new_tokens=6))[0]
 
-    @pytest.mark.parametrize("algo", ["wint8", "a8w8"])
+    @pytest.mark.parametrize("algo", ["wint8", "a8w8", "fp8"])
     def test_scan_quantized_engine_close_to_fp(self, model, algo):
         from paddlenlp_tpu.quantization import QuantizationConfig, QuantizedModel
 

@@ -1,0 +1,152 @@
+"""SentencePiece converter: pure-python ModelProto parse + fast-tokenizer build
+(counterpart of reference convert_slow_tokenizer.py SpmConverter; the test
+hand-encodes spm protos with a minimal proto2 writer so no sentencepiece wheel
+is needed)."""
+
+import os
+import struct
+
+import pytest
+
+
+def varint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def field(no, wt, payload):
+    if wt == 0:
+        return varint(no << 3 | 0) + varint(payload)
+    return varint(no << 3 | 2) + varint(len(payload)) + payload
+
+
+def piece(p, score, t=1):
+    body = field(1, 2, p.encode()) + varint(2 << 3 | 5) + struct.pack("<f", score) + field(3, 0, t)
+    return field(1, 2, body)
+
+
+UNIGRAM_PIECES = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+                  ("▁", -3.0, 1), ("▁hello", -1.0, 1), ("▁world", -1.5, 1),
+                  ("h", -4.0, 1), ("e", -4.0, 1), ("l", -4.0, 1), ("o", -4.0, 1),
+                  ("w", -4.0, 1), ("r", -4.0, 1), ("d", -4.0, 1)]
+
+
+def write_unigram_spm(path):
+    proto = b"".join(piece(p, s, t) for p, s, t in UNIGRAM_PIECES)
+    proto += field(2, 2, field(3, 0, 1) + field(40, 0, 0) + field(41, 0, 1) + field(42, 0, 2)
+                   + field(43, 0, 2**64 - 1))  # pad_id = -1
+    proto += field(3, 2, field(3, 0, 1))  # add_dummy_prefix=true
+    with open(path, "wb") as f:
+        f.write(proto)
+
+
+class TestProtoParse:
+    def test_parse_fields(self, tmp_path):
+        from paddlenlp_tpu.transformers.convert_slow_tokenizer import parse_spm_model
+
+        p = tmp_path / "spiece.model"
+        write_unigram_spm(str(p))
+        m = parse_spm_model(p.read_bytes())
+        assert [x[0] for x in m.pieces[:4]] == ["<unk>", "<s>", "</s>", "▁"]
+        assert m.pieces[4] == ("▁hello", pytest.approx(-1.0), 1)
+        assert m.model_type == 1 and m.unk_id == 0 and m.bos_id == 1 and m.eos_id == 2
+        assert m.pad_id == -1  # sign-extended negative varint decoded
+        assert m.add_dummy_prefix
+
+
+class TestUnigramConvert:
+    def test_tokenize_and_bos(self, tmp_path):
+        from paddlenlp_tpu.transformers.convert_slow_tokenizer import convert_spm_to_fast
+
+        p = tmp_path / "spiece.model"
+        write_unigram_spm(str(p))
+        tok = convert_spm_to_fast(str(p))
+        enc = tok.encode("hello world")
+        assert enc.tokens[0] == "<s>"  # llama-style bos template
+        assert "▁hello" in enc.tokens and "▁world" in enc.tokens
+
+    def test_tokenizer_from_pretrained_spm_only(self, tmp_path):
+        """A checkpoint dir with ONLY tokenizer.model (llama lineage) loads
+        through the normal path with the bos-prepending template."""
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+        write_unigram_spm(str(tmp_path / "tokenizer.model"))
+        tok = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        ids = tok("hello world")["input_ids"]
+        assert ids[0] == 1  # bos
+        assert tok._tokenizer.decode(ids, skip_special_tokens=True).strip() == "hello world"
+
+    def test_spiece_gets_t5_style_eos(self, tmp_path):
+        """spiece.model (t5 lineage) defaults to appending </s>, no bos."""
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+        write_unigram_spm(str(tmp_path / "spiece.model"))
+        tok = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        ids = tok("hello world")["input_ids"]
+        assert ids[-1] == 2 and ids[0] != 1  # </s> appended, no <s>
+
+    def test_tokenizer_config_overrides_template(self, tmp_path):
+        """Explicit add_bos_token/add_eos_token in tokenizer_config.json win."""
+        import json
+
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+        write_unigram_spm(str(tmp_path / "spiece.model"))
+        (tmp_path / "tokenizer_config.json").write_text(
+            json.dumps({"add_bos_token": True, "add_eos_token": False}))
+        tok = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        ids = tok("hello world")["input_ids"]
+        assert ids[0] == 1 and ids[-1] != 2
+
+    def test_save_roundtrip_to_fast(self, tmp_path):
+        """Converted tokenizer saves as tokenizer.json and reloads identically."""
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+        write_unigram_spm(str(tmp_path / "spiece.model"))
+        tok = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        out = tmp_path / "saved"
+        tok.save_pretrained(str(out))
+        assert (out / "tokenizer.json").exists()
+        tok2 = PretrainedTokenizer.from_pretrained(str(out))
+        assert tok2("hello world")["input_ids"] == tok("hello world")["input_ids"]
+
+
+class TestMBartLineage:
+    def test_bpe_model_appends_eos_and_lang_codes(self, tmp_path):
+        """sentencepiece.bpe.model defaults to eos-appending; lang codes from
+        additional_special_tokens are grafted onto the converted vocab."""
+        import json
+
+        from paddlenlp_tpu.transformers import PretrainedTokenizer
+
+        write_unigram_spm(str(tmp_path / "sentencepiece.bpe.model"))
+        (tmp_path / "tokenizer_config.json").write_text(
+            json.dumps({"additional_special_tokens": ["en_XX", "ro_RO"]}))
+        tok = PretrainedTokenizer.from_pretrained(str(tmp_path))
+        ids = tok("hello world")["input_ids"]
+        assert ids[-1] == 2 and ids[0] != 1  # </s> appended, no <s>
+        en = tok._tokenizer.token_to_id("en_XX")
+        assert en is not None and en >= len(UNIGRAM_PIECES)
+
+
+class TestBPEConvert:
+    def test_bpe_merges_extracted(self, tmp_path):
+        from paddlenlp_tpu.transformers.convert_slow_tokenizer import convert_spm_to_fast
+
+        pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+                  ("▁", -1.0, 1), ("h", -2.0, 1), ("e", -2.0, 1), ("l", -2.0, 1), ("o", -2.0, 1),
+                  ("he", -0.5, 1), ("ll", -0.6, 1), ("hell", -0.3, 1), ("hello", -0.1, 1),
+                  ("▁hello", -0.05, 1)]
+        proto = b"".join(piece(p, s, t) for p, s, t in pieces)
+        proto += field(2, 2, field(3, 0, 2) + field(40, 0, 0))  # model_type=BPE
+        proto += field(3, 2, field(3, 0, 1))
+        p = tmp_path / "tokenizer.model"
+        p.write_bytes(proto)
+        tok = convert_spm_to_fast(str(p))
+        enc = tok.encode("hello")
+        assert enc.tokens[-1] == "▁hello"  # merges reach the full word

@@ -5,7 +5,7 @@
 # succeeds so the orchestrator is notified and can run the full bench.
 cd /root/repo
 LOG=BENCH_PROBELOG.jsonl
-for i in $(seq 1 12); do
+for i in $(seq 1 70); do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   OUT=$(timeout 180 python - <<'EOF' 2>&1
 import json
@@ -24,7 +24,7 @@ EOF
   fi
   DETAIL=$(echo "$OUT" | tail -1 | head -c 200 | python -c 'import json,sys; print(json.dumps(sys.stdin.read()))')
   echo "{\"ts\": \"$TS\", \"attempt\": $i, \"ok\": false, \"rc\": $RC, \"detail\": $DETAIL}" >> "$LOG"
-  sleep 3600
+  sleep 600
 done
-echo "tunnel never opened after 12 hourly attempts"
+echo "tunnel never opened after 70 probes at 10-min intervals"
 exit 1
