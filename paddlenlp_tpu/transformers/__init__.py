@@ -103,4 +103,40 @@ from .pegasus import (  # noqa: F401
     PegasusForConditionalGeneration,
     PegasusModel,
 )
+from .clip import (  # noqa: F401
+    CLIPConfig,
+    CLIPModel,
+    CLIPProcessor,
+    CLIPTextConfig,
+    CLIPTextModel,
+    CLIPTextModelWithProjection,
+    CLIPVisionConfig,
+    CLIPVisionModel,
+    CLIPVisionModelWithProjection,
+)
+from .image_processing_utils import (  # noqa: F401
+    BaseImageProcessor,
+    BlipImageProcessor,
+    CLIPImageProcessor,
+)
+from .chineseclip import (  # noqa: F401
+    ChineseCLIPConfig,
+    ChineseCLIPModel,
+    ChineseCLIPTextConfig,
+    ChineseCLIPVisionConfig,
+)
+from .blip import (  # noqa: F401
+    BlipConfig,
+    BlipForConditionalGeneration,
+    BlipForImageTextRetrieval,
+    BlipModel,
+    BlipTextConfig,
+    BlipTextModel,
+    BlipVisionConfig,
+    BlipVisionModel,
+)
+from .ernie_vil import (  # noqa: F401
+    ErnieViLConfig,
+    ErnieViLModel,
+)
 from .tokenizer_utils import BatchEncoding, PretrainedTokenizer  # noqa: F401

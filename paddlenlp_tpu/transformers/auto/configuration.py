@@ -46,13 +46,18 @@ def _populate():
     from ..mt5.configuration import MT5Config
     from ..mbart.configuration import MBartConfig
     from ..pegasus.configuration import PegasusConfig
+    from ..clip.configuration import CLIPConfig
+    from ..chineseclip.configuration import ChineseCLIPConfig
+    from ..blip.configuration import BlipConfig
+    from ..ernie_vil.configuration import ErnieViLConfig
 
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
                 ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
                 OPTConfig, QWenConfig, ChatGLMv2Config, T5Config, BartConfig, DeepseekV2Config,
                 MambaConfig, RWConfig, ChatGLMConfig, YuanConfig, JambaConfig,
                 AlbertConfig, ElectraConfig, RobertaConfig,
-                MT5Config, MBartConfig, PegasusConfig):
+                MT5Config, MBartConfig, PegasusConfig,
+                CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

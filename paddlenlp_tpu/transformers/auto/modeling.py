@@ -142,6 +142,18 @@ def _populate_models():
 
     register_model("pegasus", "base", pegasus.PegasusModel)
     register_model("pegasus", "seq2seq_lm", pegasus.PegasusForConditionalGeneration)
+    from ..clip import modeling as clip
+
+    register_model("clip", "base", clip.CLIPModel)
+    from ..chineseclip import modeling as chineseclip
+
+    register_model("chinese_clip", "base", chineseclip.ChineseCLIPModel)
+    from ..blip import modeling as blip
+
+    register_model("blip", "base", blip.BlipModel)
+    from ..ernie_vil import modeling as ernie_vil
+
+    register_model("ernie_vil", "base", ernie_vil.ErnieViLModel)
 
 
 class _AutoBase:

@@ -1,0 +1,9 @@
+from .configuration import BlipConfig, BlipTextConfig, BlipVisionConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    BlipForConditionalGeneration,
+    BlipForImageTextRetrieval,
+    BlipModel,
+    BlipPretrainedModel,
+    BlipTextModel,
+    BlipVisionModel,
+)

@@ -1,0 +1,122 @@
+"""BLIP configuration (reference: paddlenlp/transformers/blip/configuration.py:393 LoC)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["BlipConfig", "BlipTextConfig", "BlipVisionConfig"]
+
+
+class BlipTextConfig(PretrainedConfig):
+    """BERT-shaped decoder with cross-attention into the vision encoder."""
+
+    model_type = "blip_text_model"
+
+    def __init__(
+        self,
+        vocab_size: int = 30524,
+        hidden_size: int = 768,
+        encoder_hidden_size: int = 768,
+        intermediate_size: int = 3072,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 8,
+        max_position_embeddings: int = 512,
+        hidden_act: str = "gelu",
+        layer_norm_eps: float = 1e-12,
+        hidden_dropout_prob: float = 0.0,
+        attention_probs_dropout_prob: float = 0.0,
+        initializer_range: float = 0.02,
+        projection_dim: int = 768,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.encoder_hidden_size = encoder_hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_act = hidden_act
+        self.layer_norm_eps = layer_norm_eps
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.projection_dim = projection_dim
+        kwargs.setdefault("pad_token_id", 0)
+        kwargs.setdefault("bos_token_id", 30522)
+        kwargs.setdefault("eos_token_id", 102)  # [SEP]
+        super().__init__(**kwargs)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class BlipVisionConfig(PretrainedConfig):
+    model_type = "blip_vision_model"
+
+    def __init__(
+        self,
+        hidden_size: int = 768,
+        intermediate_size: int = 3072,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        image_size: int = 384,
+        patch_size: int = 16,
+        num_channels: int = 3,
+        hidden_act: str = "gelu",
+        layer_norm_eps: float = 1e-5,
+        attention_dropout: float = 0.0,
+        initializer_range: float = 1e-10,
+        projection_dim: int = 512,
+        **kwargs,
+    ):
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.num_channels = num_channels
+        self.hidden_act = hidden_act
+        self.layer_norm_eps = layer_norm_eps
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+        self.projection_dim = projection_dim
+        super().__init__(**kwargs)
+
+
+class BlipConfig(PretrainedConfig):
+    model_type = "blip"
+
+    def __init__(
+        self,
+        text_config: Optional[Dict[str, Any]] = None,
+        vision_config: Optional[Dict[str, Any]] = None,
+        projection_dim: int = 512,
+        logit_scale_init_value: float = 2.6592,
+        **kwargs,
+    ):
+        if isinstance(text_config, PretrainedConfig):
+            text_config = text_config.to_dict()
+        if isinstance(vision_config, PretrainedConfig):
+            vision_config = vision_config.to_dict()
+        vision = {**(vision_config or {}), "projection_dim": projection_dim}
+        self.vision_config = BlipVisionConfig(**vision)
+        text = {**(text_config or {}), "projection_dim": projection_dim}
+        text.setdefault("encoder_hidden_size", self.vision_config.hidden_size)
+        self.text_config = BlipTextConfig(**text)
+        self.projection_dim = projection_dim
+        self.logit_scale_init_value = logit_scale_init_value
+        super().__init__(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = copy.deepcopy({k: v for k, v in self.__dict__.items()
+                             if k not in ("text_config", "vision_config")})
+        out["model_type"] = self.model_type
+        out["text_config"] = self.text_config.to_dict()
+        out["vision_config"] = self.vision_config.to_dict()
+        return out

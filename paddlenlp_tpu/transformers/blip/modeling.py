@@ -1,0 +1,449 @@
+"""BLIP vision-language model, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/blip/modeling.py`` (1590 LoC) +
+``modeling_text.py`` (1101 LoC): ``BlipVisionModel`` :581 (ViT with FUSED qkv
+projection :301), the BERT-shaped text decoder with cross-attention into the
+image sequence (modeling_text.py BertLayer w/ ``crossattention``), ``BlipModel``
+:691 (contrastive twin of CLIP), and ``BlipForConditionalGeneration`` :998
+(captioning). ``BlipForQuestionAnswering``/``ImageTextRetrieval`` reuse the same
+towers; ITM is provided, the QA encoder-decoder arrangement is legacy-scope.
+
+TPU-first notes:
+- Caption decoding runs over a FIXED [B, L] token buffer with one jitted step
+  (full causal forward per step, logits gathered at the write position). At
+  caption lengths the O(L^2) recompute is noise next to the vision tower, and
+  the static shapes avoid per-length retraces — the reference threads a dynamic
+  past_key_values dict instead.
+- pixel_values are channels-last [B, H, W, C] (see clip/modeling.py).
+"""
+
+from __future__ import annotations
+
+import re
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..clip.modeling import contrastive_output
+from ..llama.modeling import ACT2FN, VocabEmbed
+from ..model_outputs import BaseModelOutputWithPooling, CLIPOutput, CausalLMOutput
+from ..model_utils import PretrainedModel
+from .configuration import BlipConfig, BlipTextConfig, BlipVisionConfig
+
+__all__ = [
+    "BlipModel",
+    "BlipVisionModel",
+    "BlipTextModel",
+    "BlipForConditionalGeneration",
+    "BlipForImageTextRetrieval",
+    "BlipPretrainedModel",
+]
+
+
+class BlipVisionEmbeddings(nn.Module):
+    config: BlipVisionConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        cfg = self.config
+        B = pixel_values.shape[0]
+        p = cfg.patch_size
+        patches = nn.Conv(cfg.hidden_size, kernel_size=(p, p), strides=(p, p), use_bias=True,
+                          dtype=self.dtype, param_dtype=self.param_dtype,
+                          kernel_init=nn.initializers.normal(cfg.initializer_range),
+                          name="patch_embedding")(pixel_values.astype(self.dtype))
+        patches = patches.reshape(B, -1, cfg.hidden_size)
+        cls = self.param("class_embedding", nn.initializers.normal(cfg.initializer_range),
+                         (1, 1, cfg.hidden_size), self.param_dtype)
+        h = jnp.concatenate([jnp.broadcast_to(cls.astype(self.dtype), (B, 1, cfg.hidden_size)),
+                             patches], axis=1)
+        n_pos = (cfg.image_size // p) ** 2 + 1
+        pos = self.param("position_embedding", nn.initializers.normal(cfg.initializer_range),
+                         (1, n_pos, cfg.hidden_size), self.param_dtype)
+        return h + pos[:, : h.shape[1]].astype(self.dtype)
+
+
+class BlipVisionLayer(nn.Module):
+    """Pre-LN ViT block with FUSED qkv (reference BlipAttention :284-301)."""
+
+    config: BlipVisionConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, deterministic: bool = True):
+        cfg = self.config
+        B, T, D = h.shape
+        n = cfg.num_attention_heads
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        x = ln("layer_norm1")(h)
+        qkv = dense(3 * D, "self_attn_qkv")(x).reshape(B, T, 3, n, D // n)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        attn = dot_product_attention(q, k, v, causal=False).reshape(B, T, D)
+        h = h + dense(D, "self_attn_projection")(attn)
+        x = ln("layer_norm2")(h)
+        ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "mlp_fc1")(x))
+        ff = shard_constraint(ff, P("batch", None, "act_mlp"))
+        h = h + dense(D, "mlp_fc2")(ff)
+        return h
+
+
+class BlipVisionTransformer(nn.Module):
+    config: BlipVisionConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixel_values, deterministic: bool = True):
+        cfg = self.config
+        h = BlipVisionEmbeddings(cfg, self.dtype, self.param_dtype, name="embeddings")(pixel_values)
+        for i in range(cfg.num_hidden_layers):
+            h = BlipVisionLayer(cfg, self.dtype, self.param_dtype,
+                                name=f"encoder_layers_{i}")(h, deterministic)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="post_layernorm")(h)
+        return BaseModelOutputWithPooling(last_hidden_state=h, pooler_output=h[:, 0])
+
+
+class BlipTextLayer(nn.Module):
+    """BERT post-LN block + optional cross-attention sublayer
+    (reference modeling_text.py BertLayer w/ ``crossattention``)."""
+
+    config: BlipTextConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, encoder_hidden_states=None, causal=False,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+
+        q = dense(D, "attention_self_query")(h).reshape(B, T, n, hd)
+        k = dense(D, "attention_self_key")(h).reshape(B, T, n, hd)
+        v = dense(D, "attention_self_value")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask,
+                                     causal=causal).reshape(B, T, D)
+        h = ln("attention_output_LayerNorm")(h + dense(D, "attention_output_dense")(attn))
+
+        if encoder_hidden_states is not None:
+            S = encoder_hidden_states.shape[1]
+            q = dense(D, "crossattention_self_query")(h).reshape(B, T, n, hd)
+            k = dense(D, "crossattention_self_key")(encoder_hidden_states).reshape(B, S, n, hd)
+            v = dense(D, "crossattention_self_value")(encoder_hidden_states).reshape(B, S, n, hd)
+            cross = dot_product_attention(q, k, v, causal=False).reshape(B, T, D)
+            h = ln("crossattention_output_LayerNorm")(h + dense(D, "crossattention_output_dense")(cross))
+
+        ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "intermediate_dense")(h))
+        ff = shard_constraint(ff, P("batch", None, "act_mlp"))
+        h = ln("output_LayerNorm")(h + dense(D, "output_dense")(ff))
+        return h
+
+
+class BlipTextModule(nn.Module):
+    """Embeddings + N BlipTextLayers [+ BERT-style MLM cls head]."""
+
+    config: BlipTextConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    with_lm_head: bool = False
+    add_pooling_layer: bool = False  # tanh pooler, used by the contrastive BlipModel
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, encoder_hidden_states=None,
+                 position_ids=None, causal: Optional[bool] = None, deterministic: bool = True):
+        cfg = self.config
+        B, T = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        if causal is None:
+            causal = self.with_lm_head
+        init = nn.initializers.normal(cfg.initializer_range)
+        words = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                           param_dtype=self.param_dtype, embedding_init=init,
+                           name="embeddings_word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                       param_dtype=self.param_dtype, embedding_init=init,
+                       name="embeddings_position_embeddings")(position_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(words + pos)
+        for i in range(cfg.num_hidden_layers):
+            h = BlipTextLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, encoder_hidden_states, causal, deterministic)
+        if not self.with_lm_head:
+            pooled = h[:, 0]
+            if self.add_pooling_layer:
+                pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                                           param_dtype=self.param_dtype,
+                                           name="pooler_dense")(pooled))
+            return BaseModelOutputWithPooling(last_hidden_state=h, pooler_output=pooled)
+        # BERT cls.predictions head; decoder is TIED to the word embeddings with a
+        # standalone bias (HF blip omits decoder.weight/bias from checkpoints)
+        x = nn.Dense(cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="cls_predictions_transform_dense")(h)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="cls_predictions_transform_LayerNorm")(x)
+        table = self.get_variable("params", "embeddings_word_embeddings")["embedding"]
+        bias = self.param("cls_predictions_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), self.param_dtype)
+        logits = x @ table.T.astype(self.dtype) + bias.astype(self.dtype)
+        logits = shard_constraint(logits, P("batch", None, "act_vocab"))
+        return CausalLMOutput(logits=logits)
+
+
+class BlipModule(nn.Module):
+    """Contrastive dual tower (reference BlipModel :691)."""
+
+    config: BlipConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.text_model = BlipTextModule(cfg.text_config, self.dtype, self.param_dtype,
+                                         add_pooling_layer=True)
+        self.vision_model = BlipVisionTransformer(cfg.vision_config, self.dtype, self.param_dtype)
+        proj = lambda: nn.Dense(cfg.projection_dim, use_bias=False, dtype=self.dtype,
+                                param_dtype=self.param_dtype,
+                                kernel_init=nn.initializers.normal(0.02))
+        self.visual_projection = proj()
+        self.text_projection = proj()
+        self.logit_scale = self.param("logit_scale",
+                                      nn.initializers.constant(cfg.logit_scale_init_value), ())
+
+    def __call__(self, input_ids=None, pixel_values=None, attention_mask=None,
+                 deterministic: bool = True, return_loss: bool = False, return_dict: bool = True):
+        text_out = self.text_model(input_ids, attention_mask, causal=False,
+                                   deterministic=deterministic)
+        vision_out = self.vision_model(pixel_values, deterministic=deterministic)
+        return contrastive_output(self.text_projection(text_out.pooler_output),
+                                  self.visual_projection(vision_out.pooler_output),
+                                  self.logit_scale, dtype=self.dtype, return_loss=return_loss)
+
+
+class BlipForConditionalGenerationModule(nn.Module):
+    config: BlipConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.vision_model = BlipVisionTransformer(cfg.vision_config, self.dtype, self.param_dtype)
+        self.text_decoder = BlipTextModule(cfg.text_config, self.dtype, self.param_dtype,
+                                           with_lm_head=True)
+
+    def encode_image(self, pixel_values, deterministic=True):
+        return self.vision_model(pixel_values, deterministic=deterministic).last_hidden_state
+
+    def decode(self, input_ids, image_embeds, attention_mask=None, deterministic=True):
+        return self.text_decoder(input_ids, attention_mask, image_embeds,
+                                 causal=True, deterministic=deterministic)
+
+    def __call__(self, pixel_values=None, input_ids=None, attention_mask=None, labels=None,
+                 deterministic: bool = True, return_dict: bool = True):
+        image_embeds = self.encode_image(pixel_values, deterministic)
+        out = self.decode(input_ids, image_embeds, attention_mask, deterministic)
+        if labels is not None:
+            logits = out.logits[:, :-1]
+            targets = labels[:, 1:]
+            valid = targets != -100
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+            loss = (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+            return CausalLMOutput(logits=out.logits), loss
+        return out
+
+
+class BlipForImageTextRetrievalModule(nn.Module):
+    """ITM head: text attends to the image, [CLS] -> match/no-match logits
+    (reference BlipForImageTextRetrieval :1443)."""
+
+    config: BlipConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.vision_model = BlipVisionTransformer(cfg.vision_config, self.dtype, self.param_dtype)
+        self.text_encoder = BlipTextModule(cfg.text_config, self.dtype, self.param_dtype)
+        self.itm_head = nn.Dense(2, dtype=self.dtype, param_dtype=self.param_dtype)
+
+    def __call__(self, input_ids=None, pixel_values=None, attention_mask=None,
+                 deterministic: bool = True, return_dict: bool = True):
+        image_embeds = self.vision_model(pixel_values, deterministic=deterministic).last_hidden_state
+        text_out = self.text_encoder(input_ids, attention_mask, image_embeds,
+                                     causal=False, deterministic=deterministic)
+        return self.itm_head(text_out.last_hidden_state[:, 0])
+
+
+def _blip_name_mappings(flat_shapes):
+    from ..conversion_utils import StateDictNameMapping
+
+    mappings = []
+    for path, leaf in flat_shapes.items():
+        key = path
+        key = re.sub(r"\bencoder_layers_(\d+)\b", r"encoder@layers@\1", key)  # vision
+        key = re.sub(r"\bencoder_layer_(\d+)\b", r"encoder@layer@\1", key)  # text
+        key = key.replace("embeddings_", "embeddings@")
+        key = key.replace("self_attn_", "self_attn@").replace("mlp_fc", "mlp@fc")
+        key = key.replace("attention_self_", "attention@self@")
+        key = key.replace("attention_output_LayerNorm", "attention@output@LayerNorm")
+        key = key.replace("attention_output_dense", "attention@output@dense")
+        key = key.replace("intermediate_dense", "intermediate@dense")
+        key = key.replace("output_LayerNorm", "output@LayerNorm")
+        key = key.replace("output_dense", "output@dense")
+        key = key.replace("pooler_dense", "pooler@dense")
+        key = key.replace("cls_predictions_transform_LayerNorm", "cls@predictions@transform@LayerNorm")
+        key = key.replace("cls_predictions_transform_dense", "cls@predictions@transform@dense")
+        key = key.replace("cls_predictions_bias", "cls@predictions@bias")
+        key = key.replace("/", ".").replace("@", ".")
+        # ONLY the LM-head decoder nests its bert body (HF BlipTextLMHeadModel:
+        # text_decoder.bert.* + text_decoder.cls.*); BlipModel's text_model and
+        # the ITM text_encoder are bare BlipTextModels with no bert prefix
+        key = re.sub(r"\btext_decoder\.(?!cls\.)", "text_decoder.bert.", key)
+        ndim = len(getattr(leaf, "shape", ()))
+        fn = fn_reverse = None
+        action = None
+        if key.endswith(".kernel"):
+            key = key.rsplit(".", 1)[0] + ".weight"
+            if ndim == 2:
+                action = "transpose"
+            elif ndim == 4:
+                fn = lambda a: np.ascontiguousarray(a.transpose(2, 3, 1, 0))
+                fn_reverse = lambda a: np.ascontiguousarray(a.transpose(3, 2, 0, 1))
+        elif key.endswith((".scale", ".embedding")):
+            key = key.rsplit(".", 1)[0] + ".weight"
+        key = key.replace("embeddings.class_embedding.weight", "embeddings.class_embedding")
+        key = key.replace("embeddings.position_embedding.weight", "embeddings.position_embedding")
+        mappings.append(StateDictNameMapping(key, path, action, fn, fn_reverse))
+    return mappings
+
+
+class BlipPretrainedModel(PretrainedModel):
+    config_class = BlipConfig
+    base_model_prefix = "blip"
+
+    def dummy_inputs(self):
+        v = self.config.vision_config
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32),
+                "pixel_values": jnp.zeros((1, v.image_size, v.image_size, 3), dtype=jnp.float32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"(query|key|value)/kernel$", P("embed", "heads")),
+            (r"qkv/kernel$", P("embed", "heads")),
+            (r"(projection|attention_output_dense|crossattention_output_dense)/kernel$", P("heads", "embed")),
+            (r"(intermediate_dense|fc1)/kernel$", P("embed", "mlp")),
+            (r"(output_dense|fc2)/kernel$", P("mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        return _blip_name_mappings(flat_shapes)
+
+
+class BlipVisionModel(BlipPretrainedModel):
+    config_class = BlipVisionConfig
+    module_class = BlipVisionTransformer
+
+    def dummy_inputs(self):
+        s = self.config.image_size
+        return {"pixel_values": jnp.zeros((1, s, s, 3), dtype=jnp.float32)}
+
+
+class BlipTextModel(BlipPretrainedModel):
+    config_class = BlipTextConfig
+    module_class = BlipTextModule
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+
+class BlipModel(BlipPretrainedModel):
+    module_class = BlipModule
+
+
+class BlipForConditionalGeneration(BlipPretrainedModel):
+    module_class = BlipForConditionalGenerationModule
+    main_input_name = "pixel_values"
+
+    def _caption_step(self, do_sample: bool, top_k: int):
+        """One jitted decode step, cached across generate() calls (params and
+        image_embeds are traced ARGUMENTS, not baked-in constants, so repeated
+        captioning pays compilation once per (buffer-shape, sampling-mode))."""
+        key_ = ("caption_step", do_sample, top_k)
+        if key_ not in self._jit_cache:
+            def step(params, image_embeds, buf, t, temperature, key):
+                out = self.module.apply({"params": params}, buf, image_embeds,
+                                        method=self.module.decode)
+                logits = jnp.take_along_axis(out.logits, (t - 1)[None, None, None].astype(jnp.int32),
+                                             axis=1)[:, 0]
+                if do_sample:
+                    logits = logits / jnp.maximum(temperature, 1e-6)
+                    if top_k:
+                        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                        logits = jnp.where(logits < kth, -1e30, logits)
+                    nxt = jax.random.categorical(key, logits)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                return buf.at[:, t].set(nxt.astype(jnp.int32))
+
+            self._jit_cache[key_] = jax.jit(step)
+        return self._jit_cache[key_]
+
+    def generate(self, pixel_values, input_ids=None, max_new_tokens: int = 20,
+                 do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
+                 seed: int = 0, params=None):
+        """Caption decode over a fixed-size buffer: one cached jitted step, full
+        causal forward per step (cheap at caption lengths, zero retraces)."""
+        params = params if params is not None else self.params
+        cfg = self.config.text_config
+        B = pixel_values.shape[0]
+        if input_ids is None:
+            input_ids = jnp.full((B, 1), cfg.bos_token_id, jnp.int32)
+        P0 = input_ids.shape[1]
+        L = P0 + max_new_tokens
+        buf = jnp.zeros((B, L), jnp.int32).at[:, :P0].set(input_ids)
+        image_embeds = self.module.apply({"params": params}, pixel_values,
+                                         method=self.module.encode_image)
+        step = self._caption_step(do_sample, top_k)
+        key = jax.random.key(seed)
+        finished = jnp.zeros((B,), bool)
+        temp = jnp.asarray(temperature, jnp.float32)
+        for t in range(P0, L):
+            key, sub = jax.random.split(key)
+            new_buf = step(params, image_embeds, buf, jnp.asarray(t), temp, sub)
+            # keep pad after eos
+            tok = jnp.where(finished, cfg.pad_token_id, new_buf[:, t])
+            buf = buf.at[:, t].set(tok)
+            finished = finished | (tok == cfg.eos_token_id)
+            if bool(finished.all()):
+                break
+        return buf[:, P0:]
+
+
+class BlipForImageTextRetrieval(BlipPretrainedModel):
+    module_class = BlipForImageTextRetrievalModule

@@ -1,0 +1,6 @@
+from .configuration import (  # noqa: F401
+    ChineseCLIPConfig,
+    ChineseCLIPTextConfig,
+    ChineseCLIPVisionConfig,
+)
+from .modeling import ChineseCLIPModel, ChineseCLIPPretrainedModel  # noqa: F401

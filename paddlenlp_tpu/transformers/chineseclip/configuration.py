@@ -1,0 +1,54 @@
+"""ChineseCLIP configuration (reference: paddlenlp/transformers/chineseclip/configuration.py).
+
+Text tower is a Chinese BERT (bert config/keys), vision tower is the CLIP ViT.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ..bert.configuration import BertConfig
+from ..clip.configuration import CLIPVisionConfig
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["ChineseCLIPConfig", "ChineseCLIPTextConfig", "ChineseCLIPVisionConfig"]
+
+
+class ChineseCLIPTextConfig(BertConfig):
+    model_type = "chinese_clip_text_model"
+
+
+class ChineseCLIPVisionConfig(CLIPVisionConfig):
+    model_type = "chinese_clip_vision_model"
+
+
+class ChineseCLIPConfig(PretrainedConfig):
+    model_type = "chinese_clip"
+
+    def __init__(
+        self,
+        text_config: Optional[Dict[str, Any]] = None,
+        vision_config: Optional[Dict[str, Any]] = None,
+        projection_dim: int = 512,
+        logit_scale_init_value: float = 2.6592,
+        **kwargs,
+    ):
+        if isinstance(text_config, PretrainedConfig):
+            text_config = text_config.to_dict()
+        if isinstance(vision_config, PretrainedConfig):
+            vision_config = vision_config.to_dict()
+        self.text_config = ChineseCLIPTextConfig(**(text_config or {}))
+        self.vision_config = ChineseCLIPVisionConfig(
+            **{**(vision_config or {}), "projection_dim": projection_dim})
+        self.projection_dim = projection_dim
+        self.logit_scale_init_value = logit_scale_init_value
+        super().__init__(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = copy.deepcopy({k: v for k, v in self.__dict__.items()
+                             if k not in ("text_config", "vision_config")})
+        out["model_type"] = self.model_type
+        out["text_config"] = self.text_config.to_dict()
+        out["vision_config"] = self.vision_config.to_dict()
+        return out

@@ -1,0 +1,114 @@
+"""ChineseCLIP, TPU-native — BERT text tower + CLIP ViT vision tower.
+
+Counterpart of ``paddlenlp/transformers/chineseclip/modeling.py`` (1036 LoC,
+``ChineseCLIPModel``): the text encoder is architecturally Chinese BERT
+(pooling = [CLS] hidden state, NOT bert's tanh pooler) and the vision encoder
+is the CLIP ViT; both feed linear projections into the shared contrastive
+space. Reuses this repo's BertModule and CLIPVisionTransformer wholesale —
+only the pairing + projections + key mapping are new.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..bert.modeling import BertModule
+from ..clip.modeling import CLIPVisionTransformer, contrastive_output
+from ..model_utils import PretrainedModel
+from ...parallel.partition import P
+from .configuration import ChineseCLIPConfig
+
+__all__ = ["ChineseCLIPModel", "ChineseCLIPPretrainedModel"]
+
+
+class ChineseCLIPModule(nn.Module):
+    config: ChineseCLIPConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        # pooling is [CLS] hidden state, so skip bert's unused tanh pooler
+        # (absent from reference checkpoints)
+        self.text_model = BertModule(cfg.text_config, self.dtype, self.param_dtype,
+                                     add_pooling_layer=False)
+        self.vision_model = CLIPVisionTransformer(cfg.vision_config, self.dtype, self.param_dtype)
+        proj = lambda: nn.Dense(cfg.projection_dim, use_bias=False, dtype=self.dtype,
+                                param_dtype=self.param_dtype,
+                                kernel_init=nn.initializers.normal(0.02))
+        self.visual_projection = proj()
+        self.text_projection = proj()
+        self.logit_scale = self.param("logit_scale",
+                                      nn.initializers.constant(cfg.logit_scale_init_value), ())
+
+    def get_text_features(self, input_ids, attention_mask=None, token_type_ids=None,
+                          deterministic=True):
+        out = self.text_model(input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        return self.text_projection(out.last_hidden_state[:, 0])  # [CLS], not the tanh pooler
+
+    def get_image_features(self, pixel_values, deterministic=True):
+        out = self.vision_model(pixel_values, deterministic=deterministic)
+        return self.visual_projection(out.pooler_output)
+
+    def __call__(self, input_ids=None, pixel_values=None, attention_mask=None,
+                 token_type_ids=None, deterministic: bool = True, return_loss: bool = False,
+                 return_dict: bool = True):
+        return contrastive_output(
+            self.get_text_features(input_ids, attention_mask, token_type_ids, deterministic),
+            self.get_image_features(pixel_values, deterministic),
+            self.logit_scale, dtype=self.dtype, return_loss=return_loss)
+
+
+class ChineseCLIPPretrainedModel(PretrainedModel):
+    config_class = ChineseCLIPConfig
+    base_model_prefix = "chinese_clip"
+
+    def dummy_inputs(self):
+        v = self.config.vision_config
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32),
+                "pixel_values": jnp.zeros((1, v.image_size, v.image_size, 3), dtype=jnp.float32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..bert.modeling import BertPretrainedModel
+        from ..clip.modeling import CLIPPretrainedModel
+
+        return CLIPPretrainedModel.get_partition_rules(config) + [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"(query|key|value)/kernel$", P("embed", "heads")),
+            (r"attention_output_dense/kernel$", P("heads", "embed")),
+            (r"intermediate_dense/kernel$", P("embed", "mlp")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        """text_model/* follows bert key grammar, vision_model/* + projections
+        follow clip key grammar."""
+        from ..bert.modeling import BertPretrainedModel
+        from ..clip.modeling import _clip_name_mappings
+
+        text = {p: l for p, l in flat_shapes.items() if p.startswith("text_model/")}
+        rest = {p: l for p, l in flat_shapes.items() if not p.startswith("text_model/")}
+        mappings = _clip_name_mappings(rest)
+        stripped = {p[len("text_model/"):]: l for p, l in text.items()}
+        for m in BertPretrainedModel._get_name_mappings(config.text_config, stripped):
+            m.source_name = "text_model." + m.source_name
+            m.target_name = "text_model/" + m.target_name
+            mappings.append(m)
+        return mappings
+
+
+class ChineseCLIPModel(ChineseCLIPPretrainedModel):
+    module_class = ChineseCLIPModule
+
+    def get_text_features(self, input_ids, attention_mask=None, params=None):
+        return self.module.apply({"params": params if params is not None else self.params},
+                                 input_ids, attention_mask,
+                                 method=self.module.get_text_features)
+
+    def get_image_features(self, pixel_values, params=None):
+        return self.module.apply({"params": params if params is not None else self.params},
+                                 pixel_values, method=self.module.get_image_features)

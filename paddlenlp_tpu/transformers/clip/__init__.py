@@ -1,0 +1,11 @@
+from .configuration import CLIPConfig, CLIPTextConfig, CLIPVisionConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    CLIPModel,
+    CLIPPretrainedModel,
+    CLIPTextModel,
+    CLIPTextModelWithProjection,
+    CLIPVisionModel,
+    CLIPVisionModelWithProjection,
+    clip_loss,
+)
+from .processing import CLIPProcessor  # noqa: F401

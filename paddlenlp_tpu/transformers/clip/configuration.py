@@ -1,0 +1,116 @@
+"""CLIP configuration (reference: paddlenlp/transformers/clip/configuration.py:509 LoC).
+
+Nested text/vision sub-configs + projection head, HF config.json compatible.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["CLIPConfig", "CLIPTextConfig", "CLIPVisionConfig"]
+
+
+class CLIPTextConfig(PretrainedConfig):
+    model_type = "clip_text_model"
+
+    def __init__(
+        self,
+        vocab_size: int = 49408,
+        hidden_size: int = 512,
+        intermediate_size: int = 2048,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 8,
+        max_position_embeddings: int = 77,
+        hidden_act: str = "quick_gelu",
+        layer_norm_eps: float = 1e-5,
+        attention_dropout: float = 0.0,
+        initializer_range: float = 0.02,
+        initializer_factor: float = 1.0,
+        projection_dim: int = 512,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_act = hidden_act
+        self.layer_norm_eps = layer_norm_eps
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+        self.initializer_factor = initializer_factor
+        self.projection_dim = projection_dim
+        kwargs.setdefault("pad_token_id", 1)
+        kwargs.setdefault("bos_token_id", 49406)
+        kwargs.setdefault("eos_token_id", 49407)
+        super().__init__(**kwargs)
+
+
+class CLIPVisionConfig(PretrainedConfig):
+    model_type = "clip_vision_model"
+
+    def __init__(
+        self,
+        hidden_size: int = 768,
+        intermediate_size: int = 3072,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        num_channels: int = 3,
+        image_size: int = 224,
+        patch_size: int = 32,
+        hidden_act: str = "quick_gelu",
+        layer_norm_eps: float = 1e-5,
+        attention_dropout: float = 0.0,
+        initializer_range: float = 0.02,
+        initializer_factor: float = 1.0,
+        projection_dim: int = 512,
+        **kwargs,
+    ):
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_channels = num_channels
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.hidden_act = hidden_act
+        self.layer_norm_eps = layer_norm_eps
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+        self.initializer_factor = initializer_factor
+        self.projection_dim = projection_dim
+        super().__init__(**kwargs)
+
+
+class CLIPConfig(PretrainedConfig):
+    model_type = "clip"
+
+    def __init__(
+        self,
+        text_config: Optional[Dict[str, Any]] = None,
+        vision_config: Optional[Dict[str, Any]] = None,
+        projection_dim: int = 512,
+        logit_scale_init_value: float = 2.6592,
+        **kwargs,
+    ):
+        if isinstance(text_config, PretrainedConfig):
+            text_config = text_config.to_dict()
+        if isinstance(vision_config, PretrainedConfig):
+            vision_config = vision_config.to_dict()
+        self.text_config = CLIPTextConfig(**{**(text_config or {}), "projection_dim": projection_dim})
+        self.vision_config = CLIPVisionConfig(**{**(vision_config or {}), "projection_dim": projection_dim})
+        self.projection_dim = projection_dim
+        self.logit_scale_init_value = logit_scale_init_value
+        super().__init__(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = copy.deepcopy({k: v for k, v in self.__dict__.items()
+                             if k not in ("text_config", "vision_config")})
+        out["model_type"] = self.model_type
+        out["text_config"] = self.text_config.to_dict()
+        out["vision_config"] = self.vision_config.to_dict()
+        return out

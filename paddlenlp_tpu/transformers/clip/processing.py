@@ -1,0 +1,44 @@
+"""CLIP processor: tokenizer + image processor in one callable
+(reference clip/processing.py:156 ``CLIPProcessor``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..image_processing_utils import CLIPImageProcessor
+
+__all__ = ["CLIPProcessor"]
+
+
+class CLIPProcessor:
+    def __init__(self, image_processor=None, tokenizer=None):
+        self.image_processor = image_processor or CLIPImageProcessor()
+        self.tokenizer = tokenizer
+
+    @classmethod
+    def from_pretrained(cls, pretrained_model_name_or_path: str, **kwargs):
+        from ..tokenizer_utils import PretrainedTokenizer
+
+        return cls(
+            image_processor=CLIPImageProcessor.from_pretrained(pretrained_model_name_or_path),
+            tokenizer=PretrainedTokenizer.from_pretrained(pretrained_model_name_or_path, **kwargs),
+        )
+
+    def __call__(self, text=None, images=None, return_tensors: Optional[str] = "np", **kwargs):
+        out = {}
+        if text is not None:
+            out.update(self.tokenizer(text, return_tensors=return_tensors, **kwargs))
+        if images is not None:
+            out.update(self.image_processor(images, return_tensors=return_tensors))
+        return out
+
+    def save_pretrained(self, save_directory: str):
+        self.image_processor.save_pretrained(save_directory)
+        if self.tokenizer is not None:
+            self.tokenizer.save_pretrained(save_directory)
+
+    def batch_decode(self, *args, **kwargs):
+        return self.tokenizer.batch_decode(*args, **kwargs)
+
+    def decode(self, *args, **kwargs):
+        return self.tokenizer.decode(*args, **kwargs)

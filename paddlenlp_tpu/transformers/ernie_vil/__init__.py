@@ -1,0 +1,6 @@
+from .configuration import (  # noqa: F401
+    ErnieViLConfig,
+    ErnieViLTextConfig,
+    ErnieViLVisionConfig,
+)
+from .modeling import ErnieViLModel, ErnieViLPretrainedModel  # noqa: F401

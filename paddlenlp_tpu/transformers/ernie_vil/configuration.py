@@ -1,0 +1,58 @@
+"""ERNIE-ViL 2.0 configuration (reference: paddlenlp/transformers/ernie_vil/configuration.py).
+
+Dual tower: ernie text encoder + ViT; towers project into the SAME hidden size
+(no projection heads — reference modeling.py:245-248 uses pooled outputs
+directly), similarity scaled by a learned temperature.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ..clip.configuration import CLIPVisionConfig
+from ..configuration_utils import PretrainedConfig
+from ..ernie.configuration import ErnieConfig
+
+__all__ = ["ErnieViLConfig", "ErnieViLTextConfig", "ErnieViLVisionConfig"]
+
+
+class ErnieViLTextConfig(ErnieConfig):
+    model_type = "ernie_vil_text_model"
+
+
+class ErnieViLVisionConfig(CLIPVisionConfig):
+    model_type = "ernie_vil_vision_model"
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("patch_size", 16)
+        kwargs.setdefault("hidden_act", "quick_gelu")
+        super().__init__(**kwargs)
+
+
+class ErnieViLConfig(PretrainedConfig):
+    model_type = "ernie_vil"
+
+    def __init__(
+        self,
+        text_config: Optional[Dict[str, Any]] = None,
+        vision_config: Optional[Dict[str, Any]] = None,
+        logit_scale_init_value: float = 2.6592,
+        **kwargs,
+    ):
+        if isinstance(text_config, PretrainedConfig):
+            text_config = text_config.to_dict()
+        if isinstance(vision_config, PretrainedConfig):
+            vision_config = vision_config.to_dict()
+        self.text_config = ErnieViLTextConfig(**(text_config or {}))
+        self.vision_config = ErnieViLVisionConfig(**(vision_config or {}))
+        self.logit_scale_init_value = logit_scale_init_value
+        super().__init__(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = copy.deepcopy({k: v for k, v in self.__dict__.items()
+                             if k not in ("text_config", "vision_config")})
+        out["model_type"] = self.model_type
+        out["text_config"] = self.text_config.to_dict()
+        out["vision_config"] = self.vision_config.to_dict()
+        return out

@@ -1,0 +1,58 @@
+"""ERNIE-ViL 2.0, TPU-native — ernie text tower + CLIP ViT vision tower.
+
+Counterpart of ``paddlenlp/transformers/ernie_vil/modeling.py`` (672 LoC,
+``ErnieViLModel`` :150). Unlike CLIP there are NO projection heads: both
+towers' pooled outputs live in the same hidden size and similarity is scaled
+by a learned ``temperature`` (:187-191). Reuses BertModule (ernie is
+config-compatible) and CLIPVisionTransformer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..bert.modeling import BertModule
+from ..chineseclip.modeling import ChineseCLIPPretrainedModel
+from ..clip.modeling import CLIPVisionTransformer, contrastive_output
+from .configuration import ErnieViLConfig
+
+__all__ = ["ErnieViLModel", "ErnieViLPretrainedModel"]
+
+
+class ErnieViLModule(nn.Module):
+    config: ErnieViLConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        self.text_model = BertModule(cfg.text_config, self.dtype, self.param_dtype)
+        self.vision_model = CLIPVisionTransformer(cfg.vision_config, self.dtype, self.param_dtype)
+        self.temperature = self.param("temperature",
+                                      nn.initializers.constant(cfg.logit_scale_init_value), (1,))
+
+    def get_text_features(self, input_ids, attention_mask=None, token_type_ids=None,
+                          deterministic=True):
+        out = self.text_model(input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        return out.pooler_output  # ernie tanh pooler, no projection
+
+    def get_image_features(self, pixel_values, deterministic=True):
+        return self.vision_model(pixel_values, deterministic=deterministic).pooler_output
+
+    def __call__(self, input_ids=None, pixel_values=None, attention_mask=None,
+                 token_type_ids=None, deterministic: bool = True, return_loss: bool = False,
+                 return_dict: bool = True):
+        return contrastive_output(
+            self.get_text_features(input_ids, attention_mask, token_type_ids, deterministic),
+            self.get_image_features(pixel_values, deterministic),
+            self.temperature[0], dtype=self.dtype, return_loss=return_loss)
+
+
+class ErnieViLPretrainedModel(ChineseCLIPPretrainedModel):
+    config_class = ErnieViLConfig
+    base_model_prefix = "ernie_vil"
+
+
+class ErnieViLModel(ErnieViLPretrainedModel):
+    module_class = ErnieViLModule

@@ -1,0 +1,174 @@
+"""Image processors, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/image_processing_utils.py`` +
+``image_transforms.py`` (PIL-based resize/crop/normalize pipelines). Host-side
+preprocessing here is pure numpy + ``jax.image.resize`` (no PIL dependency):
+models consume [B, H, W, C] float arrays — channels-LAST, the layout XLA's TPU
+convolutions prefer (the reference emits channels-first for cudnn).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["BaseImageProcessor", "CLIPImageProcessor", "BlipImageProcessor"]
+
+IMAGE_PROCESSOR_NAME = "preprocessor_config.json"
+
+# HF preprocessor_config.json stores resample as a PIL integer enum
+_PIL_RESAMPLE = {0: "nearest", 1: "lanczos3", 2: "bilinear", 3: "bicubic",
+                 4: "bilinear", 5: "bicubic"}  # BOX/HAMMING -> closest jax method
+
+
+def _to_numpy(image) -> np.ndarray:
+    """Accept numpy [H,W,C] / [C,H,W] uint8/float, or a PIL image."""
+    if hasattr(image, "convert"):  # PIL duck-type
+        image = np.asarray(image.convert("RGB"))
+    arr = np.asarray(image)
+    if arr.ndim == 2:
+        arr = np.stack([arr] * 3, axis=-1)
+    if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3):
+        arr = arr.transpose(1, 2, 0)  # CHW -> HWC
+    return arr
+
+
+def resize(image: np.ndarray, size: Sequence[int], method: str = "bicubic") -> np.ndarray:
+    """Resize [H,W,C] to (h, w) with jax.image (antialiased, matches PIL closely)."""
+    import jax.image
+
+    h, w = size
+    out = jax.image.resize(image.astype(np.float32), (h, w, image.shape[-1]), method=method,
+                           antialias=True)
+    return np.asarray(out)
+
+
+def center_crop(image: np.ndarray, size: Sequence[int]) -> np.ndarray:
+    h, w = size
+    H, W = image.shape[:2]
+    top = max((H - h) // 2, 0)
+    left = max((W - w) // 2, 0)
+    out = image[top:top + h, left:left + w]
+    if out.shape[0] != h or out.shape[1] != w:  # pad when image smaller than crop
+        pad_h, pad_w = h - out.shape[0], w - out.shape[1]
+        out = np.pad(out, ((pad_h // 2, pad_h - pad_h // 2), (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+    return out
+
+
+class BaseImageProcessor:
+    """resize -> center_crop -> rescale -> normalize, each gated by config flags
+    (the reference pipeline order, image_processing_utils.py BaseImageProcessor)."""
+
+    model_input_names = ["pixel_values"]
+
+    def __init__(self, do_resize=True, size=224, resample="bicubic", do_center_crop=True,
+                 crop_size=224, do_rescale=True, rescale_factor=1 / 255.0, do_normalize=True,
+                 image_mean=None, image_std=None, do_convert_rgb=True, **kwargs):
+        self.do_resize = do_resize
+        self.size = size
+        self.resample = _PIL_RESAMPLE.get(resample, resample) if isinstance(resample, int) else resample
+        self.do_center_crop = do_center_crop
+        self.crop_size = crop_size
+        self.do_rescale = do_rescale
+        self.rescale_factor = rescale_factor
+        self.do_normalize = do_normalize
+        self.image_mean = image_mean if image_mean is not None else [0.5, 0.5, 0.5]
+        self.image_std = image_std if image_std is not None else [0.5, 0.5, 0.5]
+        self.do_convert_rgb = do_convert_rgb
+        self.init_kwargs = kwargs
+
+    # -- size semantics: int = shortest edge (aspect kept); (h, w) = exact ----
+    def _target_size(self, image: np.ndarray):
+        size = self.size
+        if isinstance(size, dict):
+            if "shortest_edge" in size:
+                size = size["shortest_edge"]
+            else:
+                return size["height"], size["width"]
+        if isinstance(size, (tuple, list)):
+            return tuple(size)
+        H, W = image.shape[:2]
+        short, long = (H, W) if H <= W else (W, H)
+        new_short = size
+        new_long = int(round(long * size / short))
+        return (new_short, new_long) if H <= W else (new_long, new_short)
+
+    def _crop_hw(self):
+        cs = self.crop_size
+        if isinstance(cs, dict):
+            return cs["height"], cs["width"]
+        return (cs, cs) if isinstance(cs, int) else tuple(cs)
+
+    def preprocess(self, images, return_tensors: Optional[str] = "np") -> Dict[str, Any]:
+        if not isinstance(images, (list, tuple)):
+            images = [images]
+        out = []
+        for im in images:
+            arr = _to_numpy(im).astype(np.float32)
+            if self.do_resize:
+                arr = resize(arr, self._target_size(arr), self.resample)
+            if self.do_center_crop:
+                arr = center_crop(arr, self._crop_hw())
+            if self.do_rescale:
+                arr = arr * self.rescale_factor
+            if self.do_normalize:
+                arr = (arr - np.asarray(self.image_mean)) / np.asarray(self.image_std)
+            out.append(arr.astype(np.float32))
+        pixel_values = np.stack(out)  # [B, H, W, C] channels-last for TPU
+        if return_tensors == "jax":
+            import jax.numpy as jnp
+
+            pixel_values = jnp.asarray(pixel_values)
+        return {"pixel_values": pixel_values}
+
+    __call__ = preprocess
+
+    # ------------------------------------------------------------- persistence
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: v for k, v in self.__dict__.items() if k != "init_kwargs"}
+        d.update(self.init_kwargs)
+        d["image_processor_type"] = type(self).__name__
+        return d
+
+    def save_pretrained(self, save_directory: str):
+        os.makedirs(save_directory, exist_ok=True)
+        with open(os.path.join(save_directory, IMAGE_PROCESSOR_NAME), "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    @classmethod
+    def from_pretrained(cls, pretrained_model_name_or_path: str, **kwargs):
+        from ..utils.downloader import resolve_model_dir
+
+        path = os.path.join(resolve_model_dir(pretrained_model_name_or_path), IMAGE_PROCESSOR_NAME)
+        config: Dict[str, Any] = {}
+        if os.path.isfile(path):
+            with open(path) as f:
+                config = json.load(f)
+        config.pop("image_processor_type", None)
+        config.update(kwargs)
+        return cls(**config)
+
+
+class CLIPImageProcessor(BaseImageProcessor):
+    """OpenAI CLIP preprocessing (reference clip/image_processing.py): bicubic
+    shortest-edge 224 resize, 224 center crop, /255, CLIP mean/std."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("image_mean", [0.48145466, 0.4578275, 0.40821073])
+        kwargs.setdefault("image_std", [0.26862954, 0.26130258, 0.27577711])
+        super().__init__(**kwargs)
+
+
+class BlipImageProcessor(BaseImageProcessor):
+    """BLIP preprocessing (reference blip/image_processing.py): 384x384 exact
+    resize, no crop, ImageNet mean/std."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("size", (384, 384))
+        kwargs.setdefault("do_center_crop", False)
+        kwargs.setdefault("image_mean", [0.48145466, 0.4578275, 0.40821073])
+        kwargs.setdefault("image_std", [0.26862954, 0.26130258, 0.27577711])
+        super().__init__(**kwargs)

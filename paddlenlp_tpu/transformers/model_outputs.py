@@ -26,6 +26,8 @@ __all__ = [
     "MoECausalLMOutputWithPast",
     "Seq2SeqLMOutput",
     "Seq2SeqModelOutput",
+    "BaseModelOutputWithPooling",
+    "CLIPOutput",
 ]
 
 
@@ -78,6 +80,25 @@ class BaseModelOutputWithPast(ModelOutput):
     hidden_states: Optional[Tuple] = None
     attentions: Optional[Tuple] = None
     aux_loss: Any = None  # MoE load-balancing loss (0/None for dense models)
+
+
+class BaseModelOutputWithPooling(ModelOutput):
+    last_hidden_state: Any = None
+    pooler_output: Any = None
+    hidden_states: Optional[Tuple] = None
+    attentions: Optional[Tuple] = None
+
+
+class CLIPOutput(ModelOutput):
+    """Contrastive dual-tower output (reference clip/modeling.py:138)."""
+
+    loss: Any = None
+    logits_per_image: Any = None
+    logits_per_text: Any = None
+    text_embeds: Any = None
+    image_embeds: Any = None
+    text_model_output: Any = None
+    vision_model_output: Any = None
 
 
 class BaseModelOutputWithPoolingAndCrossAttentions(ModelOutput):

@@ -296,7 +296,7 @@ class PretrainedModel(GenerationMixin):
         for path, leaf in flat.items():
             arr = np.asarray(jax.device_get(leaf))
             m = mappings.get(path)
-            if m is not None and getattr(m, "fn", None) is not None:
+            if m is not None and getattr(m, "fn", None) is not None and getattr(m, "fn_reverse", None) is None:
                 # non-invertible source transform (fused-qkv split): save under
                 # the mechanical split keys instead — from_pretrained accepts both
                 m = auto_name_mappings({path: leaf})[0]

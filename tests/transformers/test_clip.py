@@ -1,0 +1,128 @@
+"""CLIP dual-tower tests: forward shapes, contrastive logits symmetry,
+HF-torch numerical parity on identical weights (incl. the patch-conv layout
+transpose), image processor pipeline, save/load roundtrip."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddlenlp_tpu.transformers import (
+    CLIPConfig,
+    CLIPImageProcessor,
+    CLIPModel,
+    CLIPTextConfig,
+    CLIPTextModelWithProjection,
+    CLIPVisionConfig,
+    CLIPVisionModel,
+)
+
+TEXT_KW = dict(vocab_size=99, hidden_size=32, intermediate_size=37, num_hidden_layers=2,
+               num_attention_heads=4, max_position_embeddings=32,
+               eos_token_id=98, bos_token_id=97, pad_token_id=1)
+VISION_KW = dict(hidden_size=32, intermediate_size=37, num_hidden_layers=2,
+                 num_attention_heads=4, image_size=30, patch_size=6)
+
+
+def tiny_cfg():
+    return CLIPConfig(text_config=dict(TEXT_KW), vision_config=dict(VISION_KW), projection_dim=24)
+
+
+class TestCLIP:
+    def test_forward_shapes_and_loss(self):
+        model = CLIPModel.from_config(tiny_cfg(), seed=0)
+        eos = model.config.text_config.eos_token_id
+        ids = jnp.asarray([[5, 6, 7, eos], [8, 9, eos, 0]], jnp.int32)
+        pix = jnp.asarray(np.random.default_rng(0).standard_normal((2, 30, 30, 3)), jnp.float32)
+        out = model(input_ids=ids, pixel_values=pix, return_loss=True)
+        assert out.logits_per_image.shape == (2, 2)
+        assert out.text_embeds.shape == (2, 24) and out.image_embeds.shape == (2, 24)
+        np.testing.assert_allclose(np.asarray(out.logits_per_image),
+                                   np.asarray(out.logits_per_text).T, atol=1e-5)
+        assert np.isfinite(float(out.loss))
+        # embeds are L2-normalized
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(out.text_embeds), axis=-1),
+                                   1.0, atol=1e-5)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = CLIPModel.from_config(tiny_cfg(), seed=0)
+        eos = model.config.text_config.eos_token_id
+        ids = jnp.asarray([[5, 6, eos]], jnp.int32)
+        pix = jnp.asarray(np.random.default_rng(1).standard_normal((1, 30, 30, 3)), jnp.float32)
+        ref = model(input_ids=ids, pixel_values=pix)
+        model.save_pretrained(str(tmp_path))
+        reloaded = CLIPModel.from_pretrained(str(tmp_path))
+        out = reloaded(input_ids=ids, pixel_values=pix)
+        np.testing.assert_allclose(np.asarray(ref.logits_per_text),
+                                   np.asarray(out.logits_per_text), atol=1e-5)
+
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import CLIPConfig as HFC, CLIPModel as HFM
+
+        torch.manual_seed(0)
+        hf_cfg = HFC(text_config=dict(TEXT_KW, hidden_act="quick_gelu"),
+                     vision_config=dict(VISION_KW, hidden_act="quick_gelu"),
+                     projection_dim=24)
+        hm = HFM(hf_cfg).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        ids_t = torch.tensor([[5, 6, 7, 98], [8, 9, 98, 1]])
+        pix_np = np.random.default_rng(0).standard_normal((2, 3, 30, 30)).astype(np.float32)
+        with torch.no_grad():
+            golden = hm(input_ids=ids_t, pixel_values=torch.tensor(pix_np))
+        model = CLIPModel.from_pretrained(str(tmp_path))
+        out = model(input_ids=jnp.asarray(ids_t.numpy(), jnp.int32),
+                    pixel_values=jnp.asarray(pix_np.transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(np.asarray(out.logits_per_text),
+                                   golden.logits_per_text.numpy(), atol=3e-4)
+        np.testing.assert_allclose(np.asarray(out.image_embeds),
+                                   golden.image_embeds.numpy(), atol=3e-4)
+
+    def test_text_with_projection(self):
+        cfg = CLIPTextConfig(**TEXT_KW, projection_dim=24)
+        model = CLIPTextModelWithProjection.from_config(cfg, seed=0)
+        out = model(input_ids=jnp.asarray([[5, 6, cfg.eos_token_id]], jnp.int32))
+        assert out.pooler_output.shape == (1, 24)
+
+    def test_vision_model(self):
+        cfg = CLIPVisionConfig(**VISION_KW)
+        model = CLIPVisionModel.from_config(cfg, seed=0)
+        pix = jnp.asarray(np.random.default_rng(0).standard_normal((1, 30, 30, 3)), jnp.float32)
+        out = model(pixel_values=pix)
+        assert out.last_hidden_state.shape == (1, 26, 32)  # 25 patches + cls
+        assert out.pooler_output.shape == (1, 32)
+
+
+class TestImageProcessor:
+    def test_clip_pipeline_shapes(self):
+        proc = CLIPImageProcessor(size=18, crop_size=16)
+        img = (np.random.default_rng(0).random((40, 60, 3)) * 255).astype(np.uint8)
+        out = proc([img, img])
+        assert out["pixel_values"].shape == (2, 16, 16, 3)
+        assert out["pixel_values"].dtype == np.float32
+
+    def test_shortest_edge_aspect(self):
+        from paddlenlp_tpu.transformers.image_processing_utils import resize
+
+        img = np.zeros((40, 80, 3), np.float32)
+        proc = CLIPImageProcessor(size=20, do_center_crop=False, do_normalize=False)
+        out = proc(img)["pixel_values"]
+        assert out.shape == (1, 20, 40, 3)  # aspect preserved
+
+    def test_normalization_values(self):
+        proc = CLIPImageProcessor(do_resize=False, do_center_crop=False)
+        img = np.full((4, 4, 3), 255, np.uint8)
+        out = proc(img)["pixel_values"][0]
+        expected = (1.0 - np.asarray(proc.image_mean)) / np.asarray(proc.image_std)
+        np.testing.assert_allclose(out[0, 0], expected, atol=1e-6)
+
+    def test_chw_input_accepted(self):
+        proc = CLIPImageProcessor(size=8, crop_size=8)
+        img = np.zeros((3, 20, 20), np.float32)
+        assert proc(img)["pixel_values"].shape == (1, 8, 8, 3)
+
+    def test_save_load(self, tmp_path):
+        proc = CLIPImageProcessor(size=33)
+        proc.save_pretrained(str(tmp_path))
+        proc2 = CLIPImageProcessor.from_pretrained(str(tmp_path))
+        assert proc2.size == 33

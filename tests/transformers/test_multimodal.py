@@ -1,0 +1,182 @@
+"""Multimodal families beyond CLIP: chineseclip (bert text tower), blip
+(fused-qkv ViT + cross-attention text decoder, captioning generate), ernie_vil
+(no-projection dual tower). HF-torch parity for blip; key-layout checks for
+chineseclip; self-consistency + roundtrips everywhere."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddlenlp_tpu.transformers import (
+    BlipConfig,
+    BlipForConditionalGeneration,
+    BlipForImageTextRetrieval,
+    BlipModel,
+    ChineseCLIPConfig,
+    ChineseCLIPModel,
+    ErnieViLConfig,
+    ErnieViLModel,
+)
+
+TEXT_KW = dict(vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+               intermediate_size=37, max_position_embeddings=64)
+VISION_KW = dict(hidden_size=32, intermediate_size=37, num_hidden_layers=2,
+                 num_attention_heads=4, image_size=24, patch_size=6)
+
+
+def pix(b=2, s=24):
+    return jnp.asarray(np.random.default_rng(0).standard_normal((b, s, s, 3)), jnp.float32)
+
+
+class TestChineseCLIP:
+    def cfg(self):
+        return ChineseCLIPConfig(text_config=dict(TEXT_KW), vision_config=dict(VISION_KW),
+                                 projection_dim=24)
+
+    def test_forward_and_roundtrip(self, tmp_path):
+        m = ChineseCLIPModel.from_config(self.cfg(), seed=0)
+        ids = jnp.asarray([[2, 5, 6, 7], [2, 8, 9, 1]], jnp.int32)
+        out = m(input_ids=ids, pixel_values=pix(), return_loss=True)
+        assert out.logits_per_text.shape == (2, 2) and np.isfinite(float(out.loss))
+        m.save_pretrained(str(tmp_path))
+        from paddlenlp_tpu.utils.safetensors_io import safe_keys
+
+        keys = set(safe_keys(str(tmp_path / "model.safetensors")))
+        assert "text_model.encoder.layer.0.attention.self.query.weight" in keys
+        assert "vision_model.embeddings.patch_embedding.weight" in keys
+        m2 = ChineseCLIPModel.from_pretrained(str(tmp_path))
+        np.testing.assert_allclose(
+            np.asarray(out.logits_per_text),
+            np.asarray(m2(input_ids=ids, pixel_values=pix()).logits_per_text), atol=1e-5)
+
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import ChineseCLIPConfig as HFC, ChineseCLIPModel as HFM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(text_config=dict(TEXT_KW), vision_config=dict(VISION_KW, hidden_act="quick_gelu"),
+                     projection_dim=24)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        ids = np.asarray([[2, 5, 6, 7], [2, 8, 9, 1]], np.int64)
+        pv = np.random.default_rng(0).standard_normal((2, 3, 24, 24)).astype(np.float32)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(ids), pixel_values=torch.tensor(pv),
+                        attention_mask=torch.ones_like(torch.tensor(ids)))
+        m = ChineseCLIPModel.from_pretrained(str(tmp_path))
+        out = m(input_ids=jnp.asarray(ids, jnp.int32),
+                attention_mask=jnp.ones((2, 4), jnp.int32),
+                pixel_values=jnp.asarray(pv.transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(np.asarray(out.logits_per_text),
+                                   golden.logits_per_text.numpy(), atol=3e-4)
+
+
+class TestBlip:
+    def cfg(self):
+        return BlipConfig(
+            text_config=dict(TEXT_KW, num_attention_heads=4, bos_token_id=97, eos_token_id=98,
+                             pad_token_id=0),
+            vision_config=dict(VISION_KW), projection_dim=24)
+
+    def test_contrastive_and_caption_loss(self):
+        cfg = self.cfg()
+        ids = jnp.asarray([[2, 5, 6, 7], [2, 8, 9, 0]], jnp.int32)
+        m = BlipModel.from_config(cfg, seed=0)
+        out = m(input_ids=ids, pixel_values=pix(), return_loss=True)
+        assert out.logits_per_text.shape == (2, 2)
+        g = BlipForConditionalGeneration.from_config(cfg, seed=0)
+        _, loss = g(pixel_values=pix(), input_ids=ids, labels=ids)
+        assert np.isfinite(float(loss))
+
+    def test_generate_shapes_and_determinism(self):
+        g = BlipForConditionalGeneration.from_config(self.cfg(), seed=0)
+        caps1 = np.asarray(g.generate(pix(), max_new_tokens=5))
+        caps2 = np.asarray(g.generate(pix(), max_new_tokens=5))
+        assert caps1.shape == (2, 5)
+        np.testing.assert_array_equal(caps1, caps2)
+
+    def test_itm_head(self):
+        m = BlipForImageTextRetrieval.from_config(self.cfg(), seed=0)
+        ids = jnp.asarray([[2, 5, 6, 7]], jnp.int32)
+        logits = m(input_ids=ids, pixel_values=pix(1))
+        assert logits.shape == (1, 2)
+
+    def test_blipmodel_key_layout_bare_text(self, tmp_path):
+        """BlipModel's text tower saves WITHOUT the bert prefix (HF layout);
+        only the LM-head decoder nests bert + cls."""
+        from paddlenlp_tpu.utils.safetensors_io import safe_keys
+
+        m = BlipModel.from_config(self.cfg(), seed=0)
+        m.save_pretrained(str(tmp_path))
+        keys = set(safe_keys(str(tmp_path / "model.safetensors")))
+        assert "text_model.embeddings.word_embeddings.weight" in keys
+        assert "text_model.encoder.layer.0.attention.self.query.weight" in keys
+        assert not any(k.startswith("text_model.bert.") for k in keys)
+
+    def test_torch_parity_contrastive(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import BlipConfig as HFC, BlipModel as HFM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(text_config=dict(TEXT_KW, num_attention_heads=4, bos_token_id=97,
+                                      eos_token_id=98, pad_token_id=0,
+                                      hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0),
+                     vision_config=dict(VISION_KW), projection_dim=24)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        ids = np.asarray([[2, 5, 6, 7], [2, 8, 9, 1]], np.int64)
+        pv = np.random.default_rng(0).standard_normal((2, 3, 24, 24)).astype(np.float32)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(ids), pixel_values=torch.tensor(pv),
+                        attention_mask=torch.ones_like(torch.tensor(ids)))
+        m = BlipModel.from_pretrained(str(tmp_path))
+        out = m(input_ids=jnp.asarray(ids, jnp.int32),
+                attention_mask=jnp.ones((2, 4), jnp.int32),
+                pixel_values=jnp.asarray(pv.transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(np.asarray(out.logits_per_text),
+                                   golden.logits_per_text.numpy(), atol=3e-4)
+
+    def test_torch_parity_caption_logits(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import BlipConfig as HFC, BlipForConditionalGeneration as HFM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(text_config=dict(TEXT_KW, num_attention_heads=4, bos_token_id=97,
+                                      eos_token_id=98, pad_token_id=0,
+                                      hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0),
+                     vision_config=dict(VISION_KW), projection_dim=24)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        ids = np.asarray([[97, 5, 6, 7]], np.int64)
+        pv = np.random.default_rng(0).standard_normal((1, 3, 24, 24)).astype(np.float32)
+        with torch.no_grad():
+            golden = hm(pixel_values=torch.tensor(pv), input_ids=torch.tensor(ids)).logits.numpy()
+        m = BlipForConditionalGeneration.from_pretrained(str(tmp_path))
+        out = m(pixel_values=jnp.asarray(pv.transpose(0, 2, 3, 1)),
+                input_ids=jnp.asarray(ids, jnp.int32))
+        np.testing.assert_allclose(np.asarray(out.logits), golden, atol=3e-4)
+
+
+class TestErnieViL:
+    def test_forward_and_roundtrip(self, tmp_path):
+        cfg = ErnieViLConfig(text_config=dict(TEXT_KW), vision_config=dict(VISION_KW))
+        m = ErnieViLModel.from_config(cfg, seed=0)
+        ids = jnp.asarray([[2, 5, 6, 7]], jnp.int32)
+        out = m(input_ids=ids, pixel_values=pix(1), return_loss=True)
+        assert out.text_embeds.shape == (1, 32)  # pooled hidden, no projection
+        m.save_pretrained(str(tmp_path))
+        m2 = ErnieViLModel.from_pretrained(str(tmp_path))
+        np.testing.assert_allclose(np.asarray(out.text_embeds),
+                                   np.asarray(m2(input_ids=ids, pixel_values=pix(1)).text_embeds),
+                                   atol=1e-5)
+
+
+class TestMultimodalAuto:
+    def test_auto_resolves_clip(self, tmp_path):
+        from paddlenlp_tpu.transformers import CLIPConfig, CLIPModel
+        from paddlenlp_tpu.transformers.auto import AutoModel
+
+        m = CLIPModel.from_config(
+            CLIPConfig(text_config=dict(TEXT_KW, eos_token_id=98),
+                       vision_config=dict(VISION_KW, patch_size=6), projection_dim=24), seed=0)
+        m.save_pretrained(str(tmp_path))
+        auto = AutoModel.from_pretrained(str(tmp_path))
+        assert type(auto).__name__ == "CLIPModel"
