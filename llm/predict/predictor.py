@@ -49,10 +49,13 @@ class PredictorArgument:
                           "(reference predictor.py:775-791 cachekv_int8 knob)"})
     speculate_method: Optional[str] = field(
         default=None,
-        metadata={"help": "speculative decoding: 'ngram' (prompt-lookup drafts verified "
-                          "in one batched forward; greedy requests only — the reference's "
-                          "csrc/gpu/append_attn speculative write path)"})
+        metadata={"help": "speculative decoding: 'ngram' (prompt-lookup drafts, greedy "
+                          "only) or 'draft_model' (small-model proposer; greedy OR plain "
+                          "temperature sampling via rejection-sampling acceptance — the "
+                          "reference's csrc/gpu/append_attn + top_p_sampling_reject path)"})
     speculate_max_draft_tokens: int = 4
+    draft_model_name_or_path: Optional[str] = field(
+        default=None, metadata={"help": "checkpoint for the draft model (speculate_method=draft_model)"})
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -128,8 +131,24 @@ class BlockPredictor(BasePredictor):
 
         from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
 
-        if args.speculate_method not in (None, "ngram"):
-            raise ValueError(f"speculate_method={args.speculate_method!r} unsupported (only 'ngram')")
+        if args.speculate_method not in (None, "ngram", "draft_model"):
+            raise ValueError(f"speculate_method={args.speculate_method!r} unsupported "
+                             "(pick 'ngram' or 'draft_model')")
+        if args.speculate_method == "draft_model" and args.decode_strategy == "sampling" \
+                and (args.top_p < 1.0 or args.top_k):
+            logger.warning(
+                "speculate_method=draft_model with top_p<1.0/top_k>0: rejection-sampling "
+                "acceptance only covers PLAIN temperature sampling, so speculation will "
+                "be bypassed at runtime. Set --top_p 1.0 --top_k 0 (or greedy_search) "
+                "to actually engage the draft model.")
+        draft_model = None
+        if args.speculate_method == "draft_model":
+            if not args.draft_model_name_or_path:
+                raise ValueError("speculate_method=draft_model needs --draft_model_name_or_path")
+            from paddlenlp_tpu.transformers.auto import AutoModelForCausalLM as _Auto
+
+            draft_model = _Auto.from_pretrained(args.draft_model_name_or_path,
+                                                dtype=args.dtype, param_dtype=args.dtype)
         self.engine = InferenceEngine(
             self.model,
             tokenizer=self.tokenizer,
@@ -141,6 +160,7 @@ class BlockPredictor(BasePredictor):
             kv_cache_quant=self._kv_quant(args.cachekv_int8_type),
             use_speculative=args.speculate_method == "ngram",
             spec_draft_len=args.speculate_max_draft_tokens,
+            draft_model=draft_model,
         )
         self._sampling = SamplingParams(
             max_new_tokens=args.max_length,

@@ -104,6 +104,8 @@ class InferenceEngine:
         use_speculative: bool = False,
         spec_draft_len: int = 4,
         spec_ngram: int = 2,
+        draft_model=None,  # small causal LM proposer (reference speculate_method=draft_model)
+        spec_seed: int = 0,
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -125,11 +127,15 @@ class InferenceEngine:
         self._last_token = np.zeros(max_batch_size, np.int32)
         # device-resident per-slot token counts feeding the penalty kernels
         self.counts = jnp.zeros((max_batch_size, model.config.vocab_size), jnp.int32)
-        # speculative decoding (n-gram prompt-lookup proposer + batched verify)
-        self.use_speculative = use_speculative
+        # speculative decoding: n-gram prompt-lookup OR draft-model proposer,
+        # batched verify; greedy acceptance or rejection sampling
+        self.use_speculative = use_speculative or draft_model is not None
         self.spec_draft_len = spec_draft_len
         self.spec_ngram = spec_ngram
-        self.spec_stats = {"verify_steps": 0, "tokens_emitted": 0}
+        self.draft_model = draft_model
+        self._spec_seed = spec_seed
+        self._spec_rngs: Dict[int, np.random.Generator] = {}
+        self.spec_stats = {"verify_steps": 0, "tokens_emitted": 0, "drafted": 0, "accepted": 0}
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -243,18 +249,32 @@ class InferenceEngine:
                     self._last_token[slot] = tok
 
     # ------------------------------------------------------------------ speculative
-    def _spec_eligible(self) -> bool:
-        """Speculative decoding verifies greedily — only sound when every active
-        request is greedy with penalties off (the reference's speculative path
-        has the same restriction: draft acceptance must be deterministic)."""
+    def _spec_mode(self) -> Optional[str]:
+        """'greedy' when every active request decodes greedily with penalties
+        off (deterministic acceptance); 'sample' when a draft model is attached
+        and every request does plain temperature sampling (top-k/top-p and
+        penalties off) — that path accepts drafts by REJECTION SAMPLING, which
+        preserves the target distribution exactly (the generalization the
+        reference implements in top_p_sampling_reject.cu); None otherwise."""
+        greedy = sample = True
         for r in self.slots:
             if r is None:
                 continue
             s = r.sampling
-            if s.do_sample or s.repetition_penalty != 1.0 or s.presence_penalty != 0.0 \
+            if s.repetition_penalty != 1.0 or s.presence_penalty != 0.0 \
                     or s.frequency_penalty != 0.0:
-                return False
-        return True
+                return None
+            if s.do_sample:
+                greedy = False
+                if s.top_k or (s.top_p < 1.0):
+                    sample = False
+            else:
+                sample = False
+        if greedy:
+            return "greedy"
+        if sample and self.draft_model is not None:
+            return "sample"
+        return None
 
     def _propose_drafts(self, req: Request) -> np.ndarray:
         """Prompt-lookup (n-gram) proposer: find the most recent earlier
@@ -277,6 +297,58 @@ class InferenceEngine:
         s = int(starts[-1])
         return hist[s + n : s + n + k].astype(np.int32)
 
+    def _propose_drafts_draft_model(self, mode: str):
+        """Autoregressive draft-model proposer: K greedy/sampled steps of the
+        small model over a FIXED padded buffer (one compile per length bucket;
+        the draft is orders of magnitude cheaper than the target so the full
+        recompute per step is noise). Returns (drafts per slot, draft probs per
+        slot — [k, V] fp32 temperature-applied, None in greedy mode)."""
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        K = self.spec_draft_len
+        ctxs = {i: np.concatenate([self.slots[i].prompt_ids,
+                                   np.asarray(self.slots[i].output_ids, np.int32)])
+                for i in active}
+        ks = {i: min(K, max(self.slots[i].remaining_new - 1, 0)) for i in active}
+        if not active or all(ks[i] == 0 for i in active):
+            return [np.zeros(0, np.int32)] * len(self.slots), [None] * len(self.slots)
+        max_len = max(len(c) for c in ctxs.values())
+        L = 1 << max(6, (max_len + K - 1).bit_length())  # pow2 bucket caps recompiles
+        B = len(active)
+        ids = np.zeros((B, L), np.int32)
+        lens = np.zeros(B, np.int32)
+        for j, i in enumerate(active):
+            ids[j, : len(ctxs[i])] = ctxs[i]
+            lens[j] = len(ctxs[i])
+        drafts = {i: [] for i in active}
+        qprobs = {i: [] for i in active}
+        for t in range(K):
+            mask = (np.arange(L)[None, :] < (lens + t)[:, None]).astype(np.int32)
+            out = self.draft_model(input_ids=jnp.asarray(ids), attention_mask=jnp.asarray(mask))
+            # gather each sequence's next-token row ON DEVICE: only [B, V]
+            # crosses to host, not the [B, L, V] tensor
+            rows = np.asarray(jnp.take_along_axis(
+                out.logits, jnp.asarray(lens + t - 1)[:, None, None], axis=1)[:, 0],
+                dtype=np.float32)
+            for j, i in enumerate(active):
+                if t >= ks[i]:
+                    continue
+                row = rows[j]
+                temp = max(self.slots[i].sampling.temperature, 1e-6)
+                if mode == "sample":
+                    row = row / temp
+                    p = np.exp(row - row.max())
+                    p /= p.sum()
+                    nxt = int(self._req_rng(self.slots[i]).choice(len(p), p=p))
+                    qprobs[i].append(p)
+                else:
+                    nxt = int(np.argmax(row))
+                drafts[i].append(nxt)
+                ids[j, lens[j] + t] = nxt
+        out_d = [np.asarray(drafts.get(i, []), np.int32) for i in range(len(self.slots))]
+        out_q = [np.asarray(qprobs[i], np.float32) if i in qprobs and qprobs[i] else None
+                 for i in range(len(self.slots))]
+        return out_d, out_q
+
     def _preempt(self, slot: int):
         """Evict + requeue with prompt+generated as the new prompt (recompute
         recovery, the step.cu is_block_step/recover list)."""
@@ -288,10 +360,24 @@ class InferenceEngine:
         req.output_ids = []
         self.waiting.appendleft(req)
 
-    def _decode_spec(self, finished: List[Request], drafts: List[np.ndarray]):
+    def _req_rng(self, req) -> np.random.Generator:
+        """Per-request generator seeded by (engine seed, SamplingParams.seed,
+        req_id) — a request's rejection-sampling draws reproduce under re-runs
+        with the same seed, matching the device sampler's per-request contract."""
+        if req.req_id not in self._spec_rngs:
+            self._spec_rngs[req.req_id] = np.random.default_rng(
+                (self._spec_seed, req.sampling.seed, req.req_id))
+        return self._spec_rngs[req.req_id]
+
+    def _decode_spec(self, finished: List[Request], drafts: List[np.ndarray],
+                     qprobs=None, mode: str = "greedy"):
         """One speculative iteration: verify the proposed drafts for the whole
-        batch in ONE [B, K+1] forward, accept the longest matching prefix plus
-        the model's bonus token (1..K+1 tokens per sequence per forward)."""
+        batch in ONE [B, K+1] forward, then accept on the host — greedy mode
+        takes the longest argmax-matching prefix plus the model's bonus token;
+        sample mode runs Leviathan rejection sampling against the draft probs
+        (accept x_i w.p. min(1, p_i(x_i)/q_i(x_i)); on reject draw from
+        normalize(max(p_i - q_i, 0))), which emits EXACT target-distribution
+        samples. 1..K+1 tokens per sequence per forward either way."""
         K = self.spec_draft_len
         # reserve capacity for all K+1 optimistic KV writes; preempt on OOM
         active = [s for s in range(len(self.slots)) if self.slots[s] is not None]
@@ -316,20 +402,29 @@ class InferenceEngine:
             tokens[i, 1 : 1 + len(d)] = d
             tables[i] = self.mgr.table_array(req.req_id)
             start[i] = req.total_len - 1  # position of the token being fed
-        targets, self.pool = self.infer.verify(
+        argmax_dev, logits_dev, self.pool = self.infer.verify(
             self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
             jnp.asarray(start),
         )
-        targets = np.asarray(targets)  # [B, K+1]
+        # greedy only pulls [B, K+1] int32 to host; the [B, K+1, V] logits stay
+        # on device unless rejection sampling needs them
+        logits = np.asarray(logits_dev) if mode == "sample" else None
+        argmax = np.asarray(argmax_dev)
         self.spec_stats["verify_steps"] += 1
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
             d = drafts[i]
-            n_acc = 0
-            while n_acc < len(d) and targets[i, n_acc] == d[n_acc]:
-                n_acc += 1
-            emitted = list(d[:n_acc]) + [int(targets[i, n_acc])]
+            self.spec_stats["drafted"] += len(d)
+            if mode == "sample":
+                emitted = self._accept_rejection(i, req, d, logits[i], qprobs[i])
+            else:
+                targets = argmax[i]
+                n_acc = 0
+                while n_acc < len(d) and targets[n_acc] == d[n_acc]:
+                    n_acc += 1
+                emitted = list(d[:n_acc]) + [int(targets[n_acc])]
+                self.spec_stats["accepted"] += n_acc
             for tok in emitted:
                 self._emit(req, int(tok))
                 self._last_token[i] = int(tok)
@@ -344,17 +439,51 @@ class InferenceEngine:
                 # release the optimistic blocks past the accepted tokens
                 self.mgr.shrink(req.req_id, req.total_len)
 
+    def _accept_rejection(self, slot: int, req, d: np.ndarray, logits_row: np.ndarray,
+                          q: Optional[np.ndarray]) -> List[int]:
+        """Leviathan et al. rejection sampling over one row: returns the tokens
+        to emit (accepted prefix + correction-or-bonus sample)."""
+        temp = max(req.sampling.temperature, 1e-6)
+        rng = self._req_rng(req)
+        emitted: List[int] = []
+        for t in range(len(d)):
+            row = logits_row[t] / temp
+            p = np.exp(row - row.max())
+            p /= p.sum()
+            x = int(d[t])
+            qv = float(q[t][x]) if q is not None else 1.0
+            if rng.uniform() < min(1.0, float(p[x]) / max(qv, 1e-20)):
+                emitted.append(x)
+                self.spec_stats["accepted"] += 1
+                continue
+            residual = np.maximum(p - (q[t] if q is not None else 0.0), 0.0)
+            s = residual.sum()
+            residual = residual / s if s > 0 else p
+            emitted.append(int(rng.choice(len(residual), p=residual)))
+            return emitted
+        # every draft accepted: bonus token from the position after the last draft
+        row = logits_row[len(d)] / temp
+        p = np.exp(row - row.max())
+        p /= p.sum()
+        emitted.append(int(rng.choice(len(p), p=p)))
+        return emitted
+
     def _decode_running(self, finished: List[Request]):
         if not any(r is not None for r in self.slots):
             return
-        if self.use_speculative and self._spec_eligible():
+        mode = self._spec_mode() if self.use_speculative else None
+        if mode is not None:
             # propose first: when NO slot has a draft, a verify forward would
             # emit 1 token/seq for (K+1)x the compute — use the multi-step
             # decode instead and only pay for verification when drafts exist
-            drafts = [np.zeros(0, np.int32) if r is None else self._propose_drafts(r)
-                      for r in self.slots]
+            if self.draft_model is not None:
+                drafts, qprobs = self._propose_drafts_draft_model(mode)
+            else:
+                drafts = [np.zeros(0, np.int32) if r is None else self._propose_drafts(r)
+                          for r in self.slots]
+                qprobs = [None] * len(self.slots)
             if any(len(d) for d in drafts):
-                return self._decode_spec(finished, drafts)
+                return self._decode_spec(finished, drafts, qprobs, mode)
         steps = self.decode_steps
         # grow tables for up to `steps` tokens; preempt (recompute-requeue)
         # youngest on exhaustion. Surplus is shrunk back after the device call.

@@ -322,8 +322,11 @@ class PagedInferenceModel:
 
         tokens [B, K+1] (row = last accepted token then drafts, 0-padded);
         start_pos [B] absolute position of tokens[:, 0]. Returns
-        (greedy targets [B, K+1], new pool) — targets[:, i] is the model's
-        next-token prediction after consuming tokens[:, i].
+        (argmax [B, K+1] int32, logits [B, K+1, V] fp32, new pool) — position i
+        scores the token AFTER consuming tokens[:, i]. Greedy acceptance reads
+        only the argmax (tiny host transfer); rejection sampling reads the full
+        logits — both stay device-side until the host np.asarray's the one it
+        needs.
         """
         B, T = tokens.shape
         positions = start_pos[:, None] + jnp.arange(T)[None, :]
@@ -333,7 +336,8 @@ class PagedInferenceModel:
             params, pool, tokens, block_tables, positions, kv_len_mask,
             start_pos, last_pos=None,
         )
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pool
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                logits.astype(jnp.float32), new_pool)
 
     def verify(self, params, pool: PagedKVPool, tokens, block_tables, start_pos):
         return self._verify(params, pool, tokens, block_tables, start_pos)

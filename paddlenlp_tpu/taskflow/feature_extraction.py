@@ -1,0 +1,51 @@
+"""Feature extraction task (reference: paddlenlp/taskflow/feature_extraction.py):
+dense text (and, with a CLIP-family model, image) embeddings."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .task import Task
+
+__all__ = ["FeatureExtractionTask"]
+
+
+class FeatureExtractionTask(Task):
+    """Returns {'features': np.ndarray [B, D]}. Text goes through the encoder
+    with mean pooling (or CLIP text tower when the model is dual-tower);
+    ``images=...`` routes through the CLIP image tower."""
+
+    def _construct(self):
+        from ..transformers import AutoModel, AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(self.model_name)
+        self.model = AutoModel.from_pretrained(self.model_name, dtype=self.kwargs.get("dtype", "float32"))
+        self._is_dual = hasattr(self.model, "get_text_features")
+
+    def _embed_text(self, texts: List[str]) -> np.ndarray:
+        enc = self.tokenizer(list(texts), padding=True, truncation=True, max_length=256,
+                             return_tensors="np")
+        ids = jnp.asarray(enc["input_ids"])
+        mask = jnp.asarray(enc["attention_mask"])
+        if self._is_dual:
+            return np.asarray(self.model.get_text_features(ids, mask), np.float32)
+        out = self.model(input_ids=ids, attention_mask=mask)
+        h = np.asarray(out.last_hidden_state, np.float32)
+        m = np.asarray(enc["attention_mask"])[..., None]
+        return (h * m).sum(1) / np.maximum(m.sum(1), 1)
+
+    def _embed_images(self, images) -> np.ndarray:
+        from ..transformers import CLIPImageProcessor
+
+        proc = CLIPImageProcessor()
+        pix = jnp.asarray(proc(images)["pixel_values"])
+        return np.asarray(self.model.get_image_features(pix), np.float32)
+
+    def __call__(self, inputs=None, images=None, **kwargs):
+        if images is not None:
+            return {"features": self._embed_images(images)}
+        texts = [inputs] if isinstance(inputs, str) else list(inputs)
+        return {"features": self._embed_text(texts)}

@@ -49,6 +49,21 @@ def _populate():
     register_task("word_segmentation", WordSegmentationTask)
     register_task("pos_tagging", POSTaggingTask)
 
+    from .feature_extraction import FeatureExtractionTask
+    from .text_correction import TextCorrectionTask
+    from .zero_shot_text_classification import ZeroShotTextClassificationTask
+
+    register_task("feature_extraction", FeatureExtractionTask)
+    register_task("zero_shot_text_classification", ZeroShotTextClassificationTask)
+    register_task("text_correction", TextCorrectionTask)
+    # generation-flavored aliases (reference ships dedicated default models for
+    # these; the task mechanics are the shared generation/seq2seq pipelines)
+    register_task("code_generation", TextGenerationTask)
+    register_task("poetry_generation", TextGenerationTask)
+    register_task("dialogue", TextGenerationTask)
+    register_task("question_generation", SummarizationTask)
+    register_task("lexical_analysis", POSTaggingTask)
+
 
 class Taskflow:
     def __init__(self, task: str, model: str = None, task_path: str = None, **kwargs):

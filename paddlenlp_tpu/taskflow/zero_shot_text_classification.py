@@ -1,0 +1,44 @@
+"""Zero-shot text classification (reference: paddlenlp/taskflow/zero_shot_text_classification.py,
+the UTC task). Without UTC checkpoints this is prompt-similarity zero-shot:
+each candidate label is verbalized through a template and scored by embedding
+cosine against the input; scores are softmax-normalized over the schema."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .text_similarity import TextSimilarityTask
+
+__all__ = ["ZeroShotTextClassificationTask"]
+
+
+class ZeroShotTextClassificationTask(TextSimilarityTask):
+    def __init__(self, task: str, model: str, schema: List[str] = None,
+                 template: str = "这段文字是关于{}的", **kwargs):
+        self.schema = list(schema or [])
+        self.template = template
+        super().__init__(task=task, model=model, **kwargs)
+
+    def set_schema(self, schema: List[str]):
+        self.schema = list(schema)
+
+    def __call__(self, inputs, schema: List[str] = None, **kwargs):
+        labels = list(schema or self.schema)
+        if not labels:
+            raise ValueError("zero_shot_text_classification needs a label schema")
+        texts = [inputs] if isinstance(inputs, str) else list(inputs)
+        text_emb = self._embed(texts)  # [B, D]
+        label_emb = self._embed([self.template.format(l) for l in labels])  # [L, D]
+        text_emb = text_emb / (np.linalg.norm(text_emb, axis=-1, keepdims=True) + 1e-9)
+        label_emb = label_emb / (np.linalg.norm(label_emb, axis=-1, keepdims=True) + 1e-9)
+        sims = text_emb @ label_emb.T  # [B, L]
+        probs = np.exp(sims * 10.0)
+        probs = probs / probs.sum(-1, keepdims=True)
+        out = []
+        for i, t in enumerate(texts):
+            order = np.argsort(-probs[i])
+            out.append({"text_a": t, "predictions": [
+                {"label": labels[j], "score": float(probs[i, j])} for j in order]})
+        return out

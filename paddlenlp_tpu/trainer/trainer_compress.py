@@ -39,9 +39,16 @@ def compress(trainer, strategy: str = "ptq", output_dir: Optional[str] = None, *
         return _ptq(trainer, output_dir, **kwargs)
     if strategy == "prune":
         return _prune_width(trainer, output_dir, **kwargs)
+    if strategy == "prune_depth":
+        return _prune_depth(trainer, output_dir, **kwargs)
     if strategy == "a8w8":
         return _a8w8(trainer, output_dir, **kwargs)
-    raise ValueError(f"unknown compression strategy {strategy!r} (ptq | prune | a8w8)")
+    if strategy == "qat":
+        return _qat(trainer, output_dir, **kwargs)
+    if strategy == "embedding_quant":
+        return _embedding_quant(trainer, output_dir, **kwargs)
+    raise ValueError(f"unknown compression strategy {strategy!r} "
+                     "(ptq | prune | prune_depth | a8w8 | qat | embedding_quant)")
 
 
 def _ptq(trainer, output_dir: str, bits: int = 8, use_gptq: bool = False,
@@ -118,6 +125,166 @@ def _save_q(qparams: dict, output_dir: str):
     flat = flatten_params(qparams)
     tensors = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
     save_file(tensors, os.path.join(output_dir, "model_quant.safetensors"), metadata={"format": "np"})
+
+
+def _qat(trainer, output_dir: str, bits: int = 8, n_qat_steps: int = 32,
+         learning_rate: float = 1e-5, match=None):
+    """Quantization-aware finetune (reference trainer_compress.py QAT stage):
+    targeted kernels pass through fake-quant (quantize -> dequantize) in the
+    forward with a straight-through estimator — ``w + sg(qdq(w) - w)`` — so
+    gradients flow to the fp weights while the loss sees int8/int4 rounding.
+    After ``n_qat_steps`` the adapted weights are PTQ-exported."""
+    import re as _re
+
+    import optax
+
+    from ..quantization.quantization_utils import DEFAULT_SKIP
+
+    model = trainer.model
+    params = trainer.train_state.params if trainer.train_state is not None else model.params
+    dataset = trainer.train_dataset
+    if dataset is None:
+        raise ValueError("QAT needs a train dataset")
+    skip_res = [_re.compile(p) for p in DEFAULT_SKIP]
+    target_res = [_re.compile(p) for p in match] if match else None
+    qmax = 127 if bits == 8 else 7
+
+    def wanted(path, leaf):
+        is_kernel = path.endswith("/kernel") and getattr(leaf, "ndim", 0) >= 2
+        if target_res is not None:
+            return is_kernel and any(p.search(path) for p in target_res)
+        return is_kernel and not any(p.search(path) for p in skip_res)
+
+    def fake_quant_tree(p):
+        flat = flatten_params(p)
+        out = {}
+        for path, leaf in flat.items():
+            if wanted(path, leaf):
+                absmax = jnp.max(jnp.abs(leaf), axis=-2, keepdims=True)
+                scales = jnp.maximum(absmax / qmax, 1e-12)
+                qdq = jnp.clip(jnp.round(leaf / scales), -qmax - 1, qmax) * scales
+                leaf = leaf + jax.lax.stop_gradient(qdq - leaf)  # STE
+            out[path] = leaf
+        return unflatten_params(out)
+
+    def loss_fn(p, batch):
+        return trainer.compute_loss(fake_quant_tree(p), batch)
+
+    tx = optax.adamw(learning_rate)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, loss
+
+    first = last = None
+    for i in range(n_qat_steps):
+        row = dataset[i % len(dataset)]
+        batch = {k: jnp.asarray(np.asarray(v)[None]) for k, v in row.items()
+                 if k in ("input_ids", "labels", "attention_mask")}
+        params, opt_state, loss = step(params, opt_state, batch)
+        first = float(loss) if first is None else first
+        last = float(loss)
+    logger.info(f"QAT: {n_qat_steps} fake-quant steps, loss {first:.4f} -> {last:.4f}")
+    return _ptq_export(trainer, params, output_dir, bits)
+
+
+def _ptq_export(trainer, params, output_dir: str, bits: int):
+    from ..quantization import QuantizationConfig, quantize_params
+
+    algo = "weight_only_int8" if bits == 8 else "weight_only_int4"
+    qparams = quantize_params(params, QuantizationConfig(weight_quantize_algo=algo))
+    trainer.model.save_pretrained(output_dir, params=params)
+    _save_q(qparams, output_dir)
+    return output_dir
+
+
+def _embedding_quant(trainer, output_dir: str):
+    """int8 per-row (per-token) quantization of embedding tables (reference
+    trainer_compress.py embedding quantization stage): rows are what a lookup
+    reads, so per-row scales keep dequantization a cheap fused multiply."""
+    model = trainer.model
+    params = trainer.train_state.params if trainer.train_state is not None else model.params
+    flat = dict(flatten_params(params))
+    n = 0
+    for path in list(flat):
+        if not path.endswith("/embedding"):
+            continue
+        w = np.asarray(jax.device_get(flat[path]), np.float32)
+        absmax = np.abs(w).max(axis=-1, keepdims=True)  # per row
+        scales = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
+        q = np.clip(np.round(w / scales), -128, 127).astype(np.int8)
+        prefix = path.rsplit("/", 1)[0]
+        del flat[path]
+        flat[prefix + "/qembedding"] = jnp.asarray(q)
+        flat[prefix + "/embed_scales"] = jnp.asarray(scales[..., 0])
+        n += 1
+    if n == 0:
+        raise ValueError("no embedding tables found")
+    model.save_pretrained(output_dir, params=params)  # loadable fp reference
+    _save_q(unflatten_params(flat), output_dir)
+    logger.info(f"embedding-quantized {n} tables to int8 per-row; exported {output_dir}")
+    return output_dir
+
+
+def dequantize_embedding(qembedding, embed_scales, dtype=jnp.float32):
+    """Inverse of ``_embedding_quant`` (load-side helper)."""
+    return (qembedding.astype(jnp.float32) * embed_scales[..., None]).astype(dtype)
+
+
+def _prune_depth(trainer, output_dir: str, depth_mult: float = 0.5):
+    """Keep ``int(L * depth_mult)`` layers EVENLY SPACED across depth (the
+    dynabert depth schedule: uniform strided selection preserves the network's
+    coarse feature hierarchy better than dropping a contiguous block)."""
+    model = trainer.model
+    params = trainer.train_state.params if trainer.train_state is not None else model.params
+    cfg = model.config
+    L = cfg.num_hidden_layers
+    new_l = max(int(round(L * depth_mult)), 1)
+    keep = np.linspace(0, L - 1, new_l).round().astype(int)
+    keep = np.unique(keep)
+    new_l = len(keep)
+    flat = dict(flatten_params(params))
+    out = {}
+    import re as _re
+
+    layer_pat = _re.compile(r"(.*\blayers?_)(\d+)(\b.*)")
+    renumber = {int(old): i for i, old in enumerate(keep)}
+    scanned = getattr(cfg, "use_scan_layers", False)
+    n_sliced = n_dropped = 0
+    for path, leaf in flat.items():
+        m = layer_pat.match(path)
+        if m is not None:  # unrolled per-layer param
+            old = int(m.group(2))
+            if old not in renumber:
+                n_dropped += 1
+                continue
+            out[f"{m.group(1)}{renumber[old]}{m.group(3)}"] = leaf
+            continue
+        # scan-stacked layer params live under the index-less "layers" module
+        # (model/layers/...): match by PATH, not by a shape[0]==L coincidence
+        if scanned and "/layers/" in path and getattr(leaf, "ndim", 0) >= 1 \
+                and leaf.shape[0] == L:
+            out[path] = jnp.asarray(np.asarray(jax.device_get(leaf))[keep])
+            n_sliced += 1
+            continue
+        out[path] = leaf
+    if n_sliced == 0 and n_dropped == 0:
+        raise ValueError(f"no per-layer params found to prune (L={L})")
+    import copy
+
+    pruned_cfg = copy.deepcopy(cfg)
+    pruned_cfg.num_hidden_layers = new_l
+    orig_cfg = model.config
+    model.config = pruned_cfg
+    try:
+        model.save_pretrained(output_dir, params=unflatten_params(out))
+    finally:
+        model.config = orig_cfg
+    logger.info(f"depth-pruned {L} -> {new_l} layers (kept {list(keep)}); exported {output_dir}")
+    return output_dir
 
 
 def _prune_width(trainer, output_dir: str, width_mult: float = 0.75):

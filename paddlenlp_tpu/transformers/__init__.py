@@ -139,4 +139,30 @@ from .ernie_vil import (  # noqa: F401
     ErnieViLConfig,
     ErnieViLModel,
 )
+from .distilbert import (  # noqa: F401
+    DistilBertConfig,
+    DistilBertForMaskedLM,
+    DistilBertForSequenceClassification,
+    DistilBertModel,
+)
+from .nezha import (  # noqa: F401
+    NezhaConfig,
+    NezhaForMaskedLM,
+    NezhaForSequenceClassification,
+    NezhaForTokenClassification,
+    NezhaModel,
+)
+from .mpnet import (  # noqa: F401
+    MPNetConfig,
+    MPNetForMaskedLM,
+    MPNetForSequenceClassification,
+    MPNetModel,
+)
+from .deberta_v2 import (  # noqa: F401
+    DebertaV2Config,
+    DebertaV2ForMaskedLM,
+    DebertaV2ForSequenceClassification,
+    DebertaV2ForTokenClassification,
+    DebertaV2Model,
+)
 from .tokenizer_utils import BatchEncoding, PretrainedTokenizer  # noqa: F401

@@ -46,6 +46,10 @@ def _populate():
     from ..mt5.configuration import MT5Config
     from ..mbart.configuration import MBartConfig
     from ..pegasus.configuration import PegasusConfig
+    from ..distilbert.configuration import DistilBertConfig
+    from ..nezha.configuration import NezhaConfig
+    from ..mpnet.configuration import MPNetConfig
+    from ..deberta_v2.configuration import DebertaV2Config
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -57,7 +61,8 @@ def _populate():
                 MambaConfig, RWConfig, ChatGLMConfig, YuanConfig, JambaConfig,
                 AlbertConfig, ElectraConfig, RobertaConfig,
                 MT5Config, MBartConfig, PegasusConfig,
-                CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig):
+                CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
+                DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

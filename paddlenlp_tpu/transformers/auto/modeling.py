@@ -154,6 +154,28 @@ def _populate_models():
     from ..ernie_vil import modeling as ernie_vil
 
     register_model("ernie_vil", "base", ernie_vil.ErnieViLModel)
+    from ..distilbert import modeling as distilbert
+
+    register_model("distilbert", "base", distilbert.DistilBertModel)
+    register_model("distilbert", "masked_lm", distilbert.DistilBertForMaskedLM)
+    register_model("distilbert", "sequence_classification", distilbert.DistilBertForSequenceClassification)
+    from ..nezha import modeling as nezha
+
+    register_model("nezha", "base", nezha.NezhaModel)
+    register_model("nezha", "masked_lm", nezha.NezhaForMaskedLM)
+    register_model("nezha", "sequence_classification", nezha.NezhaForSequenceClassification)
+    register_model("nezha", "token_classification", nezha.NezhaForTokenClassification)
+    from ..mpnet import modeling as mpnet
+
+    register_model("mpnet", "base", mpnet.MPNetModel)
+    register_model("mpnet", "masked_lm", mpnet.MPNetForMaskedLM)
+    register_model("mpnet", "sequence_classification", mpnet.MPNetForSequenceClassification)
+    from ..deberta_v2 import modeling as deberta_v2
+
+    register_model("deberta-v2", "base", deberta_v2.DebertaV2Model)
+    register_model("deberta-v2", "masked_lm", deberta_v2.DebertaV2ForMaskedLM)
+    register_model("deberta-v2", "sequence_classification", deberta_v2.DebertaV2ForSequenceClassification)
+    register_model("deberta-v2", "token_classification", deberta_v2.DebertaV2ForTokenClassification)
 
 
 class _AutoBase:

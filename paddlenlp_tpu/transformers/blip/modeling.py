@@ -20,7 +20,6 @@ TPU-first notes:
 from __future__ import annotations
 
 import re
-from functools import partial
 from typing import Optional
 
 import jax
@@ -31,8 +30,8 @@ from flax import linen as nn
 from ...ops.flash_attention import dot_product_attention
 from ...parallel.partition import P, shard_constraint
 from ..clip.modeling import contrastive_output
-from ..llama.modeling import ACT2FN, VocabEmbed
-from ..model_outputs import BaseModelOutputWithPooling, CLIPOutput, CausalLMOutput
+from ..llama.modeling import ACT2FN, VocabEmbed, tied_mlm_head
+from ..model_outputs import BaseModelOutputWithPooling, CausalLMOutput
 from ..model_utils import PretrainedModel
 from .configuration import BlipConfig, BlipTextConfig, BlipVisionConfig
 
@@ -199,16 +198,14 @@ class BlipTextModule(nn.Module):
             return BaseModelOutputWithPooling(last_hidden_state=h, pooler_output=pooled)
         # BERT cls.predictions head; decoder is TIED to the word embeddings with a
         # standalone bias (HF blip omits decoder.weight/bias from checkpoints)
-        x = nn.Dense(cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
-                     name="cls_predictions_transform_dense")(h)
-        x = ACT2FN[cfg.hidden_act](x)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="cls_predictions_transform_LayerNorm")(x)
         table = self.get_variable("params", "embeddings_word_embeddings")["embedding"]
-        bias = self.param("cls_predictions_bias", nn.initializers.zeros,
-                          (cfg.vocab_size,), self.param_dtype)
-        logits = x @ table.T.astype(self.dtype) + bias.astype(self.dtype)
-        logits = shard_constraint(logits, P("batch", None, "act_vocab"))
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="cls_predictions_transform_dense",
+                               ln_name="cls_predictions_transform_LayerNorm",
+                               bias_name="cls_predictions_bias")
         return CausalLMOutput(logits=logits)
 
 

@@ -10,7 +10,6 @@ only the pairing + projections + key mapping are new.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -18,7 +17,6 @@ from flax import linen as nn
 from ..bert.modeling import BertModule
 from ..clip.modeling import CLIPVisionTransformer, contrastive_output
 from ..model_utils import PretrainedModel
-from ...parallel.partition import P
 from .configuration import ChineseCLIPConfig
 
 __all__ = ["ChineseCLIPModel", "ChineseCLIPPretrainedModel"]
@@ -76,12 +74,8 @@ class ChineseCLIPPretrainedModel(PretrainedModel):
         from ..bert.modeling import BertPretrainedModel
         from ..clip.modeling import CLIPPretrainedModel
 
-        return CLIPPretrainedModel.get_partition_rules(config) + [
-            (r"word_embeddings/embedding$", P("vocab", "embed")),
-            (r"(query|key|value)/kernel$", P("embed", "heads")),
-            (r"attention_output_dense/kernel$", P("heads", "embed")),
-            (r"intermediate_dense/kernel$", P("embed", "mlp")),
-        ]
+        return (CLIPPretrainedModel.get_partition_rules(config)
+                + BertPretrainedModel.get_partition_rules(config))
 
     @classmethod
     def _get_name_mappings(cls, config, flat_shapes):

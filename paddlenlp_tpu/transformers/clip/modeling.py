@@ -21,7 +21,6 @@ TPU-first notes:
 from __future__ import annotations
 
 import re
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,10 +43,6 @@ __all__ = [
     "CLIPPretrainedModel",
     "clip_loss",
 ]
-
-if "quick_gelu" not in ACT2FN:
-    ACT2FN["quick_gelu"] = lambda x: x * jax.nn.sigmoid(1.702 * x)
-
 
 def clip_loss(logits_per_text: jnp.ndarray) -> jnp.ndarray:
     """Symmetric InfoNCE over the in-batch similarity matrix (reference :1380)."""

@@ -1,0 +1,8 @@
+from .configuration import DebertaV2Config  # noqa: F401
+from .modeling import (  # noqa: F401
+    DebertaV2ForMaskedLM,
+    DebertaV2ForSequenceClassification,
+    DebertaV2ForTokenClassification,
+    DebertaV2Model,
+    DebertaV2PretrainedModel,
+)

@@ -1,0 +1,73 @@
+"""DeBERTa-v2/v3 configuration (reference: paddlenlp/transformers/deberta_v2/configuration.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["DebertaV2Config"]
+
+
+class DebertaV2Config(PretrainedConfig):
+    model_type = "deberta-v2"
+
+    def __init__(
+        self,
+        vocab_size: int = 128100,
+        hidden_size: int = 1536,
+        num_hidden_layers: int = 24,
+        num_attention_heads: int = 24,
+        intermediate_size: int = 6144,
+        hidden_act: str = "gelu",
+        hidden_dropout_prob: float = 0.1,
+        attention_probs_dropout_prob: float = 0.1,
+        max_position_embeddings: int = 512,
+        type_vocab_size: int = 0,
+        initializer_range: float = 0.02,
+        layer_norm_eps: float = 1e-7,
+        relative_attention: bool = False,
+        max_relative_positions: int = -1,
+        position_buckets: int = -1,
+        norm_rel_ebd: str = "none",
+        share_att_key: bool = False,
+        pos_att_type: Optional[List[str]] = None,
+        position_biased_input: bool = True,
+        pooler_hidden_size: Optional[int] = None,
+        pooler_dropout: float = 0.0,
+        pooler_hidden_act: str = "gelu",
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.relative_attention = relative_attention
+        self.max_relative_positions = max_relative_positions
+        self.position_buckets = position_buckets
+        self.norm_rel_ebd = norm_rel_ebd
+        self.share_att_key = share_att_key
+        if isinstance(pos_att_type, str):
+            pos_att_type = [t.strip() for t in pos_att_type.lower().split("|") if t.strip()]
+        self.pos_att_type = pos_att_type or []
+        self.position_biased_input = position_biased_input
+        self.pooler_hidden_size = pooler_hidden_size or hidden_size
+        self.pooler_dropout = pooler_dropout
+        self.pooler_hidden_act = pooler_hidden_act
+        kwargs.setdefault("pad_token_id", 0)
+        super().__init__(**kwargs)
+
+    @property
+    def pos_ebd_size(self) -> int:
+        max_rel = self.max_relative_positions
+        if max_rel < 1:
+            max_rel = self.max_position_embeddings
+        return self.position_buckets if self.position_buckets > 0 else max_rel

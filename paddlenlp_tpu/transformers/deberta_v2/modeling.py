@@ -1,0 +1,311 @@
+"""DeBERTa-v2/v3, TPU-native (reference: paddlenlp/transformers/deberta_v2/modeling.py).
+
+Disentangled attention: content-content scores plus content-to-position (c2p)
+and position-to-content (p2c) terms over a SHARED log-bucketed relative
+position embedding table (``encoder.rel_embeddings``, optionally LayerNormed).
+The bucketed distance matrix is a compile-time constant; the c2p/p2c gathers
+are expressed as one-hot contractions over the 2*span bucket axis so they lower
+to MXU matmuls instead of scatter/gather loops.
+
+Covers both plain DeBERTa-v2 (relative_attention=False falls back to standard
+BERT-style attention with absolute positions) and the v3 recipe
+(relative_attention + p2c|c2p + share_att_key + position_buckets).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..llama.modeling import ACT2FN, VocabEmbed, tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutput,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import DebertaV2Config
+
+__all__ = ["DebertaV2Model", "DebertaV2ForMaskedLM", "DebertaV2ForSequenceClassification",
+           "DebertaV2ForTokenClassification", "DebertaV2PretrainedModel"]
+
+
+@functools.lru_cache(maxsize=8)
+def _relative_bucket_matrix(q_size: int, k_size: int, bucket_size: int, max_position: int):
+    """[q, k] log-bucketed relative distances (HF make_log_bucket_position)."""
+    q = np.arange(q_size)
+    k = np.arange(k_size)
+    rel = q[:, None] - k[None, :]
+    if bucket_size > 0 and max_position > 0:
+        sign = np.sign(rel)
+        mid = bucket_size // 2
+        abs_pos = np.where((rel < mid) & (rel > -mid), mid - 1, np.abs(rel))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            log_pos = (np.ceil(np.log(abs_pos / mid) / np.log((max_position - 1) / mid) * (mid - 1))
+                       + mid)
+        rel = np.where(abs_pos <= mid, rel, (log_pos * sign).astype(np.int64))
+    return rel.astype(np.int32)
+
+
+class DisentangledSelfAttention(nn.Module):
+    """reference deberta_v2 DisentangledSelfAttention: qk/scale + c2p + p2c."""
+
+    config: DebertaV2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, rel_embeddings=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n = cfg.num_attention_heads
+        hd = D // n
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        query_proj = dense(D, "query_proj")
+        key_proj = dense(D, "key_proj")
+        value_proj = dense(D, "value_proj")
+        q = query_proj(h).reshape(B, T, n, hd)
+        k = key_proj(h).reshape(B, T, n, hd)
+        v = value_proj(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+
+        scale_factor = 1 + len(cfg.pos_att_type) if cfg.relative_attention else 1
+        scale = 1.0 / np.sqrt(hd * scale_factor)
+        scores = jnp.einsum("bqnh,bknh->bnqk", q, k) * scale
+
+        if cfg.relative_attention and rel_embeddings is not None and cfg.pos_att_type:
+            span = cfg.pos_ebd_size
+            max_rel = cfg.max_relative_positions
+            if max_rel < 1:
+                max_rel = cfg.max_position_embeddings
+            rel = _relative_bucket_matrix(T, T, cfg.position_buckets, max_rel)  # [T, T]
+            rel_emb = rel_embeddings[:2 * span]  # [2span, D]
+            if cfg.share_att_key:
+                pos_key = key_proj(rel_emb)
+                pos_query = query_proj(rel_emb)
+            else:
+                pos_key = dense(D, "pos_key_proj")(rel_emb) if "c2p" in cfg.pos_att_type else None
+                pos_query = dense(D, "pos_query_proj")(rel_emb) if "p2c" in cfg.pos_att_type else None
+            if "c2p" in cfg.pos_att_type:
+                pk = pos_key.reshape(2 * span, n, hd)
+                c2p = jnp.einsum("bqnh,snh->bnqs", q, pk)  # [B,n,T,2span]
+                idx = np.clip(rel + span, 0, 2 * span - 1)  # [T, T]
+                onehot = jax.nn.one_hot(jnp.asarray(idx), 2 * span, dtype=c2p.dtype)  # [T,T,2span]
+                scores = scores + jnp.einsum("bnqs,qks->bnqk", c2p, onehot) * scale
+            if "p2c" in cfg.pos_att_type:
+                pq = pos_query.reshape(2 * span, n, hd)
+                p2c = jnp.einsum("bknh,snh->bnks", k, pq)  # [B,n,K,2span]
+                idx = np.clip(-rel + span, 0, 2 * span - 1)  # [T(q), K]
+                # HF gathers at index[k, q] then transposes: score[q,k] = p2c[k, idx[k,q]]
+                onehot = jax.nn.one_hot(jnp.asarray(idx.T), 2 * span, dtype=p2c.dtype)  # [K,Q,2span]
+                scores = scores + jnp.einsum("bnks,kqs->bnqk", p2c, onehot) * scale
+
+        if attention_mask is not None:
+            neg = jnp.finfo(jnp.float32).min
+            scores = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                               scores.astype(jnp.float32), neg)
+        probs = jnp.asarray(nn.softmax(scores.astype(jnp.float32), axis=-1), self.dtype)
+        ctx = jnp.einsum("bnqk,bknh->bqnh", probs, v).reshape(B, T, D)
+        return ctx
+
+
+class DebertaV2Layer(nn.Module):
+    config: DebertaV2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, rel_embeddings=None, deterministic=True):
+        cfg = self.config
+        D = cfg.hidden_size
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        attn = DisentangledSelfAttention(cfg, self.dtype, self.param_dtype,
+                                         name="attention_self")(h, attention_mask, rel_embeddings,
+                                                                deterministic)
+        h = ln("attention_output_LayerNorm")(h + dense(D, "attention_output_dense")(attn))
+        ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "intermediate_dense")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        h = ln("output_LayerNorm")(h + dense(D, "output_dense")(ff))
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class DebertaV2Module(nn.Module):
+    config: DebertaV2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        if cfg.position_biased_input:
+            h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                             param_dtype=self.param_dtype, embedding_init=init,
+                             name="embeddings_position_embeddings")(jnp.arange(T)[None, :])
+        if cfg.type_vocab_size > 0:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            h = h + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+                             param_dtype=self.param_dtype, embedding_init=init,
+                             name="embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        # HF applies the padding mask to the embedding output
+        if attention_mask is not None:
+            h = h * attention_mask[..., None].astype(h.dtype)
+
+        rel_embeddings = None
+        if cfg.relative_attention:
+            span = cfg.pos_ebd_size
+            rel_embeddings = self.param("rel_embeddings", init,
+                                        (2 * span, cfg.hidden_size), self.param_dtype)
+            rel_embeddings = rel_embeddings.astype(self.dtype)
+            if "layer_norm" in cfg.norm_rel_ebd:
+                rel_embeddings = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                              param_dtype=self.param_dtype,
+                                              name="encoder_LayerNorm")(rel_embeddings)
+        for i in range(cfg.num_hidden_layers):
+            h = DebertaV2Layer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, rel_embeddings, deterministic)
+        return BaseModelOutput(last_hidden_state=h)
+
+
+class DebertaV2ForMaskedLMModule(nn.Module):
+    config: DebertaV2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = DebertaV2Module(cfg, self.dtype, self.param_dtype, name="deberta")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "deberta")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class DebertaV2ForSequenceClassificationModule(nn.Module):
+    config: DebertaV2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = DebertaV2Module(cfg, self.dtype, self.param_dtype, name="deberta")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic).last_hidden_state
+        # ContextPooler: dense + act over the [CLS] token
+        x = nn.Dense(cfg.pooler_hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="pooler_dense")(h[:, 0])
+        x = ACT2FN[cfg.pooler_hidden_act](x)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(x)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class DebertaV2ForTokenClassificationModule(nn.Module):
+    config: DebertaV2Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = DebertaV2Module(cfg, self.dtype, self.param_dtype, name="deberta")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic).last_hidden_state
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(h)
+        return TokenClassifierOutput(logits=logits)
+
+
+class DebertaV2PretrainedModel(PretrainedModel):
+    config_class = DebertaV2Config
+    base_model_prefix = "deberta"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"(query_proj|key_proj|value_proj)/kernel$", P("embed", "heads")),
+            (r"attention_output_dense/kernel$", P("heads", "embed")),
+            (r"intermediate_dense/kernel$", P("embed", "mlp")),
+            (r"output_dense/kernel$", P("mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layer_(\d+)\b", r"encoder@layer@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("attention_self", "attention@self")
+            key = key.replace("attention_output_LayerNorm", "attention@output@LayerNorm")
+            key = key.replace("attention_output_dense", "attention@output@dense")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("encoder_LayerNorm", "encoder@LayerNorm")
+            key = key.replace("rel_embeddings", "encoder@rel_embeddings@weight")
+            key = key.replace("predictions_transform_LayerNorm", "cls@predictions@transform@LayerNorm")
+            key = key.replace("predictions_transform_dense", "cls@predictions@transform@dense")
+            key = key.replace("predictions_bias", "cls@predictions@bias")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class DebertaV2Model(DebertaV2PretrainedModel):
+    module_class = DebertaV2Module
+
+
+class DebertaV2ForMaskedLM(DebertaV2PretrainedModel):
+    module_class = DebertaV2ForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"cls\.predictions\.decoder"]
+
+
+class DebertaV2ForSequenceClassification(DebertaV2PretrainedModel):
+    module_class = DebertaV2ForSequenceClassificationModule
+
+
+class DebertaV2ForTokenClassification(DebertaV2PretrainedModel):
+    module_class = DebertaV2ForTokenClassificationModule

@@ -1,0 +1,7 @@
+from .configuration import DistilBertConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    DistilBertForMaskedLM,
+    DistilBertForSequenceClassification,
+    DistilBertModel,
+    DistilBertPretrainedModel,
+)

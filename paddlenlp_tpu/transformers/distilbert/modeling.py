@@ -1,0 +1,166 @@
+"""DistilBERT, TPU-native (reference: paddlenlp/transformers/distilbert/modeling.py).
+
+BERT-shaped encoder with distil deltas: no token-type embeddings, no pooler,
+post-LN blocks with HF distil key names (``transformer.layer.N.attention.q_lin``,
+``sa_layer_norm``, ``ffn.lin1``, ``output_layer_norm``) and the
+``vocab_transform``/``vocab_layer_norm``/tied ``vocab_projector`` MLM head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..llama.modeling import ACT2FN, VocabEmbed, tied_mlm_head
+from ..model_outputs import BaseModelOutput, MaskedLMOutput, SequenceClassifierOutput
+from ..model_utils import PretrainedModel
+from .configuration import DistilBertConfig
+
+__all__ = ["DistilBertModel", "DistilBertForMaskedLM",
+           "DistilBertForSequenceClassification", "DistilBertPretrainedModel"]
+
+
+class DistilBertLayer(nn.Module):
+    config: DistilBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.n_heads, cfg.dim // cfg.n_heads
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        q = dense(D, "attention_q_lin")(h).reshape(B, T, n, hd)
+        k = dense(D, "attention_k_lin")(h).reshape(B, T, n, hd)
+        v = dense(D, "attention_v_lin")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask,
+                                     causal=False).reshape(B, T, D)
+        h = ln("sa_layer_norm")(h + dense(D, "attention_out_lin")(attn))
+        ff = ACT2FN[cfg.activation](dense(cfg.hidden_dim, "ffn_lin1")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        h = ln("output_layer_norm")(h + dense(D, "ffn_lin2")(ff))
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class DistilBertModule(nn.Module):
+    config: DistilBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(jnp.arange(T)[None, :])
+        h = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        for i in range(cfg.n_layers):
+            h = DistilBertLayer(cfg, self.dtype, self.param_dtype,
+                                name=f"transformer_layer_{i}")(h, attention_mask, deterministic)
+        return BaseModelOutput(last_hidden_state=h)
+
+
+class DistilBertForMaskedLMModule(nn.Module):
+    config: DistilBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = DistilBertModule(cfg, self.dtype, self.param_dtype, name="distilbert")(
+            input_ids, attention_mask, deterministic).last_hidden_state
+        table = self.get_variable("params", "distilbert")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size, hidden_size=cfg.dim,
+                               act=cfg.activation, layer_norm_eps=1e-12, dtype=self.dtype,
+                               param_dtype=self.param_dtype, dense_name="vocab_transform",
+                               ln_name="vocab_layer_norm", bias_name="vocab_projector_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class DistilBertForSequenceClassificationModule(nn.Module):
+    config: DistilBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = DistilBertModule(cfg, self.dtype, self.param_dtype, name="distilbert")(
+            input_ids, attention_mask, deterministic).last_hidden_state
+        x = nn.Dense(cfg.dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="pre_classifier")(h[:, 0])
+        x = nn.relu(x)
+        if not deterministic and cfg.seq_classif_dropout > 0:
+            x = nn.Dropout(cfg.seq_classif_dropout)(x, deterministic=False)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(x)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class DistilBertPretrainedModel(PretrainedModel):
+    config_class = DistilBertConfig
+    base_model_prefix = "distilbert"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"(q_lin|k_lin|v_lin)/kernel$", P("embed", "heads")),
+            (r"out_lin/kernel$", P("heads", "embed")),
+            (r"ffn_lin1/kernel$", P("embed", "mlp")),
+            (r"ffn_lin2/kernel$", P("mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        import re
+
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\btransformer_layer_(\d+)\b", r"transformer@layer@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("attention_", "attention@")
+            key = key.replace("ffn_lin", "ffn@lin")
+            key = key.replace("vocab_projector_bias", "vocab_projector@bias")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class DistilBertModel(DistilBertPretrainedModel):
+    module_class = DistilBertModule
+
+
+class DistilBertForMaskedLM(DistilBertPretrainedModel):
+    module_class = DistilBertForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"vocab_projector\.weight"]  # tied to embeddings
+
+
+class DistilBertForSequenceClassification(DistilBertPretrainedModel):
+    module_class = DistilBertForSequenceClassificationModule

@@ -62,7 +62,26 @@ ACT2FN = {
     "gelu_new": partial(nn.gelu, approximate=True),
     "gelu_pytorch_tanh": partial(nn.gelu, approximate=True),
     "tanh": jnp.tanh,
+    "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x),  # openai clip
 }
+
+
+def tied_mlm_head(module, h, *, table, vocab_size, hidden_size, act, layer_norm_eps,
+                  dtype, param_dtype, dense_name: str, ln_name: str, bias_name: str):
+    """BERT-style MLM head with the decoder TIED to the word-embedding table:
+    dense -> act -> LayerNorm -> h @ table.T + standalone bias. Shared by the
+    encoder zoo (bert/distilbert/nezha/mpnet/deberta/blip) so dtype and sharding
+    handling of the tied projection lives in one place. Param names are passed
+    in because each family keeps its HF checkpoint naming."""
+    from ...parallel.partition import P, shard_constraint
+
+    x = nn.Dense(hidden_size, dtype=dtype, param_dtype=param_dtype, name=dense_name)(h)
+    x = ACT2FN[act](x)
+    x = nn.LayerNorm(epsilon=layer_norm_eps, dtype=dtype, param_dtype=param_dtype,
+                     name=ln_name)(x)
+    bias = module.param(bias_name, nn.initializers.zeros, (vocab_size,), param_dtype)
+    logits = x @ table.T.astype(dtype) + bias.astype(dtype)
+    return shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
 
 
 class LlamaRMSNorm(nn.Module):

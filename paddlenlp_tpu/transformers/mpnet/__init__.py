@@ -1,0 +1,7 @@
+from .configuration import MPNetConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    MPNetForMaskedLM,
+    MPNetForSequenceClassification,
+    MPNetModel,
+    MPNetPretrainedModel,
+)

@@ -1,0 +1,199 @@
+"""MPNet, TPU-native (reference: paddlenlp/transformers/mpnet/modeling.py).
+
+BERT-shaped encoder with MPNet's deltas: roberta-style pad-offset positions,
+t5-style BUCKETED relative attention bias shared by all layers (ONE
+``encoder.relative_attention_bias`` Embedding(32, n_heads)), and attn.q/k/v/o
+key names. The bias is computed once per forward and added to every layer's
+attention scores through the shared flash-attention ``bias`` input.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..llama.modeling import ACT2FN, VocabEmbed, tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from ..roberta.modeling import create_position_ids_from_input_ids
+from ..t5.modeling import relative_position_bucket
+from .configuration import MPNetConfig
+
+__all__ = ["MPNetModel", "MPNetForMaskedLM", "MPNetForSequenceClassification",
+           "MPNetPretrainedModel"]
+
+
+class MPNetLayer(nn.Module):
+    config: MPNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, position_bias=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        q = dense(D, "attention_attn_q")(h).reshape(B, T, n, hd)
+        k = dense(D, "attention_attn_k")(h).reshape(B, T, n, hd)
+        v = dense(D, "attention_attn_v")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask, causal=False,
+                                     bias=position_bias).reshape(B, T, D)
+        h = ln("attention_LayerNorm")(h + dense(D, "attention_attn_o")(attn))
+        ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "intermediate_dense")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        h = ln("output_LayerNorm")(h + dense(D, "output_dense")(ff))
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class MPNetModule(nn.Module):
+    config: MPNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = create_position_ids_from_input_ids(input_ids, cfg.pad_token_id)
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(position_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        # ONE bucketed relative bias table shared by every layer (HF
+        # encoder.relative_attention_bias)
+        rel = jnp.arange(T)[None, :] - jnp.arange(T)[:, None]
+        buckets = relative_position_bucket(rel, bidirectional=True,
+                                           num_buckets=cfg.relative_attention_num_buckets,
+                                           max_distance=128)
+        bias_table = nn.Embed(cfg.relative_attention_num_buckets, cfg.num_attention_heads,
+                              dtype=self.dtype, param_dtype=self.param_dtype, embedding_init=init,
+                              name="relative_attention_bias")
+        position_bias = bias_table(buckets).transpose(2, 0, 1)[None]  # [1, n, T, T]
+        for i in range(cfg.num_hidden_layers):
+            h = MPNetLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, position_bias, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name="pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class MPNetForMaskedLMModule(nn.Module):
+    config: MPNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = MPNetModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                        name="mpnet")(input_ids, attention_mask,
+                                      deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "mpnet")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act="gelu",
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype, dense_name="lm_head_dense",
+                               ln_name="lm_head_layer_norm", bias_name="lm_head_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class MPNetForSequenceClassificationModule(nn.Module):
+    config: MPNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = MPNetModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                        name="mpnet")(input_ids, attention_mask,
+                                      deterministic=deterministic).last_hidden_state
+        x = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                              name="classifier_dense")(h[:, 0]))
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier_out_proj")(x)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class MPNetPretrainedModel(PretrainedModel):
+    config_class = MPNetConfig
+    base_model_prefix = "mpnet"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"attention_attn_(q|k|v)/kernel$", P("embed", "heads")),
+            (r"attention_attn_o/kernel$", P("heads", "embed")),
+            (r"intermediate_dense/kernel$", P("embed", "mlp")),
+            (r"output_dense/kernel$", P("mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layer_(\d+)\b", r"encoder@layer@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("attention_attn_", "attention@attn@")
+            key = key.replace("attention_LayerNorm", "attention@LayerNorm")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("relative_attention_bias", "encoder@relative_attention_bias")
+            key = key.replace("lm_head_dense", "lm_head@dense")
+            key = key.replace("lm_head_layer_norm", "lm_head@layer_norm")
+            key = key.replace("lm_head_bias", "lm_head@bias")
+            key = key.replace("classifier_dense", "classifier@dense")
+            key = key.replace("classifier_out_proj", "classifier@out_proj")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class MPNetModel(MPNetPretrainedModel):
+    module_class = MPNetModule
+
+
+class MPNetForMaskedLM(MPNetPretrainedModel):
+    module_class = MPNetForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"lm_head\.decoder"]
+
+
+class MPNetForSequenceClassification(MPNetPretrainedModel):
+    module_class = MPNetForSequenceClassificationModule

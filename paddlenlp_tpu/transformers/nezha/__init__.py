@@ -1,0 +1,8 @@
+from .configuration import NezhaConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    NezhaForMaskedLM,
+    NezhaForSequenceClassification,
+    NezhaForTokenClassification,
+    NezhaModel,
+    NezhaPretrainedModel,
+)

@@ -1,0 +1,16 @@
+"""NEZHA configuration (reference: paddlenlp/transformers/nezha/configuration.py)."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["NezhaConfig"]
+
+
+class NezhaConfig(BertConfig):
+    model_type = "nezha"
+
+    def __init__(self, max_relative_position: int = 64, **kwargs):
+        self.max_relative_position = max_relative_position
+        kwargs.setdefault("vocab_size", 21128)
+        super().__init__(**kwargs)

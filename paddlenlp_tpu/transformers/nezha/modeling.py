@@ -1,0 +1,213 @@
+"""NEZHA, TPU-native (reference: paddlenlp/transformers/nezha/modeling.py).
+
+BERT encoder with NEZHA's functional relative positions: NO learned position
+embeddings; every attention layer adds a FIXED sinusoid embedding of the
+clipped query-key distance to both the attention scores (query side) and the
+context (probability side). The distance table is a compile-time constant
+folded into the jit — nothing is stored in checkpoints, which keep plain bert
+keys minus ``position_embeddings``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, VocabEmbed, _dense
+from ..llama.modeling import tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import NezhaConfig
+
+__all__ = ["NezhaModel", "NezhaForMaskedLM", "NezhaForSequenceClassification",
+           "NezhaForTokenClassification", "NezhaPretrainedModel"]
+
+
+@functools.lru_cache(maxsize=8)
+def _relative_position_table_np(length: int, depth: int, max_relative_position: int):
+    """[T, T, depth] sinusoid embedding of clip(j - i, ±max) (HF/reference
+    NezhaRelativePositionsEncoding: interleaved sin/cos over the 2k+1 distances)."""
+    rng = np.arange(length)
+    distance = np.clip(rng[None, :] - rng[:, None], -max_relative_position, max_relative_position)
+    flat = distance + max_relative_position  # [T, T] in [0, 2k]
+    vocab = 2 * max_relative_position + 1
+    pos = np.arange(vocab, dtype=np.float64)[:, None]
+    i = np.arange(depth, dtype=np.float64)[None, :]
+    angle = pos / np.power(10000.0, 2 * (i // 2) / depth)
+    table = np.zeros((vocab, depth))
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table[flat].astype(np.float32)  # [T, T, depth]
+
+
+class NezhaLayer(nn.Module):
+    config: NezhaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_query")(h).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_key")(h).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_value")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        rel = jnp.asarray(_relative_position_table_np(T, hd, cfg.max_relative_position),
+                          dtype=self.dtype)  # [T, T, hd]
+        scores = jnp.einsum("bqnh,bknh->bnqk", q, k)
+        scores = scores + jnp.einsum("bqnh,qkh->bnqk", q, rel)
+        scores = scores / np.sqrt(hd)
+        if attention_mask is not None:
+            neg = jnp.finfo(scores.dtype).min
+            scores = jnp.where(attention_mask[:, None, None, :].astype(bool), scores, neg)
+        probs = jnp.asarray(nn.softmax(scores.astype(jnp.float32), axis=-1), self.dtype)
+        ctx = jnp.einsum("bnqk,bknh->bqnh", probs, v)
+        ctx = ctx + jnp.einsum("bnqk,qkh->bqnh", probs, rel)
+        attn = _dense(D, cfg, self.dtype, self.param_dtype, "attention_output_dense")(
+            ctx.reshape(B, T, D))
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="attention_output_LayerNorm")(h + attn)
+        ff = ACT2FN[cfg.hidden_act](_dense(cfg.intermediate_size, cfg, self.dtype,
+                                           self.param_dtype, "intermediate_dense")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dense(D, cfg, self.dtype, self.param_dtype, "output_dense")(ff)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="output_LayerNorm")(h + ff)
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class NezhaModule(nn.Module):
+    config: NezhaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        for i in range(cfg.num_hidden_layers):
+            h = NezhaLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class NezhaForMaskedLMModule(nn.Module):
+    config: NezhaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = NezhaModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                        name="nezha")(input_ids, attention_mask, token_type_ids,
+                                      deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "nezha")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class NezhaForSequenceClassificationModule(nn.Module):
+    config: NezhaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = NezhaModule(cfg, self.dtype, self.param_dtype, name="nezha")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class NezhaForTokenClassificationModule(nn.Module):
+    config: NezhaConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = NezhaModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                          name="nezha")(input_ids, attention_mask, token_type_ids,
+                                        deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.last_hidden_state)
+        return TokenClassifierOutput(logits=logits)
+
+
+class NezhaPretrainedModel(PretrainedModel):
+    config_class = NezhaConfig
+    base_model_prefix = "nezha"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..bert.modeling import BertPretrainedModel
+
+        return BertPretrainedModel.get_partition_rules(config)
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..bert.modeling import BertPretrainedModel
+
+        mappings = BertPretrainedModel._get_name_mappings(config, flat_shapes)
+        for m in mappings:
+            m.source_name = m.source_name.replace("embeddings_", "embeddings.")
+        return mappings
+
+
+class NezhaModel(NezhaPretrainedModel):
+    module_class = NezhaModule
+
+
+class NezhaForMaskedLM(NezhaPretrainedModel):
+    module_class = NezhaForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"cls\.predictions\.decoder", r"position_ids"]
+
+
+class NezhaForSequenceClassification(NezhaPretrainedModel):
+    module_class = NezhaForSequenceClassificationModule
+
+
+class NezhaForTokenClassification(NezhaPretrainedModel):
+    module_class = NezhaForTokenClassificationModule

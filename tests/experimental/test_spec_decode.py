@@ -76,3 +76,67 @@ class TestSpeculative:
         ref = _engine(model, spec=False).generate(prompts, SamplingParams(max_new_tokens=12))
         for a, b in zip(outs, ref):
             np.testing.assert_array_equal(a, b)
+
+
+@pytest.fixture(scope="module")
+def draft_model():
+    """A DIFFERENT (smaller) model than the target — drafts won't always match."""
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+                      num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=512,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=7)
+
+
+class TestDraftModelSpeculative:
+    def test_greedy_bit_identical_with_draft_model(self, model, draft_model):
+        """Draft-model proposals + greedy verify must never change outputs."""
+        prompts = [[5, 6, 7, 8, 9, 5, 6, 7], [40, 41, 42, 43]]
+        base = _engine(model, spec=False).generate(prompts, SamplingParams(max_new_tokens=16))
+        eng = _engine(model, spec=False, draft_model=draft_model, spec_draft_len=4)
+        spec = eng.generate(prompts, SamplingParams(max_new_tokens=16))
+        for b, s in zip(base, spec):
+            np.testing.assert_array_equal(b, s)
+        assert eng.spec_stats["verify_steps"] > 0
+
+    def test_self_draft_accepts_everything_greedy(self, model):
+        """Target drafting for itself: greedy drafts always match the verify
+        argmax, so acceptance must be 100%."""
+        eng = _engine(model, spec=False, draft_model=model, spec_draft_len=4)
+        out = eng.generate([[5, 6, 7, 8]], SamplingParams(max_new_tokens=12))[0]
+        assert len(out) == 12
+        s = eng.spec_stats
+        assert s["drafted"] > 0 and s["accepted"] == s["drafted"], s
+
+    def test_rejection_sampling_self_draft_full_acceptance(self, model):
+        """Sampling mode with draft == target: p == q at every position, so the
+        accept probability min(1, p/q) is 1 — every draft must be accepted and
+        the emitted stream is an exact target-distribution sample."""
+        eng = _engine(model, spec=False, draft_model=model, spec_draft_len=4)
+        out = eng.generate([[5, 6, 7, 8]],
+                           SamplingParams(max_new_tokens=12, do_sample=True, temperature=0.9,
+                                          top_k=0, top_p=1.0))[0]
+        assert len(out) == 12
+        s = eng.spec_stats
+        assert s["drafted"] > 0 and s["accepted"] == s["drafted"], s
+
+    def test_rejection_sampling_different_draft_runs(self, model, draft_model):
+        """Different draft: some rejections expected; stream must still complete
+        and stats must record partial acceptance."""
+        eng = _engine(model, spec=False, draft_model=draft_model, spec_draft_len=4)
+        out = eng.generate([[5, 6, 7, 8], [40, 41, 42, 43]],
+                           SamplingParams(max_new_tokens=16, do_sample=True, temperature=0.9,
+                                          top_k=0, top_p=1.0))
+        assert all(len(o) == 16 for o in out)
+        s = eng.spec_stats
+        assert s["verify_steps"] > 0 and s["drafted"] >= s["accepted"], s
+
+    def test_topk_sampling_falls_back(self, model, draft_model):
+        """top-k sampling is outside the rejection path — engine must fall back
+        to normal decode (no verify steps) and still produce full streams."""
+        eng = _engine(model, spec=False, draft_model=draft_model, spec_draft_len=4)
+        out = eng.generate([[5, 6, 7, 8]],
+                           SamplingParams(max_new_tokens=8, do_sample=True, top_k=5))[0]
+        assert len(out) == 8
+        assert eng.spec_stats["verify_steps"] == 0

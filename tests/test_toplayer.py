@@ -204,3 +204,77 @@ class TestTaskflowBreadth:
         _populate()
         for name in ("fill_mask", "question_answering", "text_summarization", "chat"):
             assert name in TASKS, name
+
+
+class TestTaskflowRound5:
+    """feature_extraction / zero_shot_text_classification / text_correction
+    + generation-flavored aliases (reference taskflow registry breadth)."""
+
+    def _enc_dir(self, tmp_path):
+        from tokenizers import Tokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        from paddlenlp_tpu.transformers import BertConfig, BertModel, PretrainedTokenizer
+
+        d = str(tmp_path / "enc")
+        vocab = {"<pad>": 0, "<unk>": 1}
+        for i, w in enumerate("sports movie politics the game team film actor vote law".split()):
+            vocab[w] = i + 2
+        t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", unk_token="<unk>").save_pretrained(d)
+        BertModel.from_config(
+            BertConfig(vocab_size=16, hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+                       num_attention_heads=2, max_position_embeddings=32), seed=0).save_pretrained(d)
+        return d
+
+    def test_feature_extraction(self, tmp_path):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        tf = Taskflow("feature_extraction", task_path=self._enc_dir(tmp_path))
+        out = tf(["the game", "the film"])
+        assert out["features"].shape == (2, 32)
+
+    def test_zero_shot_classification(self, tmp_path):
+        from paddlenlp_tpu.taskflow import Taskflow
+
+        tf = Taskflow("zero_shot_text_classification", task_path=self._enc_dir(tmp_path),
+                      schema=["sports", "movie"], template="{}")
+        out = tf("the team game")
+        assert len(out) == 1 and len(out[0]["predictions"]) == 2
+        scores = [p["score"] for p in out[0]["predictions"]]
+        assert abs(sum(scores) - 1.0) < 1e-5
+        assert scores == sorted(scores, reverse=True)
+
+    def test_text_correction(self, tmp_path):
+        from tokenizers import Tokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        from paddlenlp_tpu.taskflow import Taskflow
+        from paddlenlp_tpu.transformers import BertConfig, BertForMaskedLM, PretrainedTokenizer
+
+        d = str(tmp_path / "csc")
+        vocab = {"<pad>": 0, "<unk>": 1}
+        for i, w in enumerate("the cat sat mat dog ran".split()):
+            vocab[w] = i + 2
+        t = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+        t.pre_tokenizer = Whitespace()
+        PretrainedTokenizer(tokenizer_object=t, pad_token="<pad>", unk_token="<unk>").save_pretrained(d)
+        BertForMaskedLM.from_config(
+            BertConfig(vocab_size=8, hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+                       num_attention_heads=2, max_position_embeddings=32), seed=0).save_pretrained(d)
+        tf = Taskflow("text_correction", task_path=d, threshold=1e9)  # high bar: no corrections
+        out = tf("the cat sat")
+        assert out[0]["errors"] == [] and out[0]["target"] == "the cat sat"
+
+    def test_round5_tasks_registered(self):
+        from paddlenlp_tpu.taskflow.taskflow import TASKS, _populate
+
+        _populate()
+        for name in ("feature_extraction", "zero_shot_text_classification", "text_correction",
+                     "code_generation", "poetry_generation", "dialogue", "question_generation",
+                     "lexical_analysis"):
+            assert name in TASKS, name
+        assert len(TASKS) >= 21

@@ -303,3 +303,68 @@ class TestFP8:
         got = np.asarray(qm(input_ids=ids).logits[0])
         cos = float((ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got) + 1e-9))
         assert cos > 0.995, cos
+
+
+class TestCompressionDepth:
+    """Round-5 compression-trainer additions: QAT (STE fake-quant finetune),
+    embedding quantization, depth pruning (reference trainer_compress.py)."""
+
+    def _trainer(self, scan=False, n=6):
+        from paddlenlp_tpu.trainer import Trainer, TrainingArguments
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=4, num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=64, use_scan_layers=scan)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        data = [{"input_ids": np.asarray([3, 4, 5, 6, 7, 8], np.int32),
+                 "labels": np.asarray([4, 5, 6, 7, 8, 9], np.int32)} for _ in range(n)]
+        import tempfile
+
+        args = TrainingArguments(output_dir=tempfile.mkdtemp(), per_device_train_batch_size=1)
+        return Trainer(model=model, args=args, train_dataset=data)
+
+    def test_qat_improves_quantized_loss(self, tmp_path):
+        """A few STE steps must not diverge, and the export loads as wint8."""
+        import os
+
+        trainer = self._trainer()
+        out = trainer.compress(strategy="qat", output_dir=str(tmp_path), n_qat_steps=8,
+                               learning_rate=1e-4)
+        assert os.path.exists(os.path.join(out, "model_quant.safetensors"))
+        assert os.path.exists(os.path.join(out, "model.safetensors"))
+
+    def test_embedding_quant_roundtrip(self, tmp_path):
+        import os
+
+        from paddlenlp_tpu.trainer.trainer_compress import dequantize_embedding
+        from paddlenlp_tpu.utils.safetensors_io import load_file
+
+        trainer = self._trainer()
+        out = trainer.compress(strategy="embedding_quant", output_dir=str(tmp_path))
+        tensors = load_file(os.path.join(out, "model_quant.safetensors"))
+        qkeys = [k for k in tensors if k.endswith("qembedding")]
+        assert qkeys, list(tensors)[:10]
+        k = qkeys[0]
+        scales = tensors[k.rsplit("/", 1)[0] + "/embed_scales"]
+        deq = np.asarray(dequantize_embedding(jnp.asarray(tensors[k]), jnp.asarray(scales)))
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+        orig = np.asarray([v for p, v in flatten_params(trainer.model.params).items()
+                           if p.endswith("/embedding")][0])
+        rel = np.abs(deq - orig).mean() / np.abs(orig).mean()
+        assert rel < 0.02, rel
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_depth_prune(self, tmp_path, scan):
+        from paddlenlp_tpu.transformers import LlamaForCausalLM
+
+        trainer = self._trainer(scan=scan)
+        out = trainer.compress(strategy="prune_depth", output_dir=str(tmp_path / "d"),
+                               depth_mult=0.5)
+        pruned = LlamaForCausalLM.from_pretrained(out)
+        assert pruned.config.num_hidden_layers == 2
+        ids = jnp.asarray(np.arange(10)[None] % 90 + 3, jnp.int32)
+        logits = pruned(input_ids=ids).logits
+        assert logits.shape == (1, 10, 96)
+        assert np.isfinite(np.asarray(logits)).all()
