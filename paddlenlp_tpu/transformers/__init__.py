@@ -158,6 +158,16 @@ from .mpnet import (  # noqa: F401
     MPNetForSequenceClassification,
     MPNetModel,
 )
+from .gptj import GPTJConfig, GPTJForCausalLM, GPTJModel  # noqa: F401
+from .codegen import CodeGenConfig, CodeGenForCausalLM, CodeGenModel  # noqa: F401
+from .roformer import (  # noqa: F401
+    RoFormerConfig,
+    RoFormerForMaskedLM,
+    RoFormerForSequenceClassification,
+    RoFormerModel,
+)
+from .tinybert import TinyBertConfig, TinyBertForSequenceClassification, TinyBertModel  # noqa: F401
+from .ppminilm import PPMiniLMConfig, PPMiniLMForSequenceClassification, PPMiniLMModel  # noqa: F401
 from .deberta_v2 import (  # noqa: F401
     DebertaV2Config,
     DebertaV2ForMaskedLM,

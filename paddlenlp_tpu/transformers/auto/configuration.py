@@ -50,6 +50,11 @@ def _populate():
     from ..nezha.configuration import NezhaConfig
     from ..mpnet.configuration import MPNetConfig
     from ..deberta_v2.configuration import DebertaV2Config
+    from ..gptj.configuration import GPTJConfig
+    from ..codegen.configuration import CodeGenConfig
+    from ..roformer.configuration import RoFormerConfig
+    from ..tinybert.configuration import TinyBertConfig
+    from ..ppminilm.configuration import PPMiniLMConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -62,7 +67,8 @@ def _populate():
                 AlbertConfig, ElectraConfig, RobertaConfig,
                 MT5Config, MBartConfig, PegasusConfig,
                 CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
-                DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config):
+                DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
+                GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -170,6 +170,27 @@ def _populate_models():
     register_model("mpnet", "base", mpnet.MPNetModel)
     register_model("mpnet", "masked_lm", mpnet.MPNetForMaskedLM)
     register_model("mpnet", "sequence_classification", mpnet.MPNetForSequenceClassification)
+    from ..gptj import modeling as gptj
+
+    register_model("gptj", "base", gptj.GPTJModel)
+    register_model("gptj", "causal_lm", gptj.GPTJForCausalLM)
+    from ..codegen import modeling as codegen
+
+    register_model("codegen", "base", codegen.CodeGenModel)
+    register_model("codegen", "causal_lm", codegen.CodeGenForCausalLM)
+    from ..roformer import modeling as roformer
+
+    register_model("roformer", "base", roformer.RoFormerModel)
+    register_model("roformer", "masked_lm", roformer.RoFormerForMaskedLM)
+    register_model("roformer", "sequence_classification", roformer.RoFormerForSequenceClassification)
+    from ..tinybert import modeling as tinybert
+
+    register_model("tinybert", "base", tinybert.TinyBertModel)
+    register_model("tinybert", "sequence_classification", tinybert.TinyBertForSequenceClassification)
+    from ..ppminilm import modeling as ppminilm
+
+    register_model("ppminilm", "base", ppminilm.PPMiniLMModel)
+    register_model("ppminilm", "sequence_classification", ppminilm.PPMiniLMForSequenceClassification)
     from ..deberta_v2 import modeling as deberta_v2
 
     register_model("deberta-v2", "base", deberta_v2.DebertaV2Model)

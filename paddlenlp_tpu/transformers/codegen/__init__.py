@@ -1,0 +1,2 @@
+from .configuration import CodeGenConfig  # noqa: F401
+from .modeling import CodeGenForCausalLM, CodeGenModel, CodeGenPretrainedModel  # noqa: F401

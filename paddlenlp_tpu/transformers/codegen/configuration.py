@@ -1,0 +1,18 @@
+"""CodeGen configuration (reference: paddlenlp/transformers/codegen/configuration.py)."""
+
+from __future__ import annotations
+
+from ..gptj.configuration import GPTJConfig
+
+__all__ = ["CodeGenConfig"]
+
+
+class CodeGenConfig(GPTJConfig):
+    model_type = "codegen"
+
+    def __init__(self, vocab_size: int = 50400, n_embd: int = 1024, n_layer: int = 20,
+                 n_head: int = 16, rotary_dim: int = 32, **kwargs):
+        kwargs.setdefault("bos_token_id", 1)
+        kwargs.setdefault("eos_token_id", 50256)
+        super().__init__(vocab_size=vocab_size, n_embd=n_embd, n_layer=n_layer, n_head=n_head,
+                         rotary_dim=rotary_dim, **kwargs)

@@ -1,0 +1,68 @@
+"""CodeGen, TPU-native — the GPT-J network behind a fused-qkv checkpoint mapping.
+
+Counterpart of ``paddlenlp/transformers/codegen/modeling.py``: architecture is
+GPT-J (parallel residual, partial interleaved rotary, gelu_new); the ONLY delta
+is the checkpoint layout — HF stores one ``attn.qkv_proj`` whose output rows
+are 4 tensor-parallel blocks each ordered (query, value, key) (HF
+CodeGenAttention mp_num=4 split). The mapping splits it into our q/k/v kernels;
+our own saved checkpoints use split keys and load through the mechanical
+fallback, like baichuan's W_pack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..gptj.modeling import GPTJForCausalLM, GPTJModel, GPTJPretrainedModel
+from .configuration import CodeGenConfig
+
+__all__ = ["CodeGenModel", "CodeGenForCausalLM", "CodeGenPretrainedModel"]
+
+MP_NUM = 4  # HF CodeGen's fixed fused-qkv block count
+
+
+def _split_qkv(which: int, D: int):
+    """torch qkv_proj.weight [3D, D] -> one projection's flax kernel [D, D].
+    Rows: [mp][q|v|k][local] with local = D // MP_NUM; ``which`` indexes the
+    (q=0, v=1, k=2) slot."""
+
+    def fn(a):
+        local = D // MP_NUM
+        a4 = np.asarray(a).reshape(MP_NUM, 3, local, a.shape[-1])
+        rows = a4[:, which].reshape(D, a.shape[-1])  # [D_out_rows, D_in]
+        return np.ascontiguousarray(rows.T)  # flax [in, out]
+
+    return fn
+
+
+class CodeGenPretrainedModel(GPTJPretrainedModel):
+    config_class = CodeGenConfig
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StackedLayerMapping, StateDictNameMapping
+
+        mappings = GPTJPretrainedModel._get_name_mappings(config, flat_shapes)
+        D = config.n_embd
+        slot = {"q_proj": 0, "v_proj": 1, "k_proj": 2}
+        out = []
+        for m in mappings:
+            hit = next((p for p in slot if f"attn.{p}" in m.source_name), None)
+            if hit is None:
+                out.append(m)
+                continue
+            src = m.source_name.replace(f"attn.{hit}", "attn.qkv_proj")
+            fn = _split_qkv(slot[hit], D)
+            if isinstance(m, StackedLayerMapping):
+                out.append(StackedLayerMapping(src, m.target_name, dims=m.dims, fn=fn))
+            else:
+                out.append(StateDictNameMapping(src, m.target_name, fn=fn))
+        return out
+
+
+class CodeGenModel(CodeGenPretrainedModel, GPTJModel):
+    pass
+
+
+class CodeGenForCausalLM(CodeGenPretrainedModel, GPTJForCausalLM):
+    pass

@@ -1,0 +1,115 @@
+"""Knowledge distillation utilities, TPU-native.
+
+Counterpart of ``paddlenlp/transformers/distill_utils.py`` (MiniLM relation
+losses + ``to_distill`` monkey-patching of forward methods to expose q/k/v).
+No forward patching here: the losses are pure functions over (student, teacher)
+tensors, and ``DistillTrainer`` overrides ``compute_loss`` to combine them —
+the teacher runs frozen inside the same jit, so XLA overlaps both models.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..trainer.trainer import Trainer
+
+__all__ = ["kl_div_loss", "soft_cross_entropy", "hidden_mse_loss",
+           "minilm_relation_loss", "DistillTrainer"]
+
+
+def soft_cross_entropy(student_logits, teacher_logits, temperature: float = 1.0):
+    """CE against the teacher's softened distribution, scaled by T^2 (Hinton)."""
+    t = temperature
+    teacher_p = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    student_logp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    return -(teacher_p * student_logp).sum(-1).mean() * t * t
+
+
+def kl_div_loss(student_logits, teacher_logits, temperature: float = 1.0):
+    t = temperature
+    teacher_p = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    teacher_logp = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    student_logp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    return (teacher_p * (teacher_logp - student_logp)).sum(-1).mean() * t * t
+
+
+def hidden_mse_loss(student_hidden, teacher_hidden, proj_kernel=None):
+    """TinyBERT-style hidden-state MSE; ``proj_kernel`` [d_s, d_t] maps a
+    narrower student into teacher space."""
+    s = student_hidden.astype(jnp.float32)
+    if proj_kernel is not None:
+        s = s @ proj_kernel.astype(jnp.float32)
+    return jnp.mean((s - teacher_hidden.astype(jnp.float32)) ** 2)
+
+
+def minilm_relation_loss(student_states, teacher_states, num_relation_heads: int = 0):
+    """MiniLMv2 self-relation distillation (reference calc_minilm_loss :119):
+    KL between the two models' scaled self-attention RELATIONS of one vector
+    family (q/k/v hidden states reshaped to relation heads). Head counts may
+    differ between models — both are re-split to ``num_relation_heads``."""
+
+    def relations(x, n_heads):
+        B, T, D = x.shape
+        h = x.reshape(B, T, n_heads, D // n_heads).transpose(0, 2, 1, 3).astype(jnp.float32)
+        logits = jnp.einsum("bnqh,bnkh->bnqk", h, h) / jnp.sqrt(h.shape[-1])
+        return logits
+
+    n = num_relation_heads or 1
+    s = jax.nn.log_softmax(relations(student_states, n), axis=-1)
+    t = jax.nn.softmax(relations(teacher_states, n), axis=-1)
+    t_log = jax.nn.log_softmax(relations(teacher_states, n), axis=-1)
+    return (t * (t_log - s)).sum(-1).mean()
+
+
+class DistillTrainer(Trainer):
+    """Trainer whose loss = alpha * CE(labels) + (1-alpha) * KD(teacher)
+    [+ beta * hidden MSE]. The teacher's params ride inside the jitted step as
+    constants (frozen), so the combined forward compiles to ONE program."""
+
+    def __init__(self, *args, teacher=None, temperature: float = 2.0, alpha: float = 0.5,
+                 beta: float = 0.0, **kwargs):
+        if teacher is None:
+            raise ValueError("DistillTrainer needs teacher=<PretrainedModel>")
+        super().__init__(*args, **kwargs)
+        self.teacher = teacher
+        self.temperature = temperature
+        self.alpha = alpha
+        self.beta = beta
+
+    def compute_loss(self, params, inputs: Dict, dropout_rng=None):
+        inputs = dict(inputs)
+        labels = inputs.pop("labels", None)
+        rngs = {"dropout": dropout_rng} if dropout_rng is not None else {}
+        student_out = self.model.module.apply(
+            {"params": params}, **inputs, deterministic=False, rngs=rngs,
+            output_hidden_states=self.beta > 0)
+        teacher_out = self.teacher.module.apply(
+            {"params": self.teacher.params}, **inputs, deterministic=True,
+            output_hidden_states=self.beta > 0)
+        kd = soft_cross_entropy(student_out.logits, jax.lax.stop_gradient(teacher_out.logits),
+                                self.temperature)
+        loss = (1.0 - self.alpha) * kd
+        if labels is not None and self.alpha > 0:
+            from ..trainer.trainer import causal_lm_loss
+
+            if student_out.logits.ndim == 2:  # classification head
+                logp = jax.nn.log_softmax(student_out.logits.astype(jnp.float32), -1)
+                ce = -jnp.take_along_axis(logp, labels[:, None], -1).mean()
+            else:
+                # same unshifted-labels convention as Trainer.compute_loss
+                shift = not getattr(self, "_labels_preshifted", False)
+                ce = causal_lm_loss(student_out.logits, labels, shift=shift)
+            loss = loss + self.alpha * ce
+        if self.beta > 0:
+            s_hs, t_hs = student_out.hidden_states, teacher_out.hidden_states
+            if s_hs is None or t_hs is None:
+                raise ValueError(
+                    "beta>0 needs models whose task modules surface hidden_states "
+                    "(use the base *Model/*ForMaskedLM classes, or set beta=0)")
+            s_h, t_h = s_hs[-1], t_hs[-1]
+            if s_h.shape[-1] == t_h.shape[-1]:
+                loss = loss + self.beta * hidden_mse_loss(s_h, jax.lax.stop_gradient(t_h))
+        return loss

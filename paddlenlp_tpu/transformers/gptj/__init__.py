@@ -1,0 +1,2 @@
+from .configuration import GPTJConfig  # noqa: F401
+from .modeling import GPTJForCausalLM, GPTJModel, GPTJPretrainedModel  # noqa: F401

@@ -1,0 +1,53 @@
+"""GPT-J configuration (reference: paddlenlp/transformers/gptj/configuration.py)."""
+
+from __future__ import annotations
+
+from ..configuration_utils import PretrainedConfig
+
+__all__ = ["GPTJConfig"]
+
+
+class GPTJConfig(PretrainedConfig):
+    model_type = "gptj"
+    attribute_map = {
+        "hidden_size": "n_embd",
+        "num_hidden_layers": "n_layer",
+        "num_attention_heads": "n_head",
+        "num_key_value_heads": "n_head",
+        "max_position_embeddings": "n_positions",
+        "hidden_act": "activation_function",
+    }
+
+    def __init__(
+        self,
+        vocab_size: int = 50400,
+        n_positions: int = 2048,
+        n_embd: int = 4096,
+        n_layer: int = 28,
+        n_head: int = 16,
+        n_inner=None,
+        rotary_dim: int = 64,
+        activation_function: str = "gelu_new",
+        layer_norm_epsilon: float = 1e-5,
+        initializer_range: float = 0.02,
+        resid_pdrop: float = 0.0,
+        attn_pdrop: float = 0.0,
+        **kwargs,
+    ):
+        self.vocab_size = vocab_size
+        self.n_positions = n_positions
+        self.n_embd = n_embd
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.n_inner = n_inner if n_inner is not None else 4 * n_embd
+        self.intermediate_size = self.n_inner
+        self.rotary_dim = rotary_dim
+        self.activation_function = activation_function
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.resid_pdrop = resid_pdrop
+        self.attn_pdrop = attn_pdrop
+        self.head_dim = n_embd // n_head
+        kwargs.setdefault("bos_token_id", 50256)
+        kwargs.setdefault("eos_token_id", 50256)
+        super().__init__(**kwargs)

@@ -1,0 +1,215 @@
+"""GPT-J, TPU-native (reference: paddlenlp/transformers/gptj/modeling.py).
+
+Decoder deltas vs the shared skeletons: PARALLEL residual with ONE layernorm
+(``h += attn(ln_1(h)) + mlp(ln_1(h))``), unbiased separate q/k/v/out
+projections, gelu_new MLP with biases, GPT-J-STYLE partial rotary — the first
+``rotary_dim`` dims of every head rotate as interleaved (x_{2i}, x_{2i+1})
+pairs (``ops/rope.py apply_rotary_partial_interleaved``), and an lm_head WITH
+bias. CodeGen (``codegen/``) is this network behind a fused-qkv key mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import apply_rotary_partial_interleaved
+from ...parallel.partition import P, shard_constraint
+from ..cache_utils import KVCache, update_layer_kv
+from ..llama.modeling import ACT2FN, VocabEmbed, _maybe_remat
+from ..model_outputs import BaseModelOutputWithPast, CausalLMOutputWithPast
+from ..model_utils import PretrainedModel
+from .configuration import GPTJConfig
+
+__all__ = ["GPTJModel", "GPTJForCausalLM", "GPTJPretrainedModel"]
+
+
+def _dense(feats, cfg, dtype, param_dtype, name, use_bias):
+    return nn.Dense(feats, use_bias=use_bias, dtype=dtype, param_dtype=param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+class GPTJBlock(nn.Module):
+    """Scan-compatible: carry = (h, offset, aux)."""
+
+    config: GPTJConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, layer_kv, attention_mask=None, position_ids=None,
+                 segment_ids=None, deterministic: bool = True):
+        cfg = self.config
+        h, offset, aux = carry
+        B, T, D = h.shape
+        n, hd = cfg.n_head, cfg.head_dim
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_1")(h)
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "attn_q_proj", False)(x).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "attn_k_proj", False)(x).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "attn_v_proj", False)(x).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", "act_seq_attn", "act_heads", None))
+        k = shard_constraint(k, P("batch", "act_seq_attn", "act_kv_heads", None))
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :] + (offset if layer_kv is not None else 0)
+        q, k = apply_rotary_partial_interleaved(q, k, position_ids, cfg.rotary_dim)
+        q_offset = 0
+        new_kv = None
+        if layer_kv is not None:
+            q_offset = offset
+            k, v = update_layer_kv(layer_kv[0], layer_kv[1], k, v, offset)
+            new_kv = (k, v)
+        drop = cfg.attn_pdrop if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        attn = dot_product_attention(
+            q, k, v, attention_mask=attention_mask, segment_ids=segment_ids, causal=True,
+            q_offset=q_offset, dropout_rate=drop, dropout_rng=rng,
+        ).reshape(B, T, D)
+        attn = _dense(D, cfg, self.dtype, self.param_dtype, "attn_out_proj", False)(attn)
+        ff = ACT2FN[cfg.activation_function](
+            _dense(cfg.n_inner, cfg, self.dtype, self.param_dtype, "mlp_fc_in", True)(x))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dense(D, cfg, self.dtype, self.param_dtype, "mlp_fc_out", True)(ff)
+        if not deterministic and cfg.resid_pdrop > 0:
+            attn = nn.Dropout(cfg.resid_pdrop)(attn, deterministic=False)
+            ff = nn.Dropout(cfg.resid_pdrop)(ff, deterministic=False)
+        h = h + attn + ff  # parallel residual, single ln
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        return (h, offset, aux), new_kv
+
+
+class GPTJModule(nn.Module):
+    config: GPTJConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache: Optional[KVCache] = None, inputs_embeds=None, deterministic: bool = True,
+                 output_hidden_states: bool = False, return_dict: bool = True):
+        cfg = self.config
+        if inputs_embeds is None:
+            inputs_embeds = VocabEmbed(cfg.vocab_size, cfg.n_embd, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="wte")(input_ids)
+        h = shard_constraint(inputs_embeds, P("batch", "act_seq", "act_embed"))
+        offset = cache.offset if cache is not None else jnp.zeros((), jnp.int32)
+        layer_cls = _maybe_remat(GPTJBlock, cfg)
+        all_hidden = [] if output_hidden_states else None
+        use_scan = getattr(cfg, "use_scan_layers", False) and not output_hidden_states
+        aux = jnp.zeros((), jnp.float32)
+        if use_scan:
+            scan_kv = (cache.keys, cache.values) if cache is not None else None
+            ScanStack = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(0 if cache is not None else nn.broadcast,) + (nn.broadcast,) * 4,
+                length=cfg.n_layer,
+            )
+            (h, _, aux), new_kv = ScanStack(cfg, self.dtype, self.param_dtype, name="h")(
+                (h, offset, aux), scan_kv, attention_mask, position_ids, segment_ids, deterministic
+            )
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=new_kv[0], values=new_kv[1], offset=offset + T)
+        else:
+            new_keys, new_values = [], []
+            for i in range(cfg.n_layer):
+                if output_hidden_states:
+                    all_hidden.append(h)
+                layer_kv = cache.layer(i) if cache is not None else None
+                (h, _, aux), kv_i = layer_cls(cfg, self.dtype, self.param_dtype, name=f"h_{i}")(
+                    (h, offset, aux), layer_kv, attention_mask, position_ids, segment_ids,
+                    deterministic
+                )
+                if kv_i is not None:
+                    new_keys.append(kv_i[0])
+                    new_values.append(kv_i[1])
+            if cache is not None:
+                T = input_ids.shape[1] if input_ids is not None else inputs_embeds.shape[1]
+                cache = KVCache(keys=jnp.stack(new_keys), values=jnp.stack(new_values),
+                                offset=offset + T)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(h)
+        if output_hidden_states:
+            all_hidden.append(h)
+        if not return_dict:
+            return (h, cache, all_hidden)
+        return BaseModelOutputWithPast(last_hidden_state=h, past_key_values=cache,
+                                       hidden_states=tuple(all_hidden) if all_hidden else None,
+                                       aux_loss=aux)
+
+
+class GPTJForCausalLMModule(nn.Module):
+    config: GPTJConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None, segment_ids=None,
+                 cache=None, inputs_embeds=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        outputs = GPTJModule(cfg, self.dtype, self.param_dtype, name="transformer")(
+            input_ids, attention_mask, position_ids, segment_ids, cache, inputs_embeds,
+            deterministic, output_hidden_states, True,
+        )
+        # GPT-J's lm_head carries a bias (HF GPTJForCausalLM)
+        logits = nn.Dense(cfg.vocab_size, use_bias=True, dtype=self.dtype,
+                          param_dtype=self.param_dtype,
+                          kernel_init=nn.initializers.normal(cfg.initializer_range),
+                          name="lm_head")(outputs.last_hidden_state)
+        logits = shard_constraint(logits, P("batch", "act_seq", "act_vocab"))
+        if not return_dict:
+            return (logits, outputs.past_key_values)
+        return CausalLMOutputWithPast(logits=logits, past_key_values=outputs.past_key_values,
+                                      hidden_states=outputs.hidden_states,
+                                      aux_loss=outputs.aux_loss)
+
+
+class GPTJPretrainedModel(PretrainedModel):
+    config_class = GPTJConfig
+    base_model_prefix = "transformer"
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"wte/embedding$", P("vocab", "embed")),
+            (r"attn_(q|k|v)_proj/kernel$", P("embed", "heads")),
+            (r"attn_out_proj/kernel$", P("heads", "embed")),
+            (r"mlp_fc_in/kernel$", P("embed", "mlp")),
+            (r"mlp_fc_in/bias$", P("mlp")),
+            (r"mlp_fc_out/kernel$", P("mlp", "embed")),
+            (r"lm_head/kernel$", P("embed", "vocab")),
+            (r"(ln_1|ln_f)/(scale|bias)$", P()),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StackedLayerMapping, auto_name_mappings
+
+        mappings = auto_name_mappings(flat_shapes)
+        for m in mappings:
+            src = m.source_name
+            src = src.replace("attn_q_proj", "attn.q_proj").replace("attn_k_proj", "attn.k_proj")
+            src = src.replace("attn_v_proj", "attn.v_proj").replace("attn_out_proj", "attn.out_proj")
+            src = src.replace("mlp_fc_in", "mlp.fc_in").replace("mlp_fc_out", "mlp.fc_out")
+            if isinstance(m, StackedLayerMapping):
+                m.source_template = src
+            else:
+                m.source_name = src
+        return mappings
+
+
+class GPTJModel(GPTJPretrainedModel):
+    module_class = GPTJModule
+
+
+class GPTJForCausalLM(GPTJPretrainedModel):
+    module_class = GPTJForCausalLMModule
+    _keys_to_ignore_on_load_unexpected = [r"attn\.masked_bias", r"attn\.bias"]

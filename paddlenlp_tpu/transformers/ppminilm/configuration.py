@@ -1,0 +1,14 @@
+"""PP-MiniLM configuration — BERT schema under MiniLM-6L defaults."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["PPMiniLMConfig"]
+
+
+class PPMiniLMConfig(BertConfig):
+    model_type = "ppminilm"
+
+    def __init__(self, vocab_size: int = 21128, num_hidden_layers: int = 6, **kwargs):
+        super().__init__(vocab_size=vocab_size, num_hidden_layers=num_hidden_layers, **kwargs)

@@ -1,0 +1,23 @@
+"""PP-MiniLM, TPU-native — the ERNIE/BERT network under MiniLM-6L-768H defaults
+(reference paddlenlp/transformers/ppminilm/modeling.py; the MiniLMv2 relation
+distillation that produces these checkpoints lives in
+``distill_utils.minilm_relation_loss``)."""
+
+from __future__ import annotations
+
+from ..bert.modeling import BertForSequenceClassification, BertModel, BertPretrainedModel
+from .configuration import PPMiniLMConfig
+
+__all__ = ["PPMiniLMConfig", "PPMiniLMModel", "PPMiniLMForSequenceClassification"]
+
+
+class PPMiniLMPretrainedModel(BertPretrainedModel):
+    config_class = PPMiniLMConfig
+
+
+class PPMiniLMModel(PPMiniLMPretrainedModel, BertModel):
+    pass
+
+
+class PPMiniLMForSequenceClassification(PPMiniLMPretrainedModel, BertForSequenceClassification):
+    pass

@@ -1,0 +1,7 @@
+from .configuration import RoFormerConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    RoFormerForMaskedLM,
+    RoFormerForSequenceClassification,
+    RoFormerModel,
+    RoFormerPretrainedModel,
+)

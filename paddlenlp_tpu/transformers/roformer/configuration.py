@@ -1,0 +1,18 @@
+"""RoFormer configuration (reference: paddlenlp/transformers/roformer/configuration.py)."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["RoFormerConfig"]
+
+
+class RoFormerConfig(BertConfig):
+    model_type = "roformer"
+
+    def __init__(self, vocab_size: int = 50000, embedding_size=None, rotary_value: bool = False,
+                 **kwargs):
+        self.rotary_value = rotary_value
+        kwargs.setdefault("max_position_embeddings", 1536)
+        super().__init__(vocab_size=vocab_size, **kwargs)
+        self.embedding_size = embedding_size or self.hidden_size

@@ -1,0 +1,188 @@
+"""RoFormer, TPU-native (reference: paddlenlp/transformers/roformer/modeling.py).
+
+BERT encoder whose attention applies ROTARY position embeddings to q/k
+(optionally v, ``rotary_value``) instead of learned absolute positions: the
+interleaved-pair rotation over the full head dim (``ops/rope.py
+apply_rotary_partial_interleaved`` — RoFormer's sin/cos table is exactly the
+standard rotary frequencies). Embeddings are word + token_type only; the
+HF ``encoder.embed_positions.weight`` sinusoid buffer is recomputed, not loaded.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...ops.rope import apply_rotary_partial_interleaved
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, VocabEmbed, _dense
+from ..llama.modeling import tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import RoFormerConfig
+
+__all__ = ["RoFormerModel", "RoFormerForMaskedLM", "RoFormerForSequenceClassification",
+           "RoFormerPretrainedModel"]
+
+
+class RoFormerLayer(nn.Module):
+    config: RoFormerConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_query")(h).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_key")(h).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_value")(h).reshape(B, T, n, hd)
+        pos = jnp.arange(T)[None, :]
+        q, k = apply_rotary_partial_interleaved(q, k, pos, hd)
+        if cfg.rotary_value:
+            v, _ = apply_rotary_partial_interleaved(v, v, pos, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        drop = cfg.attention_probs_dropout_prob if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask, causal=False,
+                                     dropout_rate=drop, dropout_rng=rng).reshape(B, T, D)
+        attn = _dense(D, cfg, self.dtype, self.param_dtype, "attention_output_dense")(attn)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="attention_output_LayerNorm")(h + attn)
+        ff = ACT2FN[cfg.hidden_act](_dense(cfg.intermediate_size, cfg, self.dtype,
+                                           self.param_dtype, "intermediate_dense")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _dense(D, cfg, self.dtype, self.param_dtype, "output_dense")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="output_LayerNorm")(h + ff)
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class RoFormerModule(nn.Module):
+    config: RoFormerConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        init = nn.initializers.normal(cfg.initializer_range)
+        E = cfg.embedding_size
+        h = VocabEmbed(cfg.vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, E, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        if E != cfg.hidden_size:
+            # HF RoFormer inserts embeddings_project when embedding_size differs
+            h = nn.Dense(cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_project")(h)
+        for i in range(cfg.num_hidden_layers):
+            h = RoFormerLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class RoFormerForMaskedLMModule(nn.Module):
+    config: RoFormerConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = RoFormerModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                           name="roformer")(input_ids, attention_mask, token_type_ids,
+                                            deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "roformer")["embeddings_word_embeddings"]["embedding"]
+        # the transform projects into EMBEDDING space (HF RoFormerLMPredictionHead:
+        # dense hidden->embedding_size, then the tied [V, E] decoder)
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.embedding_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class RoFormerForSequenceClassificationModule(nn.Module):
+    config: RoFormerConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = RoFormerModule(cfg, self.dtype, self.param_dtype, name="roformer")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class RoFormerPretrainedModel(PretrainedModel):
+    config_class = RoFormerConfig
+    base_model_prefix = "roformer"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..bert.modeling import BertPretrainedModel
+
+        return BertPretrainedModel.get_partition_rules(config)
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..bert.modeling import BertPretrainedModel
+
+        import re as _re
+
+        mappings = BertPretrainedModel._get_name_mappings(config, flat_shapes)
+        for m in mappings:
+            # embeddings_word_embeddings -> embeddings.word_embeddings, but
+            # embeddings_project stays a single module name in HF keys
+            m.source_name = _re.sub(r"embeddings_(?!project)", "embeddings.", m.source_name)
+        return mappings
+
+
+class RoFormerModel(RoFormerPretrainedModel):
+    module_class = RoFormerModule
+    _keys_to_ignore_on_load_unexpected = [r"embed_positions\.weight"]
+
+
+class RoFormerForMaskedLM(RoFormerPretrainedModel):
+    module_class = RoFormerForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"embed_positions\.weight", r"cls\.predictions\.decoder"]
+
+
+class RoFormerForSequenceClassification(RoFormerPretrainedModel):
+    module_class = RoFormerForSequenceClassificationModule
+    _keys_to_ignore_on_load_unexpected = [r"embed_positions\.weight"]

@@ -1,0 +1,5 @@
+from .modeling import (  # noqa: F401
+    TinyBertConfig,
+    TinyBertForSequenceClassification,
+    TinyBertModel,
+)

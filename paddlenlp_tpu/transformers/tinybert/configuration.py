@@ -1,0 +1,17 @@
+"""TinyBERT configuration — BERT schema under tinybert defaults."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["TinyBertConfig"]
+
+
+class TinyBertConfig(BertConfig):
+    model_type = "tinybert"
+
+    def __init__(self, hidden_size: int = 312, num_hidden_layers: int = 4,
+                 num_attention_heads: int = 12, intermediate_size: int = 1200, **kwargs):
+        super().__init__(hidden_size=hidden_size, num_hidden_layers=num_hidden_layers,
+                         num_attention_heads=num_attention_heads,
+                         intermediate_size=intermediate_size, **kwargs)

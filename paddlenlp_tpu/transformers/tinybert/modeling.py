@@ -1,0 +1,23 @@
+"""TinyBERT, TPU-native — the BERT network under tinybert defaults
+(reference paddlenlp/transformers/tinybert/modeling.py is a BERT clone trained
+by distillation; ``distill_utils.DistillTrainer`` + ``hidden_mse_loss`` cover
+the training recipe; same one-network collapse as mistral-on-llama)."""
+
+from __future__ import annotations
+
+from ..bert.modeling import BertForSequenceClassification, BertModel, BertPretrainedModel
+from .configuration import TinyBertConfig
+
+__all__ = ["TinyBertConfig", "TinyBertModel", "TinyBertForSequenceClassification"]
+
+
+class TinyBertPretrainedModel(BertPretrainedModel):
+    config_class = TinyBertConfig
+
+
+class TinyBertModel(TinyBertPretrainedModel, BertModel):
+    pass
+
+
+class TinyBertForSequenceClassification(TinyBertPretrainedModel, BertForSequenceClassification):
+    pass

@@ -1,0 +1,122 @@
+"""Decoder/encoder wave 3: gptj (parallel residual + partial interleaved
+rotary), codegen (fused mp_num=4 qkv mapping), roformer (rotary encoder),
+tinybert/ppminilm re-exports — HF-torch parity where HF ships the family."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddlenlp_tpu.transformers import (
+    CodeGenConfig,
+    CodeGenForCausalLM,
+    GPTJConfig,
+    GPTJForCausalLM,
+    PPMiniLMConfig,
+    PPMiniLMModel,
+    RoFormerConfig,
+    RoFormerForMaskedLM,
+    TinyBertConfig,
+    TinyBertForSequenceClassification,
+)
+
+IDS = np.asarray([[2, 5, 6, 7, 8, 3]], np.int64)
+
+
+class TestGPTJ:
+    def cfg(self, **kw):
+        return GPTJConfig(vocab_size=64, n_embd=32, n_layer=2, n_head=4, rotary_dim=4,
+                          n_positions=64, resid_pdrop=0.0, attn_pdrop=0.0, **kw)
+
+    @pytest.mark.parametrize("scan", [False, True])
+    def test_forward_and_cache_parity(self, scan):
+        model = GPTJForCausalLM.from_config(self.cfg(use_scan_layers=scan), seed=0)
+        ids = jnp.asarray(IDS, jnp.int32)
+        out = model(input_ids=ids)
+        assert out.logits.shape == (1, 6, 64)
+        gen, _ = model.generate(ids, max_new_tokens=4, do_sample=False, eos_token_id=63)
+        # cached decode == teacher-forced argmax
+        dec = np.asarray(IDS, np.int64)
+        for _ in range(4):
+            logits = model(input_ids=jnp.asarray(dec, jnp.int32)).logits
+            dec = np.concatenate([dec, [[int(jnp.argmax(logits[0, -1]))]]], axis=1)
+        np.testing.assert_array_equal(np.asarray(gen)[0], dec[0, 6:])
+
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import GPTJConfig as HFC, GPTJForCausalLM as HFM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=64, n_embd=32, n_layer=2, n_head=4, rotary_dim=4,
+                     n_positions=64, resid_pdrop=0.0, attn_pdrop=0.0, embd_pdrop=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS)).logits.numpy()
+        model = GPTJForCausalLM.from_pretrained(str(tmp_path))
+        mine = model(input_ids=jnp.asarray(IDS, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+
+class TestCodeGen:
+    def test_torch_parity_fused_qkv(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import CodeGenConfig as HFC, CodeGenForCausalLM as HFM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=64, n_embd=32, n_layer=2, n_head=4, rotary_dim=4,
+                     n_positions=64, resid_pdrop=0.0, attn_pdrop=0.0, embd_pdrop=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS)).logits.numpy()
+        model = CodeGenForCausalLM.from_pretrained(str(tmp_path))
+        mine = model(input_ids=jnp.asarray(IDS, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_own_checkpoint_roundtrip(self, tmp_path):
+        model = CodeGenForCausalLM.from_config(
+            CodeGenConfig(vocab_size=64, n_embd=32, n_layer=1, n_head=4, rotary_dim=4,
+                          n_positions=64), seed=0)
+        ids = jnp.asarray(IDS, jnp.int32)
+        ref = model(input_ids=ids).logits
+        model.save_pretrained(str(tmp_path))
+        reloaded = CodeGenForCausalLM.from_pretrained(str(tmp_path))
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(reloaded(input_ids=ids).logits),
+                                   atol=1e-5)
+
+
+class TestRoFormer:
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import RoFormerConfig as HFC, RoFormerForMaskedLM as HFM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=64, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=37, max_position_embeddings=64, embedding_size=32,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                     rotary_value=False)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        mask = np.ones_like(IDS)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS),
+                        attention_mask=torch.tensor(mask)).logits.numpy()
+        model = RoFormerForMaskedLM.from_pretrained(str(tmp_path))
+        mine = model(input_ids=jnp.asarray(IDS, jnp.int32),
+                     attention_mask=jnp.asarray(mask, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+
+class TestDistilledReExports:
+    def test_tinybert_and_ppminilm(self, tmp_path):
+        m = TinyBertForSequenceClassification.from_config(
+            TinyBertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                           num_attention_heads=2, intermediate_size=48, num_labels=3), seed=0)
+        out = m(input_ids=jnp.asarray(IDS, jnp.int32))
+        assert out.logits.shape == (1, 3)
+        m.save_pretrained(str(tmp_path))
+        from paddlenlp_tpu.transformers.auto import AutoModel
+
+        auto = AutoModel.from_pretrained(str(tmp_path))
+        assert type(auto).__name__ == "TinyBertModel"
+        p = PPMiniLMModel.from_config(PPMiniLMConfig(vocab_size=64, hidden_size=32,
+                                                     num_attention_heads=2, intermediate_size=48), seed=0)
+        assert p.config.num_hidden_layers == 6

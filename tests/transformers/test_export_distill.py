@@ -1,0 +1,69 @@
+"""Static export (StableHLO via jax.export) + distillation utilities
+(reference transformers/export.py + distill_utils.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddlenlp_tpu.transformers import BertConfig, BertForSequenceClassification, LlamaConfig, LlamaForCausalLM
+
+
+class TestExport:
+    def test_export_import_roundtrip(self, tmp_path):
+        from paddlenlp_tpu.transformers.export import export_model, import_model
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=2, max_position_embeddings=64)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        export_model(model, str(tmp_path), batch_size=1, seq_length=8)
+        assert (tmp_path / "model.stablehlo").exists()
+        fn, config = import_model(str(tmp_path))
+        ids = jnp.asarray(np.arange(8)[None] % 60 + 2, jnp.int32)
+        got = np.asarray(fn(ids))
+        want = np.asarray(model(input_ids=ids).logits)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        assert config["input_names"] == ["input_ids"]
+
+
+class TestDistill:
+    def _pair(self):
+        mk = lambda h, L, seed: BertForSequenceClassification.from_config(
+            BertConfig(vocab_size=64, hidden_size=h, num_hidden_layers=L, num_attention_heads=2,
+                       intermediate_size=2 * h, max_position_embeddings=32, num_labels=2,
+                       hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0), seed=seed)
+        return mk(32, 1, 0), mk(32, 2, 1)  # student, teacher
+
+    def test_losses_zero_when_identical(self):
+        from paddlenlp_tpu.transformers.distill_utils import (
+            hidden_mse_loss, kl_div_loss, soft_cross_entropy)
+
+        logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)), jnp.float32)
+        assert float(kl_div_loss(logits, logits)) < 1e-6
+        assert float(hidden_mse_loss(logits, logits)) < 1e-9
+        # soft CE at identical logits equals the teacher's entropy (not 0)
+        assert float(soft_cross_entropy(logits, logits)) > 0
+
+    def test_minilm_relation_loss_shapes(self):
+        from paddlenlp_tpu.transformers.distill_utils import minilm_relation_loss
+
+        rng = np.random.default_rng(0)
+        s = jnp.asarray(rng.standard_normal((2, 6, 32)), jnp.float32)
+        t = jnp.asarray(rng.standard_normal((2, 6, 48)), jnp.float32)
+        loss = minilm_relation_loss(s, t, num_relation_heads=4)
+        assert np.isfinite(float(loss))
+        assert float(minilm_relation_loss(s, s, num_relation_heads=4)) < 1e-6
+
+    def test_distill_trainer_loss_decreases(self, tmp_path):
+        from paddlenlp_tpu.transformers.distill_utils import DistillTrainer
+        from paddlenlp_tpu.trainer import TrainingArguments
+
+        student, teacher = self._pair()
+        data = [{"input_ids": np.asarray([2, 5, 6, 7], np.int32),
+                 "labels": np.asarray(1, np.int32)} for _ in range(16)]
+        args = TrainingArguments(output_dir=str(tmp_path), per_device_train_batch_size=1,
+                                 learning_rate=1e-3, num_train_epochs=2, logging_steps=100)
+        trainer = DistillTrainer(model=student, args=args, train_dataset=data,
+                                 teacher=teacher, alpha=0.5, temperature=2.0)
+        result = trainer.train()
+        assert np.isfinite(result.training_loss)
