@@ -1,3 +1,5 @@
-from .word import WordDelete, WordInsert, WordSubstitute, WordSwap
+from .char import CharDelete, CharInsert, CharSubstitute, CharSwap  # noqa: F401
+from .word import WordDelete, WordInsert, WordSubstitute, WordSwap  # noqa: F401
 
-__all__ = ["WordSubstitute", "WordInsert", "WordSwap", "WordDelete"]
+__all__ = ["WordSubstitute", "WordInsert", "WordSwap", "WordDelete",
+           "CharSubstitute", "CharInsert", "CharSwap", "CharDelete"]

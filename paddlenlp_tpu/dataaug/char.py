@@ -1,0 +1,55 @@
+"""Character-level data augmentation.
+
+Counterpart of ``paddlenlp/dataaug/char.py`` (``CharSubstitute``, ``CharInsert``,
+``CharSwap``, ``CharDelete`` — ~2k LoC of download-backed variants). Character
+units (not whitespace words), so it works on Chinese text; substitution and
+insertion draw from a user-supplied homophone/confusion table, swap/delete are
+source-free. Deterministic under ``seed``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .word import BaseAugment, WordInsert, WordSubstitute
+
+__all__ = ["CharSubstitute", "CharInsert", "CharSwap", "CharDelete"]
+
+
+class _CharTokenizeMixin:
+    _joiner = ""  # char units re-join without spaces
+
+    def _tokenize(self, text: str) -> List[str]:
+        return list(text)
+
+
+class CharSubstitute(_CharTokenizeMixin, WordSubstitute):
+    """Replace characters using a confusion table {"char": ["variant", ...]}."""
+
+
+class CharInsert(_CharTokenizeMixin, WordInsert):
+    """Insert a table variant next to a known character."""
+
+
+class CharSwap(_CharTokenizeMixin, BaseAugment):
+    """Swap adjacent characters."""
+
+    def _augment_once(self, chars):
+        if len(chars) < 2:
+            return None
+        n = self._n_for(chars)
+        for _ in range(n):
+            i = int(self.rng.integers(0, len(chars) - 1))
+            chars[i], chars[i + 1] = chars[i + 1], chars[i]
+        return chars
+
+
+class CharDelete(_CharTokenizeMixin, BaseAugment):
+    """Delete random characters."""
+
+    def _augment_once(self, chars):
+        if len(chars) < 2:
+            return None
+        n = min(self._n_for(chars), len(chars) - 1)
+        drop = set(self.rng.choice(len(chars), size=n, replace=False).tolist())
+        return [c for i, c in enumerate(chars) if i not in drop]

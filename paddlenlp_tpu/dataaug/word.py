@@ -17,6 +17,8 @@ __all__ = ["WordSubstitute", "WordInsert", "WordSwap", "WordDelete"]
 
 
 class BaseAugment:
+    _joiner = " "  # char-level augmenters re-join without separators
+
     def __init__(self, create_n: int = 1, aug_n: Optional[int] = None,
                  aug_percent: float = 0.1, seed: int = 0):
         self.create_n = create_n
@@ -46,7 +48,7 @@ class BaseAugment:
                 break
             aug = self._augment_once(list(tokens))
             if aug is not None:
-                cand = " ".join(aug)
+                cand = self._joiner.join(aug)
                 if cand != text and cand not in out:
                     out.append(cand)
         return out

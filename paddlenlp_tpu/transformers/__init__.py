@@ -139,6 +139,10 @@ from .ernie_vil import (  # noqa: F401
     ErnieViLConfig,
     ErnieViLModel,
 )
+from .minigpt4 import (  # noqa: F401
+    MiniGPT4Config,
+    MiniGPT4ForConditionalGeneration,
+)
 from .distilbert import (  # noqa: F401
     DistilBertConfig,
     DistilBertForMaskedLM,

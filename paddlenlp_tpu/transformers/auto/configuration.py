@@ -59,6 +59,7 @@ def _populate():
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
     from ..ernie_vil.configuration import ErnieViLConfig
+    from ..minigpt4.configuration import MiniGPT4Config
 
     for cfg in (LlamaConfig, GPTConfig, Qwen2Config, MistralConfig, GemmaConfig, BertConfig,
                 ErnieConfig, MixtralConfig, Qwen2MoeConfig, BaichuanConfig, BloomConfig,
@@ -68,7 +69,8 @@ def _populate():
                 MT5Config, MBartConfig, PegasusConfig,
                 CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
-                GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig):
+                GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
+                MiniGPT4Config):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -154,6 +154,9 @@ def _populate_models():
     from ..ernie_vil import modeling as ernie_vil
 
     register_model("ernie_vil", "base", ernie_vil.ErnieViLModel)
+    from ..minigpt4 import modeling as minigpt4
+
+    register_model("minigpt4", "base", minigpt4.MiniGPT4ForConditionalGeneration)
     from ..distilbert import modeling as distilbert
 
     register_model("distilbert", "base", distilbert.DistilBertModel)

@@ -45,6 +45,57 @@ __all__ = [
 ]
 
 
+def caption_decode_loop(model, params, prefix, input_ids, cfg, *, logits_fn,
+                        max_new_tokens: int = 20, do_sample: bool = False,
+                        temperature: float = 1.0, top_k: int = 0, seed: int = 0,
+                        cache_key: str = "caption"):
+    """Prefix-conditioned fixed-buffer decode shared by BLIP captioning and
+    MiniGPT-4: ONE cached jitted step per (sampling-mode, buffer shape) —
+    params/prefix are traced arguments, so repeated calls don't recompile.
+    ``logits_fn(params, prefix, buf) -> [B, L, V]`` supplies the model forward;
+    eos rows continue as pad."""
+    B = prefix.shape[0]
+    if input_ids is None:
+        bos = cfg.bos_token_id if cfg.bos_token_id is not None else 0
+        input_ids = jnp.full((B, 1), bos, jnp.int32)
+    P0 = input_ids.shape[1]
+    L = P0 + max_new_tokens
+    buf = jnp.zeros((B, L), jnp.int32).at[:, :P0].set(input_ids)
+    key_ = (cache_key, do_sample, top_k)
+    if key_ not in model._jit_cache:
+        def step(p, prefix, buf, t, temp, key):
+            logits = logits_fn(p, prefix, buf)
+            row = jnp.take_along_axis(logits, (t - 1)[None, None, None].astype(jnp.int32),
+                                      axis=1)[:, 0]
+            if do_sample:
+                row = row / jnp.maximum(temp, 1e-6)
+                if top_k:
+                    kth = jnp.sort(row, axis=-1)[:, -top_k][:, None]
+                    row = jnp.where(row < kth, -1e30, row)
+                nxt = jax.random.categorical(key, row)
+            else:
+                nxt = jnp.argmax(row, axis=-1)
+            return buf.at[:, t].set(nxt.astype(jnp.int32))
+
+        model._jit_cache[key_] = jax.jit(step)
+    step = model._jit_cache[key_]
+    key = jax.random.key(seed)
+    finished = np.zeros((B,), bool)
+    pad = cfg.pad_token_id if cfg.pad_token_id is not None else 0
+    temp = jnp.asarray(temperature, jnp.float32)
+    for t in range(P0, L):
+        key, sub = jax.random.split(key)
+        new_buf = step(params, prefix, buf, jnp.asarray(t), temp, sub)
+        tok = np.asarray(new_buf[:, t])
+        tok = np.where(finished, pad, tok)
+        buf = buf.at[:, t].set(jnp.asarray(tok))
+        if cfg.eos_token_id is not None:
+            finished = finished | (tok == cfg.eos_token_id)
+        if finished.all():
+            break
+    return buf[:, P0:]
+
+
 class BlipVisionEmbeddings(nn.Module):
     config: BlipVisionConfig
     dtype: jnp.dtype = jnp.float32
@@ -387,59 +438,24 @@ class BlipForConditionalGeneration(BlipPretrainedModel):
     module_class = BlipForConditionalGenerationModule
     main_input_name = "pixel_values"
 
-    def _caption_step(self, do_sample: bool, top_k: int):
-        """One jitted decode step, cached across generate() calls (params and
-        image_embeds are traced ARGUMENTS, not baked-in constants, so repeated
-        captioning pays compilation once per (buffer-shape, sampling-mode))."""
-        key_ = ("caption_step", do_sample, top_k)
-        if key_ not in self._jit_cache:
-            def step(params, image_embeds, buf, t, temperature, key):
-                out = self.module.apply({"params": params}, buf, image_embeds,
-                                        method=self.module.decode)
-                logits = jnp.take_along_axis(out.logits, (t - 1)[None, None, None].astype(jnp.int32),
-                                             axis=1)[:, 0]
-                if do_sample:
-                    logits = logits / jnp.maximum(temperature, 1e-6)
-                    if top_k:
-                        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                        logits = jnp.where(logits < kth, -1e30, logits)
-                    nxt = jax.random.categorical(key, logits)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                return buf.at[:, t].set(nxt.astype(jnp.int32))
-
-            self._jit_cache[key_] = jax.jit(step)
-        return self._jit_cache[key_]
-
     def generate(self, pixel_values, input_ids=None, max_new_tokens: int = 20,
                  do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
                  seed: int = 0, params=None):
-        """Caption decode over a fixed-size buffer: one cached jitted step, full
-        causal forward per step (cheap at caption lengths, zero retraces)."""
+        """Caption decode over a fixed-size buffer: the shared
+        ``caption_decode_loop`` with the image sequence as prefix."""
         params = params if params is not None else self.params
-        cfg = self.config.text_config
-        B = pixel_values.shape[0]
-        if input_ids is None:
-            input_ids = jnp.full((B, 1), cfg.bos_token_id, jnp.int32)
-        P0 = input_ids.shape[1]
-        L = P0 + max_new_tokens
-        buf = jnp.zeros((B, L), jnp.int32).at[:, :P0].set(input_ids)
         image_embeds = self.module.apply({"params": params}, pixel_values,
                                          method=self.module.encode_image)
-        step = self._caption_step(do_sample, top_k)
-        key = jax.random.key(seed)
-        finished = jnp.zeros((B,), bool)
-        temp = jnp.asarray(temperature, jnp.float32)
-        for t in range(P0, L):
-            key, sub = jax.random.split(key)
-            new_buf = step(params, image_embeds, buf, jnp.asarray(t), temp, sub)
-            # keep pad after eos
-            tok = jnp.where(finished, cfg.pad_token_id, new_buf[:, t])
-            buf = buf.at[:, t].set(tok)
-            finished = finished | (tok == cfg.eos_token_id)
-            if bool(finished.all()):
-                break
-        return buf[:, P0:]
+
+        def logits_fn(p, prefix, buf):
+            return self.module.apply({"params": p}, buf, prefix,
+                                     method=self.module.decode).logits
+
+        return caption_decode_loop(self, params, image_embeds, input_ids,
+                                   self.config.text_config, logits_fn=logits_fn,
+                                   max_new_tokens=max_new_tokens, do_sample=do_sample,
+                                   temperature=temperature, top_k=top_k, seed=seed,
+                                   cache_key="blip_caption")
 
 
 class BlipForImageTextRetrieval(BlipPretrainedModel):

@@ -59,8 +59,9 @@ def minilm_relation_loss(student_states, teacher_states, num_relation_heads: int
 
     n = num_relation_heads or 1
     s = jax.nn.log_softmax(relations(student_states, n), axis=-1)
-    t = jax.nn.softmax(relations(teacher_states, n), axis=-1)
-    t_log = jax.nn.log_softmax(relations(teacher_states, n), axis=-1)
+    rel_t = relations(teacher_states, n)  # built once — the dominant cost
+    t = jax.nn.softmax(rel_t, axis=-1)
+    t_log = jax.nn.log_softmax(rel_t, axis=-1)
     return (t * (t_log - s)).sum(-1).mean()
 
 
@@ -110,6 +111,10 @@ class DistillTrainer(Trainer):
                     "beta>0 needs models whose task modules surface hidden_states "
                     "(use the base *Model/*ForMaskedLM classes, or set beta=0)")
             s_h, t_h = s_hs[-1], t_hs[-1]
-            if s_h.shape[-1] == t_h.shape[-1]:
-                loss = loss + self.beta * hidden_mse_loss(s_h, jax.lax.stop_gradient(t_h))
+            if s_h.shape[-1] != t_h.shape[-1]:
+                raise ValueError(
+                    f"beta>0 with student width {s_h.shape[-1]} != teacher width "
+                    f"{t_h.shape[-1]}: add a projection to the student (TinyBERT fit_dense) "
+                    "or use minilm_relation_loss, which is width-agnostic")
+            loss = loss + self.beta * hidden_mse_loss(s_h, jax.lax.stop_gradient(t_h))
         return loss

@@ -1,0 +1,6 @@
+from .configuration import (  # noqa: F401
+    MiniGPT4Config,
+    MiniGPT4QFormerConfig,
+    MiniGPT4VisionConfig,
+)
+from .modeling import MiniGPT4ForConditionalGeneration, MiniGPT4PretrainedModel  # noqa: F401

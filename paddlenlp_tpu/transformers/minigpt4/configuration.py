@@ -1,0 +1,63 @@
+"""MiniGPT-4 configuration (reference: paddlenlp/transformers/minigpt4/configuration.py).
+
+Three-stage vision-language pipeline: BLIP ViT vision tower -> Q-Former (a
+BERT-with-cross-attention over learned query tokens) -> linear projection into
+the language model's embedding space -> llama decoder.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ..blip.configuration import BlipTextConfig, BlipVisionConfig
+from ..configuration_utils import PretrainedConfig
+from ..llama.configuration import LlamaConfig
+
+__all__ = ["MiniGPT4Config", "MiniGPT4QFormerConfig", "MiniGPT4VisionConfig"]
+
+
+class MiniGPT4VisionConfig(BlipVisionConfig):
+    model_type = "minigpt4_vision_model"
+
+
+class MiniGPT4QFormerConfig(BlipTextConfig):
+    model_type = "minigpt4_qformer"
+
+    def __init__(self, num_query_tokens: int = 32, cross_attention_frequency: int = 1, **kwargs):
+        self.num_query_tokens = num_query_tokens
+        self.cross_attention_frequency = cross_attention_frequency
+        super().__init__(**kwargs)
+
+
+class MiniGPT4Config(PretrainedConfig):
+    model_type = "minigpt4"
+
+    def __init__(
+        self,
+        vision_config: Optional[Dict[str, Any]] = None,
+        qformer_config: Optional[Dict[str, Any]] = None,
+        text_config: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        if isinstance(vision_config, PretrainedConfig):
+            vision_config = vision_config.to_dict()
+        if isinstance(qformer_config, PretrainedConfig):
+            qformer_config = qformer_config.to_dict()
+        if isinstance(text_config, PretrainedConfig):
+            text_config = text_config.to_dict()
+        self.vision_config = MiniGPT4VisionConfig(**(vision_config or {}))
+        qf = dict(qformer_config or {})
+        qf.setdefault("encoder_hidden_size", self.vision_config.hidden_size)
+        self.qformer_config = MiniGPT4QFormerConfig(**qf)
+        self.text_config = LlamaConfig(**(text_config or {}))
+        super().__init__(**kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = copy.deepcopy({k: v for k, v in self.__dict__.items()
+                             if k not in ("vision_config", "qformer_config", "text_config")})
+        out["model_type"] = self.model_type
+        out["vision_config"] = self.vision_config.to_dict()
+        out["qformer_config"] = self.qformer_config.to_dict()
+        out["text_config"] = self.text_config.to_dict()
+        return out

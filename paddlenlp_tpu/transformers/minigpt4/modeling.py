@@ -1,0 +1,143 @@
+"""MiniGPT-4, TPU-native (reference: paddlenlp/transformers/minigpt4/modeling.py, 1900 LoC).
+
+BLIP-2-family architecture: frozen BLIP ViT -> Q-Former (learned query tokens
+attending to the image through the SAME BlipTextLayer blocks blip's decoder
+uses) -> ``language_projection`` into llama embedding space -> llama decodes
+with the projected queries as a soft prompt. Caption generation runs the
+fixed-buffer recompute loop (see blip/modeling.py) with the visual prefix
+supplied as ``inputs_embeds``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..blip.modeling import BlipTextLayer, BlipVisionTransformer
+from ..model_outputs import CausalLMOutput
+from ..model_utils import PretrainedModel
+from .configuration import MiniGPT4Config
+
+__all__ = ["MiniGPT4ForConditionalGeneration", "MiniGPT4PretrainedModel"]
+
+
+class MiniGPT4QFormer(nn.Module):
+    """Learned query tokens + BlipTextLayers with cross-attention into the
+    image sequence (reference MiniGPT4QFormerModel)."""
+
+    config: object  # MiniGPT4QFormerConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, image_embeds, deterministic: bool = True):
+        cfg = self.config
+        B = image_embeds.shape[0]
+        queries = self.param("query_tokens", nn.initializers.normal(cfg.initializer_range),
+                             (1, cfg.num_query_tokens, cfg.hidden_size), self.param_dtype)
+        h = jnp.broadcast_to(queries.astype(self.dtype), (B,) + queries.shape[1:])
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="layernorm")(h)
+        for i in range(cfg.num_hidden_layers):
+            cross = image_embeds if i % cfg.cross_attention_frequency == 0 else None
+            h = BlipTextLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, None, cross, False, deterministic)
+        return h
+
+
+class MiniGPT4Module(nn.Module):
+    config: MiniGPT4Config
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.config
+        from ..llama.modeling import LlamaForCausalLMModule
+
+        self.vision_model = BlipVisionTransformer(cfg.vision_config, self.dtype, self.param_dtype)
+        self.qformer = MiniGPT4QFormer(cfg.qformer_config, self.dtype, self.param_dtype)
+        self.language_projection = nn.Dense(cfg.text_config.hidden_size, dtype=self.dtype,
+                                            param_dtype=self.param_dtype)
+        self.language_model = LlamaForCausalLMModule(cfg.text_config, self.dtype, self.param_dtype)
+
+    def encode_image(self, pixel_values, deterministic: bool = True):
+        """pixel_values -> [B, num_query_tokens, llm_hidden] soft prompt."""
+        image_embeds = self.vision_model(pixel_values, deterministic=deterministic).last_hidden_state
+        q = self.qformer(image_embeds, deterministic=deterministic)
+        return self.language_projection(q)
+
+    def decode(self, prefix_embeds, input_ids, deterministic: bool = True):
+        """LLM forward over [visual prefix ; embedded text]; returns logits for
+        the TEXT positions only."""
+        if self.is_initializing():
+            # materialize the language model's params (incl. embed_tokens, which
+            # the inputs_embeds path below would never create) before reading
+            # its embedding table
+            self.language_model(input_ids=input_ids, deterministic=True)
+        table = self.get_variable("params", "language_model")["model"]["embed_tokens"]["embedding"]
+        text_embeds = jnp.take(table, input_ids, axis=0).astype(self.dtype)
+        embeds = jnp.concatenate([prefix_embeds, text_embeds], axis=1)
+        out = self.language_model(inputs_embeds=embeds, deterministic=deterministic)
+        return out.logits[:, prefix_embeds.shape[1]:]
+
+    def __call__(self, pixel_values=None, input_ids=None, labels=None,
+                 deterministic: bool = True, return_dict: bool = True):
+        prefix = self.encode_image(pixel_values, deterministic)
+        logits = self.decode(prefix, input_ids, deterministic)
+        if labels is not None:
+            shifted = logits[:, :-1]
+            targets = labels[:, 1:]
+            valid = targets != -100
+            logp = jax.nn.log_softmax(shifted.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+            loss = (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+            return CausalLMOutput(logits=logits), loss
+        return CausalLMOutput(logits=logits)
+
+
+class MiniGPT4PretrainedModel(PretrainedModel):
+    config_class = MiniGPT4Config
+    base_model_prefix = "minigpt4"
+    main_input_name = "pixel_values"
+
+    def dummy_inputs(self):
+        v = self.config.vision_config
+        return {"input_ids": jnp.zeros((1, 4), dtype=jnp.int32),
+                "pixel_values": jnp.zeros((1, v.image_size, v.image_size, 3), dtype=jnp.float32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..blip.modeling import BlipPretrainedModel
+        from ..llama.modeling import LlamaPretrainedModel
+
+        return (LlamaPretrainedModel.get_partition_rules(
+                    config.text_config if config is not None else None)
+                + BlipPretrainedModel.get_partition_rules(config))
+
+
+class MiniGPT4ForConditionalGeneration(MiniGPT4PretrainedModel):
+    module_class = MiniGPT4Module
+
+    def generate(self, pixel_values, input_ids=None, max_new_tokens: int = 20,
+                 do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
+                 seed: int = 0, params=None):
+        """Shared prefix-conditioned decode loop with the projected query
+        tokens as the soft prompt."""
+        from ..blip.modeling import caption_decode_loop
+
+        params = params if params is not None else self.params
+        prefix = self.module.apply({"params": params}, pixel_values,
+                                   method=self.module.encode_image)
+
+        def logits_fn(p, prefix, buf):
+            return self.module.apply({"params": p}, prefix, buf, method=self.module.decode)
+
+        return caption_decode_loop(self, params, prefix, input_ids,
+                                   self.config.text_config, logits_fn=logits_fn,
+                                   max_new_tokens=max_new_tokens, do_sample=do_sample,
+                                   temperature=temperature, top_k=top_k, seed=seed,
+                                   cache_key="minigpt4_caption")

@@ -247,3 +247,36 @@ class TestAutoNLP:
         import os
 
         assert os.path.isfile(os.path.join(export, "model.safetensors"))
+
+
+class TestCharDataAug:
+    def test_char_substitute_and_insert(self):
+        from paddlenlp_tpu.dataaug import CharInsert, CharSubstitute
+
+        table = {"好": ["佳", "良"], "天": ["日"]}
+        subst = CharSubstitute(custom_file_or_dict=table, create_n=2, aug_n=1, seed=0)
+        outs = subst("今天天气好")
+        assert outs and all(o != "今天天气好" for o in outs)
+        assert all(len(o) == 5 for o in outs)  # substitution preserves length
+        ins = CharInsert(custom_file_or_dict=table, create_n=1, aug_n=1, seed=0)
+        outs = ins("今天好")
+        assert outs and len(outs[0]) == 4  # one char inserted, no spaces
+
+    def test_char_swap_delete(self):
+        from paddlenlp_tpu.dataaug import CharDelete, CharSwap
+
+        sw = CharSwap(create_n=1, aug_n=1, seed=0)
+        outs = sw("abcdef")
+        assert outs and sorted(outs[0]) == list("abcdef") and outs[0] != "abcdef"
+        de = CharDelete(create_n=1, aug_n=2, seed=0)
+        outs = de("abcdef")
+        assert outs and len(outs[0]) == 4
+
+    def test_batch_and_determinism(self):
+        from paddlenlp_tpu.dataaug import CharSwap
+
+        a = CharSwap(create_n=1, seed=3)("hello world")
+        b = CharSwap(create_n=1, seed=3)("hello world")
+        assert a == b
+        batch = CharSwap(create_n=1, seed=0)(["abcd", "efgh"])
+        assert len(batch) == 2

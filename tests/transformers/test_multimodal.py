@@ -180,3 +180,39 @@ class TestMultimodalAuto:
         m.save_pretrained(str(tmp_path))
         auto = AutoModel.from_pretrained(str(tmp_path))
         assert type(auto).__name__ == "CLIPModel"
+
+
+class TestMiniGPT4:
+    def cfg(self):
+        from paddlenlp_tpu.transformers import MiniGPT4Config
+
+        return MiniGPT4Config(
+            vision_config=dict(hidden_size=32, intermediate_size=48, num_hidden_layers=2,
+                               num_attention_heads=4, image_size=24, patch_size=6),
+            qformer_config=dict(vocab_size=60, hidden_size=32, num_hidden_layers=2,
+                                num_attention_heads=4, intermediate_size=48, num_query_tokens=4),
+            text_config=dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+                             num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+                             max_position_embeddings=64, bos_token_id=1, eos_token_id=2,
+                             pad_token_id=0, use_scan_layers=False))
+
+    def test_forward_loss_generate_roundtrip(self, tmp_path):
+        from paddlenlp_tpu.transformers import MiniGPT4ForConditionalGeneration
+
+        m = MiniGPT4ForConditionalGeneration.from_config(self.cfg(), seed=0)
+        ids = jnp.asarray([[1, 5, 6, 7], [1, 8, 9, 0]], jnp.int32)
+        out, loss = m(pixel_values=pix(), input_ids=ids, labels=ids)
+        assert out.logits.shape == (2, 4, 96) and np.isfinite(float(loss))
+        caps = np.asarray(m.generate(pix(), max_new_tokens=4))
+        assert caps.shape == (2, 4)
+        m.save_pretrained(str(tmp_path))
+        m2 = MiniGPT4ForConditionalGeneration.from_pretrained(str(tmp_path))
+        _, loss2 = m2(pixel_values=pix(), input_ids=ids, labels=ids)
+        np.testing.assert_allclose(float(loss), float(loss2), atol=1e-5)
+
+    def test_qformer_prefix_shape(self):
+        from paddlenlp_tpu.transformers import MiniGPT4ForConditionalGeneration
+
+        m = MiniGPT4ForConditionalGeneration.from_config(self.cfg(), seed=0)
+        prefix = m.module.apply({"params": m.params}, pix(), method=m.module.encode_image)
+        assert prefix.shape == (2, 4, 32)  # [B, num_query_tokens, llm_hidden]
