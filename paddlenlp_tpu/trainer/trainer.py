@@ -167,6 +167,40 @@ class Trainer:
             self.optimizer = tx
         return self.optimizer
 
+    def _logical_overrides(self) -> dict:
+        """Mesh-dependent logical->physical rule overrides, applied BOTH to
+        initial param placement and (via ``_with_rules``) to the jitted
+        train/eval traces so activation constraints agree with placement."""
+        overrides = {}
+        if self.mesh.shape.get("pp", 1) > 1:
+            overrides["layers"] = "pp"  # stacked [L] decoder params split across stages
+            # embedding + lm_head would otherwise be REPLICATED per stage (at
+            # 7B/32k-vocab that's ~260M params each): ride the vocab dim on pp
+            # too — the one-hot embed contraction and the fused CE are
+            # vocab-sharding-agnostic, GSPMD adds the psum over (tp, pp)
+            overrides["vocab"] = ("tp", "pp")
+            overrides["act_vocab"] = ("tp", "pp")
+        if getattr(self.args, "sequence_parallel", False) and self.mesh.shape.get("tp", 1) > 1:
+            # Megatron-SP: residual-stream activations also shard over tp
+            overrides["act_seq"] = ("sep", "cp", "tp")
+        return overrides
+
+    def _with_rules(self, fn):
+        """Wrap a jitted step so its (lazy, first-call) trace runs under this
+        trainer's logical-rule overrides — shard_constraint/logical_axis_size
+        inside the model then resolve against the same mapping the params were
+        placed with."""
+        overrides = self._logical_overrides()
+        if not overrides:
+            return fn
+        from ..parallel.partition import logical_axis_rules
+
+        def wrapped(*args, **kwargs):
+            with logical_axis_rules(overrides):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
     def _shard_params(self, params, logical_overrides=None):
         """Place params on the mesh per the model's partition rules."""
         from ..parallel.partition import logical_axis_rules
@@ -210,9 +244,7 @@ class Trainer:
         params = self.model.params
         fsdp = self.mesh.shape.get("fsdp", 1)
         stage = self.args.sharding_stage
-        overrides = {}
-        if self.mesh.shape.get("pp", 1) > 1:
-            overrides["layers"] = "pp"  # stacked [L] decoder params split across stages
+        overrides = dict(self._logical_overrides())
         if stage in (1, 2) and fsdp > 1:
             params = self._shard_params(params, logical_overrides={"embed": None, **overrides})
             opt_shardings = self._zero1_opt_shardings(params)
@@ -307,7 +339,7 @@ class Trainer:
                 new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
                 return new_state, {"loss": loss, "grad_norm": grad_norm}
 
-            return jax.jit(pipeline_train_step, donate_argnums=(0,))
+            return self._with_rules(jax.jit(pipeline_train_step, donate_argnums=(0,)))
 
         def loss_for_micro(params, micro, rng):
             return self.compute_loss(params, micro, dropout_rng=rng)
@@ -340,7 +372,7 @@ class Trainer:
             metrics = {"loss": loss, "grad_norm": grad_norm}
             return new_state, metrics
 
-        return jax.jit(train_step, donate_argnums=(0,))
+        return self._with_rules(jax.jit(train_step, donate_argnums=(0,)))
 
     def _build_eval_step(self):
         shift = not self._labels_preshifted
@@ -358,7 +390,7 @@ class Trainer:
                 loss = causal_lm_loss(logits, labels, shift=shift)
             return {"loss": loss, "logits": logits}
 
-        return jax.jit(eval_step)
+        return self._with_rules(jax.jit(eval_step))
 
     # ------------------------------------------------------------------ data
     def _data_shard_geometry(self):

@@ -12,7 +12,6 @@ from paddlenlp_tpu.parallel.pipeline import spatial_pipeline
 from paddlenlp_tpu.trainer import Trainer, TrainingArguments
 from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
 
-
 class TestSpatialPipeline:
     def test_matches_sequential(self, eight_devices):
         L, M, mb, D = 4, 3, 2, 8
@@ -70,7 +69,6 @@ class TestSpatialPipeline:
         g_ref = jax.grad(loss_seq)(w)
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-6)
 
-
 def _data(n=64, seq=16):
     rng = np.random.default_rng(7)
     rows = [rng.integers(0, 128, size=seq).astype(np.int32) for _ in range(n)]
@@ -83,7 +81,6 @@ def _data(n=64, seq=16):
             return {"input_ids": rows[i], "labels": rows[i].copy()}
 
     return DS()
-
 
 def _run(tmp_path, tag, *, pp, tp, mbs, steps=2):
     cfg = LlamaConfig(
@@ -101,7 +98,6 @@ def _run(tmp_path, tag, *, pp, tp, mbs, steps=2):
     trainer.train()
     return [h["loss"] for h in trainer.state.log_history if "loss" in h]
 
-
 class TestPipelineTrainerParity:
     def test_pp2_matches_pp1(self, tmp_path, eight_devices):
         # identical global batch (32): pp1tp2 -> 4 data shards x mbs2 x accum4;
@@ -110,7 +106,6 @@ class TestPipelineTrainerParity:
         piped = _run(tmp_path, "pp2", pp=2, tp=2, mbs=4)
         assert len(base) == len(piped) >= 2
         np.testing.assert_allclose(base, piped, rtol=2e-4, atol=2e-4)
-
 
 class TestPipelineDropout:
     def test_dropout_threads_through_pipeline(self, eight_devices):
@@ -138,3 +133,30 @@ class TestPipelineDropout:
         assert l1 == l1_again  # same key -> bit-stable
         assert l1 != l2, "dropout rng has no effect in the pipeline"
         assert det not in (l1, l2) and np.isfinite(det)
+
+class TestPPVocabSharding:
+    def test_embed_and_head_shard_over_pp(self, tmp_path, eight_devices):
+        """pp>1 must NOT replicate the embedding/lm_head per stage: the vocab
+        dim rides (tp, pp) so each stage holds 1/(tp*pp) of both tables."""
+
+        from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=4, num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=64, use_scan_layers=True)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        data = [{"input_ids": np.asarray([3, 4, 5, 6, 7, 8], np.int32),
+                 "labels": np.asarray([4, 5, 6, 7, 8, 9], np.int32)} for _ in range(32)]
+        args = TrainingArguments(output_dir=str(tmp_path), per_device_train_batch_size=4,
+                                 max_steps=1, pipeline_parallel_degree=2,
+                                 tensor_parallel_degree=2, logging_steps=100)
+        trainer = Trainer(model=model, args=args, train_dataset=data)
+        trainer.create_optimizer_and_scheduler(num_training_steps=1)
+        state = trainer._make_train_state()
+        embed = state.params["model"]["embed_tokens"]["embedding"]
+        head = state.params["lm_head"]["kernel"]
+        assert "pp" in str(embed.sharding.spec) and "tp" in str(embed.sharding.spec), embed.sharding
+        assert "pp" in str(head.sharding.spec), head.sharding
+        # vocab dim split across tp*pp=4: each shard holds 128/4 rows
+        shard_shape = embed.sharding.shard_shape(embed.shape)
+        assert shard_shape[0] == 128 // 4, shard_shape
