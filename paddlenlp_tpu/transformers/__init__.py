@@ -171,6 +171,7 @@ from .roformer import (  # noqa: F401
     RoFormerModel,
 )
 from .tinybert import TinyBertConfig, TinyBertForSequenceClassification, TinyBertModel  # noqa: F401
+from .fnet import FNetConfig, FNetForMaskedLM, FNetForSequenceClassification, FNetModel  # noqa: F401
 from .ppminilm import PPMiniLMConfig, PPMiniLMForSequenceClassification, PPMiniLMModel  # noqa: F401
 from .deberta_v2 import (  # noqa: F401
     DebertaV2Config,

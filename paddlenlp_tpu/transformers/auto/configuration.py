@@ -55,6 +55,7 @@ def _populate():
     from ..roformer.configuration import RoFormerConfig
     from ..tinybert.configuration import TinyBertConfig
     from ..ppminilm.configuration import PPMiniLMConfig
+    from ..fnet.configuration import FNetConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -70,7 +71,7 @@ def _populate():
                 CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
                 GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
-                MiniGPT4Config):
+                MiniGPT4Config, FNetConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -194,6 +194,11 @@ def _populate_models():
 
     register_model("ppminilm", "base", ppminilm.PPMiniLMModel)
     register_model("ppminilm", "sequence_classification", ppminilm.PPMiniLMForSequenceClassification)
+    from ..fnet import modeling as fnet
+
+    register_model("fnet", "base", fnet.FNetModel)
+    register_model("fnet", "masked_lm", fnet.FNetForMaskedLM)
+    register_model("fnet", "sequence_classification", fnet.FNetForSequenceClassification)
     from ..deberta_v2 import modeling as deberta_v2
 
     register_model("deberta-v2", "base", deberta_v2.DebertaV2Model)

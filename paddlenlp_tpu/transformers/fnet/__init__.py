@@ -1,0 +1,7 @@
+from .configuration import FNetConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    FNetForMaskedLM,
+    FNetForSequenceClassification,
+    FNetModel,
+    FNetPretrainedModel,
+)

@@ -1,0 +1,189 @@
+"""FNet, TPU-native (reference: paddlenlp/transformers/fnet/modeling.py).
+
+Attention-free encoder: token mixing is the REAL PART OF A 2D FOURIER
+TRANSFORM over (sequence, hidden) — a particularly TPU-friendly design (XLA
+lowers fft to fused kernels; no attention memory at all). Embeddings carry an
+extra ``projection`` dense (HF layout); post-LN residuals like BERT.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..llama.modeling import ACT2FN, VocabEmbed, tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import FNetConfig
+
+__all__ = ["FNetModel", "FNetForMaskedLM", "FNetForSequenceClassification", "FNetPretrainedModel"]
+
+
+class FNetLayer(nn.Module):
+    config: FNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, deterministic=True):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        # fourier token mixing: Re(FFT_seq(FFT_hidden(h)))
+        mixed = jnp.fft.fft(jnp.fft.fft(h.astype(jnp.float32), axis=-1), axis=-2).real
+        h = ln("fourier_output_LayerNorm")(h + jnp.asarray(mixed, self.dtype))
+        ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "intermediate_dense")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = dense(cfg.hidden_size, "output_dense")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
+        h = ln("output_LayerNorm")(h + ff)
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class FNetModule(nn.Module):
+    config: FNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, token_type_ids=None, position_ids=None,
+                 attention_mask=None, deterministic=True, output_hidden_states=False,
+                 return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        h = nn.Dense(cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                     kernel_init=init, name="embeddings_projection")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        for i in range(cfg.num_hidden_layers):
+            h = FNetLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       kernel_init=nn.initializers.normal(cfg.initializer_range),
+                                       name="pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class FNetForMaskedLMModule(nn.Module):
+    config: FNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, token_type_ids=None, position_ids=None,
+                 attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        # attention_mask accepted for API uniformity; fourier mixing has no mask
+        cfg = self.config
+        h = FNetModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                       name="fnet")(input_ids, token_type_ids, position_ids,
+                                    deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "fnet")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class FNetForSequenceClassificationModule(nn.Module):
+    config: FNetConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, token_type_ids=None, position_ids=None,
+                 attention_mask=None, deterministic=True,
+                 output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = FNetModule(cfg, self.dtype, self.param_dtype, name="fnet")(
+            input_ids, token_type_ids, position_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class FNetPretrainedModel(PretrainedModel):
+    config_class = FNetConfig
+    base_model_prefix = "fnet"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"intermediate_dense/kernel$", P("embed", "mlp")),
+            (r"output_dense/kernel$", P("mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layer_(\d+)\b", r"encoder@layer@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("fourier_output_LayerNorm", "fourier@output@LayerNorm")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("predictions_transform_LayerNorm", "cls@predictions@transform@LayerNorm")
+            key = key.replace("predictions_transform_dense", "cls@predictions@transform@dense")
+            key = key.replace("predictions_bias", "cls@predictions@bias")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class FNetModel(FNetPretrainedModel):
+    module_class = FNetModule
+
+
+class FNetForMaskedLM(FNetPretrainedModel):
+    module_class = FNetForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"cls\.predictions\.decoder"]
+
+
+class FNetForSequenceClassification(FNetPretrainedModel):
+    module_class = FNetForSequenceClassificationModule

@@ -593,7 +593,8 @@ class LlamaForCausalLM(LlamaPretrainedModel):
         ``LlamaForCausalLMPipe`` modeling_pp.py:296 — here the SAME network/
         params pipeline themselves; no second model class). ``batch`` tensors
         are [M, mb, ...] with M = microbatch count (the grad-accum axis).
-        Embedding/head run outside the pipeline, replicated over pp (they are
+        Embedding/head run outside the pipeline; under the Trainer they are
+        vocab-sharded over (tp, pp) — see Trainer._logical_overrides — (they are
         a small fraction of trunk FLOPs); shared-embedding gradients therefore
         need no special handling — AD sums both uses.
         """

@@ -186,3 +186,33 @@ class TestDebertaV2:
             DebertaV2Config(**self.KW, num_labels=3), seed=0)
         out = m(input_ids=jnp.asarray(IDS, jnp.int32))
         assert out.logits.shape == (2, 3)
+
+
+class TestFNet:
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import FNetConfig as HFC, FNetForMaskedLM as HFM
+
+        from paddlenlp_tpu.transformers import FNetForMaskedLM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=60, hidden_size=32, num_hidden_layers=2, intermediate_size=37,
+                     max_position_embeddings=64, type_vocab_size=2,
+                     hidden_dropout_prob=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS)).logits.numpy()
+        m = FNetForMaskedLM.from_pretrained(str(tmp_path))
+        mine = m(input_ids=jnp.asarray(IDS, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_no_attention_params(self, tmp_path):
+        from paddlenlp_tpu.transformers import FNetConfig, FNetModel
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+        m = FNetModel.from_config(FNetConfig(vocab_size=60, hidden_size=32, num_hidden_layers=2,
+                                             intermediate_size=37, type_vocab_size=2), seed=0)
+        paths = list(flatten_params(m.params))
+        assert not any("query" in p or "attn" in p for p in paths)  # attention-free
+        out = m(input_ids=jnp.asarray(IDS, jnp.int32))
+        assert out.last_hidden_state.shape == (2, 6, 32)
