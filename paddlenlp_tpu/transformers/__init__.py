@@ -172,6 +172,12 @@ from .roformer import (  # noqa: F401
 )
 from .tinybert import TinyBertConfig, TinyBertForSequenceClassification, TinyBertModel  # noqa: F401
 from .fnet import FNetConfig, FNetForMaskedLM, FNetForSequenceClassification, FNetModel  # noqa: F401
+from .ernie_m import (  # noqa: F401
+    ErnieMConfig,
+    ErnieMForSequenceClassification,
+    ErnieMForTokenClassification,
+    ErnieMModel,
+)
 from .ppminilm import PPMiniLMConfig, PPMiniLMForSequenceClassification, PPMiniLMModel  # noqa: F401
 from .deberta_v2 import (  # noqa: F401
     DebertaV2Config,

@@ -56,6 +56,7 @@ def _populate():
     from ..tinybert.configuration import TinyBertConfig
     from ..ppminilm.configuration import PPMiniLMConfig
     from ..fnet.configuration import FNetConfig
+    from ..ernie_m.configuration import ErnieMConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -71,7 +72,7 @@ def _populate():
                 CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
                 GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
-                MiniGPT4Config, FNetConfig):
+                MiniGPT4Config, FNetConfig, ErnieMConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

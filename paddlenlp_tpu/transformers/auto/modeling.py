@@ -199,6 +199,11 @@ def _populate_models():
     register_model("fnet", "base", fnet.FNetModel)
     register_model("fnet", "masked_lm", fnet.FNetForMaskedLM)
     register_model("fnet", "sequence_classification", fnet.FNetForSequenceClassification)
+    from ..ernie_m import modeling as ernie_m
+
+    register_model("ernie_m", "base", ernie_m.ErnieMModel)
+    register_model("ernie_m", "sequence_classification", ernie_m.ErnieMForSequenceClassification)
+    register_model("ernie_m", "token_classification", ernie_m.ErnieMForTokenClassification)
     from ..deberta_v2 import modeling as deberta_v2
 
     register_model("deberta-v2", "base", deberta_v2.DebertaV2Model)

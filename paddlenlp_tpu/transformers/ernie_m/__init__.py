@@ -1,0 +1,7 @@
+from .configuration import ErnieMConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    ErnieMForSequenceClassification,
+    ErnieMForTokenClassification,
+    ErnieMModel,
+    ErnieMPretrainedModel,
+)

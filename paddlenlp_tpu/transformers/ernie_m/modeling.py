@@ -1,0 +1,186 @@
+"""ERNIE-M, TPU-native (reference: paddlenlp/transformers/ernie_m/modeling.py).
+
+Multilingual XLM-R-lineage encoder: NO token types, positions offset by +2
+(paddle convention the checkpoints bake in), post-LN transformer blocks in
+paddle ``nn.TransformerEncoderLayer`` key grammar
+(``self_attn.self_attn.q_proj`` / ``linear1`` / ``norm1`` ...).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..llama.modeling import ACT2FN, VocabEmbed
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    SequenceClassifierOutput,
+    TokenClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import ErnieMConfig
+
+__all__ = ["ErnieMModel", "ErnieMForSequenceClassification",
+           "ErnieMForTokenClassification", "ErnieMPretrainedModel"]
+
+
+class ErnieMLayer(nn.Module):
+    config: ErnieMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        q = dense(D, "self_attn_q_proj")(h).reshape(B, T, n, hd)
+        k = dense(D, "self_attn_k_proj")(h).reshape(B, T, n, hd)
+        v = dense(D, "self_attn_v_proj")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        drop = cfg.attention_probs_dropout_prob if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask, causal=False,
+                                     dropout_rate=drop, dropout_rng=rng).reshape(B, T, D)
+        attn = dense(D, "self_attn_out_proj")(attn)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
+        h = ln("norm1")(h + attn)
+        ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "linear1")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = dense(D, "linear2")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
+        h = ln("norm2")(h + ff)
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class ErnieMModule(nn.Module):
+    config: ErnieMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None,
+                 token_type_ids=None, deterministic=True, output_hidden_states=False,
+                 return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        # paddle convention the checkpoints bake in: positions start at 2
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(position_ids + 2)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_layer_norm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        for i in range(cfg.num_hidden_layers):
+            h = ErnieMLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layers_{i}")(
+                h, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                                       param_dtype=self.param_dtype,
+                                       kernel_init=init, name="pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class ErnieMForSequenceClassificationModule(nn.Module):
+    config: ErnieMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None,
+                 token_type_ids=None, deterministic=True, output_hidden_states=False,
+                 return_dict=True):
+        cfg = self.config
+        out = ErnieMModule(cfg, self.dtype, self.param_dtype, name="ernie_m")(
+            input_ids, attention_mask, position_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class ErnieMForTokenClassificationModule(nn.Module):
+    config: ErnieMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, position_ids=None,
+                 token_type_ids=None, deterministic=True, output_hidden_states=False,
+                 return_dict=True):
+        cfg = self.config
+        out = ErnieMModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                           name="ernie_m")(input_ids, attention_mask, position_ids,
+                                           deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.last_hidden_state)
+        return TokenClassifierOutput(logits=logits)
+
+
+class ErnieMPretrainedModel(PretrainedModel):
+    config_class = ErnieMConfig
+    base_model_prefix = "ernie_m"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"self_attn_(q|k|v)_proj/kernel$", P("embed", "heads")),
+            (r"self_attn_out_proj/kernel$", P("heads", "embed")),
+            (r"linear1/kernel$", P("embed", "mlp")),
+            (r"linear2/kernel$", P("mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layers_(\d+)\b", r"encoder@layers@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            # paddle TransformerEncoderLayer nests q/k/v under a second
+            # self_attn scope; out_proj sits one level up
+            key = key.replace("self_attn_out_proj", "self_attn@out_proj")
+            key = key.replace("self_attn_", "self_attn@self_attn@")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class ErnieMModel(ErnieMPretrainedModel):
+    module_class = ErnieMModule
+
+
+class ErnieMForSequenceClassification(ErnieMPretrainedModel):
+    module_class = ErnieMForSequenceClassificationModule
+
+
+class ErnieMForTokenClassification(ErnieMPretrainedModel):
+    module_class = ErnieMForTokenClassificationModule

@@ -216,3 +216,31 @@ class TestFNet:
         assert not any("query" in p or "attn" in p for p in paths)  # attention-free
         out = m(input_ids=jnp.asarray(IDS, jnp.int32))
         assert out.last_hidden_state.shape == (2, 6, 32)
+
+
+class TestErnieM:
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import ErnieMConfig as HFC, ErnieMModel as HFM
+
+        from paddlenlp_tpu.transformers import ErnieMModel
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=60, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=48, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS)).last_hidden_state.numpy()
+        m = ErnieMModel.from_pretrained(str(tmp_path))
+        mine = m(input_ids=jnp.asarray(IDS, jnp.int32)).last_hidden_state
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_cls_heads(self):
+        from paddlenlp_tpu.transformers import ErnieMConfig, ErnieMForSequenceClassification
+
+        m = ErnieMForSequenceClassification.from_config(
+            ErnieMConfig(vocab_size=60, hidden_size=32, num_hidden_layers=1,
+                         num_attention_heads=4, intermediate_size=48, num_labels=3), seed=0)
+        out = m(input_ids=jnp.asarray(IDS, jnp.int32))
+        assert out.logits.shape == (2, 3)
