@@ -78,6 +78,9 @@ class ErnieMModule(nn.Module):
         T = input_ids.shape[1]
         if position_ids is None:
             position_ids = jnp.arange(T)[None, :]
+        if attention_mask is None and cfg.pad_token_id is not None:
+            # HF/reference ErnieM auto-masks pad tokens when no mask is given
+            attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
         init = nn.initializers.normal(cfg.initializer_range)
         h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
                        embedding_init=init, name="embeddings_word_embeddings")(input_ids)
