@@ -51,7 +51,8 @@ class PretrainedConfig:
         self.cls_token_id = kwargs.pop("cls_token_id", None)
         self.mask_token_id = kwargs.pop("mask_token_id", None)
         self.unk_token_id = kwargs.pop("unk_token_id", None)
-        self.num_labels = kwargs.pop("num_labels", 2)
+        id2label = kwargs.get("id2label")
+        self.num_labels = kwargs.pop("num_labels", len(id2label) if id2label else 2)
         self.classifier_dropout = kwargs.pop("classifier_dropout", None)
         self.is_encoder_decoder = kwargs.pop("is_encoder_decoder", False)
         self.is_decoder = kwargs.pop("is_decoder", False)
