@@ -225,13 +225,19 @@ def run_bench(tiny: bool) -> None:
 
 
 def _spawn(argv: list[str], timeout: float, env: dict | None = None) -> tuple[int, str, str]:
+    merged = {**os.environ, **(env or {})}
+    if merged.get("JAX_PLATFORMS") == "cpu" and merged.get("PYTHONPATH"):
+        # a wedged tunnel can BLOCK jax init even under JAX_PLATFORMS=cpu (the
+        # axon plugin registers at discovery): drop its site dir for cpu runs
+        merged["PYTHONPATH"] = os.pathsep.join(
+            p for p in merged["PYTHONPATH"].split(os.pathsep) if "axon" not in p)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *argv],
             capture_output=True,
             text=True,
             timeout=timeout,
-            env={**os.environ, **(env or {})},
+            env=merged,
         )
         return proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:

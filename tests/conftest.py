@@ -3,9 +3,17 @@ without TPU hardware (SURVEY.md §4: the reference's `TestMultipleGpus` local-su
 simulator maps to XLA's forced host platform device count)."""
 
 import os
+import sys
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
+# a wedged TPU tunnel can BLOCK jax backend init even under JAX_PLATFORMS=cpu
+# (the axon PJRT plugin registers at discovery time): drop its site dir from
+# the import path before jax ever loads
+sys.path[:] = [p for p in sys.path if "axon" not in p]
+if os.environ.get("PYTHONPATH"):
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ["PYTHONPATH"].split(os.pathsep) if "axon" not in p)
 
 import jax  # noqa: E402
 
