@@ -172,6 +172,12 @@ from .roformer import (  # noqa: F401
 )
 from .tinybert import TinyBertConfig, TinyBertForSequenceClassification, TinyBertModel  # noqa: F401
 from .fnet import FNetConfig, FNetForMaskedLM, FNetForSequenceClassification, FNetModel  # noqa: F401
+from .megatronbert import (  # noqa: F401
+    MegatronBertConfig,
+    MegatronBertForMaskedLM,
+    MegatronBertForSequenceClassification,
+    MegatronBertModel,
+)
 from .ernie_m import (  # noqa: F401
     ErnieMConfig,
     ErnieMForSequenceClassification,

@@ -57,6 +57,7 @@ def _populate():
     from ..ppminilm.configuration import PPMiniLMConfig
     from ..fnet.configuration import FNetConfig
     from ..ernie_m.configuration import ErnieMConfig
+    from ..megatronbert.configuration import MegatronBertConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -72,7 +73,7 @@ def _populate():
                 CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
                 GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
-                MiniGPT4Config, FNetConfig, ErnieMConfig):
+                MiniGPT4Config, FNetConfig, ErnieMConfig, MegatronBertConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -201,6 +201,12 @@ def _populate_models():
     register_model("fnet", "sequence_classification", fnet.FNetForSequenceClassification)
     from ..ernie_m import modeling as ernie_m
 
+    from ..megatronbert import modeling as megatronbert
+
+    register_model("megatron-bert", "base", megatronbert.MegatronBertModel)
+    register_model("megatron-bert", "masked_lm", megatronbert.MegatronBertForMaskedLM)
+    register_model("megatron-bert", "sequence_classification",
+                   megatronbert.MegatronBertForSequenceClassification)
     register_model("ernie_m", "base", ernie_m.ErnieMModel)
     register_model("ernie_m", "sequence_classification", ernie_m.ErnieMForSequenceClassification)
     register_model("ernie_m", "token_classification", ernie_m.ErnieMForTokenClassification)

@@ -1,0 +1,7 @@
+from .configuration import MegatronBertConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    MegatronBertForMaskedLM,
+    MegatronBertForSequenceClassification,
+    MegatronBertModel,
+    MegatronBertPretrainedModel,
+)

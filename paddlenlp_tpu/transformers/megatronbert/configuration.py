@@ -1,0 +1,19 @@
+"""MegatronBERT configuration (reference: paddlenlp/transformers/megatronbert/configuration.py)."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["MegatronBertConfig"]
+
+
+class MegatronBertConfig(BertConfig):
+    model_type = "megatron-bert"
+
+    def __init__(self, vocab_size: int = 29056, hidden_size: int = 1024,
+                 num_hidden_layers: int = 24, num_attention_heads: int = 16,
+                 intermediate_size: int = 4096, **kwargs):
+        super().__init__(vocab_size=vocab_size, hidden_size=hidden_size,
+                         num_hidden_layers=num_hidden_layers,
+                         num_attention_heads=num_attention_heads,
+                         intermediate_size=intermediate_size, **kwargs)

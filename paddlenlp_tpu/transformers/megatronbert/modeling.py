@@ -1,0 +1,188 @@
+"""MegatronBERT, TPU-native (reference: paddlenlp/transformers/megatronbert/modeling.py).
+
+BERT with Megatron-LM's PRE-layernorm residual order: each sublayer reads
+``ln(h)`` and adds back to the raw stream (``attention.ln`` / ``ln`` keys), the
+embedding LayerNorm is gone, and one final ``encoder.ln`` closes the stack —
+the arrangement that keeps very deep stacks trainable.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, VocabEmbed, _dense
+from ..llama.modeling import tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import MegatronBertConfig
+
+__all__ = ["MegatronBertModel", "MegatronBertForMaskedLM",
+           "MegatronBertForSequenceClassification", "MegatronBertPretrainedModel"]
+
+
+class MegatronBertLayer(nn.Module):
+    config: MegatronBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        x = ln("attention_ln")(h)
+        q = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_query")(x).reshape(B, T, n, hd)
+        k = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_key")(x).reshape(B, T, n, hd)
+        v = _dense(D, cfg, self.dtype, self.param_dtype, "attention_self_value")(x).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask,
+                                     causal=False).reshape(B, T, D)
+        h = h + _dense(D, cfg, self.dtype, self.param_dtype, "attention_output_dense")(attn)
+        x = ln("ln")(h)
+        ff = ACT2FN[cfg.hidden_act](_dense(cfg.intermediate_size, cfg, self.dtype,
+                                           self.param_dtype, "intermediate_dense")(x))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        h = h + _dense(D, cfg, self.dtype, self.param_dtype, "output_dense")(ff)
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class MegatronBertModule(nn.Module):
+    config: MegatronBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_token_type_embeddings")(token_type_ids)
+        # pre-LN design: NO embedding LayerNorm
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        for i in range(cfg.num_hidden_layers):
+            h = MegatronBertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="encoder_ln")(h)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class MegatronBertForMaskedLMModule(nn.Module):
+    config: MegatronBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = MegatronBertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                               name="bert")(input_ids, attention_mask, token_type_ids,
+                                            deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "bert")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class MegatronBertForSequenceClassificationModule(nn.Module):
+    config: MegatronBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = MegatronBertModule(cfg, self.dtype, self.param_dtype, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class MegatronBertPretrainedModel(PretrainedModel):
+    config_class = MegatronBertConfig
+    base_model_prefix = "bert"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..bert.modeling import BertPretrainedModel
+
+        return BertPretrainedModel.get_partition_rules(config)
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layer_(\d+)\b", r"encoder@layer@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("attention_self_", "attention@self@")
+            key = key.replace("attention_output_dense", "attention@output@dense")
+            key = key.replace("attention_ln", "attention@ln")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("encoder_ln", "encoder@ln")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("predictions_transform_LayerNorm", "cls@predictions@transform@LayerNorm")
+            key = key.replace("predictions_transform_dense", "cls@predictions@transform@dense")
+            key = key.replace("predictions_bias", "cls@predictions@bias")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class MegatronBertModel(MegatronBertPretrainedModel):
+    module_class = MegatronBertModule
+
+
+class MegatronBertForMaskedLM(MegatronBertPretrainedModel):
+    module_class = MegatronBertForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"cls\.predictions\.decoder"]
+
+
+class MegatronBertForSequenceClassification(MegatronBertPretrainedModel):
+    module_class = MegatronBertForSequenceClassificationModule

@@ -244,3 +244,36 @@ class TestErnieM:
                          num_attention_heads=4, intermediate_size=48, num_labels=3), seed=0)
         out = m(input_ids=jnp.asarray(IDS, jnp.int32))
         assert out.logits.shape == (2, 3)
+
+
+class TestMegatronBert:
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import MegatronBertConfig as HFC, MegatronBertForMaskedLM as HFM
+
+        from paddlenlp_tpu.transformers import MegatronBertForMaskedLM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=60, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=48, max_position_embeddings=64,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS), attention_mask=torch.tensor(MASK)).logits.numpy()
+        m = MegatronBertForMaskedLM.from_pretrained(str(tmp_path))
+        mine = m(input_ids=jnp.asarray(IDS, jnp.int32),
+                 attention_mask=jnp.asarray(MASK, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_pre_ln_no_embed_norm(self, tmp_path):
+        from paddlenlp_tpu.transformers import MegatronBertConfig, MegatronBertModel
+        from paddlenlp_tpu.utils.safetensors_io import safe_keys
+
+        m = MegatronBertModel.from_config(
+            MegatronBertConfig(vocab_size=60, hidden_size=32, num_hidden_layers=1,
+                               num_attention_heads=4, intermediate_size=48), seed=0)
+        m.save_pretrained(str(tmp_path))
+        keys = set(safe_keys(str(tmp_path / "model.safetensors")))
+        assert "encoder.layer.0.attention.ln.weight" in keys
+        assert "encoder.ln.weight" in keys
+        assert "embeddings.LayerNorm.weight" not in keys
