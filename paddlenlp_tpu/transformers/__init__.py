@@ -172,6 +172,12 @@ from .roformer import (  # noqa: F401
 )
 from .tinybert import TinyBertConfig, TinyBertForSequenceClassification, TinyBertModel  # noqa: F401
 from .fnet import FNetConfig, FNetForMaskedLM, FNetForSequenceClassification, FNetModel  # noqa: F401
+from .layoutlm import (  # noqa: F401
+    LayoutLMConfig,
+    LayoutLMForMaskedLM,
+    LayoutLMForTokenClassification,
+    LayoutLMModel,
+)
 from .megatronbert import (  # noqa: F401
     MegatronBertConfig,
     MegatronBertForMaskedLM,

@@ -58,6 +58,7 @@ def _populate():
     from ..fnet.configuration import FNetConfig
     from ..ernie_m.configuration import ErnieMConfig
     from ..megatronbert.configuration import MegatronBertConfig
+    from ..layoutlm.configuration import LayoutLMConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -73,7 +74,8 @@ def _populate():
                 CLIPConfig, ChineseCLIPConfig, BlipConfig, ErnieViLConfig,
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
                 GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
-                MiniGPT4Config, FNetConfig, ErnieMConfig, MegatronBertConfig):
+                MiniGPT4Config, FNetConfig, ErnieMConfig, MegatronBertConfig,
+                LayoutLMConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -201,6 +201,11 @@ def _populate_models():
     register_model("fnet", "sequence_classification", fnet.FNetForSequenceClassification)
     from ..ernie_m import modeling as ernie_m
 
+    from ..layoutlm import modeling as layoutlm
+
+    register_model("layoutlm", "base", layoutlm.LayoutLMModel)
+    register_model("layoutlm", "masked_lm", layoutlm.LayoutLMForMaskedLM)
+    register_model("layoutlm", "token_classification", layoutlm.LayoutLMForTokenClassification)
     from ..megatronbert import modeling as megatronbert
 
     register_model("megatron-bert", "base", megatronbert.MegatronBertModel)

@@ -1,0 +1,7 @@
+from .configuration import LayoutLMConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    LayoutLMForMaskedLM,
+    LayoutLMForTokenClassification,
+    LayoutLMModel,
+    LayoutLMPretrainedModel,
+)

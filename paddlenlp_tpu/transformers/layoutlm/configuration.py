@@ -1,0 +1,15 @@
+"""LayoutLM configuration (reference: paddlenlp/transformers/layoutlm/configuration.py)."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["LayoutLMConfig"]
+
+
+class LayoutLMConfig(BertConfig):
+    model_type = "layoutlm"
+
+    def __init__(self, max_2d_position_embeddings: int = 1024, **kwargs):
+        self.max_2d_position_embeddings = max_2d_position_embeddings
+        super().__init__(**kwargs)

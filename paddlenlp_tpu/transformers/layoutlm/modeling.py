@@ -1,0 +1,152 @@
+"""LayoutLM, TPU-native (reference: paddlenlp/transformers/layoutlm/modeling.py).
+
+Document-AI BERT: token embeddings are summed with 2D LAYOUT embeddings of each
+token's bounding box — x/y for the (x0, y0, x1, y1) corners plus height/width
+tables — then the standard BERT encoder runs unchanged (reused wholesale).
+``bbox`` is [B, T, 4] in 0..max_2d_position_embeddings-1 page coordinates.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import BertLayer, VocabEmbed, _dense
+from ..llama.modeling import tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    TokenClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import LayoutLMConfig
+
+__all__ = ["LayoutLMModel", "LayoutLMForMaskedLM", "LayoutLMForTokenClassification",
+           "LayoutLMPretrainedModel"]
+
+
+class LayoutLMModule(nn.Module):
+    config: LayoutLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, bbox=None, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic=True, output_hidden_states=False,
+                 return_dict=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        if bbox is None:
+            bbox = jnp.zeros((B, T, 4), jnp.int32)
+        init = nn.initializers.normal(cfg.initializer_range)
+        embed = lambda n_rows, name: nn.Embed(n_rows, cfg.hidden_size, dtype=self.dtype,
+                                              param_dtype=self.param_dtype, embedding_init=init,
+                                              name=name)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + embed(cfg.max_position_embeddings, "embeddings_position_embeddings")(position_ids)
+        x_tab = embed(cfg.max_2d_position_embeddings, "embeddings_x_position_embeddings")
+        y_tab = embed(cfg.max_2d_position_embeddings, "embeddings_y_position_embeddings")
+        h_tab = embed(cfg.max_2d_position_embeddings, "embeddings_h_position_embeddings")
+        w_tab = embed(cfg.max_2d_position_embeddings, "embeddings_w_position_embeddings")
+        h = (h + x_tab(bbox[..., 0]) + y_tab(bbox[..., 1]) + x_tab(bbox[..., 2])
+             + y_tab(bbox[..., 3])
+             + h_tab(bbox[..., 3] - bbox[..., 1]) + w_tab(bbox[..., 2] - bbox[..., 0]))
+        h = h + embed(cfg.type_vocab_size, "embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        for i in range(cfg.num_hidden_layers):
+            h = BertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class LayoutLMForMaskedLMModule(nn.Module):
+    config: LayoutLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, bbox=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = LayoutLMModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                           name="layoutlm")(input_ids, bbox, attention_mask, token_type_ids,
+                                            deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "layoutlm")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class LayoutLMForTokenClassificationModule(nn.Module):
+    config: LayoutLMConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, bbox=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = LayoutLMModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                             name="layoutlm")(input_ids, bbox, attention_mask, token_type_ids,
+                                              deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.last_hidden_state)
+        return TokenClassifierOutput(logits=logits)
+
+
+class LayoutLMPretrainedModel(PretrainedModel):
+    config_class = LayoutLMConfig
+    base_model_prefix = "layoutlm"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..bert.modeling import BertPretrainedModel
+
+        return BertPretrainedModel.get_partition_rules(config)
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        import re as _re
+
+        from ..bert.modeling import BertPretrainedModel
+
+        mappings = BertPretrainedModel._get_name_mappings(config, flat_shapes)
+        for m in mappings:
+            m.source_name = _re.sub(r"embeddings_", "embeddings.", m.source_name)
+        return mappings
+
+
+class LayoutLMModel(LayoutLMPretrainedModel):
+    module_class = LayoutLMModule
+
+
+class LayoutLMForMaskedLM(LayoutLMPretrainedModel):
+    module_class = LayoutLMForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"cls\.predictions\.decoder"]
+
+
+class LayoutLMForTokenClassification(LayoutLMPretrainedModel):
+    module_class = LayoutLMForTokenClassificationModule

@@ -277,3 +277,38 @@ class TestMegatronBert:
         assert "encoder.layer.0.attention.ln.weight" in keys
         assert "encoder.ln.weight" in keys
         assert "embeddings.LayerNorm.weight" not in keys
+
+
+class TestLayoutLM:
+    def test_torch_parity_with_bbox(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import LayoutLMConfig as HFC, LayoutLMForMaskedLM as HFM
+
+        from paddlenlp_tpu.transformers import LayoutLMForMaskedLM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=60, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=48, max_position_embeddings=64,
+                     max_2d_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        rng = np.random.default_rng(0)
+        x0 = rng.integers(0, 50, (2, 6)); y0 = rng.integers(0, 50, (2, 6))
+        bbox = np.stack([x0, y0, x0 + rng.integers(1, 40, (2, 6)),
+                         y0 + rng.integers(1, 40, (2, 6))], axis=-1).astype(np.int64)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS), bbox=torch.tensor(bbox),
+                        attention_mask=torch.tensor(MASK)).logits.numpy()
+        m = LayoutLMForMaskedLM.from_pretrained(str(tmp_path))
+        mine = m(input_ids=jnp.asarray(IDS, jnp.int32), bbox=jnp.asarray(bbox, jnp.int32),
+                 attention_mask=jnp.asarray(MASK, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_token_classification_head(self):
+        from paddlenlp_tpu.transformers import LayoutLMConfig, LayoutLMForTokenClassification
+
+        m = LayoutLMForTokenClassification.from_config(
+            LayoutLMConfig(vocab_size=60, hidden_size=32, num_hidden_layers=1,
+                           num_attention_heads=4, intermediate_size=48, num_labels=5), seed=0)
+        out = m(input_ids=jnp.asarray(IDS, jnp.int32))
+        assert out.logits.shape == (2, 6, 5)
