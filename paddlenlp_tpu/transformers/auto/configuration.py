@@ -59,6 +59,7 @@ def _populate():
     from ..ernie_m.configuration import ErnieMConfig
     from ..megatronbert.configuration import MegatronBertConfig
     from ..layoutlm.configuration import LayoutLMConfig
+    from ..rembert.configuration import RemBertConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -75,7 +76,7 @@ def _populate():
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
                 GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
                 MiniGPT4Config, FNetConfig, ErnieMConfig, MegatronBertConfig,
-                LayoutLMConfig):
+                LayoutLMConfig, RemBertConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -201,6 +201,11 @@ def _populate_models():
     register_model("fnet", "sequence_classification", fnet.FNetForSequenceClassification)
     from ..ernie_m import modeling as ernie_m
 
+    from ..rembert import modeling as rembert
+
+    register_model("rembert", "base", rembert.RemBertModel)
+    register_model("rembert", "masked_lm", rembert.RemBertForMaskedLM)
+    register_model("rembert", "sequence_classification", rembert.RemBertForSequenceClassification)
     from ..layoutlm import modeling as layoutlm
 
     register_model("layoutlm", "base", layoutlm.LayoutLMModel)

@@ -1,0 +1,7 @@
+from .configuration import RemBertConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    RemBertForMaskedLM,
+    RemBertForSequenceClassification,
+    RemBertModel,
+    RemBertPretrainedModel,
+)

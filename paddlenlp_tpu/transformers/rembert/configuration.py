@@ -1,0 +1,17 @@
+"""RemBERT configuration (reference: paddlenlp/transformers/rembert/configuration.py)."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["RemBertConfig"]
+
+
+class RemBertConfig(BertConfig):
+    model_type = "rembert"
+
+    def __init__(self, vocab_size: int = 250300, input_embedding_size: int = 256,
+                 output_embedding_size: int = 1664, **kwargs):
+        self.input_embedding_size = input_embedding_size
+        self.output_embedding_size = output_embedding_size
+        super().__init__(vocab_size=vocab_size, **kwargs)

@@ -1,0 +1,164 @@
+"""RemBERT, TPU-native (reference: paddlenlp/transformers/rembert/modeling.py).
+
+"Rebalanced embeddings" BERT: a SMALL decoupled input embedding (256-dim,
+projected up by ``encoder.embedding_hidden_mapping_in``) and a LARGE UNTIED
+output embedding in the MLM head (``cls.predictions.decoder``) — the parameter
+budget moves from the input table into the output projection. Encoder blocks
+are the reused BERT layers.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, BertLayer, VocabEmbed, _dense
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import RemBertConfig
+
+__all__ = ["RemBertModel", "RemBertForMaskedLM", "RemBertForSequenceClassification",
+           "RemBertPretrainedModel"]
+
+
+class RemBertModule(nn.Module):
+    config: RemBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        init = nn.initializers.normal(cfg.initializer_range)
+        E = cfg.input_embedding_size
+        h = VocabEmbed(cfg.vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, E, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, E, dtype=self.dtype, param_dtype=self.param_dtype,
+                         embedding_init=init,
+                         name="embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        h = nn.Dense(cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                     kernel_init=init, name="encoder_embedding_hidden_mapping_in")(h)
+        h = shard_constraint(h, P("batch", "act_seq", "act_embed"))
+        for i in range(cfg.num_hidden_layers):
+            h = BertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layer_{i}")(
+                h, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class RemBertForMaskedLMModule(nn.Module):
+    config: RemBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = RemBertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                          name="rembert")(input_ids, attention_mask, token_type_ids,
+                                          deterministic=deterministic).last_hidden_state
+        # decoupled UNTIED output head: dense -> act -> LN -> decoder
+        x = nn.Dense(cfg.output_embedding_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="predictions_dense")(h)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="predictions_LayerNorm")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="predictions_decoder")(x)
+        return MaskedLMOutput(logits=shard_constraint(logits, P("batch", "act_seq", "act_vocab")))
+
+
+class RemBertForSequenceClassificationModule(nn.Module):
+    config: RemBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = RemBertModule(cfg, self.dtype, self.param_dtype, name="rembert")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class RemBertPretrainedModel(PretrainedModel):
+    config_class = RemBertConfig
+    base_model_prefix = "rembert"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        from ..bert.modeling import BertPretrainedModel
+
+        return BertPretrainedModel.get_partition_rules(config) + [
+            (r"predictions_decoder/kernel$", P("embed", "vocab")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layer_(\d+)\b", r"encoder@layer@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("encoder_embedding_hidden_mapping_in", "encoder@embedding_hidden_mapping_in")
+            key = key.replace("attention_self_", "attention@self@")
+            key = key.replace("attention_output_LayerNorm", "attention@output@LayerNorm")
+            key = key.replace("attention_output_dense", "attention@output@dense")
+            key = key.replace("intermediate_dense", "intermediate@dense")
+            key = key.replace("output_LayerNorm", "output@LayerNorm")
+            key = key.replace("output_dense", "output@dense")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("predictions_LayerNorm", "cls@predictions@LayerNorm")
+            key = key.replace("predictions_dense", "cls@predictions@dense")
+            key = key.replace("predictions_decoder", "cls@predictions@decoder")
+            key = key.replace("/", ".").replace("@", ".")
+            if key.endswith((".kernel", ".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            ndim = len(getattr(leaf, "shape", ()))
+            action = "transpose" if path.endswith("/kernel") and ndim == 2 else None
+            mappings.append(StateDictNameMapping(key, path, action))
+        return mappings
+
+
+class RemBertModel(RemBertPretrainedModel):
+    module_class = RemBertModule
+
+
+class RemBertForMaskedLM(RemBertPretrainedModel):
+    module_class = RemBertForMaskedLMModule
+
+
+class RemBertForSequenceClassification(RemBertPretrainedModel):
+    module_class = RemBertForSequenceClassificationModule

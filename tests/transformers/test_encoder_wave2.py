@@ -312,3 +312,37 @@ class TestLayoutLM:
                            num_attention_heads=4, intermediate_size=48, num_labels=5), seed=0)
         out = m(input_ids=jnp.asarray(IDS, jnp.int32))
         assert out.logits.shape == (2, 6, 5)
+
+
+class TestRemBert:
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import RemBertConfig as HFC, RemBertForMaskedLM as HFM
+
+        from paddlenlp_tpu.transformers import RemBertForMaskedLM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=60, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                     intermediate_size=48, max_position_embeddings=64,
+                     input_embedding_size=16, output_embedding_size=24,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                     classifier_dropout_prob=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS), attention_mask=torch.tensor(MASK)).logits.numpy()
+        m = RemBertForMaskedLM.from_pretrained(str(tmp_path))
+        mine = m(input_ids=jnp.asarray(IDS, jnp.int32),
+                 attention_mask=jnp.asarray(MASK, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_decoupled_embedding_shapes(self):
+        from paddlenlp_tpu.transformers import RemBertConfig, RemBertModel
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+        m = RemBertModel.from_config(
+            RemBertConfig(vocab_size=60, hidden_size=32, num_hidden_layers=1,
+                          num_attention_heads=4, intermediate_size=48,
+                          input_embedding_size=16), seed=0)
+        flat = flatten_params(m.params)
+        assert flat["embeddings_word_embeddings/embedding"].shape == (60, 16)
+        assert flat["encoder_embedding_hidden_mapping_in/kernel"].shape == (16, 32)
