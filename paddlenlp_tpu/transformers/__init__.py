@@ -172,6 +172,12 @@ from .roformer import (  # noqa: F401
 )
 from .tinybert import TinyBertConfig, TinyBertForSequenceClassification, TinyBertModel  # noqa: F401
 from .fnet import FNetConfig, FNetForMaskedLM, FNetForSequenceClassification, FNetModel  # noqa: F401
+from .squeezebert import (  # noqa: F401
+    SqueezeBertConfig,
+    SqueezeBertForMaskedLM,
+    SqueezeBertForSequenceClassification,
+    SqueezeBertModel,
+)
 from .rembert import (  # noqa: F401
     RemBertConfig,
     RemBertForMaskedLM,

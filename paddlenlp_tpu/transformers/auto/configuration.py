@@ -60,6 +60,7 @@ def _populate():
     from ..megatronbert.configuration import MegatronBertConfig
     from ..layoutlm.configuration import LayoutLMConfig
     from ..rembert.configuration import RemBertConfig
+    from ..squeezebert.configuration import SqueezeBertConfig
     from ..clip.configuration import CLIPConfig
     from ..chineseclip.configuration import ChineseCLIPConfig
     from ..blip.configuration import BlipConfig
@@ -76,7 +77,7 @@ def _populate():
                 DistilBertConfig, NezhaConfig, MPNetConfig, DebertaV2Config,
                 GPTJConfig, CodeGenConfig, RoFormerConfig, TinyBertConfig, PPMiniLMConfig,
                 MiniGPT4Config, FNetConfig, ErnieMConfig, MegatronBertConfig,
-                LayoutLMConfig, RemBertConfig):
+                LayoutLMConfig, RemBertConfig, SqueezeBertConfig):
         register_config(cfg.model_type, cfg)
     register_config("gpt2", GPTConfig)
 

@@ -201,6 +201,12 @@ def _populate_models():
     register_model("fnet", "sequence_classification", fnet.FNetForSequenceClassification)
     from ..ernie_m import modeling as ernie_m
 
+    from ..squeezebert import modeling as squeezebert
+
+    register_model("squeezebert", "base", squeezebert.SqueezeBertModel)
+    register_model("squeezebert", "masked_lm", squeezebert.SqueezeBertForMaskedLM)
+    register_model("squeezebert", "sequence_classification",
+                   squeezebert.SqueezeBertForSequenceClassification)
     from ..rembert import modeling as rembert
 
     register_model("rembert", "base", rembert.RemBertModel)

@@ -1,0 +1,7 @@
+from .configuration import SqueezeBertConfig  # noqa: F401
+from .modeling import (  # noqa: F401
+    SqueezeBertForMaskedLM,
+    SqueezeBertForSequenceClassification,
+    SqueezeBertModel,
+    SqueezeBertPretrainedModel,
+)

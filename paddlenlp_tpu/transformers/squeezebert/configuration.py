@@ -1,0 +1,22 @@
+"""SqueezeBERT configuration (reference: paddlenlp/transformers/squeezebert/configuration.py)."""
+
+from __future__ import annotations
+
+from ..bert.configuration import BertConfig
+
+__all__ = ["SqueezeBertConfig"]
+
+
+class SqueezeBertConfig(BertConfig):
+    model_type = "squeezebert"
+
+    def __init__(self, q_groups: int = 4, k_groups: int = 4, v_groups: int = 4,
+                 post_attention_groups: int = 1, intermediate_groups: int = 4,
+                 output_groups: int = 4, **kwargs):
+        self.q_groups = q_groups
+        self.k_groups = k_groups
+        self.v_groups = v_groups
+        self.post_attention_groups = post_attention_groups
+        self.intermediate_groups = intermediate_groups
+        self.output_groups = output_groups
+        super().__init__(**kwargs)

@@ -1,0 +1,211 @@
+"""SqueezeBERT, TPU-native (reference: paddlenlp/transformers/squeezebert/modeling.py).
+
+BERT where every projection is a GROUPED pointwise convolution (q/k/v,
+post-attention, ffn in/out) — the mobile-efficiency design. Grouped pointwise
+conv == block-diagonal matmul, which maps cleanly onto the MXU via
+``nn.Conv(feature_group_count=g, kernel_size=(1,))``. Post-LN residuals,
+standard BERT embeddings and tied MLM head.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ...ops.flash_attention import dot_product_attention
+from ...parallel.partition import P, shard_constraint
+from ..bert.modeling import ACT2FN, VocabEmbed, _dense
+from ..llama.modeling import tied_mlm_head
+from ..model_outputs import (
+    BaseModelOutputWithPoolingAndCrossAttentions,
+    MaskedLMOutput,
+    SequenceClassifierOutput,
+)
+from ..model_utils import PretrainedModel
+from .configuration import SqueezeBertConfig
+
+__all__ = ["SqueezeBertModel", "SqueezeBertForMaskedLM",
+           "SqueezeBertForSequenceClassification", "SqueezeBertPretrainedModel"]
+
+
+def _gconv(features, groups, cfg, dtype, param_dtype, name):
+    return nn.Conv(features, kernel_size=(1,), feature_group_count=groups, use_bias=True,
+                   dtype=dtype, param_dtype=param_dtype,
+                   kernel_init=nn.initializers.normal(cfg.initializer_range), name=name)
+
+
+class SqueezeBertLayer(nn.Module):
+    config: SqueezeBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic=True):
+        cfg = self.config
+        B, T, D = h.shape
+        n, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype,
+                                       param_dtype=self.param_dtype, name=name)
+        q = _gconv(D, cfg.q_groups, cfg, self.dtype, self.param_dtype,
+                   "attention_query")(h).reshape(B, T, n, hd)
+        k = _gconv(D, cfg.k_groups, cfg, self.dtype, self.param_dtype,
+                   "attention_key")(h).reshape(B, T, n, hd)
+        v = _gconv(D, cfg.v_groups, cfg, self.dtype, self.param_dtype,
+                   "attention_value")(h).reshape(B, T, n, hd)
+        q = shard_constraint(q, P("batch", None, "act_heads", None))
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask,
+                                     causal=False).reshape(B, T, D)
+        attn = _gconv(D, cfg.post_attention_groups, cfg, self.dtype, self.param_dtype,
+                      "post_attention_conv1d")(attn)
+        h = ln("post_attention_layernorm")(h + attn)
+        ff = ACT2FN[cfg.hidden_act](_gconv(cfg.intermediate_size, cfg.intermediate_groups, cfg,
+                                           self.dtype, self.param_dtype, "intermediate_conv1d")(h))
+        ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
+        ff = _gconv(D, cfg.output_groups, cfg, self.dtype, self.param_dtype, "output_conv1d")(ff)
+        h = ln("output_layernorm")(h + ff)
+        return shard_constraint(h, P("batch", "act_seq", "act_embed"))
+
+
+class SqueezeBertModule(nn.Module):
+    config: SqueezeBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None, position_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        T = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(T)[None, :]
+        init = nn.initializers.normal(cfg.initializer_range)
+        h = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, param_dtype=self.param_dtype,
+                       embedding_init=init, name="embeddings_word_embeddings")(input_ids)
+        h = h + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_position_embeddings")(position_ids)
+        h = h + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, embedding_init=init,
+                         name="embeddings_token_type_embeddings")(token_type_ids)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="embeddings_LayerNorm")(h)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            h = nn.Dropout(cfg.hidden_dropout_prob)(h, deterministic=False)
+        for i in range(cfg.num_hidden_layers):
+            h = SqueezeBertLayer(cfg, self.dtype, self.param_dtype, name=f"encoder_layers_{i}")(
+                h, attention_mask, deterministic)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = jnp.tanh(_dense(cfg.hidden_size, cfg, self.dtype, self.param_dtype,
+                                     "pooler_dense")(h[:, 0]))
+        return BaseModelOutputWithPoolingAndCrossAttentions(last_hidden_state=h, pooler_output=pooled)
+
+
+class SqueezeBertForMaskedLMModule(nn.Module):
+    config: SqueezeBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        h = SqueezeBertModule(cfg, self.dtype, self.param_dtype, add_pooling_layer=False,
+                              name="transformer")(input_ids, attention_mask, token_type_ids,
+                                                  deterministic=deterministic).last_hidden_state
+        table = self.get_variable("params", "transformer")["embeddings_word_embeddings"]["embedding"]
+        logits = tied_mlm_head(self, h, table=table, vocab_size=cfg.vocab_size,
+                               hidden_size=cfg.hidden_size, act=cfg.hidden_act,
+                               layer_norm_eps=cfg.layer_norm_eps, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               dense_name="predictions_transform_dense",
+                               ln_name="predictions_transform_LayerNorm",
+                               bias_name="predictions_bias")
+        return MaskedLMOutput(logits=logits)
+
+
+class SqueezeBertForSequenceClassificationModule(nn.Module):
+    config: SqueezeBertConfig
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids=None, attention_mask=None, token_type_ids=None,
+                 deterministic=True, output_hidden_states=False, return_dict=True):
+        cfg = self.config
+        out = SqueezeBertModule(cfg, self.dtype, self.param_dtype, name="transformer")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = nn.Dense(cfg.num_labels, dtype=self.dtype, param_dtype=self.param_dtype,
+                          name="classifier")(out.pooler_output)
+        return SequenceClassifierOutput(logits=logits)
+
+
+class SqueezeBertPretrainedModel(PretrainedModel):
+    config_class = SqueezeBertConfig
+    base_model_prefix = "transformer"
+
+    def dummy_inputs(self):
+        return {"input_ids": jnp.zeros((1, 8), dtype=jnp.int32)}
+
+    @classmethod
+    def get_partition_rules(cls, config=None):
+        return [
+            (r"word_embeddings/embedding$", P("vocab", "embed")),
+            (r"(intermediate_conv1d)/kernel$", P(None, "embed", "mlp")),
+            (r"(output_conv1d)/kernel$", P(None, "mlp", "embed")),
+        ]
+
+    @classmethod
+    def _get_name_mappings(cls, config, flat_shapes):
+        from ..conversion_utils import StateDictNameMapping
+
+        mappings = []
+        for path, leaf in flat_shapes.items():
+            key = re.sub(r"\bencoder_layers_(\d+)\b", r"encoder@layers@\1", path)
+            key = key.replace("embeddings_", "embeddings@")
+            key = key.replace("attention_query", "attention@query")
+            key = key.replace("attention_key", "attention@key")
+            key = key.replace("attention_value", "attention@value")
+            key = key.replace("post_attention_conv1d", "post_attention@conv1d")
+            key = key.replace("post_attention_layernorm", "post_attention@layernorm")
+            key = key.replace("intermediate_conv1d", "intermediate@conv1d")
+            key = key.replace("output_conv1d", "output@conv1d")
+            key = key.replace("output_layernorm", "output@layernorm")
+            key = key.replace("pooler_dense", "pooler@dense")
+            key = key.replace("predictions_transform_LayerNorm", "cls@predictions@transform@LayerNorm")
+            key = key.replace("predictions_transform_dense", "cls@predictions@transform@dense")
+            key = key.replace("predictions_bias", "cls@predictions@bias")
+            key = key.replace("/", ".").replace("@", ".")
+            ndim = len(getattr(leaf, "shape", ()))
+            fn = fn_reverse = None
+            action = None
+            if key.endswith(".kernel"):
+                key = key.rsplit(".", 1)[0] + ".weight"
+                if ndim == 2:
+                    action = "transpose"
+                elif ndim == 3:  # grouped conv1d: flax [1, I/g, O] <- torch [O, I/g, 1]
+                    fn = lambda a: np.ascontiguousarray(np.transpose(a, (2, 1, 0)))
+                    fn_reverse = lambda a: np.ascontiguousarray(np.transpose(a, (2, 1, 0)))
+            elif key.endswith((".scale", ".embedding")):
+                key = key.rsplit(".", 1)[0] + ".weight"
+            mappings.append(StateDictNameMapping(key, path, action, fn, fn_reverse))
+        return mappings
+
+
+class SqueezeBertModel(SqueezeBertPretrainedModel):
+    module_class = SqueezeBertModule
+
+
+class SqueezeBertForMaskedLM(SqueezeBertPretrainedModel):
+    module_class = SqueezeBertForMaskedLMModule
+    _keys_to_ignore_on_load_unexpected = [r"cls\.predictions\.decoder"]
+
+
+class SqueezeBertForSequenceClassification(SqueezeBertPretrainedModel):
+    module_class = SqueezeBertForSequenceClassificationModule

@@ -346,3 +346,43 @@ class TestRemBert:
         flat = flatten_params(m.params)
         assert flat["embeddings_word_embeddings/embedding"].shape == (60, 16)
         assert flat["encoder_embedding_hidden_mapping_in/kernel"].shape == (16, 32)
+
+
+class TestSqueezeBert:
+    def test_torch_parity(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from transformers import SqueezeBertConfig as HFC, SqueezeBertForMaskedLM as HFM
+
+        from paddlenlp_tpu.transformers import SqueezeBertForMaskedLM
+
+        torch.manual_seed(0)
+        hm = HFM(HFC(vocab_size=60, hidden_size=32, embedding_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=48, max_position_embeddings=64,
+                     q_groups=2, k_groups=2, v_groups=2, post_attention_groups=2,
+                     intermediate_groups=2, output_groups=2,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)).eval()
+        hm.save_pretrained(str(tmp_path), safe_serialization=True)
+        with torch.no_grad():
+            golden = hm(input_ids=torch.tensor(IDS), attention_mask=torch.tensor(MASK)).logits.numpy()
+        m = SqueezeBertForMaskedLM.from_pretrained(str(tmp_path))
+        mine = m(input_ids=jnp.asarray(IDS, jnp.int32),
+                 attention_mask=jnp.asarray(MASK, jnp.int32)).logits
+        np.testing.assert_allclose(np.asarray(mine), golden, atol=3e-4)
+
+    def test_grouped_conv_kernels(self, tmp_path):
+        from paddlenlp_tpu.transformers import SqueezeBertConfig, SqueezeBertModel
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+        m = SqueezeBertModel.from_config(
+            SqueezeBertConfig(vocab_size=60, hidden_size=32, num_hidden_layers=1,
+                              num_attention_heads=4, intermediate_size=48,
+                              q_groups=2, k_groups=2, v_groups=2, post_attention_groups=2,
+                              intermediate_groups=2, output_groups=2), seed=0)
+        flat = flatten_params(m.params)
+        # grouped pointwise conv: [1, in/groups, out]
+        assert flat["encoder_layers_0/attention_query/kernel"].shape == (1, 16, 32)
+        m.save_pretrained(str(tmp_path))
+        m2 = SqueezeBertModel.from_pretrained(str(tmp_path))
+        ids = jnp.asarray(IDS, jnp.int32)
+        np.testing.assert_allclose(np.asarray(m(input_ids=ids).last_hidden_state),
+                                   np.asarray(m2(input_ids=ids).last_hidden_state), atol=1e-5)
