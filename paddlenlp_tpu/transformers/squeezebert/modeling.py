@@ -56,15 +56,23 @@ class SqueezeBertLayer(nn.Module):
         v = _gconv(D, cfg.v_groups, cfg, self.dtype, self.param_dtype,
                    "attention_value")(h).reshape(B, T, n, hd)
         q = shard_constraint(q, P("batch", None, "act_heads", None))
-        attn = dot_product_attention(q, k, v, attention_mask=attention_mask,
-                                     causal=False).reshape(B, T, D)
+        k = shard_constraint(k, P("batch", None, "act_kv_heads", None))
+        v = shard_constraint(v, P("batch", None, "act_kv_heads", None))
+        drop = cfg.attention_probs_dropout_prob if not deterministic else 0.0
+        rng = self.make_rng("dropout") if drop > 0 else None
+        attn = dot_product_attention(q, k, v, attention_mask=attention_mask, causal=False,
+                                     dropout_rate=drop, dropout_rng=rng).reshape(B, T, D)
         attn = _gconv(D, cfg.post_attention_groups, cfg, self.dtype, self.param_dtype,
                       "post_attention_conv1d")(attn)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
         h = ln("post_attention_layernorm")(h + attn)
         ff = ACT2FN[cfg.hidden_act](_gconv(cfg.intermediate_size, cfg.intermediate_groups, cfg,
                                            self.dtype, self.param_dtype, "intermediate_conv1d")(h))
         ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
         ff = _gconv(D, cfg.output_groups, cfg, self.dtype, self.param_dtype, "output_conv1d")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
         h = ln("output_layernorm")(h + ff)
         return shard_constraint(h, P("batch", "act_seq", "act_embed"))
 
