@@ -114,6 +114,8 @@ class DisentangledSelfAttention(nn.Module):
             scores = jnp.where(attention_mask[:, None, None, :].astype(bool),
                                scores.astype(jnp.float32), neg)
         probs = jnp.asarray(nn.softmax(scores.astype(jnp.float32), axis=-1), self.dtype)
+        if not deterministic and cfg.attention_probs_dropout_prob > 0:
+            probs = nn.Dropout(cfg.attention_probs_dropout_prob)(probs, deterministic=False)
         ctx = jnp.einsum("bnqk,bknh->bqnh", probs, v).reshape(B, T, D)
         return ctx
 
@@ -135,10 +137,16 @@ class DebertaV2Layer(nn.Module):
         attn = DisentangledSelfAttention(cfg, self.dtype, self.param_dtype,
                                          name="attention_self")(h, attention_mask, rel_embeddings,
                                                                 deterministic)
-        h = ln("attention_output_LayerNorm")(h + dense(D, "attention_output_dense")(attn))
+        attn = dense(D, "attention_output_dense")(attn)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
+        h = ln("attention_output_LayerNorm")(h + attn)
         ff = ACT2FN[cfg.hidden_act](dense(cfg.intermediate_size, "intermediate_dense")(h))
         ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
-        h = ln("output_LayerNorm")(h + dense(D, "output_dense")(ff))
+        ff = dense(D, "output_dense")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
+        h = ln("output_LayerNorm")(h + ff)
         return shard_constraint(h, P("batch", "act_seq", "act_embed"))
 
 

@@ -72,16 +72,22 @@ class NezhaLayer(nn.Module):
             neg = jnp.finfo(scores.dtype).min
             scores = jnp.where(attention_mask[:, None, None, :].astype(bool), scores, neg)
         probs = jnp.asarray(nn.softmax(scores.astype(jnp.float32), axis=-1), self.dtype)
+        if not deterministic and cfg.attention_probs_dropout_prob > 0:
+            probs = nn.Dropout(cfg.attention_probs_dropout_prob)(probs, deterministic=False)
         ctx = jnp.einsum("bnqk,bknh->bqnh", probs, v)
         ctx = ctx + jnp.einsum("bnqk,qkh->bqnh", probs, rel)
         attn = _dense(D, cfg, self.dtype, self.param_dtype, "attention_output_dense")(
             ctx.reshape(B, T, D))
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            attn = nn.Dropout(cfg.hidden_dropout_prob)(attn, deterministic=False)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
                          name="attention_output_LayerNorm")(h + attn)
         ff = ACT2FN[cfg.hidden_act](_dense(cfg.intermediate_size, cfg, self.dtype,
                                            self.param_dtype, "intermediate_dense")(h))
         ff = shard_constraint(ff, P("batch", "seq", "act_mlp"))
         ff = _dense(D, cfg, self.dtype, self.param_dtype, "output_dense")(ff)
+        if not deterministic and cfg.hidden_dropout_prob > 0:
+            ff = nn.Dropout(cfg.hidden_dropout_prob)(ff, deterministic=False)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, param_dtype=self.param_dtype,
                          name="output_LayerNorm")(h + ff)
         return shard_constraint(h, P("batch", "act_seq", "act_embed"))
