@@ -89,14 +89,41 @@ def serve(predictor, port: int = 8011):
     server.serve_forever()
 
 
+def serve_v1(predictor, port: int = 8011):
+    """Serve through the continuous-batching runtime (paddlenlp_tpu.serving):
+    concurrent requests share the engine's running batch instead of taking
+    turns behind the legacy per-request lock; adds /v1/completions SSE
+    streaming, admission control (429/503) and /metrics. Needs --mode block."""
+    from paddlenlp_tpu.serving import SchedulerConfig, ServingServer
+
+    if not isinstance(predictor, BlockPredictor):
+        raise ValueError("--api v1 needs the paged engine: run with --mode block")
+    server = ServingServer(
+        predictor.engine,
+        tokenizer=predictor.tokenizer,
+        scheduler_config=SchedulerConfig(max_inflight=4 * predictor.args.batch_size),
+        max_src_tokens=predictor.args.src_length,
+    )
+    server.run(port=port)
+
+
 def main():
     parser = PdArgumentParser((PredictorArgument,))
     (args, remaining) = parser.parse_args_into_dataclasses(return_remaining_strings=True)
-    port = 8011
+    port, api = 8011, "legacy"
     for i, r in enumerate(remaining):
-        if r == "--port":
-            port = int(remaining[i + 1])
-    serve(create_predictor(args), port)
+        if r in ("--port", "--api"):
+            if i + 1 >= len(remaining):
+                raise SystemExit(f"{r} requires a value")
+            if r == "--port":
+                port = int(remaining[i + 1])
+            else:
+                api = remaining[i + 1]
+    predictor = create_predictor(args)
+    if api == "v1":
+        serve_v1(predictor, port)
+    else:
+        serve(predictor, port)
 
 
 if __name__ == "__main__":

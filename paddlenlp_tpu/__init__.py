@@ -7,5 +7,5 @@ PaddlePaddle/PaddleNLP (see SURVEY.md for the blueprint).
 __version__ = "0.1.0.dev0"
 
 from . import data, datasets, generation, metrics, ops, parallel, peft, quantization  # noqa: F401
-from . import dataaug, embeddings, layers, losses, seq2vec, server  # noqa: F401
+from . import dataaug, embeddings, layers, losses, seq2vec, server, serving  # noqa: F401
 from . import taskflow, trainer, transformers, trl, utils  # noqa: F401

@@ -64,12 +64,36 @@ class Request:
     done: bool = False
     stream_cb: Optional[Callable[[int, bool], None]] = None
     arrival_t: float = 0.0
+    sched_t: Optional[float] = None  # first admitted to a slot (prefill launch)
     first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    finish_reason: Optional[str] = None  # stop | length | abort | capacity
+    aborted: bool = False
     base_prompt_len: int = 0  # original prompt length (preemption grows prompt_ids)
 
     @property
     def total_len(self) -> int:
         return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        """Seconds spent waiting before first admission (TTFT = queue + prefill)."""
+        if self.sched_t is None:
+            return None
+        return self.sched_t - self.arrival_t
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.arrival_t
+
+    @property
+    def decode_time(self) -> Optional[float]:
+        """Seconds from first token to completion (0 for single-token results)."""
+        if self.finish_t is None or self.first_token_t is None:
+            return None
+        return self.finish_t - self.first_token_t
 
     @property
     def gen_offset(self) -> int:
@@ -136,6 +160,11 @@ class InferenceEngine:
         self._spec_seed = spec_seed
         self._spec_rngs: Dict[int, np.random.Generator] = {}
         self.spec_stats = {"verify_steps": 0, "tokens_emitted": 0, "drafted": 0, "accepted": 0}
+        self.num_preemptions = 0
+        # serving hook: called after every step() with a stats dict (queue
+        # depth, running slots, free KV blocks) — the metrics plane subscribes
+        # here instead of monkey-patching the loop
+        self.step_cb: Optional[Callable[[Dict], None]] = None
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -155,6 +184,48 @@ class InferenceEngine:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(r is not None for r in self.slots)
 
+    def abort(self, req_id: int) -> Optional[Request]:
+        """Cancel a request wherever it is (waiting queue or a running slot).
+
+        Counterpart of the reference's stop-flag write into the running batch
+        (step.cu clears the slot; here the host owns scheduling so it is a
+        plain dict/slot edit). Frees the request's KV blocks, marks it
+        ``aborted`` with ``finish_reason='abort'`` and returns it; returns
+        None for ids that are unknown or already finished. The stream callback
+        is NOT fired — cancellation notification is the caller's job (the
+        serving loop resolves the handle)."""
+        for i, req in enumerate(self.waiting):
+            if req.req_id == req_id:
+                del self.waiting[i]
+                self._finish_abort(req)
+                return req
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.req_id == req_id:
+                self.mgr.free_seq(req.req_id)
+                self.slots[slot] = None
+                self._finish_abort(req)
+                return req
+        return None
+
+    def _finish_abort(self, req: Request):
+        req.done = True
+        req.aborted = True
+        req.finish_reason = "abort"
+        req.finish_t = time.time()
+        self._spec_rngs.pop(req.req_id, None)
+
+    def stats(self) -> Dict:
+        """Point-in-time scheduler/allocator stats (the step_cb payload)."""
+        return {
+            "queue_depth": len(self.waiting),
+            "running": sum(1 for r in self.slots if r is not None),
+            "max_batch_size": self.max_batch_size,
+            "free_blocks": self.mgr.num_free,
+            "total_blocks": self.mgr.total_usable_blocks,
+            "num_preemptions": self.num_preemptions,
+            "spec_stats": dict(self.spec_stats),
+        }
+
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
         """Submit a batch and run to completion (convenience API)."""
         ids = [self.add_request(p, sampling) for p in prompts]
@@ -170,6 +241,8 @@ class InferenceEngine:
         finished: List[Request] = []
         self._admit(finished)
         self._decode_running(finished)
+        if self.step_cb is not None:
+            self.step_cb(self.stats())
         return finished
 
     def _samp_arrays(self, reqs: List[Optional[Request]]):
@@ -206,6 +279,8 @@ class InferenceEngine:
             if need > self.mgr.max_blocks_per_seq or need > self.mgr.total_usable_blocks:
                 self.waiting.popleft()
                 req.done = True
+                req.finish_reason = "capacity"
+                req.finish_t = time.time()
                 logger.warning(f"req {req.req_id}: needs {need} KV blocks (> capacity); rejected")
                 finished.append(req)
                 continue
@@ -213,6 +288,8 @@ class InferenceEngine:
             if not self.mgr.can_allocate(prompt_len + 1):
                 break
             self.waiting.popleft()
+            if req.sched_t is None:  # preserved across preemption-requeues
+                req.sched_t = time.time()
             self.mgr.allocate(req.req_id, prompt_len)
             admitted.append((free.pop(0), req))
 
@@ -354,6 +431,7 @@ class InferenceEngine:
         recovery, the step.cu is_block_step/recover list)."""
         req = self.slots[slot]
         logger.warning(f"req {req.req_id}: KV blocks exhausted; preempting (recompute)")
+        self.num_preemptions += 1
         self.mgr.free_seq(req.req_id)
         self.slots[slot] = None
         req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
@@ -544,5 +622,8 @@ class InferenceEngine:
         is_eos = tok in self.eos_ids
         hit_max = req.gen_offset + len(req.output_ids) >= req.sampling.max_new_tokens
         req.done = is_eos or hit_max
+        if req.done:
+            req.finish_t = time.time()
+            req.finish_reason = "stop" if is_eos else "length"
         if req.stream_cb is not None:
             req.stream_cb(tok, req.done)

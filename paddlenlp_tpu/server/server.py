@@ -23,11 +23,15 @@ from .handlers import ClsPostHandler, CustomModelHandler, TaskflowHandler
 
 __all__ = ["SimpleServer"]
 
+#: reject request bodies larger than this with 413 (overridable per instance)
+MAX_BODY_BYTES = 8 << 20
+
 
 class SimpleServer:
-    def __init__(self):
+    def __init__(self, max_body_bytes: int = MAX_BODY_BYTES):
         self._routes: Dict[str, Callable[[Any, Dict[str, Any]], Any]] = {}
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self.max_body_bytes = max_body_bytes
 
     # ------------------------------------------------------------------ register
     def register(self, task_name: str, model_path: str, tokenizer_name: Optional[str] = None,
@@ -67,6 +71,7 @@ class SimpleServer:
     # ------------------------------------------------------------------ serve
     def _make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
         routes = self._routes
+        max_body = self.max_body_bytes
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -87,18 +92,33 @@ class SimpleServer:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                fn = routes.get(self.path)
-                if fn is None:
-                    self._send(404, {"error": f"no route {self.path}", "routes": sorted(routes)})
-                    return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                    result = fn(body.get("data"), body.get("parameters") or {})
-                    self._send(200, {"result": result})
-                except Exception as e:  # surfaced to the client, not swallowed
-                    logger.warning(f"server error on {self.path}: {e}")
-                    self._send(500, {"error": str(e)})
+                    fn = routes.get(self.path)
+                    if fn is None:
+                        self._send(404, {"error": f"no route {self.path}", "routes": sorted(routes)})
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                    except ValueError:
+                        self._send(400, {"error": "invalid Content-Length header"})
+                        return
+                    if n > max_body:
+                        # reject before reading: an oversized body never buffers
+                        self._send(413, {"error": f"body of {n} bytes exceeds limit {max_body}"})
+                        return
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        result = fn(body.get("data"), body.get("parameters") or {})
+                        self._send(200, {"result": result})
+                    except (BrokenPipeError, ConnectionResetError):
+                        raise
+                    except Exception as e:  # surfaced to the client, not swallowed
+                        logger.warning(f"server error on {self.path}: {e}")
+                        self._send(500, {"error": str(e)})
+                except (BrokenPipeError, ConnectionResetError):
+                    # client hung up: the socket is dead, a second write from an
+                    # error path would just raise again — log and drop
+                    logger.debug(f"server: client disconnected on {self.path}")
 
         return ThreadingHTTPServer((host, port), Handler)
 

@@ -1,0 +1,33 @@
+"""Continuous-batching LLM serving runtime (engine loop, admission control,
+OpenAI-style HTTP API with SSE streaming, Prometheus metrics plane).
+
+Import order matters for dependency weight: :mod:`.metrics` is stdlib-only
+(reused by trainer callbacks/tools); the loop/scheduler/API pull in the
+jax-backed engine lazily at construction time.
+"""
+
+from .api import ServingServer  # noqa: F401
+from .engine_loop import EngineLoop, RequestHandle, ServingMetrics  # noqa: F401
+from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .scheduler import (  # noqa: F401
+    SaturatedError,
+    Scheduler,
+    SchedulerConfig,
+    ShuttingDownError,
+)
+
+__all__ = [
+    "ServingServer",
+    "EngineLoop",
+    "RequestHandle",
+    "ServingMetrics",
+    "Scheduler",
+    "SchedulerConfig",
+    "SaturatedError",
+    "ShuttingDownError",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "REGISTRY",
+]

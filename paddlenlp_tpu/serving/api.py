@@ -1,0 +1,350 @@
+"""OpenAI-style HTTP front end for the continuous-batching engine.
+
+Counterpart of the reference's ``llm/predict/flask_server.py`` (streaming chat
+HTTP) and ``paddlenlp/server`` (REST), rebuilt on the serving runtime: requests
+go through :class:`Scheduler` admission into the :class:`EngineLoop`, tokens
+stream back over SSE, and the metrics plane is scraped at ``/metrics``.
+Stdlib ``ThreadingHTTPServer`` only (no flask/fastapi in the image).
+
+Routes::
+
+    POST /v1/completions   {"prompt": str | [int], "max_tokens": int,
+                            "stream": bool, "temperature"/"top_p"/"top_k"/
+                            "seed"/"do_sample", "timeout": float}
+    POST /v1/abort         {"id": "cmpl-N"}        — cancel an in-flight request
+    GET  /metrics          Prometheus text exposition
+    GET  /health           liveness + scheduler/engine stats
+
+Backpressure maps to HTTP: 429 when the admission window is full (retryable),
+503 while draining, 413 for oversized bodies. A client disconnect mid-stream
+aborts the request so its KV blocks free immediately.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..utils.log import logger
+from .engine_loop import EngineLoop, RequestHandle, ServingMetrics
+from .metrics import REGISTRY, MetricsRegistry
+from .scheduler import SaturatedError, Scheduler, SchedulerConfig, ShuttingDownError
+
+__all__ = ["ServingServer"]
+
+MAX_BODY_BYTES = 8 << 20  # 8 MiB: far above any sane prompt payload
+
+
+def _sampling_from_payload(payload: dict, max_new_default: int = 64):
+    from ..experimental import SamplingParams
+
+    return SamplingParams(
+        max_new_tokens=int(payload.get("max_tokens", max_new_default)),
+        do_sample=bool(payload.get("do_sample", False)),
+        temperature=float(payload.get("temperature", 1.0)),
+        top_p=float(payload.get("top_p", 1.0)),
+        top_k=int(payload.get("top_k", 0)),
+        seed=int(payload.get("seed", 0)),
+        repetition_penalty=float(payload.get("repetition_penalty", 1.0)),
+        presence_penalty=float(payload.get("presence_penalty", 0.0)),
+        frequency_penalty=float(payload.get("frequency_penalty", 0.0)),
+    )
+
+
+class ServingServer:
+    """Engine + loop + scheduler + HTTP, wired together.
+
+    ``tokenizer`` is optional: without one, ``prompt`` must be a token-id list
+    and responses carry ``token_ids`` instead of decoded ``text`` (the shape
+    the CPU tests and the smoke benchmark use)."""
+
+    def __init__(self, engine, tokenizer=None,
+                 scheduler_config: Optional[SchedulerConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 max_src_tokens: Optional[int] = None):
+        self.engine = engine
+        self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
+        self.registry = registry or REGISTRY
+        self.max_body_bytes = max_body_bytes
+        self.max_src_tokens = max_src_tokens
+        self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry))
+        self.scheduler = Scheduler(self.loop, scheduler_config)
+        self._ids = itertools.count()
+        self._live: Dict[str, RequestHandle] = {}
+        self._live_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------- submission
+    def _encode(self, prompt):
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt needs a tokenizer; pass token ids instead")
+            ids = self.tokenizer.encode(prompt)
+            ids = getattr(ids, "ids", ids)
+        else:
+            ids = [int(t) for t in prompt]
+        if not ids:
+            raise ValueError("empty prompt")
+        if self.max_src_tokens is not None:
+            ids = ids[-self.max_src_tokens:]
+        return ids
+
+    def submit(self, payload: dict):
+        """Parse + admit one completion request. Returns (completion_id, handle)."""
+        if "prompt" not in payload:
+            raise ValueError("missing required field 'prompt'")
+        ids = self._encode(payload["prompt"])
+        sampling = _sampling_from_payload(payload)
+        if sampling.max_new_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        timeout_s = payload.get("timeout")
+        if timeout_s is not None:
+            timeout_s = float(timeout_s)
+            if timeout_s <= 0:
+                raise ValueError("timeout must be > 0 seconds")
+        handle = self.scheduler.submit(ids, sampling, timeout_s=timeout_s)
+        cid = f"cmpl-{next(self._ids)}"
+        with self._live_lock:
+            self._live[cid] = handle
+        handle.add_done_callback(lambda _h: self._forget(cid))
+        return cid, handle
+
+    def _forget(self, cid: str):
+        with self._live_lock:
+            self._live.pop(cid, None)
+
+    def abort(self, cid: str) -> bool:
+        with self._live_lock:
+            handle = self._live.get(cid)
+        if handle is None or handle.done():
+            return False
+        self.scheduler.cancel(handle)
+        return True
+
+    def _decode_delta(self, toks, emitted: int, final: bool = False):
+        """Incremental detokenization: full-decode + diff. A trailing U+FFFD
+        means a codepoint is still split across tokens — hold it back until the
+        next token resolves it (or ``final`` flushes it as-is), otherwise the
+        replacement char would be emitted and never corrected."""
+        if self.tokenizer is None:
+            return None, emitted
+        text = self.tokenizer.decode(toks, skip_special_tokens=True)
+        safe = len(text)
+        if not final:
+            while safe > emitted and text[safe - 1] == "�":
+                safe -= 1
+        return text[emitted:safe], safe
+
+    # ------------------------------------------------------------- http
+    def _make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("serving: " + fmt % args)
+
+            def _send_json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_error_json(self, code: int, message: str, etype: str):
+                self._send_json(code, {"error": {"message": message, "type": etype, "code": code}})
+
+            # --------------------------------------------------------- GET
+            def do_GET(self):
+                try:
+                    if self.path == "/metrics":
+                        body = server.registry.expose().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    elif self.path == "/health":
+                        status = "draining" if server.scheduler.draining else "ok"
+                        self._send_json(200 if status == "ok" else 503, {
+                            "status": status,
+                            "scheduler": server.scheduler.stats(),
+                            "engine": server.engine.stats(),
+                        })
+                    else:
+                        self._send_error_json(404, f"no route {self.path}", "not_found")
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.debug("serving: client disconnected during GET")
+
+            # --------------------------------------------------------- POST
+            def _read_body(self) -> Optional[dict]:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > server.max_body_bytes:
+                    # rejected before reading: the unread body makes this
+                    # connection unusable for keep-alive
+                    self.close_connection = True
+                    self._send_error_json(
+                        413, f"body of {n} bytes exceeds limit {server.max_body_bytes}",
+                        "payload_too_large")
+                    return None
+                raw = self.rfile.read(n) if n else b"{}"
+                try:
+                    payload = json.loads(raw or b"{}")
+                except ValueError as e:
+                    self._send_error_json(400, f"invalid JSON body: {e}", "invalid_request")
+                    return None
+                if not isinstance(payload, dict):
+                    self._send_error_json(400, "body must be a JSON object", "invalid_request")
+                    return None
+                return payload
+
+            def do_POST(self):
+                try:
+                    if self.path == "/v1/completions":
+                        payload = self._read_body()
+                        if payload is not None:
+                            self._completions(payload)
+                    elif self.path == "/v1/abort":
+                        payload = self._read_body()
+                        if payload is not None:
+                            ok = server.abort(str(payload.get("id", "")))
+                            self._send_json(200, {"id": payload.get("id"), "cancelled": ok})
+                    else:
+                        self._send_error_json(404, f"no route {self.path}", "not_found")
+                except (BrokenPipeError, ConnectionResetError):
+                    # dead socket: never attempt a second write
+                    logger.debug("serving: client disconnected during POST")
+                except Exception as e:
+                    logger.warning(f"serving: error on {self.path}: {e!r}")
+                    try:
+                        self._send_error_json(500, str(e), "internal_error")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+            def _completions(self, payload: dict):
+                try:
+                    cid, handle = server.submit(payload)
+                except SaturatedError as e:
+                    self._send_error_json(429, str(e), "rate_limit_exceeded")
+                    return
+                except ShuttingDownError as e:
+                    self._send_error_json(503, str(e), "shutting_down")
+                    return
+                except (ValueError, TypeError) as e:
+                    self._send_error_json(400, str(e), "invalid_request")
+                    return
+                if payload.get("stream"):
+                    self._stream_response(cid, handle)
+                else:
+                    self._batch_response(cid, handle)
+
+            def _batch_response(self, cid: str, handle):
+                req = handle.result()  # deadline enforced by the loop
+                choice = {"index": 0, "finish_reason": req.finish_reason if req else "abort"}
+                toks = list(req.output_ids) if req is not None else []
+                choice["token_ids"] = toks
+                if server.tokenizer is not None:
+                    choice["text"] = server.tokenizer.decode(toks, skip_special_tokens=True)
+                self._send_json(200, {
+                    "id": cid,
+                    "object": "text_completion",
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": handle.prompt_len,
+                        "completion_tokens": len(toks),
+                        "total_tokens": handle.prompt_len + len(toks),
+                    },
+                    "timing": {
+                        "ttft_s": req.ttft if req else None,
+                        "queue_wait_s": req.queue_wait if req else None,
+                        "decode_time_s": req.decode_time if req else None,
+                    },
+                })
+
+            def _stream_response(self, cid: str, handle):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def chunk(obj: dict):
+                    self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+                    self.wfile.flush()
+
+                toks, emitted = [], 0
+                try:
+                    for tok in handle.tokens():
+                        toks.append(tok)
+                        piece, emitted = server._decode_delta(toks, emitted)
+                        c = {"index": 0, "token": tok, "finish_reason": None}
+                        if piece is not None:
+                            c["text"] = piece
+                        chunk({"id": cid, "object": "text_completion.chunk", "choices": [c]})
+                    req = handle.result()
+                    final = {"index": 0,
+                             "finish_reason": req.finish_reason if req else "abort"}
+                    # flush any held-back partial-codepoint text
+                    piece, emitted = server._decode_delta(toks, emitted, final=True)
+                    if piece:
+                        final["text"] = piece
+                    chunk({"id": cid, "object": "text_completion.chunk",
+                           "choices": [final],
+                           "usage": {"prompt_tokens": handle.prompt_len,
+                                     "completion_tokens": len(toks),
+                                     "total_tokens": handle.prompt_len + len(toks)}})
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away mid-stream: free the slot + KV now
+                    logger.debug(f"serving: client disconnected; aborting {cid}")
+                    server.abort(cid)
+                except Exception as e:
+                    # headers already sent — a second status line would corrupt
+                    # the stream; terminate it in-band instead
+                    logger.warning(f"serving: stream {cid} failed: {e!r}")
+                    server.abort(cid)
+                    try:
+                        chunk({"id": cid, "object": "error",
+                               "error": {"message": str(e), "type": "internal_error"}})
+                        self.wfile.write(b"data: [DONE]\n\n")
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        httpd.daemon_threads = True
+        return httpd
+
+    # ------------------------------------------------------------- lifecycle
+    def start_in_thread(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start loop + HTTP without blocking; returns the bound port."""
+        self.loop.start()
+        self._httpd = self._make_httpd(host, port)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True, name="serving-http")
+        t.start()
+        bound = self._httpd.server_address[1]
+        logger.info(f"serving API on {host}:{bound} (POST /v1/completions, GET /metrics)")
+        return bound
+
+    def run(self, host: str = "0.0.0.0", port: int = 8011):
+        self.loop.start()
+        self._httpd = self._make_httpd(host, port)
+        logger.info(f"serving API on {host}:{port} (POST /v1/completions, GET /metrics)")
+        try:
+            self._httpd.serve_forever()
+        finally:
+            self.shutdown()
+
+    def shutdown(self, drain_timeout_s: Optional[float] = 30.0):
+        """Graceful: stop admitting (503), drain in-flight, stop loop + HTTP."""
+        self.scheduler.shutdown(timeout_s=drain_timeout_s)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -1,0 +1,355 @@
+"""Background engine-driver thread: thread-safe submission + token streams.
+
+Counterpart of the reference's serving split (``llm/predict/flask_server.py``
+pushes prompts into the inference process and reads tokens back over a SysV
+message queue): here the ``InferenceEngine`` runs on ONE dedicated thread that
+continuously drives ``engine.step()``, and HTTP worker threads talk to it only
+through queues — the engine itself is never touched concurrently, so the
+host-side block manager needs no locks.
+
+- ``submit()`` returns a :class:`RequestHandle`: a future (``result()``) plus
+  a per-request token queue (``tokens()``) fed by the engine's ``stream_cb``;
+- ``cancel()`` routes through the loop thread to ``engine.abort`` so KV blocks
+  free deterministically between steps;
+- per-request deadlines are enforced by the loop (expired requests abort with
+  ``finish_reason='abort'`` and ``timed_out=True`` on the handle);
+- all request lifecycle events land in the metrics plane (TTFT, queue wait,
+  inter-token latency, tokens, preemptions, KV utilization).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.log import logger
+from .metrics import REGISTRY, MetricsRegistry
+
+__all__ = ["EngineLoop", "RequestHandle", "ServingMetrics"]
+
+_END = object()  # token-queue sentinel: stream closed
+
+
+class RequestHandle:
+    """Client-side view of one in-flight request (future + token stream)."""
+
+    def __init__(self, prompt_len: int, deadline_t: Optional[float] = None):
+        self.req_id: Optional[int] = None  # assigned on the loop thread
+        self.prompt_len = prompt_len
+        self.deadline_t = deadline_t
+        self.submitted_t = time.time()
+        self.timed_out = False
+        self._token_q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._request = None  # engine Request once finished
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._callbacks: List = []
+        self._cb_lock = threading.Lock()
+
+    # ------------------------------------------------------------- futures
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the request finishes; returns the engine ``Request``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.req_id} not finished within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._request
+
+    @property
+    def output_ids(self) -> List[int]:
+        req = self.result()
+        return list(req.output_ids)
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._request.finish_reason if self._request is not None else None
+
+    # ------------------------------------------------------------- streaming
+    def tokens(self, timeout: Optional[float] = None):
+        """Yield token ids in generation order until the stream closes.
+
+        ``timeout`` bounds the wait for EACH token (None = wait forever)."""
+        while True:
+            item = self._token_q.get(timeout=timeout)
+            if item is _END:
+                return
+            tok, done = item
+            yield tok
+            if done:
+                # drain the sentinel the resolver pushes after the last token
+                try:
+                    self._token_q.get_nowait()
+                except queue.Empty:
+                    pass
+                return
+
+    # ------------------------------------------------------------- loop-side
+    def _on_token(self, tok: int, done: bool):
+        self._token_q.put((tok, done))
+
+    def add_done_callback(self, fn):
+        """Run ``fn(handle)`` when the request resolves (immediately if done)."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, request, error: Optional[BaseException] = None):
+        with self._cb_lock:
+            if self._done.is_set():
+                return
+            self._request = request
+            self._error = error
+            self._token_q.put(_END)
+            self._done.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception as e:  # a bad callback must not kill the loop
+                logger.warning(f"request done-callback failed: {e!r}")
+
+
+class ServingMetrics:
+    """Registers the serving metric catalog against one engine.
+
+    Engine-state gauges are pull-mode (sampled at scrape); request-lifecycle
+    series are pushed by the loop. Names are stable API — the README catalog
+    and ``tools/bench_serve.py`` consume them."""
+
+    def __init__(self, engine, registry: Optional[MetricsRegistry] = None):
+        self.registry = r = registry or REGISTRY
+        self.requests = r.counter(
+            "paddlenlp_serving_requests_total", "Finished requests by terminal state",
+            labelnames=("status",))
+        self.tokens = r.counter(
+            "paddlenlp_serving_tokens_generated_total", "Generated tokens (all requests)")
+        self.preemptions = r.counter(
+            "paddlenlp_serving_preemptions_total", "KV-exhaustion preemptions (recompute requeues)")
+        self.ttft = r.histogram(
+            "paddlenlp_serving_ttft_seconds", "Time from arrival to first token")
+        self.queue_wait = r.histogram(
+            "paddlenlp_serving_queue_wait_seconds", "Time from arrival to slot admission")
+        self.inter_token = r.histogram(
+            "paddlenlp_serving_inter_token_seconds", "Latency between consecutive tokens")
+        self.e2e = r.histogram(
+            "paddlenlp_serving_e2e_seconds", "Time from arrival to completion")
+        self.queue_depth = r.gauge(
+            "paddlenlp_serving_queue_depth", "Requests waiting for a slot")
+        self.running = r.gauge(
+            "paddlenlp_serving_running_slots", "Requests actively decoding")
+        self.occupancy = r.gauge(
+            "paddlenlp_serving_slot_occupancy", "running / max_batch_size")
+        self.kv_free = r.gauge(
+            "paddlenlp_serving_kv_free_blocks", "Free KV-cache blocks")
+        self.kv_util = r.gauge(
+            "paddlenlp_serving_kv_utilization", "1 - free/total KV blocks")
+        self.spec_accept = r.gauge(
+            "paddlenlp_serving_spec_acceptance_rate", "Accepted/drafted speculative tokens")
+        mgr = engine.mgr
+        self.queue_depth.set_function(lambda: len(engine.waiting))
+        self.running.set_function(
+            lambda: sum(1 for s in engine.slots if s is not None))
+        self.occupancy.set_function(
+            lambda: sum(1 for s in engine.slots if s is not None) / max(engine.max_batch_size, 1))
+        self.kv_free.set_function(lambda: mgr.num_free)
+        self.kv_util.set_function(
+            lambda: 1.0 - mgr.num_free / max(mgr.total_usable_blocks, 1))
+        self.spec_accept.set_function(
+            lambda: engine.spec_stats["accepted"] / max(engine.spec_stats["drafted"], 1))
+
+    def on_finished(self, req):
+        status = req.finish_reason or ("abort" if req.aborted else "unknown")
+        self.requests.inc(status=status)
+        self.tokens.inc(len(req.output_ids))
+        if req.ttft is not None:
+            self.ttft.observe(req.ttft)
+        if req.queue_wait is not None:
+            self.queue_wait.observe(req.queue_wait)
+        if req.finish_t is not None:
+            self.e2e.observe(req.finish_t - req.arrival_t)
+
+    def on_step(self, stats: Dict, preempt_delta: int):
+        if preempt_delta > 0:
+            self.preemptions.inc(preempt_delta)
+
+
+class EngineLoop:
+    """Owns the engine on one thread; everything else talks through queues."""
+
+    def __init__(self, engine, metrics: Optional[ServingMetrics] = None,
+                 registry: Optional[MetricsRegistry] = None, idle_wait_s: float = 0.05):
+        self.engine = engine
+        self.metrics = metrics or ServingMetrics(engine, registry)
+        self.idle_wait_s = idle_wait_s
+        self._cmds: "queue.Queue" = queue.Queue()
+        self._wake = threading.Event()
+        self._handles: Dict[int, RequestHandle] = {}
+        self._last_token_t: Dict[int, float] = {}
+        self._seen_preemptions = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._started = False
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._started and not self._stop
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the loop. ``drain=True`` finishes in-flight work first
+        (bounded by ``timeout``); leftovers and ``drain=False`` abort."""
+        if not self._started:
+            return
+        if drain:
+            deadline = None if timeout is None else time.time() + timeout
+            while self.pending_count() > 0:
+                if deadline is not None and time.time() >= deadline:
+                    logger.warning(f"engine loop drain timed out; aborting {self.pending_count()} requests")
+                    break
+                time.sleep(0.01)
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        self._started = False
+
+    def pending_count(self) -> int:
+        return len(self._handles) + self._cmds.qsize()
+
+    # ------------------------------------------------------------- client api
+    def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None) -> RequestHandle:
+        """Thread-safe request submission; returns immediately with a handle."""
+        if not self.running:
+            raise RuntimeError("engine loop is not running")
+        deadline_t = None if deadline_s is None else time.time() + deadline_s
+        handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t)
+        self._cmds.put(("submit", handle, prompt_ids, sampling))
+        self._wake.set()
+        return handle
+
+    def cancel(self, handle: RequestHandle):
+        """Request cancellation; resolves the handle once the loop aborts it."""
+        handle._cancelled = True
+        self._cmds.put(("abort", handle))
+        self._wake.set()
+
+    # ------------------------------------------------------------- loop body
+    def _run(self):
+        try:
+            while not self._stop:
+                self._drain_cmds()
+                self._enforce_deadlines()
+                if self.engine.has_work():
+                    stats_before = self.engine.num_preemptions
+                    for req in self.engine.step():
+                        self._finish(req)
+                    self.metrics.on_step(
+                        self.engine.stats(), self.engine.num_preemptions - stats_before)
+                else:
+                    self._wake.wait(timeout=self.idle_wait_s)
+                    self._wake.clear()
+        except BaseException as e:  # loop death must not strand waiters
+            logger.error(f"engine loop crashed: {e!r}")
+            for h in list(self._handles.values()):
+                h._resolve(None, error=e)
+            self._handles.clear()
+            while True:
+                try:
+                    cmd = self._cmds.get_nowait()
+                except queue.Empty:
+                    break
+                if cmd[0] == "submit":
+                    cmd[1]._resolve(None, error=e)
+            raise
+        finally:
+            self._shutdown_cleanup()
+
+    def _drain_cmds(self):
+        while True:
+            try:
+                cmd = self._cmds.get_nowait()
+            except queue.Empty:
+                return
+            kind, handle = cmd[0], cmd[1]
+            if kind == "submit":
+                _, _, prompt_ids, sampling = cmd
+                if handle._cancelled:
+                    handle._resolve(None)
+                    continue
+                stream_cb = self._make_stream_cb(handle)
+                handle.req_id = self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb)
+                self._handles[handle.req_id] = handle
+            elif kind == "abort":
+                self._abort_handle(handle)
+
+    def _make_stream_cb(self, handle: RequestHandle):
+        def cb(tok: int, done: bool):
+            now = time.time()
+            last = self._last_token_t.get(handle.req_id)
+            if last is not None:
+                self.metrics.inter_token.observe(now - last)
+            self._last_token_t[handle.req_id] = now
+            handle._on_token(tok, done)
+        return cb
+
+    def _abort_handle(self, handle: RequestHandle):
+        if handle.done():
+            return
+        if handle.req_id is None:
+            # submit command not yet processed; the submit branch resolves it
+            return
+        req = self.engine.abort(handle.req_id)
+        if req is not None:
+            self._finish(req)
+
+    def _enforce_deadlines(self):
+        now = time.time()
+        for handle in list(self._handles.values()):
+            if handle.deadline_t is not None and now >= handle.deadline_t and not handle.done():
+                logger.warning(f"req {handle.req_id}: deadline exceeded; aborting")
+                handle.timed_out = True
+                self._abort_handle(handle)
+
+    def _finish(self, req):
+        self.metrics.on_finished(req)
+        self._last_token_t.pop(req.req_id, None)
+        handle = self._handles.pop(req.req_id, None)
+        if handle is not None:
+            handle._resolve(req)
+
+    def _shutdown_cleanup(self):
+        for handle in list(self._handles.values()):
+            if handle.req_id is not None:
+                req = self.engine.abort(handle.req_id)
+                if req is not None:
+                    self.metrics.on_finished(req)
+                    handle._resolve(req)
+                    continue
+            handle._resolve(None)
+        self._handles.clear()
+        # submit commands that raced the stop and never reached the engine:
+        # their clients are blocked in result() — resolve them too
+        while True:
+            try:
+                cmd = self._cmds.get_nowait()
+            except queue.Empty:
+                break
+            if cmd[0] == "submit":
+                cmd[1]._resolve(None)

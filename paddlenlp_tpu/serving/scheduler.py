@@ -1,0 +1,125 @@
+"""Admission control + backpressure in front of the engine loop.
+
+The engine's own waiting queue is unbounded (a batch ``generate()`` call wants
+that); a server does not — heavy traffic must shed load *before* prompts pile
+up in host memory. The scheduler enforces:
+
+- a bounded in-flight window (``max_inflight`` = running + waiting): past it,
+  submissions raise :class:`SaturatedError` (HTTP 429, retryable);
+- per-request deadlines (``default_timeout_s`` unless the caller overrides) so
+  one stuck client cannot hold a slot forever;
+- graceful drain: ``drain()`` flips to rejecting new work with
+  :class:`ShuttingDownError` (HTTP 503) while in-flight requests finish.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..utils.log import logger
+from .engine_loop import EngineLoop, RequestHandle
+
+__all__ = ["Scheduler", "SchedulerConfig", "SaturatedError", "ShuttingDownError"]
+
+
+class SaturatedError(Exception):
+    """In-flight window full — shed load (HTTP 429)."""
+
+
+class ShuttingDownError(Exception):
+    """Scheduler draining/stopped — not accepting work (HTTP 503)."""
+
+
+class SchedulerConfig:
+    def __init__(self, max_inflight: int = 64, default_timeout_s: Optional[float] = 120.0,
+                 max_prompt_tokens: Optional[int] = None):
+        if max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        self.max_inflight = max_inflight
+        self.default_timeout_s = default_timeout_s
+        self.max_prompt_tokens = max_prompt_tokens
+
+
+class Scheduler:
+    """Bounded admission window around an :class:`EngineLoop`."""
+
+    def __init__(self, loop: EngineLoop, config: Optional[SchedulerConfig] = None):
+        self.loop = loop
+        self.config = config or SchedulerConfig()
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._draining = False
+        self._idle = threading.Event()
+        self._idle.set()
+        self.rejected_saturated = 0
+        self.rejected_draining = 0
+
+    # ------------------------------------------------------------- admission
+    def submit(self, prompt_ids, sampling=None, timeout_s: Optional[float] = None) -> RequestHandle:
+        """Admit one request or raise (SaturatedError / ShuttingDownError)."""
+        cfg = self.config
+        if cfg.max_prompt_tokens is not None and len(prompt_ids) > cfg.max_prompt_tokens:
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds max_prompt_tokens={cfg.max_prompt_tokens}")
+        with self._lock:
+            if self._draining or not self.loop.running:
+                self.rejected_draining += 1
+                raise ShuttingDownError("server is draining; retry against another replica")
+            if self._inflight >= cfg.max_inflight:
+                self.rejected_saturated += 1
+                raise SaturatedError(
+                    f"in-flight window full ({self._inflight}/{cfg.max_inflight}); retry later")
+            self._inflight += 1
+            self._idle.clear()
+        deadline = timeout_s if timeout_s is not None else cfg.default_timeout_s
+        try:
+            handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline)
+        except BaseException:
+            self._release()
+            raise
+        # release the window slot the moment the request resolves (any reason)
+        handle.add_done_callback(lambda _h: self._release())
+        return handle
+
+    def cancel(self, handle: RequestHandle):
+        self.loop.cancel(handle)
+
+    def _release(self):
+        with self._lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._idle.set()
+
+    # ------------------------------------------------------------- stats/drain
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def stats(self) -> dict:
+        return {
+            "inflight": self.inflight,
+            "max_inflight": self.config.max_inflight,
+            "draining": self._draining,
+            "rejected_saturated": self.rejected_saturated,
+            "rejected_draining": self.rejected_draining,
+        }
+
+    def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
+        """Stop admitting; wait for in-flight work. Returns True if empty."""
+        with self._lock:
+            self._draining = True
+        ok = self._idle.wait(timeout=timeout_s)
+        if not ok:
+            logger.warning(f"scheduler drain timed out with {self.inflight} in flight")
+        return ok
+
+    def shutdown(self, timeout_s: Optional[float] = 30.0):
+        """Drain then stop the engine loop (leftovers abort)."""
+        self.drain(timeout_s)
+        self.loop.stop(drain=False)

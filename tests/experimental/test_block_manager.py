@@ -1,0 +1,133 @@
+"""BlockManager accounting invariants: across any allocate/extend/shrink/free/
+preempt interleaving, no block is leaked or double-owned and ``num_free`` is
+conserved (free + owned == total)."""
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import BlockManager
+
+
+def owned_blocks(mgr):
+    out = []
+    for blocks in mgr.tables.values():
+        out.extend(blocks)
+    return out
+
+
+def check_conserved(mgr, total_usable):
+    owned = owned_blocks(mgr)
+    # sentinel block 0 is never handed out
+    assert 0 not in owned and 0 not in mgr.free
+    # no block owned twice, none both free and owned
+    assert len(owned) == len(set(owned))
+    assert not (set(owned) & set(mgr.free))
+    assert len(mgr.free) + len(owned) == total_usable
+    assert mgr.num_free == len(mgr.free)
+
+
+class TestInvariants:
+    def test_allocate_free_conserves(self):
+        mgr = BlockManager(num_blocks=33, block_size=4, max_blocks_per_seq=16)
+        total = mgr.total_usable_blocks
+        mgr.allocate(1, 10)
+        mgr.allocate(2, 1)
+        check_conserved(mgr, total)
+        mgr.free_seq(1)
+        check_conserved(mgr, total)
+        mgr.free_seq(2)
+        check_conserved(mgr, total)
+        assert mgr.num_free == total
+
+    def test_extend_then_shrink_returns_blocks(self):
+        mgr = BlockManager(num_blocks=17, block_size=4, max_blocks_per_seq=16)
+        total = mgr.total_usable_blocks
+        mgr.allocate(7, 4)  # 1 block
+        assert mgr.extend(7, 12) is not None  # 16 tokens -> 4 blocks
+        check_conserved(mgr, total)
+        assert len(mgr.tables[7]) == 4
+        mgr.shrink(7, 5)  # keep 2 blocks
+        check_conserved(mgr, total)
+        assert len(mgr.tables[7]) == 2 and mgr.lengths[7] == 5
+
+    def test_shrink_keeps_at_least_one_block(self):
+        mgr = BlockManager(num_blocks=9, block_size=4, max_blocks_per_seq=8)
+        mgr.allocate(1, 8)
+        mgr.shrink(1, 0)
+        assert len(mgr.tables[1]) == 1  # a live sequence never loses its last block
+        check_conserved(mgr, mgr.total_usable_blocks)
+
+    def test_failed_extend_leaks_nothing(self):
+        mgr = BlockManager(num_blocks=4, block_size=4, max_blocks_per_seq=8)
+        total = mgr.total_usable_blocks
+        mgr.allocate(1, 12)  # all 3 usable blocks
+        before_len = mgr.lengths[1]
+        assert mgr.extend(1, 8) is None  # OOM
+        # a refused extend must not mutate length or ownership
+        assert mgr.lengths[1] == before_len
+        check_conserved(mgr, total)
+
+    def test_over_cap_extend_refused(self):
+        mgr = BlockManager(num_blocks=64, block_size=4, max_blocks_per_seq=2)
+        mgr.allocate(1, 8)  # at the per-seq cap
+        assert mgr.extend(1, 4) is None
+        check_conserved(mgr, mgr.total_usable_blocks)
+
+    def test_free_seq_idempotent_and_unknown(self):
+        mgr = BlockManager(num_blocks=9, block_size=4, max_blocks_per_seq=8)
+        total = mgr.total_usable_blocks
+        mgr.allocate(3, 6)
+        mgr.free_seq(3)
+        mgr.free_seq(3)  # double-free: no-op
+        mgr.free_seq(999)  # unknown id: no-op
+        check_conserved(mgr, total)
+        assert mgr.num_free == total
+
+    def test_preempt_free_realloc_cycle(self):
+        """The engine's preemption pattern: free the victim, re-admit later with
+        a longer prompt — accounting must survive many cycles."""
+        mgr = BlockManager(num_blocks=12, block_size=4, max_blocks_per_seq=8)
+        total = mgr.total_usable_blocks
+        rng = np.random.default_rng(0)
+        live = {}
+        next_id = 0
+        for _ in range(300):
+            op = rng.choice(["alloc", "extend", "shrink", "free"])
+            if op == "alloc":
+                n = int(rng.integers(1, 20))
+                if mgr.can_allocate(n) and mgr.blocks_needed(n) <= mgr.max_blocks_per_seq:
+                    mgr.allocate(next_id, n)
+                    live[next_id] = n
+                    next_id += 1
+            elif op == "extend" and live:
+                sid = int(rng.choice(list(live)))
+                grew = mgr.extend(sid, int(rng.integers(1, 8)))
+                if grew is not None:
+                    live[sid] = mgr.lengths[sid]
+            elif op == "shrink" and live:
+                sid = int(rng.choice(list(live)))
+                new_len = int(rng.integers(0, live[sid] + 1))
+                mgr.shrink(sid, new_len)
+                live[sid] = new_len
+            elif op == "free" and live:
+                sid = int(rng.choice(list(live)))
+                mgr.free_seq(sid)
+                del live[sid]
+            check_conserved(mgr, total)
+        for sid in list(live):
+            mgr.free_seq(sid)
+        assert mgr.num_free == total
+
+    def test_table_array_matches_ownership(self):
+        mgr = BlockManager(num_blocks=17, block_size=4, max_blocks_per_seq=6)
+        mgr.allocate(1, 9)  # 3 blocks
+        t = mgr.table_array(1)
+        assert list(t[:3]) == mgr.tables[1]
+        assert (t[3:] == 0).all()
+
+    def test_allocate_raises_cleanly_when_oom(self):
+        mgr = BlockManager(num_blocks=3, block_size=4, max_blocks_per_seq=8)
+        mgr.allocate(1, 8)
+        with pytest.raises(RuntimeError):
+            mgr.allocate(2, 4)
+        check_conserved(mgr, mgr.total_usable_blocks)

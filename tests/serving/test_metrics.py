@@ -1,0 +1,103 @@
+"""Metrics plane unit tests: counter/gauge/histogram semantics + Prometheus
+text exposition (no jax, no engine — the module must stand alone)."""
+
+import threading
+
+import pytest
+
+from paddlenlp_tpu.serving.metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+
+class TestCounter:
+    def test_inc_and_labels(self):
+        c = Counter("req_total", "requests", labelnames=("status",))
+        c.inc(status="ok")
+        c.inc(2, status="ok")
+        c.inc(status="err")
+        assert c.value(status="ok") == 3 and c.value(status="err") == 1
+
+    def test_monotonic(self):
+        c = Counter("x", "")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_label_mismatch_rejected(self):
+        c = Counter("y", "", labelnames=("a",))
+        with pytest.raises(ValueError):
+            c.inc(b="nope")
+
+    def test_thread_safety(self):
+        c = Counter("z", "")
+        threads = [threading.Thread(target=lambda: [c.inc() for _ in range(1000)])
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == 8000
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = Gauge("depth", "")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 4
+
+    def test_pull_mode(self):
+        state = {"v": 0}
+        g = Gauge("pull", "")
+        g.set_function(lambda: state["v"])
+        state["v"] = 7
+        assert g.value() == 7
+        assert "pull 7" in "\n".join(g.expose())
+
+
+class TestHistogram:
+    def test_buckets_sum_count(self):
+        h = Histogram("lat", "", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count() == 4 and h.sum() == pytest.approx(55.55)
+        lines = "\n".join(h.expose())
+        assert 'lat_bucket{le="0.1"} 1' in lines
+        assert 'lat_bucket{le="1"} 2' in lines
+        assert 'lat_bucket{le="10"} 3' in lines
+        assert 'lat_bucket{le="+Inf"} 4' in lines
+        assert "lat_count 4" in lines
+
+    def test_percentile_bucket_upper_bound(self):
+        h = Histogram("p", "", buckets=(1, 2, 4, 8))
+        for v in (0.5, 1.5, 1.6, 3.0):
+            h.observe(v)
+        assert h.percentile(0.5) == 2
+        assert h.percentile(0.99) == 4
+        assert Histogram("empty", "").percentile(0.5) == 0.0
+
+
+class TestRegistry:
+    def test_idempotent_and_exposition(self):
+        r = MetricsRegistry()
+        c1 = r.counter("a_total", "help text")
+        c2 = r.counter("a_total")
+        assert c1 is c2
+        c1.inc(3)
+        r.gauge("b").set(1.5)
+        text = r.expose()
+        assert "# HELP a_total help text" in text
+        assert "# TYPE a_total counter" in text
+        assert "a_total 3" in text
+        assert "b 1.5" in text
+        assert text.endswith("\n")
+
+    def test_kind_conflict(self):
+        r = MetricsRegistry()
+        r.counter("m")
+        with pytest.raises(ValueError):
+            r.gauge("m")
+
+    def test_unregistered_zero_series(self):
+        r = MetricsRegistry()
+        r.counter("never_touched_total", "")
+        assert "never_touched_total 0" in r.expose()

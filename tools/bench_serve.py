@@ -1,0 +1,189 @@
+"""Serving smoke benchmark: N concurrent HTTP requests through the
+continuous-batching runtime on CPU.
+
+Prints ONE JSON line — always, in the same always-emit style as bench.py: on
+any failure or timeout a structured record with value 0 and an "error" field
+is emitted instead of a traceback. CPU-safe by construction (forces
+JAX_PLATFORMS=cpu and drops the axon PJRT plugin from the import path before
+jax loads, so a wedged TPU tunnel cannot block the run).
+
+Usage::
+
+    python tools/bench_serve.py                  # 16 requests, 8-way concurrency
+    python tools/bench_serve.py --requests 32 --concurrency 16 --max-tokens 24
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+METRIC = "serve_smoke_requests_per_sec"
+UNIT = "requests/sec (tiny-llama CPU serving smoke)"
+RUN_TIMEOUT_S = float(os.environ.get("PDNLP_BENCH_SERVE_TIMEOUT", 600))
+
+
+def _fail(reason: str) -> None:
+    print(json.dumps({"metric": METRIC, "value": 0.0, "unit": UNIT, "error": reason[:2000]}))
+    sys.exit(1)
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    sys.path[:] = [p for p in sys.path if "axon" not in p]
+    if os.environ.get("PYTHONPATH"):
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            p for p in os.environ["PYTHONPATH"].split(os.pathsep) if "axon" not in p)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _arg(flag: str, default: int) -> int:
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+def run() -> None:
+    _force_cpu()
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+    import http.client
+    import threading
+
+    from paddlenlp_tpu.experimental import InferenceEngine
+    from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, ServingServer
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+    n_requests = _arg("--requests", 16)
+    concurrency = _arg("--concurrency", 8)
+    max_tokens = _arg("--max-tokens", 16)
+
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    model = LlamaForCausalLM.from_config(cfg, seed=0)
+    engine = InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
+                             max_blocks_per_seq=32, decode_steps=4)
+    registry = MetricsRegistry()
+    server = ServingServer(engine, registry=registry,
+                           scheduler_config=SchedulerConfig(max_inflight=2 * n_requests))
+    port = server.start_in_thread()
+
+    # warmup: one request pays the jit compiles so the timed window measures
+    # steady-state serving, not tracing
+    def one_request(i: int, stats: dict):
+        t0 = time.time()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=RUN_TIMEOUT_S)
+        body = json.dumps({"prompt": [5 + i % 8, 6, 7], "max_tokens": max_tokens, "stream": True})
+        conn.request("POST", "/v1/completions", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f"request {i}: HTTP {resp.status}")
+        n_toks, ttft = 0, None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: ") or line == b"data: [DONE]":
+                if line == b"data: [DONE]":
+                    break
+                continue
+            ev = json.loads(line[len(b"data: "):])
+            if "token" in ev["choices"][0]:
+                if ttft is None:
+                    ttft = time.time() - t0
+                n_toks += 1
+        conn.close()
+        stats["ttft"].append(ttft if ttft is not None else float("nan"))
+        stats["tokens"] += n_toks
+
+    warm = {"ttft": [], "tokens": 0}
+    one_request(0, warm)
+
+    stats = {"ttft": [], "tokens": 0}
+    lock = threading.Lock()
+    errors: list = []
+    sem = threading.Semaphore(concurrency)
+
+    def worker(i: int):
+        local = {"ttft": [], "tokens": 0}
+        try:
+            one_request(i, local)
+        except Exception as e:
+            with lock:
+                errors.append(f"req {i}: {e!r}")
+            return
+        finally:
+            sem.release()
+        with lock:
+            stats["ttft"].extend(local["ttft"])
+            stats["tokens"] += local["tokens"]
+
+    t0 = time.time()
+    threads = []
+    for i in range(n_requests):
+        sem.acquire()
+        t = threading.Thread(target=worker, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    server.shutdown(drain_timeout_s=10)
+
+    if errors:
+        _fail(f"{len(errors)}/{n_requests} requests failed: {errors[:3]}")
+    ttfts = sorted(stats["ttft"])
+    p = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)] if ttfts else 0.0
+    server_ttft = registry.get("paddlenlp_serving_ttft_seconds")
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(n_requests / dt, 3),
+        "unit": UNIT,
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "max_tokens": max_tokens,
+        "wall_s": round(dt, 3),
+        "tokens_per_sec": round(stats["tokens"] / dt, 1),
+        "p50_ttft_ms": round(p(0.50) * 1e3, 1),
+        "p99_ttft_ms": round(p(0.99) * 1e3, 1),
+        "server_ttft_p50_ms": round(server_ttft.percentile(0.5) * 1e3, 1),
+        "preemptions": registry.get("paddlenlp_serving_preemptions_total").value(),
+    }))
+
+
+def main() -> None:
+    # subprocess isolation: a hung backend or deadlocked loop cannot eat the
+    # caller — the watchdog timeout always produces the JSON failure record
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run", *sys.argv[1:]],
+            capture_output=True, text=True, timeout=RUN_TIMEOUT_S,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except subprocess.TimeoutExpired:
+        _fail(f"serving smoke run timed out after {RUN_TIMEOUT_S}s")
+        return
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        if line.startswith("{"):
+            print(line)
+            sys.exit(proc.returncode)
+    tail = "\n".join(((proc.stdout or "") + (proc.stderr or "")).strip().splitlines()[-8:])
+    _fail(f"serving smoke produced no JSON line (rc={proc.returncode}): {tail}")
+
+
+if __name__ == "__main__":
+    if "--run" in sys.argv:
+        try:
+            run()
+        except Exception as e:
+            _fail(f"{type(e).__name__}: {e}")
+    else:
+        main()
