@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.tracer import TRACER
 from ..utils.log import logger
 from .inference_model import PagedInferenceModel
 from .paged_cache import BlockManager, init_paged_pool
@@ -70,6 +71,7 @@ class Request:
     finish_reason: Optional[str] = None  # stop | length | abort | capacity
     aborted: bool = False
     base_prompt_len: int = 0  # original prompt length (preemption grows prompt_ids)
+    trace: Optional[str] = None  # observability trace id (serving request context)
 
     @property
     def total_len(self) -> int:
@@ -168,7 +170,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
-                    stream_cb: Optional[Callable] = None) -> int:
+                    stream_cb: Optional[Callable] = None, trace: Optional[str] = None) -> int:
         sampling = sampling or SamplingParams()
         req = Request(
             req_id=next(self._next_id),
@@ -176,6 +178,7 @@ class InferenceEngine:
             sampling=sampling,
             stream_cb=stream_cb,
             arrival_t=time.time(),
+            trace=trace,
         )
         req.base_prompt_len = len(req.prompt_ids)
         self.waiting.append(req)
@@ -201,11 +204,19 @@ class InferenceEngine:
                 return req
         for slot, req in enumerate(self.slots):
             if req is not None and req.req_id == req_id:
-                self.mgr.free_seq(req.req_id)
+                self._free_kv(req)
                 self.slots[slot] = None
                 self._finish_abort(req)
                 return req
         return None
+
+    def _free_kv(self, req: Request):
+        """Release a request's KV blocks (+ an alloc/free trace marker)."""
+        freed = self.mgr.lengths.get(req.req_id)
+        self.mgr.free_seq(req.req_id)
+        TRACER.instant("kv_free", cat="engine", trace=req.trace,
+                       req_id=req.req_id, tokens_held=freed,
+                       free_blocks=self.mgr.num_free)
 
     def _finish_abort(self, req: Request):
         req.done = True
@@ -267,6 +278,11 @@ class InferenceEngine:
 
     def _admit(self, finished: List[Request]):
         free = self._free_slot_indices()
+        if not self.waiting or not free:
+            return
+        queue_depth = len(self.waiting)
+        n_finished0 = len(finished)
+        admit_t0 = time.perf_counter()
         admitted: List[tuple] = []  # (slot, req)
         while self.waiting and free:
             req = self.waiting[0]
@@ -291,7 +307,18 @@ class InferenceEngine:
             if req.sched_t is None:  # preserved across preemption-requeues
                 req.sched_t = time.time()
             self.mgr.allocate(req.req_id, prompt_len)
+            TRACER.instant("kv_alloc", cat="engine", trace=req.trace,
+                           req_id=req.req_id, tokens=prompt_len,
+                           free_blocks=self.mgr.num_free)
             admitted.append((free.pop(0), req))
+        # admission span closes BEFORE prefill (sibling phases, not nested) and
+        # only when something happened — a blocked queue spinning admitted=0
+        # every step must not flood the span ring
+        if admitted or len(finished) > n_finished0:
+            TRACER.add_span("admission", TRACER.epoch_time(admit_t0),
+                            time.perf_counter() - admit_t0, cat="engine",
+                            queue_depth=queue_depth, admitted=len(admitted),
+                            rejected_capacity=len(finished) - n_finished0)
 
         # batch prefills, grouped by padded prompt length (bounded retraces)
         by_bucket: Dict[int, List[tuple]] = {}
@@ -308,18 +335,20 @@ class InferenceEngine:
                 tables[j] = self.mgr.table_array(req.req_id)
                 lens[j] = len(req.prompt_ids)
                 reqs[j] = req
-            tokens, counts_rows, self.pool = self.infer.prefill(
-                self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
-                jnp.asarray(lens), self._samp_arrays(reqs),
-            )
-            tokens = np.asarray(tokens)
+            with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
+                             req_ids=[r.req_id for _, r in group]):
+                tokens, counts_rows, self.pool = self.infer.prefill(
+                    self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
+                    jnp.asarray(lens), self._samp_arrays(reqs),
+                )
+                tokens = np.asarray(tokens)
             slot_idx = [slot for slot, _ in group]
             self.counts = self.counts.at[jnp.asarray(slot_idx)].set(counts_rows[: len(group)])
             for j, (slot, req) in enumerate(group):
                 tok = int(tokens[j])
                 self._emit(req, tok)
                 if req.done:
-                    self.mgr.free_seq(req.req_id)
+                    self._free_kv(req)
                     finished.append(req)
                 else:
                     self.slots[slot] = req
@@ -432,7 +461,9 @@ class InferenceEngine:
         req = self.slots[slot]
         logger.warning(f"req {req.req_id}: KV blocks exhausted; preempting (recompute)")
         self.num_preemptions += 1
-        self.mgr.free_seq(req.req_id)
+        TRACER.instant("preempt", cat="engine", trace=req.trace, req_id=req.req_id,
+                       generated=len(req.output_ids), free_blocks=self.mgr.num_free)
+        self._free_kv(req)
         self.slots[slot] = None
         req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
         req.output_ids = []
@@ -480,14 +511,16 @@ class InferenceEngine:
             tokens[i, 1 : 1 + len(d)] = d
             tables[i] = self.mgr.table_array(req.req_id)
             start[i] = req.total_len - 1  # position of the token being fed
-        argmax_dev, logits_dev, self.pool = self.infer.verify(
-            self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
-            jnp.asarray(start),
-        )
-        # greedy only pulls [B, K+1] int32 to host; the [B, K+1, V] logits stay
-        # on device unless rejection sampling needs them
-        logits = np.asarray(logits_dev) if mode == "sample" else None
-        argmax = np.asarray(argmax_dev)
+        with TRACER.span("spec_verify", cat="engine", mode=mode,
+                         drafted=int(sum(len(d) for d in drafts))):
+            argmax_dev, logits_dev, self.pool = self.infer.verify(
+                self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
+                jnp.asarray(start),
+            )
+            # greedy only pulls [B, K+1] int32 to host; the [B, K+1, V] logits
+            # stay on device unless rejection sampling needs them
+            logits = np.asarray(logits_dev) if mode == "sample" else None
+            argmax = np.asarray(argmax_dev)
         self.spec_stats["verify_steps"] += 1
         for i, req in enumerate(self.slots):
             if req is None:
@@ -495,7 +528,9 @@ class InferenceEngine:
             d = drafts[i]
             self.spec_stats["drafted"] += len(d)
             if mode == "sample":
-                emitted = self._accept_rejection(i, req, d, logits[i], qprobs[i])
+                with TRACER.span("sampling", cat="engine", trace=req.trace,
+                                 req_id=req.req_id, kind="rejection", drafted=len(d)):
+                    emitted = self._accept_rejection(i, req, d, logits[i], qprobs[i])
             else:
                 targets = argmax[i]
                 n_acc = 0
@@ -510,7 +545,7 @@ class InferenceEngine:
                 if req.done:
                     break
             if req.done:
-                self.mgr.free_seq(req.req_id)
+                self._free_kv(req)
                 self.slots[i] = None
                 finished.append(req)
             else:
@@ -554,12 +589,14 @@ class InferenceEngine:
             # propose first: when NO slot has a draft, a verify forward would
             # emit 1 token/seq for (K+1)x the compute — use the multi-step
             # decode instead and only pay for verification when drafts exist
-            if self.draft_model is not None:
-                drafts, qprobs = self._propose_drafts_draft_model(mode)
-            else:
-                drafts = [np.zeros(0, np.int32) if r is None else self._propose_drafts(r)
-                          for r in self.slots]
-                qprobs = [None] * len(self.slots)
+            with TRACER.span("spec_propose", cat="engine", mode=mode,
+                             proposer="draft_model" if self.draft_model is not None else "ngram"):
+                if self.draft_model is not None:
+                    drafts, qprobs = self._propose_drafts_draft_model(mode)
+                else:
+                    drafts = [np.zeros(0, np.int32) if r is None else self._propose_drafts(r)
+                              for r in self.slots]
+                    qprobs = [None] * len(self.slots)
             if any(len(d) for d in drafts):
                 return self._decode_spec(finished, drafts, qprobs, mode)
         steps = self.decode_steps
@@ -590,14 +627,16 @@ class InferenceEngine:
             ctx[i] = req.total_len - 1  # position of the token being fed
             done0[i] = False
             remaining[i] = req.remaining_new
-        toks, valid, _, _, self.counts, self.pool = self.infer.decode(
-            self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
-            jnp.asarray(ctx), jnp.asarray(done0), jnp.asarray(remaining),
-            self.counts, self._samp_arrays(self.slots),
-        )
-        # ONE host transfer of ids + validity flags (no logits)
-        toks = np.asarray(toks)  # [steps, B]
-        valid = np.asarray(valid)
+        with TRACER.span("decode", cat="engine", steps=steps,
+                         active=int(sum(1 for r in self.slots if r is not None))):
+            toks, valid, _, _, self.counts, self.pool = self.infer.decode(
+                self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
+                jnp.asarray(ctx), jnp.asarray(done0), jnp.asarray(remaining),
+                self.counts, self._samp_arrays(self.slots),
+            )
+            # ONE host transfer of ids + validity flags (no logits)
+            toks = np.asarray(toks)  # [steps, B]
+            valid = np.asarray(valid)
         for s in range(toks.shape[0]):
             for i, req in enumerate(self.slots):
                 if req is None or req.done or not valid[s, i]:
@@ -608,7 +647,7 @@ class InferenceEngine:
             if req is None:
                 continue
             if req.done:
-                self.mgr.free_seq(req.req_id)
+                self._free_kv(req)
                 self.slots[i] = None
                 finished.append(req)
             elif req.req_id in start_len:

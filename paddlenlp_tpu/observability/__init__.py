@@ -1,0 +1,40 @@
+"""Unified observability layer shared by serving and training.
+
+Three pieces, all stdlib-only at import time:
+
+- :mod:`.tracer` — thread-safe span tracing into a bounded ring buffer,
+  exportable as Chrome trace-event JSON (Perfetto) or JSONL; the process-wide
+  :data:`TRACER` is fed by the serving scheduler/engine loop, the inference
+  engine's step phases, and the trainer's step timers.
+- :mod:`.exporter` — opt-in background HTTP plane (``/metrics``, ``/health``,
+  ``/debug/trace``) for processes that have no server of their own (training
+  jobs); the serving API mounts the same data on its existing server.
+- :mod:`.prometheus` — text-format parsing + exposition lint for scrapers and
+  ``tools/check_metrics.py``.
+
+The metric registry itself lives in :mod:`paddlenlp_tpu.serving.metrics`
+(predates this package; its names are stable API) — this package is the
+tracing/exposition layer around it.
+"""
+
+from .exporter import ObservabilityExporter  # noqa: F401
+from .prometheus import (  # noqa: F401
+    MetricFamily,
+    histogram_quantile,
+    lint_exposition,
+    parse_prometheus_text,
+)
+from .tracer import TRACER, Span, SpanTracer, current_trace, use_trace  # noqa: F401
+
+__all__ = [
+    "Span",
+    "SpanTracer",
+    "TRACER",
+    "use_trace",
+    "current_trace",
+    "ObservabilityExporter",
+    "MetricFamily",
+    "parse_prometheus_text",
+    "histogram_quantile",
+    "lint_exposition",
+]

@@ -1,0 +1,122 @@
+"""Background HTTP observability plane for non-serving processes.
+
+The serving runtime already fronts its metrics with ``serving/api.py``; a
+training job has no HTTP server at all — this one is tiny, opt-in, and
+read-only so it can ride inside ``Trainer`` without touching the step loop:
+
+    GET /metrics        Prometheus text exposition (shared MetricsRegistry)
+    GET /health         liveness JSON (+ caller-provided stats)
+    GET /debug/trace    span ring buffer as Chrome trace-event JSON (Perfetto)
+    GET /debug/spans    span ring buffer as structured JSONL
+
+Stdlib ``ThreadingHTTPServer`` on a daemon thread; ``port=0`` binds an
+ephemeral port (tests), and a crashed exporter can never take training down —
+every handler failure is swallowed into a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from ..utils.log import logger
+from .tracer import TRACER, SpanTracer
+
+__all__ = ["ObservabilityExporter", "route_observability"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def route_observability(path: str, registry, tracer: SpanTracer):
+    """Shared GET routing for the observability surface: returns
+    ``(status, content_type, body_bytes)`` or None for unknown paths. Both HTTP
+    planes — this exporter and ``serving/api.py`` — dispatch through here so
+    the routes cannot drift."""
+    if path == "/metrics":
+        return 200, PROMETHEUS_CONTENT_TYPE, registry.expose().encode()
+    if path == "/debug/trace":
+        return 200, "application/json", json.dumps(tracer.chrome_trace()).encode()
+    if path == "/debug/spans":
+        return 200, "application/jsonl", tracer.to_jsonl().encode()
+    return None
+
+
+class ObservabilityExporter:
+    """Serve ``/metrics`` + ``/health`` + ``/debug/*`` off a daemon thread."""
+
+    def __init__(self, registry=None, tracer: Optional[SpanTracer] = None,
+                 health_fn: Optional[Callable[[], Dict]] = None):
+        if registry is None:
+            from ..serving.metrics import REGISTRY as registry  # stdlib-only module
+        self.registry = registry
+        self.tracer = tracer or TRACER
+        self.health_fn = health_fn
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd is not None else None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Bind + serve in the background; returns the bound port."""
+        if self._httpd is not None:
+            return self.port
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("observability: " + fmt % args)
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    routed = route_observability(self.path, exporter.registry,
+                                                 exporter.tracer)
+                    if routed is not None:
+                        self._send(routed[0], routed[2], routed[1])
+                    elif self.path == "/health":
+                        payload = {"status": "ok"}
+                        if exporter.health_fn is not None:
+                            payload.update(exporter.health_fn())
+                        self._send(200, json.dumps(payload, default=str).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, json.dumps({"error": f"no route {self.path}"}).encode(),
+                                   "application/json")
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.debug("observability: client disconnected")
+                except Exception as e:  # exporter must never take the job down
+                    logger.warning(f"observability: error on {self.path}: {e!r}")
+                    try:
+                        self._send(500, json.dumps({"error": str(e)}).encode(),
+                                   "application/json")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="observability-http")
+        self._thread.start()
+        bound = self._httpd.server_address[1]
+        logger.info(f"observability exporter on {host}:{bound} "
+                    "(GET /metrics /health /debug/trace)")
+        return bound
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

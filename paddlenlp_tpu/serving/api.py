@@ -14,6 +14,9 @@ Routes::
     POST /v1/abort         {"id": "cmpl-N"}        — cancel an in-flight request
     GET  /metrics          Prometheus text exposition
     GET  /health           liveness + scheduler/engine stats
+    GET  /debug/requests   in-flight + recently finished request timelines
+    GET  /debug/trace      span ring buffer as Chrome trace JSON (Perfetto)
+    GET  /debug/spans      span ring buffer as structured JSONL
 
 Backpressure maps to HTTP: 429 when the admission window is full (retryable),
 503 while draining, 413 for oversized bodies. A client disconnect mid-stream
@@ -28,6 +31,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..observability.exporter import route_observability
+from ..observability.tracer import TRACER
 from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle, ServingMetrics
 from .metrics import REGISTRY, MetricsRegistry
@@ -69,6 +74,7 @@ class ServingServer:
         self.engine = engine
         self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
         self.registry = registry or REGISTRY
+        self.tracer = TRACER
         self.max_body_bytes = max_body_bytes
         self.max_src_tokens = max_src_tokens
         self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry))
@@ -150,32 +156,37 @@ class ServingServer:
                 logger.debug("serving: " + fmt % args)
 
             def _send_json(self, code: int, payload: dict):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_raw(code, json.dumps(payload).encode(), "application/json")
 
             def _send_error_json(self, code: int, message: str, etype: str):
                 self._send_json(code, {"error": {"message": message, "type": etype, "code": code}})
 
             # --------------------------------------------------------- GET
+            def _send_raw(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
-                    if self.path == "/metrics":
-                        body = server.registry.expose().encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                    # /metrics, /debug/trace, /debug/spans: shared with the
+                    # training exporter (observability.exporter)
+                    routed = route_observability(self.path, server.registry, server.tracer)
+                    if routed is not None:
+                        self._send_raw(routed[0], routed[2], routed[1])
                     elif self.path == "/health":
                         status = "draining" if server.scheduler.draining else "ok"
                         self._send_json(200 if status == "ok" else 503, {
                             "status": status,
                             "scheduler": server.scheduler.stats(),
                             "engine": server.engine.stats(),
+                        })
+                    elif self.path == "/debug/requests":
+                        self._send_json(200, {
+                            "inflight": server.loop.inflight_info(),
+                            "recent": list(server.loop.recent_finished),
                         })
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")

@@ -19,11 +19,14 @@ host-side block manager needs no locks.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
+from ..observability.tracer import TRACER
 from ..utils.log import logger
 from .metrics import REGISTRY, MetricsRegistry
 
@@ -35,8 +38,10 @@ _END = object()  # token-queue sentinel: stream closed
 class RequestHandle:
     """Client-side view of one in-flight request (future + token stream)."""
 
-    def __init__(self, prompt_len: int, deadline_t: Optional[float] = None):
+    def __init__(self, prompt_len: int, deadline_t: Optional[float] = None,
+                 trace: Optional[str] = None):
         self.req_id: Optional[int] = None  # assigned on the loop thread
+        self.trace = trace  # span-tracer trace id linking this request's phases
         self.prompt_len = prompt_len
         self.deadline_t = deadline_t
         self.submitted_t = time.time()
@@ -197,6 +202,10 @@ class EngineLoop:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._started = False
+        self._trace_seq = itertools.count()
+        # /debug/requests tail: finished-request summaries (appended only on
+        # the loop thread; deque ops are atomic so HTTP readers need no lock)
+        self.recent_finished: deque = deque(maxlen=64)
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -239,7 +248,8 @@ class EngineLoop:
         if not self.running:
             raise RuntimeError("engine loop is not running")
         deadline_t = None if deadline_s is None else time.time() + deadline_s
-        handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t)
+        handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t,
+                               trace=f"req-{next(self._trace_seq)}")
         self._cmds.put(("submit", handle, prompt_ids, sampling))
         self._wake.set()
         return handle
@@ -294,7 +304,8 @@ class EngineLoop:
                     handle._resolve(None)
                     continue
                 stream_cb = self._make_stream_cb(handle)
-                handle.req_id = self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb)
+                handle.req_id = self.engine.add_request(
+                    prompt_ids, sampling, stream_cb=stream_cb, trace=handle.trace)
                 self._handles[handle.req_id] = handle
             elif kind == "abort":
                 self._abort_handle(handle)
@@ -331,8 +342,84 @@ class EngineLoop:
         self.metrics.on_finished(req)
         self._last_token_t.pop(req.req_id, None)
         handle = self._handles.pop(req.req_id, None)
+        self._trace_finished(req, handle)
         if handle is not None:
             handle._resolve(req)
+
+    def _trace_finished(self, req, handle: Optional[RequestHandle]):
+        """Retrospective per-request phase spans (the engine's timing fields
+        become a queue → prefill → decode timeline under the request's trace)
+        plus a summary row for /debug/requests."""
+        trace = handle.trace if handle is not None else getattr(req, "trace", None)
+        phases = {}
+        meta = dict(req_id=req.req_id, prompt_len=len(req.prompt_ids))
+        if req.sched_t is not None:
+            phases["queue"] = (req.arrival_t, req.sched_t)
+        if req.sched_t is not None and req.first_token_t is not None:
+            phases["prefill"] = (req.sched_t, req.first_token_t)
+        if req.first_token_t is not None and req.finish_t is not None:
+            phases["decode"] = (req.first_token_t, req.finish_t)
+        for name, (t0, t1) in phases.items():
+            TRACER.add_span(name, t0, t1 - t0, cat="request", trace=trace,
+                            wall=True, **meta)
+        if req.finish_t is not None:
+            TRACER.add_span("request", req.arrival_t, req.finish_t - req.arrival_t,
+                            cat="request", trace=trace, wall=True,
+                            finish_reason=req.finish_reason,
+                            tokens=len(req.output_ids), **meta)
+        self.recent_finished.append({
+            "trace": trace,
+            "req_id": req.req_id,
+            "state": "finished",
+            "finish_reason": req.finish_reason,
+            "prompt_len": len(req.prompt_ids),
+            "output_tokens": len(req.output_ids),
+            "arrival_t": req.arrival_t,
+            "queue_wait_s": req.queue_wait,
+            "ttft_s": req.ttft,
+            "decode_time_s": req.decode_time,
+            "finish_t": req.finish_t,
+        })
+
+    def inflight_info(self) -> List[Dict]:
+        """Point-in-time timelines of in-flight requests for /debug/requests.
+
+        Called from HTTP threads while the loop mutates state: every field read
+        is a single attribute/len fetch (atomic under the GIL) and the handle
+        map is copied defensively, so the result may be a few tokens stale but
+        never corrupt."""
+        now = time.time()
+        out = []
+        for handle in list(self._handles.values()):
+            req = None
+            if handle.req_id is not None:
+                try:
+                    req = next((r for r in list(self.engine.slots)
+                                if r is not None and r.req_id == handle.req_id), None)
+                    if req is None:
+                        req = next((r for r in list(self.engine.waiting)
+                                    if r.req_id == handle.req_id), None)
+                except RuntimeError:
+                    # slots/waiting mutated mid-copy by the loop thread: report
+                    # the handle-level view only rather than failing the scrape
+                    req = None
+            info = {
+                "trace": handle.trace,
+                "req_id": handle.req_id,
+                "prompt_len": handle.prompt_len,
+                "age_s": now - handle.submitted_t,
+                "deadline_in_s": None if handle.deadline_t is None else handle.deadline_t - now,
+            }
+            if req is None:
+                info["state"] = "submitted"
+            else:
+                info["state"] = "queued" if req.sched_t is None else (
+                    "prefill" if req.first_token_t is None else "decode")
+                info["output_tokens"] = len(req.output_ids)
+                info["queue_wait_s"] = req.queue_wait
+                info["ttft_s"] = req.ttft
+            out.append(info)
+        return out
 
     def _shutdown_cleanup(self):
         for handle in list(self._handles.values()):

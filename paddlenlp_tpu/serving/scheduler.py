@@ -15,8 +15,10 @@ up in host memory. The scheduler enforces:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
+from ..observability.tracer import TRACER
 from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle
 
@@ -65,16 +67,25 @@ class Scheduler:
         with self._lock:
             if self._draining or not self.loop.running:
                 self.rejected_draining += 1
+                TRACER.instant("admission_rejected", cat="scheduler", reason="draining")
                 raise ShuttingDownError("server is draining; retry against another replica")
             if self._inflight >= cfg.max_inflight:
                 self.rejected_saturated += 1
+                TRACER.instant("admission_rejected", cat="scheduler", reason="saturated",
+                               inflight=self._inflight)
                 raise SaturatedError(
                     f"in-flight window full ({self._inflight}/{cfg.max_inflight}); retry later")
             self._inflight += 1
             self._idle.clear()
         deadline = timeout_s if timeout_s is not None else cfg.default_timeout_s
         try:
+            # recorded retrospectively so Span.trace carries the request's id
+            # (assigned by submit) and trace-filtered timelines include admission
+            t0 = time.perf_counter()
             handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline)
+            TRACER.add_span("admission", TRACER.epoch_time(t0),
+                            time.perf_counter() - t0, cat="scheduler",
+                            trace=handle.trace, prompt_len=len(prompt_ids))
         except BaseException:
             self._release()
             raise

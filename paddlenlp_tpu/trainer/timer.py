@@ -3,13 +3,18 @@ Megatron-style ``Timers`` :96, ``RuntimeTimer`` :70; wired as
 ``self.timers("forward-backward")`` around trainer phases).
 
 On TPU the device runs async: a timer stop optionally blocks on a marker array so
-phases measure device work, not dispatch.
+phases measure device work, not dispatch. Every stop also lands as a span in the
+observability tracer (trace id ``train``), so the trainer's phase breakdown —
+including the ``jax.block_until_ready`` sync portion, recorded as its own nested
+span — shows up in ``/debug/trace`` Chrome timelines next to serving spans.
 """
 
 from __future__ import annotations
 
 import time
 from typing import Dict, Optional
+
+from ..observability.tracer import TRACER
 
 __all__ = ["Timers", "RuntimeTimer"]
 
@@ -30,10 +35,17 @@ class _Timer:
         if self._started is None:
             raise RuntimeError(f"timer {self.name} not started")
         if block_on is not None:
+            t_sync = time.perf_counter()
             import jax
 
             jax.block_until_ready(block_on)
-        self._elapsed += time.perf_counter() - self._started
+            TRACER.add_span("block_until_ready", TRACER.epoch_time(t_sync),
+                            time.perf_counter() - t_sync, cat="trainer",
+                            trace="train", phase=self.name)
+        t_end = time.perf_counter()
+        TRACER.add_span(self.name, TRACER.epoch_time(self._started),
+                        t_end - self._started, cat="trainer", trace="train")
+        self._elapsed += t_end - self._started
         self._started = None
         self.count += 1
 

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.tracer import TRACER
 from ..ops.cross_entropy import causal_lm_loss
 from ..parallel.mesh import use_mesh
 from ..parallel.partition import P, sharding_tree
@@ -111,9 +112,12 @@ class Trainer:
         # reorder (a post-permutation causal shift would be wrong); both the train
         # and eval steps then compute the loss with shift=False.
         self._labels_preshifted = self.mesh.shape.get("cp", 1) > 1 and criterion is None
-        from .integrations import get_reporting_callbacks
+        from .integrations import MetricsCallback, get_reporting_callbacks
 
-        callbacks = DEFAULT_CALLBACKS + get_reporting_callbacks(args.report_to) + (callbacks or [])
+        # MetricsCallback feeds the shared metrics plane (serving.metrics.REGISTRY)
+        # on every run; its HTTP exporter only starts when args.metrics_port is set
+        callbacks = DEFAULT_CALLBACKS + [MetricsCallback] \
+            + get_reporting_callbacks(args.report_to) + (callbacks or [])
         self.callback_handler = CallbackHandler(callbacks, self.model, self.tokenizer)
         self.timers = Timers()  # reference trainer/plugins/timer.py phase buckets
         set_seed(args.seed)
@@ -635,6 +639,7 @@ class Trainer:
                         # is in DATA steps — those batches are consumed untrained
                         self.state.consumed_samples += args.global_train_batch_size
                         continue
+                    step_t0 = time.perf_counter()
                     self.control = self.callback_handler.on_step_begin(args, self.state, self.control)
                     batch = self._device_put_batch(host_batch, accum, micro_axis=self._use_pipeline())
                     self.timers("read-data").stop()
@@ -663,9 +668,19 @@ class Trainer:
                             self._profiler = ProfilerStepper(
                                 ProfilerOptions.parse(args.profiler_options))
                         self._profiler.step(self.state.global_step)
+                    step_tokens, seq_len = 0, None
                     if "input_ids" in host_batch:
-                        tokens_seen += int(np.prod(np.asarray(host_batch["input_ids"]).shape))
-                    self.control = self.callback_handler.on_step_end(args, self.state, self.control)
+                        shape = np.asarray(host_batch["input_ids"]).shape
+                        step_tokens = int(np.prod(shape))
+                        seq_len = int(shape[-1])
+                        tokens_seen += step_tokens
+                    self.control = self.callback_handler.on_step_end(
+                        args, self.state, self.control, step_tokens=step_tokens,
+                        seq_len=seq_len)
+                    TRACER.add_span("train_step", TRACER.epoch_time(step_t0),
+                                    time.perf_counter() - step_t0, cat="trainer",
+                                    trace="train", step=self.state.global_step,
+                                    tokens=step_tokens)
                     self._maybe_log_save_evaluate(last_metrics, train_start, tokens_seen)
                     if self.control.should_training_stop or self.state.global_step >= max_steps:
                         break
@@ -734,10 +749,14 @@ class Trainer:
             self.timers.log(["read-data", "forward-backward-optimizer"], normalizer=max(len(interval), 1))
             self.control = self.callback_handler.on_log(args, self.state, self.control, logs=logs)
         if self.control.should_evaluate:
-            metrics_out = self.evaluate()
+            with TRACER.span("evaluate", cat="trainer", trace="train",
+                             step=self.state.global_step):
+                metrics_out = self.evaluate()
             self.control = self.callback_handler.on_evaluate(args, self.state, self.control, metrics=metrics_out)
         if self.control.should_save:
-            self._save_checkpoint()
+            with TRACER.span("checkpoint", cat="trainer", trace="train",
+                             step=self.state.global_step):
+                self._save_checkpoint()
             self.control = self.callback_handler.on_save(args, self.state, self.control)
 
     # ------------------------------------------------------------------ eval

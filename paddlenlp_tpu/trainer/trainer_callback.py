@@ -180,12 +180,14 @@ class CallbackHandler(TrainerCallback):
     def on_epoch_end(self, args, state, control):
         return self.call_event("on_epoch_end", args, state, control)
 
-    def on_step_begin(self, args, state, control):
+    def on_step_begin(self, args, state, control, **kwargs):
         control._new_step()
-        return self.call_event("on_step_begin", args, state, control)
+        return self.call_event("on_step_begin", args, state, control, **kwargs)
 
-    def on_step_end(self, args, state, control):
-        return self.call_event("on_step_end", args, state, control)
+    def on_step_end(self, args, state, control, **kwargs):
+        # kwargs carry per-step observables (e.g. ``step_tokens``) for
+        # metrics/reporting callbacks
+        return self.call_event("on_step_end", args, state, control, **kwargs)
 
     def on_substep_end(self, args, state, control):
         return self.call_event("on_substep_end", args, state, control)

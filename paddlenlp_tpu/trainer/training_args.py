@@ -98,7 +98,18 @@ class TrainingArguments:
     profiler_options: Optional[str] = field(
         default=None,
         metadata={"help": 'jax.profiler trace window, e.g. "batch_range=[10,20];profile_path=./prof" '
-                          "(reference utils/profiler.py ProfilerOptions)"})
+                          "(reference utils/profiler.py ProfilerOptions). The same window also "
+                          "dumps the host-side span timeline (Chrome trace JSON) next to the "
+                          "device trace."})
+    metrics_port: Optional[int] = field(
+        default=None,
+        metadata={"help": "start a background HTTP observability exporter for this training job "
+                          "(GET /metrics Prometheus text, /health, /debug/trace) on this port "
+                          "(0 = ephemeral). None (default) disables it; metrics still populate "
+                          "the in-process registry either way."})
+    metrics_host: str = field(
+        default="127.0.0.1",
+        metadata={"help": "bind host for the metrics exporter (0.0.0.0 to expose off-host)"})
     disable_tqdm: bool = False
 
     # ---- parallelism (reference degrees, training_args.py:539-705) ----

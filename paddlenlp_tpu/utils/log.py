@@ -3,11 +3,18 @@
 TPU-native counterpart of the reference's ``paddlenlp/utils/log.py`` (colorlog-based
 singleton logger). Here rank-awareness comes from ``jax.process_index()`` instead of
 ``paddle.distributed`` env vars; only process 0 logs at INFO by default.
+
+Structured mode: ``PDNLP_TPU_LOG_JSON=1`` switches the formatter to one JSON
+object per line (``ts``/``level``/``logger``/``msg``/``file``/``line`` [+
+``exc``]) so serving and trainer logs are machine-parseable — the shape log
+shippers (fluentbit/vector) and ``jq`` expect. ``logger.set_json(True)``
+toggles it at runtime.
 """
 
 from __future__ import annotations
 
 import functools
+import json
 import logging
 import os
 import sys
@@ -50,6 +57,23 @@ class _ColorFormatter(logging.Formatter):
         return f"{color}[{timestamp}] [{record.levelname:>8}]{_RESET} {record.pathname.split('/')[-1]}:{record.lineno} - {msg}"
 
 
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line; keys stable for log shippers."""
+
+    def format(self, record):  # noqa: A003
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+            "file": record.pathname.split("/")[-1],
+            "line": record.lineno,
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
 class Logger:
     """Singleton logger with level context manager, mirroring reference semantics."""
 
@@ -69,11 +93,16 @@ class Logger:
         self._initialized = True
         self.logger = logging.getLogger(name)
         self.logger.propagate = False
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(_ColorFormatter())
-        self.logger.addHandler(handler)
+        self._handler = logging.StreamHandler(sys.stderr)
+        json_mode = os.environ.get("PDNLP_TPU_LOG_JSON", "").lower() in ("1", "true", "yes")
+        self._handler.setFormatter(_JsonFormatter() if json_mode else _ColorFormatter())
+        self.logger.addHandler(self._handler)
         level = os.environ.get("PDNLP_TPU_LOG_LEVEL", "INFO").upper()
         self.logger.setLevel(level)
+
+    def set_json(self, enabled: bool = True):
+        """Switch between JSON-lines and colored human formatting."""
+        self._handler.setFormatter(_JsonFormatter() if enabled else _ColorFormatter())
 
     def _log(self, level: int, msg, *args):
         if _process_index() != 0 and level < logging.WARNING:

@@ -4,7 +4,11 @@ Counterpart of ``paddlenlp/utils/profiler.py`` (``ProfilerOptions`` :28,
 ``add_profiler_step`` :88 — timeline export controlled by the
 ``--profiler_options`` launch flag). TPU-native: the window drives
 ``jax.profiler.start_trace``/``stop_trace``, producing an XPlane/TensorBoard
-trace of the XLA device timeline.
+trace of the XLA device timeline — AND the host-side span timeline for the
+same step range: when the window closes, every observability-tracer span
+recorded inside it is written to ``<profile_path>/span_timeline.json`` (Chrome
+trace-event JSON, open in Perfetto next to the device trace) and
+``<profile_path>/spans.jsonl``. One flag, both timelines.
 
 Options string: ``key=value`` pairs separated by ``;``, e.g.
 ``batch_range=[10,20];profile_path=./profile_out`` — the trace covers steps
@@ -14,8 +18,10 @@ Options string: ``key=value`` pairs separated by ``;``, e.g.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
+from ..observability.tracer import TRACER
 from .log import logger
 
 __all__ = ["ProfilerOptions", "ProfilerStepper", "add_profiler_step"]
@@ -52,10 +58,12 @@ class ProfilerStepper:
     """Call ``step(global_step)`` once per train step; traces the configured
     window exactly once."""
 
-    def __init__(self, options: ProfilerOptions):
+    def __init__(self, options: ProfilerOptions, tracer=TRACER):
         self.options = options
+        self.tracer = tracer
         self._active = False
         self._done = False
+        self._window_t0: Optional[float] = None
 
     def step(self, global_step: int):
         import jax
@@ -66,20 +74,43 @@ class ProfilerStepper:
         if not self._active and global_step >= start and global_step < end:
             jax.profiler.start_trace(self.options.profile_path)
             self._active = True
+            # anchored-timeline cursor (snapshot since_ts compares span.ts,
+            # which is perf-anchored — a wall-clock step must not empty the window)
+            self._window_t0 = self.tracer.now()
+            self.tracer.instant("profiler_window_start", cat="profiler",
+                                trace="train", step=global_step)
             logger.info(f"profiler: tracing steps [{global_step}, {end}) -> {self.options.profile_path}")
         elif self._active and global_step >= end:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            logger.info(f"profiler: trace written to {self.options.profile_path}")
+            self._stop(global_step)
+
+    def _stop(self, global_step: Optional[int] = None):
+        import jax
+
+        self.tracer.instant("profiler_window_stop", cat="profiler",
+                            trace="train", step=global_step)
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        self._dump_spans()
+        logger.info(f"profiler: trace written to {self.options.profile_path}")
+
+    def _dump_spans(self):
+        """Write the window's host-side span timeline next to the device trace
+        (same step range — filtered by the window's start timestamp)."""
+        try:
+            os.makedirs(self.options.profile_path, exist_ok=True)
+            spans = self.tracer.snapshot(since_ts=self._window_t0)
+            path = os.path.join(self.options.profile_path, "span_timeline.json")
+            self.tracer.write_chrome_trace(path, spans)
+            with open(os.path.join(self.options.profile_path, "spans.jsonl"), "w") as f:
+                f.write(self.tracer.to_jsonl(spans) + "\n")
+            logger.info(f"profiler: {len(spans)} host spans -> {path}")
+        except Exception as e:  # span dump must never fail the run
+            logger.warning(f"profiler: span timeline dump failed: {e!r}")
 
     def close(self):
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            self._stop()
 
 
 _GLOBAL: Optional[ProfilerStepper] = None

@@ -1,0 +1,38 @@
+"""tier-1 enforcement of metric-catalog hygiene: tools/check_metrics.py must
+lint the full serving + training catalog clean (HELP/TYPE present, valid
+Prometheus text format)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+TOOL = os.path.join(REPO, "tools", "check_metrics.py")
+
+
+class TestCheckMetrics:
+    def test_catalog_lints_clean(self):
+        proc = subprocess.run(
+            [sys.executable, TOOL], capture_output=True, text=True, timeout=300,
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        line = next((ln for ln in reversed(proc.stdout.strip().splitlines())
+                     if ln.startswith("{")), None)
+        assert line is not None, f"no JSON output (rc={proc.returncode}): {proc.stderr[-2000:]}"
+        report = json.loads(line)
+        assert proc.returncode == 0 and report["ok"], report["problems"]
+        # the serving + training catalogs are both present
+        assert report["families"] >= 20
+
+    def test_lint_flags_dirty_exposition(self, tmp_path):
+        dump = tmp_path / "dump.txt"
+        dump.write_text("# TYPE nohelp_total counter\nnohelp_total 1\nuntyped_thing 2\n")
+        proc = subprocess.run(
+            [sys.executable, TOOL, "--file", str(dump)],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert len(report["problems"]) == 2

@@ -1,0 +1,110 @@
+"""Background HTTP exporter: /metrics + /health + /debug/trace off a daemon
+thread, plus the Prometheus parse/lint helpers it feeds."""
+
+import http.client
+import json
+
+import pytest
+
+from paddlenlp_tpu.observability import (
+    ObservabilityExporter,
+    SpanTracer,
+    histogram_quantile,
+    lint_exposition,
+    parse_prometheus_text,
+)
+from paddlenlp_tpu.serving.metrics import MetricsRegistry
+
+
+@pytest.fixture()
+def exporter():
+    registry = MetricsRegistry()
+    registry.counter("demo_requests_total", "Demo requests").inc(3)
+    registry.histogram("demo_latency_seconds", "Demo latency").observe(0.02)
+    tracer = SpanTracer(capacity=32)
+    with tracer.span("phase", cat="demo"):
+        pass
+    exp = ObservabilityExporter(
+        registry=registry, tracer=tracer, health_fn=lambda: {"step": 7})
+    port = exp.start(port=0)
+    yield exp, port
+    exp.shutdown()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+class TestExporter:
+    def test_metrics_endpoint(self, exporter):
+        _, port = exporter
+        status, body = _get(port, "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "# TYPE demo_requests_total counter" in text
+        assert "demo_requests_total 3" in text
+        assert lint_exposition(text) == []
+
+    def test_health_endpoint(self, exporter):
+        _, port = exporter
+        status, body = _get(port, "/health")
+        payload = json.loads(body)
+        assert status == 200 and payload["status"] == "ok" and payload["step"] == 7
+
+    def test_debug_trace_endpoint(self, exporter):
+        _, port = exporter
+        status, body = _get(port, "/debug/trace")
+        assert status == 200
+        events = json.loads(body)["traceEvents"]
+        assert any(e["name"] == "phase" and e["ph"] == "X" for e in events)
+
+    def test_debug_spans_endpoint(self, exporter):
+        _, port = exporter
+        status, body = _get(port, "/debug/spans")
+        assert status == 200
+        assert json.loads(body.decode().splitlines()[0])["name"] == "phase"
+
+    def test_404(self, exporter):
+        _, port = exporter
+        status, _ = _get(port, "/nope")
+        assert status == 404
+
+
+class TestPromParse:
+    def test_parse_and_quantile_roundtrip(self):
+        registry = MetricsRegistry()
+        h = registry.histogram("rt_seconds", "round trip", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.6, 5.0):
+            h.observe(v)
+        registry.counter("hits_total", "hits", labelnames=("code",)).inc(4, code="200")
+        fams = parse_prometheus_text(registry.expose())
+        assert fams["hits_total"].value(code="200") == 4
+        assert fams["rt_seconds"].type == "histogram"
+        assert fams["rt_seconds"].value("rt_seconds_count") == 4
+        # in-process percentile and scraped-quantile agree (same bucket math)
+        assert histogram_quantile(fams["rt_seconds"], 0.5) == h.percentile(0.5)
+
+    def test_label_values_roundtrip(self):
+        registry = MetricsRegistry()
+        c = registry.counter("hits_total", "hits", labelnames=("model",))
+        for value in ('café', 'a"b', 'x\\y', 'line\nbreak'):
+            c.inc(model=value)
+        fams = parse_prometheus_text(registry.expose())
+        for value in ('café', 'a"b', 'x\\y', 'line\nbreak'):
+            assert fams["hits_total"].value(model=value) == 1, value
+
+    def test_lint_catches_problems(self):
+        assert lint_exposition("no_type_metric 1\n") == [
+            "no_type_metric: samples without a # TYPE line"]
+        missing_help = "# TYPE x counter\nx 1\n"
+        assert any("missing # HELP" in p for p in lint_exposition(missing_help))
+        bad_hist = ("# HELP h H\n# TYPE h histogram\n"
+                    'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\nh_sum 1\nh_count 3\n')
+        assert any("not cumulative" in p for p in lint_exposition(bad_hist))
+        neg = "# HELP c C\n# TYPE c counter\nc -1\n"
+        assert any("has value -1" in p for p in lint_exposition(neg))

@@ -1,0 +1,55 @@
+"""Satellite: one profiler flag produces BOTH a jax.profiler device trace and
+a host-side span timeline for the same step window."""
+
+import json
+import os
+
+import pytest
+
+from paddlenlp_tpu.observability import SpanTracer
+from paddlenlp_tpu.utils.profiler import ProfilerOptions, ProfilerStepper
+
+
+class TestProfilerSpanWindow:
+    def test_window_dumps_span_timeline(self, tmp_path):
+        path = str(tmp_path / "prof")
+        tracer = SpanTracer(capacity=128)
+        stepper = ProfilerStepper(
+            ProfilerOptions(batch_range=(1, 3), profile_path=path), tracer=tracer)
+        tracer.instant("before_window", cat="test")  # outside: must be excluded
+        for step in range(5):
+            stepper.step(step)
+            with tracer.span(f"step{step}", cat="test"):
+                pass
+        timeline = os.path.join(path, "span_timeline.json")
+        assert os.path.isdir(path), "jax.profiler trace dir missing"
+        assert os.path.exists(timeline)
+        with open(timeline) as f:
+            events = json.load(f)["traceEvents"]
+        names = {e["name"] for e in events}
+        assert "profiler_window_start" in names
+        assert "profiler_window_stop" in names
+        assert {"step1", "step2"} <= names  # spans inside [1, 3)
+        assert "before_window" not in names
+        with open(os.path.join(path, "spans.jsonl")) as f:
+            for line in f.read().strip().splitlines():
+                json.loads(line)
+
+    def test_close_flushes_open_window(self, tmp_path):
+        path = str(tmp_path / "prof2")
+        tracer = SpanTracer(capacity=128)
+        stepper = ProfilerStepper(
+            ProfilerOptions(batch_range=(0, 100), profile_path=path), tracer=tracer)
+        stepper.step(0)
+        with tracer.span("inside", cat="test"):
+            pass
+        stepper.close()
+        with open(os.path.join(path, "span_timeline.json")) as f:
+            names = {e["name"] for e in json.load(f)["traceEvents"]}
+        assert "inside" in names
+
+    def test_parse_rejects_bad_ranges(self):
+        with pytest.raises(ValueError):
+            ProfilerOptions.parse("batch_range=[5,2]")
+        opts = ProfilerOptions.parse("batch_range=[10,20];profile_path=/tmp/x")
+        assert opts.batch_range == (10, 20) and opts.profile_path == "/tmp/x"

@@ -1,0 +1,176 @@
+"""Span tracer unit tests: concurrency, ring-buffer eviction, trace-context
+propagation, and Chrome trace-event JSON validity (the format /debug/trace
+serves and Perfetto loads). No jax, no engine — stdlib-only module."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from paddlenlp_tpu.observability import SpanTracer, current_trace, use_trace
+
+
+class TestRecording:
+    def test_span_records_duration(self):
+        tr = SpanTracer(capacity=16)
+        with tr.span("work", cat="test", k=1):
+            time.sleep(0.01)
+        (s,) = tr.snapshot()
+        assert s.name == "work" and s.cat == "test"
+        assert s.dur >= 0.01
+        assert s.args == {"k": 1}
+        assert s.tid == threading.get_ident()
+
+    def test_instant_has_no_duration(self):
+        tr = SpanTracer(capacity=16)
+        tr.instant("marker", cat="test")
+        (s,) = tr.snapshot()
+        assert s.dur is None
+
+    def test_mid_span_args_and_error_capture(self):
+        tr = SpanTracer(capacity=16)
+        with tr.span("w") as sp:
+            sp.set(tokens=7)
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("nope")
+        spans = {s.name: s for s in tr.snapshot()}
+        assert spans["w"].args == {"tokens": 7}
+        assert "RuntimeError" in spans["boom"].args["error"]
+
+    def test_add_span_retrospective(self):
+        tr = SpanTracer(capacity=16)
+        t0 = time.time() - 1.0
+        tr.add_span("late", t0, 0.5, cat="x", trace="req-1", n=2)
+        (s,) = tr.snapshot()
+        assert s.ts == t0 and s.dur == 0.5 and s.trace == "req-1"
+
+    def test_disabled_tracer_records_nothing(self):
+        tr = SpanTracer(capacity=16, enabled=False)
+        with tr.span("w"):
+            pass
+        tr.instant("i")
+        tr.add_span("a", time.time(), 0.1)
+        assert len(tr) == 0
+
+
+class TestRingBuffer:
+    def test_eviction_keeps_newest(self):
+        tr = SpanTracer(capacity=10)
+        for i in range(25):
+            tr.instant(f"s{i}")
+        assert len(tr) == 10
+        assert tr.dropped == 15
+        assert [s.name for s in tr.snapshot()] == [f"s{i}" for i in range(15, 25)]
+
+    def test_clear(self):
+        tr = SpanTracer(capacity=4)
+        for i in range(8):
+            tr.instant(f"s{i}")
+        tr.clear()
+        assert len(tr) == 0 and tr.dropped == 0
+
+    def test_concurrent_spans(self):
+        tr = SpanTracer(capacity=4096)
+        n_threads, per_thread = 8, 100
+        # all workers alive until everyone recorded, else the OS recycles
+        # thread idents and the distinct-tid assertion undercounts
+        barrier = threading.Barrier(n_threads)
+
+        def worker(t):
+            for i in range(per_thread):
+                with tr.span(f"t{t}-{i}", cat="conc"):
+                    pass
+            barrier.wait()
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spans = tr.snapshot()
+        assert len(spans) == n_threads * per_thread
+        assert len({s.tid for s in spans}) == n_threads
+        assert {s.name for s in spans} == {
+            f"t{t}-{i}" for t in range(n_threads) for i in range(per_thread)}
+
+
+class TestTraceContext:
+    def test_ambient_trace_propagates(self):
+        tr = SpanTracer(capacity=16)
+        assert current_trace() is None
+        with use_trace("req-7"):
+            assert current_trace() == "req-7"
+            with tr.span("inner"):
+                pass
+            tr.instant("mark")
+        assert current_trace() is None
+        assert all(s.trace == "req-7" for s in tr.snapshot())
+
+    def test_explicit_trace_wins(self):
+        tr = SpanTracer(capacity=16)
+        with use_trace("ambient"):
+            with tr.span("s", trace="explicit"):
+                pass
+        (s,) = tr.snapshot()
+        assert s.trace == "explicit"
+
+    def test_snapshot_filters(self):
+        tr = SpanTracer(capacity=16)
+        tr.add_span("a", 100.0, 1.0, trace="x")
+        tr.add_span("b", 200.0, 1.0, trace="y")
+        assert [s.name for s in tr.snapshot(trace="y")] == ["b"]
+        assert [s.name for s in tr.snapshot(since_ts=150.0)] == ["b"]
+
+
+class TestChromeExport:
+    def _tracer(self):
+        tr = SpanTracer(capacity=64)
+        with tr.span("outer", cat="phase", trace="req-0", size=3):
+            with tr.span("inner", cat="phase"):
+                pass
+        tr.instant("evicted", cat="event")
+        return tr
+
+    def test_chrome_trace_json_valid(self):
+        tr = self._tracer()
+        parsed = json.loads(json.dumps(tr.chrome_trace()))
+        events = parsed["traceEvents"]
+        assert events, "no events exported"
+        complete = [e for e in events if e["ph"] == "X"]
+        instants = [e for e in events if e["ph"] == "i"]
+        meta = [e for e in events if e["ph"] == "M"]
+        assert {e["name"] for e in complete} == {"outer", "inner"}
+        assert {e["name"] for e in instants} == {"evicted"}
+        for e in complete:
+            assert {"name", "cat", "ph", "ts", "pid", "tid", "dur"} <= set(e)
+            assert isinstance(e["ts"], (int, float)) and e["dur"] >= 0
+        for e in instants:
+            assert "dur" not in e and e["s"] == "t"
+        # thread metadata names the lane
+        assert any(e["name"] == "thread_name" and e["args"]["name"] for e in meta)
+        # trace id rides on args
+        outer = next(e for e in complete if e["name"] == "outer")
+        assert outer["args"]["trace"] == "req-0" and outer["args"]["size"] == 3
+
+    def test_inner_nested_within_outer(self):
+        tr = self._tracer()
+        ev = {e["name"]: e for e in tr.chrome_trace()["traceEvents"] if e["ph"] == "X"}
+        o, i = ev["outer"], ev["inner"]
+        assert o["ts"] <= i["ts"] and i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+
+    def test_jsonl_export(self):
+        tr = self._tracer()
+        lines = tr.to_jsonl().splitlines()
+        assert len(lines) == 3
+        for line in lines:
+            d = json.loads(line)
+            assert {"name", "ts", "tid", "thread"} <= set(d)
+
+    def test_write_chrome_trace(self, tmp_path):
+        tr = self._tracer()
+        path = str(tmp_path / "trace.json")
+        tr.write_chrome_trace(path)
+        with open(path) as f:
+            assert json.load(f)["traceEvents"]

@@ -1,0 +1,122 @@
+"""Debug introspection endpoints (ISSUE 2 acceptance): a served request must
+produce a span timeline retrievable via /debug/trace that parses as valid
+Chrome trace-event JSON, and /debug/requests must show in-flight and finished
+request timelines."""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine
+from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, ServingServer
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    model = LlamaForCausalLM.from_config(cfg, seed=0)
+    engine = InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
+                             max_blocks_per_seq=32, decode_steps=4)
+    server = ServingServer(engine, registry=MetricsRegistry(),
+                           scheduler_config=SchedulerConfig(max_inflight=8))
+    port = server.start_in_thread()
+    yield server, port
+    server.shutdown(drain_timeout_s=10)
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def _complete(port, max_tokens=8):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/completions",
+                 body=json.dumps({"prompt": [5, 6, 7], "max_tokens": max_tokens}),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    return out
+
+
+class TestDebugTrace:
+    def test_request_produces_valid_chrome_trace(self, server_port):
+        server, port = server_port
+        _complete(port)
+        status, body = _get(port, "/debug/trace")
+        assert status == 200
+        parsed = json.loads(body)  # valid JSON is the acceptance bar
+        events = parsed["traceEvents"]
+        names = {e["name"] for e in events}
+        # request lifecycle spans (engine loop) + engine phase spans
+        assert {"request", "prefill", "decode", "admission"} <= names
+        for e in events:
+            assert {"name", "ph", "pid", "tid"} <= set(e)
+            if e["ph"] == "X":
+                assert "ts" in e and e["dur"] >= 0
+        # the request's phases share one trace id
+        req_ev = next(e for e in events if e["name"] == "request")
+        trace_id = req_ev["args"]["trace"]
+        phases = {e["name"] for e in events
+                  if e.get("args", {}).get("trace") == trace_id}
+        assert {"queue", "prefill", "decode", "request"} <= phases
+
+    def test_trace_grows_with_requests(self, server_port):
+        server, port = server_port
+        _, before = _get(port, "/debug/trace")
+        _complete(port)
+        _, after = _get(port, "/debug/trace")
+        assert len(json.loads(after)["traceEvents"]) > len(json.loads(before)["traceEvents"])
+
+
+class TestDebugRequests:
+    def test_finished_request_in_recent(self, server_port):
+        server, port = server_port
+        out = _complete(port)
+        status, body = _get(port, "/debug/requests")
+        assert status == 200
+        payload = json.loads(body)
+        assert {"inflight", "recent"} <= set(payload)
+        assert payload["recent"], "finished request missing from /debug/requests"
+        rec = payload["recent"][-1]
+        assert rec["state"] == "finished"
+        assert rec["finish_reason"] == out["choices"][0]["finish_reason"]
+        assert rec["trace"].startswith("req-")
+        assert rec["output_tokens"] >= 1 and rec["ttft_s"] >= 0
+
+    def test_inflight_request_visible(self, server_port):
+        server, port = server_port
+        # long request submitted straight through the scheduler (no HTTP block);
+        # 100 new tokens ≈ hundreds of ms on CPU — plenty of polls catch it
+        from paddlenlp_tpu.experimental import SamplingParams
+
+        handle = server.scheduler.submit(
+            [5, 6, 7, 8], SamplingParams(max_new_tokens=100), timeout_s=60)
+        try:
+            deadline = time.time() + 30
+            seen = None
+            while time.time() < deadline and not handle.done():
+                _, body = _get(port, "/debug/requests")
+                inflight = json.loads(body)["inflight"]
+                if inflight:
+                    seen = inflight[0]
+                    break
+                time.sleep(0.005)
+            assert seen is not None, "request never appeared in /debug/requests"
+            assert seen["trace"] == handle.trace
+            assert seen["state"] in ("submitted", "queued", "prefill", "decode")
+            assert seen["age_s"] >= 0
+        finally:
+            server.scheduler.cancel(handle)
+            handle.result(timeout=30)

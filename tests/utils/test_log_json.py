@@ -1,0 +1,71 @@
+"""Structured-JSON logging mode: one parseable JSON object per line with
+level/ts/logger/msg, selectable via PDNLP_TPU_LOG_JSON."""
+
+import importlib.util
+import json
+import logging
+import os
+import subprocess
+import sys
+
+LOG_PY = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "paddlenlp_tpu", "utils", "log.py")
+
+
+def _load_log_module(name):
+    # log.py is stdlib-only and relative-import-free: loading it straight from
+    # its file skips the heavyweight package __init__
+    spec = importlib.util.spec_from_file_location(name, LOG_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestJsonFormatter:
+    def _record(self, msg="hello", exc=None):
+        return logging.LogRecord(
+            name="paddlenlp_tpu", level=logging.WARNING, pathname="/a/b/serving.py",
+            lineno=42, msg=msg, args=(), exc_info=exc)
+
+    def test_record_formats_as_json(self):
+        mod = _load_log_module("_log_json_test")
+        out = json.loads(mod._JsonFormatter().format(self._record()))
+        assert out["level"] == "WARNING"
+        assert out["logger"] == "paddlenlp_tpu"
+        assert out["msg"] == "hello"
+        assert out["file"] == "serving.py" and out["line"] == 42
+        assert isinstance(out["ts"], float)
+
+    def test_exception_lands_in_exc_key(self):
+        mod = _load_log_module("_log_json_test2")
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            rec = self._record(exc=sys.exc_info())
+        out = json.loads(mod._JsonFormatter().format(rec))
+        assert "ValueError: boom" in out["exc"]
+        assert "\n" not in mod._JsonFormatter().format(rec)  # one line per event
+
+    def test_env_var_selects_json_mode(self):
+        # fresh interpreter so the env var is read at Logger construction;
+        # log.py loads from file, keeping the subprocess light
+        code = (
+            "import importlib.util\n"
+            f"spec = importlib.util.spec_from_file_location('l', {LOG_PY!r})\n"
+            "m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)\n"
+            "m.logger.warning('json mode works')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=60,
+            env={**os.environ, "PDNLP_TPU_LOG_JSON": "1"})
+        line = proc.stderr.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["msg"] == "json mode works" and out["level"] == "WARNING"
+
+    def test_set_json_toggles_at_runtime(self):
+        mod = _load_log_module("_log_json_test3")
+        logger = mod.logger
+        logger.set_json(True)
+        assert isinstance(logger._handler.formatter, mod._JsonFormatter)
+        logger.set_json(False)
+        assert isinstance(logger._handler.formatter, mod._ColorFormatter)

@@ -136,13 +136,29 @@ def run() -> None:
     for t in threads:
         t.join()
     dt = time.time() - t0
+
+    # scrape /metrics over HTTP (the same path a real Prometheus takes) BEFORE
+    # shutdown, while the end-of-run engine state is still live
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    scraped = resp.read().decode()
+    conn.close()
+    if resp.status != 200:
+        _fail(f"/metrics scrape failed: HTTP {resp.status}")
     server.shutdown(drain_timeout_s=10)
 
     if errors:
         _fail(f"{len(errors)}/{n_requests} requests failed: {errors[:3]}")
     ttfts = sorted(stats["ttft"])
     p = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)] if ttfts else 0.0
-    server_ttft = registry.get("paddlenlp_serving_ttft_seconds")
+
+    from paddlenlp_tpu.observability import histogram_quantile, parse_prometheus_text
+
+    fams = parse_prometheus_text(scraped)
+    scalar = lambda name: (fams[name].value() or 0.0) if name in fams else 0.0
+    inter_token = fams.get("paddlenlp_serving_inter_token_seconds")
+    server_ttft = fams.get("paddlenlp_serving_ttft_seconds")
     print(json.dumps({
         "metric": METRIC,
         "value": round(n_requests / dt, 3),
@@ -154,8 +170,14 @@ def run() -> None:
         "tokens_per_sec": round(stats["tokens"] / dt, 1),
         "p50_ttft_ms": round(p(0.50) * 1e3, 1),
         "p99_ttft_ms": round(p(0.99) * 1e3, 1),
-        "server_ttft_p50_ms": round(server_ttft.percentile(0.5) * 1e3, 1),
-        "preemptions": registry.get("paddlenlp_serving_preemptions_total").value(),
+        "server_ttft_p50_ms": round(
+            histogram_quantile(server_ttft, 0.5) * 1e3 if server_ttft else 0.0, 1),
+        "p99_inter_token_ms": round(
+            histogram_quantile(inter_token, 0.99) * 1e3 if inter_token else 0.0, 1),
+        "kv_utilization": round(scalar("paddlenlp_serving_kv_utilization"), 4),
+        "kv_free_blocks": scalar("paddlenlp_serving_kv_free_blocks"),
+        "preemptions": scalar("paddlenlp_serving_preemptions_total"),
+        "tokens_generated": scalar("paddlenlp_serving_tokens_generated_total"),
     }))
 
 
