@@ -36,11 +36,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability.tracer import TRACER
+from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .inference_model import PagedInferenceModel
 from .paged_cache import BlockManager, init_paged_pool
 
 __all__ = ["InferenceEngine", "Request", "SamplingParams"]
+
+_F_STEP = FaultPoint("engine.step")
 
 
 @dataclasses.dataclass
@@ -225,6 +228,24 @@ class InferenceEngine:
         req.finish_t = time.time()
         self._spec_rngs.pop(req.req_id, None)
 
+    def reset(self):
+        """Drop ALL scheduler/allocator state after a failed step — the
+        in-place recovery the serving supervisor uses when it has no
+        ``engine_factory``. The device pool tensor is kept (stale KV is
+        unreachable once the block tables are rebuilt; prefill overwrites
+        live slots), so reset is O(host state), not O(HBM).
+
+        In-flight requests are NOT resolved here: the supervisor owns their
+        retry/abort disposition and must triage before calling reset."""
+        self.waiting.clear()
+        self.slots = [None] * self.max_batch_size
+        self.mgr = BlockManager(self.mgr.total_usable_blocks + 1, self.mgr.block_size,
+                                self.mgr.max_blocks_per_seq)
+        self._last_token[:] = 0
+        self.counts = jnp.zeros_like(self.counts)
+        self._spec_rngs.clear()
+        logger.warning("inference engine reset: scheduler + KV allocator state dropped")
+
     def stats(self) -> Dict:
         """Point-in-time scheduler/allocator stats (the step_cb payload)."""
         return {
@@ -249,6 +270,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------ scheduling
     def step(self) -> List[Request]:
         """One engine iteration: admit + decode. Returns requests finished this step."""
+        _F_STEP.fire()
         finished: List[Request] = []
         self._admit(finished)
         self._decode_running(finished)

@@ -7,9 +7,15 @@ jax-backed engine lazily at construction time.
 """
 
 from .api import ServingServer  # noqa: F401
-from .engine_loop import EngineLoop, RequestHandle, ServingMetrics  # noqa: F401
+from .engine_loop import (  # noqa: F401
+    EngineLoop,
+    RequestHandle,
+    ServingMetrics,
+    SupervisorPolicy,
+)
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .scheduler import (  # noqa: F401
+    DegradedError,
     SaturatedError,
     Scheduler,
     SchedulerConfig,
@@ -21,10 +27,12 @@ __all__ = [
     "EngineLoop",
     "RequestHandle",
     "ServingMetrics",
+    "SupervisorPolicy",
     "Scheduler",
     "SchedulerConfig",
     "SaturatedError",
     "ShuttingDownError",
+    "DegradedError",
     "MetricsRegistry",
     "Counter",
     "Gauge",

@@ -34,9 +34,15 @@ from typing import Dict, Optional
 from ..observability.exporter import route_observability
 from ..observability.tracer import TRACER
 from ..utils.log import logger
-from .engine_loop import EngineLoop, RequestHandle, ServingMetrics
+from .engine_loop import EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
 from .metrics import REGISTRY, MetricsRegistry
-from .scheduler import SaturatedError, Scheduler, SchedulerConfig, ShuttingDownError
+from .scheduler import (
+    DegradedError,
+    SaturatedError,
+    Scheduler,
+    SchedulerConfig,
+    ShuttingDownError,
+)
 
 __all__ = ["ServingServer"]
 
@@ -70,14 +76,17 @@ class ServingServer:
                  scheduler_config: Optional[SchedulerConfig] = None,
                  registry: Optional[MetricsRegistry] = None,
                  max_body_bytes: int = MAX_BODY_BYTES,
-                 max_src_tokens: Optional[int] = None):
+                 max_src_tokens: Optional[int] = None,
+                 engine_factory=None,
+                 supervisor_policy: Optional[SupervisorPolicy] = None):
         self.engine = engine
         self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
         self.registry = registry or REGISTRY
         self.tracer = TRACER
         self.max_body_bytes = max_body_bytes
         self.max_src_tokens = max_src_tokens
-        self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry))
+        self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry),
+                               engine_factory=engine_factory, policy=supervisor_policy)
         self.scheduler = Scheduler(self.loop, scheduler_config)
         self._ids = itertools.count()
         self._live: Dict[str, RequestHandle] = {}
@@ -112,7 +121,13 @@ class ServingServer:
             timeout_s = float(timeout_s)
             if timeout_s <= 0:
                 raise ValueError("timeout must be > 0 seconds")
-        handle = self.scheduler.submit(ids, sampling, timeout_s=timeout_s)
+        max_retries = payload.get("max_retries")
+        if max_retries is not None:
+            max_retries = int(max_retries)
+            if max_retries < 0:
+                raise ValueError("max_retries must be >= 0")
+        handle = self.scheduler.submit(ids, sampling, timeout_s=timeout_s,
+                                       max_retries=max_retries)
         cid = f"cmpl-{next(self._ids)}"
         with self._live_lock:
             self._live[cid] = handle
@@ -155,17 +170,23 @@ class ServingServer:
             def log_message(self, fmt, *args):
                 logger.debug("serving: " + fmt % args)
 
-            def _send_json(self, code: int, payload: dict):
-                self._send_raw(code, json.dumps(payload).encode(), "application/json")
+            def _send_json(self, code: int, payload: dict, headers: Optional[dict] = None):
+                self._send_raw(code, json.dumps(payload).encode(), "application/json",
+                               headers=headers)
 
-            def _send_error_json(self, code: int, message: str, etype: str):
-                self._send_json(code, {"error": {"message": message, "type": etype, "code": code}})
+            def _send_error_json(self, code: int, message: str, etype: str,
+                                 headers: Optional[dict] = None):
+                self._send_json(code, {"error": {"message": message, "type": etype, "code": code}},
+                                headers=headers)
 
             # --------------------------------------------------------- GET
-            def _send_raw(self, code: int, body: bytes, ctype: str):
+            def _send_raw(self, code: int, body: bytes, ctype: str,
+                          headers: Optional[dict] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -177,12 +198,20 @@ class ServingServer:
                     if routed is not None:
                         self._send_raw(routed[0], routed[2], routed[1])
                     elif self.path == "/health":
-                        status = "draining" if server.scheduler.draining else "ok"
+                        if server.scheduler.draining:
+                            status = "draining"
+                        elif server.loop.degraded:
+                            status = "degraded"
+                        else:
+                            status = "ok"
+                        headers = None
+                        if status == "degraded":
+                            headers = {"Retry-After": max(1, int(round(server.loop.retry_after_hint())))}
                         self._send_json(200 if status == "ok" else 503, {
                             "status": status,
                             "scheduler": server.scheduler.stats(),
-                            "engine": server.engine.stats(),
-                        })
+                            "engine": server.loop.engine.stats(),
+                        }, headers=headers)
                     elif self.path == "/debug/requests":
                         self._send_json(200, {
                             "inflight": server.loop.inflight_info(),
@@ -243,6 +272,13 @@ class ServingServer:
                     cid, handle = server.submit(payload)
                 except SaturatedError as e:
                     self._send_error_json(429, str(e), "rate_limit_exceeded")
+                    return
+                except DegradedError as e:
+                    # circuit breaker: engine rebuild in progress — a clean 503
+                    # with a recovery hint, never a connection reset
+                    self._send_error_json(
+                        503, str(e), "engine_recovering",
+                        headers={"Retry-After": max(1, int(round(e.retry_after_s)))})
                     return
                 except ShuttingDownError as e:
                     self._send_error_json(503, str(e), "shutting_down")

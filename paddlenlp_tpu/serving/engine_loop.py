@@ -1,4 +1,5 @@
-"""Background engine-driver thread: thread-safe submission + token streams.
+"""Background engine-driver thread: thread-safe submission + token streams,
+supervised for fault tolerance.
 
 Counterpart of the reference's serving split (``llm/predict/flask_server.py``
 pushes prompts into the inference process and reads tokens back over a SysV
@@ -15,37 +16,100 @@ host-side block manager needs no locks.
   ``finish_reason='abort'`` and ``timed_out=True`` on the handle);
 - all request lifecycle events land in the metrics plane (TTFT, queue wait,
   inter-token latency, tokens, preemptions, KV utilization).
+
+**Supervision.** An exception out of ``engine.step()`` no longer kills the
+loop. The loop transitions to DEGRADED: in-flight requests are triaged by the
+:class:`SupervisorPolicy` — retryable ones (within their bounded retry budget)
+are stashed for requeue, the rest resolve immediately with
+``finish_reason="engine_error"`` — then the engine is rebuilt (via the
+``engine_factory``, or ``engine.reset()`` in place) after an exponential
+backoff, stashed requests are resubmitted with their already-streamed tokens
+folded into the prompt (the same recompute trick preemption uses, so greedy
+and fixed-seed sampled requests continue with identical tokens), and the loop
+resumes. While DEGRADED the :class:`~.scheduler.Scheduler` circuit-breaks new
+admissions with 503 + ``Retry-After``. Restarts and retries are exported as
+``paddlenlp_serving_engine_restarts_total`` /
+``paddlenlp_serving_request_retries_total``, and each degraded window lands in
+the span tracer as an ``engine_degraded`` span.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import queue
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..observability.tracer import TRACER
+from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .metrics import REGISTRY, MetricsRegistry
 
-__all__ = ["EngineLoop", "RequestHandle", "ServingMetrics"]
+__all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy"]
 
 _END = object()  # token-queue sentinel: stream closed
+
+_F_REBUILD = FaultPoint("engine.rebuild")
+
+
+@dataclasses.dataclass
+class SupervisorPolicy:
+    """Governs the DEGRADED transition after an engine-step exception.
+
+    ``max_retries`` bounds how many engine rebuilds a single request may ride
+    through before it is fast-cleared with ``finish_reason="engine_error"``
+    (per-request override via ``submit(..., max_retries=)``). The rebuild
+    backoff is exponential in the consecutive-failure count, capped at
+    ``backoff_max_s``; a healthy stretch of ``failure_reset_s`` resets the
+    count. ``max_rebuild_attempts=None`` keeps trying forever — the circuit
+    breaker (503) is the pressure valve, not loop death."""
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 10.0
+    failure_reset_s: float = 60.0
+    max_rebuild_attempts: Optional[int] = None
+
+
+class _FailedRequest:
+    """Finished-request shim for handles resolved without a live engine
+    request (engine died and the retry budget is spent). Carries exactly the
+    fields the metrics plane, the trace emitter, and the HTTP layer read."""
+
+    def __init__(self, req_id, prompt_ids, output_ids, trace,
+                 arrival_t, finish_reason="engine_error"):
+        self.req_id = req_id if req_id is not None else -1
+        self.prompt_ids = list(prompt_ids)
+        self.output_ids = list(output_ids)
+        self.trace = trace
+        self.aborted = False
+        self.done = True
+        self.finish_reason = finish_reason
+        self.arrival_t = arrival_t
+        self.sched_t = None
+        self.first_token_t = None
+        self.finish_t = time.time()
+        self.queue_wait = None
+        self.ttft = None
+        self.decode_time = None
 
 
 class RequestHandle:
     """Client-side view of one in-flight request (future + token stream)."""
 
     def __init__(self, prompt_len: int, deadline_t: Optional[float] = None,
-                 trace: Optional[str] = None):
+                 trace: Optional[str] = None, max_retries: Optional[int] = None):
         self.req_id: Optional[int] = None  # assigned on the loop thread
         self.trace = trace  # span-tracer trace id linking this request's phases
         self.prompt_len = prompt_len
         self.deadline_t = deadline_t
         self.submitted_t = time.time()
         self.timed_out = False
+        self.max_retries = max_retries  # None = supervisor policy default
+        self.retries = 0  # engine rebuilds this request rode through
         self._token_q: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
         self._request = None  # engine Request once finished
@@ -53,6 +117,13 @@ class RequestHandle:
         self._cancelled = False
         self._callbacks: List = []
         self._cb_lock = threading.Lock()
+        # supervisor state: everything needed to resubmit after a rebuild
+        self._streamed: List[int] = []  # every token delivered to the client
+        self._stream_closed = False  # a done=True token was delivered (EOS/length)
+        self._first_token_t: Optional[float] = None  # true TTFT anchor across rebuilds
+        self._retry_prefix: List[int] = []  # tokens emitted before the last rebuild
+        self._prompt_ids: Optional[List[int]] = None
+        self._sampling = None
 
     # ------------------------------------------------------------- futures
     def done(self) -> bool:
@@ -96,6 +167,11 @@ class RequestHandle:
 
     # ------------------------------------------------------------- loop-side
     def _on_token(self, tok: int, done: bool):
+        if self._first_token_t is None:
+            self._first_token_t = time.time()
+        self._streamed.append(tok)
+        if done:
+            self._stream_closed = True
         self._token_q.put((tok, done))
 
     def add_done_callback(self, fn):
@@ -138,6 +214,12 @@ class ServingMetrics:
             "paddlenlp_serving_tokens_generated_total", "Generated tokens (all requests)")
         self.preemptions = r.counter(
             "paddlenlp_serving_preemptions_total", "KV-exhaustion preemptions (recompute requeues)")
+        self.engine_restarts = r.counter(
+            "paddlenlp_serving_engine_restarts_total",
+            "Engine rebuilds after a step exception (supervisor recoveries)")
+        self.request_retries = r.counter(
+            "paddlenlp_serving_request_retries_total",
+            "In-flight requests requeued across an engine rebuild")
         self.ttft = r.histogram(
             "paddlenlp_serving_ttft_seconds", "Time from arrival to first token")
         self.queue_wait = r.histogram(
@@ -158,6 +240,12 @@ class ServingMetrics:
             "paddlenlp_serving_kv_utilization", "1 - free/total KV blocks")
         self.spec_accept = r.gauge(
             "paddlenlp_serving_spec_acceptance_rate", "Accepted/drafted speculative tokens")
+        self.rebind(engine)
+
+    def rebind(self, engine):
+        """Point the pull-mode gauges at ``engine`` — the supervisor swaps the
+        engine on rebuild, and gauges bound to the dead instance would scrape
+        a ghost."""
         mgr = engine.mgr
         self.queue_depth.set_function(lambda: len(engine.waiting))
         self.running.set_function(
@@ -190,18 +278,27 @@ class EngineLoop:
     """Owns the engine on one thread; everything else talks through queues."""
 
     def __init__(self, engine, metrics: Optional[ServingMetrics] = None,
-                 registry: Optional[MetricsRegistry] = None, idle_wait_s: float = 0.05):
+                 registry: Optional[MetricsRegistry] = None, idle_wait_s: float = 0.05,
+                 engine_factory: Optional[Callable[[], object]] = None,
+                 policy: Optional[SupervisorPolicy] = None):
         self.engine = engine
         self.metrics = metrics or ServingMetrics(engine, registry)
         self.idle_wait_s = idle_wait_s
+        self.engine_factory = engine_factory
+        self.policy = policy or SupervisorPolicy()
         self._cmds: "queue.Queue" = queue.Queue()
         self._wake = threading.Event()
         self._handles: Dict[int, RequestHandle] = {}
+        self._requeue: List[RequestHandle] = []  # stashed across a rebuild
         self._last_token_t: Dict[int, float] = {}
-        self._seen_preemptions = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._started = False
+        self._state = "stopped"  # stopped | running | degraded
+        self._phase = "init"  # last loop phase (join-failure diagnostics)
+        self._consecutive_failures = 0
+        self._last_failure_t = 0.0
+        self._retry_after_hint = self.policy.backoff_base_s
         self._trace_seq = itertools.count()
         # /debug/requests tail: finished-request summaries (appended only on
         # the loop thread; deque ops are atomic so HTTP readers need no lock)
@@ -213,6 +310,7 @@ class EngineLoop:
             return self
         self._started = True
         self._stop = False
+        self._state = "running"
         self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
         self._thread.start()
         return self
@@ -221,14 +319,41 @@ class EngineLoop:
     def running(self) -> bool:
         return self._started and not self._stop
 
-    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+    @property
+    def state(self) -> str:
+        """``running`` | ``degraded`` | ``stopped``."""
+        return self._state
+
+    @property
+    def degraded(self) -> bool:
+        return self._state == "degraded"
+
+    def retry_after_hint(self) -> float:
+        """Suggested client backoff (seconds) while degraded — the current
+        rebuild backoff, so Retry-After tracks actual recovery cadence."""
+        return max(self._retry_after_hint, 0.1)
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None,
+             join_timeout_s: float = 30.0) -> bool:
         """Stop the loop. ``drain=True`` finishes in-flight work first
-        (bounded by ``timeout``); leftovers and ``drain=False`` abort."""
+        (bounded by ``timeout``); leftovers and ``drain=False`` abort.
+
+        Returns True once the loop thread has actually exited. A thread that
+        refuses to join within ``join_timeout_s`` (e.g. wedged inside a device
+        call) is reported — with its last-known phase — and ``False`` is
+        returned so the caller knows the engine may still be mutating."""
         if not self._started:
-            return
+            return True
         if drain:
             deadline = None if timeout is None else time.time() + timeout
             while self.pending_count() > 0:
+                if self.degraded:
+                    # a degraded engine may never come back (factory failing
+                    # forever) — draining would spin until the heat death of
+                    # the process; abort the stashed work instead
+                    logger.warning(
+                        f"engine degraded during drain; aborting {self.pending_count()} requests")
+                    break
                 if deadline is not None and time.time() >= deadline:
                     logger.warning(f"engine loop drain timed out; aborting {self.pending_count()} requests")
                     break
@@ -236,20 +361,35 @@ class EngineLoop:
         self._stop = True
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=join_timeout_s)
+            if self._thread.is_alive():
+                logger.error(
+                    f"engine loop thread failed to stop within {join_timeout_s}s "
+                    f"(last phase: {self._phase!r}); thread left detached — "
+                    "engine state must be treated as poisoned")
+                return False
         self._started = False
+        self._state = "stopped"
+        return True
 
     def pending_count(self) -> int:
-        return len(self._handles) + self._cmds.qsize()
+        return len(self._handles) + len(self._requeue) + self._cmds.qsize()
 
     # ------------------------------------------------------------- client api
-    def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None) -> RequestHandle:
-        """Thread-safe request submission; returns immediately with a handle."""
+    def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None,
+               max_retries: Optional[int] = None) -> RequestHandle:
+        """Thread-safe request submission; returns immediately with a handle.
+
+        ``max_retries`` overrides the supervisor policy's per-request requeue
+        budget (0 = never requeue across an engine rebuild: fail fast with
+        ``finish_reason="engine_error"``)."""
         if not self.running:
             raise RuntimeError("engine loop is not running")
         deadline_t = None if deadline_s is None else time.time() + deadline_s
         handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t,
-                               trace=f"req-{next(self._trace_seq)}")
+                               trace=f"req-{next(self._trace_seq)}", max_retries=max_retries)
+        handle._prompt_ids = [int(t) for t in prompt_ids]
+        handle._sampling = sampling
         self._cmds.put(("submit", handle, prompt_ids, sampling))
         self._wake.set()
         return handle
@@ -264,33 +404,213 @@ class EngineLoop:
     def _run(self):
         try:
             while not self._stop:
-                self._drain_cmds()
-                self._enforce_deadlines()
-                if self.engine.has_work():
-                    stats_before = self.engine.num_preemptions
-                    for req in self.engine.step():
-                        self._finish(req)
-                    self.metrics.on_step(
-                        self.engine.stats(), self.engine.num_preemptions - stats_before)
-                else:
-                    self._wake.wait(timeout=self.idle_wait_s)
-                    self._wake.clear()
+                try:
+                    self._run_iteration()
+                except Exception as e:
+                    # engine-step (or command-processing) failure: supervise —
+                    # degrade, triage, rebuild, resume. Raises only when the
+                    # rebuild budget is exhausted.
+                    self._supervise(e)
         except BaseException as e:  # loop death must not strand waiters
             logger.error(f"engine loop crashed: {e!r}")
-            for h in list(self._handles.values()):
-                h._resolve(None, error=e)
-            self._handles.clear()
-            while True:
-                try:
-                    cmd = self._cmds.get_nowait()
-                except queue.Empty:
-                    break
-                if cmd[0] == "submit":
-                    cmd[1]._resolve(None, error=e)
+            self._resolve_all_with_error(e)
             raise
         finally:
+            self._state = "stopped"
             self._shutdown_cleanup()
 
+    def _run_iteration(self):
+        self._phase = "drain_cmds"
+        self._drain_cmds()
+        self._phase = "deadlines"
+        self._enforce_deadlines()
+        if self.engine.has_work():
+            self._phase = "step"
+            stats_before = self.engine.num_preemptions
+            for req in self.engine.step():
+                self._finish(req)
+            self.metrics.on_step(
+                self.engine.stats(), self.engine.num_preemptions - stats_before)
+        else:
+            self._phase = "idle"
+            self._wake.wait(timeout=self.idle_wait_s)
+            self._wake.clear()
+
+    # ------------------------------------------------------------- supervisor
+    def _supervise(self, exc: Exception):
+        """DEGRADED transition: triage in-flight work, rebuild, requeue, resume."""
+        now = time.time()
+        if now - self._last_failure_t > self.policy.failure_reset_s:
+            self._consecutive_failures = 0
+        self._consecutive_failures += 1
+        self._last_failure_t = now
+        self._state = "degraded"
+        degraded_t0 = now
+        logger.error(
+            f"engine step failed (consecutive failure {self._consecutive_failures}): {exc!r}; "
+            "entering DEGRADED state")
+        TRACER.instant("engine_failure", cat="engine_loop", error=repr(exc),
+                       consecutive=self._consecutive_failures,
+                       inflight=len(self._handles))
+        n_failed = self._triage(exc)
+
+        attempt = 0
+        while not self._stop:
+            # exponent clamped: a persistent failure grows the counters without
+            # bound, and 2**1000 would overflow float and kill the supervisor
+            # that promises to retry forever
+            backoff = min(
+                self.policy.backoff_base_s
+                * (2 ** min(self._consecutive_failures - 1 + attempt, 30)),
+                self.policy.backoff_max_s)
+            self._retry_after_hint = backoff
+            self._phase = "degraded"
+            self._wake.wait(timeout=backoff)
+            self._wake.clear()
+            if self._stop:
+                return
+            self._phase = "rebuild"
+            try:
+                _F_REBUILD.fire(attempt=attempt)
+                engine = self.engine_factory() if self.engine_factory is not None \
+                    else self._reset_engine()
+            except Exception as rebuild_exc:
+                attempt += 1
+                logger.error(f"engine rebuild attempt {attempt} failed: {rebuild_exc!r}")
+                if (self.policy.max_rebuild_attempts is not None
+                        and attempt >= self.policy.max_rebuild_attempts):
+                    for handle in self._requeue:
+                        handle._resolve(None, error=rebuild_exc)
+                    self._requeue = []
+                    raise
+                continue
+            self.engine = engine
+            self.metrics.rebind(engine)
+            self.metrics.engine_restarts.inc()
+            n_requeued = self._resubmit_stashed()
+            self._state = "running"
+            dur = time.time() - degraded_t0
+            TRACER.add_span("engine_degraded", degraded_t0, dur, cat="engine_loop",
+                            wall=True, error=repr(exc), requeued=n_requeued,
+                            failed=n_failed, rebuild_attempts=attempt + 1)
+            logger.warning(
+                f"engine rebuilt after {dur:.2f}s degraded "
+                f"(requeued {n_requeued}, failed {n_failed}, attempts {attempt + 1})")
+            return
+
+    def _triage(self, exc: Exception) -> int:
+        """Split in-flight handles into the requeue stash and immediate
+        ``engine_error`` resolutions, per the retry policy. Returns the number
+        fast-cleared."""
+        n_failed = 0
+        for handle in list(self._handles.values()):
+            if handle.done():
+                continue
+            limit = handle.max_retries if handle.max_retries is not None \
+                else self.policy.max_retries
+            streamed = list(handle._streamed)
+            max_new = getattr(handle._sampling, "max_new_tokens", None)
+            # a request whose stream already delivered its done=True token
+            # (EOS or full budget) just needs its resolution — the crash ate
+            # only the finish bookkeeping; requeueing it would generate PAST
+            # the end of a completed sequence
+            if handle._stream_closed or (max_new is not None and len(streamed) >= max_new):
+                reason = "length" if (max_new is not None and len(streamed) >= max_new) \
+                    else "stop"
+                self._resolve_failed(handle, streamed, finish_reason=reason)
+                continue
+            # a cancel that raced the crash is still a cancel, not an engine
+            # failure — resolve it as the abort the client asked for
+            if handle._cancelled:
+                self._resolve_failed(handle, streamed, finish_reason="abort")
+                continue
+            retryable = (
+                handle.retries < limit
+                # streamed tokens can only be folded into a retry prompt when
+                # the sampling budget is adjustable alongside
+                and (not streamed or handle._sampling is not None)
+            )
+            if retryable:
+                handle.retries += 1
+                self.metrics.request_retries.inc()
+                self._requeue.append(handle)
+            else:
+                n_failed += 1
+                self._resolve_failed(handle, streamed)
+        self._handles.clear()
+        self._last_token_t.clear()
+        return n_failed
+
+    def _resolve_failed(self, handle: RequestHandle, streamed: List[int],
+                        finish_reason: str = "engine_error"):
+        req = _FailedRequest(handle.req_id, handle._prompt_ids or [], streamed,
+                             handle.trace, handle.submitted_t, finish_reason=finish_reason)
+        req.aborted = finish_reason == "abort"
+        if handle._first_token_t is not None:
+            req.first_token_t = handle._first_token_t
+            req.ttft = handle._first_token_t - req.arrival_t
+            req.decode_time = req.finish_t - handle._first_token_t
+        self.metrics.on_finished(req)
+        self._trace_finished(req, handle)
+        handle._resolve(req)
+
+    def _resubmit_stashed(self) -> int:
+        """Resubmit stashed handles into the rebuilt engine. Tokens already
+        streamed become prompt suffix (recompute-requeue, exactly the
+        preemption trick) with the remaining budget — positional sampling keys
+        make the continuation identical for greedy/fixed-seed requests."""
+        stashed, self._requeue = self._requeue, []
+        n = 0
+        for handle in stashed:
+            if handle.done():  # cancelled while degraded
+                continue
+            streamed = list(handle._streamed)
+            prompt = list(handle._prompt_ids or []) + streamed
+            sampling = handle._sampling
+            if streamed and sampling is not None:
+                sampling = dataclasses.replace(
+                    sampling, max_new_tokens=sampling.max_new_tokens - len(streamed))
+            handle._retry_prefix = streamed
+            stream_cb = self._make_stream_cb(handle)
+            try:
+                handle.req_id = self.engine.add_request(
+                    prompt, sampling, stream_cb=stream_cb, trace=handle.trace)
+            except Exception as e:
+                # the rebuilt engine rejected the requeue: fail THIS request
+                # rather than losing it (a poisoned engine will re-trip the
+                # supervisor on the next step)
+                logger.error(f"requeue of {handle.trace} failed: {e!r}")
+                self._resolve_failed(handle, streamed)
+                continue
+            self._handles[handle.req_id] = handle
+            n += 1
+        return n
+
+    def _reset_engine(self):
+        """No factory: recover the existing engine in place via its
+        ``reset()`` (drops all scheduler/allocator state)."""
+        reset = getattr(self.engine, "reset", None)
+        if reset is None:
+            raise RuntimeError(
+                "engine has no reset() and no engine_factory was provided; "
+                "cannot recover from a step failure")
+        reset()
+        return self.engine
+
+    def _resolve_all_with_error(self, e: BaseException):
+        for h in list(self._handles.values()) + list(self._requeue):
+            h._resolve(None, error=e)
+        self._handles.clear()
+        self._requeue = []
+        while True:
+            try:
+                cmd = self._cmds.get_nowait()
+            except queue.Empty:
+                break
+            if cmd[0] == "submit":
+                cmd[1]._resolve(None, error=e)
+
+    # ------------------------------------------------------------- commands
     def _drain_cmds(self):
         while True:
             try:
@@ -304,8 +624,14 @@ class EngineLoop:
                     handle._resolve(None)
                     continue
                 stream_cb = self._make_stream_cb(handle)
-                handle.req_id = self.engine.add_request(
-                    prompt_ids, sampling, stream_cb=stream_cb, trace=handle.trace)
+                try:
+                    handle.req_id = self.engine.add_request(
+                        prompt_ids, sampling, stream_cb=stream_cb, trace=handle.trace)
+                except BaseException as e:
+                    # the command is consumed — resolve the waiter before the
+                    # supervisor takes over, or the client blocks forever
+                    handle._resolve(None, error=e)
+                    raise
                 self._handles[handle.req_id] = handle
             elif kind == "abort":
                 self._abort_handle(handle)
@@ -339,9 +665,21 @@ class EngineLoop:
                 self._abort_handle(handle)
 
     def _finish(self, req):
+        handle = self._handles.pop(req.req_id, None)
+        if handle is not None and handle._retry_prefix:
+            # a request that rode through >=1 engine rebuilds: its pre-crash
+            # tokens were folded into the prompt — unfold so output_ids /
+            # usage counts cover the FULL generation the client received, and
+            # rebase the timing anchors so TTFT/e2e cover the pre-crash stint
+            # and the degraded window (the SLO series must SEE the incident,
+            # not report a fresh fast request)
+            req.output_ids = list(handle._retry_prefix) + list(req.output_ids)
+            req.prompt_ids = req.prompt_ids[: handle.prompt_len]
+            req.arrival_t = handle.submitted_t
+            if handle._first_token_t is not None:
+                req.first_token_t = handle._first_token_t
         self.metrics.on_finished(req)
         self._last_token_t.pop(req.req_id, None)
-        handle = self._handles.pop(req.req_id, None)
         self._trace_finished(req, handle)
         if handle is not None:
             handle._resolve(req)
@@ -372,6 +710,7 @@ class EngineLoop:
             "req_id": req.req_id,
             "state": "finished",
             "finish_reason": req.finish_reason,
+            "retries": handle.retries if handle is not None else 0,
             "prompt_len": len(req.prompt_ids),
             "output_tokens": len(req.output_ids),
             "arrival_t": req.arrival_t,
@@ -390,9 +729,11 @@ class EngineLoop:
         never corrupt."""
         now = time.time()
         out = []
-        for handle in list(self._handles.values()):
+        handles = list(self._handles.values())
+        requeued = list(self._requeue)
+        for handle in handles + requeued:
             req = None
-            if handle.req_id is not None:
+            if handle.req_id is not None and handle not in requeued:
                 try:
                     req = next((r for r in list(self.engine.slots)
                                 if r is not None and r.req_id == handle.req_id), None)
@@ -408,9 +749,12 @@ class EngineLoop:
                 "req_id": handle.req_id,
                 "prompt_len": handle.prompt_len,
                 "age_s": now - handle.submitted_t,
+                "retries": handle.retries,
                 "deadline_in_s": None if handle.deadline_t is None else handle.deadline_t - now,
             }
-            if req is None:
+            if handle in requeued:
+                info["state"] = "requeued"  # waiting for the engine rebuild
+            elif req is None:
                 info["state"] = "submitted"
             else:
                 info["state"] = "queued" if req.sched_t is None else (
@@ -431,6 +775,11 @@ class EngineLoop:
                     continue
             handle._resolve(None)
         self._handles.clear()
+        # requests stashed for a rebuild that never happened (stop while
+        # degraded): their clients are blocked in result() — resolve them
+        for handle in self._requeue:
+            handle._resolve(None)
+        self._requeue = []
         # submit commands that raced the stop and never reached the engine:
         # their clients are blocked in result() — resolve them too
         while True:

@@ -9,7 +9,10 @@ up in host memory. The scheduler enforces:
 - per-request deadlines (``default_timeout_s`` unless the caller overrides) so
   one stuck client cannot hold a slot forever;
 - graceful drain: ``drain()`` flips to rejecting new work with
-  :class:`ShuttingDownError` (HTTP 503) while in-flight requests finish.
+  :class:`ShuttingDownError` (HTTP 503) while in-flight requests finish;
+- circuit breaker: while the engine loop is DEGRADED (supervisor rebuilding
+  the engine after a step failure), submissions raise :class:`DegradedError`
+  (HTTP 503 with ``Retry-After``) instead of queueing behind a dead engine.
 """
 
 from __future__ import annotations
@@ -19,10 +22,14 @@ import time
 from typing import Optional
 
 from ..observability.tracer import TRACER
+from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle
 
-__all__ = ["Scheduler", "SchedulerConfig", "SaturatedError", "ShuttingDownError"]
+__all__ = ["Scheduler", "SchedulerConfig", "SaturatedError", "ShuttingDownError",
+           "DegradedError"]
+
+_F_SUBMIT = FaultPoint("serving.submit")
 
 
 class SaturatedError(Exception):
@@ -31,6 +38,15 @@ class SaturatedError(Exception):
 
 class ShuttingDownError(Exception):
     """Scheduler draining/stopped — not accepting work (HTTP 503)."""
+
+
+class DegradedError(Exception):
+    """Engine loop is DEGRADED (rebuilding) — retry later (HTTP 503 +
+    ``Retry-After: retry_after_s``)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class SchedulerConfig:
@@ -56,10 +72,14 @@ class Scheduler:
         self._idle.set()
         self.rejected_saturated = 0
         self.rejected_draining = 0
+        self.rejected_degraded = 0
 
     # ------------------------------------------------------------- admission
-    def submit(self, prompt_ids, sampling=None, timeout_s: Optional[float] = None) -> RequestHandle:
-        """Admit one request or raise (SaturatedError / ShuttingDownError)."""
+    def submit(self, prompt_ids, sampling=None, timeout_s: Optional[float] = None,
+               max_retries: Optional[int] = None) -> RequestHandle:
+        """Admit one request or raise (SaturatedError / ShuttingDownError /
+        DegradedError). ``max_retries`` is the per-request engine-rebuild
+        requeue budget (None = supervisor policy default)."""
         cfg = self.config
         if cfg.max_prompt_tokens is not None and len(prompt_ids) > cfg.max_prompt_tokens:
             raise ValueError(
@@ -69,6 +89,16 @@ class Scheduler:
                 self.rejected_draining += 1
                 TRACER.instant("admission_rejected", cat="scheduler", reason="draining")
                 raise ShuttingDownError("server is draining; retry against another replica")
+            if self.loop.degraded:
+                # circuit breaker: the engine is being rebuilt — shed load NOW
+                # with a recovery hint instead of piling work on a dead engine
+                self.rejected_degraded += 1
+                retry_after = self.loop.retry_after_hint()
+                TRACER.instant("admission_rejected", cat="scheduler", reason="degraded",
+                               retry_after_s=retry_after)
+                raise DegradedError(
+                    "engine is recovering from a failure; retry shortly",
+                    retry_after_s=retry_after)
             if self._inflight >= cfg.max_inflight:
                 self.rejected_saturated += 1
                 TRACER.instant("admission_rejected", cat="scheduler", reason="saturated",
@@ -79,10 +109,12 @@ class Scheduler:
             self._idle.clear()
         deadline = timeout_s if timeout_s is not None else cfg.default_timeout_s
         try:
+            _F_SUBMIT.fire(prompt_len=len(prompt_ids))
             # recorded retrospectively so Span.trace carries the request's id
             # (assigned by submit) and trace-filtered timelines include admission
             t0 = time.perf_counter()
-            handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline)
+            handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline,
+                                      max_retries=max_retries)
             TRACER.add_span("admission", TRACER.epoch_time(t0),
                             time.perf_counter() - t0, cat="scheduler",
                             trace=handle.trace, prompt_len=len(prompt_ids))
@@ -117,8 +149,10 @@ class Scheduler:
             "inflight": self.inflight,
             "max_inflight": self.config.max_inflight,
             "draining": self._draining,
+            "engine_state": self.loop.state,
             "rejected_saturated": self.rejected_saturated,
             "rejected_draining": self.rejected_draining,
+            "rejected_degraded": self.rejected_degraded,
         }
 
     def drain(self, timeout_s: Optional[float] = 30.0) -> bool:

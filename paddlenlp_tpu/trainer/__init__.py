@@ -19,6 +19,14 @@ from .trainer_utils import (  # noqa: F401
     speed_metrics,
 )
 from .training_args import TrainingArguments  # noqa: F401
+from .unified_checkpoint import (  # noqa: F401
+    CorruptCheckpointError,
+    get_last_committed_checkpoint,
+    is_committed,
+    join_pending_saves,
+    rotate_checkpoints,
+    validate_checkpoint,
+)
 from .timer import RuntimeTimer, Timers  # noqa: F401
 from .trainer_seq2seq import Seq2SeqTrainer  # noqa: F401
 from .integrations import JsonlLoggerCallback, TensorBoardCallback  # noqa: F401

@@ -26,7 +26,6 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
-import shutil
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -51,7 +50,6 @@ from .trainer_utils import (
     PREFIX_CHECKPOINT_DIR,
     IntervalStrategy,
     TrainOutput,
-    get_last_checkpoint,
     get_scheduler,
     has_length,
     set_seed,
@@ -588,7 +586,26 @@ class Trainer:
         if resume_from_checkpoint is None:
             resume_from_checkpoint = args.resume_from_checkpoint
         if resume_from_checkpoint is True:
-            resume_from_checkpoint = get_last_checkpoint(args.output_dir)
+            # auto-discovery goes through the commit protocol: the newest
+            # *committed* checkpoint wins, torn dirs from a crashed save are
+            # skipped (get_last_checkpoint would happily hand one back)
+            from .unified_checkpoint import (
+                get_last_committed_checkpoint,
+                get_last_legacy_checkpoint,
+            )
+
+            resume_from_checkpoint = get_last_committed_checkpoint(args.output_dir)
+            if resume_from_checkpoint is None:
+                # no committed checkpoint: fall back to the newest MANIFEST-LESS
+                # dir (written by a pre-protocol trainer, loadable via the
+                # legacy path) — losing the run to a protocol upgrade would be
+                # worse than trusting it. Dirs whose manifest fails validation
+                # are torn saves and are never resumed from.
+                resume_from_checkpoint = get_last_legacy_checkpoint(args.output_dir)
+                if resume_from_checkpoint:
+                    logger.warning(
+                        f"resume: no committed checkpoint under {args.output_dir}; "
+                        f"falling back to legacy (pre-commit-protocol) {resume_from_checkpoint}")
         if resume_from_checkpoint:
             self._load_checkpoint(resume_from_checkpoint)
 
@@ -708,6 +725,12 @@ class Trainer:
             # flush an open trace even when training ended inside the window
             self._profiler.close()
             self._profiler = None
+        # trainer exit: a live async-save thread must land (and be reaped)
+        # before train() returns — callers may rotate, rsync, or exit the
+        # process the moment this function hands back control
+        from .unified_checkpoint import join_pending_saves
+
+        join_pending_saves(timeout=None)
         self.control = self.callback_handler.on_train_end(args, self.state, self.control)
         self.model.params = self.train_state.params
         return TrainOutput(self.state.global_step, final_loss, metrics)
@@ -891,10 +914,22 @@ class Trainer:
 
     # ------------------------------------------------------------------ checkpoint
     def _save_checkpoint(self):
-        from .unified_checkpoint import save_unified_checkpoint
+        from .unified_checkpoint import (
+            join_pending_saves,
+            rotate_checkpoints,
+            save_unified_checkpoint,
+        )
 
         args = self.args
+        # one async writer at a time: joining here reaps finished threads (the
+        # module list is otherwise unbounded) and keeps the new save from
+        # racing a previous in-flight one
+        join_pending_saves(timeout=None)
         ckpt_dir = os.path.join(args.output_dir, f"{PREFIX_CHECKPOINT_DIR}-{self.state.global_step}")
+        # rotation runs on the writer thread right after the commit rename
+        # lands — an async save stays async (no join-just-to-rotate) and
+        # rotation always sees the new checkpoint as committed
+        best = self.state.best_model_checkpoint
         save_unified_checkpoint(
             ckpt_dir,
             model=self.model,
@@ -902,8 +937,9 @@ class Trainer:
             trainer_state=self.state,
             tokenizer=self.tokenizer,
             async_save=args.async_save,
+            after_commit=lambda: rotate_checkpoints(
+                args.output_dir, args.save_total_limit, best_model_checkpoint=best),
         )
-        self._rotate_checkpoints()
 
     def save_model(self, output_dir: Optional[str] = None):
         output_dir = output_dir or self.args.output_dir
@@ -924,21 +960,17 @@ class Trainer:
         self.model.params = self.train_state.params
 
     def _rotate_checkpoints(self):
-        limit = self.args.save_total_limit
-        if limit is None or limit <= 0:
-            return
-        folder = self.args.output_dir
-        if not os.path.isdir(folder):
-            return
-        ckpts = sorted(
-            (d for d in os.listdir(folder) if d.startswith(PREFIX_CHECKPOINT_DIR + "-")),
-            key=lambda d: int(d.split("-")[-1]),
+        """Manual rotation entry point (saves rotate themselves post-commit)."""
+        from .unified_checkpoint import join_pending_saves, rotate_checkpoints
+
+        # an in-flight async save must land before we decide what is stale:
+        # with async_save the newest checkpoint may still be a staging dir
+        join_pending_saves(timeout=None)
+        rotate_checkpoints(
+            self.args.output_dir,
+            self.args.save_total_limit,
+            best_model_checkpoint=self.state.best_model_checkpoint,
         )
-        for stale in ckpts[:-limit]:
-            path = os.path.join(folder, stale)
-            if path != (self.state.best_model_checkpoint or ""):
-                logger.info(f"rotating old checkpoint {path}")
-                shutil.rmtree(path, ignore_errors=True)
 
     def compress(self, strategy: str = "ptq", output_dir: Optional[str] = None, **kwargs):
         """Post-training compression (reference Trainer.compress,

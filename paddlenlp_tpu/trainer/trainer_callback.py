@@ -11,6 +11,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils.fileio import atomic_write
 from ..utils.log import logger
 from .trainer_utils import IntervalStrategy
 
@@ -41,7 +42,9 @@ class TrainerState:
     trial_params: Optional[Dict[str, Any]] = None
 
     def save_to_json(self, json_path: str):
-        with open(json_path, "w") as f:
+        # tmp+rename: a crash mid-dump must leave the previous state file
+        # intact, never a truncated JSON that load_from_json chokes on
+        with atomic_write(json_path) as f:
             json.dump(dataclasses.asdict(self), f, indent=2, sort_keys=True, default=str)
 
     @classmethod

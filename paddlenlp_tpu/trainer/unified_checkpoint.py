@@ -1,4 +1,5 @@
-"""Unified checkpoint: topology-elastic safetensors save/resume.
+"""Unified checkpoint: topology-elastic safetensors save/resume with an
+atomic commit protocol.
 
 Counterpart of ``paddlenlp/trainer/plugins/unified_checkpoint.py`` (112k chars).
 The reference needs TP-merge actions, send/recv dispatch tables, and resharding
@@ -11,27 +12,66 @@ converters because every rank holds opaque shards. TPU-native, the design invert
   NamedShardings — the dynamic re-dispatch machinery (:1382-1569) disappears;
 - async save (reference :159-261, shm + writer process) becomes device_get into
   host RAM + a writer thread.
+
+**Commit protocol.** A crash mid-save must never leave a directory that
+resume will mistake for a checkpoint. Every save therefore writes into a
+``<ckpt_dir>.tmp`` staging directory, fsyncs the payload, writes a
+``commit.json`` manifest (file list + sizes + step) and only then
+``os.replace``'s the staging dir into place — rename is the commit point.
+The observable states are: no dir, a ``*.tmp`` staging dir (ignored by the
+``checkpoint-<step>`` regex), or a fully-committed dir. ``load`` validates
+the manifest; :func:`get_last_committed_checkpoint` is the resume
+auto-discovery that skips torn dirs; :func:`rotate_checkpoints` never deletes
+an uncommitted dir or the newest committed one (the resume fallback).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..transformers.conversion_utils import flatten_params, unflatten_params
+from ..utils.faults import FaultPoint
+from ..utils.fileio import atomic_write, fsync_dir, fsync_file
 from ..utils.log import logger
 from ..utils.safetensors_io import SafeFile, save_file, shard_checkpoint
+from .trainer_utils import _re_checkpoint
 
-__all__ = ["save_unified_checkpoint", "load_unified_checkpoint"]
+__all__ = [
+    "save_unified_checkpoint",
+    "load_unified_checkpoint",
+    "validate_checkpoint",
+    "is_committed",
+    "get_last_committed_checkpoint",
+    "get_last_legacy_checkpoint",
+    "rotate_checkpoints",
+    "join_pending_saves",
+    "wait_for_pending_saves",
+    "CorruptCheckpointError",
+    "COMMIT_MANIFEST",
+]
 
 OPTIMIZER_NAME = "optimizer.safetensors"
 TRAINER_STATE_NAME = "trainer_state.json"
-_pending_saves: list = []
+COMMIT_MANIFEST = "commit.json"
+STAGING_SUFFIX = ".tmp"
+
+_F_WRITE_SHARD = FaultPoint("ckpt.write_shard")
+_F_COMMIT = FaultPoint("ckpt.commit")
+
+_pending_saves: List[threading.Thread] = []
+_pending_lock = threading.Lock()
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint directory failed commit-manifest validation (torn write)."""
 
 
 def _flatten_opt_state(opt_state) -> Dict[str, np.ndarray]:
@@ -44,6 +84,148 @@ def _flatten_opt_state(opt_state) -> Dict[str, np.ndarray]:
     return flat
 
 
+# --------------------------------------------------------------------- commit
+def _manifest_files(ckpt_dir: str) -> Dict[str, int]:
+    """Relative path → size for every payload file under ``ckpt_dir``."""
+    files: Dict[str, int] = {}
+    for root, _dirs, names in os.walk(ckpt_dir):
+        for name in names:
+            if name == COMMIT_MANIFEST:
+                continue
+            p = os.path.join(root, name)
+            files[os.path.relpath(p, ckpt_dir)] = os.path.getsize(p)
+    return files
+
+
+def _commit_checkpoint(staging: str, final: str, step: Optional[int]):
+    """Manifest + fsync + rename: the all-or-nothing commit point.
+
+    Everything before the ``os.replace`` can crash with zero effect on
+    ``final``; everything after it is durable (parent dir fsync'd)."""
+    files = _manifest_files(staging)
+    for rel in files:
+        fsync_file(os.path.join(staging, rel))
+    _F_COMMIT.fire(step=step)
+    with atomic_write(os.path.join(staging, COMMIT_MANIFEST)) as f:
+        json.dump({"version": 1, "step": step, "time": time.time(), "files": files}, f,
+                  indent=2, sort_keys=True)
+    if os.path.isdir(final):
+        # re-saving the same step: drop the old dir so rename can land. The
+        # vulnerable window (old gone, new not yet renamed) only affects the
+        # step being overwritten, never other checkpoints.
+        shutil.rmtree(final)
+    os.replace(staging, final)
+    fsync_dir(os.path.dirname(final) or ".")
+
+
+def validate_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """None when ``ckpt_dir`` holds a committed, size-consistent checkpoint;
+    otherwise a human-readable reason it must not be trusted."""
+    manifest_path = os.path.join(ckpt_dir, COMMIT_MANIFEST)
+    if not os.path.isfile(manifest_path):
+        return f"no {COMMIT_MANIFEST} (save never committed)"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        return f"unreadable {COMMIT_MANIFEST}: {e}"
+    for rel, size in manifest.get("files", {}).items():
+        p = os.path.join(ckpt_dir, rel)
+        if not os.path.isfile(p):
+            return f"manifest file missing: {rel}"
+        actual = os.path.getsize(p)
+        if actual != size:
+            return f"size mismatch for {rel}: manifest {size}, on disk {actual}"
+    return None
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    return os.path.isdir(ckpt_dir) and validate_checkpoint(ckpt_dir) is None
+
+
+def get_last_committed_checkpoint(folder: str) -> Optional[str]:
+    """Resume auto-discovery: newest ``checkpoint-<step>`` dir that passes
+    manifest validation. Torn/uncommitted dirs are skipped with a warning —
+    the fallback order is strictly newest-committed-first."""
+    if not os.path.isdir(folder):
+        return None
+    steps = sorted(
+        (int(m.group(1)), d)
+        for d in os.listdir(folder)
+        if (m := _re_checkpoint.match(d)) and os.path.isdir(os.path.join(folder, d))
+    )
+    for _step, d in reversed(steps):
+        path = os.path.join(folder, d)
+        reason = validate_checkpoint(path)
+        if reason is None:
+            return path
+        logger.warning(f"resume: skipping torn checkpoint {path}: {reason}")
+    return None
+
+
+def get_last_legacy_checkpoint(folder: str) -> Optional[str]:
+    """Newest checkpoint dir with NO commit manifest at all — written by a
+    pre-protocol trainer, loadable via the legacy path. A dir that HAS a
+    manifest which fails validation is a torn post-protocol save and is never
+    returned (loading it would raise CorruptCheckpointError)."""
+    if not os.path.isdir(folder):
+        return None
+    steps = sorted(
+        (int(m.group(1)), d)
+        for d in os.listdir(folder)
+        if (m := _re_checkpoint.match(d)) and os.path.isdir(os.path.join(folder, d))
+    )
+    for _step, d in reversed(steps):
+        path = os.path.join(folder, d)
+        if not os.path.isfile(os.path.join(path, COMMIT_MANIFEST)):
+            return path
+    return None
+
+
+def rotate_checkpoints(folder: str, limit: Optional[int],
+                       best_model_checkpoint: Optional[str] = None) -> List[str]:
+    """Delete stale ``checkpoint-*`` dirs beyond ``limit``, never touching:
+
+    - the best-model checkpoint (paths realpath-normalized — a relative
+      ``best_model_checkpoint`` must still protect the absolute dir);
+    - uncommitted dirs (an in-progress async save or a torn dir a human may
+      want for diagnosis — either way not ours to reap);
+    - the newest committed checkpoint (the resume fallback target).
+
+    Returns the deleted paths. Pending async saves must be joined by the
+    caller first (``Trainer._rotate_checkpoints`` does) so an in-flight save's
+    staging dir has landed before we decide what is stale."""
+    if limit is None or limit <= 0 or not os.path.isdir(folder):
+        return []
+    ckpts = sorted(
+        (d for d in os.listdir(folder)
+         if _re_checkpoint.match(d) and os.path.isdir(os.path.join(folder, d))),
+        key=lambda d: int(d.split("-")[-1]),
+    )
+    if len(ckpts) <= limit:
+        return []
+    best = os.path.realpath(best_model_checkpoint) if best_model_checkpoint else None
+    fallback = get_last_committed_checkpoint(folder)
+    fallback = os.path.realpath(fallback) if fallback else None
+    deleted: List[str] = []
+    for stale in ckpts[:-limit]:
+        path = os.path.join(folder, stale)
+        real = os.path.realpath(path)
+        if best is not None and real == best:
+            continue
+        if fallback is not None and real == fallback:
+            logger.info(f"rotation: keeping {path} (newest committed checkpoint; resume fallback)")
+            continue
+        if not is_committed(path):
+            logger.warning(f"rotation: keeping uncommitted dir {path} (in-progress or torn save)")
+            continue
+        logger.info(f"rotating old checkpoint {path}")
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+    return deleted
+
+
+# --------------------------------------------------------------------- save
 def save_unified_checkpoint(
     ckpt_dir: str,
     model,
@@ -51,8 +233,13 @@ def save_unified_checkpoint(
     trainer_state=None,
     tokenizer=None,
     async_save: bool = False,
+    after_commit=None,
 ):
-    os.makedirs(ckpt_dir, exist_ok=True)
+    """``after_commit`` (no-arg callable) runs on the writer thread right
+    after the rename lands — rotation hooks in here so an async save stays
+    async instead of being joined just to rotate."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    os.makedirs(os.path.dirname(ckpt_dir) or ".", exist_ok=True)
     params = train_state.params if train_state is not None else model.params
 
     opt_tensors: Dict[str, np.ndarray] = {}
@@ -61,37 +248,92 @@ def save_unified_checkpoint(
             opt_tensors[key] = leaf
         opt_tensors["__step__"] = train_state.step
 
+    if trainer_state is not None:
+        step = int(trainer_state.global_step)
+    elif train_state is not None:
+        step = int(np.asarray(jax.device_get(train_state.step)))
+    else:
+        step = None
+
+    staging = ckpt_dir + STAGING_SUFFIX
+
     def _write(host_params, host_opt):
-        model.save_pretrained(ckpt_dir, params=host_params)
+        # stale staging from an earlier crashed save: ours to reclaim (the
+        # committed dir, if any, is untouched by anything below until commit)
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        model.save_pretrained(staging, params=host_params)
         if host_opt:
             shards, index = shard_checkpoint(host_opt, weights_name=OPTIMIZER_NAME)
             for fname, shard in shards:
-                save_file(shard, os.path.join(ckpt_dir, fname), metadata={"format": "np"})
+                path = os.path.join(staging, fname)
+                save_file(shard, path, metadata={"format": "np"})
+                _F_WRITE_SHARD.fire(file=path, shard=fname)
             if index is not None:
-                with open(os.path.join(ckpt_dir, OPTIMIZER_NAME + ".index.json"), "w") as f:
+                with atomic_write(os.path.join(staging, OPTIMIZER_NAME + ".index.json")) as f:
                     json.dump(index, f)
         if trainer_state is not None:
-            trainer_state.save_to_json(os.path.join(ckpt_dir, TRAINER_STATE_NAME))
+            trainer_state.save_to_json(os.path.join(staging, TRAINER_STATE_NAME))
         if tokenizer is not None and hasattr(tokenizer, "save_pretrained"):
-            tokenizer.save_pretrained(ckpt_dir)
-        logger.info(f"unified checkpoint saved to {ckpt_dir}")
+            tokenizer.save_pretrained(staging)
+        _commit_checkpoint(staging, ckpt_dir, step)
+        logger.info(f"unified checkpoint saved to {ckpt_dir} (step {step}, committed)")
+        if after_commit is not None:
+            after_commit()
 
     # gather to host (the TP-merge of the reference, for free)
     host_params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
     host_opt = {k: np.asarray(jax.device_get(v)) for k, v in opt_tensors.items()}
     if async_save:
-        t = threading.Thread(target=_write, args=(host_params, host_opt), daemon=False)
+        t = threading.Thread(target=_writer_main, args=(_write, host_params, host_opt, ckpt_dir),
+                             name=f"ckpt-save-{step}", daemon=False)
         t.start()
-        _pending_saves.append(t)
+        with _pending_lock:
+            _pending_saves.append(t)
     else:
         _write(host_params, host_opt)
 
 
+def _writer_main(write_fn, host_params, host_opt, ckpt_dir):
+    """Async-writer thread body: record the exception for join_pending_saves
+    to surface — a save that died must not fail silently."""
+    try:
+        write_fn(host_params, host_opt)
+    except BaseException as e:  # noqa: BLE001 - re-surfaced at join
+        threading.current_thread()._ckpt_exc = e
+        logger.error(f"async checkpoint save to {ckpt_dir} failed: {e!r} "
+                     f"(staging dir left uncommitted; previous checkpoint still valid)")
+
+
+def join_pending_saves(timeout: Optional[float] = None) -> int:
+    """Join async writer threads and prune finished ones from the module list
+    (they were previously never reaped — an unbounded leak over a long run).
+
+    Returns the number of saves still running after ``timeout`` (0 = drained).
+    Exceptions raised inside writer threads are logged here; the checkpoint
+    they belonged to is simply absent/uncommitted on disk."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with _pending_lock:
+        threads = list(_pending_saves)
+    for t in threads:
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        t.join(timeout=remaining)
+        exc = getattr(t, "_ckpt_exc", None)
+        if exc is not None and not t.is_alive():
+            logger.error(f"pending checkpoint save {t.name} failed: {exc!r}")
+            t._ckpt_exc = None
+    with _pending_lock:
+        _pending_saves[:] = [t for t in _pending_saves if t.is_alive()]
+        return len(_pending_saves)
+
+
 def wait_for_pending_saves():
-    while _pending_saves:
-        _pending_saves.pop().join()
+    """Back-compat alias: block until every async save finishes."""
+    join_pending_saves(timeout=None)
 
 
+# --------------------------------------------------------------------- load
 def _resolve_optimizer_files(ckpt_dir: str):
     """Single optimizer.safetensors OR sharded optimizer-XXXXX-of-NNNNN via index."""
     index_path = os.path.join(ckpt_dir, OPTIMIZER_NAME + ".index.json")
@@ -110,9 +352,24 @@ def load_unified_checkpoint(
     mesh=None,
 ) -> Tuple[Any, Optional[Any]]:
     """Restore (TrainState, TrainerState) from ``ckpt_dir`` under the CURRENT mesh —
-    works across topology changes (the reference's `check_dynamic_load` path)."""
+    works across topology changes (the reference's `check_dynamic_load` path).
+
+    The commit manifest is validated first: a dir with a manifest that does not
+    match the bytes on disk raises :class:`CorruptCheckpointError` (use
+    :func:`get_last_committed_checkpoint` to auto-skip such dirs). A dir with
+    no manifest at all is accepted as a legacy pre-protocol checkpoint, with a
+    warning — it predates crash-safety, so its integrity is on the operator."""
     from ..trainer.trainer_callback import TrainerState
     from .trainer import TrainState
+
+    manifest_path = os.path.join(ckpt_dir, COMMIT_MANIFEST)
+    if os.path.isfile(manifest_path):
+        reason = validate_checkpoint(ckpt_dir)
+        if reason is not None:
+            raise CorruptCheckpointError(f"checkpoint {ckpt_dir} failed validation: {reason}")
+    else:
+        logger.warning(f"checkpoint {ckpt_dir} has no {COMMIT_MANIFEST}; loading as legacy "
+                       "(pre-commit-protocol) checkpoint without integrity validation")
 
     # model params through the standard sharding-aware loader
     reloaded = type(model).from_pretrained(

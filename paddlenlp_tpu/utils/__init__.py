@@ -8,5 +8,7 @@ from .env import (  # noqa: F401
     device_peak_flops,
     get_env_device,
 )
+from .faults import FAULTS, FaultPoint, InjectedFault  # noqa: F401
+from .fileio import atomic_write, fsync_dir, fsync_file  # noqa: F401
 from .import_utils import is_package_available  # noqa: F401
 from .log import logger  # noqa: F401

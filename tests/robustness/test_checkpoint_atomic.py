@@ -1,0 +1,236 @@
+"""Checkpoint durability chaos tests (ISSUE 3 acceptance, trainer side).
+
+A fault injected mid-optimizer-shard write must leave NO commit.json; resume
+auto-discovery must skip the torn dir and restore the previous committed
+step; rotation must never remove the fallback target or an uncommitted dir.
+
+Exercised at the ``unified_checkpoint`` layer directly (the container's jax
+lacks ``jax.sharding.AxisType``, so ``Trainer.__init__`` — which builds a
+mesh — cannot run in tier-1; the protocol functions are mesh-free)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddlenlp_tpu.trainer.trainer import TrainState
+from paddlenlp_tpu.trainer.trainer_callback import TrainerState
+from paddlenlp_tpu.trainer.unified_checkpoint import (
+    COMMIT_MANIFEST,
+    CorruptCheckpointError,
+    get_last_committed_checkpoint,
+    get_last_legacy_checkpoint,
+    is_committed,
+    join_pending_saves,
+    load_unified_checkpoint,
+    rotate_checkpoints,
+    save_unified_checkpoint,
+    validate_checkpoint,
+)
+from paddlenlp_tpu.utils.faults import FAULTS, InjectedFault
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=56,
+                      num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+                      max_position_embeddings=32)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_state(model, step=0):
+    opt_state = optax.adam(1e-3).init(model.params)
+    return TrainState(params=model.params, opt_state=opt_state,
+                      step=jnp.asarray(step, jnp.int32))
+
+
+def save_step(tmp_path, model, step, **kw):
+    ckpt = os.path.join(str(tmp_path), f"checkpoint-{step}")
+    save_unified_checkpoint(ckpt, model=model, train_state=make_state(model, step),
+                            trainer_state=TrainerState(global_step=step), **kw)
+    return ckpt
+
+
+class TestCommitProtocol:
+    def test_roundtrip_commits_and_validates(self, tmp_path, model):
+        ckpt = save_step(tmp_path, model, 2)
+        assert validate_checkpoint(ckpt) is None and is_committed(ckpt)
+        manifest = json.loads(open(os.path.join(ckpt, COMMIT_MANIFEST)).read())
+        assert manifest["step"] == 2
+        assert "optimizer.safetensors" in manifest["files"]
+        assert all((tmp_path / f"checkpoint-2" / rel).stat().st_size == size
+                   for rel, size in manifest["files"].items())
+        # no staging litter after a clean commit
+        assert not os.path.isdir(ckpt + ".tmp")
+        state, trainer_state = load_unified_checkpoint(ckpt, model, make_state(model))
+        assert int(np.asarray(state.step)) == 2 and trainer_state.global_step == 2
+        for a, b in zip(np.asarray(model.params["model"]["embed_tokens"]["embedding"]).ravel()[:8],
+                        np.asarray(state.params["model"]["embed_tokens"]["embedding"]).ravel()[:8]):
+            np.testing.assert_allclose(a, b)
+
+    def test_fault_mid_shard_write_leaves_no_committed_dir(self, tmp_path, model):
+        """ISSUE 3 acceptance: kill the save mid-optimizer-shard → no
+        commit.json anywhere, resume discovery falls back to the previous
+        committed step, rotation keeps the fallback."""
+        save_step(tmp_path, model, 2)
+
+        FAULTS.arm("ckpt.write_shard", action="partial", nth=1)
+        with pytest.raises(InjectedFault):
+            save_step(tmp_path, model, 4)
+
+        final = os.path.join(str(tmp_path), "checkpoint-4")
+        staging = final + ".tmp"
+        assert not os.path.isdir(final)  # rename never happened
+        assert os.path.isdir(staging)  # torn staging left for diagnosis
+        assert not os.path.isfile(os.path.join(staging, COMMIT_MANIFEST))
+        # the torn optimizer shard really is torn (partial action truncates)
+        opt = os.path.join(staging, "optimizer.safetensors")
+        assert os.path.isfile(opt)
+
+        # resume auto-discovery: the torn save is invisible, step 2 is the target
+        fallback = get_last_committed_checkpoint(str(tmp_path))
+        assert fallback == os.path.join(str(tmp_path), "checkpoint-2")
+        state, trainer_state = load_unified_checkpoint(fallback, model, make_state(model))
+        assert int(np.asarray(state.step)) == 2 and trainer_state.global_step == 2
+
+        # rotation with limit=1 must NOT reap the fallback (it is the newest
+        # committed checkpoint), even though a higher-numbered dir exists
+        deleted = rotate_checkpoints(str(tmp_path), limit=1)
+        assert deleted == []
+        assert os.path.isdir(fallback)
+
+    def test_crash_before_commit_manifest(self, tmp_path, model):
+        """Crash between payload write and manifest: same guarantees."""
+        save_step(tmp_path, model, 2)
+        FAULTS.arm("ckpt.commit")
+        with pytest.raises(InjectedFault):
+            save_step(tmp_path, model, 4)
+        assert not os.path.isdir(os.path.join(str(tmp_path), "checkpoint-4"))
+        assert get_last_committed_checkpoint(str(tmp_path)).endswith("checkpoint-2")
+
+    def test_next_save_reclaims_stale_staging(self, tmp_path, model):
+        FAULTS.arm("ckpt.commit")
+        with pytest.raises(InjectedFault):
+            save_step(tmp_path, model, 4)
+        FAULTS.reset()
+        ckpt = save_step(tmp_path, model, 4)  # same step, fresh save
+        assert is_committed(ckpt)
+        assert not os.path.isdir(ckpt + ".tmp")
+
+    def test_torn_committed_dir_detected_and_load_refuses(self, tmp_path, model):
+        """A committed dir whose bytes no longer match the manifest (disk
+        corruption, partial rsync) is not trusted: load raises, discovery
+        skips it."""
+        save_step(tmp_path, model, 2)
+        ckpt4 = save_step(tmp_path, model, 4)
+        opt = os.path.join(ckpt4, "optimizer.safetensors")
+        with open(opt, "r+b") as f:
+            f.truncate(os.path.getsize(opt) // 2)
+        assert "size mismatch" in validate_checkpoint(ckpt4)
+        with pytest.raises(CorruptCheckpointError):
+            load_unified_checkpoint(ckpt4, model, make_state(model))
+        assert get_last_committed_checkpoint(str(tmp_path)).endswith("checkpoint-2")
+
+    def test_legacy_checkpoint_without_manifest_still_loads(self, tmp_path, model):
+        ckpt = save_step(tmp_path, model, 2)
+        os.unlink(os.path.join(ckpt, COMMIT_MANIFEST))
+        state, _ = load_unified_checkpoint(ckpt, model, make_state(model))
+        assert int(np.asarray(state.step)) == 2
+        # but auto-discovery holds it to the committed standard
+        assert get_last_committed_checkpoint(str(tmp_path)) is None
+        # ... and the Trainer's legacy fallback finds it
+        assert get_last_legacy_checkpoint(str(tmp_path)) == ckpt
+
+    def test_legacy_fallback_skips_torn_committed_dirs(self, tmp_path, model):
+        """The legacy fallback returns manifest-LESS dirs only: a dir with a
+        manifest that fails validation is a torn save, and handing it to the
+        loader would crash resume instead of using the older legacy state."""
+        legacy = save_step(tmp_path, model, 2)
+        os.unlink(os.path.join(legacy, COMMIT_MANIFEST))  # pre-protocol dir
+        torn = save_step(tmp_path, model, 4)  # newer, committed...
+        opt = os.path.join(torn, "optimizer.safetensors")
+        with open(opt, "r+b") as f:  # ...then damaged on disk
+            f.truncate(os.path.getsize(opt) // 2)
+        assert get_last_committed_checkpoint(str(tmp_path)) is None
+        assert get_last_legacy_checkpoint(str(tmp_path)) == legacy  # NOT checkpoint-4
+
+
+class TestRotation:
+    def test_rotates_only_committed_beyond_limit(self, tmp_path, model):
+        for step in (2, 4, 6):
+            save_step(tmp_path, model, step)
+        deleted = rotate_checkpoints(str(tmp_path), limit=2)
+        assert [os.path.basename(d) for d in deleted] == ["checkpoint-2"]
+        assert sorted(d for d in os.listdir(tmp_path) if d.startswith("checkpoint-")) == \
+            ["checkpoint-4", "checkpoint-6"]
+
+    def test_best_checkpoint_guard_normalizes_paths(self, tmp_path, model):
+        """The old guard compared raw strings — a relative
+        best_model_checkpoint failed to protect the absolute dir."""
+        for step in (2, 4, 6):
+            save_step(tmp_path, model, step)
+        rel_best = os.path.relpath(os.path.join(str(tmp_path), "checkpoint-2"))
+        deleted = rotate_checkpoints(str(tmp_path), limit=1, best_model_checkpoint=rel_best)
+        assert os.path.isdir(os.path.join(str(tmp_path), "checkpoint-2"))  # protected
+        assert [os.path.basename(d) for d in deleted] == ["checkpoint-4"]
+
+    def test_uncommitted_dir_never_deleted(self, tmp_path, model):
+        for step in (4, 6, 8):
+            save_step(tmp_path, model, step)
+        torn = os.path.join(str(tmp_path), "checkpoint-2")
+        os.makedirs(torn)
+        (lambda p: open(p, "w").write("partial"))(os.path.join(torn, "optimizer.safetensors"))
+        rotate_checkpoints(str(tmp_path), limit=1)
+        assert os.path.isdir(torn)  # torn dir kept for diagnosis
+
+    def test_async_save_commits_and_joins(self, tmp_path, model):
+        from paddlenlp_tpu.trainer import unified_checkpoint as uc
+
+        ckpt = save_step(tmp_path, model, 2, async_save=True)
+        assert join_pending_saves(timeout=60.0) == 0
+        assert uc._pending_saves == []  # finished writers reaped, not leaked
+        assert is_committed(ckpt)
+
+    def test_after_commit_hook_rotates_on_writer_thread(self, tmp_path, model):
+        """Trainer wires rotation through after_commit so async saves stay
+        async: the hook must run post-rename (the new checkpoint is committed
+        and protected) on the writer thread."""
+        for step in (2, 4):
+            save_step(tmp_path, model, step)
+        ckpt6 = os.path.join(str(tmp_path), "checkpoint-6")
+        save_unified_checkpoint(
+            ckpt6, model=model, train_state=make_state(model, 6),
+            trainer_state=TrainerState(global_step=6), async_save=True,
+            after_commit=lambda: rotate_checkpoints(str(tmp_path), limit=2))
+        assert join_pending_saves(timeout=60.0) == 0
+        assert is_committed(ckpt6)
+        assert sorted(d for d in os.listdir(tmp_path) if d.startswith("checkpoint-")) == \
+            ["checkpoint-4", "checkpoint-6"]
+
+    def test_after_commit_skipped_when_save_fails(self, tmp_path, model):
+        hook_ran = []
+        FAULTS.arm("ckpt.commit")
+        with pytest.raises(InjectedFault):
+            save_unified_checkpoint(
+                os.path.join(str(tmp_path), "checkpoint-2"), model=model,
+                train_state=make_state(model, 2),
+                after_commit=lambda: hook_ran.append(True))
+        assert hook_ran == []  # never rotate on behalf of a save that died
+
+    def test_async_save_failure_is_reaped_and_uncommitted(self, tmp_path, model):
+        FAULTS.arm("ckpt.write_shard", nth=1)
+        save_step(tmp_path, model, 4, async_save=True)
+        assert join_pending_saves(timeout=60.0) == 0
+        assert not os.path.isdir(os.path.join(str(tmp_path), "checkpoint-4"))
+        assert get_last_committed_checkpoint(str(tmp_path)) is None

@@ -1,0 +1,149 @@
+"""Fault-injection harness unit tests: trigger determinism, actions, arming.
+
+The harness is the foundation the chaos suite stands on — if nth/seed
+semantics drift, every downstream chaos test silently stops testing what it
+claims to. Stdlib-only (no jax, no engine)."""
+
+import os
+import time
+
+import pytest
+
+from paddlenlp_tpu.utils.faults import (
+    CATALOG,
+    FAULTS,
+    FaultPoint,
+    FaultRegistry,
+    InjectedFault,
+    _parse_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+class TestTriggerSpecs:
+    def test_unknown_name_rejected_at_construction(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultPoint("no.such.point")
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FAULTS.arm("no.such.point")
+
+    def test_disarmed_fire_is_noop(self):
+        FaultPoint("engine.step").fire()  # nothing armed: must not raise
+
+    def test_nth_fires_on_exact_hit(self):
+        FAULTS.arm("engine.step", nth=3)
+        point = FaultPoint("engine.step")
+        point.fire()
+        point.fire()
+        with pytest.raises(InjectedFault) as ei:
+            point.fire()
+        assert ei.value.hit == 3 and ei.value.point == "engine.step"
+        # times=1 default: the 3rd hit fired, later hits pass through
+        point.fire()
+        assert FAULTS.hits("engine.step") == 4
+        assert FAULTS.fired("engine.step") == 1
+
+    def test_nth_list(self):
+        FAULTS.arm("engine.step", nth=(1, 3), times=None)
+        point = FaultPoint("engine.step")
+        outcomes = []
+        for _ in range(4):
+            try:
+                point.fire()
+                outcomes.append(False)
+            except InjectedFault:
+                outcomes.append(True)
+        assert outcomes == [True, False, True, False]
+
+    def test_every_hit_with_times_cap(self):
+        FAULTS.arm("engine.step", times=2)
+        point = FaultPoint("engine.step")
+        fired = 0
+        for _ in range(5):
+            try:
+                point.fire()
+            except InjectedFault:
+                fired += 1
+        assert fired == 2
+
+    def test_probability_deterministic_under_seed(self):
+        def run(seed):
+            reg = FaultRegistry()
+            reg._env_loaded = True
+            reg.arm("engine.step", p=0.5, seed=seed, times=None)
+            out = []
+            for _ in range(32):
+                try:
+                    reg.fire("engine.step")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b = run(7), run(7)
+        assert a == b and 1 in a and 0 in a  # same seed, same chaos
+        assert run(8) != a  # different seed, different chaos
+
+    def test_delay_action_sleeps_without_raising(self):
+        FAULTS.arm("engine.step", action="delay", delay_s=0.05)
+        t0 = time.monotonic()
+        FaultPoint("engine.step").fire()
+        assert time.monotonic() - t0 >= 0.045
+
+    def test_partial_action_truncates_then_raises(self, tmp_path):
+        f = tmp_path / "shard.bin"
+        f.write_bytes(b"x" * 1000)
+        FAULTS.arm("ckpt.write_shard", action="partial")
+        with pytest.raises(InjectedFault):
+            FaultPoint("ckpt.write_shard").fire(file=str(f))
+        assert f.stat().st_size == 500  # torn, not missing
+
+    def test_nth_and_p_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="nth= OR p="):
+            FAULTS.arm("engine.step", nth=2, p=0.5)
+
+
+class TestArming:
+    def test_spec_string_parsing(self):
+        name, spec = _parse_spec("ckpt.write_shard:nth=2,5:action=partial:times=3")
+        assert name == "ckpt.write_shard"
+        assert spec.nth == (2, 5) and spec.action == "partial" and spec.times == 3
+        with pytest.raises(ValueError):
+            _parse_spec("x:badfield")
+        with pytest.raises(ValueError):
+            _parse_spec("x:what=1")
+
+    def test_arm_from_spec_multiple(self):
+        FAULTS.arm_from_spec("engine.step:nth=1; serving.submit:p=0.25:seed=3")
+        assert FAULTS.armed("engine.step").nth == (1,)
+        assert FAULTS.armed("serving.submit").p == 0.25
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("PDNLP_TPU_FAULTS", "serving.submit:nth=1")
+        reg = FaultRegistry()
+        reg.load_env()
+        assert reg.armed("serving.submit") is not None
+        # idempotent: second load does not re-arm after a reset
+        reg.reset()
+        reg.load_env()
+        assert reg.armed("serving.submit") is None
+
+    def test_disarm_and_reset(self):
+        FAULTS.arm("engine.step", nth=1)
+        FAULTS.arm("serving.submit", nth=1)
+        FAULTS.disarm("engine.step")
+        assert FAULTS.armed("engine.step") is None
+        assert FAULTS.armed("serving.submit") is not None
+        FAULTS.reset()
+        assert FAULTS.armed("serving.submit") is None
+        assert not FAULTS._enabled
+
+    def test_catalog_docs_nonempty(self):
+        for name, doc in CATALOG.items():
+            assert doc and len(doc) >= 20, name

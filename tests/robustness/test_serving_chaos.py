@@ -1,0 +1,242 @@
+"""Serving recovery chaos test (ISSUE 3 acceptance, serving side).
+
+With 8 concurrent SSE streams and a fault injected into ``engine.step``:
+
+- the API returns 503 (+ ``Retry-After``) while DEGRADED — never a
+  connection reset;
+- ``engine_restarts_total`` increments;
+- retried requests complete with exactly the tokens an uninterrupted run
+  produces (position-keyed sampling + recompute requeue);
+- non-retryable requests (``max_retries: 0``) finish with
+  ``finish_reason="engine_error"`` delivered in-band over SSE.
+
+CPU-only, tiny model — tier-1 speed."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import (
+    MetricsRegistry,
+    SchedulerConfig,
+    ServingServer,
+    SupervisorPolicy,
+)
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine(model):
+    return InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=128,
+                           max_blocks_per_seq=32, decode_steps=4)
+
+
+def get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def post_json(port, path, payload, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+class SSEStream:
+    def __init__(self, port, payload, timeout=300):
+        self.conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        self.conn.request("POST", "/v1/completions", body=json.dumps(payload),
+                          headers={"Content-Type": "application/json"})
+        self.resp = self.conn.getresponse()
+        self.status = self.resp.status
+
+    def events(self):
+        while True:
+            line = self.resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                return
+            yield json.loads(data)
+
+    def close(self):
+        self.conn.close()
+
+
+GEN_LEN = 24
+
+
+class TestServingRecovery:
+    def test_engine_fault_under_concurrent_sse_streams(self, model):
+        n_stream, n_err = 8, 2
+        registry = MetricsRegistry()
+        srv = ServingServer(
+            make_engine(model),
+            engine_factory=lambda: make_engine(model),
+            supervisor_policy=SupervisorPolicy(max_retries=2, backoff_base_s=0.75,
+                                               backoff_max_s=2.0),
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0),
+            registry=registry,
+        )
+        port = srv.start_in_thread()
+        try:
+            # fault on the 4th engine step: all streams admitted, none can have
+            # finished (<= 1 prefill + 3x4 decode tokens < GEN_LEN); the first
+            # rebuild attempt also fails so the DEGRADED window is wide enough
+            # to probe deterministically
+            FAULTS.arm("engine.step", nth=4)
+            FAULTS.arm("engine.rebuild", nth=1)
+
+            results, errors = {}, {}
+
+            def stream_worker(i):
+                s = SSEStream(port, {"prompt": [5 + i, 6 + i, 7 + i],
+                                     "max_tokens": GEN_LEN, "stream": True})
+                assert s.status == 200
+                toks, finish = [], None
+                for ev in s.events():
+                    c = ev["choices"][0]
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+                    elif "token" in c:
+                        toks.append(c["token"])
+                results[i] = (toks, finish)
+                s.close()
+
+            def error_worker(i):
+                s = SSEStream(port, {"prompt": [40 + i, 41 + i], "max_tokens": GEN_LEN,
+                                     "stream": True, "max_retries": 0})
+                assert s.status == 200
+                toks, finish = [], None
+                for ev in s.events():
+                    c = ev["choices"][0]
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+                    elif "token" in c:
+                        toks.append(c["token"])
+                errors[i] = (toks, finish)
+                s.close()
+
+            threads = [threading.Thread(target=stream_worker, args=(i,)) for i in range(n_stream)]
+            threads += [threading.Thread(target=error_worker, args=(i,)) for i in range(n_err)]
+            for t in threads:
+                t.start()
+
+            # ---- while degraded: clean 503s, never connection resets ----
+            deadline = time.time() + 60
+            while time.time() < deadline and not srv.loop.degraded:
+                time.sleep(0.01)
+            assert srv.loop.degraded, "engine.step fault never tripped the supervisor"
+            status, health, _ = get_json(port, "/health")
+            assert status == 503 and health["status"] == "degraded"
+            status, body, headers = post_json(
+                port, "/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 2})
+            assert status == 503, body
+            assert body["error"]["type"] == "engine_recovering"
+            assert int(headers.get("Retry-After", 0)) >= 1
+
+            for t in threads:
+                t.join(timeout=600)
+            assert not any(t.is_alive() for t in threads)
+
+            # ---- retried streams: full budget, token-exact vs a solo run ----
+            assert len(results) == n_stream
+            for i, (toks, finish) in results.items():
+                assert finish == "length", (i, finish)
+                assert len(toks) == GEN_LEN, (i, len(toks))
+            solo = make_engine(model).generate(
+                [[5, 6, 7]], SamplingParams(max_new_tokens=GEN_LEN))[0]
+            np.testing.assert_array_equal(results[0][0], solo)
+
+            # ---- non-retryable: fast-cleared in-band with engine_error ----
+            assert len(errors) == n_err
+            for i, (toks, finish) in errors.items():
+                assert finish == "engine_error", (i, finish)
+                assert len(toks) < GEN_LEN
+
+            # ---- metrics plane ----
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            conn.close()
+
+            def metric_value(name):
+                for line in text.splitlines():
+                    if line.startswith(name + " ") or line.startswith(name + "{"):
+                        return float(line.rsplit(" ", 1)[1])
+                raise AssertionError(f"metric {name} missing:\n{text}")
+
+            assert metric_value("paddlenlp_serving_engine_restarts_total") >= 1
+            assert metric_value("paddlenlp_serving_request_retries_total") >= n_stream
+            assert 'paddlenlp_serving_requests_total{status="engine_error"}' in text
+            assert 'paddlenlp_serving_requests_total{status="length"}' in text
+
+            # ---- post-recovery health + fresh traffic ----
+            status, health, _ = get_json(port, "/health")
+            assert status == 200 and health["status"] == "ok"
+            assert health["scheduler"]["rejected_degraded"] >= 1
+            status, body, _ = post_json(port, "/v1/completions",
+                                        {"prompt": [5, 6, 7], "max_tokens": 4})
+            assert status == 200
+            assert len(body["choices"][0]["token_ids"]) == 4
+        finally:
+            srv.shutdown(drain_timeout_s=5)
+
+    def test_in_place_reset_recovery_without_factory(self, model):
+        """No engine_factory: the supervisor recovers via engine.reset()."""
+        registry = MetricsRegistry()
+        srv = ServingServer(
+            make_engine(model),
+            supervisor_policy=SupervisorPolicy(backoff_base_s=0.05, backoff_max_s=0.2),
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0),
+            registry=registry,
+        )
+        port = srv.start_in_thread()
+        try:
+            FAULTS.arm("engine.step", nth=2)
+            status, body, _ = post_json(port, "/v1/completions",
+                                        {"prompt": [5, 6, 7], "max_tokens": 8}, timeout=300)
+            assert status == 200, body
+            choice = body["choices"][0]
+            assert choice["finish_reason"] == "length"
+            # same engine object, identical continuation after reset
+            solo = make_engine(model).generate([[5, 6, 7]], SamplingParams(max_new_tokens=8))[0]
+            np.testing.assert_array_equal(choice["token_ids"], solo)
+            assert registry.get("paddlenlp_serving_engine_restarts_total").value() >= 1
+        finally:
+            srv.shutdown(drain_timeout_s=5)

@@ -1,0 +1,73 @@
+"""atomic_write contract: the target is always either old-complete or
+new-complete — a crash (exception) mid-write changes nothing."""
+
+import json
+import os
+
+import pytest
+
+from paddlenlp_tpu.utils.fileio import atomic_write, fsync_dir, fsync_file
+
+
+class TestAtomicWrite:
+    def test_creates_new_file(self, tmp_path):
+        p = tmp_path / "state.json"
+        with atomic_write(str(p)) as f:
+            json.dump({"step": 4}, f)
+        assert json.loads(p.read_text()) == {"step": 4}
+
+    def test_replaces_existing_atomically(self, tmp_path):
+        p = tmp_path / "state.json"
+        p.write_text("old")
+        with atomic_write(str(p)) as f:
+            f.write("new")
+        assert p.read_text() == "new"
+
+    def test_exception_leaves_target_untouched(self, tmp_path):
+        p = tmp_path / "state.json"
+        p.write_text('{"step": 2}')
+        with pytest.raises(RuntimeError):
+            with atomic_write(str(p)) as f:
+                f.write('{"step": 4, "truncat')  # mid-payload crash
+                raise RuntimeError("killed mid-save")
+        assert json.loads(p.read_text()) == {"step": 2}  # old content intact
+
+    def test_no_tmp_litter(self, tmp_path):
+        p = tmp_path / "state.json"
+        with atomic_write(str(p)) as f:
+            f.write("ok")
+        with pytest.raises(ValueError):
+            with atomic_write(str(p)) as f:
+                raise ValueError("boom")
+        assert sorted(os.listdir(tmp_path)) == ["state.json"]
+
+    def test_binary_mode(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        with atomic_write(str(p), mode="wb") as f:
+            f.write(b"\x00\x01\x02")
+        assert p.read_bytes() == b"\x00\x01\x02"
+
+    def test_fsync_helpers_tolerate_real_paths(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_text("x")
+        fsync_file(str(p))
+        fsync_dir(str(tmp_path))  # best-effort; must not raise
+
+
+class TestTrainerStateAtomicSave:
+    def test_save_to_json_is_crash_safe(self, tmp_path, monkeypatch):
+        """TrainerState.save_to_json goes through atomic_write: simulate a
+        crash inside json.dump and verify the previous state file survives."""
+        from paddlenlp_tpu.trainer.trainer_callback import TrainerState
+
+        path = tmp_path / "trainer_state.json"
+        TrainerState(global_step=6).save_to_json(str(path))
+        assert TrainerState.load_from_json(str(path)).global_step == 6
+
+        state = TrainerState(global_step=8)
+        # make asdict explode after the file is opened
+        monkeypatch.setattr("dataclasses.asdict",
+                            lambda *_a, **_k: (_ for _ in ()).throw(OSError("died")))
+        with pytest.raises(OSError):
+            state.save_to_json(str(path))
+        assert TrainerState.load_from_json(str(path)).global_step == 6
