@@ -20,6 +20,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlsplit
 
 from ..utils.log import logger
 from .tracer import TRACER, SpanTracer
@@ -33,13 +34,31 @@ def route_observability(path: str, registry, tracer: SpanTracer):
     """Shared GET routing for the observability surface: returns
     ``(status, content_type, body_bytes)`` or None for unknown paths. Both HTTP
     planes — this exporter and ``serving/api.py`` — dispatch through here so
-    the routes cannot drift."""
-    if path == "/metrics":
+    the routes cannot drift.
+
+    ``/debug/trace`` and ``/debug/spans`` accept filters so one request's
+    timeline is dumpable without shipping the whole ring:
+
+    - ``?trace=req-42`` — only spans carrying that trace id;
+    - ``?since_ts=<epoch seconds>`` — cursor for incremental scrapes (pair it
+      with ``SpanTracer.now()`` readings from the previous dump).
+    """
+    parts = urlsplit(path)
+    route, query = parts.path, parse_qs(parts.query)
+    if route == "/metrics":
         return 200, PROMETHEUS_CONTENT_TYPE, registry.expose().encode()
-    if path == "/debug/trace":
-        return 200, "application/json", json.dumps(tracer.chrome_trace()).encode()
-    if path == "/debug/spans":
-        return 200, "application/jsonl", tracer.to_jsonl().encode()
+    if route in ("/debug/trace", "/debug/spans"):
+        trace = query.get("trace", [None])[0]
+        since_raw = query.get("since_ts", [None])[0]
+        try:
+            since_ts = float(since_raw) if since_raw is not None else None
+        except ValueError:
+            return (400, "application/json",
+                    json.dumps({"error": f"since_ts must be a number, got {since_raw!r}"}).encode())
+        spans = tracer.snapshot(since_ts=since_ts, trace=trace)
+        if route == "/debug/trace":
+            return 200, "application/json", json.dumps(tracer.chrome_trace(spans)).encode()
+        return 200, "application/jsonl", tracer.to_jsonl(spans).encode()
     return None
 
 

@@ -6,6 +6,7 @@ Import order matters for dependency weight: :mod:`.metrics` is stdlib-only
 jax-backed engine lazily at construction time.
 """
 
+from . import router  # noqa: F401  (multi-replica front tier; stdlib-only)
 from .api import ServingServer  # noqa: F401
 from .engine_loop import (  # noqa: F401
     EngineLoop,
@@ -23,6 +24,7 @@ from .scheduler import (  # noqa: F401
 )
 
 __all__ = [
+    "router",
     "ServingServer",
     "EngineLoop",
     "RequestHandle",

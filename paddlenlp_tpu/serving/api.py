@@ -28,13 +28,14 @@ from __future__ import annotations
 import itertools
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ..observability.exporter import route_observability
 from ..observability.tracer import TRACER
 from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
+from .httputil import JsonRequestHandler
 from .metrics import REGISTRY, MetricsRegistry
 from .scheduler import (
     DegradedError,
@@ -164,32 +165,14 @@ class ServingServer:
     def _make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+        class Handler(JsonRequestHandler):
+            log_prefix = "serving"
 
-            def log_message(self, fmt, *args):
-                logger.debug("serving: " + fmt % args)
-
-            def _send_json(self, code: int, payload: dict, headers: Optional[dict] = None):
-                self._send_raw(code, json.dumps(payload).encode(), "application/json",
-                               headers=headers)
-
-            def _send_error_json(self, code: int, message: str, etype: str,
-                                 headers: Optional[dict] = None):
-                self._send_json(code, {"error": {"message": message, "type": etype, "code": code}},
-                                headers=headers)
+            @property
+            def max_body_bytes(self):  # live read: the cap is server-tunable
+                return server.max_body_bytes
 
             # --------------------------------------------------------- GET
-            def _send_raw(self, code: int, body: bytes, ctype: str,
-                          headers: Optional[dict] = None):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, str(v))
-                self.end_headers()
-                self.wfile.write(body)
-
             def do_GET(self):
                 try:
                     # /metrics, /debug/trace, /debug/spans: shared with the
@@ -223,27 +206,6 @@ class ServingServer:
                     logger.debug("serving: client disconnected during GET")
 
             # --------------------------------------------------------- POST
-            def _read_body(self) -> Optional[dict]:
-                n = int(self.headers.get("Content-Length", 0))
-                if n > server.max_body_bytes:
-                    # rejected before reading: the unread body makes this
-                    # connection unusable for keep-alive
-                    self.close_connection = True
-                    self._send_error_json(
-                        413, f"body of {n} bytes exceeds limit {server.max_body_bytes}",
-                        "payload_too_large")
-                    return None
-                raw = self.rfile.read(n) if n else b"{}"
-                try:
-                    payload = json.loads(raw or b"{}")
-                except ValueError as e:
-                    self._send_error_json(400, f"invalid JSON body: {e}", "invalid_request")
-                    return None
-                if not isinstance(payload, dict):
-                    self._send_error_json(400, "body must be a JSON object", "invalid_request")
-                    return None
-                return payload
-
             def do_POST(self):
                 try:
                     if self.path == "/v1/completions":

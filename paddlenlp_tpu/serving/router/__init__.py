@@ -1,0 +1,59 @@
+"""Multi-replica front tier: health-aware routing, cross-replica failover,
+prefix-affinity placement.
+
+The fourth pillar of the serving stack (after the continuous-batching
+runtime, the observability layer, and the fault-tolerance supervisor): a
+stdlib-only HTTP router that fronts N ``ServingServer`` replicas —
+
+- :mod:`.pool` — replica registry + background health poller
+  (HEALTHY → DEGRADED → DOWN → RECOVERING state machine off each replica's
+  ``/health`` and ``/metrics`` planes);
+- :mod:`.policy` — least-loaded candidate ordering and consistent-hash
+  prefix affinity;
+- :mod:`.proxy` — ``RouterServer``: SSE passthrough, 429/503 re-routing,
+  pre-token failover, in-band ``replica_error`` mid-stream terminal;
+- :mod:`.metrics` — the ``paddlenlp_router_*`` catalog;
+- :mod:`.launcher` — in-process fleet helpers for tests and the CPU bench.
+"""
+
+from .launcher import ReplicaFleet, launch_fleet, launch_replicas  # noqa: F401
+from .metrics import RouterMetrics  # noqa: F401
+from .policy import (  # noqa: F401
+    HashRing,
+    LeastLoadedPolicy,
+    PrefixAffinityPolicy,
+    load_score,
+    resolve_policy,
+)
+from .pool import (  # noqa: F401
+    DEGRADED,
+    DOWN,
+    HEALTHY,
+    RECOVERING,
+    ProbeResult,
+    Replica,
+    ReplicaPool,
+    ReplicaSnapshot,
+)
+from .proxy import RouterServer  # noqa: F401
+
+__all__ = [
+    "RouterServer",
+    "ReplicaPool",
+    "Replica",
+    "ReplicaSnapshot",
+    "ProbeResult",
+    "RouterMetrics",
+    "LeastLoadedPolicy",
+    "PrefixAffinityPolicy",
+    "HashRing",
+    "load_score",
+    "resolve_policy",
+    "ReplicaFleet",
+    "launch_replicas",
+    "launch_fleet",
+    "HEALTHY",
+    "DEGRADED",
+    "DOWN",
+    "RECOVERING",
+]

@@ -1,0 +1,110 @@
+"""In-process fleet launcher: N ``ServingServer`` replicas on ephemeral ports.
+
+The whole front tier is exercisable under tier-1 CPU tests and the smoke
+bench without any external process management: ``launch_replicas`` builds N
+real serving replicas (each with its **own** ``MetricsRegistry`` — the pull
+gauges bind to one engine, so replicas must never share a registry) and
+``launch_fleet`` puts a started ``RouterServer`` in front of them.
+
+Everything here takes an ``engine_factory`` callable instead of an engine so
+the module stays import-light (no jax until a factory runs) and each replica's
+supervisor can rebuild its engine independently.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from ...utils.log import logger
+from ..api import ServingServer
+from ..metrics import MetricsRegistry
+from .proxy import RouterServer
+
+__all__ = ["ReplicaFleet", "launch_replicas", "launch_fleet"]
+
+
+class ReplicaFleet:
+    """Handle over N started in-process replicas (and optionally a router)."""
+
+    def __init__(self, servers: List[ServingServer], ports: List[int], host: str):
+        self.servers = servers
+        self.ports = ports
+        self.host = host
+        self.router: Optional[RouterServer] = None
+        self.router_port: Optional[int] = None
+
+    def endpoints(self) -> List[Tuple[str, int]]:
+        return [(self.host, p) for p in self.ports]
+
+    def registries(self) -> List[MetricsRegistry]:
+        return [s.registry for s in self.servers]
+
+    def shutdown(self, drain_timeout_s: Optional[float] = 10.0):
+        """Router first (stop admitting), then the replicas (drain)."""
+        if self.router is not None:
+            self.router.shutdown()
+            self.router = None
+        for server in self.servers:
+            try:
+                server.shutdown(drain_timeout_s=drain_timeout_s)
+            except Exception as e:
+                logger.warning(f"fleet: replica shutdown failed: {e!r}")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def launch_replicas(n: int, engine_factory: Callable[[], object], *,
+                    tokenizer=None, scheduler_config=None, supervisor_policy=None,
+                    host: str = "127.0.0.1") -> ReplicaFleet:
+    """Start ``n`` in-process serving replicas on ephemeral ports.
+
+    Each replica gets a fresh engine from ``engine_factory`` (which also
+    serves as its supervisor's rebuild factory) and a private registry."""
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    servers: List[ServingServer] = []
+    ports: List[int] = []
+    try:
+        for _ in range(n):
+            server = ServingServer(
+                engine_factory(), tokenizer=tokenizer,
+                scheduler_config=scheduler_config,
+                registry=MetricsRegistry(),
+                engine_factory=engine_factory,
+                supervisor_policy=supervisor_policy)
+            ports.append(server.start_in_thread(host=host))
+            servers.append(server)
+    except BaseException:
+        for server in servers:
+            server.shutdown(drain_timeout_s=1.0)
+        raise
+    return ReplicaFleet(servers, ports, host)
+
+
+def launch_fleet(n: int, engine_factory: Callable[[], object], *,
+                 policy="least_loaded", router_registry: Optional[MetricsRegistry] = None,
+                 poll_interval_s: float = 0.1, max_attempts: int = 3,
+                 host: str = "127.0.0.1", **replica_kw) -> ReplicaFleet:
+    """``launch_replicas`` + a started :class:`RouterServer` in front.
+
+    Returns the fleet with ``.router`` / ``.router_port`` set; one initial
+    synchronous poll sweep runs before the port is returned so the first
+    request already routes on real health/load data."""
+    fleet = launch_replicas(n, engine_factory, host=host, **replica_kw)
+    try:
+        router = RouterServer(fleet.endpoints(), policy=policy,
+                              registry=router_registry or MetricsRegistry(),
+                              poll_interval_s=poll_interval_s,
+                              max_attempts=max_attempts)
+        router.pool.poll_once()
+        fleet.router = router
+        fleet.router_port = router.start_in_thread(host=host)
+    except BaseException:
+        fleet.shutdown(drain_timeout_s=1.0)
+        raise
+    return fleet

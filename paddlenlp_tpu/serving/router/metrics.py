@@ -1,0 +1,58 @@
+"""Router metrics catalog: one registration point for every ``paddlenlp_router_*``
+series the front tier exports.
+
+Same contract as :class:`~..engine_loop.ServingMetrics` for the replica plane:
+names are stable API — the serving README catalog, ``tools/check_metrics.py``
+(which instantiates this class so tier-1 lints the exposition) and
+``tools/bench_serve.py --replicas N`` all consume them by string.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..metrics import REGISTRY, MetricsRegistry
+
+__all__ = ["RouterMetrics", "ROUTE_DECISION_BUCKETS"]
+
+# seconds; routing decisions are pure host work (snapshot + sort/hash), so the
+# interesting range is tens of microseconds to a few milliseconds — the default
+# latency buckets would dump every observation into the first bucket
+ROUTE_DECISION_BUCKETS: Tuple[float, ...] = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.1,
+)
+
+
+class RouterMetrics:
+    """Registers the router metric catalog in one registry.
+
+    Push-mode only: the pool's health poller writes ``replica_healthy`` on
+    every poll, and the proxy writes the request/failover counters at request
+    terminal — there is no engine to bind pull gauges against."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = r = registry or REGISTRY
+        self.requests = r.counter(
+            "paddlenlp_router_requests_total",
+            "Requests terminated by the router, by backing replica and outcome",
+            labelnames=("replica", "outcome"))
+        self.replica_healthy = r.gauge(
+            "paddlenlp_router_replica_healthy",
+            "1 when the replica's last health poll was HEALTHY, else 0",
+            labelnames=("replica",))
+        self.failovers = r.counter(
+            "paddlenlp_router_failovers_total",
+            "In-flight requests resubmitted to another replica after their "
+            "replica failed before emitting a token")
+        self.rerouted = r.counter(
+            "paddlenlp_router_rerouted_total",
+            "Forward attempts re-routed to the next candidate on a replica "
+            "429/503 or connect failure (nothing relayed yet)")
+        self.route_decision = r.histogram(
+            "paddlenlp_router_route_decision_seconds",
+            "Latency of one routing decision (pool snapshot + policy ordering)",
+            buckets=ROUTE_DECISION_BUCKETS)
+        self.health_polls = r.counter(
+            "paddlenlp_router_health_polls_total",
+            "Health-poller probes by replica and outcome (ok/degraded/error)",
+            labelnames=("replica", "outcome"))

@@ -1,0 +1,344 @@
+"""Replica registry + background health poller for the router front tier.
+
+Each replica is a ``ServingServer`` (or anything speaking its HTTP surface);
+the pool learns replica health the same way an external prober would — by
+polling ``GET /health`` (scheduler/engine stats; 503 while the replica's
+engine-loop supervisor reports DEGRADED or the scheduler is draining) and
+scraping ``GET /metrics`` for ``paddlenlp_serving_kv_utilization`` — so the
+router needs no privileged in-process hooks and works unchanged against
+out-of-process replicas.
+
+**State machine** (per replica)::
+
+    HEALTHY ──probe 503 (degraded/draining)──▶ DEGRADED
+    HEALTHY/DEGRADED ──unreachable × down_after──▶ DOWN
+    DOWN ──probe ok──▶ RECOVERING ──ok × recovery_polls──▶ HEALTHY
+    RECOVERING ──probe fails──▶ back toward DOWN
+
+A single unreachable probe demotes to DEGRADED (the replica may just be
+GC-pausing); ``down_after`` consecutive failures mean DOWN — the policy layer
+stops offering the replica entirely. Recovery is probational: a replica coming
+back from DOWN serves traffic at RECOVERING priority until ``recovery_polls``
+consecutive clean probes promote it, so a flapping replica cannot oscillate
+straight back into preferred rotation.
+
+The proxy feeds forwarding observations back through
+:meth:`ReplicaPool.note_forward_failure` / :meth:`ReplicaPool.note_degraded`
+so a mid-stream incident demotes the replica immediately instead of waiting a
+poll interval.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ...observability.tracer import TRACER
+from ...utils.faults import FaultPoint
+from ...utils.log import logger
+from .metrics import RouterMetrics
+
+__all__ = ["HEALTHY", "DEGRADED", "DOWN", "RECOVERING", "Replica",
+           "ReplicaSnapshot", "ProbeResult", "ReplicaPool"]
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DOWN = "down"
+RECOVERING = "recovering"
+
+_F_HEALTH_POLL = FaultPoint("router.health_poll")
+
+KV_UTILIZATION_METRIC = "paddlenlp_serving_kv_utilization"
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    """Outcome of one health probe. ``reachable`` separates a live replica
+    shedding load (503 degraded/draining — still owns its queue) from one
+    that cannot be talked to at all (connect/timeout — may be gone)."""
+
+    reachable: bool
+    status: Optional[str] = None  # the /health "status" field
+    inflight: int = 0
+    queue_depth: int = 0
+    kv_utilization: Optional[float] = None
+    retry_after_s: Optional[float] = None
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSnapshot:
+    """Immutable point-in-time view the routing policy consumes."""
+
+    id: str
+    host: str
+    port: int
+    state: str
+    inflight: int
+    queue_depth: int
+    kv_utilization: float
+    retry_after_s: Optional[float]
+    consecutive_failures: int
+    last_poll_t: Optional[float]
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class Replica:
+    """Mutable pool-side record for one replica (poller-thread writes, HTTP
+    threads read only through :meth:`snapshot` under the pool lock)."""
+
+    def __init__(self, replica_id: str, host: str, port: int):
+        self.id = replica_id
+        self.host = host
+        self.port = port
+        # optimistic start: a replica is offered traffic until the first probe
+        # says otherwise — the common launch order is "replicas up, then
+        # router", and starting DOWN would 503 every request for one interval
+        self.state = HEALTHY
+        self.inflight = 0
+        self.queue_depth = 0
+        self.kv_utilization = 0.0
+        self.retry_after_s: Optional[float] = None
+        self.consecutive_failures = 0
+        self.recovery_streak = 0
+        self.last_poll_t: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self.polls = 0  # probe count (drives the kv-scrape cadence)
+
+    def snapshot(self) -> ReplicaSnapshot:
+        return ReplicaSnapshot(
+            id=self.id, host=self.host, port=self.port, state=self.state,
+            inflight=self.inflight, queue_depth=self.queue_depth,
+            kv_utilization=self.kv_utilization, retry_after_s=self.retry_after_s,
+            consecutive_failures=self.consecutive_failures, last_poll_t=self.last_poll_t)
+
+
+class ReplicaPool:
+    """Owns the replica set and the background poller thread."""
+
+    def __init__(self, metrics: Optional[RouterMetrics] = None,
+                 poll_interval_s: float = 1.0, probe_timeout_s: float = 2.0,
+                 down_after: int = 3, recovery_polls: int = 2,
+                 kv_scrape_every: int = 5):
+        if down_after < 1:
+            raise ValueError("down_after must be >= 1")
+        if recovery_polls < 1:
+            raise ValueError("recovery_polls must be >= 1")
+        if kv_scrape_every < 1:
+            raise ValueError("kv_scrape_every must be >= 1")
+        self.metrics = metrics
+        self.poll_interval_s = poll_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.down_after = down_after
+        self.recovery_polls = recovery_polls
+        self.kv_scrape_every = kv_scrape_every
+        self._replicas: List[Replica] = []
+        self._by_id: Dict[str, Replica] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- membership
+    def add(self, host: str, port: int, replica_id: Optional[str] = None) -> Replica:
+        rid = replica_id or f"{host}:{port}"
+        with self._lock:
+            if rid in self._by_id:
+                raise ValueError(f"replica {rid!r} already registered")
+            replica = Replica(rid, host, port)
+            self._replicas.append(replica)
+            self._by_id[rid] = replica
+        if self.metrics is not None:
+            self.metrics.replica_healthy.set(1.0, replica=rid)
+        return replica
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def get(self, replica_id: str) -> Optional[Replica]:
+        with self._lock:
+            return self._by_id.get(replica_id)
+
+    def snapshots(self) -> List[ReplicaSnapshot]:
+        with self._lock:
+            return [r.snapshot() for r in self._replicas]
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="router-health-poller",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout_s: float = 10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout_s)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # the poller must outlive any single probe
+                logger.warning(f"router: health-poll sweep failed: {e!r}")
+            self._stop.wait(timeout=self.poll_interval_s)
+
+    # ------------------------------------------------------------- polling
+    def poll_once(self):
+        """One synchronous sweep over every replica (tests call this directly
+        for deterministic state-machine coverage)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        for replica in replicas:
+            try:
+                result = self._probe(replica)
+            except Exception as e:
+                # connect refused, timeout, injected router.health_poll fault,
+                # junk body — all the same to the state machine: unreachable
+                result = ProbeResult(reachable=False, error=repr(e))
+            self._apply(replica, result)
+
+    def _probe(self, replica: Replica) -> ProbeResult:
+        """GET /health (+ /metrics kv_utilization) of one replica. Raises on
+        transport failure; the caller folds that into ProbeResult."""
+        _F_HEALTH_POLL.fire(replica=replica.id)
+        conn = http.client.HTTPConnection(replica.host, replica.port,
+                                          timeout=self.probe_timeout_s)
+        try:
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            retry_after = resp.getheader("Retry-After")
+            body = json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+        sched = body.get("scheduler") or {}
+        engine = body.get("engine") or {}
+        result = ProbeResult(
+            reachable=True,
+            status=body.get("status"),
+            inflight=int(sched.get("inflight", 0)),
+            queue_depth=int(engine.get("queue_depth", 0)),
+            retry_after_s=float(retry_after) if retry_after else None,
+        )
+        # kv_utilization rides on the replica's Prometheus plane (pull gauge
+        # sampled at scrape). Scraping + parsing the full exposition per poll
+        # would dominate a fast poll interval, so it runs every Nth probe —
+        # KV pressure moves on decode timescales, not poll timescales. A
+        # failed scrape keeps the last observation rather than failing the
+        # whole probe.
+        if replica.polls % self.kv_scrape_every == 0:
+            try:
+                result.kv_utilization = self._scrape_kv_utilization(replica)
+            except Exception as e:
+                logger.debug(f"router: kv scrape of {replica.id} failed: {e!r}")
+        replica.polls += 1
+        return result
+
+    def _scrape_kv_utilization(self, replica: Replica) -> Optional[float]:
+        conn = http.client.HTTPConnection(replica.host, replica.port,
+                                          timeout=self.probe_timeout_s)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+        finally:
+            conn.close()
+        if resp.status != 200:
+            return None
+        from ...observability.prometheus import parse_prometheus_text
+
+        fam = parse_prometheus_text(text).get(KV_UTILIZATION_METRIC)
+        if fam is None:
+            return None
+        v = fam.value()
+        return None if v is None or v != v else float(v)  # NaN-safe
+
+    # ------------------------------------------------------------- transitions
+    def _apply(self, replica: Replica, result: ProbeResult, probed: bool = True):
+        """Fold one observation into the replica's state machine. ``probed``
+        distinguishes a real prober visit (stamps ``last_poll_t``, counts in
+        ``health_polls_total``) from proxy forward feedback (state transition
+        only — phantom probe bookkeeping would lie to operators)."""
+        with self._lock:
+            prev = replica.state
+            if probed:
+                replica.last_poll_t = time.time()
+            replica.last_error = result.error
+            if result.reachable and result.status == "ok":
+                replica.consecutive_failures = 0
+                replica.retry_after_s = None
+                if prev in (DOWN, RECOVERING):
+                    replica.recovery_streak += 1
+                    replica.state = (HEALTHY if replica.recovery_streak >= self.recovery_polls
+                                     else RECOVERING)
+                else:
+                    replica.state = HEALTHY
+                outcome = "ok"
+            elif result.reachable:
+                # alive but shedding (degraded/draining): not a reachability
+                # failure — it still owns its in-flight work
+                replica.consecutive_failures = 0
+                replica.recovery_streak = 0
+                replica.state = DEGRADED
+                replica.retry_after_s = result.retry_after_s
+                outcome = "degraded"
+            else:
+                replica.consecutive_failures += 1
+                replica.recovery_streak = 0
+                replica.state = (DOWN if replica.consecutive_failures >= self.down_after
+                                 else DEGRADED)
+                # an unreachable replica's last Retry-After hint is stale — a
+                # dead replica must not inflate retry_after_hint() forever
+                replica.retry_after_s = None
+                outcome = "error"
+            if result.reachable:
+                replica.inflight = result.inflight
+                replica.queue_depth = result.queue_depth
+                if result.kv_utilization is not None:
+                    replica.kv_utilization = result.kv_utilization
+            new = replica.state
+        if self.metrics is not None:
+            self.metrics.replica_healthy.set(1.0 if new == HEALTHY else 0.0,
+                                             replica=replica.id)
+            if probed:
+                self.metrics.health_polls.inc(replica=replica.id, outcome=outcome)
+        if new != prev:
+            logger.warning(f"router: replica {replica.id} {prev} -> {new}"
+                           + (f" ({result.error})" if result.error else ""))
+            TRACER.instant("replica_state", cat="router", replica=replica.id,
+                           prev=prev, state=new, error=result.error)
+
+    # ------------------------------------------------------------- proxy feedback
+    def note_forward_failure(self, replica_id: str):
+        """A forward attempt hit a transport failure or a replica-side request
+        failure — demote now instead of waiting for the next poll."""
+        replica = self.get(replica_id)
+        if replica is not None:
+            self._apply(replica, ProbeResult(reachable=False, error="forward failure"),
+                        probed=False)
+
+    def note_degraded(self, replica_id: str, retry_after_s: Optional[float] = None):
+        """A forward attempt got the replica's 503 circuit breaker."""
+        replica = self.get(replica_id)
+        if replica is not None:
+            self._apply(replica, ProbeResult(reachable=True, status="degraded",
+                                             inflight=replica.inflight,
+                                             queue_depth=replica.queue_depth,
+                                             retry_after_s=retry_after_s),
+                        probed=False)
+
+    def retry_after_hint(self) -> float:
+        """Largest replica-reported Retry-After (>=1s floor) — what the router
+        tells clients when every candidate is unavailable."""
+        hints = [s.retry_after_s for s in self.snapshots() if s.retry_after_s]
+        return max([1.0] + [float(h) for h in hints])

@@ -1,0 +1,550 @@
+"""HTTP front tier: health-aware forwarding with cross-replica failover.
+
+``RouterServer`` sits in front of N ``ServingServer`` replicas and owns three
+concerns the replicas cannot solve alone:
+
+- **placement** — every request gets an ordered candidate list from the
+  routing policy (least-loaded or prefix-affinity) over live pool snapshots;
+- **re-routing** — a replica 429 (window full) / 503 (draining or its engine
+  supervisor's circuit breaker) or a connect failure moves the request to the
+  next candidate *before anything reaches the client*
+  (``paddlenlp_router_rerouted_total``);
+- **failover** — when a replica fails a request it had already accepted
+  (transport drop mid-stream, or an in-band ``finish_reason="engine_error"``
+  terminal), the router splits on whether the client has seen tokens:
+
+  - **no tokens emitted** → the request is transparently resubmitted to the
+    next healthy replica with the failed one excluded (bounded by
+    ``max_attempts``; the client's SSE connection and the router-side timing
+    anchors are preserved — the stream just pauses), counted in
+    ``paddlenlp_router_failovers_total``;
+  - **mid-stream** → regenerating would re-emit divergent tokens, so the
+    stream finishes **in-band** with ``finish_reason="replica_error"`` and a
+    usage block covering what was actually relayed — exactly the engine-loop
+    supervisor's ``engine_error`` contract, one level up.
+
+Upstream completion ids are rewritten to the router's own ``rtr-N`` ids so a
+failover is invisible to the client; ``POST /v1/abort`` is routed back to
+whichever replica currently owns the stream. The router's own observability
+plane (``/metrics``, ``/health``, ``/debug/trace``) rides on the shared
+registry/tracer machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import itertools
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ...observability.exporter import route_observability
+from ...observability.tracer import TRACER
+from ...utils.faults import FaultPoint, InjectedFault
+from ...utils.log import logger
+from ..httputil import JsonRequestHandler
+from ..metrics import REGISTRY, MetricsRegistry
+from .metrics import RouterMetrics
+from .policy import resolve_policy
+from .pool import DEGRADED, HEALTHY, RECOVERING, ReplicaPool, ReplicaSnapshot
+
+__all__ = ["RouterServer"]
+
+MAX_BODY_BYTES = 8 << 20
+
+_F_FORWARD = FaultPoint("router.forward")
+
+#: transport-level failures on the upstream leg; InjectedFault rides along so
+#: the router.forward fault point is handled exactly like a real socket error
+_UPSTREAM_ERRORS = (OSError, http.client.HTTPException, InjectedFault)
+
+
+class _RelayState:
+    """Per-request relay bookkeeping shared across forward attempts. One
+    instance per client request, touched only by that request's handler
+    thread — no locking needed."""
+
+    __slots__ = ("rid", "stream", "headers_sent", "tokens_relayed", "arrival_t",
+                 "attempts", "finished")
+
+    def __init__(self, rid: str, stream: bool):
+        self.rid = rid
+        self.stream = stream
+        self.headers_sent = False
+        self.tokens_relayed = 0
+        self.arrival_t = time.perf_counter()  # original timing anchor
+        self.attempts = 0
+        self.finished = False  # a finish_reason chunk was relayed to the client
+
+
+class RouterServer:
+    """Multi-replica front tier over the replica pool + routing policy."""
+
+    def __init__(self, replicas=(), pool: Optional[ReplicaPool] = None,
+                 policy="least_loaded", registry: Optional[MetricsRegistry] = None,
+                 max_attempts: int = 3, max_body_bytes: int = MAX_BODY_BYTES,
+                 poll_interval_s: float = 1.0, probe_timeout_s: float = 2.0,
+                 upstream_timeout_s: float = 600.0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.registry = registry or REGISTRY
+        self.tracer = TRACER
+        self.metrics = RouterMetrics(self.registry)
+        self.pool = pool if pool is not None else ReplicaPool(
+            metrics=self.metrics, poll_interval_s=poll_interval_s,
+            probe_timeout_s=probe_timeout_s)
+        if self.pool.metrics is None:
+            self.pool.metrics = self.metrics
+        for spec in replicas:
+            self.pool.add(spec[0], int(spec[1]), *spec[2:3])
+        self.policy = resolve_policy(policy)
+        self.max_attempts = max_attempts
+        self.max_body_bytes = max_body_bytes
+        self.upstream_timeout_s = upstream_timeout_s
+        self._ids = itertools.count()
+        self._live: Dict[str, Tuple[str, str]] = {}  # rid -> (replica_id, upstream cid)
+        self._live_lock = threading.Lock()
+        # router-side in-flight per replica: the poller's inflight reading is
+        # up to a poll interval stale, so a burst arriving between polls would
+        # all see the same "least-loaded" replica — forwards the router itself
+        # has open are folded into the score instead
+        self._forward_inflight: Dict[str, int] = {}
+        self._inflight_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------- routing
+    def _candidates(self, prompt, exclude: set, state: _RelayState) -> List[ReplicaSnapshot]:
+        """One routing decision: snapshot the pool, let the policy order it.
+        Re-run per attempt so health transitions observed mid-request (a
+        candidate marked DOWN by the poller) are honored immediately."""
+        t0 = time.perf_counter()
+        with TRACER.span("route", cat="router", trace=state.rid,
+                         attempt=state.attempts, excluded=len(exclude)) as sp:
+            snaps = self._adjusted_snapshots()
+            candidates = self.policy.select(snaps, prompt=prompt,
+                                            exclude=frozenset(exclude))
+            sp.set(candidates=[c.id for c in candidates[:4]])
+        self.metrics.route_decision.observe(time.perf_counter() - t0)
+        return candidates
+
+    def _adjusted_snapshots(self) -> List[ReplicaSnapshot]:
+        with self._inflight_lock:
+            fly = {k: v for k, v in self._forward_inflight.items() if v > 0}
+        if not fly:
+            return self.pool.snapshots()
+        return [dataclasses.replace(s, inflight=s.inflight + fly.get(s.id, 0))
+                for s in self.pool.snapshots()]
+
+    def _inflight_delta(self, replica_id: str, delta: int):
+        with self._inflight_lock:
+            self._forward_inflight[replica_id] = \
+                self._forward_inflight.get(replica_id, 0) + delta
+
+    def _finish(self, state: _RelayState, replica_id: str, outcome: str):
+        self.metrics.requests.inc(replica=replica_id, outcome=outcome)
+        # NOT named "request": that name is the engine loop's per-request
+        # timeline span, and /debug/trace consumers select by name
+        TRACER.add_span("router_request", TRACER.epoch_time(state.arrival_t),
+                        time.perf_counter() - state.arrival_t, cat="router",
+                        trace=state.rid, replica=replica_id, outcome=outcome,
+                        attempts=state.attempts, tokens=state.tokens_relayed)
+        with self._live_lock:
+            self._live.pop(state.rid, None)
+
+    def _track(self, state: _RelayState, replica_id: str, upstream_cid: str):
+        with self._live_lock:
+            self._live[state.rid] = (replica_id, upstream_cid)
+
+    # ------------------------------------------------------------- abort
+    def abort(self, rid: str) -> bool:
+        """Route a client abort to whichever replica owns the stream now."""
+        with self._live_lock:
+            owner = self._live.get(rid)
+        if owner is None:
+            return False
+        replica_id, upstream_cid = owner
+        replica = self.pool.get(replica_id)
+        if replica is None:
+            return False
+        try:
+            conn = http.client.HTTPConnection(replica.host, replica.port, timeout=10)
+            try:
+                conn.request("POST", "/v1/abort",
+                             body=json.dumps({"id": upstream_cid}).encode(),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+            return bool(body.get("cancelled"))
+        except _UPSTREAM_ERRORS + (ValueError,) as e:
+            logger.warning(f"router: abort of {rid} on {replica_id} failed: {e!r}")
+            return False
+
+    # ------------------------------------------------------------- http plumbing
+    def _make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
+        router = self
+
+        class Handler(JsonRequestHandler):
+            log_prefix = "router"
+
+            @property
+            def max_body_bytes(self):  # live read: the cap is router-tunable
+                return router.max_body_bytes
+
+            def do_GET(self):
+                try:
+                    routed = route_observability(self.path, router.registry, router.tracer)
+                    if routed is not None:
+                        self._send_raw(routed[0], routed[2], routed[1])
+                    elif self.path == "/health":
+                        status, code = router.health_status()
+                        self._send_json(code, {
+                            "status": status,
+                            "policy": getattr(router.policy, "name", type(router.policy).__name__),
+                            "replicas": [s.to_dict() for s in router.pool.snapshots()],
+                        })
+                    else:
+                        self._send_error_json(404, f"no route {self.path}", "not_found")
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.debug("router: client disconnected during GET")
+
+            def do_POST(self):
+                try:
+                    if self.path == "/v1/completions":
+                        payload = self._read_body()
+                        if payload is not None:
+                            router._handle_completion(self, payload)
+                    elif self.path == "/v1/abort":
+                        payload = self._read_body()
+                        if payload is not None:
+                            ok = router.abort(str(payload.get("id", "")))
+                            self._send_json(200, {"id": payload.get("id"), "cancelled": ok})
+                    else:
+                        self._send_error_json(404, f"no route {self.path}", "not_found")
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.debug("router: client disconnected during POST")
+                except Exception as e:
+                    logger.warning(f"router: error on {self.path}: {e!r}")
+                    try:
+                        self._send_error_json(500, str(e), "internal_error")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        httpd.daemon_threads = True
+        return httpd
+
+    def health_status(self) -> Tuple[str, int]:
+        states = {s.state for s in self.pool.snapshots()}
+        if states & {HEALTHY, RECOVERING}:
+            return "ok", 200
+        if DEGRADED in states:
+            # still routable — the breaker may lift between poll and forward
+            return "degraded", 200
+        return "unhealthy", 503
+
+    # ------------------------------------------------------------- forwarding
+    def _handle_completion(self, handler, payload: dict):
+        state = _RelayState(f"rtr-{next(self._ids)}", bool(payload.get("stream")))
+        prompt = payload.get("prompt")
+        body = json.dumps(payload).encode()
+        exclude: set = set()
+
+        while state.attempts < self.max_attempts:
+            candidates = self._candidates(prompt, exclude, state)
+            if not candidates:
+                break
+            cand = candidates[0]
+            state.attempts += 1
+            self._inflight_delta(cand.id, +1)
+            try:
+                if state.stream:
+                    outcome = self._attempt_stream(handler, state, cand, body)
+                else:
+                    outcome = self._attempt_batch(handler, state, cand, body)
+            finally:
+                self._inflight_delta(cand.id, -1)
+            if outcome == "done":
+                return
+            if outcome == "reroute":
+                # nothing relayed; 429/503/connect failure — next candidate
+                exclude.add(cand.id)
+                self.metrics.rerouted.inc()
+                TRACER.instant("reroute", cat="router", trace=state.rid, replica=cand.id)
+                continue
+            if outcome == "failover":
+                # accepted then failed pre-token: transparent resubmission
+                exclude.add(cand.id)
+                self.pool.note_forward_failure(cand.id)
+                self.metrics.failovers.inc()
+                TRACER.add_span("failover", TRACER.epoch_time(state.arrival_t),
+                                time.perf_counter() - state.arrival_t, cat="router",
+                                trace=state.rid, replica=cand.id,
+                                attempt=state.attempts)
+                continue
+            if outcome == "midstream_failed":
+                self._terminate_midstream(handler, state, cand, payload)
+                return
+            if outcome == "client_gone":
+                self._finish(state, cand.id, "client_gone")
+                return
+
+        # candidates/attempts exhausted
+        self._reject_exhausted(handler, state, payload)
+
+    def _reject_exhausted(self, handler, state: _RelayState, payload: dict):
+        retry_after = max(1, int(round(self.pool.retry_after_hint())))
+        if state.headers_sent:
+            # SSE already open: a status line now would corrupt the stream —
+            # same in-band contract as a mid-stream replica failure
+            self._terminate_midstream(handler, state, None, payload)
+            return
+        self._finish(state, "none", "rejected")
+        try:
+            handler._send_error_json(
+                503, "no replica available for this request; retry shortly",
+                "no_replica_available", headers={"Retry-After": retry_after})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # ------------------------------------------------------------- batch leg
+    def _attempt_batch(self, handler, state: _RelayState, cand: ReplicaSnapshot,
+                       body: bytes) -> str:
+        conn = http.client.HTTPConnection(cand.host, cand.port,
+                                          timeout=self.upstream_timeout_s)
+        try:
+            try:
+                _F_FORWARD.fire(replica=cand.id)
+                conn.request("POST", "/v1/completions", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                raw = resp.read()
+            except _UPSTREAM_ERRORS as e:
+                logger.warning(f"router: forward to {cand.id} failed: {e!r}")
+                self.pool.note_forward_failure(cand.id)
+                return "reroute"
+            if resp.status in (429, 503):
+                self._note_reject(cand, resp)
+                return "reroute"
+            if resp.status >= 500:
+                # replica-internal failure (api.py maps unexpected exceptions
+                # to 500): the request was accepted then failed — another
+                # replica may well serve it
+                logger.warning(f"router: {cand.id} answered {resp.status}")
+                return "failover"
+            if resp.status != 200:
+                # the replica judged the request itself bad (400/413): relay
+                # verbatim — another replica would say the same thing
+                self._finish(state, cand.id, "error")
+                self._relay_raw(handler, resp.status, raw)
+                return "done"
+            try:
+                doc = json.loads(raw)
+                finish = (doc.get("choices") or [{}])[0].get("finish_reason")
+            except (ValueError, AttributeError, IndexError):
+                doc, finish = None, None
+            if doc is None or finish == "engine_error":
+                # the replica accepted then failed it (or returned junk);
+                # nothing reached the client — resubmit elsewhere
+                return "failover"
+            doc["id"] = state.rid
+            doc["replica"] = cand.id
+            self._finish(state, cand.id, "ok")
+            self._relay_raw(handler, 200, json.dumps(doc).encode())
+            return "done"
+        finally:
+            conn.close()
+
+    def _note_reject(self, cand: ReplicaSnapshot, resp):
+        retry_after = resp.getheader("Retry-After")
+        if resp.status == 503:
+            self.pool.note_degraded(
+                cand.id, retry_after_s=float(retry_after) if retry_after else None)
+
+    def _relay_raw(self, handler, status: int, raw: bytes):
+        try:
+            handler._send_raw(status, raw, "application/json")
+        except (BrokenPipeError, ConnectionResetError):
+            logger.debug("router: client disconnected before response relay")
+
+    # ------------------------------------------------------------- stream leg
+    def _attempt_stream(self, handler, state: _RelayState, cand: ReplicaSnapshot,
+                        body: bytes) -> str:
+        conn = http.client.HTTPConnection(cand.host, cand.port,
+                                          timeout=self.upstream_timeout_s)
+        try:
+            try:
+                _F_FORWARD.fire(replica=cand.id)
+                conn.request("POST", "/v1/completions", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except _UPSTREAM_ERRORS as e:
+                logger.warning(f"router: forward to {cand.id} failed: {e!r}")
+                self.pool.note_forward_failure(cand.id)
+                return "reroute"
+            if resp.status in (429, 503):
+                self._note_reject(cand, resp)
+                resp.read()
+                return "reroute"
+            if resp.status >= 500:
+                # replica-internal failure: accepted then failed, retryable
+                logger.warning(f"router: {cand.id} answered {resp.status}")
+                resp.read()
+                return "failover"
+            if resp.status != 200:
+                raw = resp.read()
+                if state.headers_sent:
+                    return "failover"  # can't restate the status; try elsewhere
+                self._finish(state, cand.id, "error")
+                self._relay_raw(handler, resp.status, raw)
+                return "done"
+            return self._relay_sse(handler, state, cand, resp)
+        finally:
+            conn.close()
+
+    def _relay_sse(self, handler, state: _RelayState, cand: ReplicaSnapshot,
+                   resp) -> str:
+        """Relay one upstream SSE leg. Returns done / failover /
+        midstream_failed / client_gone."""
+        if not state.headers_sent:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            state.headers_sent = True
+
+        def upstream_broke() -> str:
+            if state.finished:
+                # the client already has its terminal chunk; only [DONE] was
+                # lost — close out the stream ourselves
+                try:
+                    handler.wfile.write(b"data: [DONE]\n\n")
+                    handler.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return "client_gone"
+                self._finish(state, cand.id, "ok")
+                return "done"
+            return "failover" if state.tokens_relayed == 0 else "midstream_failed"
+
+        while True:
+            try:
+                line = resp.readline()
+            except _UPSTREAM_ERRORS as e:
+                logger.warning(f"router: stream from {cand.id} broke: {e!r}")
+                return upstream_broke()
+            if not line:
+                # upstream closed without [DONE]: a crash, not a completion
+                return upstream_broke()
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                # the terminal chunk was already relayed on a previous line
+                try:
+                    handler.wfile.write(b"data: [DONE]\n\n")
+                    handler.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return "client_gone"
+                self._finish(state, cand.id, "ok" if state.finished else "error")
+                return "done"
+            try:
+                ev = json.loads(data)
+            except ValueError:
+                continue
+            if ev.get("object") == "error":
+                # upstream's in-band internal error (its headers were already
+                # sent too) — same disposition as a transport drop
+                return upstream_broke()
+            upstream_cid = ev.get("id")
+            if upstream_cid:
+                self._track(state, cand.id, str(upstream_cid))
+            choice = (ev.get("choices") or [{}])[0]
+            finish = choice.get("finish_reason")
+            if finish == "engine_error":
+                # the replica's supervisor gave up on this request: pre-token
+                # it is ours to retry elsewhere, mid-stream it becomes the
+                # router-level replica_error terminal
+                return "failover" if state.tokens_relayed == 0 else "midstream_failed"
+            ev["id"] = state.rid
+            if finish:
+                ev["replica"] = cand.id
+            try:
+                handler.wfile.write(f"data: {json.dumps(ev)}\n\n".encode())
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                logger.debug(f"router: client left stream {state.rid}; aborting upstream")
+                self._abort_upstream(state, cand)
+                return "client_gone"
+            if finish:
+                state.finished = True
+            elif "token" in choice:
+                state.tokens_relayed += 1
+
+    def _abort_upstream(self, state: _RelayState, cand: ReplicaSnapshot):
+        with self._live_lock:
+            owner = self._live.get(state.rid)
+        if owner is not None and owner[0] == cand.id:
+            self.abort(state.rid)
+
+    def _terminate_midstream(self, handler, state: _RelayState,
+                             cand: Optional[ReplicaSnapshot], payload: dict):
+        """In-band terminal for a stream whose replica died after tokens were
+        relayed (PR 3's engine_error contract, one level up): final chunk with
+        ``finish_reason="replica_error"`` + usage covering what the client
+        actually received, then [DONE] — never a mid-stream connection reset."""
+        replica_id = cand.id if cand is not None else "none"
+        if cand is not None:
+            self.pool.note_forward_failure(cand.id)
+        prompt = payload.get("prompt")
+        self._finish(state, replica_id, "replica_error")
+        try:
+            usage = {"completion_tokens": state.tokens_relayed}
+            if isinstance(prompt, (list, tuple)):
+                # for a string prompt the router cannot know the token count
+                # (no tokenizer); omit rather than emit a null the client's
+                # usage accounting would trip over
+                usage["prompt_tokens"] = len(prompt)
+                usage["total_tokens"] = len(prompt) + state.tokens_relayed
+            final = {"id": state.rid, "object": "text_completion.chunk",
+                     "replica": replica_id,
+                     "choices": [{"index": 0, "finish_reason": "replica_error"}],
+                     "usage": usage}
+            handler.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+            handler.wfile.write(b"data: [DONE]\n\n")
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # ------------------------------------------------------------- lifecycle
+    def start_in_thread(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start poller + HTTP without blocking; returns the bound port."""
+        self.pool.start()
+        self._httpd = self._make_httpd(host, port)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                             name="router-http")
+        t.start()
+        bound = self._httpd.server_address[1]
+        logger.info(f"router on {host}:{bound} fronting {len(self.pool)} replicas "
+                    f"(policy={getattr(self.policy, 'name', '?')})")
+        return bound
+
+    def run(self, host: str = "0.0.0.0", port: int = 8010):
+        self.pool.start()
+        self._httpd = self._make_httpd(host, port)
+        logger.info(f"router on {host}:{port} fronting {len(self.pool)} replicas")
+        try:
+            self._httpd.serve_forever()
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        self.pool.stop()

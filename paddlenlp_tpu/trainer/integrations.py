@@ -29,8 +29,30 @@ __all__ = [
     "TensorBoardCallback",
     "WandbCallback",
     "get_reporting_callbacks",
+    "note_checkpoint_commit",
     "register_training_metrics",
 ]
+
+# epoch time of the last committed checkpoint in this process — stamped by
+# unified_checkpoint._commit_checkpoint (on the writer thread for async
+# saves, i.e. at the actual rename, not at save *submission*)
+_LAST_COMMIT_T: Optional[float] = None
+
+
+def note_checkpoint_commit(step: Optional[int] = None, t: Optional[float] = None):
+    """Record that a checkpoint commit landed (feeds
+    ``ckpt_last_commit_age_seconds``). Stdlib-only so the checkpoint writer
+    can call it without the metrics plane being up."""
+    global _LAST_COMMIT_T
+    _LAST_COMMIT_T = time.time() if t is None else float(t)
+
+
+def _ckpt_commit_age_seconds() -> float:
+    """NaN before the first commit — a scraper alerting on this gauge must
+    distinguish 'never saved' from 'saved just now', and 0 would lie."""
+    if _LAST_COMMIT_T is None:
+        return float("nan")
+    return max(0.0, time.time() - _LAST_COMMIT_T)
 
 
 def register_training_metrics(registry: MetricsRegistry) -> dict:
@@ -61,7 +83,19 @@ def register_training_metrics(registry: MetricsRegistry) -> dict:
             "jax_jit_compile_seconds_total", "Seconds spent in XLA backend compilation"),
         "epoch": registry.gauge(
             "train_epoch", "Fractional training epoch"),
+        "ckpt_age": _ckpt_age_gauge(registry),
     }
+
+
+def _ckpt_age_gauge(registry: MetricsRegistry):
+    """Pull-mode gauge: seconds since the last committed checkpoint (the
+    async-save health signal — a growing age means the writer is wedged or
+    every save is dying before its rename)."""
+    g = registry.gauge(
+        "ckpt_last_commit_age_seconds",
+        "Seconds since the last committed checkpoint (NaN before the first commit)")
+    g.set_function(_ckpt_commit_age_seconds)
+    return g
 
 
 # jax.monitoring listeners are process-global and unremovable — register ONE

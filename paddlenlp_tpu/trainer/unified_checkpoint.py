@@ -27,6 +27,7 @@ an uncommitted dir or the newest committed one (the resume fallback).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
@@ -97,18 +98,34 @@ def _manifest_files(ckpt_dir: str) -> Dict[str, int]:
     return files
 
 
+def _sha256_file(path: str, chunk_bytes: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def _commit_checkpoint(staging: str, final: str, step: Optional[int]):
     """Manifest + fsync + rename: the all-or-nothing commit point.
 
     Everything before the ``os.replace`` can crash with zero effect on
-    ``final``; everything after it is durable (parent dir fsync'd)."""
+    ``final``; everything after it is durable (parent dir fsync'd). The
+    manifest carries per-file sha256 alongside sizes: sizes catch truncation,
+    hashes catch bit rot / partial rsync that preserves length."""
     files = _manifest_files(staging)
+    hashes = {}
     for rel in files:
         fsync_file(os.path.join(staging, rel))
+        hashes[rel] = _sha256_file(os.path.join(staging, rel))
     _F_COMMIT.fire(step=step)
+    commit_t = time.time()
     with atomic_write(os.path.join(staging, COMMIT_MANIFEST)) as f:
-        json.dump({"version": 1, "step": step, "time": time.time(), "files": files}, f,
-                  indent=2, sort_keys=True)
+        json.dump({"version": 2, "step": step, "time": commit_t, "files": files,
+                   "sha256": hashes}, f, indent=2, sort_keys=True)
     if os.path.isdir(final):
         # re-saving the same step: drop the old dir so rename can land. The
         # vulnerable window (old gone, new not yet renamed) only affects the
@@ -116,11 +133,25 @@ def _commit_checkpoint(staging: str, final: str, step: Optional[int]):
         shutil.rmtree(final)
     os.replace(staging, final)
     fsync_dir(os.path.dirname(final) or ".")
+    # stamp the training metrics plane (ckpt_last_commit_age_seconds) — lazy
+    # import, and never let an observability hiccup fail a landed commit
+    try:
+        from .integrations import note_checkpoint_commit
+
+        note_checkpoint_commit(step=step, t=commit_t)
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning(f"checkpoint commit-time stamp failed: {e!r}")
 
 
-def validate_checkpoint(ckpt_dir: str) -> Optional[str]:
-    """None when ``ckpt_dir`` holds a committed, size-consistent checkpoint;
-    otherwise a human-readable reason it must not be trusted."""
+def validate_checkpoint(ckpt_dir: str, verify_hashes: bool = True) -> Optional[str]:
+    """None when ``ckpt_dir`` holds a committed, consistent checkpoint;
+    otherwise a human-readable reason it must not be trusted.
+
+    Size validation always runs (cheap; catches truncation). Content-hash
+    validation runs when the manifest carries ``sha256`` entries and
+    ``verify_hashes`` is true (full re-read; catches bit rot). Manifests
+    written before the hash field (version 1) still validate — with a warning
+    that integrity is size-only."""
     manifest_path = os.path.join(ckpt_dir, COMMIT_MANIFEST)
     if not os.path.isfile(manifest_path):
         return f"no {COMMIT_MANIFEST} (save never committed)"
@@ -129,6 +160,13 @@ def validate_checkpoint(ckpt_dir: str) -> Optional[str]:
             manifest = json.load(f)
     except (ValueError, OSError) as e:
         return f"unreadable {COMMIT_MANIFEST}: {e}"
+    hashes = manifest.get("sha256") or {}
+    if verify_hashes and not hashes:
+        # only worth saying when the caller ASKED for hash validation —
+        # is_committed() (rotation, per dir per save) explicitly opts out
+        logger.warning(
+            f"checkpoint {ckpt_dir}: manifest has no content hashes (written by a "
+            "pre-hash trainer); validating sizes only — truncation is caught, bit rot is not")
     for rel, size in manifest.get("files", {}).items():
         p = os.path.join(ckpt_dir, rel)
         if not os.path.isfile(p):
@@ -136,11 +174,19 @@ def validate_checkpoint(ckpt_dir: str) -> Optional[str]:
         actual = os.path.getsize(p)
         if actual != size:
             return f"size mismatch for {rel}: manifest {size}, on disk {actual}"
+        if verify_hashes and rel in hashes:
+            digest = _sha256_file(p)
+            if digest != hashes[rel]:
+                return (f"content hash mismatch for {rel}: manifest sha256 "
+                        f"{hashes[rel][:12]}…, on disk {digest[:12]}… (bit rot?)")
     return None
 
 
 def is_committed(ckpt_dir: str) -> bool:
-    return os.path.isdir(ckpt_dir) and validate_checkpoint(ckpt_dir) is None
+    """Commit-status check (manifest present + sizes match). Skips the full
+    content-hash re-read: rotation calls this per dir on every save, and
+    bit-rot detection belongs to the load/resume path, not the reaper."""
+    return os.path.isdir(ckpt_dir) and validate_checkpoint(ckpt_dir, verify_hashes=False) is None
 
 
 def get_last_committed_checkpoint(folder: str) -> Optional[str]:

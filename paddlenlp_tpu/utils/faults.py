@@ -65,6 +65,13 @@ CATALOG: Dict[str, str] = {
                       "extends the DEGRADED window (503 + Retry-After) deterministically.",
     "serving.submit": "Inside Scheduler.submit after the admission slot is taken — "
                       "exercises the release-on-error path and HTTP 500 mapping.",
+    "router.forward": "Immediately before the router opens the upstream connection for "
+                      "one forwarding attempt — an injected failure is handled exactly "
+                      "like a socket error: candidate excluded, request re-routed or "
+                      "failed over to the next replica.",
+    "router.health_poll": "Inside the ReplicaPool prober before the /health scrape of "
+                          "one replica — injected failures drive the HEALTHY → DEGRADED "
+                          "→ DOWN demotion deterministically without killing a server.",
 }
 
 

@@ -22,8 +22,24 @@ class TestCheckMetrics:
         assert line is not None, f"no JSON output (rc={proc.returncode}): {proc.stderr[-2000:]}"
         report = json.loads(line)
         assert proc.returncode == 0 and report["ok"], report["problems"]
-        # the serving + training catalogs are both present
-        assert report["families"] >= 20
+        # the serving + router + training catalogs are all present
+        assert report["families"] >= 26
+
+    def test_router_series_in_catalog(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_metrics
+        finally:
+            sys.path.pop(0)
+        text = check_metrics.catalog_exposition()
+        for name in ("paddlenlp_router_requests_total",
+                     "paddlenlp_router_replica_healthy",
+                     "paddlenlp_router_failovers_total",
+                     "paddlenlp_router_rerouted_total",
+                     "paddlenlp_router_route_decision_seconds",
+                     "paddlenlp_router_health_polls_total",
+                     "ckpt_last_commit_age_seconds"):
+            assert f"# TYPE {name} " in text, f"{name} missing from lint catalog"
 
     def test_lint_flags_dirty_exposition(self, tmp_path):
         dump = tmp_path / "dump.txt"

@@ -22,9 +22,15 @@ class TestCheckFaults:
         report = json.loads(line)
         assert proc.returncode == 0 and report["ok"], report["problems"]
         # the catalog covers the checkpoint writer, engine step, supervisor
-        # rebuild, and admission — the fault surface this PR wires up
-        assert report["catalog"] >= 5
+        # rebuild, admission, and the router front tier
+        assert report["catalog"] >= 7
         assert report["call_sites"] >= report["catalog"]
+
+    def test_router_fault_points_registered(self):
+        from paddlenlp_tpu.utils.faults import CATALOG
+
+        assert "router.forward" in CATALOG
+        assert "router.health_poll" in CATALOG
 
     def test_scan_flags_unregistered_use(self, tmp_path):
         sys.path.insert(0, os.path.join(REPO, "tools"))

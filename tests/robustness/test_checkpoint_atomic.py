@@ -10,6 +10,7 @@ mesh — cannot run in tier-1; the protocol functions are mesh-free)."""
 
 import json
 import os
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -141,6 +142,65 @@ class TestCommitProtocol:
         with pytest.raises(CorruptCheckpointError):
             load_unified_checkpoint(ckpt4, model, make_state(model))
         assert get_last_committed_checkpoint(str(tmp_path)).endswith("checkpoint-2")
+
+    def test_manifest_carries_content_hashes(self, tmp_path, model):
+        ckpt = save_step(tmp_path, model, 2)
+        manifest = json.loads(open(os.path.join(ckpt, COMMIT_MANIFEST)).read())
+        assert manifest["version"] == 2
+        assert set(manifest["sha256"]) == set(manifest["files"])
+        assert all(len(h) == 64 for h in manifest["sha256"].values())
+
+    def test_bit_rot_same_size_detected_by_hash(self, tmp_path, model):
+        """Size validation cannot see a flipped byte; the sha256 pass must."""
+        save_step(tmp_path, model, 2)
+        ckpt4 = save_step(tmp_path, model, 4)
+        opt = os.path.join(ckpt4, "optimizer.safetensors")
+        size = os.path.getsize(opt)
+        with open(opt, "r+b") as f:  # flip one payload byte, length unchanged
+            f.seek(size - 1)
+            byte = f.read(1)
+            f.seek(size - 1)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        assert os.path.getsize(opt) == size
+        reason = validate_checkpoint(ckpt4)
+        assert reason is not None and "content hash mismatch" in reason
+        # sizes alone still pass — exactly the gap hashes exist to close
+        assert validate_checkpoint(ckpt4, verify_hashes=False) is None
+        with pytest.raises(CorruptCheckpointError):
+            load_unified_checkpoint(ckpt4, model, make_state(model))
+        assert get_last_committed_checkpoint(str(tmp_path)).endswith("checkpoint-2")
+
+    def test_pre_hash_manifest_still_validates_with_warning(self, tmp_path, model, monkeypatch):
+        """A version-1 manifest (sizes only) written by an older trainer keeps
+        loading — integrity is size-only and says so."""
+        ckpt = save_step(tmp_path, model, 2)
+        path = os.path.join(ckpt, COMMIT_MANIFEST)
+        manifest = json.loads(open(path).read())
+        del manifest["sha256"]
+        manifest["version"] = 1
+        with open(path, "w") as f:
+            json.dump(manifest, f)
+        # the project logger bypasses caplog (propagate=False): intercept the
+        # warning method itself
+        from paddlenlp_tpu.trainer import unified_checkpoint as uc
+
+        warnings = []
+        monkeypatch.setattr(uc.logger, "warning",
+                            lambda msg, *a, **k: warnings.append(str(msg)))
+        assert validate_checkpoint(ckpt) is None
+        assert any("no content hashes" in w for w in warnings)
+        state, _ = load_unified_checkpoint(ckpt, model, make_state(model))
+        assert int(np.asarray(state.step)) == 2
+
+    def test_commit_stamps_metrics_plane(self, tmp_path, model):
+        """The commit path must feed ckpt_last_commit_age_seconds."""
+        from paddlenlp_tpu.trainer import integrations
+
+        before = time.time()
+        save_step(tmp_path, model, 2)
+        assert integrations._LAST_COMMIT_T is not None
+        assert integrations._LAST_COMMIT_T >= before
+        assert integrations._ckpt_commit_age_seconds() >= 0.0
 
     def test_legacy_checkpoint_without_manifest_still_loads(self, tmp_path, model):
         ckpt = save_step(tmp_path, model, 2)

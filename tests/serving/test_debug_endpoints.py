@@ -80,6 +80,61 @@ class TestDebugTrace:
         assert len(json.loads(after)["traceEvents"]) > len(json.loads(before)["traceEvents"])
 
 
+class TestDebugTraceFilter:
+    """`/debug/trace?trace=req-N` must dump ONE request's timeline without
+    shipping the whole ring, and `since_ts` must work as an incremental
+    scrape cursor."""
+
+    def test_trace_filter_isolates_one_request(self, server_port):
+        server, port = server_port
+        _complete(port)
+        _complete(port)
+        status, body = _get(port, "/debug/requests")
+        trace_id = json.loads(body)["recent"][-1]["trace"]
+        status, body = _get(port, f"/debug/trace?trace={trace_id}")
+        assert status == 200
+        events = json.loads(body)["traceEvents"]
+        data_events = [e for e in events if e["ph"] != "M"]
+        assert data_events, "filtered dump is empty"
+        # every non-metadata event belongs to the requested trace ...
+        assert all(e.get("args", {}).get("trace") == trace_id for e in data_events)
+        # ... and the full request phase timeline is present
+        assert {"queue", "prefill", "decode", "request"} <= {e["name"] for e in data_events}
+        # an unknown trace id filters down to nothing (not an error)
+        status, body = _get(port, "/debug/trace?trace=req-does-not-exist")
+        assert status == 200
+        assert [e for e in json.loads(body)["traceEvents"] if e["ph"] != "M"] == []
+
+    def test_spans_endpoint_accepts_same_filter(self, server_port):
+        server, port = server_port
+        _complete(port)
+        status, body = _get(port, "/debug/requests")
+        trace_id = json.loads(body)["recent"][-1]["trace"]
+        status, body = _get(port, f"/debug/spans?trace={trace_id}")
+        assert status == 200
+        spans = [json.loads(line) for line in body.decode().splitlines() if line]
+        assert spans and all(s.get("trace") == trace_id for s in spans)
+
+    def test_since_ts_cursor(self, server_port):
+        server, port = server_port
+        _complete(port)
+        cursor = server.tracer.now()
+        # nothing recorded after the cursor yet
+        status, body = _get(port, f"/debug/spans?since_ts={cursor}")
+        old = [json.loads(line) for line in body.decode().splitlines() if line]
+        _complete(port)
+        status, body = _get(port, f"/debug/spans?since_ts={cursor}")
+        new = [json.loads(line) for line in body.decode().splitlines() if line]
+        assert len(new) > len(old)
+        assert all(s["ts"] >= cursor for s in new)
+
+    def test_bad_since_ts_is_a_clean_400(self, server_port):
+        server, port = server_port
+        status, body = _get(port, "/debug/trace?since_ts=banana")
+        assert status == 400
+        assert "since_ts" in json.loads(body)["error"]
+
+
 class TestDebugRequests:
     def test_finished_request_in_recent(self, server_port):
         server, port = server_port

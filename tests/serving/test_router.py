@@ -1,0 +1,689 @@
+"""Router front tier unit tests (ISSUE 4): routing-policy ordering,
+prefix-affinity determinism, the health-poller state machine, and the proxy's
+reroute/failover behaviors against scriptable stub replicas — no engine, no
+jax compute, so the whole file runs in milliseconds."""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddlenlp_tpu.serving.metrics import MetricsRegistry
+from paddlenlp_tpu.serving.router import (
+    DEGRADED,
+    DOWN,
+    HEALTHY,
+    RECOVERING,
+    HashRing,
+    LeastLoadedPolicy,
+    PrefixAffinityPolicy,
+    ProbeResult,
+    ReplicaPool,
+    ReplicaSnapshot,
+    RouterMetrics,
+    RouterServer,
+    load_score,
+    resolve_policy,
+)
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def snap(rid, state=HEALTHY, inflight=0, queue=0, kv=0.0):
+    return ReplicaSnapshot(id=rid, host="127.0.0.1", port=0, state=state,
+                           inflight=inflight, queue_depth=queue, kv_utilization=kv,
+                           retry_after_s=None, consecutive_failures=0, last_poll_t=None)
+
+
+# --------------------------------------------------------------------- policy
+class TestLeastLoaded:
+    def test_load_score_components(self):
+        assert load_score(snap("a", inflight=2, queue=3, kv=0.5)) == 5.5
+
+    def test_orders_by_effective_load(self):
+        snaps = [snap("a", inflight=4), snap("b", inflight=1, queue=1),
+                 snap("c", kv=0.9)]
+        order = [s.id for s in LeastLoadedPolicy().select(snaps)]
+        assert order == ["c", "b", "a"]  # 0.9 < 2 < 4
+
+    def test_down_excluded_degraded_last(self):
+        snaps = [snap("a", state=DOWN), snap("b", state=DEGRADED),
+                 snap("c", inflight=50), snap("d", state=RECOVERING)]
+        order = [s.id for s in LeastLoadedPolicy().select(snaps)]
+        assert order == ["c", "d", "b"]  # loaded-healthy > recovering > degraded; DOWN gone
+
+    def test_exclude_set(self):
+        snaps = [snap("a"), snap("b")]
+        order = [s.id for s in LeastLoadedPolicy().select(snaps, exclude=frozenset({"a"}))]
+        assert order == ["b"]
+
+    def test_deterministic_tiebreak(self):
+        snaps = [snap("b"), snap("a")]
+        assert [s.id for s in LeastLoadedPolicy().select(snaps)] == ["a", "b"]
+
+
+class TestPrefixAffinity:
+    IDS = ["r0", "r1", "r2", "r3"]
+
+    def snaps(self, **states):
+        return [snap(i, state=states.get(i, HEALTHY)) for i in self.IDS]
+
+    def test_same_prefix_same_replica_across_instances(self):
+        p1 = PrefixAffinityPolicy(prefix_tokens=4)
+        p2 = PrefixAffinityPolicy(prefix_tokens=4)
+        prompt = [5, 6, 7, 8, 99, 100]
+        a = [s.id for s in p1.select(self.snaps(), prompt=prompt)]
+        b = [s.id for s in p2.select(self.snaps(), prompt=prompt)]
+        assert a == b  # no hidden state: a fresh policy agrees
+
+    def test_prefix_key_ignores_tail(self):
+        p = PrefixAffinityPolicy(prefix_tokens=3)
+        base = [5, 6, 7]
+        pin = p.select(self.snaps(), prompt=base + [1000])[0].id
+        for tail in ([1], [42, 43], list(range(50))):
+            assert p.select(self.snaps(), prompt=base + tail)[0].id == pin
+
+    def test_pin_ignores_load(self):
+        p = PrefixAffinityPolicy(prefix_tokens=3)
+        prompt = [5, 6, 7, 8]
+        pin = p.select(self.snaps(), prompt=prompt)[0].id
+        loaded = [snap(i, inflight=30 if i == pin else 0) for i in self.IDS]
+        assert p.select(loaded, prompt=prompt)[0].id == pin
+
+    def test_distribution_covers_all_replicas(self):
+        p = PrefixAffinityPolicy(prefix_tokens=2)
+        hits = {i: 0 for i in self.IDS}
+        for k in range(200):
+            hits[p.select(self.snaps(), prompt=[k, k + 1, 7])[0].id] += 1
+        assert all(v > 0 for v in hits.values()), hits
+
+    def test_down_pin_falls_to_agreed_successor(self):
+        p = PrefixAffinityPolicy(prefix_tokens=3)
+        prompt = [5, 6, 7, 8]
+        order = [s.id for s in p.select(self.snaps(), prompt=prompt)]
+        pin, successor = order[0], order[1]
+        # pinned replica DOWN: everyone agrees on the same next ring member
+        failed = p.select(self.snaps(**{pin: DOWN}), prompt=prompt)
+        assert failed[0].id == successor
+        # DEGRADED pin yields too (state rank outranks ring order) ...
+        degraded = p.select(self.snaps(**{pin: DEGRADED}), prompt=prompt)
+        assert degraded[0].id == successor
+        # ... but stays a candidate of last resort
+        assert pin in [s.id for s in degraded]
+
+    def test_membership_change_moves_few_prefixes(self):
+        """Consistent hashing: adding a 5th replica should re-pin roughly 1/5
+        of the prefix space, not most of it."""
+        four = self.snaps()
+        five = four + [snap("r4")]
+        p = PrefixAffinityPolicy(prefix_tokens=2)
+        moved = sum(
+            1 for k in range(300)
+            if p.select(four, prompt=[k, 3, 9])[0].id != p.select(five, prompt=[k, 3, 9])[0].id)
+        assert moved / 300 < 0.5, f"{moved}/300 prefixes re-pinned"
+
+    def test_string_prompt_and_fallback(self):
+        p = PrefixAffinityPolicy(prefix_tokens=4)
+        a = p.select(self.snaps(), prompt="You are a helpful assistant. Task A")[0].id
+        b = p.select(self.snaps(), prompt="You are a helpful assistant. Task B")[0].id
+        assert a == b  # shared 16-char prefix window pins together
+        # no prompt at all: least-loaded fallback
+        loaded = [snap("r0", inflight=9), snap("r1")]
+        assert p.select(loaded, prompt=None)[0].id == "r1"
+
+    def test_ring_walk_is_total(self):
+        ring = HashRing(self.IDS, vnodes=16)
+        order = ring.ordered("some-prefix")
+        assert sorted(order) == sorted(self.IDS)
+
+    def test_resolve_policy(self):
+        assert isinstance(resolve_policy("least_loaded"), LeastLoadedPolicy)
+        assert isinstance(resolve_policy("prefix_affinity"), PrefixAffinityPolicy)
+        with pytest.raises(ValueError):
+            resolve_policy("round_robin")
+
+
+# --------------------------------------------------------------------- pool
+class TestPoolStateMachine:
+    def make_pool(self, results, **kw):
+        """Pool over one replica whose probes are scripted by ``results``
+        (a list of ProbeResult | Exception)."""
+        pool = ReplicaPool(metrics=RouterMetrics(MetricsRegistry()),
+                           down_after=kw.pop("down_after", 2),
+                           recovery_polls=kw.pop("recovery_polls", 2), **kw)
+        replica = pool.add("127.0.0.1", 1, "r0")
+        seq = iter(results)
+
+        def fake_probe(_replica):
+            item = next(seq)
+            if isinstance(item, Exception):
+                raise item
+            return item
+
+        pool._probe = fake_probe
+        return pool, replica
+
+    OK = ProbeResult(reachable=True, status="ok", inflight=3, queue_depth=2,
+                     kv_utilization=0.5)
+    SHED = ProbeResult(reachable=True, status="degraded", retry_after_s=4.0)
+
+    def test_healthy_updates_load_fields(self):
+        pool, r = self.make_pool([self.OK])
+        pool.poll_once()
+        s = pool.snapshots()[0]
+        assert s.state == HEALTHY and s.inflight == 3 and s.queue_depth == 2
+        assert s.kv_utilization == 0.5
+        assert pool.metrics.replica_healthy.value(replica="r0") == 1.0
+
+    def test_degraded_on_503(self):
+        pool, r = self.make_pool([self.OK, self.SHED])
+        pool.poll_once()
+        pool.poll_once()
+        s = pool.snapshots()[0]
+        assert s.state == DEGRADED and s.retry_after_s == 4.0
+        assert pool.metrics.replica_healthy.value(replica="r0") == 0.0
+        assert pool.retry_after_hint() == 4.0
+
+    def test_unreachable_degrades_then_down(self):
+        pool, r = self.make_pool([self.OK, ConnectionRefusedError("boom"),
+                                  ConnectionRefusedError("boom")])
+        pool.poll_once()
+        pool.poll_once()
+        assert pool.snapshots()[0].state == DEGRADED  # first failure: benefit of the doubt
+        pool.poll_once()
+        assert pool.snapshots()[0].state == DOWN  # down_after=2 consecutive
+
+    def test_recovery_is_probational(self):
+        pool, r = self.make_pool([ConnectionRefusedError(), ConnectionRefusedError(),
+                                  self.OK, self.OK])
+        pool.poll_once(), pool.poll_once()
+        assert pool.snapshots()[0].state == DOWN
+        pool.poll_once()
+        assert pool.snapshots()[0].state == RECOVERING  # first clean probe
+        assert pool.metrics.replica_healthy.value(replica="r0") == 0.0
+        pool.poll_once()
+        assert pool.snapshots()[0].state == HEALTHY  # recovery_polls=2 reached
+        assert pool.metrics.replica_healthy.value(replica="r0") == 1.0
+
+    def test_relapse_during_recovery_resets_streak(self):
+        pool, r = self.make_pool([ConnectionRefusedError(), ConnectionRefusedError(),
+                                  self.OK, ConnectionRefusedError(), self.OK, self.OK])
+        for _ in range(3):
+            pool.poll_once()
+        assert pool.snapshots()[0].state == RECOVERING
+        pool.poll_once()  # relapse
+        assert pool.snapshots()[0].state == DEGRADED
+        pool.poll_once()
+        assert pool.snapshots()[0].state == HEALTHY  # was never DOWN again: direct promote
+
+    def test_forward_feedback_demotes_immediately(self):
+        pool, r = self.make_pool([])
+        assert pool.snapshots()[0].state == HEALTHY
+        pool.note_forward_failure("r0")
+        assert pool.snapshots()[0].state == DEGRADED
+        pool.note_degraded("r0", retry_after_s=2.5)
+        s = pool.snapshots()[0]
+        assert s.state == DEGRADED and s.retry_after_s == 2.5
+
+    def test_health_poll_fault_point(self):
+        """router.health_poll armed: the probe raises like a transport error
+        and drives the demotion deterministically."""
+        pool = ReplicaPool(metrics=RouterMetrics(MetricsRegistry()), down_after=1)
+        pool.add("127.0.0.1", 1, "r0")  # nothing listens; probe would fail anyway
+        FAULTS.arm("router.health_poll", nth=1)
+        pool.poll_once()
+        assert FAULTS.fired("router.health_poll") == 1
+        assert pool.snapshots()[0].state == DOWN  # down_after=1
+
+
+# --------------------------------------------------------------------- proxy
+class StubReplica:
+    """Scriptable replica speaking just enough of the ServingServer surface:
+    /health, /metrics, /v1/completions (SSE + batch), /v1/abort.
+
+    ``mode`` picks the completion script:
+      ok               stream/batch the configured tokens, finish "length"
+      reject429        429 window-full
+      reject503        503 + Retry-After (engine recovering)
+      engine_error_pre SSE terminal engine_error before any token
+      engine_error_mid 2 tokens, then terminal engine_error
+      die_midstream    2 tokens, then drop the connection (no [DONE])
+    """
+
+    def __init__(self, mode="ok", tokens=(7, 8, 9), health="ok", kv=0.25,
+                 token_delay_s=0.0):
+        self.mode = mode
+        self.tokens = list(tokens)
+        self.health = health
+        self.kv = kv
+        self.token_delay_s = token_delay_s
+        self.requests = []  # /v1/completions payloads received
+        self.aborts = []  # /v1/abort payloads received
+        self._ids = iter(range(10_000))
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    code = 200 if stub.health == "ok" else 503
+                    self._json(code, {"status": stub.health,
+                                      "scheduler": {"inflight": len(stub.requests)},
+                                      "engine": {"queue_depth": 0}})
+                elif self.path == "/metrics":
+                    text = ("# HELP paddlenlp_serving_kv_utilization x\n"
+                            "# TYPE paddlenlp_serving_kv_utilization gauge\n"
+                            f"paddlenlp_serving_kv_utilization {stub.kv}\n")
+                    body = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": "no route"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/v1/abort":
+                    stub.aborts.append(payload)
+                    self._json(200, {"id": payload.get("id"), "cancelled": True})
+                    return
+                stub.requests.append(payload)
+                if "prompt" not in payload:  # mirror the real server's validation
+                    self._json(400, {"error": {"message": "missing required field 'prompt'",
+                                               "type": "invalid_request"}})
+                    return
+                cid = f"cmpl-{next(stub._ids)}"
+                if stub.mode == "reject429":
+                    self._json(429, {"error": {"message": "full", "type": "rate_limit_exceeded"}})
+                    return
+                if stub.mode == "reject503":
+                    self._json(503, {"error": {"message": "recovering",
+                                               "type": "engine_recovering"}},
+                               headers={"Retry-After": 7})
+                    return
+                if stub.mode == "fail500":
+                    self._json(500, {"error": {"message": "boom", "type": "internal_error"}})
+                    return
+                if payload.get("stream"):
+                    self._stream(cid, payload)
+                else:
+                    self._batch(cid, payload)
+
+            def _batch(self, cid, payload):
+                if stub.mode in ("engine_error_pre", "engine_error_mid"):
+                    self._json(200, {"id": cid, "object": "text_completion",
+                                     "choices": [{"index": 0, "finish_reason": "engine_error",
+                                                  "token_ids": []}]})
+                    return
+                toks = stub.tokens[: int(payload.get("max_tokens", 16))]
+                self._json(200, {"id": cid, "object": "text_completion",
+                                 "choices": [{"index": 0, "finish_reason": "length",
+                                              "token_ids": toks}],
+                                 "usage": {"prompt_tokens": len(payload.get("prompt", [])),
+                                           "completion_tokens": len(toks)}})
+
+            def _stream(self, cid, payload):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def chunk(obj):
+                    self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
+                    self.wfile.flush()
+
+                def token_chunks(toks):
+                    for t in toks:
+                        if stub.token_delay_s:
+                            time.sleep(stub.token_delay_s)
+                        chunk({"id": cid, "object": "text_completion.chunk",
+                               "choices": [{"index": 0, "token": t, "finish_reason": None}]})
+
+                if stub.mode == "engine_error_pre":
+                    chunk({"id": cid, "object": "text_completion.chunk",
+                           "choices": [{"index": 0, "finish_reason": "engine_error"}]})
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    return
+                if stub.mode == "engine_error_mid":
+                    token_chunks(stub.tokens[:2])
+                    chunk({"id": cid, "object": "text_completion.chunk",
+                           "choices": [{"index": 0, "finish_reason": "engine_error"}]})
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    return
+                if stub.mode == "die_midstream":
+                    token_chunks(stub.tokens[:2])
+                    self.wfile.flush()
+                    self.connection.close()  # crash, not completion
+                    return
+                toks = stub.tokens[: int(payload.get("max_tokens", 16))]
+                token_chunks(toks)
+                chunk({"id": cid, "object": "text_completion.chunk",
+                       "choices": [{"index": 0, "finish_reason": "length"}],
+                       "usage": {"prompt_tokens": len(payload.get("prompt", [])),
+                                 "completion_tokens": len(toks)}})
+                self.wfile.write(b"data: [DONE]\n\n")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self.port = self._httpd.server_address[1]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def post_completion(port, payload, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        headers = dict(resp.getheaders())
+        if payload.get("stream"):
+            toks, finish, ids = [], None, set()
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                data = line[len(b"data: "):]
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                ids.add(ev.get("id"))
+                c = ev["choices"][0]
+                if c.get("finish_reason"):
+                    finish = c["finish_reason"]
+                    final = ev
+                elif "token" in c:
+                    toks.append(c["token"])
+            return resp.status, {"tokens": toks, "finish": finish, "ids": ids,
+                                 "final": locals().get("final")}, headers
+        return resp.status, json.loads(resp.read() or b"{}"), headers
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def stub_router():
+    """Factory: build a router over stub replicas; tears everything down."""
+    created = []
+
+    def build(stubs, **router_kw):
+        registry = MetricsRegistry()
+        router = RouterServer(
+            [("127.0.0.1", s.port, rid) for rid, s in stubs],
+            registry=registry, poll_interval_s=30.0,  # later polls driven manually
+            **router_kw)
+        port = router.start_in_thread()
+        created.append((router, [s for _, s in stubs]))
+        # wait out the poller's startup sweep: a request racing a half-done
+        # sweep would see asymmetric kv_utilization and flip the tiebreak
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and any(s.last_poll_t is None for s in router.pool.snapshots())):
+            time.sleep(0.005)
+        return router, port, registry
+
+    yield build
+    for router, stubs in created:
+        router.shutdown()
+        for s in stubs:
+            s.stop()
+
+
+class TestProxy:
+    def test_reroute_on_429(self, stub_router):
+        a, b = StubReplica(mode="reject429"), StubReplica()
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(port, {"prompt": [1, 2, 3], "max_tokens": 3})
+        assert status == 200
+        assert body["replica"] == "b" and body["choices"][0]["token_ids"] == [7, 8, 9]
+        assert body["id"].startswith("rtr-")
+        assert reg.get("paddlenlp_router_rerouted_total").value() == 1
+        assert reg.get("paddlenlp_router_requests_total").value(replica="b", outcome="ok") == 1
+        assert len(a.requests) == 1 and len(b.requests) == 1
+
+    def test_reroute_on_503_marks_degraded(self, stub_router):
+        a, b = StubReplica(mode="reject503"), StubReplica()
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(port, {"prompt": [1, 2, 3], "max_tokens": 3})
+        assert status == 200 and body["replica"] == "b"
+        s = {x.id: x for x in router.pool.snapshots()}["a"]
+        assert s.state == DEGRADED and s.retry_after_s == 7.0
+
+    def test_pre_token_failover_sse(self, stub_router):
+        """A replica that accepts the stream then dies before any token: the
+        client transparently gets the full stream from the next replica, under
+        one router id."""
+        a, b = StubReplica(mode="engine_error_pre"), StubReplica(tokens=(7, 8, 9))
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(
+            port, {"prompt": [1, 2, 3], "max_tokens": 3, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [7, 8, 9] and body["finish"] == "length"
+        assert len(body["ids"]) == 1 and body["ids"].pop().startswith("rtr-")
+        assert reg.get("paddlenlp_router_failovers_total").value() == 1
+        assert reg.get("paddlenlp_router_requests_total").value(replica="b", outcome="ok") == 1
+        # the failed replica is immediately demoted, not just excluded
+        assert {x.id: x for x in router.pool.snapshots()}["a"].state != HEALTHY
+
+    def test_pre_token_failover_batch(self, stub_router):
+        a, b = StubReplica(mode="engine_error_mid"), StubReplica(tokens=(4, 5))
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(port, {"prompt": [9], "max_tokens": 2})
+        assert status == 200 and body["replica"] == "b"
+        assert body["choices"][0]["token_ids"] == [4, 5]
+        assert reg.get("paddlenlp_router_failovers_total").value() == 1
+
+    def test_midstream_death_finishes_in_band(self, stub_router):
+        """Tokens already relayed: no regeneration — the stream ends with
+        finish_reason="replica_error" + usage, never a connection reset."""
+        a = StubReplica(mode="die_midstream", tokens=(7, 8, 9, 10))
+        b = StubReplica()
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(
+            port, {"prompt": [1, 2], "max_tokens": 4, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [7, 8] and body["finish"] == "replica_error"
+        assert body["final"]["usage"]["completion_tokens"] == 2
+        assert body["final"]["usage"]["prompt_tokens"] == 2
+        assert reg.get("paddlenlp_router_requests_total").value(
+            replica="a", outcome="replica_error") == 1
+        assert reg.get("paddlenlp_router_failovers_total").value() == 0
+        assert len(b.requests) == 0  # never resubmitted
+
+    def test_midstream_engine_error_becomes_replica_error(self, stub_router):
+        a = StubReplica(mode="engine_error_mid", tokens=(7, 8, 9))
+        router, port, reg = stub_router([("a", a)])
+        status, body, _ = post_completion(
+            port, {"prompt": [1], "max_tokens": 3, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [7, 8] and body["finish"] == "replica_error"
+
+    def test_router_forward_fault_point(self, stub_router):
+        """router.forward armed: the first attempt fails like a socket error
+        and the request lands on the next candidate."""
+        a, b = StubReplica(), StubReplica(tokens=(1, 2))
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        FAULTS.arm("router.forward", nth=1)
+        status, body, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200 and body["replica"] == "b"
+        assert FAULTS.fired("router.forward") == 1
+        assert reg.get("paddlenlp_router_rerouted_total").value() == 1
+        assert len(a.requests) == 0  # fault fired before the connect
+
+    def test_replica_500_fails_over_not_relayed(self, stub_router):
+        """A replica-internal 500 (api.py's unexpected-exception mapping) is a
+        replica failure, not a verdict on the request — the router must try
+        the next candidate instead of relaying the 5xx."""
+        a, b = StubReplica(mode="fail500"), StubReplica(tokens=(4, 5))
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200 and body["replica"] == "b"
+        assert reg.get("paddlenlp_router_failovers_total").value() == 1
+        # same on the SSE leg
+        status, body, _ = post_completion(
+            port, {"prompt": [2], "max_tokens": 2, "stream": True})
+        assert status == 200 and body["tokens"] == [4, 5] and body["finish"] == "length"
+
+    def test_forward_feedback_not_counted_as_probes(self, stub_router):
+        """note_forward_failure must transition state without inventing
+        health-poller bookkeeping (health_polls_total, last_poll_t)."""
+        a = StubReplica()
+        router, port, reg = stub_router([("a", a)])
+        polls_before = reg.get("paddlenlp_router_health_polls_total").value(
+            replica="a", outcome="error")
+        last_poll_before = router.pool.get("a").last_poll_t
+        router.pool.note_forward_failure("a")
+        assert {x.id: x for x in router.pool.snapshots()}["a"].state == DEGRADED
+        assert reg.get("paddlenlp_router_health_polls_total").value(
+            replica="a", outcome="error") == polls_before
+        assert router.pool.get("a").last_poll_t == last_poll_before
+
+    def test_all_replicas_unavailable_clean_503(self, stub_router):
+        a = StubReplica(mode="reject503")
+        router, port, reg = stub_router([("a", a)])
+        status, body, headers = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 503
+        assert body["error"]["type"] == "no_replica_available"
+        assert int(headers.get("Retry-After", 0)) >= 1
+        assert reg.get("paddlenlp_router_requests_total").value(
+            replica="none", outcome="rejected") == 1
+
+    def test_client_error_relayed_not_retried(self, stub_router):
+        """A 400 from the replica is the request's fault: relay it verbatim,
+        do not burn failover attempts on other replicas."""
+        a, b = StubReplica(), StubReplica()
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, body, _ = post_completion(port, {"max_tokens": 2})  # no prompt
+        assert status == 400
+        assert body["error"]["type"] == "invalid_request"
+        assert len(a.requests) + len(b.requests) == 1
+
+    def test_abort_routes_to_owning_replica(self, stub_router):
+        # slow stream: the live-id window must stay open while the test finds it
+        a = StubReplica(tokens=tuple(range(40)), token_delay_s=0.02)
+        router, port, reg = stub_router([("a", a)])
+        got = {}
+
+        def worker():
+            got["resp"] = post_completion(
+                port, {"prompt": [1], "max_tokens": 40, "stream": True})
+
+        t = threading.Thread(target=worker)
+        t.start()
+        # find the live router id, then abort through the router
+        deadline = time.time() + 10
+        rid = None
+        while time.time() < deadline and rid is None:
+            with router._live_lock:
+                rid = next(iter(router._live), None)
+            time.sleep(0.002)
+        assert rid is not None
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/v1/abort", body=json.dumps({"id": rid}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        assert out["cancelled"] is True
+        assert len(a.aborts) == 1
+        assert a.aborts[0]["id"].startswith("cmpl-")  # upstream id, not rtr-
+        t.join(timeout=30)
+
+    def test_negative_content_length_is_a_clean_400(self, stub_router):
+        """Content-Length: -1 must not reach rfile.read(-1) (which would pin
+        the handler thread until the client hangs up)."""
+        a = StubReplica()
+        router, port, reg = stub_router([("a", a)])
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.putrequest("POST", "/v1/completions")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 400
+        assert "Content-Length" in body["error"]["message"]
+        assert len(a.requests) == 0
+
+    def test_down_replica_does_not_pin_retry_after_hint(self, stub_router):
+        """A dead replica's stale Retry-After must not inflate the hint the
+        router hands out after every candidate is exhausted."""
+        a = StubReplica()
+        router, port, reg = stub_router([("a", a)])
+        router.pool.note_degraded("a", retry_after_s=120.0)
+        assert router.pool.retry_after_hint() == 120.0
+        for _ in range(router.pool.down_after):
+            router.pool.note_forward_failure("a")
+        assert {x.id: x for x in router.pool.snapshots()}["a"].state == DOWN
+        assert router.pool.retry_after_hint() == 1.0  # floor, not the stale 120
+
+    def test_router_span_names_do_not_collide_with_engine(self, stub_router):
+        """The engine loop owns the span name "request" (with queue/prefill/
+        decode phases under one trace); the router's terminal span must use a
+        distinct name or /debug/trace consumers pick the wrong timeline."""
+        from paddlenlp_tpu.observability.tracer import TRACER
+
+        a = StubReplica()
+        router, port, reg = stub_router([("a", a)])
+        status, body, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200
+        rid = body["id"]
+        names = {s.name for s in TRACER.snapshot(trace=rid)}
+        assert "router_request" in names and "route" in names
+        assert "request" not in names
+
+    def test_health_and_metrics_planes(self, stub_router):
+        a = StubReplica(kv=0.75)
+        router, port, reg = stub_router([("a", a)])
+        router.pool.poll_once()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/health")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and health["status"] == "ok"
+        assert health["replicas"][0]["state"] == HEALTHY
+        assert health["replicas"][0]["kv_utilization"] == 0.75
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        assert 'paddlenlp_router_replica_healthy{replica="a"} 1' in text
+        from paddlenlp_tpu.observability import lint_exposition
+
+        assert lint_exposition(text) == []

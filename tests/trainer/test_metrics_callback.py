@@ -10,6 +10,7 @@ device mesh, so the test runs on any jax version/backend the container has."""
 
 import http.client
 import json
+import math
 import time
 
 import jax
@@ -92,6 +93,34 @@ class TestMetricsCallback:
         fams = parse_prometheus_text(text)
         assert fams["train_step_seconds"].value("train_step_seconds_count") == MAX_STEPS
         assert fams["train_tokens_per_second"].value() > 0
+
+
+class TestCheckpointAgeGauge:
+    """ckpt_last_commit_age_seconds: the async-save health signal."""
+
+    def test_nan_before_first_commit(self, monkeypatch):
+        from paddlenlp_tpu.trainer import integrations
+
+        monkeypatch.setattr(integrations, "_LAST_COMMIT_T", None)
+        registry = MetricsRegistry()
+        integrations.register_training_metrics(registry)
+        gauge = registry.get("ckpt_last_commit_age_seconds")
+        assert math.isnan(gauge.value())
+        # NaN renders as the literal Prometheus NaN, and the exposition stays lint-clean
+        text = registry.expose()
+        assert "ckpt_last_commit_age_seconds NaN" in text
+        assert lint_exposition(text) == []
+
+    def test_age_tracks_last_commit(self, monkeypatch):
+        from paddlenlp_tpu.trainer import integrations
+
+        registry = MetricsRegistry()
+        integrations.register_training_metrics(registry)
+        monkeypatch.setattr(integrations, "_LAST_COMMIT_T", time.time() - 7.0)
+        age = registry.get("ckpt_last_commit_age_seconds").value()
+        assert 6.5 <= age <= 30.0
+        integrations.note_checkpoint_commit(step=3)
+        assert registry.get("ckpt_last_commit_age_seconds").value() < 6.5
 
 
 class TestHttpExporter:

@@ -11,6 +11,9 @@ Usage::
 
     python tools/bench_serve.py                  # 16 requests, 8-way concurrency
     python tools/bench_serve.py --requests 32 --concurrency 16 --max-tokens 24
+    python tools/bench_serve.py --replicas 2     # router front tier over 2 CPU
+                                                 # replicas; the JSON line adds
+                                                 # request_share/failovers/rerouted
 """
 
 from __future__ import annotations
@@ -62,17 +65,34 @@ def run() -> None:
     n_requests = _arg("--requests", 16)
     concurrency = _arg("--concurrency", 8)
     max_tokens = _arg("--max-tokens", 16)
+    n_replicas = _arg("--replicas", 1)
 
     cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
                       num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
                       eos_token_id=None, pad_token_id=0, use_scan_layers=True)
     model = LlamaForCausalLM.from_config(cfg, seed=0)
-    engine = InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
-                             max_blocks_per_seq=32, decode_steps=4)
+
+    def make_engine():
+        # one shared model (read-only params), one engine per replica
+        return InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
+                               max_blocks_per_seq=32, decode_steps=4)
+
     registry = MetricsRegistry()
-    server = ServingServer(engine, registry=registry,
-                           scheduler_config=SchedulerConfig(max_inflight=2 * n_requests))
-    port = server.start_in_thread()
+    fleet = server = None
+    if n_replicas > 1:
+        # multi-replica mode: the timed window goes through the router front
+        # tier, so the measured path includes routing + SSE passthrough
+        from paddlenlp_tpu.serving.router import launch_fleet
+
+        fleet = launch_fleet(
+            n_replicas, make_engine, policy="least_loaded", router_registry=registry,
+            poll_interval_s=0.2,
+            scheduler_config=SchedulerConfig(max_inflight=2 * n_requests))
+        port = fleet.router_port
+    else:
+        server = ServingServer(make_engine(), registry=registry,
+                               scheduler_config=SchedulerConfig(max_inflight=2 * n_requests))
+        port = server.start_in_thread()
 
     # warmup: one request pays the jit compiles so the timed window measures
     # steady-state serving, not tracing
@@ -138,7 +158,9 @@ def run() -> None:
     dt = time.time() - t0
 
     # scrape /metrics over HTTP (the same path a real Prometheus takes) BEFORE
-    # shutdown, while the end-of-run engine state is still live
+    # shutdown, while the end-of-run engine state is still live. In router
+    # mode the HTTP plane serves the paddlenlp_router_* series; the per-replica
+    # serving planes are read straight from the in-process registries.
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
     conn.request("GET", "/metrics")
     resp = conn.getresponse()
@@ -146,7 +168,12 @@ def run() -> None:
     conn.close()
     if resp.status != 200:
         _fail(f"/metrics scrape failed: HTTP {resp.status}")
-    server.shutdown(drain_timeout_s=10)
+    replica_expositions = [r.expose() for r in fleet.registries()] if fleet is not None \
+        else [scraped]
+    if fleet is not None:
+        fleet.shutdown(drain_timeout_s=10)
+    else:
+        server.shutdown(drain_timeout_s=10)
 
     if errors:
         _fail(f"{len(errors)}/{n_requests} requests failed: {errors[:3]}")
@@ -155,30 +182,52 @@ def run() -> None:
 
     from paddlenlp_tpu.observability import histogram_quantile, parse_prometheus_text
 
-    fams = parse_prometheus_text(scraped)
-    scalar = lambda name: (fams[name].value() or 0.0) if name in fams else 0.0
-    inter_token = fams.get("paddlenlp_serving_inter_token_seconds")
-    server_ttft = fams.get("paddlenlp_serving_ttft_seconds")
-    print(json.dumps({
+    replica_fams = [parse_prometheus_text(t) for t in replica_expositions]
+
+    def scalar_sum(name):
+        return sum((f[name].value() or 0.0) for f in replica_fams if name in f)
+
+    def quantile_max(name, q):
+        # worst replica's quantile: merging bucket vectors across registries
+        # buys nothing a tail-latency readout cares about
+        vals = [histogram_quantile(f[name], q) for f in replica_fams if name in f]
+        return max(vals) if vals else 0.0
+
+    record = {
         "metric": METRIC,
         "value": round(n_requests / dt, 3),
         "unit": UNIT,
         "n_requests": n_requests,
         "concurrency": concurrency,
         "max_tokens": max_tokens,
+        "replicas": n_replicas,
         "wall_s": round(dt, 3),
         "tokens_per_sec": round(stats["tokens"] / dt, 1),
         "p50_ttft_ms": round(p(0.50) * 1e3, 1),
         "p99_ttft_ms": round(p(0.99) * 1e3, 1),
         "server_ttft_p50_ms": round(
-            histogram_quantile(server_ttft, 0.5) * 1e3 if server_ttft else 0.0, 1),
+            quantile_max("paddlenlp_serving_ttft_seconds", 0.5) * 1e3, 1),
         "p99_inter_token_ms": round(
-            histogram_quantile(inter_token, 0.99) * 1e3 if inter_token else 0.0, 1),
-        "kv_utilization": round(scalar("paddlenlp_serving_kv_utilization"), 4),
-        "kv_free_blocks": scalar("paddlenlp_serving_kv_free_blocks"),
-        "preemptions": scalar("paddlenlp_serving_preemptions_total"),
-        "tokens_generated": scalar("paddlenlp_serving_tokens_generated_total"),
-    }))
+            quantile_max("paddlenlp_serving_inter_token_seconds", 0.99) * 1e3, 1),
+        "kv_utilization": round(
+            scalar_sum("paddlenlp_serving_kv_utilization") / max(len(replica_fams), 1), 4),
+        "kv_free_blocks": scalar_sum("paddlenlp_serving_kv_free_blocks"),
+        "preemptions": scalar_sum("paddlenlp_serving_preemptions_total"),
+        "tokens_generated": scalar_sum("paddlenlp_serving_tokens_generated_total"),
+    }
+    if fleet is not None:
+        router_fams = parse_prometheus_text(scraped)
+        share = {}
+        req_fam = router_fams.get("paddlenlp_router_requests_total")
+        if req_fam is not None:
+            for (_sample, labels), v in req_fam.samples.items():
+                share[dict(labels).get("replica", "?")] = \
+                    share.get(dict(labels).get("replica", "?"), 0.0) + v
+        rscalar = lambda name: (router_fams[name].value() or 0.0) if name in router_fams else 0.0
+        record["request_share"] = {k: int(v) for k, v in sorted(share.items())}
+        record["failovers"] = int(rscalar("paddlenlp_router_failovers_total"))
+        record["rerouted"] = int(rscalar("paddlenlp_router_rerouted_total"))
+    print(json.dumps(record))
 
 
 def main() -> None:

@@ -2,7 +2,8 @@
 text format with HELP/TYPE lines.
 
 Instantiates the full catalog — the serving runtime's ``ServingMetrics`` (on a
-stub engine, no jax compute) and the trainer's ``register_training_metrics`` —
+stub engine, no jax compute), the router front tier's ``RouterMetrics``, and
+the trainer's ``register_training_metrics`` —
 into one fresh registry, renders the exposition, and runs
 ``observability.lint_exposition`` over it: missing HELP, missing TYPE, illegal
 names/labels, non-cumulative histogram buckets, negative counters all fail.
@@ -43,13 +44,21 @@ def _stub_engine():
 
 
 def catalog_exposition() -> str:
-    """Render the full serving + training metric catalog from a fresh registry."""
+    """Render the full serving + router + training metric catalog from a
+    fresh registry."""
     from paddlenlp_tpu.serving.engine_loop import ServingMetrics
     from paddlenlp_tpu.serving.metrics import MetricsRegistry
+    from paddlenlp_tpu.serving.router.metrics import RouterMetrics
     from paddlenlp_tpu.trainer.integrations import register_training_metrics
 
     registry = MetricsRegistry()
     ServingMetrics(_stub_engine(), registry=registry)
+    router = RouterMetrics(registry)
+    # labeled series expose no samples until touched — exercise one labelset
+    # of each so the lint sees real sample lines, not just HELP/TYPE headers
+    router.replica_healthy.set(1.0, replica="replica-0")
+    router.requests.inc(replica="replica-0", outcome="ok")
+    router.health_polls.inc(replica="replica-0", outcome="ok")
     register_training_metrics(registry)
     return registry.expose()
 
