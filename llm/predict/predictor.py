@@ -56,6 +56,11 @@ class PredictorArgument:
     speculate_max_draft_tokens: int = 4
     draft_model_name_or_path: Optional[str] = field(
         default=None, metadata={"help": "checkpoint for the draft model (speculate_method=draft_model)"})
+    enable_prefix_cache: bool = field(
+        default=True,
+        metadata={"help": "share KV blocks across requests with a common prompt prefix "
+                          "(refcounted blocks + copy-on-write; prefill runs only on the "
+                          "uncached suffix). Disable to force full prefill per request."})
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -158,6 +163,7 @@ class BlockPredictor(BasePredictor):
             max_blocks_per_seq=args.max_blocks_per_seq,
             dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
             kv_cache_quant=self._kv_quant(args.cachekv_int8_type),
+            enable_prefix_cache=args.enable_prefix_cache,
             use_speculative=args.speculate_method == "ngram",
             spec_draft_len=args.speculate_max_draft_tokens,
             draft_model=draft_model,

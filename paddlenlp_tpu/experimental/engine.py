@@ -39,7 +39,7 @@ from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .inference_model import PagedInferenceModel
-from .paged_cache import BlockManager, init_paged_pool
+from .paged_cache import BlockManager, copy_blocks, init_paged_pool
 
 __all__ = ["InferenceEngine", "Request", "SamplingParams"]
 
@@ -135,6 +135,10 @@ class InferenceEngine:
         spec_ngram: int = 2,
         draft_model=None,  # small causal LM proposer (reference speculate_method=draft_model)
         spec_seed: int = 0,
+        # share KV blocks across common prompt prefixes. Content-addressed:
+        # only valid while params are frozen — callers that update weights
+        # between requests must disable this or call clear_prefix_cache()
+        enable_prefix_cache: bool = True,
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -147,7 +151,9 @@ class InferenceEngine:
         self.pool = init_paged_pool(model.config, num_blocks, block_size,
                                     dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32,
                                     quant=kv_cache_quant)
-        self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq)
+        self.enable_prefix_cache = enable_prefix_cache
+        self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq,
+                                enable_prefix_cache=enable_prefix_cache)
         self.max_batch_size = max_batch_size
         self.decode_steps = decode_steps
         self.waiting: deque[Request] = deque()
@@ -213,13 +219,22 @@ class InferenceEngine:
                 return req
         return None
 
-    def _free_kv(self, req: Request):
-        """Release a request's KV blocks (+ an alloc/free trace marker)."""
+    def _free_kv(self, req: Request, cache: bool = False):
+        """Release a request's KV blocks (+ an alloc/free trace marker).
+
+        ``cache=True`` (normal finishes) registers the request's full prompt
+        blocks in the prefix index instead of freeing them, so the next
+        request sharing the prefix skips their prefill; aborts and
+        preemptions release by refcount without registering."""
         freed = self.mgr.lengths.get(req.req_id)
-        self.mgr.free_seq(req.req_id)
+        if cache and self.enable_prefix_cache and req.finish_reason in ("stop", "length"):
+            self.mgr.finish_seq_cached(req.req_id, req.prompt_ids)
+        else:
+            self.mgr.free_seq(req.req_id)
         TRACER.instant("kv_free", cat="engine", trace=req.trace,
                        req_id=req.req_id, tokens_held=freed,
-                       free_blocks=self.mgr.num_free)
+                       free_blocks=self.mgr.num_free,
+                       cached_blocks=self.mgr.num_cached_blocks)
 
     def _finish_abort(self, req: Request):
         req.done = True
@@ -227,6 +242,12 @@ class InferenceEngine:
         req.finish_reason = "abort"
         req.finish_t = time.time()
         self._spec_rngs.pop(req.req_id, None)
+
+    def clear_prefix_cache(self):
+        """Invalidate every cached prefix block (idle ones return to the free
+        list). Required after a weight update: cached KV is only valid under
+        the params that produced it."""
+        self.mgr.clear_prefix_cache()
 
     def reset(self):
         """Drop ALL scheduler/allocator state after a failed step — the
@@ -240,7 +261,8 @@ class InferenceEngine:
         self.waiting.clear()
         self.slots = [None] * self.max_batch_size
         self.mgr = BlockManager(self.mgr.total_usable_blocks + 1, self.mgr.block_size,
-                                self.mgr.max_blocks_per_seq)
+                                self.mgr.max_blocks_per_seq,
+                                enable_prefix_cache=self.enable_prefix_cache)
         self._last_token[:] = 0
         self.counts = jnp.zeros_like(self.counts)
         self._spec_rngs.clear()
@@ -256,6 +278,13 @@ class InferenceEngine:
             "total_blocks": self.mgr.total_usable_blocks,
             "num_preemptions": self.num_preemptions,
             "spec_stats": dict(self.spec_stats),
+            "prefix_cache": {
+                "enabled": self.enable_prefix_cache,
+                "hits": self.mgr.cache_hits,
+                "cached_tokens": self.mgr.cached_tokens_total,
+                "evictions": self.mgr.evictions,
+                "cached_blocks": self.mgr.num_cached_blocks,
+            },
         }
 
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
@@ -305,7 +334,9 @@ class InferenceEngine:
         queue_depth = len(self.waiting)
         n_finished0 = len(finished)
         admit_t0 = time.perf_counter()
-        admitted: List[tuple] = []  # (slot, req)
+        cache_on = self.enable_prefix_cache
+        hits0, cached0 = self.mgr.cache_hits, self.mgr.cached_tokens_total
+        admitted: List[tuple] = []  # (slot, req, n_cached)
         while self.waiting and free:
             req = self.waiting[0]
             prompt_len = len(req.prompt_ids)
@@ -322,17 +353,36 @@ class InferenceEngine:
                 logger.warning(f"req {req.req_id}: needs {need} KV blocks (> capacity); rejected")
                 finished.append(req)
                 continue
-            # reserve prompt + 1 so the first decode never immediately preempts
-            if not self.mgr.can_allocate(prompt_len + 1):
+            # reserve prompt + 1 so the first decode never immediately preempts;
+            # cached prefix blocks need no fresh capacity, so a warm request
+            # can be admitted where a cold one of the same length must wait.
+            # The prefix match is computed ONCE and shared with allocate
+            match = None
+            if cache_on:
+                # bound check before hashing: if even a perfect full-block
+                # match can't fit, a blocked head-of-queue request must not
+                # chain-hash its whole prompt again every engine step
+                best_need = self.mgr.blocks_needed(prompt_len + 1) \
+                    - prompt_len // self.mgr.block_size
+                if best_need > self.mgr.num_free:
+                    break
+                match = self.mgr.match_prefix(req.prompt_ids, prompt_len)
+            if not self.mgr.can_admit(prompt_len + 1, match=match):
                 break
             self.waiting.popleft()
             if req.sched_t is None:  # preserved across preemption-requeues
                 req.sched_t = time.time()
-            self.mgr.allocate(req.req_id, prompt_len)
+            if cache_on:
+                _cached_blocks, n_cached, _new = self.mgr.allocate(
+                    req.req_id, prompt_len, token_ids=req.prompt_ids, match=match)
+            else:
+                self.mgr.allocate(req.req_id, prompt_len)
+                n_cached = 0
             TRACER.instant("kv_alloc", cat="engine", trace=req.trace,
                            req_id=req.req_id, tokens=prompt_len,
+                           cached_tokens=n_cached,
                            free_blocks=self.mgr.num_free)
-            admitted.append((free.pop(0), req))
+            admitted.append((free.pop(0), req, n_cached))
         # admission span closes BEFORE prefill (sibling phases, not nested) and
         # only when something happened — a blocked queue spinning admitted=0
         # every step must not flood the span ring
@@ -341,36 +391,71 @@ class InferenceEngine:
                             time.perf_counter() - admit_t0, cat="engine",
                             queue_depth=queue_depth, admitted=len(admitted),
                             rejected_capacity=len(finished) - n_finished0)
+        if cache_on and admitted:
+            # prefix_cache phase: match/COW bookkeeping + the owed block copies
+            pc_t0 = time.perf_counter()
+            cow = self.mgr.drain_cow_pairs()
+            if cow:
+                self.pool = copy_blocks(self.pool, cow)
+            TRACER.add_span("prefix_cache", TRACER.epoch_time(pc_t0),
+                            time.perf_counter() - pc_t0, cat="engine",
+                            hits=self.mgr.cache_hits - hits0,
+                            cached_tokens=self.mgr.cached_tokens_total - cached0,
+                            cow_copies=len(cow))
 
-        # batch prefills, grouped by padded prompt length (bounded retraces)
+        # batch prefills, grouped by padded UNCACHED suffix length (bounded
+        # retraces; a cache hit shortens the fed sequence, not just the FLOPs)
+        vocab = self.model.config.vocab_size
         by_bucket: Dict[int, List[tuple]] = {}
-        for slot, req in admitted:
-            by_bucket.setdefault(_bucket(len(req.prompt_ids)), []).append((slot, req))
+        for slot, req, n_cached in admitted:
+            by_bucket.setdefault(_bucket(len(req.prompt_ids) - n_cached),
+                                 []).append((slot, req, n_cached))
         for padded, group in by_bucket.items():
             n = _bucket(len(group), minimum=1)
             ids = np.zeros((n, padded), np.int32)
             tables = np.zeros((n, self.mgr.max_blocks_per_seq), np.int32)
-            lens = np.zeros(n, np.int32)
+            suffix_lens = np.zeros(n, np.int32)
+            cached_lens = np.zeros(n, np.int32)
+            counts_in = None  # host bincount only when a cached span exists
             reqs: List[Optional[Request]] = [None] * n
-            for j, (slot, req) in enumerate(group):
-                ids[j, : len(req.prompt_ids)] = req.prompt_ids
+            for j, (slot, req, n_cached) in enumerate(group):
+                suffix = req.prompt_ids[n_cached:]
+                ids[j, : len(suffix)] = suffix
                 tables[j] = self.mgr.table_array(req.req_id)
-                lens[j] = len(req.prompt_ids)
+                suffix_lens[j] = len(suffix)
+                cached_lens[j] = n_cached
+                if n_cached > 0:
+                    # penalty counts must cover the FULL prompt: the fed
+                    # suffix is counted on device, the cached span here.
+                    # Clipped: an out-of-vocab id from a direct caller must
+                    # degrade to a garbage count (the old one_hot behavior),
+                    # not crash the step / allocate a token-id-sized array
+                    if counts_in is None:
+                        counts_in = np.zeros((n, vocab), np.int32)
+                    counts_in[j] = np.bincount(
+                        np.clip(req.prompt_ids[:n_cached], 0, vocab - 1),
+                        minlength=vocab)[:vocab]
                 reqs[j] = req
+            # all-miss (or cache-off) batches materialize the zeros on device
+            # instead of shipping an n*vocab host buffer every prefill
+            counts_dev = jnp.zeros((n, vocab), jnp.int32) if counts_in is None \
+                else jnp.asarray(counts_in)
             with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
-                             req_ids=[r.req_id for _, r in group]):
+                             req_ids=[r.req_id for _, r, _ in group],
+                             cached_tokens=int(cached_lens.sum())):
                 tokens, counts_rows, self.pool = self.infer.prefill(
                     self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
-                    jnp.asarray(lens), self._samp_arrays(reqs),
+                    jnp.asarray(suffix_lens), jnp.asarray(cached_lens),
+                    counts_dev, self._samp_arrays(reqs),
                 )
                 tokens = np.asarray(tokens)
-            slot_idx = [slot for slot, _ in group]
+            slot_idx = [slot for slot, _, _ in group]
             self.counts = self.counts.at[jnp.asarray(slot_idx)].set(counts_rows[: len(group)])
-            for j, (slot, req) in enumerate(group):
+            for j, (slot, req, _) in enumerate(group):
                 tok = int(tokens[j])
                 self._emit(req, tok)
                 if req.done:
-                    self._free_kv(req)
+                    self._free_kv(req, cache=True)
                     finished.append(req)
                 else:
                     self.slots[slot] = req
@@ -535,12 +620,12 @@ class InferenceEngine:
             start[i] = req.total_len - 1  # position of the token being fed
         with TRACER.span("spec_verify", cat="engine", mode=mode,
                          drafted=int(sum(len(d) for d in drafts))):
+            # greedy acceptance never reads the logits: need_logits=False keeps
+            # the [B, K+1, V] fp32 buffer from materializing at all
             argmax_dev, logits_dev, self.pool = self.infer.verify(
                 self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
-                jnp.asarray(start),
+                jnp.asarray(start), need_logits=mode == "sample",
             )
-            # greedy only pulls [B, K+1] int32 to host; the [B, K+1, V] logits
-            # stay on device unless rejection sampling needs them
             logits = np.asarray(logits_dev) if mode == "sample" else None
             argmax = np.asarray(argmax_dev)
         self.spec_stats["verify_steps"] += 1
@@ -567,7 +652,7 @@ class InferenceEngine:
                 if req.done:
                     break
             if req.done:
-                self._free_kv(req)
+                self._free_kv(req, cache=True)
                 self.slots[i] = None
                 finished.append(req)
             else:
@@ -669,7 +754,7 @@ class InferenceEngine:
             if req is None:
                 continue
             if req.done:
-                self._free_kv(req)
+                self._free_kv(req, cache=True)
                 self.slots[i] = None
                 finished.append(req)
             elif req.req_id in start_len:

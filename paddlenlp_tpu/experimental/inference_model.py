@@ -126,7 +126,8 @@ class PagedInferenceModel:
         self.quant_cfg = getattr(model, "quantization_config", None)
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
-        self._verify = jax.jit(self._verify_impl, donate_argnums=(1,))
+        self._verify = jax.jit(self._verify_impl, donate_argnums=(1,),
+                               static_argnames=("need_logits",))
 
     def _mm(self, p, x):
         """x @ kernel with quantized-leaf dispatch: a8w8 -> int8 x int8 MXU dot;
@@ -246,27 +247,46 @@ class PagedInferenceModel:
             logits = last @ params["lm_head"]["kernel"].astype(self.dtype)
         else:
             logits = last @ embed.T.astype(self.dtype)
-        return logits.astype(jnp.float32), new_pool
+        # logits stay in compute dtype: every consumer either casts to fp32
+        # itself (sample_tokens) or explicitly opts out of the cast (greedy
+        # verify reads only the argmax, sparing the [B, T, V] fp32 buffer)
+        return logits, new_pool
 
     # ------------------------------------------------------------------ entry points
-    def _prefill_impl(self, params, pool, input_ids, block_tables, prompt_lens, samp):
-        """Batched prefill: [n, T_pad] sequences; samples the first token on device.
+    def _prefill_impl(self, params, pool, input_ids, block_tables, suffix_lens,
+                      cached_lens, cached_counts, samp):
+        """Batched prefill: [n, T_pad] SUFFIX sequences; samples the first token
+        on device.
+
+        Prefix caching feeds only the uncached tail of each prompt:
+        ``input_ids`` row j holds prompt tokens ``[cached_lens[j]:]`` (padded to
+        T), attention reads the cached span straight from the shared blocks in
+        ``block_tables``, and new KV is written starting at ``cached_lens[j]``.
+        ``cached_lens = 0`` everywhere reproduces the uncached full prefill.
+        ``cached_counts`` [n, V] int32 are the token counts of the CACHED span
+        only (host-side — suffix-only input can't see the cached tokens the
+        penalty kernels must still count); the fed suffix is counted on device
+        as before, so the cache-off / cache-miss path ships only zeros.
 
         Returns (tokens [n], counts [n, V] incl. prompt + sampled token, new pool).
         """
         n, T = input_ids.shape
-        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (n, T))
+        positions = cached_lens[:, None] + jnp.arange(T)[None, :]
+        total_lens = cached_lens + suffix_lens
         S = block_tables.shape[1] * self.block_size
-        kv_len_mask = jnp.arange(S)[None, :] < prompt_lens[:, None]
+        kv_len_mask = jnp.arange(S)[None, :] < total_lens[:, None]
         logits, new_pool = self._forward(
             params, pool, input_ids, block_tables, positions,
-            kv_len_mask, jnp.zeros((n,), jnp.int32),
-            jnp.maximum(prompt_lens - 1, 0),  # last VALID token (input may be padded)
+            kv_len_mask, cached_lens,
+            jnp.maximum(suffix_lens - 1, 0),  # last VALID token (input may be padded)
         )
-        V = logits.shape[-1]
-        valid = (jnp.arange(T)[None, :] < prompt_lens[:, None]).astype(jnp.int32)
-        counts = (jax.nn.one_hot(input_ids, V, dtype=jnp.int32) * valid[..., None]).sum(axis=1)
-        tokens = sample_tokens(logits, positions=prompt_lens, counts=counts, **samp)
+        V = cached_counts.shape[-1]
+        valid = (jnp.arange(T)[None, :] < suffix_lens[:, None]).astype(jnp.int32)
+        # out-of-vocab ids one_hot to zero rows — same degrade as the old
+        # full-prompt device count
+        counts = cached_counts + (jax.nn.one_hot(input_ids, V, dtype=jnp.int32)
+                                  * valid[..., None]).sum(axis=1)
+        tokens = sample_tokens(logits, positions=total_lens, counts=counts, **samp)
         counts = counts + jax.nn.one_hot(tokens, V, dtype=jnp.int32)
         return tokens, counts, new_pool
 
@@ -309,7 +329,8 @@ class PagedInferenceModel:
         )
         return toks, valid, done, ctx, counts, pool
 
-    def _verify_impl(self, params, pool, tokens, block_tables, start_pos):
+    def _verify_impl(self, params, pool, tokens, block_tables, start_pos,
+                     need_logits: bool = True):
         """Speculative-decoding verify: one forward over ``[last_token, d_1..d_K]``.
 
         Counterpart of the reference's speculative write path
@@ -322,11 +343,12 @@ class PagedInferenceModel:
 
         tokens [B, K+1] (row = last accepted token then drafts, 0-padded);
         start_pos [B] absolute position of tokens[:, 0]. Returns
-        (argmax [B, K+1] int32, logits [B, K+1, V] fp32, new pool) — position i
-        scores the token AFTER consuming tokens[:, i]. Greedy acceptance reads
-        only the argmax (tiny host transfer); rejection sampling reads the full
-        logits — both stay device-side until the host np.asarray's the one it
-        needs.
+        (argmax [B, K+1] int32, logits [B, K+1, V] fp32 or None, new pool) —
+        position i scores the token AFTER consuming tokens[:, i]. Greedy
+        acceptance reads only the argmax, and ``need_logits=False`` skips the
+        [B, K+1, V] fp32 materialization entirely (it doubled the verify
+        buffer per speculative step for a tensor greedy mode never read);
+        rejection sampling passes ``need_logits=True`` for the full logits.
         """
         B, T = tokens.shape
         positions = start_pos[:, None] + jnp.arange(T)[None, :]
@@ -336,14 +358,20 @@ class PagedInferenceModel:
             params, pool, tokens, block_tables, positions, kv_len_mask,
             start_pos, last_pos=None,
         )
-        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                logits.astype(jnp.float32), new_pool)
+        argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not need_logits:
+            return argmax, None, new_pool
+        return argmax, logits.astype(jnp.float32), new_pool
 
-    def verify(self, params, pool: PagedKVPool, tokens, block_tables, start_pos):
-        return self._verify(params, pool, tokens, block_tables, start_pos)
+    def verify(self, params, pool: PagedKVPool, tokens, block_tables, start_pos,
+               need_logits: bool = True):
+        return self._verify(params, pool, tokens, block_tables, start_pos,
+                            need_logits=need_logits)
 
-    def prefill(self, params, pool: PagedKVPool, input_ids, block_tables, prompt_lens, samp):
-        return self._prefill(params, pool, input_ids, block_tables, prompt_lens, samp)
+    def prefill(self, params, pool: PagedKVPool, input_ids, block_tables, suffix_lens,
+                cached_lens, cached_counts, samp):
+        return self._prefill(params, pool, input_ids, block_tables, suffix_lens,
+                             cached_lens, cached_counts, samp)
 
     def decode(self, params, pool: PagedKVPool, tokens, block_tables, context_lens, done0,
                remaining, counts, samp):

@@ -20,13 +20,17 @@ preempt + recover). TPU-native split:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+import functools
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagedKVPool", "BlockManager", "init_paged_pool", "write_kv_block", "gather_kv"]
+__all__ = ["PagedKVPool", "BlockManager", "init_paged_pool", "write_kv_block", "gather_kv",
+           "copy_blocks"]
 
 
 @dataclasses.dataclass
@@ -141,51 +145,245 @@ def gather_kv(pool_layer: jnp.ndarray, block_tables: jnp.ndarray,
     return k, v
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_blocks_plane(plane: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray) -> jnp.ndarray:
+    return plane.at[:, :, dst].set(plane[:, :, src])
+
+
+def copy_blocks(pool: PagedKVPool, pairs: Sequence[Tuple[int, int]]) -> PagedKVPool:
+    """Copy whole KV blocks src -> dst across every layer (K and V planes).
+
+    The copy-on-write primitive behind prefix caching: when a request's prompt
+    is fully covered by cached blocks, the tail block must still absorb the
+    re-prefilled last token — so it is duplicated into a private block first.
+    Jitted with the pool donated so XLA scatters in place — an eager ``.at[]``
+    would materialize a second full pool (transient 2x HBM) to copy one block.
+    Functional semantics still order the copy before any later prefill/decode
+    write that might recycle ``src``.
+
+    The pair list is padded to the next power of two with ``(0, 0)`` identity
+    copies of the zero sentinel block (real dsts are never block 0), so the
+    full-pool scatter compiles for at most log2(max pairs) shapes instead of
+    once per distinct COW count seen in the admission hot path."""
+    if not pairs:
+        return pool
+    padded = 1
+    while padded < len(pairs):
+        padded *= 2
+    pairs = list(pairs) + [(0, 0)] * (padded - len(pairs))
+    src = jnp.asarray([s for s, _ in pairs], jnp.int32)
+    dst = jnp.asarray([d for _, d in pairs], jnp.int32)
+    kv = _copy_blocks_plane(pool.kv, src, dst)
+    scale = None if pool.scale is None else _copy_blocks_plane(pool.scale, src, dst)
+    return PagedKVPool(kv=kv, scale=scale)
+
+
 class BlockManager:
     """Host-side allocator (the step.cu bookkeeping in Python).
 
     Block 0 is reserved as the zero sentinel for unused table slots.
+
+    **Prefix caching** (``enable_prefix_cache=True``): every owned block carries
+    a refcount, and full blocks of finished prompts are registered in a
+    chained-hash index (``h_i = sha256(h_{i-1} || block_i tokens)`` — block-
+    granular, content-addressed). ``allocate(..., token_ids=...)`` walks the
+    chain and reuses the longest cached prefix of FULL blocks; the caller skips
+    prefill for those tokens. Zero-ref cached blocks sit on an LRU list and are
+    evicted only under allocation pressure, so the cache can never cause an
+    admission failure the uncached allocator wouldn't have had: ``num_free``
+    counts them as available.
     """
 
-    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int,
+                 enable_prefix_cache: bool = False):
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.total_usable_blocks = num_blocks - 1
         self.free: List[int] = list(range(1, num_blocks))  # block 0 = sentinel
         self.tables: Dict[int, List[int]] = {}
         self.lengths: Dict[int, int] = {}
+        self.enable_prefix_cache = enable_prefix_cache
+        self.ref: Dict[int, int] = {}  # block -> #sequences referencing it
+        self._index: Dict[int, int] = {}  # chained prefix hash -> block
+        self._block_hash: Dict[int, int] = {}  # registered block -> its hash
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # zero-ref cached blocks
+        self._cow_pairs: List[Tuple[int, int]] = []  # (src, dst) device copies owed
+        self._cache_epoch = 0  # bumped by clear_prefix_cache()
+        self._seq_epoch: Dict[int, int] = {}  # seq -> epoch it was allocated in
+        self.cache_hits = 0  # allocations that reused >=1 cached block
+        self.cached_tokens_total = 0  # prompt tokens whose prefill was skipped
+        self.evictions = 0  # cached blocks recycled under pressure
 
     @property
     def num_free(self) -> int:
-        return len(self.free)
+        """Blocks available to an allocation: the free list plus zero-ref
+        cached blocks (evictable on demand, so they ARE capacity)."""
+        return len(self.free) + len(self._lru)
+
+    @property
+    def num_cached_blocks(self) -> int:
+        """Blocks currently registered in the prefix index (shared or idle)."""
+        return len(self._block_hash)
 
     def blocks_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.block_size - 1) // self.block_size
 
     def can_allocate(self, n_tokens: int) -> bool:
-        return self.blocks_needed(n_tokens) <= len(self.free)
+        return self.blocks_needed(n_tokens) <= self.num_free
 
-    def allocate(self, seq_id: int, n_tokens: int) -> List[int]:
+    def can_admit(self, n_tokens: int, token_ids=None, match=None) -> bool:
+        """Like :meth:`can_allocate`, but cached prefix blocks don't need fresh
+        capacity — the scheduler admits a warm request a cold one must wait for.
+
+        Pass a precomputed ``match`` (from :meth:`match_prefix`) to skip
+        re-hashing the prompt; matched blocks that are idle on the LRU are
+        subtracted from available capacity — they can't be both "no fresh
+        block needed" AND "evictable free capacity" at once."""
+        if match is None and token_ids is not None:
+            match = self.match_prefix(token_ids, min(len(token_ids), n_tokens))
+        matched = match[0] if match is not None else []
+        need = self.blocks_needed(n_tokens) - len(matched)
+        return need <= self.num_free - self._idle_count(matched)
+
+    # ------------------------------------------------------------- prefix cache
+    def _idle_count(self, blocks) -> int:
+        """How many of ``blocks`` currently sit on the (counted-as-free) LRU."""
+        return sum(1 for b in blocks if b in self._lru)
+
+    def _chain_hashes(self, token_ids, nb_full: int):
+        """Chained sha256 content digests of the first ``nb_full`` full blocks.
+
+        Cryptographic on purpose: the index serves another prompt's KV on a
+        key collision with no further check, so a non-collision-resistant
+        hash would be a silent-wrong-output (and cross-request leak) channel."""
+        h = b""
+        bs = self.block_size
+        arr = np.ascontiguousarray(
+            np.asarray(token_ids[: nb_full * bs], dtype=np.int64))
+        out = []
+        for i in range(nb_full):
+            h = hashlib.sha256(h + arr[i * bs: (i + 1) * bs].tobytes()).digest()
+            out.append(h)
+        return out
+
+    def match_prefix(self, token_ids, n_tokens: int):
+        """Longest cached full-block prefix of ``token_ids``.
+
+        Returns ``(shared_blocks, n_cached_tokens, cow_src)``: blocks to attach
+        by reference, tokens covered, and — when the match would cover the whole
+        prompt (leaving nothing to prefill) — the tail block to copy-on-write
+        instead of sharing, so the re-prefilled last token never mutates a
+        shared block. Pure lookup: acquires nothing."""
+        if not self.enable_prefix_cache:
+            return [], 0, None
+        bs = self.block_size
+        nb_full = min(len(token_ids), n_tokens) // bs
+        matched: List[int] = []
+        for h in self._chain_hashes(token_ids, nb_full):
+            b = self._index.get(h)
+            if b is None:
+                break
+            matched.append(b)
+        if not matched:
+            return [], 0, None
+        if len(matched) * bs == n_tokens:
+            # full cover: keep >=1 token to prefill (the sampler needs logits
+            # at the last prompt position) — COW the tail block
+            return matched[:-1], n_tokens - 1, matched[-1]
+        return matched, len(matched) * bs, None
+
+    def _acquire(self, block: int):
+        """Take a reference on a cached block (removing it from the LRU if idle)."""
+        self.ref[block] = self.ref.get(block, 0) + 1
+        self._lru.pop(block, None)
+
+    def _release_block(self, block: int):
+        r = self.ref.get(block, 0) - 1
+        if r > 0:
+            self.ref[block] = r
+            return
+        self.ref.pop(block, None)
+        if block in self._block_hash:
+            # zero-ref but cached: evictable, not free — most-recently-used last
+            self._lru[block] = None
+            self._lru.move_to_end(block)
+        else:
+            self.free.append(block)
+
+    def _pop_block(self) -> int:
+        """A fresh private block: free list first, else evict the LRU cached
+        block (allocation pressure is the ONLY thing that shrinks the cache)."""
+        if self.free:
+            b = self.free.pop()
+        else:
+            b, _ = self._lru.popitem(last=False)
+            self._index.pop(self._block_hash.pop(b), None)
+            self.evictions += 1
+        self.ref[b] = 1
+        return b
+
+    def drain_cow_pairs(self) -> List[Tuple[int, int]]:
+        """(src, dst) block copies the caller owes the device pool (see
+        :func:`copy_blocks`); cleared on read."""
+        pairs, self._cow_pairs = self._cow_pairs, []
+        return pairs
+
+    # ------------------------------------------------------------- allocation
+    def allocate(self, seq_id: int, n_tokens: int, token_ids=None, match=None):
+        """Allocate a sequence's blocks.
+
+        Plain call (``token_ids=None``): the uncached path — returns the block
+        list, exactly the historical contract.
+
+        With ``token_ids`` and prefix caching enabled: matches the longest
+        cached full-block prefix and returns ``(cached_blocks,
+        n_cached_tokens, new_blocks)``; the sequence's table is
+        ``cached_blocks [+ cow dst] + new_blocks`` and the caller only
+        prefills tokens ``[n_cached_tokens:]``. Pass the ``match`` a prior
+        :meth:`match_prefix`/:meth:`can_admit` computed (no mutation may
+        happen in between) to avoid re-hashing the prompt."""
         need = self.blocks_needed(n_tokens)
-        if need > len(self.free):
-            raise RuntimeError(f"out of KV blocks: need {need}, free {len(self.free)}")
         if need > self.max_blocks_per_seq:
             raise ValueError(f"sequence needs {need} blocks > max_blocks_per_seq {self.max_blocks_per_seq}")
-        blocks = [self.free.pop() for _ in range(need)]
-        self.tables[seq_id] = blocks
+        if match is None and token_ids is not None:
+            match = self.match_prefix(token_ids, n_tokens)
+        shared, n_cached, cow_src = match if match is not None else ([], 0, None)
+        n_fresh = need - len(shared)
+        # matched idle blocks are about to leave the LRU: they can't double as
+        # evictable capacity for this same allocation's fresh blocks
+        available = self.num_free - self._idle_count(shared)
+        if n_fresh > available:
+            raise RuntimeError(f"out of KV blocks: need {n_fresh}, free {available}")
+        # acquire shared refs BEFORE popping fresh blocks: a matched idle block
+        # must leave the LRU first or the eviction path could recycle it
+        for b in shared:
+            self._acquire(b)
+        if cow_src is not None and cow_src in self._lru:
+            self._lru.move_to_end(cow_src)  # just used: keep it warm
+        new_blocks = [self._pop_block() for _ in range(n_fresh)]
+        if cow_src is not None:
+            # new_blocks[0] becomes the private copy of the shared tail block
+            self._cow_pairs.append((cow_src, new_blocks[0]))
+        self.tables[seq_id] = shared + new_blocks
         self.lengths[seq_id] = n_tokens
-        return blocks
+        self._seq_epoch[seq_id] = self._cache_epoch
+        if n_cached > 0:
+            self.cache_hits += 1
+            self.cached_tokens_total += n_cached
+        if token_ids is not None:
+            return shared, n_cached, new_blocks
+        return self.tables[seq_id]
 
     def extend(self, seq_id: int, n_new_tokens: int = 1) -> Optional[List[int]]:
         """Grow a sequence; returns newly-allocated blocks (None if OOM -> preempt)."""
         new_len = self.lengths[seq_id] + n_new_tokens
         need = self.blocks_needed(new_len) - len(self.tables[seq_id])
         if need > 0:
-            if need > len(self.free):
+            if need > self.num_free:
                 return None
             if self.blocks_needed(new_len) > self.max_blocks_per_seq:
                 return None
-            new_blocks = [self.free.pop() for _ in range(need)]
+            new_blocks = [self._pop_block() for _ in range(need)]
             self.tables[seq_id].extend(new_blocks)
         else:
             new_blocks = []
@@ -194,20 +392,66 @@ class BlockManager:
 
     def shrink(self, seq_id: int, new_len: int):
         """Release blocks beyond ``new_len`` tokens (undo speculative multi-step
-        extension after a sequence finished early)."""
+        extension after a sequence finished early). Refcount-aware: a shared
+        block dropped from this table survives for its other holders."""
         if seq_id not in self.tables:
             return
         keep = max(self.blocks_needed(new_len), 1)
         blocks = self.tables[seq_id]
         if keep < len(blocks):
-            self.free.extend(blocks[keep:])
+            for b in blocks[keep:]:
+                self._release_block(b)
             del blocks[keep:]
         self.lengths[seq_id] = new_len
 
     def free_seq(self, seq_id: int):
+        """Release a sequence WITHOUT registering its blocks (abort/preempt)."""
         blocks = self.tables.pop(seq_id, [])
         self.lengths.pop(seq_id, None)
-        self.free.extend(blocks)
+        self._seq_epoch.pop(seq_id, None)
+        for b in blocks:
+            self._release_block(b)
+
+    def finish_seq_cached(self, seq_id: int, token_ids):
+        """Release a finished sequence, registering its full prompt blocks in
+        the prefix index so later requests skip their prefill.
+
+        Chain registration is content-addressed: a block whose hash is already
+        claimed by another block is simply not registered (deeper blocks still
+        are — a future match mixes providers freely, content is identical).
+
+        A sequence allocated before the last :meth:`clear_prefix_cache` holds
+        KV computed under superseded params — it releases without registering
+        (the epoch check), otherwise it would re-poison the cleared index."""
+        blocks = self.tables.pop(seq_id, None)
+        self.lengths.pop(seq_id, None)
+        epoch = self._seq_epoch.pop(seq_id, None)
+        if blocks is None:
+            return
+        if self.enable_prefix_cache and token_ids is not None and epoch == self._cache_epoch:
+            bs = self.block_size
+            nb_full = min(len(token_ids) // bs, len(blocks))
+            for i, h in enumerate(self._chain_hashes(token_ids, nb_full)):
+                b = blocks[i]
+                if h not in self._index and b not in self._block_hash:
+                    self._index[h] = b
+                    self._block_hash[b] = h
+        for b in blocks:
+            self._release_block(b)
+
+    def clear_prefix_cache(self):
+        """Drop every idle cached block back to the free list (index reset)."""
+        for b in list(self._lru):
+            self._index.pop(self._block_hash.pop(b), None)
+            self.free.append(b)
+        self._lru.clear()
+        # blocks still referenced by running sequences stay out of the index
+        # from now on: unregister them so they free normally on release
+        for b in list(self._block_hash):
+            self._index.pop(self._block_hash.pop(b), None)
+        # in-flight sequences hold KV from before the clear: the epoch bump
+        # stops finish_seq_cached from re-registering it into the fresh index
+        self._cache_epoch += 1
 
     def table_array(self, seq_id: int) -> np.ndarray:
         """Padded table row (sentinel block 0 for unused slots)."""

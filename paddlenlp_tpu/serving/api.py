@@ -103,6 +103,15 @@ class ServingServer:
             ids = getattr(ids, "ids", ids)
         else:
             ids = [int(t) for t in prompt]
+            # raw token ids come straight off the wire: range-check against
+            # the model vocab (a bad id would otherwise surface as a garbage
+            # completion, or as an engine-step failure downstream)
+            vocab = getattr(getattr(self.engine, "model", None), "config", None)
+            vocab = getattr(vocab, "vocab_size", None)
+            if ids and vocab is not None and (min(ids) < 0 or max(ids) >= vocab):
+                raise ValueError(
+                    f"prompt token ids must be in [0, {vocab}); "
+                    f"got min {min(ids)}, max {max(ids)}")
         if not ids:
             raise ValueError("empty prompt")
         if self.max_src_tokens is not None:

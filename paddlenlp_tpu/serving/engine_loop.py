@@ -240,6 +240,18 @@ class ServingMetrics:
             "paddlenlp_serving_kv_utilization", "1 - free/total KV blocks")
         self.spec_accept = r.gauge(
             "paddlenlp_serving_spec_acceptance_rate", "Accepted/drafted speculative tokens")
+        self.prefix_hits = r.counter(
+            "paddlenlp_serving_prefix_cache_hits_total",
+            "Admissions that reused >=1 cached KV block from the prefix cache")
+        self.prefix_cached_tokens = r.counter(
+            "paddlenlp_serving_prefix_cache_cached_tokens_total",
+            "Prompt tokens whose prefill was skipped via cached KV blocks")
+        self.prefix_evictions = r.counter(
+            "paddlenlp_serving_prefix_cache_evictions_total",
+            "Cached KV blocks evicted under allocation pressure")
+        self.kv_cached = r.gauge(
+            "paddlenlp_serving_kv_cached_blocks",
+            "KV blocks registered in the prefix-cache index")
         self.rebind(engine)
 
     def rebind(self, engine):
@@ -257,6 +269,14 @@ class ServingMetrics:
             lambda: 1.0 - mgr.num_free / max(mgr.total_usable_blocks, 1))
         self.spec_accept.set_function(
             lambda: engine.spec_stats["accepted"] / max(engine.spec_stats["drafted"], 1))
+        self.kv_cached.set_function(lambda: getattr(mgr, "num_cached_blocks", 0))
+        # prefix-cache counters are deltas off the engine's monotone totals;
+        # a rebuilt engine restarts its totals at 0, so rebaseline here
+        self._pc_last = {
+            "hits": getattr(mgr, "cache_hits", 0),
+            "cached_tokens": getattr(mgr, "cached_tokens_total", 0),
+            "evictions": getattr(mgr, "evictions", 0),
+        }
 
     def on_finished(self, req):
         status = req.finish_reason or ("abort" if req.aborted else "unknown")
@@ -272,6 +292,15 @@ class ServingMetrics:
     def on_step(self, stats: Dict, preempt_delta: int):
         if preempt_delta > 0:
             self.preemptions.inc(preempt_delta)
+        pc = stats.get("prefix_cache")
+        if pc:
+            for key, counter in (("hits", self.prefix_hits),
+                                 ("cached_tokens", self.prefix_cached_tokens),
+                                 ("evictions", self.prefix_evictions)):
+                delta = pc.get(key, 0) - self._pc_last[key]
+                if delta > 0:
+                    counter.inc(delta)
+                self._pc_last[key] = pc.get(key, 0)
 
 
 class EngineLoop:

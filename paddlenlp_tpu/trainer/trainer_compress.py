@@ -250,7 +250,10 @@ def _prune_depth(trainer, output_dir: str, depth_mult: float = 0.5):
     out = {}
     import re as _re
 
-    layer_pat = _re.compile(r"(.*\blayers?_)(\d+)(\b.*)")
+    # NOT \blayers?_ : underscore-joined module names (bert's encoder_layer_0,
+    # ernie's encoder_layers_0) have no word boundary before "layer", so \b
+    # never fires and BERT-family depth pruning found no per-layer params
+    layer_pat = _re.compile(r"(.*?layers?_)(\d+)(?=[/_]|$)")
     renumber = {int(old): i for i, old in enumerate(keep)}
     scanned = getattr(cfg, "use_scan_layers", False)
     n_sliced = n_dropped = 0
@@ -261,7 +264,7 @@ def _prune_depth(trainer, output_dir: str, depth_mult: float = 0.5):
             if old not in renumber:
                 n_dropped += 1
                 continue
-            out[f"{m.group(1)}{renumber[old]}{m.group(3)}"] = leaf
+            out[f"{m.group(1)}{renumber[old]}{path[m.end():]}"] = leaf
             continue
         # scan-stacked layer params live under the index-less "layers" module
         # (model/layers/...): match by PATH, not by a shape[0]==L coincidence

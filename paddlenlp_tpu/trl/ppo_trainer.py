@@ -123,6 +123,10 @@ class PPOTrainer(Trainer):
             block_size=16,
             num_blocks=max(512, 4 * self._engine_blocks_needed()),
             max_blocks_per_seq=256,
+            # the prefix cache is keyed on token content only — valid solely
+            # under frozen weights. PPO updates the policy between rollouts,
+            # so cached KV from round N would poison round N+1's prompts.
+            enable_prefix_cache=False,
         )
         if self.ppo_config.use_value_model:
             self._init_value_model(value_model)

@@ -75,6 +75,53 @@ class TestCompress:
         assert np.isfinite(np.asarray(logits)).all()
 
 
+class TestDepthPrune:
+    """Depth pruning must see per-layer params in BOTH naming families:
+    scanned llama ("model/layers/...") and underscore-joined bert encoders
+    ("bert/encoder_layer_0/...") — \\blayers?_ never matched the latter, so
+    BERT depth pruning raised "no per-layer params found"."""
+
+    @staticmethod
+    def _shim(model):
+        # _prune_depth only reads trainer.model / trainer.train_state, so a
+        # shim keeps the test off Trainer (whose mesh setup needs a newer jax)
+        import types
+
+        return types.SimpleNamespace(model=model, train_state=None)
+
+    def test_depth_prune_bert(self, tmp_path):
+        from paddlenlp_tpu.trainer.trainer_compress import _prune_depth
+        from paddlenlp_tpu.transformers import BertConfig, BertForSequenceClassification
+
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=4,
+                         num_attention_heads=2, intermediate_size=64,
+                         max_position_embeddings=64, num_labels=2)
+        model = BertForSequenceClassification.from_config(cfg, seed=0)
+        out = _prune_depth(self._shim(model), str(tmp_path / "pruned"), depth_mult=0.5)
+        reloaded = BertForSequenceClassification.from_pretrained(out)
+        assert reloaded.config.num_hidden_layers == 2
+        from paddlenlp_tpu.transformers.conversion_utils import flatten_params
+
+        paths = set(flatten_params(reloaded.params))
+        # kept layers are renumbered contiguously from 0
+        assert any("encoder_layer_0/" in p for p in paths)
+        assert any("encoder_layer_1/" in p for p in paths)
+        assert not any("encoder_layer_2/" in p or "encoder_layer_3/" in p for p in paths)
+        logits = reloaded(input_ids=jnp.asarray([[5, 6, 7]], jnp.int32)).logits
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_depth_prune_llama_scanned_still_works(self, tmp_path):
+        from paddlenlp_tpu.trainer.trainer_compress import _prune_depth
+        from paddlenlp_tpu.transformers import LlamaForCausalLM
+
+        model = tiny()
+        out = _prune_depth(self._shim(model), str(tmp_path / "pruned"), depth_mult=0.5)
+        reloaded = LlamaForCausalLM.from_pretrained(out)
+        assert reloaded.config.num_hidden_layers == 1
+        logits = reloaded(input_ids=jnp.asarray([[5, 6, 7]], jnp.int32)).logits
+        assert np.isfinite(np.asarray(logits)).all()
+
+
 class TestArgKnobs:
     def test_obsolete_fleet_options_warn(self, tmp_path):
         args = TrainingArguments(output_dir=str(tmp_path),

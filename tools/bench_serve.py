@@ -14,6 +14,11 @@ Usage::
     python tools/bench_serve.py --replicas 2     # router front tier over 2 CPU
                                                  # replicas; the JSON line adds
                                                  # request_share/failovers/rerouted
+    python tools/bench_serve.py --prefix-share 0.75
+                                                 # 75% of requests reuse one long
+                                                 # common prefix; the JSON line's
+                                                 # prefix_cache_hit_rate and
+                                                 # cached_tokens track the win
 """
 
 from __future__ import annotations
@@ -52,6 +57,12 @@ def _arg(flag: str, default: int) -> int:
     return default
 
 
+def _farg(flag: str, default: float) -> float:
+    if flag in sys.argv:
+        return float(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
 def run() -> None:
     _force_cpu()
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
@@ -66,6 +77,11 @@ def run() -> None:
     concurrency = _arg("--concurrency", 8)
     max_tokens = _arg("--max-tokens", 16)
     n_replicas = _arg("--replicas", 1)
+    prefix_share = _farg("--prefix-share", 0.0)
+    if not 0.0 <= prefix_share <= 1.0:
+        _fail(f"--prefix-share must be in [0, 1], got {prefix_share}")
+    # 24 tokens = 6 full blocks at block_size=4: a warm hit skips all of them
+    shared_prefix = [9, 8, 7, 6, 5, 4, 3, 2] * 3
 
     cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
                       num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
@@ -99,7 +115,15 @@ def run() -> None:
     def one_request(i: int, stats: dict):
         t0 = time.time()
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=RUN_TIMEOUT_S)
-        body = json.dumps({"prompt": [5 + i % 8, 6, 7], "max_tokens": max_tokens, "stream": True})
+        # --prefix-share P: fraction P of requests open with one long common
+        # prefix (a system prompt stand-in), so the prefix cache has something
+        # to hit; the unique tail keeps every request distinct. The golden-
+        # ratio stride spreads the P fraction evenly even for small N
+        if (i * 0.6180339887) % 1.0 < prefix_share:
+            prompt = shared_prefix + [5 + i % 8, 6, 7]
+        else:
+            prompt = [5 + i % 8, 6, 7]
+        body = json.dumps({"prompt": prompt, "max_tokens": max_tokens, "stream": True})
         conn.request("POST", "/v1/completions", body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
@@ -214,6 +238,11 @@ def run() -> None:
         "kv_free_blocks": scalar_sum("paddlenlp_serving_kv_free_blocks"),
         "preemptions": scalar_sum("paddlenlp_serving_preemptions_total"),
         "tokens_generated": scalar_sum("paddlenlp_serving_tokens_generated_total"),
+        "prefix_share": prefix_share,
+        # hit rate over every request the engines saw (timed + warmup)
+        "prefix_cache_hit_rate": round(
+            scalar_sum("paddlenlp_serving_prefix_cache_hits_total") / (n_requests + 1), 4),
+        "cached_tokens": int(scalar_sum("paddlenlp_serving_prefix_cache_cached_tokens_total")),
     }
     if fleet is not None:
         router_fams = parse_prometheus_text(scraped)

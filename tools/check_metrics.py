@@ -32,6 +32,10 @@ def _stub_engine():
         num_free = 42
         total_usable_blocks = 64
         max_blocks_per_seq = 8
+        num_cached_blocks = 3
+        cache_hits = 0
+        cached_tokens_total = 0
+        evictions = 0
 
     class _Engine:
         mgr = _Mgr()
