@@ -172,6 +172,11 @@ class InferenceEngine:
         self._spec_rngs: Dict[int, np.random.Generator] = {}
         self.spec_stats = {"verify_steps": 0, "tokens_emitted": 0, "drafted": 0, "accepted": 0}
         self.num_preemptions = 0
+        # monotone step id: stamped on host spans AND on the device timeline
+        # via jax.profiler.StepTraceAnnotation, so a span in /debug/trace and
+        # an XLA op in a device profile join on the same number
+        self._step_seq = itertools.count()
+        self._cur_step = -1
         # serving hook: called after every step() with a stats dict (queue
         # depth, running slots, free KV blocks) — the metrics plane subscribes
         # here instead of monkey-patching the loop
@@ -300,9 +305,15 @@ class InferenceEngine:
     def step(self) -> List[Request]:
         """One engine iteration: admit + decode. Returns requests finished this step."""
         _F_STEP.fire()
+        self._cur_step = next(self._step_seq)
         finished: List[Request] = []
-        self._admit(finished)
-        self._decode_running(finished)
+        # StepTraceAnnotation brackets this step on the device timeline: a
+        # jax.profiler capture (POST /debug/profile) shows per-step lanes
+        # whose step_num matches the step= arg on the host prefill/decode
+        # spans — host stall or device stall is one cross-reference away
+        with jax.profiler.StepTraceAnnotation("engine_step", step_num=self._cur_step):
+            self._admit(finished)
+            self._decode_running(finished)
         if self.step_cb is not None:
             self.step_cb(self.stats())
         return finished
@@ -389,6 +400,7 @@ class InferenceEngine:
         if admitted or len(finished) > n_finished0:
             TRACER.add_span("admission", TRACER.epoch_time(admit_t0),
                             time.perf_counter() - admit_t0, cat="engine",
+                            step=self._cur_step,
                             queue_depth=queue_depth, admitted=len(admitted),
                             rejected_capacity=len(finished) - n_finished0)
         if cache_on and admitted:
@@ -441,6 +453,7 @@ class InferenceEngine:
             counts_dev = jnp.zeros((n, vocab), jnp.int32) if counts_in is None \
                 else jnp.asarray(counts_in)
             with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
+                             step=self._cur_step,
                              req_ids=[r.req_id for _, r, _ in group],
                              cached_tokens=int(cached_lens.sum())):
                 tokens, counts_rows, self.pool = self.infer.prefill(
@@ -618,7 +631,7 @@ class InferenceEngine:
             tokens[i, 1 : 1 + len(d)] = d
             tables[i] = self.mgr.table_array(req.req_id)
             start[i] = req.total_len - 1  # position of the token being fed
-        with TRACER.span("spec_verify", cat="engine", mode=mode,
+        with TRACER.span("spec_verify", cat="engine", mode=mode, step=self._cur_step,
                          drafted=int(sum(len(d) for d in drafts))):
             # greedy acceptance never reads the logits: need_logits=False keeps
             # the [B, K+1, V] fp32 buffer from materializing at all
@@ -697,6 +710,7 @@ class InferenceEngine:
             # emit 1 token/seq for (K+1)x the compute — use the multi-step
             # decode instead and only pay for verification when drafts exist
             with TRACER.span("spec_propose", cat="engine", mode=mode,
+                             step=self._cur_step,
                              proposer="draft_model" if self.draft_model is not None else "ngram"):
                 if self.draft_model is not None:
                     drafts, qprobs = self._propose_drafts_draft_model(mode)
@@ -734,7 +748,7 @@ class InferenceEngine:
             ctx[i] = req.total_len - 1  # position of the token being fed
             done0[i] = False
             remaining[i] = req.remaining_new
-        with TRACER.span("decode", cat="engine", steps=steps,
+        with TRACER.span("decode", cat="engine", steps=steps, step=self._cur_step,
                          active=int(sum(1 for r in self.slots if r is not None))):
             toks, valid, _, _, self.counts, self.pool = self.infer.decode(
                 self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),

@@ -1,6 +1,6 @@
 """Unified observability layer shared by serving and training.
 
-Three pieces, all stdlib-only at import time:
+Four pieces, all stdlib-only at import time:
 
 - :mod:`.tracer` — thread-safe span tracing into a bounded ring buffer,
   exportable as Chrome trace-event JSON (Perfetto) or JSONL; the process-wide
@@ -11,20 +11,33 @@ Three pieces, all stdlib-only at import time:
   jobs); the serving API mounts the same data on its existing server.
 - :mod:`.prometheus` — text-format parsing + exposition lint for scrapers and
   ``tools/check_metrics.py``.
+- :mod:`.slo` — multi-window availability/TTFT burn rates over federated
+  replica counters (the router's ``/fleet/slo`` plane).
 
 The metric registry itself lives in :mod:`paddlenlp_tpu.serving.metrics`
 (predates this package; its names are stable API) — this package is the
 tracing/exposition layer around it.
 """
 
-from .exporter import ObservabilityExporter  # noqa: F401
+from .exporter import ObservabilityExporter, ProfileCapture  # noqa: F401
 from .prometheus import (  # noqa: F401
     MetricFamily,
     histogram_quantile,
     lint_exposition,
     parse_prometheus_text,
 )
-from .tracer import TRACER, Span, SpanTracer, current_trace, use_trace  # noqa: F401
+from .slo import SLOObjectives, SLOTracker, slo_inputs_from_families  # noqa: F401
+from .tracer import (  # noqa: F401
+    TRACER,
+    Span,
+    SpanTracer,
+    current_trace,
+    format_traceparent,
+    merge_chrome_traces,
+    parse_traceparent,
+    trace_sampled,
+    use_trace,
+)
 
 __all__ = [
     "Span",
@@ -32,9 +45,17 @@ __all__ = [
     "TRACER",
     "use_trace",
     "current_trace",
+    "trace_sampled",
+    "format_traceparent",
+    "parse_traceparent",
+    "merge_chrome_traces",
     "ObservabilityExporter",
+    "ProfileCapture",
     "MetricFamily",
     "parse_prometheus_text",
     "histogram_quantile",
     "lint_exposition",
+    "SLOObjectives",
+    "SLOTracker",
+    "slo_inputs_from_families",
 ]

@@ -20,6 +20,17 @@ leak. Export formats:
 Trace context: a span can carry a ``trace`` id (e.g. ``req-42`` or ``train``)
 linking every phase of one request/step across threads. ``use_trace()`` sets an
 ambient id via ``contextvars`` so nested spans inherit it without plumbing.
+
+Head-based sampling: under heavy traffic the per-request span volume (queue/
+prefill/decode phases, kv alloc/free instants, sampling spans) dominates the
+ring. ``sample_every=N`` keeps 1-in-N traces — the decision is a deterministic
+hash of the trace id (:func:`trace_sampled`), so every process that sees the
+same id independently agrees — and unsampled traces take a no-op path that
+costs one hash + dict probe per span, not a record. A tier ahead of this one
+(the router) can pin the decision explicitly via :meth:`SpanTracer.mark_trace`
+after parsing the propagated traceparent header (:func:`parse_traceparent`).
+Trace-less spans (batch-level engine phases, trainer steps) are never sampled
+out.
 """
 
 from __future__ import annotations
@@ -29,10 +40,56 @@ import contextvars
 import json
 import threading
 import time
-from collections import deque
-from typing import Any, Dict, Iterable, List, Optional
+import zlib
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-__all__ = ["Span", "SpanTracer", "TRACER", "use_trace", "current_trace"]
+__all__ = [
+    "Span", "SpanTracer", "TRACER", "use_trace", "current_trace",
+    "trace_sampled", "TRACEPARENT_HEADER", "format_traceparent",
+    "parse_traceparent", "merge_chrome_traces",
+]
+
+#: cross-tier trace propagation header (traceparent-style: trace id + parent
+#: span id + sampled flag). Custom name because our trace ids (``rtr-N``)
+#: are not W3C 16-byte hex ids.
+TRACEPARENT_HEADER = "X-Pdnlp-Traceparent"
+
+
+def trace_sampled(trace_id: str, sample_every: int) -> bool:
+    """Deterministic 1-in-N sampling decision for a trace id. Stable across
+    processes and runs (crc32, not Python ``hash``) so the router and every
+    replica agree on the same id without coordination."""
+    if sample_every <= 1:
+        return True
+    return zlib.crc32(trace_id.encode()) % sample_every == 0
+
+
+def format_traceparent(trace_id: str, parent_id: str = "", sampled: bool = True) -> str:
+    """Render the propagation header value: ``<trace>;parent=<id>;sampled=<0|1>``."""
+    return f"{trace_id};parent={parent_id};sampled={1 if sampled else 0}"
+
+
+def parse_traceparent(value: Optional[str]):
+    """Parse a propagation header into ``(trace_id, parent_id, sampled)``;
+    returns None for missing/malformed values (the receiver then mints its own
+    id). Unknown ``k=v`` fields are ignored for forward compatibility."""
+    if not value:
+        return None
+    parts = [p.strip() for p in value.split(";")]
+    trace_id = parts[0]
+    if not trace_id or any(c.isspace() for c in trace_id):
+        return None
+    parent_id, sampled = "", True
+    for part in parts[1:]:
+        if "=" not in part:
+            continue
+        k, v = part.split("=", 1)
+        if k == "parent":
+            parent_id = v
+        elif k == "sampled":
+            sampled = v.strip() not in ("0", "false")
+    return trace_id, parent_id, sampled
 
 _trace_ctx: contextvars.ContextVar = contextvars.ContextVar("pdnlp_trace", default=None)
 
@@ -136,14 +193,23 @@ _NULL = _NullCtx()
 class SpanTracer:
     """Bounded-ring span recorder; every method is thread-safe."""
 
-    def __init__(self, capacity: int = 8192, enabled: bool = True):
+    def __init__(self, capacity: int = 8192, enabled: bool = True,
+                 sample_every: int = 1):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
+        if sample_every < 1:
+            raise ValueError("sample_every must be >= 1")
         self.capacity = capacity
         self.enabled = enabled
+        self.sample_every = sample_every  # 1 = record every trace
         self._buf: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self.dropped = 0  # spans evicted by the ring since the last clear()
+        # explicit per-trace decisions (propagated from an upstream tier);
+        # bounded so a long-lived process cannot leak one entry per request —
+        # an evicted entry just falls back to the deterministic hash
+        self._trace_marks: "OrderedDict[str, bool]" = OrderedDict()
+        self._marks_cap = 4096
         # anchor perf_counter to the epoch once so spans from all threads share
         # one monotonic-but-absolute timeline (time.time() can step backwards)
         self._epoch0 = time.time() - time.perf_counter()
@@ -161,20 +227,46 @@ class SpanTracer:
         to wall-clock steps). Use for since_ts cursors over :meth:`snapshot`."""
         return self._to_epoch(time.perf_counter())
 
+    # ------------------------------------------------------------- sampling
+    def mark_trace(self, trace_id: str, sampled: bool):
+        """Pin the sampling decision for one trace id (propagated from an
+        upstream tier's traceparent header — overrides the local hash)."""
+        with self._lock:
+            self._trace_marks[trace_id] = sampled
+            self._trace_marks.move_to_end(trace_id)
+            while len(self._trace_marks) > self._marks_cap:
+                self._trace_marks.popitem(last=False)
+
+    def trace_is_sampled(self, trace_id: Optional[str]) -> bool:
+        """True if spans carrying ``trace_id`` should record. Trace-less spans
+        always record; marked traces use the pinned decision; otherwise the
+        deterministic hash against ``sample_every``."""
+        if trace_id is None:
+            return True
+        mark = self._trace_marks.get(trace_id)  # racy read is fine: bool or None
+        if mark is not None:
+            return mark
+        return self.sample_every <= 1 or trace_sampled(trace_id, self.sample_every)
+
     # ------------------------------------------------------------- recording
     def span(self, name: str, cat: str = "", trace: Optional[str] = None, **args):
         """``with tracer.span("prefill", cat="engine", batch=4): ...``"""
         if not self.enabled:
             return _NULL
-        return _SpanCtx(self, name, cat, trace if trace is not None else current_trace(),
-                        args or None)
+        t = trace if trace is not None else current_trace()
+        if not self.trace_is_sampled(t):
+            return _NULL
+        return _SpanCtx(self, name, cat, t, args or None)
 
     def instant(self, name: str, cat: str = "", trace: Optional[str] = None, **args):
         """Zero-duration marker (preemption, eviction, window edges)."""
         if not self.enabled:
             return
+        t = trace if trace is not None else current_trace()
+        if not self.trace_is_sampled(t):
+            return
         self._record(name, cat, self._to_epoch(time.perf_counter()), None,
-                     trace if trace is not None else current_trace(), args or None)
+                     t, args or None)
 
     def add_span(self, name: str, start_t: float, dur: float, cat: str = "",
                  trace: Optional[str] = None, wall: bool = False, **args):
@@ -184,7 +276,7 @@ class SpanTracer:
         timestamps (the engine's per-request ``arrival_t``/``sched_t``/...
         bookkeeping): they are re-anchored so a wall-clock step between capture
         and record cannot shear these spans away from live perf-anchored ones."""
-        if not self.enabled:
+        if not self.enabled or not self.trace_is_sampled(trace):
             return
         if wall:
             start_t = start_t + (self.now() - time.time())
@@ -216,9 +308,13 @@ class SpanTracer:
         return spans
 
     def clear(self):
+        """Full reset: spans, the drop count, AND pinned per-trace sampling
+        marks — a cleared tracer must not keep suppressing trace ids that a
+        previous traffic epoch (or test) marked unsampled."""
         with self._lock:
             self._buf.clear()
             self.dropped = 0
+            self._trace_marks.clear()
 
     # ------------------------------------------------------------- export
     def chrome_trace(self, spans: Optional[Iterable[Span]] = None) -> Dict[str, Any]:
@@ -263,6 +359,35 @@ class SpanTracer:
     def write_chrome_trace(self, path: str, spans: Optional[Iterable[Span]] = None):
         with open(path, "w") as f:
             json.dump(self.chrome_trace(spans), f)
+
+
+def merge_chrome_traces(tiers: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Stitch per-process Chrome traces into one multi-process timeline.
+
+    Each tier is ``{"name": str, "events": [chrome events], "offset_s": float,
+    "dropped": int}`` — ``events`` as produced by :meth:`SpanTracer.chrome_trace`
+    (or scraped from another process's ``/debug/trace``), ``offset_s`` the
+    estimated clock offset of that process relative to the reference tier
+    (``remote_now - local_now``; its timestamps are shifted by ``-offset_s`` so
+    everything lands on the reference timeline). Tiers become distinct ``pid``
+    lanes with ``process_name`` metadata; per-tier ring-drop counts ride in
+    ``otherData`` so a consumer knows when a timeline has holes.
+    """
+    events: List[Dict[str, Any]] = []
+    dropped: Dict[str, int] = {}
+    for pid, tier in enumerate(tiers, start=1):
+        shift_us = -float(tier.get("offset_s", 0.0)) * 1e6
+        for ev in tier.get("events", ()):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") != "M" and "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift_us, 3)
+            events.append(ev)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": tier.get("name", f"process-{pid}")}})
+        dropped[tier.get("name", f"process-{pid}")] = int(tier.get("dropped", 0))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": dropped}}
 
 
 #: process-wide tracer (serving loop, engine phases, trainer steps all share it)

@@ -13,14 +13,21 @@ Routes::
                             "seed"/"do_sample", "timeout": float}
     POST /v1/abort         {"id": "cmpl-N"}        — cancel an in-flight request
     GET  /metrics          Prometheus text exposition
-    GET  /health           liveness + scheduler/engine stats
+    GET  /health           liveness + scheduler/engine stats + tracer clock
     GET  /debug/requests   in-flight + recently finished request timelines
     GET  /debug/trace      span ring buffer as Chrome trace JSON (Perfetto)
     GET  /debug/spans      span ring buffer as structured JSONL
+    POST /debug/profile    on-demand jax.profiler capture (?seconds=S; 409
+                           while another capture runs)
 
 Backpressure maps to HTTP: 429 when the admission window is full (retryable),
 503 while draining, 413 for oversized bodies. A client disconnect mid-stream
 aborts the request so its KV blocks free immediately.
+
+Cross-tier tracing: a ``X-Pdnlp-Traceparent`` request header (stamped by the
+router) makes the replica adopt the inbound trace id instead of minting its
+own ``req-N``, and pins the propagated 1-in-N sampling decision on the tracer
+— the router can then stitch both tiers' spans into one timeline.
 """
 
 from __future__ import annotations
@@ -31,8 +38,8 @@ import threading
 from http.server import ThreadingHTTPServer
 from typing import Dict, Optional
 
-from ..observability.exporter import route_observability
-from ..observability.tracer import TRACER
+from ..observability.exporter import handle_profile_request, route_observability
+from ..observability.tracer import TRACEPARENT_HEADER, TRACER, parse_traceparent, use_trace
 from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
 from .httputil import JsonRequestHandler
@@ -79,11 +86,16 @@ class ServingServer:
                  max_body_bytes: int = MAX_BODY_BYTES,
                  max_src_tokens: Optional[int] = None,
                  engine_factory=None,
-                 supervisor_policy: Optional[SupervisorPolicy] = None):
+                 supervisor_policy: Optional[SupervisorPolicy] = None,
+                 trace_sample_every: Optional[int] = None):
         self.engine = engine
         self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
         self.registry = registry or REGISTRY
         self.tracer = TRACER
+        if trace_sample_every is not None:
+            # standalone 1-in-N sampling knob (router-fronted replicas get the
+            # decision in the traceparent header instead)
+            self.tracer.sample_every = int(trace_sample_every)
         self.max_body_bytes = max_body_bytes
         self.max_src_tokens = max_src_tokens
         self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry),
@@ -118,8 +130,12 @@ class ServingServer:
             ids = ids[-self.max_src_tokens:]
         return ids
 
-    def submit(self, payload: dict):
-        """Parse + admit one completion request. Returns (completion_id, handle)."""
+    def submit(self, payload: dict, traceparent: Optional[str] = None):
+        """Parse + admit one completion request. Returns (completion_id, handle).
+
+        ``traceparent`` is the raw inbound propagation header (if any): the
+        request adopts the upstream trace id and sampling decision, so its
+        spans stitch into the router's timeline under one id."""
         if "prompt" not in payload:
             raise ValueError("missing required field 'prompt'")
         ids = self._encode(payload["prompt"])
@@ -136,8 +152,18 @@ class ServingServer:
             max_retries = int(max_retries)
             if max_retries < 0:
                 raise ValueError("max_retries must be >= 0")
+        trace_id = None
+        ctx = parse_traceparent(traceparent)
+        if ctx is not None:
+            trace_id, parent_id, sampled = ctx
+            # pin the upstream decision BEFORE any span can record under the id
+            self.tracer.mark_trace(trace_id, sampled)
+            # parentage marker: ties the adopted trace back to the tier that
+            # placed it (recorded only for sampled traces, like every span)
+            self.tracer.instant("trace_adopted", cat="serving", trace=trace_id,
+                                parent=parent_id)
         handle = self.scheduler.submit(ids, sampling, timeout_s=timeout_s,
-                                       max_retries=max_retries)
+                                       max_retries=max_retries, trace=trace_id)
         cid = f"cmpl-{next(self._ids)}"
         with self._live_lock:
             self._live[cid] = handle
@@ -203,6 +229,9 @@ class ServingServer:
                             "status": status,
                             "scheduler": server.scheduler.stats(),
                             "engine": server.loop.engine.stats(),
+                            # tracer-timeline clock, piggybacked for the
+                            # router's RTT-midpoint clock-skew estimate
+                            "now": server.tracer.now(),
                         }, headers=headers)
                     elif self.path == "/debug/requests":
                         self._send_json(200, {
@@ -217,7 +246,16 @@ class ServingServer:
             # --------------------------------------------------------- POST
             def do_POST(self):
                 try:
-                    if self.path == "/v1/completions":
+                    if self.path.split("?", 1)[0] == "/debug/profile":
+                        # drain any request body before responding: leftover
+                        # bytes would desync the next request on this
+                        # keep-alive connection
+                        n = int(self.headers.get("Content-Length") or 0)
+                        if n:
+                            self.rfile.read(n)
+                        routed = handle_profile_request(self.path)
+                        self._send_raw(routed[0], routed[2], routed[1])
+                    elif self.path == "/v1/completions":
                         payload = self._read_body()
                         if payload is not None:
                             self._completions(payload)
@@ -240,7 +278,8 @@ class ServingServer:
 
             def _completions(self, payload: dict):
                 try:
-                    cid, handle = server.submit(payload)
+                    cid, handle = server.submit(
+                        payload, traceparent=self.headers.get(TRACEPARENT_HEADER))
                 except SaturatedError as e:
                     self._send_error_json(429, str(e), "rate_limit_exceeded")
                     return
@@ -257,10 +296,13 @@ class ServingServer:
                 except (ValueError, TypeError) as e:
                     self._send_error_json(400, str(e), "invalid_request")
                     return
-                if payload.get("stream"):
-                    self._stream_response(cid, handle)
-                else:
-                    self._batch_response(cid, handle)
+                # ambient trace id: log records emitted while serving this
+                # request carry it in JSON log mode (log <-> trace join key)
+                with use_trace(handle.trace):
+                    if payload.get("stream"):
+                        self._stream_response(cid, handle)
+                    else:
+                        self._batch_response(cid, handle)
 
             def _batch_response(self, cid: str, handle):
                 req = handle.result()  # deadline enforced by the loop

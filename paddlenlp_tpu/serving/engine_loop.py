@@ -406,17 +406,21 @@ class EngineLoop:
 
     # ------------------------------------------------------------- client api
     def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None,
-               max_retries: Optional[int] = None) -> RequestHandle:
+               max_retries: Optional[int] = None,
+               trace: Optional[str] = None) -> RequestHandle:
         """Thread-safe request submission; returns immediately with a handle.
 
         ``max_retries`` overrides the supervisor policy's per-request requeue
         budget (0 = never requeue across an engine rebuild: fail fast with
-        ``finish_reason="engine_error"``)."""
+        ``finish_reason="engine_error"``). ``trace`` adopts an inbound trace id
+        (the router's ``rtr-N`` from the traceparent header) instead of minting
+        a local ``req-N`` — the key to cross-tier trace stitching."""
         if not self.running:
             raise RuntimeError("engine loop is not running")
         deadline_t = None if deadline_s is None else time.time() + deadline_s
         handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t,
-                               trace=f"req-{next(self._trace_seq)}", max_retries=max_retries)
+                               trace=trace if trace is not None else f"req-{next(self._trace_seq)}",
+                               max_retries=max_retries)
         handle._prompt_ids = [int(t) for t in prompt_ids]
         handle._sampling = sampling
         self._cmds.put(("submit", handle, prompt_ids, sampling))

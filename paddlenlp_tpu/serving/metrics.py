@@ -49,6 +49,8 @@ def _escape(v: str) -> str:
 
 
 def _format_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
     if math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
     if float(v).is_integer():
@@ -148,7 +150,7 @@ class Gauge(_Metric):
                 v = float(self._fn())
             except Exception:
                 v = float("nan")
-            return [f"{self.name} {_format_value(v) if not math.isnan(v) else 'NaN'}"]
+            return [f"{self.name} {_format_value(v)}"]
         with self._lock:
             items = sorted(self._values.items())
         if not items and not self.labelnames:

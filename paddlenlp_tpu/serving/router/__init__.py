@@ -17,7 +17,7 @@ stdlib-only HTTP router that fronts N ``ServingServer`` replicas —
 """
 
 from .launcher import ReplicaFleet, launch_fleet, launch_replicas  # noqa: F401
-from .metrics import RouterMetrics  # noqa: F401
+from .metrics import RouterMetrics, federate_expositions, lint_federation  # noqa: F401
 from .policy import (  # noqa: F401
     HashRing,
     LeastLoadedPolicy,
@@ -44,6 +44,8 @@ __all__ = [
     "ReplicaSnapshot",
     "ProbeResult",
     "RouterMetrics",
+    "federate_expositions",
+    "lint_federation",
     "LeastLoadedPolicy",
     "PrefixAffinityPolicy",
     "HashRing",

@@ -89,18 +89,25 @@ def launch_replicas(n: int, engine_factory: Callable[[], object], *,
 def launch_fleet(n: int, engine_factory: Callable[[], object], *,
                  policy="least_loaded", router_registry: Optional[MetricsRegistry] = None,
                  poll_interval_s: float = 0.1, max_attempts: int = 3,
+                 trace_sample_every: int = 1,
                  host: str = "127.0.0.1", **replica_kw) -> ReplicaFleet:
     """``launch_replicas`` + a started :class:`RouterServer` in front.
 
     Returns the fleet with ``.router`` / ``.router_port`` set; one initial
     synchronous poll sweep runs before the port is returned so the first
     request already routes on real health/load data."""
+    from ...observability.tracer import SpanTracer
+
     fleet = launch_replicas(n, engine_factory, host=host, **replica_kw)
     try:
+        # private tracer: in-process replicas share the global TRACER, and a
+        # router recording into the same ring would double every stitched span
         router = RouterServer(fleet.endpoints(), policy=policy,
                               registry=router_registry or MetricsRegistry(),
                               poll_interval_s=poll_interval_s,
-                              max_attempts=max_attempts)
+                              max_attempts=max_attempts,
+                              trace_sample_every=trace_sample_every,
+                              tracer=SpanTracer())
         router.pool.poll_once()
         fleet.router = router
         fleet.router_port = router.start_in_thread(host=host)

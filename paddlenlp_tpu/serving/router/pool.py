@@ -35,6 +35,7 @@ import http.client
 import json
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from ...observability.tracer import TRACER
@@ -68,6 +69,10 @@ class ProbeResult:
     kv_utilization: Optional[float] = None
     retry_after_s: Optional[float] = None
     error: Optional[str] = None
+    # clock-sync piggyback: the replica's tracer-timeline "now" plus the
+    # probe's RTT — one offset estimate per probe (NTP-style midpoint)
+    clock_offset_s: Optional[float] = None
+    rtt_s: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +89,7 @@ class ReplicaSnapshot:
     retry_after_s: Optional[float]
     consecutive_failures: int
     last_poll_t: Optional[float]
+    clock_offset_s: Optional[float] = None  # replica tracer time - router tracer time
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -110,13 +116,24 @@ class Replica:
         self.last_poll_t: Optional[float] = None
         self.last_error: Optional[str] = None
         self.polls = 0  # probe count (drives the kv-scrape cadence)
+        # clock skew vs the router, for cross-tier trace stitching: each probe
+        # yields (rtt, offset); the lowest-RTT sample in the window wins (the
+        # midpoint assumption — request and response legs symmetric — is most
+        # credible when the network round trip was fastest)
+        self._offset_samples: deque = deque(maxlen=8)
+        self.clock_offset_s: Optional[float] = None
+
+    def note_offset(self, rtt_s: float, offset_s: float):
+        self._offset_samples.append((rtt_s, offset_s))
+        self.clock_offset_s = min(self._offset_samples)[1]
 
     def snapshot(self) -> ReplicaSnapshot:
         return ReplicaSnapshot(
             id=self.id, host=self.host, port=self.port, state=self.state,
             inflight=self.inflight, queue_depth=self.queue_depth,
             kv_utilization=self.kv_utilization, retry_after_s=self.retry_after_s,
-            consecutive_failures=self.consecutive_failures, last_poll_t=self.last_poll_t)
+            consecutive_failures=self.consecutive_failures, last_poll_t=self.last_poll_t,
+            clock_offset_s=self.clock_offset_s)
 
 
 class ReplicaPool:
@@ -125,7 +142,7 @@ class ReplicaPool:
     def __init__(self, metrics: Optional[RouterMetrics] = None,
                  poll_interval_s: float = 1.0, probe_timeout_s: float = 2.0,
                  down_after: int = 3, recovery_polls: int = 2,
-                 kv_scrape_every: int = 5):
+                 kv_scrape_every: int = 5, tracer=None):
         if down_after < 1:
             raise ValueError("down_after must be >= 1")
         if recovery_polls < 1:
@@ -133,6 +150,10 @@ class ReplicaPool:
         if kv_scrape_every < 1:
             raise ValueError("kv_scrape_every must be >= 1")
         self.metrics = metrics
+        # clock-offset probes must read the SAME timeline stitching shifts
+        # onto: the owning router's tracer (launch_fleet gives it a private
+        # one whose epoch anchor differs from the global TRACER's)
+        self.tracer = tracer if tracer is not None else TRACER
         self.poll_interval_s = poll_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.down_after = down_after
@@ -214,6 +235,7 @@ class ReplicaPool:
         _F_HEALTH_POLL.fire(replica=replica.id)
         conn = http.client.HTTPConnection(replica.host, replica.port,
                                           timeout=self.probe_timeout_s)
+        t0 = self.tracer.now()
         try:
             conn.request("GET", "/health")
             resp = conn.getresponse()
@@ -221,6 +243,7 @@ class ReplicaPool:
             body = json.loads(resp.read() or b"{}")
         finally:
             conn.close()
+        t1 = self.tracer.now()
         sched = body.get("scheduler") or {}
         engine = body.get("engine") or {}
         result = ProbeResult(
@@ -230,6 +253,12 @@ class ReplicaPool:
             queue_depth=int(engine.get("queue_depth", 0)),
             retry_after_s=float(retry_after) if retry_after else None,
         )
+        # clock-offset estimate for trace stitching: the replica stamped its
+        # tracer-timeline "now" somewhere inside [t0, t1]; assume the midpoint
+        remote_now = body.get("now")
+        if isinstance(remote_now, (int, float)):
+            result.rtt_s = t1 - t0
+            result.clock_offset_s = float(remote_now) - (t0 + t1) / 2.0
         # kv_utilization rides on the replica's Prometheus plane (pull gauge
         # sampled at scrape). Scraping + parsing the full exposition per poll
         # would dominate a fast poll interval, so it runs every Nth probe —
@@ -306,6 +335,8 @@ class ReplicaPool:
                 replica.queue_depth = result.queue_depth
                 if result.kv_utilization is not None:
                     replica.kv_utilization = result.kv_utilization
+                if result.clock_offset_s is not None and result.rtt_s is not None:
+                    replica.note_offset(result.rtt_s, result.clock_offset_s)
             new = replica.state
         if self.metrics is not None:
             self.metrics.replica_healthy.set(1.0 if new == HEALTHY else 0.0,
@@ -315,8 +346,8 @@ class ReplicaPool:
         if new != prev:
             logger.warning(f"router: replica {replica.id} {prev} -> {new}"
                            + (f" ({result.error})" if result.error else ""))
-            TRACER.instant("replica_state", cat="router", replica=replica.id,
-                           prev=prev, state=new, error=result.error)
+            self.tracer.instant("replica_state", cat="router", replica=replica.id,
+                                prev=prev, state=new, error=result.error)
 
     # ------------------------------------------------------------- proxy feedback
     def note_forward_failure(self, replica_id: str):
@@ -336,6 +367,14 @@ class ReplicaPool:
                                              queue_depth=replica.queue_depth,
                                              retry_after_s=retry_after_s),
                         probed=False)
+
+    def clock_offset(self, replica_id: str) -> float:
+        """Best current clock-offset estimate (replica tracer time minus router
+        tracer time) for a replica; 0.0 before any estimate exists."""
+        replica = self.get(replica_id)
+        if replica is None or replica.clock_offset_s is None:
+            return 0.0
+        return replica.clock_offset_s
 
     def retry_after_hint(self) -> float:
         """Largest replica-reported Retry-After (>=1s floor) — what the router

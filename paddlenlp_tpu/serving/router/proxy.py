@@ -28,28 +28,64 @@ failover is invisible to the client; ``POST /v1/abort`` is routed back to
 whichever replica currently owns the stream. The router's own observability
 plane (``/metrics``, ``/health``, ``/debug/trace``) rides on the shared
 registry/tracer machinery.
+
+**Fleet observability.** The router is where per-process planes become one:
+
+- every forward carries a traceparent-style header (trace id + parent span id
+  + sampled flag), and the replica adopts the ``rtr-N`` id instead of minting
+  its own — ``GET /debug/trace?trace=rtr-N`` then fetches the owning replica's
+  spans and stitches them with the router's into one multi-process Chrome
+  trace, correcting clock skew with the offset the health poller estimates
+  from probe-RTT midpoints;
+- the 1-in-N trace sampling decision (``trace_sample_every``) is made ONCE
+  here, by deterministic hash of the trace id, and propagated in the header —
+  unsampled requests take the tracer's no-op path in every tier;
+- ``GET /fleet/metrics`` merges the replicas' expositions (re-labeled
+  ``{replica="..."}``), and ``GET /fleet/slo`` computes multi-window
+  availability + TTFT burn rates over the federated counters
+  (``observability/slo.py``), exposed as ``paddlenlp_slo_*`` on the router's
+  own ``/metrics``.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import http.client
 import itertools
 import json
 import threading
 import time
+from collections import OrderedDict
 from http.server import ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, quote, urlsplit
 
 from ...observability.exporter import route_observability
-from ...observability.tracer import TRACER
+from ...observability.slo import (
+    DEFAULT_WINDOWS_S,
+    SLOInputs,
+    SLOObjectives,
+    SLOTracker,
+    slo_inputs_from_families,
+)
+from ...observability.tracer import (
+    TRACEPARENT_HEADER,
+    TRACER,
+    SpanTracer,
+    format_traceparent,
+    merge_chrome_traces,
+    trace_sampled,
+    use_trace,
+)
 from ...utils.faults import FaultPoint, InjectedFault
 from ...utils.log import logger
 from ..httputil import JsonRequestHandler
 from ..metrics import REGISTRY, MetricsRegistry
-from .metrics import RouterMetrics
+from ...observability.prometheus import parse_prometheus_text
+from .metrics import RouterMetrics, federate_families
 from .policy import resolve_policy
-from .pool import DEGRADED, HEALTHY, RECOVERING, ReplicaPool, ReplicaSnapshot
+from .pool import DEGRADED, DOWN, HEALTHY, RECOVERING, ReplicaPool, ReplicaSnapshot
 
 __all__ = ["RouterServer"]
 
@@ -68,9 +104,9 @@ class _RelayState:
     thread — no locking needed."""
 
     __slots__ = ("rid", "stream", "headers_sent", "tokens_relayed", "arrival_t",
-                 "attempts", "finished")
+                 "attempts", "finished", "sampled")
 
-    def __init__(self, rid: str, stream: bool):
+    def __init__(self, rid: str, stream: bool, sampled: bool = True):
         self.rid = rid
         self.stream = stream
         self.headers_sent = False
@@ -78,6 +114,7 @@ class _RelayState:
         self.arrival_t = time.perf_counter()  # original timing anchor
         self.attempts = 0
         self.finished = False  # a finish_reason chunk was relayed to the client
+        self.sampled = sampled  # head-based trace sampling decision
 
 
 class RouterServer:
@@ -87,15 +124,28 @@ class RouterServer:
                  policy="least_loaded", registry: Optional[MetricsRegistry] = None,
                  max_attempts: int = 3, max_body_bytes: int = MAX_BODY_BYTES,
                  poll_interval_s: float = 1.0, probe_timeout_s: float = 2.0,
-                 upstream_timeout_s: float = 600.0):
+                 upstream_timeout_s: float = 600.0,
+                 trace_sample_every: int = 1,
+                 tracer: Optional[SpanTracer] = None,
+                 slo_objectives: Optional[SLOObjectives] = None,
+                 slo_windows_s: Sequence[float] = DEFAULT_WINDOWS_S,
+                 scrape_timeout_s: float = 5.0):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        if trace_sample_every < 1:
+            raise ValueError("trace_sample_every must be >= 1")
         self.registry = registry or REGISTRY
-        self.tracer = TRACER
+        # a private tracer keeps router spans out of in-process replicas' rings
+        # (the launcher passes one); a dedicated router process uses the global
+        self.tracer = tracer if tracer is not None else TRACER
+        self.trace_sample_every = trace_sample_every
+        self.scrape_timeout_s = scrape_timeout_s
         self.metrics = RouterMetrics(self.registry)
+        self.slo = SLOTracker(objectives=slo_objectives, windows_s=slo_windows_s,
+                              registry=self.registry)
         self.pool = pool if pool is not None else ReplicaPool(
             metrics=self.metrics, poll_interval_s=poll_interval_s,
-            probe_timeout_s=probe_timeout_s)
+            probe_timeout_s=probe_timeout_s, tracer=self.tracer)
         if self.pool.metrics is None:
             self.pool.metrics = self.metrics
         for spec in replicas:
@@ -107,6 +157,10 @@ class RouterServer:
         self._ids = itertools.count()
         self._live: Dict[str, Tuple[str, str]] = {}  # rid -> (replica_id, upstream cid)
         self._live_lock = threading.Lock()
+        # trace id -> owning replica, SURVIVING request finish (stitching a
+        # trace is most useful after the request completed); bounded LRU
+        self._trace_owner: "OrderedDict[str, str]" = OrderedDict()
+        self._trace_owner_cap = 1024
         # router-side in-flight per replica: the poller's inflight reading is
         # up to a poll interval stale, so a burst arriving between polls would
         # all see the same "least-loaded" replica — forwards the router itself
@@ -121,8 +175,8 @@ class RouterServer:
         Re-run per attempt so health transitions observed mid-request (a
         candidate marked DOWN by the poller) are honored immediately."""
         t0 = time.perf_counter()
-        with TRACER.span("route", cat="router", trace=state.rid,
-                         attempt=state.attempts, excluded=len(exclude)) as sp:
+        with self.tracer.span("route", cat="router", trace=state.rid,
+                              attempt=state.attempts, excluded=len(exclude)) as sp:
             snaps = self._adjusted_snapshots()
             candidates = self.policy.select(snaps, prompt=prompt,
                                             exclude=frozenset(exclude))
@@ -147,16 +201,26 @@ class RouterServer:
         self.metrics.requests.inc(replica=replica_id, outcome=outcome)
         # NOT named "request": that name is the engine loop's per-request
         # timeline span, and /debug/trace consumers select by name
-        TRACER.add_span("router_request", TRACER.epoch_time(state.arrival_t),
-                        time.perf_counter() - state.arrival_t, cat="router",
-                        trace=state.rid, replica=replica_id, outcome=outcome,
-                        attempts=state.attempts, tokens=state.tokens_relayed)
+        self.tracer.add_span("router_request", self.tracer.epoch_time(state.arrival_t),
+                             time.perf_counter() - state.arrival_t, cat="router",
+                             trace=state.rid, replica=replica_id, outcome=outcome,
+                             attempts=state.attempts, tokens=state.tokens_relayed)
+        if replica_id != "none":
+            self._note_owner(state.rid, replica_id)
         with self._live_lock:
             self._live.pop(state.rid, None)
+
+    def _note_owner(self, rid: str, replica_id: str):
+        with self._live_lock:
+            self._trace_owner[rid] = replica_id
+            self._trace_owner.move_to_end(rid)
+            while len(self._trace_owner) > self._trace_owner_cap:
+                self._trace_owner.popitem(last=False)
 
     def _track(self, state: _RelayState, replica_id: str, upstream_cid: str):
         with self._live_lock:
             self._live[state.rid] = (replica_id, upstream_cid)
+        self._note_owner(state.rid, replica_id)
 
     # ------------------------------------------------------------- abort
     def abort(self, rid: str) -> bool:
@@ -197,6 +261,29 @@ class RouterServer:
 
             def do_GET(self):
                 try:
+                    parts = urlsplit(self.path)
+                    stitch_trace = None
+                    if parts.path == "/debug/trace":
+                        query = parse_qs(parts.query)
+                        # a since_ts cursor means an incremental scrape of the
+                        # router's own ring (route_observability contract) —
+                        # only plain ?trace= requests pay for a two-tier stitch
+                        if "since_ts" not in query:
+                            stitch_trace = query.get("trace", [None])[0]
+                    if stitch_trace is not None:
+                        # two-tier stitch when the owning replica is known;
+                        # falls back to the router-only timeline otherwise
+                        doc = router.stitched_trace(stitch_trace)
+                        self._send_raw(200, json.dumps(doc).encode(), "application/json")
+                        return
+                    if parts.path == "/fleet/metrics":
+                        text, _skipped = router.fleet_metrics()
+                        self._send_raw(200, text.encode(),
+                                       "text/plain; version=0.0.4; charset=utf-8")
+                        return
+                    if parts.path == "/fleet/slo":
+                        self._send_json(200, router.fleet_slo())
+                        return
                     routed = route_observability(self.path, router.registry, router.tracer)
                     if routed is not None:
                         self._send_raw(routed[0], routed[2], routed[1])
@@ -247,13 +334,132 @@ class RouterServer:
             return "degraded", 200
         return "unhealthy", 503
 
+    # ------------------------------------------------------------- fleet planes
+    def _scrape_replica(self, snap: ReplicaSnapshot, path: str) -> str:
+        conn = http.client.HTTPConnection(snap.host, snap.port,
+                                          timeout=self.scrape_timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            text = resp.read().decode()
+        finally:
+            conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"{snap.id}{path}: HTTP {resp.status}")
+        return text
+
+    def fleet_families(self) -> Tuple[Dict[str, Dict], List[str]]:
+        """Scrape + parse every non-DOWN replica's ``/metrics``. Returns
+        ``({replica_id: parsed families}, [skipped ids])`` — a dead,
+        unreachable, or unparseable replica shrinks the merge, it never fails
+        it (partial fleet data beats no fleet data during exactly the
+        incidents you scrape during). Scrapes run concurrently: one wedged
+        replica that the poller hasn't demoted yet costs the whole merge one
+        scrape timeout, not a timeout per bad replica."""
+        out: Dict[str, Dict] = {}
+        skipped: List[str] = []
+
+        def scrape(snap):
+            return snap.id, parse_prometheus_text(
+                self._scrape_replica(snap, "/metrics"))
+
+        live = []
+        for snap in self.pool.snapshots():
+            if snap.state == DOWN:
+                skipped.append(snap.id)
+            else:
+                live.append(snap)
+        if not live:
+            return out, skipped
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, len(live))) as pool:
+            futures = {pool.submit(scrape, s): s for s in live}
+            for fut in concurrent.futures.as_completed(futures):
+                snap = futures[fut]
+                try:
+                    rid, fams = fut.result()
+                    out[rid] = fams
+                except Exception as e:
+                    logger.warning(f"router: fleet scrape of {snap.id} failed: {e!r}")
+                    self.metrics.fleet_scrape_errors.inc(replica=snap.id)
+                    skipped.append(snap.id)
+        return out, skipped
+
+    def fleet_metrics(self) -> Tuple[str, List[str]]:
+        """Federated exposition: every replica's samples under one scrape,
+        re-labeled ``{replica="..."}``."""
+        parsed, skipped = self.fleet_families()
+        return federate_families(parsed), skipped
+
+    def fleet_slo(self) -> Dict:
+        """Scrape → fold → burn rates. Each call is one SLO observation; the
+        tracker's history turns successive scrapes into windowed rates."""
+        parsed, skipped = self.fleet_families()
+        inputs = SLOInputs()
+        for fams in parsed.values():
+            inputs = inputs + slo_inputs_from_families(fams, self.slo.objectives)
+        now = time.time()
+        self.slo.observe(inputs, now=now)
+        report = self.slo.report(now=now)
+        report["replicas"] = sorted(parsed)
+        report["skipped"] = skipped
+        return report
+
+    # ------------------------------------------------------------- trace stitch
+    def stitched_trace(self, trace_id: str) -> Dict:
+        """One request's two-tier timeline: the router's spans plus the owning
+        replica's, clock-skew-corrected onto the router's timeline and merged
+        into a single multi-process Chrome trace. Falls back to the
+        router-only view when the owner is unknown/unreachable (the stitch
+        degrades, it never 500s)."""
+        router_events = self.tracer.chrome_trace(
+            self.tracer.snapshot(trace=trace_id))["traceEvents"]
+        tiers = [{"name": "router", "events": router_events,
+                  "offset_s": 0.0, "dropped": self.tracer.dropped}]
+        with self._live_lock:
+            owner_id = self._trace_owner.get(trace_id)
+        owner = self.pool.get(owner_id) if owner_id is not None else None
+        stitch_error = None
+        if owner is not None:
+            try:
+                raw = self._scrape_replica(
+                    owner.snapshot(), f"/debug/trace?trace={quote(trace_id)}")
+                doc = json.loads(raw)
+                tiers.append({
+                    "name": owner_id,
+                    "events": doc.get("traceEvents", []),
+                    "offset_s": self.pool.clock_offset(owner_id),
+                    "dropped": doc.get("otherData", {}).get("dropped_spans", 0),
+                })
+            except Exception as e:
+                logger.warning(f"router: trace fetch from {owner_id} failed: {e!r}")
+                stitch_error = repr(e)
+        merged = merge_chrome_traces(tiers)
+        merged["otherData"]["trace"] = trace_id
+        merged["otherData"]["replica"] = owner_id
+        if stitch_error is not None:
+            merged["otherData"]["stitch_error"] = stitch_error
+        return merged
+
     # ------------------------------------------------------------- forwarding
     def _handle_completion(self, handler, payload: dict):
-        state = _RelayState(f"rtr-{next(self._ids)}", bool(payload.get("stream")))
+        rid = f"rtr-{next(self._ids)}"
+        # the head-based sampling decision: made once here, pinned on the
+        # router's tracer, and propagated to the replica in the traceparent
+        # header — every tier then agrees without re-deciding
+        sampled = trace_sampled(rid, self.trace_sample_every)
+        if self.trace_sample_every > 1:
+            self.tracer.mark_trace(rid, sampled)
+        state = _RelayState(rid, bool(payload.get("stream")), sampled=sampled)
         prompt = payload.get("prompt")
         body = json.dumps(payload).encode()
         exclude: set = set()
 
+        with use_trace(rid):
+            self._relay_attempts(handler, state, payload, prompt, body, exclude)
+
+    def _relay_attempts(self, handler, state: _RelayState, payload: dict,
+                        prompt, body: bytes, exclude: set):
         while state.attempts < self.max_attempts:
             candidates = self._candidates(prompt, exclude, state)
             if not candidates:
@@ -274,17 +480,18 @@ class RouterServer:
                 # nothing relayed; 429/503/connect failure — next candidate
                 exclude.add(cand.id)
                 self.metrics.rerouted.inc()
-                TRACER.instant("reroute", cat="router", trace=state.rid, replica=cand.id)
+                self.tracer.instant("reroute", cat="router", trace=state.rid,
+                                    replica=cand.id)
                 continue
             if outcome == "failover":
                 # accepted then failed pre-token: transparent resubmission
                 exclude.add(cand.id)
                 self.pool.note_forward_failure(cand.id)
                 self.metrics.failovers.inc()
-                TRACER.add_span("failover", TRACER.epoch_time(state.arrival_t),
-                                time.perf_counter() - state.arrival_t, cat="router",
-                                trace=state.rid, replica=cand.id,
-                                attempt=state.attempts)
+                self.tracer.add_span("failover", self.tracer.epoch_time(state.arrival_t),
+                                     time.perf_counter() - state.arrival_t, cat="router",
+                                     trace=state.rid, replica=cand.id,
+                                     attempt=state.attempts)
                 continue
             if outcome == "midstream_failed":
                 self._terminate_midstream(handler, state, cand, payload)
@@ -311,6 +518,16 @@ class RouterServer:
         except (BrokenPipeError, ConnectionResetError):
             pass
 
+    def _forward_headers(self, state: _RelayState) -> Dict[str, str]:
+        """Per-forward headers: the traceparent contract. The parent span id
+        names the router's request span (``<rid>@router``) so the replica's
+        stitched spans can point back at the tier that placed them."""
+        return {
+            "Content-Type": "application/json",
+            TRACEPARENT_HEADER: format_traceparent(
+                state.rid, f"{state.rid}@router", state.sampled),
+        }
+
     # ------------------------------------------------------------- batch leg
     def _attempt_batch(self, handler, state: _RelayState, cand: ReplicaSnapshot,
                        body: bytes) -> str:
@@ -320,7 +537,7 @@ class RouterServer:
             try:
                 _F_FORWARD.fire(replica=cand.id)
                 conn.request("POST", "/v1/completions", body=body,
-                             headers={"Content-Type": "application/json"})
+                             headers=self._forward_headers(state))
                 resp = conn.getresponse()
                 raw = resp.read()
             except _UPSTREAM_ERRORS as e:
@@ -380,7 +597,7 @@ class RouterServer:
             try:
                 _F_FORWARD.fire(replica=cand.id)
                 conn.request("POST", "/v1/completions", body=body,
-                             headers={"Content-Type": "application/json"})
+                             headers=self._forward_headers(state))
                 resp = conn.getresponse()
             except _UPSTREAM_ERRORS as e:
                 logger.warning(f"router: forward to {cand.id} failed: {e!r}")

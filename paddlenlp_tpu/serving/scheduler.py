@@ -76,10 +76,12 @@ class Scheduler:
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt_ids, sampling=None, timeout_s: Optional[float] = None,
-               max_retries: Optional[int] = None) -> RequestHandle:
+               max_retries: Optional[int] = None,
+               trace: Optional[str] = None) -> RequestHandle:
         """Admit one request or raise (SaturatedError / ShuttingDownError /
         DegradedError). ``max_retries`` is the per-request engine-rebuild
-        requeue budget (None = supervisor policy default)."""
+        requeue budget (None = supervisor policy default); ``trace`` adopts an
+        inbound cross-tier trace id (None = the loop mints ``req-N``)."""
         cfg = self.config
         if cfg.max_prompt_tokens is not None and len(prompt_ids) > cfg.max_prompt_tokens:
             raise ValueError(
@@ -114,7 +116,7 @@ class Scheduler:
             # (assigned by submit) and trace-filtered timelines include admission
             t0 = time.perf_counter()
             handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline,
-                                      max_retries=max_retries)
+                                      max_retries=max_retries, trace=trace)
             TRACER.add_span("admission", TRACER.epoch_time(t0),
                             time.perf_counter() - t0, cat="scheduler",
                             trace=handle.trace, prompt_len=len(prompt_ids))

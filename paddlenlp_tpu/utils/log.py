@@ -6,9 +6,10 @@ singleton logger). Here rank-awareness comes from ``jax.process_index()`` instea
 
 Structured mode: ``PDNLP_TPU_LOG_JSON=1`` switches the formatter to one JSON
 object per line (``ts``/``level``/``logger``/``msg``/``file``/``line`` [+
-``exc``]) so serving and trainer logs are machine-parseable — the shape log
-shippers (fluentbit/vector) and ``jq`` expect. ``logger.set_json(True)``
-toggles it at runtime.
+``exc``, + ``trace`` when a span-tracer trace id is ambient]) so serving and
+trainer logs are machine-parseable — the shape log shippers (fluentbit/vector)
+and ``jq`` expect, and the ``trace`` key grep-joins fleet logs to stitched
+``/debug/trace`` timelines. ``logger.set_json(True)`` toggles it at runtime.
 """
 
 from __future__ import annotations
@@ -57,6 +58,18 @@ class _ColorFormatter(logging.Formatter):
         return f"{color}[{timestamp}] [{record.levelname:>8}]{_RESET} {record.pathname.split('/')[-1]}:{record.lineno} - {msg}"
 
 
+def _ambient_trace():
+    """Active span-tracer trace id (None outside a traced request). Imported
+    lazily: observability pulls this module in at import time, so a top-level
+    import here would be circular."""
+    try:
+        from ..observability.tracer import current_trace
+
+        return current_trace()
+    except Exception:
+        return None
+
+
 class _JsonFormatter(logging.Formatter):
     """One JSON object per line; keys stable for log shippers."""
 
@@ -69,6 +82,9 @@ class _JsonFormatter(logging.Formatter):
             "file": record.pathname.split("/")[-1],
             "line": record.lineno,
         }
+        trace = _ambient_trace()
+        if trace is not None:
+            out["trace"] = trace
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out, default=str)

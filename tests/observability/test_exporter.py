@@ -74,6 +74,24 @@ class TestExporter:
         status, _ = _get(port, "/nope")
         assert status == 404
 
+    def test_ring_overflow_is_accounted(self, exporter):
+        # the bounded ring drops oldest spans silently; the drop count must
+        # surface in /debug/trace responses AND as a counter on /metrics
+        exp, port = exporter
+        for i in range(40):  # capacity is 32: 8 spans fall off the back
+            exp.tracer.instant(f"s{i}")
+        status, body = _get(port, "/debug/trace")
+        assert status == 200
+        dropped = json.loads(body)["otherData"]["dropped_spans"]
+        assert dropped == exp.tracer.dropped > 0
+        status, body = _get(port, "/metrics")
+        fams = parse_prometheus_text(body.decode())
+        assert fams["paddlenlp_traces_dropped_total"].value() == dropped
+        # counter only tops UP (monotone) across scrapes
+        _get(port, "/metrics")
+        fams = parse_prometheus_text(_get(port, "/metrics")[1].decode())
+        assert fams["paddlenlp_traces_dropped_total"].value() == dropped
+
 
 class TestPromParse:
     def test_parse_and_quantile_roundtrip(self):

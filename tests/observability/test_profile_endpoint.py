@@ -1,0 +1,178 @@
+"""On-demand device profiling tests (ISSUE 6): the ``POST /debug/profile``
+capture guard — one capture at a time (concurrent -> 409), window validation,
+and the HTTP plumbing on the exporter. The jax profiler is replaced by a fake
+so the suite stays engine-free and fast."""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paddlenlp_tpu.observability import ObservabilityExporter, ProfileCapture
+from paddlenlp_tpu.observability.exporter import (
+    ProfileInProgressError,
+    handle_profile_request,
+)
+from paddlenlp_tpu.serving.metrics import MetricsRegistry
+
+
+class FakeProfiler:
+    """Records start/stop calls; optionally blocks inside the window."""
+
+    def __init__(self):
+        self.traces = []  # paths passed to start_trace
+        self.active = False
+        self.started = threading.Event()
+
+    def start_trace(self, path):
+        assert not self.active, "overlapping start_trace: the guard failed"
+        self.active = True
+        self.traces.append(path)
+        self.started.set()
+
+    def stop_trace(self):
+        self.active = False
+
+
+@pytest.fixture
+def capture(tmp_path):
+    return ProfileCapture(base_dir=str(tmp_path), max_seconds=2.0,
+                          profiler=FakeProfiler())
+
+
+class TestProfileCapture:
+    def test_capture_returns_path(self, capture):
+        out = capture.capture(0.01)
+        assert out["seconds"] == 0.01
+        assert os.path.isdir(out["path"])
+        assert capture._profiler.traces == [out["path"]]
+        assert not capture._profiler.active  # stopped even on success
+
+    def test_sequential_captures_get_distinct_paths(self, capture):
+        a = capture.capture(0.01)["path"]
+        b = capture.capture(0.01)["path"]
+        assert a != b
+
+    def test_concurrent_capture_rejected(self, capture):
+        fake = capture._profiler
+        done = threading.Event()
+
+        def long_capture():
+            capture.capture(0.5)
+            done.set()
+
+        t = threading.Thread(target=long_capture, daemon=True)
+        t.start()
+        assert fake.started.wait(2.0)
+        with pytest.raises(ProfileInProgressError):
+            capture.capture(0.01)
+        assert done.wait(5.0)
+        # guard released: the next capture goes through
+        assert capture.capture(0.01)["seconds"] == 0.01
+
+    def test_window_validation(self, capture):
+        with pytest.raises(ValueError):
+            capture.capture(0.0)
+        with pytest.raises(ValueError):
+            capture.capture(-1.0)
+        with pytest.raises(ValueError):
+            capture.capture(100.0)  # > max_seconds
+        assert capture._profiler.traces == []  # rejected before start_trace
+
+    def test_stop_trace_on_failure_releases_guard(self, tmp_path):
+        class Boom(FakeProfiler):
+            def start_trace(self, path):
+                raise RuntimeError("no backend")
+
+        cap = ProfileCapture(base_dir=str(tmp_path), profiler=Boom())
+        with pytest.raises(RuntimeError):
+            cap.capture(0.01)
+        # lock released: a retry raises the backend error again, not 409
+        with pytest.raises(RuntimeError):
+            cap.capture(0.01)
+
+
+class TestHandleProfileRequest:
+    def test_path_mismatch_returns_none(self, capture):
+        assert handle_profile_request("/v1/completions", capture) is None
+        assert handle_profile_request("/debug/trace", capture) is None
+
+    def test_ok_request(self, capture):
+        status, ctype, body = handle_profile_request("/debug/profile?seconds=0.01",
+                                                     capture)
+        assert status == 200 and ctype == "application/json"
+        assert os.path.isdir(json.loads(body)["path"])
+
+    def test_bad_seconds(self, capture):
+        status, _, body = handle_profile_request("/debug/profile?seconds=nope", capture)
+        assert status == 400
+        status, _, body = handle_profile_request("/debug/profile?seconds=-3", capture)
+        assert status == 400 and json.loads(body)["type"] == "invalid_request"
+
+    def test_concurrent_is_409(self, capture):
+        fake = capture._profiler
+        t = threading.Thread(target=lambda: capture.capture(0.5), daemon=True)
+        t.start()
+        assert fake.started.wait(2.0)
+        status, _, body = handle_profile_request("/debug/profile?seconds=0.01", capture)
+        assert status == 409
+        assert json.loads(body)["type"] == "profile_in_progress"
+        t.join(5.0)
+
+    def test_backend_failure_is_500(self, tmp_path):
+        class Boom(FakeProfiler):
+            def start_trace(self, path):
+                raise RuntimeError("no backend")
+
+        cap = ProfileCapture(base_dir=str(tmp_path), profiler=Boom())
+        status, _, body = handle_profile_request("/debug/profile?seconds=0.01", cap)
+        assert status == 500 and json.loads(body)["type"] == "profile_failed"
+
+
+class TestExporterEndpoint:
+    def test_post_profile_over_http(self, tmp_path):
+        cap = ProfileCapture(base_dir=str(tmp_path), profiler=FakeProfiler())
+        exporter = ObservabilityExporter(registry=MetricsRegistry(), profile=cap)
+        port = exporter.start(port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/debug/profile?seconds=0.01")
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200 and os.path.isdir(body["path"])
+            # unknown POST routes still 404
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/nope")
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            assert resp.status == 404
+        finally:
+            exporter.shutdown()
+
+    def test_post_with_body_keeps_keepalive_in_sync(self, tmp_path):
+        # both HTTP planes are HTTP/1.1 keep-alive: an unread request body
+        # (curl -d '{}') left on the socket would be parsed as the NEXT
+        # request's start line — the handler must drain it before responding
+        cap = ProfileCapture(base_dir=str(tmp_path), profiler=FakeProfiler())
+        exporter = ObservabilityExporter(registry=MetricsRegistry(), profile=cap)
+        port = exporter.start(port=0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("POST", "/debug/profile?seconds=0.01", body=b'{"why": "not"}',
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            # second request on the SAME connection must not see body leftovers
+            conn.request("POST", "/debug/profile?seconds=0.01")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            conn.close()
+        finally:
+            exporter.shutdown()

@@ -1,0 +1,193 @@
+"""SLO plane unit tests (ISSUE 6): burn-rate math against synthetic
+histograms, window baselining, counter-reset handling, and the
+``paddlenlp_slo_*`` gauge series. Stdlib-only module — no jax, no engine."""
+
+import pytest
+
+from paddlenlp_tpu.observability import (
+    SLOObjectives,
+    SLOTracker,
+    parse_prometheus_text,
+    slo_inputs_from_families,
+)
+from paddlenlp_tpu.observability.slo import SLOInputs
+from paddlenlp_tpu.serving.metrics import MetricsRegistry
+
+
+def synthetic_exposition(stop=90.0, engine_error=5.0, abort=5.0,
+                         buckets=((0.1, 80.0), (1.0, 95.0), ("+Inf", 100.0)),
+                         count=100.0, replica=None):
+    """Hand-built replica exposition: requests by status + a TTFT histogram."""
+    lbl = f',replica="{replica}"' if replica else ""
+    pre = f'replica="{replica}",' if replica else ""
+    lines = [
+        "# TYPE paddlenlp_serving_requests_total counter",
+        f'paddlenlp_serving_requests_total{{status="stop"{lbl}}} {stop}',
+        f'paddlenlp_serving_requests_total{{status="engine_error"{lbl}}} {engine_error}',
+        f'paddlenlp_serving_requests_total{{status="abort"{lbl}}} {abort}',
+        "# TYPE paddlenlp_serving_ttft_seconds histogram",
+    ]
+    for le, c in buckets:
+        lines.append(f'paddlenlp_serving_ttft_seconds_bucket{{{pre}le="{le}"}} {c}')
+    lines.append(f"paddlenlp_serving_ttft_seconds_count{{{lbl.lstrip(',')}}} {count}"
+                 if replica else f"paddlenlp_serving_ttft_seconds_count {count}")
+    lines.append(f"paddlenlp_serving_ttft_seconds_sum{{{lbl.lstrip(',')}}} 12.5"
+                 if replica else "paddlenlp_serving_ttft_seconds_sum 12.5")
+    return "\n".join(lines) + "\n"
+
+
+class TestObjectives:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOObjectives(availability=1.0)
+        with pytest.raises(ValueError):
+            SLOObjectives(ttft_quantile=0.0)
+        with pytest.raises(ValueError):
+            SLOObjectives(ttft_threshold_s=0.0)
+
+    def test_defaults_valid(self):
+        obj = SLOObjectives()
+        assert 0 < obj.availability < 1 and obj.ttft_threshold_s > 0
+
+
+class TestInputsFromFamilies:
+    def test_hand_computed_totals(self):
+        fams = parse_prometheus_text(synthetic_exposition())
+        inputs = slo_inputs_from_families(fams, SLOObjectives(ttft_threshold_s=1.0))
+        # stop+engine_error+abort = 100 finished; engine_error spends budget,
+        # stop/abort do not
+        assert inputs.total == 100.0 and inputs.errors == 5.0
+        # threshold 1.0 sits exactly on a bucket bound: good = 95, so 5 violations
+        assert inputs.ttft_count == 100.0 and inputs.ttft_violations == 5.0
+
+    def test_off_bucket_threshold_overcounts_violations(self):
+        # threshold 0.5 between bounds 0.1 and 1.0: the next-LOWER bound is
+        # used (good=80 -> 20 violations), over-counting — the safe side
+        fams = parse_prometheus_text(synthetic_exposition())
+        inputs = slo_inputs_from_families(fams, SLOObjectives(ttft_threshold_s=0.5))
+        assert inputs.ttft_violations == 20.0
+
+    def test_federated_labelsets_sum(self):
+        # two replicas' series in one exposition (the federated case): totals
+        # sum across the replica label, buckets grouped per replica labelset
+        text = (synthetic_exposition(replica="r0").rstrip("\n") + "\n"
+                + "\n".join(l for l in synthetic_exposition(replica="r1").splitlines()
+                            if not l.startswith("#")) + "\n")
+        fams = parse_prometheus_text(text)
+        inputs = slo_inputs_from_families(fams, SLOObjectives(ttft_threshold_s=1.0))
+        assert inputs.total == 200.0 and inputs.errors == 10.0
+        assert inputs.ttft_count == 200.0 and inputs.ttft_violations == 10.0
+
+    def test_empty_families(self):
+        inputs = slo_inputs_from_families({}, SLOObjectives())
+        assert inputs == SLOInputs()
+
+
+class TestBurnRates:
+    OBJ = SLOObjectives(availability=0.999, ttft_threshold_s=1.0, ttft_quantile=0.99)
+
+    def test_lifetime_window_falls_back_to_zero_baseline(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(60.0, 3600.0))
+        tr.observe(SLOInputs(total=100, errors=1, ttft_count=100, ttft_violations=2),
+                   now=1000.0)
+        rep = tr.report(now=1000.0)
+        for w in ("60s", "3600s"):  # no history: both windows see process start
+            row = rep["windows"][w]
+            assert row["availability"] == pytest.approx(0.99)
+            # err rate 0.01 against a 0.001 budget: burning 10x
+            assert row["availability_burn_rate"] == pytest.approx(10.0)
+            assert row["ttft_violation_rate"] == pytest.approx(0.02)
+            assert row["ttft_burn_rate"] == pytest.approx(2.0)
+
+    def test_short_window_uses_recent_baseline(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(60.0, 3600.0))
+        tr.observe(SLOInputs(total=100, errors=1, ttft_count=100, ttft_violations=2),
+                   now=1000.0)
+        tr.observe(SLOInputs(total=200, errors=1, ttft_count=200, ttft_violations=2),
+                   now=1070.0)
+        rep = tr.report(now=1070.0)
+        # 60s window baseline = the t=1000 point: 100 new requests, 0 new errors
+        short = rep["windows"]["60s"]
+        assert short["requests"] == 100.0
+        assert short["availability"] == 1.0 and short["availability_burn_rate"] == 0.0
+        assert short["ttft_burn_rate"] == 0.0
+        # 3600s window still reaches past history: lifetime rates
+        assert rep["windows"]["3600s"]["availability"] == pytest.approx(1 - 1 / 200)
+
+    def test_empty_window_spends_no_budget(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(60.0,))
+        inputs = SLOInputs(total=50, errors=50, ttft_count=50, ttft_violations=50)
+        tr.observe(inputs, now=0.0)
+        tr.observe(inputs, now=120.0)  # no new traffic in the last 60s
+        row = tr.report(now=120.0)["windows"]["60s"]
+        assert row["requests"] == 0.0
+        assert row["availability"] == 1.0 and row["availability_burn_rate"] == 0.0
+
+    def test_counter_reset_drops_history(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(60.0,))
+        tr.observe(SLOInputs(total=1000, errors=900), now=0.0)
+        # fleet totals shrank (replica restart) and STAYED low: the second
+        # consecutive shrunk observation confirms the reset and drops history
+        tr.observe(SLOInputs(total=10, errors=0), now=10.0)
+        tr.observe(SLOInputs(total=12, errors=0), now=20.0)
+        row = tr.report(now=20.0)["windows"]["60s"]
+        assert row["requests"] == 12.0 and row["availability"] == 1.0
+
+    def test_masked_replica_reset_clamps_not_inflates(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(60.0,))
+        tr.observe(SLOInputs(total=100, errors=5, ttft_count=100,
+                             ttft_violations=5), now=0.0)
+        # one replica reset (its 5 errors vanished) masked by another's
+        # growth: total still rose, so reset detection cannot fire — the
+        # negative error delta must clamp to 0, not report availability > 1
+        tr.observe(SLOInputs(total=250, errors=0, ttft_count=250,
+                             ttft_violations=0), now=10.0)
+        row = tr.report(now=10.0)["windows"]["60s"]
+        assert row["availability"] == 1.0
+        assert row["availability_burn_rate"] == 0.0
+        assert row["ttft_burn_rate"] == 0.0
+
+    def test_transient_scrape_dip_does_not_wipe_history(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(3600.0,))
+        tr.observe(SLOInputs(total=3000, errors=30), now=0.0)
+        # one replica's scrape blipped out of the merge for a single
+        # observation: dropped, NOT treated as a counter reset
+        tr.observe(SLOInputs(total=2000, errors=20), now=10.0)
+        tr.observe(SLOInputs(total=3300, errors=33), now=20.0)
+        row = tr.report(now=20.0)["windows"]["3600s"]
+        assert row["requests"] == 3300.0  # lifetime baseline survived the blip
+        assert abs(row["availability"] - (1.0 - 33.0 / 3300.0)) < 1e-9
+
+    def test_empty_tracker_report(self):
+        rep = SLOTracker(objectives=self.OBJ).report()
+        assert rep["windows"] == {}
+
+    def test_history_pruning_keeps_long_window_baseline(self):
+        tr = SLOTracker(objectives=self.OBJ, windows_s=(60.0,))
+        for i in range(200):
+            tr.observe(SLOInputs(total=float(i), errors=0.0), now=float(i))
+        # pruned to ~window depth, but one at-or-before-horizon point remains
+        assert len(tr._history) < 200
+        assert tr._history[0][0] <= 199.0 - 60.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOTracker(windows_s=())
+        with pytest.raises(ValueError):
+            SLOTracker(windows_s=(0.0,))
+
+
+class TestGaugeSeries:
+    def test_slo_gauges_land_in_registry(self):
+        reg = MetricsRegistry()
+        tr = SLOTracker(objectives=SLOObjectives(availability=0.999),
+                        windows_s=(60.0,), registry=reg)
+        tr.observe(SLOInputs(total=100, errors=1, ttft_count=100, ttft_violations=2),
+                   now=0.0)
+        tr.report(now=0.0)
+        fams = parse_prometheus_text(reg.expose())
+        avail = fams["paddlenlp_slo_availability"].value(window="60s")
+        assert avail == pytest.approx(0.99)
+        burn = fams["paddlenlp_slo_availability_burn_rate"].value(window="60s")
+        assert burn == pytest.approx(10.0)
+        assert fams["paddlenlp_slo_availability_objective"].value() == pytest.approx(0.999)

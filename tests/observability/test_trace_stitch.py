@@ -1,0 +1,171 @@
+"""Cross-tier tracing unit tests (ISSUE 6): head-based 1-in-N sampling
+determinism and the no-op span path, traceparent header round-trips, and
+multi-process Chrome-trace stitching with clock-skew correction. Stdlib-only
+module — no jax, no HTTP."""
+
+import time
+
+import pytest
+
+from paddlenlp_tpu.observability import (
+    SpanTracer,
+    format_traceparent,
+    merge_chrome_traces,
+    parse_traceparent,
+    trace_sampled,
+)
+
+
+class TestSamplingDecision:
+    def test_deterministic_across_instances_and_processes(self):
+        # crc32, not Python hash(): every process that sees the same id
+        # independently agrees without coordination
+        ids = [f"rtr-{i}" for i in range(512)]
+        a = {t for t in ids if trace_sampled(t, 8)}
+        b = {t for t in ids if trace_sampled(t, 8)}
+        assert a == b
+        # roughly 1-in-8 (crc32 is uniform over sequential ids)
+        assert 512 / 16 < len(a) < 512 / 4
+
+    def test_sample_every_one_keeps_everything(self):
+        assert all(trace_sampled(f"t-{i}", 1) for i in range(32))
+
+    def test_noop_path_span_volume(self):
+        # the acceptance-criteria shape: with 1-in-8 sampling, per-request
+        # span volume drops >= 4x on a 64-request load while sampled requests
+        # keep FULL span detail
+        full = SpanTracer(capacity=4096)
+        sampled = SpanTracer(capacity=4096, sample_every=8)
+        per_request = 3  # queue + prefill + decode retrospective spans
+        for tr in (full, sampled):
+            for i in range(64):
+                rid = f"rtr-{i}"
+                for name in ("queue", "prefill", "decode")[:per_request]:
+                    tr.add_span(name, time.time(), 0.01, trace=rid, wall=True)
+        assert len(full) == 64 * per_request
+        assert len(sampled) <= len(full) / 4
+        kept = {s.trace for s in sampled.snapshot()}
+        assert kept == {f"rtr-{i}" for i in range(64) if trace_sampled(f"rtr-{i}", 8)}
+        # sampled traces keep every span, not a thinned subset
+        for rid in kept:
+            assert len(sampled.snapshot(trace=rid)) == per_request
+
+    def test_traceless_spans_never_sampled_out(self):
+        tr = SpanTracer(capacity=64, sample_every=1_000_000)
+        with tr.span("engine_phase", cat="engine"):
+            pass
+        tr.instant("marker")
+        assert len(tr) == 2
+
+    def test_mark_overrides_hash(self):
+        tr = SpanTracer(capacity=64, sample_every=2)
+        ids = [f"t-{i}" for i in range(16)]
+        hash_in = next(t for t in ids if trace_sampled(t, 2))
+        hash_out = next(t for t in ids if not trace_sampled(t, 2))
+        # upstream tier said the opposite of the local hash: the mark wins
+        tr.mark_trace(hash_in, False)
+        tr.mark_trace(hash_out, True)
+        tr.instant("a", trace=hash_in)
+        tr.instant("b", trace=hash_out)
+        spans = tr.snapshot()
+        assert [s.name for s in spans] == ["b"]
+
+    def test_mark_table_is_bounded(self):
+        tr = SpanTracer(capacity=64)
+        tr._marks_cap = 8
+        for i in range(32):
+            tr.mark_trace(f"t-{i}", True)
+        assert len(tr._trace_marks) == 8
+        assert "t-31" in tr._trace_marks and "t-0" not in tr._trace_marks
+
+    def test_context_manager_path_respects_sampling(self):
+        tr = SpanTracer(capacity=64, sample_every=1)
+        tr.mark_trace("quiet", False)
+        with tr.span("w", trace="quiet"):
+            pass
+        tr.instant("i", trace="quiet")
+        tr.add_span("a", time.time(), 0.1, trace="quiet", wall=True)
+        assert len(tr) == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SpanTracer(sample_every=0)
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        v = format_traceparent("rtr-42", "rtr-42@router", False)
+        assert parse_traceparent(v) == ("rtr-42", "rtr-42@router", False)
+        v = format_traceparent("rtr-7")
+        assert parse_traceparent(v) == ("rtr-7", "", True)
+
+    def test_malformed_values(self):
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("") is None
+        assert parse_traceparent(";parent=x") is None
+        assert parse_traceparent("has space;sampled=1") is None
+
+    def test_unknown_fields_ignored(self):
+        got = parse_traceparent("rtr-1;parent=p;future=thing;sampled=0")
+        assert got == ("rtr-1", "p", False)
+
+    def test_sampled_flag_forms(self):
+        assert parse_traceparent("t;sampled=0")[2] is False
+        assert parse_traceparent("t;sampled=false")[2] is False
+        assert parse_traceparent("t;sampled=1")[2] is True
+        assert parse_traceparent("t")[2] is True  # default: sampled
+
+
+class TestMergeChromeTraces:
+    def _tier(self, name, spans, offset_s=0.0, dropped=0):
+        tr = SpanTracer(capacity=256)
+        for sname, start, dur in spans:
+            tr.add_span(sname, start, dur, trace="rtr-0")
+        return {"name": name, "events": tr.chrome_trace()["traceEvents"],
+                "offset_s": offset_s, "dropped": dropped}
+
+    def test_tiers_become_pid_lanes(self):
+        t0 = time.time()
+        merged = merge_chrome_traces([
+            self._tier("router", [("route", t0, 0.001)]),
+            self._tier("replica-0", [("prefill", t0, 0.01)]),
+        ])
+        pids = {ev["pid"] for ev in merged["traceEvents"]}
+        assert pids == {1, 2}
+        names = {ev["args"]["name"] for ev in merged["traceEvents"]
+                 if ev.get("name") == "process_name"}
+        assert names == {"router", "replica-0"}
+
+    def test_clock_skew_correction_restores_monotonic_order(self):
+        # router span [t0, t0+1.0]; the replica's clock runs 5s AHEAD, so its
+        # nested span is recorded at t0+5.2 in replica time. After shifting by
+        # -offset the replica span lands back inside the router span.
+        t0 = time.time()
+        skew = 5.0
+        merged = merge_chrome_traces([
+            self._tier("router", [("router_request", t0, 1.0)]),
+            self._tier("replica-0", [("decode", t0 + skew + 0.2, 0.3)],
+                       offset_s=skew),
+        ])
+        by_name = {ev["name"]: ev for ev in merged["traceEvents"]
+                   if ev.get("ph") == "X"}
+        router_ev, replica_ev = by_name["router_request"], by_name["decode"]
+        assert router_ev["ts"] <= replica_ev["ts"]
+        assert (replica_ev["ts"] + replica_ev["dur"]
+                <= router_ev["ts"] + router_ev["dur"] + 1)  # us rounding slack
+        # corrected, the replica span starts ~0.2s into the router span
+        assert replica_ev["ts"] - router_ev["ts"] == pytest.approx(0.2e6, rel=0.05)
+
+    def test_metadata_events_not_shifted(self):
+        tier = self._tier("replica-0", [("x", time.time(), 0.1)], offset_s=100.0)
+        merged = merge_chrome_traces([tier])
+        for ev in merged["traceEvents"]:
+            if ev.get("ph") == "M":
+                assert "ts" not in ev or ev["ts"] < 1e15  # untouched metadata
+
+    def test_dropped_counts_surface(self):
+        merged = merge_chrome_traces([
+            self._tier("router", [], dropped=3),
+            self._tier("replica-0", [], dropped=7),
+        ])
+        assert merged["otherData"]["dropped_spans"] == {"router": 3, "replica-0": 7}

@@ -1,0 +1,522 @@
+"""Fleet observability tests (ISSUE 6): metrics federation (including a
+replica DOWN -> partial merge), the ``/fleet/slo`` plane, traceparent
+propagation with head-based sampling, and two-tier trace stitching with
+injected clock skew — against scriptable stub replicas, then end-to-end over
+two real in-process replicas."""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddlenlp_tpu.observability import parse_prometheus_text
+from paddlenlp_tpu.observability.tracer import TRACER, SpanTracer, trace_sampled
+from paddlenlp_tpu.serving.metrics import MetricsRegistry
+from paddlenlp_tpu.serving.router import (
+    DOWN,
+    RouterServer,
+    federate_expositions,
+    lint_federation,
+)
+
+REQS = "paddlenlp_serving_requests_total"
+TTFT = "paddlenlp_serving_ttft_seconds"
+
+
+def replica_exposition(stop=95.0, engine_error=5.0,
+                       buckets=((0.1, 80.0), (1.0, 95.0), ("+Inf", 100.0)),
+                       count=100.0, extra=""):
+    lines = [
+        f"# HELP {REQS} Finished requests by terminal state",
+        f"# TYPE {REQS} counter",
+        f'{REQS}{{status="stop"}} {stop}',
+        f'{REQS}{{status="engine_error"}} {engine_error}',
+        f"# HELP {TTFT} Arrival to first token",
+        f"# TYPE {TTFT} histogram",
+    ]
+    lines += [f'{TTFT}_bucket{{le="{le}"}} {c}' for le, c in buckets]
+    lines += [f"{TTFT}_count {count}", f"{TTFT}_sum 9.5"]
+    if extra:
+        lines.append(extra)
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------------ federation
+class TestFederation:
+    def test_merge_relabels_per_replica(self):
+        merged = federate_expositions({
+            "r0": replica_exposition(stop=10.0),
+            "r1": replica_exposition(stop=20.0),
+        })
+        fams = parse_prometheus_text(merged)
+        assert fams[REQS].value(replica="r0", status="stop") == 10.0
+        assert fams[REQS].value(replica="r1", status="stop") == 20.0
+        # histogram buckets keep per-replica series, le stays a valid label
+        assert fams[TTFT].value(sample_name=f"{TTFT}_bucket",
+                                replica="r0", le="0.1") == 80.0
+        assert fams[REQS].type == "counter" and fams[REQS].help
+
+    def test_partial_input_is_partial_output(self):
+        merged = federate_expositions({"r0": replica_exposition()})
+        fams = parse_prometheus_text(merged)
+        assert {dict(l)["replica"] for _, l in fams[REQS].samples} == {"r0"}
+
+    def test_lint_clean_on_homogeneous_fleet(self):
+        assert lint_federation({"r0": replica_exposition(),
+                                "r1": replica_exposition()}) == []
+
+    def test_lint_flags_type_conflict(self):
+        conflicting = replica_exposition().replace(
+            f"# TYPE {REQS} counter", f"# TYPE {REQS} gauge")
+        problems = lint_federation({"r0": replica_exposition(), "r1": conflicting})
+        assert any("TYPE conflict" in p and REQS in p for p in problems)
+
+    def test_lint_flags_replica_label_collision(self):
+        poisoned = replica_exposition(
+            extra='paddlenlp_custom_gauge{replica="oops"} 1')
+        problems = lint_federation({"r0": poisoned})
+        assert any("replica label" in p for p in problems)
+
+    def test_merged_exposition_is_lintable(self):
+        from paddlenlp_tpu.observability import lint_exposition
+        merged = federate_expositions({"r0": replica_exposition(),
+                                       "r1": replica_exposition()})
+        assert lint_exposition(merged) == []
+
+    def test_bucket_lines_in_ascending_le_order(self):
+        # lexicographic le ordering ("+Inf" first, "10" before "2.5") breaks
+        # strict OpenMetrics consumers — buckets must come out cumulative
+        merged = federate_expositions({"r0": replica_exposition(
+            buckets=(("0.1", 10.0), ("2.5", 60.0), ("10", 80.0), ("+Inf", 100.0)))})
+        les = [line.split('le="')[1].split('"')[0]
+               for line in merged.splitlines() if f"{TTFT}_bucket" in line]
+        assert les == ["0.1", "2.5", "10", "+Inf"]
+
+
+# ------------------------------------------------------------------ stub tier
+class FleetStub:
+    """Replica stub for the fleet planes: /health (with tracer clock + skew),
+    /metrics (configurable exposition), /debug/trace (skewed spans), and a
+    header-recording /v1/completions."""
+
+    def __init__(self, exposition=None, skew_s=0.0, metrics_status=200,
+                 tokens=(7, 8, 9)):
+        self.exposition = exposition if exposition is not None else replica_exposition()
+        self.skew_s = skew_s
+        self.metrics_status = metrics_status
+        self.tokens = list(tokens)
+        self.seen_headers = []  # traceparent headers from /v1/completions
+        self.trace_events = {}  # trace id -> [chrome events]
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _raw(self, code, body, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._raw(200, json.dumps({
+                        "status": "ok",
+                        "scheduler": {"inflight": 0},
+                        "engine": {"queue_depth": 0},
+                        # the replica's tracer clock runs skew_s ahead
+                        "now": TRACER.now() + stub.skew_s,
+                    }).encode())
+                elif self.path == "/metrics":
+                    self._raw(stub.metrics_status, stub.exposition.encode(),
+                              "text/plain; version=0.0.4")
+                elif self.path.startswith("/debug/trace"):
+                    from urllib.parse import parse_qs, urlsplit
+                    trace = parse_qs(urlsplit(self.path).query).get("trace", [None])[0]
+                    self._raw(200, json.dumps({
+                        "traceEvents": stub.trace_events.get(trace, []),
+                        "otherData": {"dropped_spans": 0},
+                    }).encode())
+                else:
+                    self._raw(404, b"{}")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                stub.seen_headers.append(self.headers.get("X-Pdnlp-Traceparent"))
+                cid = f"cmpl-{len(stub.seen_headers)}"
+                self._raw(200, json.dumps({
+                    "id": cid, "object": "text_completion",
+                    "choices": [{"index": 0, "finish_reason": "length",
+                                 "token_ids": stub.tokens}],
+                    "usage": {"prompt_tokens": len(payload.get("prompt", [])),
+                              "completion_tokens": len(stub.tokens)},
+                }).encode())
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self.port = self._httpd.server_address[1]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def get_text(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+@pytest.fixture
+def fleet_router():
+    created = []
+
+    def build(stubs, **router_kw):
+        registry = MetricsRegistry()
+        # private tracer per router (like launch_fleet): sampling marks and
+        # rtr-N spans from one test's router must not leak into the next
+        router_kw.setdefault("tracer", SpanTracer())
+        router = RouterServer(
+            [("127.0.0.1", s.port, rid) for rid, s in stubs],
+            registry=registry, poll_interval_s=30.0, **router_kw)
+        port = router.start_in_thread()
+        created.append((router, [s for _, s in stubs]))
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and any(s.last_poll_t is None for s in router.pool.snapshots())):
+            time.sleep(0.005)
+        return router, port, registry
+
+    yield build
+    for router, stubs in created:
+        router.shutdown()
+        for s in stubs:
+            s.stop()
+
+
+class TestFleetMetrics:
+    def test_fleet_metrics_merges_replicas(self, fleet_router):
+        a, b = FleetStub(replica_exposition(stop=10.0)), FleetStub(replica_exposition(stop=20.0))
+        router, port, _ = fleet_router([("a", a), ("b", b)])
+        status, text = get_text(port, "/fleet/metrics")
+        assert status == 200
+        fams = parse_prometheus_text(text)
+        assert fams[REQS].value(replica="a", status="stop") == 10.0
+        assert fams[REQS].value(replica="b", status="stop") == 20.0
+
+    def test_down_replica_partial_merge_not_error(self, fleet_router):
+        a, b = FleetStub(), FleetStub()
+        router, port, _ = fleet_router([("a", a), ("b", b)])
+        router.pool.get("b").state = DOWN
+        status, text = get_text(port, "/fleet/metrics")
+        assert status == 200  # partial beats nothing during an incident
+        fams = parse_prometheus_text(text)
+        assert {dict(l)["replica"] for _, l in fams[REQS].samples} == {"a"}
+
+    def test_unreachable_scrape_skipped_and_counted(self, fleet_router):
+        a, b = FleetStub(), FleetStub(metrics_status=500)
+        router, port, registry = fleet_router([("a", a), ("b", b)])
+        status, _ = get_text(port, "/fleet/metrics")
+        assert status == 200
+        err = registry.get("paddlenlp_router_fleet_scrape_errors_total")
+        assert err.value(replica="b") == 1.0
+
+    def test_unparseable_exposition_skipped_not_500(self, fleet_router):
+        # a 200 body that isn't Prometheus text (port reused by another
+        # process, truncated read): skipped like a failed scrape, the merge
+        # stays partial — federation never 500s the whole fleet
+        a, b = FleetStub(), FleetStub(exposition="<html>not metrics</html>")
+        router, port, registry = fleet_router([("a", a), ("b", b)])
+        status, text = get_text(port, "/fleet/metrics")
+        assert status == 200
+        fams = parse_prometheus_text(text)
+        assert {dict(l)["replica"] for _, l in fams[REQS].samples} == {"a"}
+        err = registry.get("paddlenlp_router_fleet_scrape_errors_total")
+        assert err.value(replica="b") == 1.0
+        status, rep = get_json(port, "/fleet/slo")
+        assert status == 200
+        assert rep["replicas"] == ["a"] and rep["skipped"] == ["b"]
+
+    def test_fleet_slo_matches_hand_computed(self, fleet_router):
+        # each replica: 100 finished, 5 engine_error; threshold 1.0 on a
+        # bucket bound -> 5 TTFT violations per replica
+        a, b = FleetStub(), FleetStub()
+        router, port, _ = fleet_router([("a", a), ("b", b)])
+        status, rep = get_json(port, "/fleet/slo")
+        assert status == 200
+        assert sorted(rep["replicas"]) == ["a", "b"] and rep["skipped"] == []
+        assert rep["totals"]["total"] == 200.0 and rep["totals"]["errors"] == 10.0
+        widest = rep["windows"]["3600s"]
+        assert widest["availability"] == pytest.approx(1 - 10 / 200)
+        # err rate 0.05 over the default 0.999 objective: burning 50x budget
+        assert widest["availability_burn_rate"] == pytest.approx(0.05 / 0.001)
+        assert widest["ttft_violation_rate"] == pytest.approx(10 / 200)
+        # the paddlenlp_slo_* series landed on the router's own /metrics
+        _, text = get_text(port, "/metrics")
+        fams = parse_prometheus_text(text)
+        assert fams["paddlenlp_slo_availability"].value(window="3600s") == \
+            pytest.approx(0.95)
+
+    def test_fleet_slo_partial_on_down_replica(self, fleet_router):
+        a, b = FleetStub(), FleetStub()
+        router, port, _ = fleet_router([("a", a), ("b", b)])
+        router.pool.get("b").state = DOWN
+        status, rep = get_json(port, "/fleet/slo")
+        assert status == 200
+        assert rep["replicas"] == ["a"] and rep["skipped"] == ["b"]
+        assert rep["totals"]["total"] == 100.0
+
+
+class TestTraceparentPropagation:
+    def test_header_carries_rid_and_sampling(self, fleet_router):
+        a = FleetStub()
+        router, port, _ = fleet_router([("a", a)], trace_sample_every=8)
+        rids = []
+        for _ in range(16):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": [1, 2, 3], "max_tokens": 3}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200
+            rids.append(body["id"])
+        assert all(r.startswith("rtr-") for r in rids)
+        assert len(a.seen_headers) == 16
+        for rid, header in zip(rids, a.seen_headers):
+            tid, parent, sampled = header.split(";")[0], None, None
+            assert tid == rid
+            assert f"parent={rid}@router" in header
+            # the router made the 1-in-8 decision ONCE and propagated it
+            want = trace_sampled(rid, 8)
+            assert f"sampled={1 if want else 0}" in header
+        # 1-in-8 over 16 sequential ids: strictly fewer sampled than not
+        sampled_n = sum(1 for r in rids if trace_sampled(r, 8))
+        assert 0 < sampled_n < len(rids) / 4
+
+
+class TestStitchedTrace:
+    SKEW = 5.0
+
+    def _seed_two_tier_trace(self, router, stub, rid):
+        """One request's spans in both tiers, the replica's on a clock SKEW
+        seconds ahead of the router's."""
+        t0 = router.tracer.now()
+        router.tracer.add_span("router_request", t0, 1.0, cat="router", trace=rid)
+        # replica events in REPLICA time: skewed ahead; raw merge would put
+        # them outside the router span entirely
+        stub.trace_events[rid] = [
+            {"name": "queue", "cat": "request", "ph": "X",
+             "ts": (t0 + self.SKEW + 0.1) * 1e6, "dur": 0.1e6, "pid": 1, "tid": 1,
+             "args": {"trace": rid}},
+            {"name": "decode", "cat": "request", "ph": "X",
+             "ts": (t0 + self.SKEW + 0.3) * 1e6, "dur": 0.5e6, "pid": 1, "tid": 1,
+             "args": {"trace": rid}},
+        ]
+        router._note_owner(rid, "a")
+
+    def test_skew_corrected_single_timeline(self, fleet_router):
+        a = FleetStub(skew_s=self.SKEW)
+        router, port, _ = fleet_router([("a", a)])
+        router.pool.poll_once()  # health probes estimate the clock offset
+        est = router.pool.clock_offset("a")
+        assert est == pytest.approx(self.SKEW, abs=0.25)
+        self._seed_two_tier_trace(router, a, "rtr-0")
+        status, doc = get_json(port, "/debug/trace?trace=rtr-0")
+        assert status == 200
+        assert doc["otherData"]["trace"] == "rtr-0"
+        assert doc["otherData"]["replica"] == "a"
+        evs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert set(evs) == {"router_request", "queue", "decode"}
+        # distinct pid lanes per tier
+        assert evs["router_request"]["pid"] != evs["queue"]["pid"]
+        # corrected timestamps: replica spans land INSIDE the router span and
+        # keep their order (monotonic corrected timeline)
+        r = evs["router_request"]
+        slack = 0.25e6  # offset-estimate error budget (us)
+        for name in ("queue", "decode"):
+            assert r["ts"] - slack <= evs[name]["ts"], name
+            assert (evs[name]["ts"] + evs[name]["dur"]
+                    <= r["ts"] + r["dur"] + slack), name
+        assert evs["queue"]["ts"] < evs["decode"]["ts"]
+
+    def test_unknown_owner_falls_back_to_router_only(self, fleet_router):
+        a = FleetStub()
+        router, port, _ = fleet_router([("a", a)])
+        router.tracer.add_span("router_request", router.tracer.now(), 0.1,
+                               trace="rtr-99")
+        status, doc = get_json(port, "/debug/trace?trace=rtr-99")
+        assert status == 200
+        names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert names == {"router_request"}
+        assert doc["otherData"]["replica"] is None
+
+    def test_dropped_counts_ride_along(self, fleet_router):
+        a = FleetStub()
+        router, port, _ = fleet_router([("a", a)])
+        self._seed_two_tier_trace(router, a, "rtr-1")
+        _, doc = get_json(port, "/debug/trace?trace=rtr-1")
+        assert set(doc["otherData"]["dropped_spans"]) == {"router", "a"}
+
+    def test_since_ts_cursor_stays_incremental_ring_read(self, fleet_router):
+        # a since_ts cursor is the incremental-scrape contract: it must read
+        # the router's own ring (honoring the cursor), not trigger a stitch
+        a = FleetStub()
+        router, port, _ = fleet_router([("a", a)])
+        self._seed_two_tier_trace(router, a, "rtr-2")
+        cursor = router.tracer.now()
+        status, doc = get_json(port, f"/debug/trace?trace=rtr-2&since_ts={cursor}")
+        assert status == 200
+        assert "trace" not in doc["otherData"]  # not the stitched shape
+        assert [e for e in doc["traceEvents"] if e.get("ph") == "X"] == []
+        # and everything before the cursor is still there without it filtered
+        status, doc = get_json(port, f"/debug/trace?trace=rtr-2&since_ts=0")
+        names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert names == {"router_request"}  # router ring only, no replica fetch
+
+
+# ---------------------------------------------------------------- end-to-end
+@pytest.fixture(scope="module")
+def model():
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine_factory(model):
+    from paddlenlp_tpu.experimental import InferenceEngine
+
+    def make_engine():
+        return InferenceEngine(model, max_batch_size=4, block_size=4,
+                               num_blocks=128, max_blocks_per_seq=32,
+                               decode_steps=4)
+    return make_engine
+
+
+def post_completion(port, payload, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+class TestTwoTierEndToEnd:
+    """ISSUE 6 acceptance: a two-replica fleet run yields ONE stitched Chrome
+    trace for a request — router route/forward spans and replica
+    queue/prefill/decode spans under one trace id with monotonic corrected
+    timestamps — and head-based sampling keeps only deterministically-chosen
+    traces on the replicas while sampled ones keep full detail."""
+
+    def test_stitched_trace_single_request(self, model):
+        from paddlenlp_tpu.serving.router import launch_fleet
+
+        TRACER.clear()
+        fleet = launch_fleet(2, make_engine_factory(model), poll_interval_s=0.2)
+        try:
+            status, body = post_completion(
+                fleet.router_port, {"prompt": [5, 6, 7, 8], "max_tokens": 4})
+            assert status == 200
+            rid = body["id"]
+            assert rid.startswith("rtr-")
+            # retrospective per-request spans land at finish; one poll of slack
+            deadline = time.time() + 5
+            while time.time() < deadline and not TRACER.snapshot(trace=rid):
+                time.sleep(0.02)
+            status, doc = get_json(fleet.router_port, f"/debug/trace?trace={rid}")
+            assert status == 200
+            assert doc["otherData"]["trace"] == rid
+            xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            by_name = {}
+            for e in xs:
+                assert e["args"]["trace"] == rid  # one trace id end to end
+                by_name.setdefault(e["name"], []).append(e)
+            # router tier spans + replica tier spans in one document
+            for name in ("route", "router_request", "queue", "prefill", "decode"):
+                assert name in by_name, (name, sorted(by_name))
+            router_pid = by_name["router_request"][0]["pid"]
+            assert by_name["decode"][0]["pid"] != router_pid  # distinct lanes
+            # monotonic corrected timeline: queue -> prefill -> decode inside
+            # the router's request span (same host, offset ~0, 0.5s slack)
+            rq = by_name["router_request"][0]
+            q, p, d = (by_name[n][0] for n in ("queue", "prefill", "decode"))
+            assert q["ts"] <= p["ts"] <= d["ts"]
+            slack = 0.5e6
+            for ev in (q, p, d):
+                assert rq["ts"] - slack <= ev["ts"] <= rq["ts"] + rq["dur"] + slack
+            # device correlation: engine phase spans carry the step id that
+            # StepTraceAnnotation stamps on the device timeline
+            engine_spans = [s for s in TRACER.snapshot()
+                            if s.cat == "engine" and s.args and "step" in s.args]
+            assert engine_spans and all(s.args["step"] >= 0 for s in engine_spans)
+        finally:
+            fleet.shutdown(drain_timeout_s=10)
+            TRACER.clear()
+
+    def test_head_sampling_thins_replica_spans(self, model):
+        from paddlenlp_tpu.serving.router import launch_fleet
+
+        TRACER.clear()
+        n_requests, every = 24, 8
+        fleet = launch_fleet(2, make_engine_factory(model), poll_interval_s=0.2,
+                             trace_sample_every=every)
+        try:
+            rids = []
+            for _ in range(n_requests):
+                status, body = post_completion(
+                    fleet.router_port, {"prompt": [5, 6, 7], "max_tokens": 2})
+                assert status == 200
+                rids.append(body["id"])
+            want_sampled = {r for r in rids if trace_sampled(r, every)}
+            assert 0 < len(want_sampled) < n_requests / 4
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                got = {s.trace for s in TRACER.snapshot()
+                       if s.trace in set(rids)}
+                if got == want_sampled:
+                    break
+                time.sleep(0.05)
+            # the replicas recorded EXACTLY the router's deterministic 1-in-N
+            # choice: unsampled requests took the no-op path...
+            assert got == want_sampled
+            # ...while sampled ones kept full per-request detail
+            for rid in want_sampled:
+                names = {s.name for s in TRACER.snapshot(trace=rid)}
+                assert {"queue", "prefill", "decode"} <= names, (rid, names)
+        finally:
+            fleet.shutdown(drain_timeout_s=10)
+            # drop the rtr-N sampling marks pinned on the process-global
+            # tracer: later tests mint fresh routers whose ids restart at
+            # rtr-0 and must not inherit this fleet's decisions
+            TRACER.clear()

@@ -69,3 +69,37 @@ class TestJsonFormatter:
         assert isinstance(logger._handler.formatter, mod._JsonFormatter)
         logger.set_json(False)
         assert isinstance(logger._handler.formatter, mod._ColorFormatter)
+
+
+class TestLogTraceCorrelation:
+    """ISSUE 6: JSON log records carry the ambient span-tracer trace id, the
+    grep-join key between fleet logs and stitched /debug/trace timelines.
+    Uses the real package module (the standalone file load above cannot reach
+    the tracer's contextvar)."""
+
+    def _record(self):
+        return logging.LogRecord(
+            name="paddlenlp_tpu", level=logging.WARNING, pathname="/a/serving.py",
+            lineno=1, msg="step", args=(), exc_info=None)
+
+    def test_trace_key_inside_traced_request(self):
+        from paddlenlp_tpu.observability import use_trace
+        from paddlenlp_tpu.utils.log import _JsonFormatter
+
+        with use_trace("rtr-42"):
+            out = json.loads(_JsonFormatter().format(self._record()))
+        assert out["trace"] == "rtr-42"
+
+    def test_no_trace_key_outside_requests(self):
+        from paddlenlp_tpu.utils.log import _JsonFormatter
+
+        out = json.loads(_JsonFormatter().format(self._record()))
+        assert "trace" not in out
+
+    def test_nested_trace_wins(self):
+        from paddlenlp_tpu.observability import use_trace
+        from paddlenlp_tpu.utils.log import _JsonFormatter
+
+        with use_trace("outer"), use_trace("inner"):
+            out = json.loads(_JsonFormatter().format(self._record()))
+        assert out["trace"] == "inner"

@@ -14,6 +14,9 @@ Usage::
     python tools/bench_serve.py --replicas 2     # router front tier over 2 CPU
                                                  # replicas; the JSON line adds
                                                  # request_share/failovers/rerouted
+                                                 # + /fleet/slo readouts (fleet
+                                                 # availability, TTFT vs the
+                                                 # objective, burn rates)
     python tools/bench_serve.py --prefix-share 0.75
                                                  # 75% of requests reuse one long
                                                  # common prefix; the JSON line's
@@ -194,6 +197,17 @@ def run() -> None:
         _fail(f"/metrics scrape failed: HTTP {resp.status}")
     replica_expositions = [r.expose() for r in fleet.registries()] if fleet is not None \
         else [scraped]
+    fleet_slo = None
+    if fleet is not None:
+        # fleet SLO plane: federated availability + TTFT burn rates, scraped
+        # the same way an on-call dashboard would
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/fleet/slo")
+        resp = conn.getresponse()
+        slo_raw = resp.read()
+        conn.close()
+        if resp.status == 200:
+            fleet_slo = json.loads(slo_raw)
     if fleet is not None:
         fleet.shutdown(drain_timeout_s=10)
     else:
@@ -256,6 +270,21 @@ def run() -> None:
         record["request_share"] = {k: int(v) for k, v in sorted(share.items())}
         record["failovers"] = int(rscalar("paddlenlp_router_failovers_total"))
         record["rerouted"] = int(rscalar("paddlenlp_router_rerouted_total"))
+        if fleet_slo is not None and fleet_slo.get("windows"):
+            # the longest window covers the whole bench run (process lifetime)
+            widest = fleet_slo["windows"][max(
+                fleet_slo["windows"], key=lambda w: int(w.rstrip("s")))]
+            objectives = fleet_slo.get("objectives", {})
+            record["fleet_availability"] = round(widest["availability"], 6)
+            record["fleet_availability_burn_rate"] = round(
+                widest["availability_burn_rate"], 3)
+            record["fleet_ttft_burn_rate"] = round(widest["ttft_burn_rate"], 3)
+            record["fleet_ttft_violation_rate"] = round(
+                widest["ttft_violation_rate"], 4)
+            record["ttft_objective_ms"] = round(
+                objectives.get("ttft_threshold_s", 0.0) * 1e3, 1)
+            record["server_ttft_p99_ms"] = round(
+                quantile_max("paddlenlp_serving_ttft_seconds", 0.99) * 1e3, 1)
     print(json.dumps(record))
 
 

@@ -2,11 +2,17 @@
 text format with HELP/TYPE lines.
 
 Instantiates the full catalog — the serving runtime's ``ServingMetrics`` (on a
-stub engine, no jax compute), the router front tier's ``RouterMetrics``, and
-the trainer's ``register_training_metrics`` —
-into one fresh registry, renders the exposition, and runs
-``observability.lint_exposition`` over it: missing HELP, missing TYPE, illegal
-names/labels, non-cumulative histogram buckets, negative counters all fail.
+stub engine, no jax compute), the router front tier's ``RouterMetrics``, the
+SLO plane's ``paddlenlp_slo_*`` series, the tracer-overflow counter, and the
+trainer's ``register_training_metrics`` — into one fresh registry, renders the
+exposition, and runs ``observability.lint_exposition`` over it: missing HELP,
+missing TYPE, illegal names/labels, non-cumulative histogram buckets, negative
+counters all fail.
+
+Also lints the *federated* exposition path: two synthetic replica expositions
+are merged through ``router.metrics.federate_expositions`` and checked with
+both the standard lint and ``lint_federation`` (duplicate-family TYPE
+conflicts, pre-existing ``replica`` label collisions across the merge).
 
 Prints ONE JSON line (``{"ok": ..., "families": N, "problems": [...]}``) and
 exits non-zero on problems — `tests/observability/test_check_metrics.py` runs
@@ -48,8 +54,10 @@ def _stub_engine():
 
 
 def catalog_exposition() -> str:
-    """Render the full serving + router + training metric catalog from a
+    """Render the full serving + router + SLO + training metric catalog from a
     fresh registry."""
+    from paddlenlp_tpu.observability.exporter import TRACES_DROPPED_METRIC
+    from paddlenlp_tpu.observability.slo import SLOInputs, SLOTracker
     from paddlenlp_tpu.serving.engine_loop import ServingMetrics
     from paddlenlp_tpu.serving.metrics import MetricsRegistry
     from paddlenlp_tpu.serving.router.metrics import RouterMetrics
@@ -63,8 +71,38 @@ def catalog_exposition() -> str:
     router.replica_healthy.set(1.0, replica="replica-0")
     router.requests.inc(replica="replica-0", outcome="ok")
     router.health_polls.inc(replica="replica-0", outcome="ok")
+    router.fleet_scrape_errors.inc(replica="replica-0")
+    slo = SLOTracker(registry=registry)
+    slo.observe(SLOInputs(total=10.0, errors=1.0, ttft_count=10.0,
+                          ttft_violations=2.0), now=100.0)
+    slo.report(now=100.0)  # populates the per-window gauge labelsets
+    registry.counter(TRACES_DROPPED_METRIC,
+                     "Spans evicted from the bounded trace ring (oldest-first overflow)")
     register_training_metrics(registry)
     return registry.expose()
+
+
+def federation_problems() -> list:
+    """Lint the federated-exposition path: merge two synthetic replica
+    catalogs through ``federate_expositions`` and run both the standard
+    exposition lint over the merge and ``lint_federation`` over the inputs
+    (duplicate-family TYPE conflicts, pre-existing ``replica`` labels)."""
+    from paddlenlp_tpu.observability import lint_exposition
+    from paddlenlp_tpu.serving.engine_loop import ServingMetrics
+    from paddlenlp_tpu.serving.metrics import MetricsRegistry
+    from paddlenlp_tpu.serving.router.metrics import federate_expositions, lint_federation
+
+    expositions = {}
+    for rid in ("replica-0", "replica-1"):
+        registry = MetricsRegistry()
+        metrics = ServingMetrics(_stub_engine(), registry=registry)
+        metrics.requests.inc(status="stop")
+        metrics.ttft.observe(0.05)
+        expositions[rid] = registry.expose()
+    problems = [f"federation: {p}" for p in lint_federation(expositions)]
+    merged = federate_expositions(expositions)
+    problems += [f"federated exposition: {p}" for p in lint_exposition(merged)]
+    return problems
 
 
 def main() -> int:
@@ -74,9 +112,10 @@ def main() -> int:
     if "--file" in sys.argv:
         with open(sys.argv[sys.argv.index("--file") + 1]) as f:
             text = f.read()
+        problems = lint_exposition(text)
     else:
         text = catalog_exposition()
-    problems = lint_exposition(text)
+        problems = lint_exposition(text) + federation_problems()
     families = parse_prometheus_text(text)
     print(json.dumps({
         "ok": not problems,
