@@ -61,6 +61,13 @@ class PredictorArgument:
         metadata={"help": "share KV blocks across requests with a common prompt prefix "
                           "(refcounted blocks + copy-on-write; prefill runs only on the "
                           "uncached suffix). Disable to force full prefill per request."})
+    prefill_chunk_tokens: Optional[int] = field(
+        default=None,
+        metadata={"help": "split prompt processing into chunks of at most this many "
+                          "tokens, interleaved with decode tokens in ragged mixed "
+                          "engine steps (256-512 is a good TPU range) — a long prompt "
+                          "no longer stalls running decodes for its whole prefill. "
+                          "None/0 = monolithic prefill."})
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -164,6 +171,7 @@ class BlockPredictor(BasePredictor):
             dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
             kv_cache_quant=self._kv_quant(args.cachekv_int8_type),
             enable_prefix_cache=args.enable_prefix_cache,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
             use_speculative=args.speculate_method == "ngram",
             spec_draft_len=args.speculate_max_draft_tokens,
             draft_model=draft_model,

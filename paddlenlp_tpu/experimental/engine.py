@@ -10,6 +10,13 @@ device programs:
 
 - admission: waiting requests prefill in BATCHES grouped by power-of-two padded
   prompt length; the first token is sampled on device inside the prefill jit;
+- chunked prefill (``prefill_chunk_tokens=N``): prompt processing is split into
+  fixed-size chunks interleaved with decode tokens — each engine step feeds at
+  most N prompt tokens (tracked per slot via ``Request.prefilled_len``) plus one
+  decode token per running sequence through ONE ragged mixed forward, so a
+  long-prompt admission never stalls running decodes for the whole prompt; the
+  sampler fires only when a request's last chunk lands (the *Ragged Paged
+  Attention* TPU-serving design);
 - decode: ALL slots advance up to ``decode_steps`` tokens in ONE jit —
   sampling, repetition/presence/frequency penalties, eos and length stops all
   run on device; the host round-trip carries int32 ids + flags only (the
@@ -44,6 +51,7 @@ from .paged_cache import BlockManager, copy_blocks, init_paged_pool
 __all__ = ["InferenceEngine", "Request", "SamplingParams"]
 
 _F_STEP = FaultPoint("engine.step")
+_F_CHUNK = FaultPoint("engine.prefill_chunk")
 
 
 @dataclasses.dataclass
@@ -75,6 +83,12 @@ class Request:
     aborted: bool = False
     base_prompt_len: int = 0  # original prompt length (preemption grows prompt_ids)
     trace: Optional[str] = None  # observability trace id (serving request context)
+    prefilled_len: int = 0  # prompt tokens whose KV is in the pool (chunked prefill)
+
+    @property
+    def needs_prefill(self) -> bool:
+        """True while part of the prompt still awaits a prefill chunk."""
+        return self.prefilled_len < len(self.prompt_ids)
 
     @property
     def total_len(self) -> int:
@@ -139,6 +153,10 @@ class InferenceEngine:
         # only valid while params are frozen — callers that update weights
         # between requests must disable this or call clear_prefix_cache()
         enable_prefix_cache: bool = True,
+        # split prompt processing into chunks of at most this many tokens,
+        # interleaved with decode tokens (one ragged mixed step per chunk) so
+        # no engine step does unbounded prefill. None/0 = monolithic prefill.
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -172,6 +190,16 @@ class InferenceEngine:
         self._spec_rngs: Dict[int, np.random.Generator] = {}
         self.spec_stats = {"verify_steps": 0, "tokens_emitted": 0, "drafted": 0, "accepted": 0}
         self.num_preemptions = 0
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 0:
+            raise ValueError(f"prefill_chunk_tokens must be >= 0, got {prefill_chunk_tokens}")
+        self.prefill_chunk_tokens = prefill_chunk_tokens or None
+        # chunked-prefill accounting: monotone totals (stats()) plus bounded
+        # event rings the metrics plane drains by sequence number — a stats()
+        # read from an HTTP thread must never consume a histogram observation
+        self.chunk_stats = {"chunks": 0, "chunk_tokens": 0}
+        self._chunk_seq = itertools.count(1)
+        self.recent_chunk_sizes: deque = deque(maxlen=256)  # (seq, n_tokens)
+        self.recent_decode_stalls: deque = deque(maxlen=256)  # (seq, seconds)
         # monotone step id: stamped on host spans AND on the device timeline
         # via jax.profiler.StepTraceAnnotation, so a span in /debug/trace and
         # an XLA op in a device profile join on the same number
@@ -290,6 +318,12 @@ class InferenceEngine:
                 "evictions": self.mgr.evictions,
                 "cached_blocks": self.mgr.num_cached_blocks,
             },
+            "chunked_prefill": {
+                "enabled": bool(self.prefill_chunk_tokens),
+                "chunk_tokens": self.prefill_chunk_tokens or 0,
+                "chunks": self.chunk_stats["chunks"],
+                "chunk_tokens_total": self.chunk_stats["chunk_tokens"],
+            },
         }
 
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
@@ -312,8 +346,18 @@ class InferenceEngine:
         # whose step_num matches the step= arg on the host prefill/decode
         # spans — host stall or device stall is one cross-reference away
         with jax.profiler.StepTraceAnnotation("engine_step", step_num=self._cur_step):
-            self._admit(finished)
-            self._decode_running(finished)
+            if self.prefill_chunk_tokens:
+                self._admit_chunked(finished)
+                if any(r is not None and r.needs_prefill for r in self.slots):
+                    # >=1 slot mid-prefill: one ragged mixed step (chunks +
+                    # one decode token per running sequence)
+                    self._mixed_step(finished)
+                else:
+                    # steady state: the multi-token decode jit as usual
+                    self._decode_running(finished)
+            else:
+                self._admit(finished)
+                self._decode_running(finished)
         if self.step_cb is not None:
             self.step_cb(self.stats())
         return finished
@@ -338,10 +382,14 @@ class InferenceEngine:
     def _free_slot_indices(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
 
-    def _admit(self, finished: List[Request]):
+    def _admit_slots(self, finished: List[Request]) -> List[tuple]:
+        """Shared admission front half: bind waiting requests to free slots and
+        allocate their KV blocks (prefix-cache match + COW included). Returns
+        ``[(slot, req, n_cached), ...]``; the caller owns the prefill launch —
+        monolithic (:meth:`_admit`) or chunked (:meth:`_admit_chunked`)."""
         free = self._free_slot_indices()
         if not self.waiting or not free:
-            return
+            return []
         queue_depth = len(self.waiting)
         n_finished0 = len(finished)
         admit_t0 = time.perf_counter()
@@ -414,10 +462,35 @@ class InferenceEngine:
                             hits=self.mgr.cache_hits - hits0,
                             cached_tokens=self.mgr.cached_tokens_total - cached0,
                             cow_copies=len(cow))
+        return admitted
 
+    def _seed_cached_counts(self, entries: List[tuple], n_rows: int) -> jnp.ndarray:
+        """Penalty counts for prefix-cache-hit prompt spans: the fed suffix is
+        counted on device, the cached span here via host bincount. Clipped: an
+        out-of-vocab id from a direct caller must degrade to a garbage count
+        (the old one_hot behavior), not crash the step / allocate a
+        token-id-sized array. All-miss (or cache-off) batches materialize the
+        zeros on device instead of shipping an n*vocab host buffer.
+        ``entries`` = [(row, req, n_cached)]; returns [n_rows, vocab] int32."""
+        vocab = self.model.config.vocab_size
+        counts_in = None
+        for row, req, n_cached in entries:
+            if n_cached > 0:
+                if counts_in is None:
+                    counts_in = np.zeros((n_rows, vocab), np.int32)
+                counts_in[row] = np.bincount(
+                    np.clip(req.prompt_ids[:n_cached], 0, vocab - 1),
+                    minlength=vocab)[:vocab]
+        if counts_in is None:
+            return jnp.zeros((n_rows, vocab), jnp.int32)
+        return jnp.asarray(counts_in)
+
+    def _admit(self, finished: List[Request]):
+        admitted = self._admit_slots(finished)
+        if not admitted:
+            return
         # batch prefills, grouped by padded UNCACHED suffix length (bounded
         # retraces; a cache hit shortens the fed sequence, not just the FLOPs)
-        vocab = self.model.config.vocab_size
         by_bucket: Dict[int, List[tuple]] = {}
         for slot, req, n_cached in admitted:
             by_bucket.setdefault(_bucket(len(req.prompt_ids) - n_cached),
@@ -428,7 +501,6 @@ class InferenceEngine:
             tables = np.zeros((n, self.mgr.max_blocks_per_seq), np.int32)
             suffix_lens = np.zeros(n, np.int32)
             cached_lens = np.zeros(n, np.int32)
-            counts_in = None  # host bincount only when a cached span exists
             reqs: List[Optional[Request]] = [None] * n
             for j, (slot, req, n_cached) in enumerate(group):
                 suffix = req.prompt_ids[n_cached:]
@@ -436,22 +508,9 @@ class InferenceEngine:
                 tables[j] = self.mgr.table_array(req.req_id)
                 suffix_lens[j] = len(suffix)
                 cached_lens[j] = n_cached
-                if n_cached > 0:
-                    # penalty counts must cover the FULL prompt: the fed
-                    # suffix is counted on device, the cached span here.
-                    # Clipped: an out-of-vocab id from a direct caller must
-                    # degrade to a garbage count (the old one_hot behavior),
-                    # not crash the step / allocate a token-id-sized array
-                    if counts_in is None:
-                        counts_in = np.zeros((n, vocab), np.int32)
-                    counts_in[j] = np.bincount(
-                        np.clip(req.prompt_ids[:n_cached], 0, vocab - 1),
-                        minlength=vocab)[:vocab]
                 reqs[j] = req
-            # all-miss (or cache-off) batches materialize the zeros on device
-            # instead of shipping an n*vocab host buffer every prefill
-            counts_dev = jnp.zeros((n, vocab), jnp.int32) if counts_in is None \
-                else jnp.asarray(counts_in)
+            counts_dev = self._seed_cached_counts(
+                [(j, req, c) for j, (_, req, c) in enumerate(group)], n)
             with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
                              step=self._cur_step,
                              req_ids=[r.req_id for _, r, _ in group],
@@ -465,14 +524,145 @@ class InferenceEngine:
             slot_idx = [slot for slot, _, _ in group]
             self.counts = self.counts.at[jnp.asarray(slot_idx)].set(counts_rows[: len(group)])
             for j, (slot, req, _) in enumerate(group):
-                tok = int(tokens[j])
-                self._emit(req, tok)
-                if req.done:
-                    self._free_kv(req, cache=True)
-                    finished.append(req)
-                else:
-                    self.slots[slot] = req
-                    self._last_token[slot] = tok
+                req.prefilled_len = len(req.prompt_ids)
+                self._settle_sampled(slot, req, int(tokens[j]), finished)
+
+    def _settle_sampled(self, slot: int, req: Request, tok: int, finished: List[Request]):
+        """Post-sample bookkeeping shared by every sampling site (monolithic
+        prefill, mixed-step final chunks, mixed-step decode rows): emit, then
+        either retire the request (KV freed / prefix-cache registered, slot
+        vacated) or keep it decoding in its slot."""
+        self._emit(req, tok)
+        if req.done:
+            self._free_kv(req, cache=True)
+            self.slots[slot] = None
+            finished.append(req)
+        else:
+            self.slots[slot] = req
+            self._last_token[slot] = tok
+
+    # ------------------------------------------------------------------ chunked prefill
+    def _admit_chunked(self, finished: List[Request]):
+        """Chunked admission: bind slots + allocate KV, but launch NO prefill —
+        the request sits in its slot with ``prefilled_len`` = its prefix-cache
+        hit and :meth:`_mixed_step` feeds the rest chunk by chunk."""
+        admitted = self._admit_slots(finished)
+        if not admitted:
+            return
+        slot_idx = []
+        for slot, req, n_cached in admitted:
+            req.prefilled_len = n_cached
+            self.slots[slot] = req
+            slot_idx.append(slot)
+        # seed the device-side penalty counts: the cached span never rides
+        # through a chunk forward, so its counts come from a host bincount
+        # (zeros rows still land — the slot's previous occupant is stale)
+        rows = self._seed_cached_counts(
+            [(i, req, c) for i, (_, req, c) in enumerate(admitted)], len(admitted))
+        self.counts = self.counts.at[jnp.asarray(slot_idx)].set(rows)
+
+    def _mixed_step(self, finished: List[Request]):
+        """One ragged mixed step: up to ``prefill_chunk_tokens`` prompt tokens
+        (split across mid-prefill slots, oldest request first) plus ONE decode token
+        for every running sequence, in a single forward. Decode keeps flowing
+        while a long prompt fills — the per-step stall is bounded by the chunk
+        budget, not the prompt length."""
+        _F_CHUNK.fire(
+            prefilling=sum(1 for r in self.slots if r is not None and r.needs_prefill))
+        # capacity pass: every decoding slot needs a block covering this step's
+        # KV write. Oldest slots secure theirs first; exhaustion preempts the
+        # YOUNGEST active slot — which may be a mid-prefill request (its chunk
+        # progress resets on requeue; mid-prefill rows themselves never grow,
+        # their full-prompt blocks were reserved at admission).
+        for slot in sorted(
+                [s for s, r in enumerate(self.slots)
+                 if r is not None and not r.needs_prefill],
+                key=lambda s: self.slots[s].req_id):
+            req = self.slots[slot]
+            if req is None or req.needs_prefill:
+                continue  # victim of an earlier iteration's preemption
+            while True:
+                grow = req.total_len - self.mgr.lengths[req.req_id]
+                if grow <= 0 or self.mgr.extend(req.req_id, grow) is not None:
+                    break
+                active = [s for s, r in enumerate(self.slots) if r is not None]
+                victim = max(active, key=lambda s: self.slots[s].req_id)
+                self._preempt(victim)
+                if victim == slot:
+                    break
+        budget = self.prefill_chunk_tokens
+        chunk_rows: List[tuple] = []  # (slot, req, n_new)
+        decode_rows: List[tuple] = []  # (slot, req)
+        prefilling: List[int] = []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if req.needs_prefill:
+                prefilling.append(slot)
+            else:
+                decode_rows.append((slot, req))
+        # the OLDEST mid-prefill request drinks the chunk budget first: slot
+        # order would let a newly-admitted prompt landing in a lower slot
+        # starve an older one indefinitely under sustained admissions
+        for slot in sorted(prefilling, key=lambda s: self.slots[s].req_id):
+            if budget <= 0:
+                break
+            req = self.slots[slot]
+            n = min(budget, len(req.prompt_ids) - req.prefilled_len)
+            chunk_rows.append((slot, req, n))
+            budget -= n
+        if not chunk_rows and not decode_rows:
+            return
+        t0 = time.perf_counter()
+        B = self.max_batch_size
+        T = _bucket(max([n for _, _, n in chunk_rows], default=1), minimum=1)
+        ids = np.zeros((B, T), np.int32)
+        tables = np.zeros((B, self.mgr.max_blocks_per_seq), np.int32)
+        q_lens = np.zeros(B, np.int32)
+        q_start = np.zeros(B, np.int32)
+        count_fed = np.zeros(B, bool)
+        emit = np.zeros(B, bool)
+        reqs: List[Optional[Request]] = [None] * B
+        for slot, req, n in chunk_rows:
+            p0 = req.prefilled_len
+            ids[slot, :n] = req.prompt_ids[p0 : p0 + n]
+            tables[slot] = self.mgr.table_array(req.req_id)
+            q_lens[slot] = n
+            q_start[slot] = p0
+            count_fed[slot] = True  # chunk tokens accumulate into the counts
+            emit[slot] = p0 + n == len(req.prompt_ids)  # sampler on last chunk
+            reqs[slot] = req
+        for slot, req in decode_rows:
+            ids[slot, 0] = self._last_token[slot]
+            tables[slot] = self.mgr.table_array(req.req_id)
+            q_lens[slot] = 1
+            q_start[slot] = req.total_len - 1  # position of the token being fed
+            emit[slot] = True
+            reqs[slot] = req
+        with TRACER.span("mixed_step", cat="engine", step=self._cur_step,
+                         chunk=T, chunks=len(chunk_rows), decodes=len(decode_rows),
+                         chunk_tokens=int(sum(n for _, _, n in chunk_rows)),
+                         req_ids=[r.req_id for _, r, _ in chunk_rows]):
+            tokens, self.counts, self.pool = self.infer.mixed_step(
+                self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
+                jnp.asarray(q_lens), jnp.asarray(q_start), self.counts,
+                jnp.asarray(count_fed), jnp.asarray(emit), self._samp_arrays(reqs),
+            )
+            tokens = np.asarray(tokens)
+        dur = time.perf_counter() - t0
+        for slot, req, n in chunk_rows:
+            req.prefilled_len += n
+            self.chunk_stats["chunks"] += 1
+            self.chunk_stats["chunk_tokens"] += n
+            self.recent_chunk_sizes.append((next(self._chunk_seq), n))
+            if not req.needs_prefill:
+                self._settle_sampled(slot, req, int(tokens[slot]), finished)
+        for slot, req in decode_rows:
+            self._settle_sampled(slot, req, int(tokens[slot]), finished)
+        if chunk_rows and decode_rows:
+            # every decode token in this step waited out the chunk work: the
+            # step duration IS the decode stall attributable to prefill
+            self.recent_decode_stalls.append((next(self._chunk_seq), dur))
 
     # ------------------------------------------------------------------ speculative
     def _spec_mode(self) -> Optional[str]:
@@ -587,6 +777,9 @@ class InferenceEngine:
         self.slots[slot] = None
         req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
         req.output_ids = []
+        # a half-prefilled request's KV is gone with its blocks: re-admission
+        # starts the chunk walk over (prefix-cache hits re-credit what they can)
+        req.prefilled_len = 0
         self.waiting.appendleft(req)
 
     def _req_rng(self, req) -> np.random.Generator:

@@ -128,6 +128,7 @@ class PagedInferenceModel:
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._verify = jax.jit(self._verify_impl, donate_argnums=(1,),
                                static_argnames=("need_logits",))
+        self._mixed = jax.jit(self._mixed_impl, donate_argnums=(1,))
 
     def _mm(self, p, x):
         """x @ kernel with quantized-leaf dispatch: a8w8 -> int8 x int8 MXU dot;
@@ -164,7 +165,8 @@ class PagedInferenceModel:
         out = jnp.einsum("bnts,bsnh->btnh", probs, v.astype(jnp.float32))
         return out.astype(q.dtype)
 
-    def _layer(self, carry, scanned, block_tables, q_positions, kv_len_mask, write_pos):
+    def _layer(self, carry, scanned, block_tables, q_positions, kv_len_mask, write_pos,
+               q_lens):
         """One decoder layer inside lax.scan: scanned = (layer_params, pool_layer
         [, scale_layer] for quantized caches)."""
         h = carry
@@ -195,17 +197,20 @@ class PagedInferenceModel:
                 pool_layer, scale_layer = written
             else:
                 pool_layer = written
-        if T == 1 and self.use_paged_kernel:
-            # fused block-table walk + attend: the Pallas decode kernel streams
+        if self.use_paged_kernel:
+            # fused block-table walk + attend: the Pallas ragged kernel streams
             # addressed KV blocks instead of materializing the gathered cache
-            # (dequant rides in-kernel for int8/fp8 pools)
-            from ..ops.pallas.paged_attention import paged_decode_attention
+            # (dequant rides in-kernel for int8/fp8 pools). One launch covers
+            # the whole ragged batch — decode rows (q_lens=1), prefill chunks
+            # (q_lens up to T), and inactive padding (q_lens=0) together.
+            from ..ops.pallas.paged_attention import ragged_paged_attention
 
-            attn_out = paged_decode_attention(
-                q[:, 0], pool_layer[0], pool_layer[1], block_tables, q_positions[:, 0],
+            attn_out = ragged_paged_attention(
+                q, pool_layer[0], pool_layer[1], block_tables,
+                q_start=q_positions[:, 0], q_lens=q_lens,
                 k_scale=None if scale_layer is None else scale_layer[0],
                 v_scale=None if scale_layer is None else scale_layer[1],
-            )[:, None]
+            )
         else:
             k_all, v_all = gather_kv(pool_layer, block_tables, scale_layer)
             attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
@@ -221,11 +226,17 @@ class PagedInferenceModel:
             return h, (pool_layer, scale_layer)
         return h, pool_layer
 
-    def _forward(self, params, pool: PagedKVPool, input_ids, block_tables, q_positions, kv_len_mask, write_pos, last_pos):
+    def _forward(self, params, pool: PagedKVPool, input_ids, block_tables, q_positions,
+                 kv_len_mask, write_pos, last_pos, q_lens=None):
         """input_ids [B,T]; returns (logits at last_pos [B,V], new PagedKVPool).
 
         ``last_pos=None`` returns full-sequence logits [B,T,V] (the speculative
-        verify step needs the model's prediction after EVERY draft position)."""
+        verify step needs the model's prediction after EVERY draft position).
+        ``q_lens`` [B] = valid new tokens per row (defaults to T everywhere);
+        only the Pallas ragged kernel consumes it — the XLA path masks padded
+        rows implicitly (their outputs are never read)."""
+        if q_lens is None:
+            q_lens = jnp.full((input_ids.shape[0],), input_ids.shape[1], jnp.int32)
         m = params["model"]
         embed = m["embed_tokens"]["embedding"]
         h = embed[input_ids].astype(self.dtype)
@@ -233,7 +244,8 @@ class PagedInferenceModel:
             h = h * jnp.asarray(self.config.hidden_size**0.5, h.dtype)
 
         def body(carry, scanned):
-            return self._layer(carry, scanned, block_tables, q_positions, kv_len_mask, write_pos)
+            return self._layer(carry, scanned, block_tables, q_positions, kv_len_mask,
+                               write_pos, q_lens)
 
         scanned = (m["layers"], pool.kv) if pool.scale is None else (m["layers"], pool.kv, pool.scale)
         h, new_pool = jax.lax.scan(body, h, scanned)
@@ -279,6 +291,7 @@ class PagedInferenceModel:
             params, pool, input_ids, block_tables, positions,
             kv_len_mask, cached_lens,
             jnp.maximum(suffix_lens - 1, 0),  # last VALID token (input may be padded)
+            q_lens=suffix_lens,
         )
         V = cached_counts.shape[-1]
         valid = (jnp.arange(T)[None, :] < suffix_lens[:, None]).astype(jnp.int32)
@@ -288,6 +301,51 @@ class PagedInferenceModel:
                                   * valid[..., None]).sum(axis=1)
         tokens = sample_tokens(logits, positions=total_lens, counts=counts, **samp)
         counts = counts + jax.nn.one_hot(tokens, V, dtype=jnp.int32)
+        return tokens, counts, new_pool
+
+    def _mixed_impl(self, params, pool, input_ids, block_tables, q_lens, q_start,
+                    counts, count_fed, emit, samp):
+        """One ragged mixed prefill/decode step: every row feeds ``q_lens[j]``
+        new tokens starting at absolute position ``q_start[j]`` — a prefill
+        CHUNK (``q_start`` = tokens already prefilled, ``q_lens`` up to the
+        chunk size), a decode step (``q_lens = 1``, ``q_start`` = position of
+        the last sampled token), or nothing (``q_lens = 0``, padded slot). KV
+        for every fed token is written into the paged pool at its absolute
+        position; attention covers ``[0, q_start + t]`` per fed token t —
+        causal across chunk boundaries because earlier chunks' KV is already
+        in the pool.
+
+        Sampling fires for EVERY row at position ``q_start + q_lens`` (the
+        next position) from the logits after the last valid fed token; the
+        caller keeps the token only where ``emit`` is set (final prefill
+        chunks and decode rows) — non-final chunks discard it, exactly the
+        "sampler fires only when the last chunk lands" contract.
+
+        Penalty-count accumulation across chunks: ``counts`` [B, V] is the
+        running per-row token count. Rows with ``count_fed`` add their fed
+        tokens on device (prefill chunks — the count survives to the next
+        chunk through the returned array); decode rows don't (their fed token
+        was counted when it was sampled). Rows with ``emit`` add the sampled
+        token. Penalties see counts INCLUDING the fed tokens, matching the
+        monolithic prefill exactly.
+
+        Returns (tokens [B], counts' [B, V], new pool).
+        """
+        n, T = input_ids.shape
+        positions = q_start[:, None] + jnp.arange(T)[None, :]
+        S = block_tables.shape[1] * self.block_size
+        kv_len_mask = jnp.arange(S)[None, :] < (q_start + q_lens)[:, None]
+        logits, new_pool = self._forward(
+            params, pool, input_ids, block_tables, positions, kv_len_mask,
+            q_start, jnp.maximum(q_lens - 1, 0), q_lens=q_lens,
+        )
+        V = counts.shape[-1]
+        valid = (jnp.arange(T)[None, :] < q_lens[:, None]).astype(jnp.int32)
+        fed = (jax.nn.one_hot(input_ids, V, dtype=jnp.int32) * valid[..., None]).sum(axis=1)
+        counts = counts + fed * count_fed.astype(jnp.int32)[:, None]
+        tokens = sample_tokens(logits, positions=q_start + q_lens, counts=counts, **samp)
+        counts = counts + jax.nn.one_hot(tokens, V, dtype=jnp.int32) \
+            * emit.astype(jnp.int32)[:, None]
         return tokens, counts, new_pool
 
     def _decode_impl(self, params, pool, tokens, block_tables, context_lens, done0,
@@ -378,3 +436,8 @@ class PagedInferenceModel:
         return self._decode(
             params, pool, tokens, block_tables, context_lens, done0, remaining, counts, samp
         )
+
+    def mixed_step(self, params, pool: PagedKVPool, input_ids, block_tables, q_lens,
+                   q_start, counts, count_fed, emit, samp):
+        return self._mixed(params, pool, input_ids, block_tables, q_lens, q_start,
+                           counts, count_fed, emit, samp)

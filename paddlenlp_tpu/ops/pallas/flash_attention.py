@@ -36,6 +36,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import CompilerParams
+
 __all__ = ["flash_attention"]
 
 NEG_INF = -1e30
@@ -175,7 +177,7 @@ def _flash_fwd(q, k, v, segments, scale, causal, window, block_q, block_kv, inte
             pltpu.VMEM((block_q, 1), jnp.float32),  # l
             pltpu.VMEM((block_q, H), jnp.float32),  # acc
         ],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf, seg_q3, seg_k3)
     return out.reshape(B, N, T, H).transpose(0, 2, 1, 3), lse[..., 0]
@@ -286,7 +288,7 @@ def _flash_bwd(q, k, v, segments, out, lse, g, scale, causal, window, block_q, b
 
     common = dict(scale=scale, block_q=block_q, block_kv=block_kv, causal=causal,
                   window=window, q_len=T, kv_len=S, use_segments=use_seg)
-    params = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary"))
+    params = CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),

@@ -1,22 +1,37 @@
-"""Pallas TPU paged-attention decode kernel.
+"""Pallas TPU ragged paged-attention kernel (mixed prefill-chunk + decode).
 
-Counterpart of the reference's block-attention decode op
-(``csrc/gpu/append_attention.cu:801`` + ``csrc/gpu/append_attn/*.cuh``): one
-fused kernel walks each sequence's block table, streams the addressed KV blocks
-HBM->VMEM, and runs the online-softmax attention — no [B, max_blocks*bs, K, H]
-gathered copy of the cache ever materializes (the XLA fallback's cost).
+Counterpart of the reference's block-attention ops
+(``csrc/gpu/append_attention.cu:801`` + ``csrc/gpu/append_attn/*.cuh``) in the
+shape the *Ragged Paged Attention* TPU kernel paper describes: ONE launch
+computes attention for a ragged batch where each sequence contributes a
+different number of new query tokens — a prefill chunk (tens to hundreds of
+tokens picking up at ``q_start`` = its already-prefilled length), a decode step
+(one token), or nothing (padded slot) — against its own paged KV, walking each
+sequence's block table and streaming the addressed KV blocks HBM->VMEM with an
+online-softmax accumulator. No ``[B, max_blocks*bs, K, H]`` gathered copy of
+the cache ever materializes (the XLA fallback's cost).
 
 Design:
 - grid = (B, K, max_blocks); the block axis is innermost and sequential,
-  carrying (m, l, acc) VMEM scratch per (group, H) query tile;
-- the block table and per-sequence context lengths ride scalar prefetch
-  (``pltpu.PrefetchScalarGridSpec``): the KV BlockSpec index map reads
-  ``tables[b, j]`` to aim the DMA at the right pool block — the table gather
-  IS the address computation, exactly like the CUDA kernel's block walk;
-- blocks past the context length are skipped (@pl.when), tail slots inside the
-  last block are masked;
-- GQA: queries fold to [B, K, group, H]; each grid cell attends its kv head's
-  whole query group.
+  carrying (m, l, acc) VMEM scratch per (T*group, H) query tile;
+- the block table plus per-sequence ``q_start``/``q_lens`` ride scalar
+  prefetch (``pltpu.PrefetchScalarGridSpec``): the KV BlockSpec index map
+  reads ``tables[b, j]`` to aim the DMA at the right pool block — the table
+  gather IS the address computation, exactly like the CUDA kernel's block
+  walk;
+- causal masking is per query ROW: query token t of sequence b sits at
+  absolute position ``q_start[b] + t`` and sees kv positions ``<= q_start+t``
+  — correct across chunk boundaries (a chunk's first token attends over the
+  whole prefilled span, its last over prefilled+chunk-1);
+- rows past ``q_lens[b]`` (padding) and fully-masked rows produce exact zeros
+  (their softmax denominator stays 0); blocks past the highest live query
+  position are skipped entirely (@pl.when);
+- GQA: queries fold to [B, K, T*group, H]; each grid cell attends its kv
+  head's whole query group for every chunk token at once;
+- ``q_lens = 1`` everywhere reduces to the classic paged decode kernel —
+  :func:`paged_decode_attention` is that wrapper, kept as the stable
+  decode-only API (``_layer`` now always dispatches the ragged kernel; the
+  wrapper has no library call sites, only external/test callers).
 
 Off-TPU (tests), the kernel runs in Pallas interpret mode.
 """
@@ -31,12 +46,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["paged_decode_attention"]
+from . import CompilerParams
+
+__all__ = ["paged_decode_attention", "ragged_paged_attention"]
 
 NEG_INF = -1e30
 
 
-def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, scale, use_kv_scale):
+def _kernel(tables_ref, start_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+            bs, scale, use_kv_scale, group):
     if use_kv_scale:
         ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
     else:
@@ -52,19 +70,23 @@ def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, scale, use_kv_s
         l_s[...] = jnp.zeros_like(l_s)
         acc_s[...] = jnp.zeros_like(acc_s)
 
-    ctx = ctx_ref[b]
+    start = start_ref[b]
+    qlen = len_ref[b]
+    # highest live query position: blocks past it contribute nothing to any row
+    hi = start + qlen - 1
 
-    @pl.when(j * bs <= ctx)
+    @pl.when((qlen > 0) & (j * bs <= hi))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [group, H]
+        q = q_ref[0, 0].astype(jnp.float32)  # [T*group, H]
         k = k_ref[0, 0].astype(jnp.float32)  # [bs, H]
         v = v_ref[0, 0].astype(jnp.float32)
         if use_kv_scale:  # int8/fp8 cache: dequant the streamed block in VMEM
             k = k * ks_ref[0, 0]
             v = v * vs_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [group, bs]
-        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = pos <= ctx
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # [T*group, bs]
+        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group  # query token idx
+        valid = (kv_pos <= start + t) & (t < qlen)
         s = jnp.where(valid, s, NEG_INF)
         m_prev = m_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -76,21 +98,31 @@ def _kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, *rest, bs, scale, use_kv_s
 
     @pl.when(j == nj - 1)
     def _finalize():
+        # dead rows (t >= q_lens, or q_lens == 0) kept l == 0 -> exact zeros
         o_ref[0, 0] = (acc_s[...] / jnp.maximum(l_s[...], 1e-37)).astype(o_ref.dtype)
 
 
-def paged_decode_attention(
-    q: jnp.ndarray,  # [B, N, H] one query token per sequence
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [B, T, N, H] new-token queries (rows past q_lens ignored)
     pool_k: jnp.ndarray,  # [num_blocks, K, bs, H] (kv-head-major: TPU-tileable DMA)
     pool_v: jnp.ndarray,
     block_tables: jnp.ndarray,  # [B, max_blocks] int32
-    context_lens: jnp.ndarray,  # [B] int32 (position of the current token)
+    q_start: jnp.ndarray,  # [B] absolute position of q[:, 0]
+    q_lens: jnp.ndarray,  # [B] valid new tokens per sequence (0 = inactive row)
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
     k_scale: Optional[jnp.ndarray] = None,  # [num_blocks, K, bs, 1] quantized-pool scales
     v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    B, N, H = q.shape
+    """One-launch attention for a ragged mixed prefill/decode batch.
+
+    Query token t of row b attends kv positions ``[0, q_start[b] + t]`` read
+    through ``block_tables[b]`` — the KV for positions ``< q_start`` was
+    written by earlier chunks/steps, the chunk's own KV by this step's scatter
+    (ordered before the kernel by jit data dependence on the pool). Returns
+    ``[B, T, N, H]`` with rows ``t >= q_lens[b]`` zeroed.
+    """
+    B, T, N, H = q.shape
     nb, K, bs, _ = pool_k.shape
     group = N // K
     max_blocks = block_tables.shape[1]
@@ -99,11 +131,13 @@ def paged_decode_attention(
         interpret = jax.default_backend() not in ("tpu",)
     use_kv_scale = k_scale is not None
 
-    qf = q.reshape(B, K, group, H)
-    kv_spec = pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0))
-    sc_spec = pl.BlockSpec((1, 1, bs, 1), lambda b, kh, j, t, c: (t[b, j], kh, 0, 0))
+    # [B, T, N, H] -> [B, K, T*group, H]: head n = kh*group + g, so T and group
+    # interleave as rows (t, g) -> row t*group + g of kv head kh
+    qf = q.reshape(B, T, K, group, H).transpose(0, 2, 1, 3, 4).reshape(B, K, T * group, H)
+    kv_spec = pl.BlockSpec((1, 1, bs, H), lambda b, kh, j, t, s, l: (t[b, j], kh, 0, 0))
+    sc_spec = pl.BlockSpec((1, 1, bs, 1), lambda b, kh, j, t, s, l: (t[b, j], kh, 0, 0))
     in_specs = [
-        pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
+        pl.BlockSpec((1, 1, T * group, H), lambda b, kh, j, t, s, l: (b, kh, 0, 0)),
         kv_spec,
         kv_spec,
     ]
@@ -112,21 +146,45 @@ def paged_decode_attention(
         in_specs += [sc_spec, sc_spec]
         operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, K, max_blocks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, group, H), lambda b, kh, j, t, c: (b, kh, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, T * group, H), lambda b, kh, j, t, s, l: (b, kh, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, 1), jnp.float32),  # m
-            pltpu.VMEM((group, 1), jnp.float32),  # l
-            pltpu.VMEM((group, H), jnp.float32),  # acc
+            pltpu.VMEM((T * group, 1), jnp.float32),  # m
+            pltpu.VMEM((T * group, 1), jnp.float32),  # l
+            pltpu.VMEM((T * group, H), jnp.float32),  # acc
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, scale=scale, use_kv_scale=use_kv_scale),
+        functools.partial(_kernel, bs=bs, scale=scale, use_kv_scale=use_kv_scale,
+                          group=group),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, K, group, H), q.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
+        out_shape=jax.ShapeDtypeStruct((B, K, T * group, H), q.dtype),
+        compiler_params=CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), *operands)
-    return out.reshape(B, N, H)
+    )(block_tables.astype(jnp.int32), q_start.astype(jnp.int32),
+      q_lens.astype(jnp.int32), *operands)
+    return out.reshape(B, K, T, group, H).transpose(0, 2, 1, 3, 4).reshape(B, T, N, H)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, N, H] one query token per sequence
+    pool_k: jnp.ndarray,  # [num_blocks, K, bs, H]
+    pool_v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    context_lens: jnp.ndarray,  # [B] int32 (position of the current token)
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Decode-only wrapper: every sequence contributes exactly one query token
+    at position ``context_lens[b]`` (the ragged kernel with ``q_lens = 1``)."""
+    B = q.shape[0]
+    out = ragged_paged_attention(
+        q[:, None], pool_k, pool_v, block_tables,
+        q_start=context_lens, q_lens=jnp.ones((B,), jnp.int32),
+        scale=scale, interpret=interpret, k_scale=k_scale, v_scale=v_scale,
+    )
+    return out[:, 0]

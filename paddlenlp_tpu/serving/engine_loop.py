@@ -252,6 +252,17 @@ class ServingMetrics:
         self.kv_cached = r.gauge(
             "paddlenlp_serving_kv_cached_blocks",
             "KV blocks registered in the prefix-cache index")
+        self.prefill_chunks = r.counter(
+            "paddlenlp_serving_prefill_chunks_total",
+            "Prompt chunks processed by ragged mixed prefill/decode steps")
+        self.prefill_chunk_tokens = r.histogram(
+            "paddlenlp_serving_prefill_chunk_tokens",
+            "Prompt tokens fed per prefill chunk",
+            buckets=(16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+        self.decode_stall = r.histogram(
+            "paddlenlp_serving_decode_stall_seconds",
+            "Per-step decode gap attributable to concurrent prefill-chunk work "
+            "(duration of mixed steps that carried both chunks and decodes)")
         self.rebind(engine)
 
     def rebind(self, engine):
@@ -277,6 +288,15 @@ class ServingMetrics:
             "cached_tokens": getattr(mgr, "cached_tokens_total", 0),
             "evictions": getattr(mgr, "evictions", 0),
         }
+        self._engine = engine
+        self._chunk_last = dict(getattr(engine, "chunk_stats", {"chunks": 0}))
+        # chunked-prefill histograms consume the engine's (seq, value) event
+        # rings; start past whatever the (possibly reset-in-place) engine
+        # already recorded so a rebuild never re-observes old events
+        self._chunk_seq_seen = max(
+            [s for s, _ in getattr(engine, "recent_chunk_sizes", ())]
+            + [s for s, _ in getattr(engine, "recent_decode_stalls", ())]
+            + [0])
 
     def on_finished(self, req):
         status = req.finish_reason or ("abort" if req.aborted else "unknown")
@@ -301,6 +321,23 @@ class ServingMetrics:
                 if delta > 0:
                     counter.inc(delta)
                 self._pc_last[key] = pc.get(key, 0)
+        cp = stats.get("chunked_prefill")
+        if cp:
+            delta = cp.get("chunks", 0) - self._chunk_last.get("chunks", 0)
+            if delta > 0:
+                self.prefill_chunks.inc(delta)
+            self._chunk_last["chunks"] = cp.get("chunks", 0)
+            # histogram observations come from the engine's bounded event rings
+            # (on_step runs on the loop thread, the only writer — no race)
+            seen = self._chunk_seq_seen
+            for seq, n in getattr(self._engine, "recent_chunk_sizes", ()):
+                if seq > seen:
+                    self.prefill_chunk_tokens.observe(n)
+                    self._chunk_seq_seen = max(self._chunk_seq_seen, seq)
+            for seq, dur in getattr(self._engine, "recent_decode_stalls", ()):
+                if seq > seen:
+                    self.decode_stall.observe(dur)
+                    self._chunk_seq_seen = max(self._chunk_seq_seen, seq)
 
 
 class EngineLoop:

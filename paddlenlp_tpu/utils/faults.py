@@ -63,6 +63,11 @@ CATALOG: Dict[str, str] = {
                    "engine-loop supervisor must absorb (degrade, rebuild, requeue).",
     "engine.rebuild": "Inside the supervisor's engine-rebuild attempt — failing it "
                       "extends the DEGRADED window (503 + Retry-After) deterministically.",
+    "engine.prefill_chunk": "Top of the engine's ragged mixed prefill/decode step, "
+                            "before the capacity pass and chunk schedule — a crash here leaves "
+                            "requests partially prefilled (no token emitted) and must "
+                            "triage through the supervisor with token-exact retry and "
+                            "no leaked KV blocks.",
     "serving.submit": "Inside Scheduler.submit after the admission slot is taken — "
                       "exercises the release-on-error path and HTTP 500 mapping.",
     "router.forward": "Immediately before the router opens the upstream connection for "

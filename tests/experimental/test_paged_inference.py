@@ -187,6 +187,82 @@ class TestPagedKernel:
         np.testing.assert_array_equal(want[1], got[1])
 
 
+class TestRaggedKernel:
+    def _ref(self, q, pk, pv, tables, q_start, q_lens):
+        """XLA reference: gather + per-row causal mask + zeroed dead rows."""
+        B, T, N, H = q.shape
+        nb, K, bs, _ = pk.shape
+        mb = tables.shape[1]
+
+        def flat(pool):
+            return pool[tables].transpose(0, 1, 3, 2, 4).reshape(B, mb * bs, K, H)
+
+        k_all = jnp.repeat(flat(pk), N // K, axis=2)
+        v_all = jnp.repeat(flat(pv), N // K, axis=2)
+        s = jnp.einsum("btnh,bsnh->bnts", q, k_all) * H**-0.5
+        q_pos = q_start[:, None] + jnp.arange(T)[None, :]
+        mask = jnp.arange(mb * bs)[None, None, :] <= q_pos[:, :, None]
+        out = jnp.einsum("bnts,bsnh->btnh",
+                         jax.nn.softmax(jnp.where(mask[:, None], s, -1e30), axis=-1),
+                         v_all)
+        live = jnp.arange(T)[None, :, None, None] < q_lens[:, None, None, None]
+        return jnp.where(live, out, 0.0)
+
+    def test_mixed_prefill_decode_rows(self):
+        """One launch over a ragged batch: a mid-prompt chunk, a decode row
+        (q_lens=1) and an inactive row (q_lens=0) against the same pool."""
+        from paddlenlp_tpu.ops.pallas.paged_attention import ragged_paged_attention
+
+        rng = np.random.default_rng(2)
+        B, T, N, K, H, nb, bs, mb = 3, 8, 4, 2, 64, 16, 8, 5
+        q = jnp.asarray(rng.standard_normal((B, T, N, H)), jnp.float32)
+        pk = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        pv = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        tables = jnp.asarray(rng.permutation(np.arange(1, nb))[: B * mb].reshape(B, mb),
+                             jnp.int32)
+        q_start = jnp.asarray([9, 22, 0], jnp.int32)  # chunk @9, decode @22, dead
+        q_lens = jnp.asarray([8, 1, 0], jnp.int32)
+        out = ragged_paged_attention(q, pk, pv, tables, q_start, q_lens, interpret=True)
+        ref = self._ref(q, pk, pv, tables, q_start, q_lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        assert np.all(np.asarray(out)[2] == 0.0)  # dead row is exact zeros
+        assert np.all(np.asarray(out)[1, 1:] == 0.0)  # decode row padding zeroed
+
+    def test_chunk_boundary_on_block_boundary(self):
+        """q_start on an exact block boundary: the first kv block of the chunk
+        is fully visible, later in-chunk positions unmask one column at a time."""
+        from paddlenlp_tpu.ops.pallas.paged_attention import ragged_paged_attention
+
+        rng = np.random.default_rng(3)
+        B, T, N, K, H, nb, bs, mb = 1, 8, 2, 2, 64, 10, 8, 4
+        q = jnp.asarray(rng.standard_normal((B, T, N, H)), jnp.float32)
+        pk = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        pv = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        tables = jnp.asarray([[3, 7, 1, 5]], jnp.int32)
+        q_start = jnp.asarray([8], jnp.int32)  # exactly one full block prefilled
+        q_lens = jnp.asarray([8], jnp.int32)
+        out = ragged_paged_attention(q, pk, pv, tables, q_start, q_lens, interpret=True)
+        ref = self._ref(q, pk, pv, tables, q_start, q_lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_decode_wrapper_matches_ragged(self):
+        from paddlenlp_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention, ragged_paged_attention)
+
+        rng = np.random.default_rng(4)
+        B, N, K, H, nb, bs, mb = 2, 4, 2, 64, 12, 8, 4
+        q = jnp.asarray(rng.standard_normal((B, N, H)), jnp.float32)
+        pk = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        pv = jnp.asarray(rng.standard_normal((nb, K, bs, H)), jnp.float32)
+        tables = jnp.asarray(rng.permutation(np.arange(1, nb))[: B * mb].reshape(B, mb),
+                             jnp.int32)
+        ctx = jnp.asarray([7, 22], jnp.int32)
+        a = paged_decode_attention(q, pk, pv, tables, ctx, interpret=True)
+        b = ragged_paged_attention(q[:, None], pk, pv, tables, ctx,
+                                   jnp.ones((B,), jnp.int32), interpret=True)[:, 0]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
 class TestPreemption:
     def test_preempt_and_recover(self, model):
         """Tiny pool forces preemption; the preempted request must still finish

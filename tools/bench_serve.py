@@ -22,6 +22,22 @@ Usage::
                                                  # common prefix; the JSON line's
                                                  # prefix_cache_hit_rate and
                                                  # cached_tokens track the win
+    python tools/bench_serve.py --long-prompt-mix --prefill-chunk 64
+                                                 # a few multi-thousand-token
+                                                 # prompts injected into a stream
+                                                 # of short chatty requests; the
+                                                 # JSON line folds in client p99
+                                                 # TTFT + p99 inter-token (decode
+                                                 # stall) — rerun with
+                                                 # --prefill-chunk 0 and the
+                                                 # chunked-vs-monolithic tail is
+                                                 # one flag flip to compare.
+                                                 # (64 is the CPU-smoke sweet
+                                                 # spot: a mixed step pads every
+                                                 # row to the chunk bucket on the
+                                                 # XLA fallback, so the per-step
+                                                 # stall scales with B*chunk;
+                                                 # 256-512 suits real TPU runs)
 """
 
 from __future__ import annotations
@@ -81,20 +97,50 @@ def run() -> None:
     max_tokens = _arg("--max-tokens", 16)
     n_replicas = _arg("--replicas", 1)
     prefix_share = _farg("--prefix-share", 0.0)
+    long_mix = "--long-prompt-mix" in sys.argv
+    n_long = _arg("--long-prompts", 2)
+    long_tokens = _arg("--long-prompt-tokens", 2048)
+    prefill_chunk = _arg("--prefill-chunk", 0)
     if not 0.0 <= prefix_share <= 1.0:
         _fail(f"--prefix-share must be in [0, 1], got {prefix_share}")
     # 24 tokens = 6 full blocks at block_size=4: a warm hit skips all of them
     shared_prefix = [9, 8, 7, 6, 5, 4, 3, 2] * 3
 
     cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
-                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=4096 if long_mix else 256,
                       eos_token_id=None, pad_token_id=0, use_scan_layers=True)
     model = LlamaForCausalLM.from_config(cfg, seed=0)
 
+    if long_mix:
+        # bigger blocks so a multi-thousand-token prompt fits a sane table
+        eng_kw = dict(max_batch_size=4, block_size=32, num_blocks=352,
+                      max_blocks_per_seq=96, decode_steps=4)
+        # over-capacity long prompts would finish 'capacity' with zero tokens
+        # over a normal 200 stream — the mix would silently measure nothing
+        cap = eng_kw["max_blocks_per_seq"] * eng_kw["block_size"]
+        if long_tokens + max_tokens > cap:
+            _fail(f"--long-prompt-tokens {long_tokens} + --max-tokens {max_tokens} "
+                  f"exceeds the long-mix engine's per-seq KV capacity ({cap} tokens)")
+    else:
+        eng_kw = dict(max_batch_size=4, block_size=4, num_blocks=256,
+                      max_blocks_per_seq=32, decode_steps=4)
+    if prefill_chunk:
+        eng_kw["prefill_chunk_tokens"] = prefill_chunk
+    # which stream positions carry a long prompt (spread through the run so
+    # chatty decodes are always in flight when one lands)
+    long_every = max(n_requests // max(n_long, 1), 1)
+    # request 0 is the warmup; long prompts land at i = 1, 1+long_every, ...
+    # (the i-1 anchor keeps long_every == 1 meaningful: requests 1..n_long)
+    is_long = (lambda i: long_mix and i >= 1 and (i - 1) % long_every == 0
+               and (i - 1) // long_every < n_long)
+    # what the schedule actually issues (i ranges over 0..n_requests-1, so
+    # --long-prompts close to --requests can't all land); report THIS count
+    n_long_issued = sum(1 for i in range(n_requests) if is_long(i))
+
     def make_engine():
         # one shared model (read-only params), one engine per replica
-        return InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
-                               max_blocks_per_seq=32, decode_steps=4)
+        return InferenceEngine(model, **eng_kw)
 
     registry = MetricsRegistry()
     fleet = server = None
@@ -118,11 +164,20 @@ def run() -> None:
     def one_request(i: int, stats: dict):
         t0 = time.time()
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=RUN_TIMEOUT_S)
+        # --long-prompt-mix: a few multi-thousand-token prompts ride a stream
+        # of short chatty requests (the worst decode-stall workload). Unique
+        # deterministic token streams keep the prefix cache out of the picture.
         # --prefix-share P: fraction P of requests open with one long common
         # prefix (a system prompt stand-in), so the prefix cache has something
         # to hit; the unique tail keeps every request distinct. The golden-
         # ratio stride spreads the P fraction evenly even for small N
-        if (i * 0.6180339887) % 1.0 < prefix_share:
+        if i < 0:
+            # dedicated long-prompt warmup: same length as the measured long
+            # prompts but a distinct token stream (no prefix-cache overlap)
+            prompt = [(5 + 3 * j) % 90 + 1 for j in range(long_tokens)]
+        elif is_long(i):
+            prompt = [(7 * i + 3 * j) % 90 + 1 for j in range(long_tokens)]
+        elif (i * 0.6180339887) % 1.0 < prefix_share:
             prompt = shared_prefix + [5 + i % 8, 6, 7]
         else:
             prompt = [5 + i % 8, 6, 7]
@@ -132,7 +187,8 @@ def run() -> None:
         resp = conn.getresponse()
         if resp.status != 200:
             raise RuntimeError(f"request {i}: HTTP {resp.status}")
-        n_toks, ttft = 0, None
+        n_toks, ttft, last_t = 0, None, None
+        gaps = []
         while True:
             line = resp.readline()
             if not line:
@@ -144,23 +200,36 @@ def run() -> None:
                 continue
             ev = json.loads(line[len(b"data: "):])
             if "token" in ev["choices"][0]:
+                now = time.time()
                 if ttft is None:
-                    ttft = time.time() - t0
+                    ttft = now - t0
+                else:
+                    gaps.append(now - last_t)
+                last_t = now
                 n_toks += 1
         conn.close()
         stats["ttft"].append(ttft if ttft is not None else float("nan"))
         stats["tokens"] += n_toks
+        if not is_long(i):
+            # the chatty requests are the decode-stall victims: their token
+            # gaps are the p99 the long-prompt mix is trying to protect
+            stats["gaps_short"].extend(gaps)
 
-    warm = {"ttft": [], "tokens": 0}
+    warm = {"ttft": [], "tokens": 0, "gaps_short": []}
     one_request(0, warm)
+    if long_mix:
+        # compile the long-prefill path (mixed-step jit / long prefill bucket)
+        # outside the measured window: the tail comparison is about steady-state
+        # scheduling, not one-time XLA compiles
+        one_request(-1, warm)
 
-    stats = {"ttft": [], "tokens": 0}
+    stats = {"ttft": [], "tokens": 0, "gaps_short": []}
     lock = threading.Lock()
     errors: list = []
     sem = threading.Semaphore(concurrency)
 
     def worker(i: int):
-        local = {"ttft": [], "tokens": 0}
+        local = {"ttft": [], "tokens": 0, "gaps_short": []}
         try:
             one_request(i, local)
         except Exception as e:
@@ -172,6 +241,7 @@ def run() -> None:
         with lock:
             stats["ttft"].extend(local["ttft"])
             stats["tokens"] += local["tokens"]
+            stats["gaps_short"].extend(local["gaps_short"])
 
     t0 = time.time()
     threads = []
@@ -258,6 +328,21 @@ def run() -> None:
             scalar_sum("paddlenlp_serving_prefix_cache_hits_total") / (n_requests + 1), 4),
         "cached_tokens": int(scalar_sum("paddlenlp_serving_prefix_cache_cached_tokens_total")),
     }
+    if long_mix:
+        gaps = sorted(stats["gaps_short"])
+        gp = lambda q: gaps[min(int(q * len(gaps)), len(gaps) - 1)] if gaps else 0.0
+        record["long_prompt_mix"] = {
+            "long_prompts": n_long_issued,
+            "long_prompt_tokens": long_tokens,
+            "prefill_chunk": prefill_chunk,
+            # client-observed tails: the chatty requests' inter-token gaps are
+            # the decode stalls the chunked prefill bounds
+            "client_p99_inter_token_ms": round(gp(0.99) * 1e3, 1),
+            "client_p50_inter_token_ms": round(gp(0.50) * 1e3, 1),
+            "prefill_chunks": int(scalar_sum("paddlenlp_serving_prefill_chunks_total")),
+            "decode_stall_p99_ms": round(
+                quantile_max("paddlenlp_serving_decode_stall_seconds", 0.99) * 1e3, 1),
+        }
     if fleet is not None:
         router_fams = parse_prometheus_text(scraped)
         share = {}

@@ -49,6 +49,9 @@ def _stub_engine():
         slots = [None] * 4
         max_batch_size = 4
         spec_stats = {"drafted": 0, "accepted": 0}
+        chunk_stats = {"chunks": 0, "chunk_tokens": 0}
+        recent_chunk_sizes = []  # (seq, n_tokens) chunked-prefill event ring
+        recent_decode_stalls = []  # (seq, seconds)
 
     return _Engine()
 
