@@ -68,6 +68,15 @@ class PredictorArgument:
                           "engine steps (256-512 is a good TPU range) — a long prompt "
                           "no longer stalls running decodes for its whole prefill. "
                           "None/0 = monolithic prefill."})
+    mesh_shape: Optional[str] = field(
+        default=None,
+        metadata={"help": "shard the serving forward + KV pool over a device mesh: "
+                          "'R,C' (dp x tp) or a bare tp degree 'T'. Weights/KV lay "
+                          "out with NamedSharding on the tp axis and the jitted "
+                          "steps compile with explicit in/out shardings — one "
+                          "replica spans several chips (CPU smoke: "
+                          "XLA_FLAGS=--xla_force_host_platform_device_count=N). "
+                          "None = single device."})
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -172,6 +181,7 @@ class BlockPredictor(BasePredictor):
             kv_cache_quant=self._kv_quant(args.cachekv_int8_type),
             enable_prefix_cache=args.enable_prefix_cache,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
+            mesh_shape=self._parse_mesh_shape(args.mesh_shape),
             use_speculative=args.speculate_method == "ngram",
             spec_draft_len=args.speculate_max_draft_tokens,
             draft_model=draft_model,
@@ -183,6 +193,19 @@ class BlockPredictor(BasePredictor):
             top_k=args.top_k,
             temperature=args.temperature,
         )
+
+    @staticmethod
+    def _parse_mesh_shape(raw: Optional[str]):
+        """'R,C' -> (dp, tp); bare 'T' -> (1, T); None stays single-device."""
+        if not raw:
+            return None
+        parts = [int(x) for x in str(raw).split(",")]
+        if len(parts) == 1:
+            parts = [1, parts[0]]
+        if len(parts) != 2 or any(p < 1 for p in parts):
+            raise ValueError(
+                f"--mesh_shape must be 'T' or 'R,C' with positive degrees, got {raw!r}")
+        return tuple(parts)
 
     @staticmethod
     def _kv_quant(cachekv_int8_type):

@@ -1,0 +1,342 @@
+"""Pluggable model backend: the seam between the scheduler and the device.
+
+``InferenceEngine`` (engine.py) owns *scheduling* — the waiting queue, slot
+binding, the ``BlockManager``, preemption, chunk budgets, prefix-cache
+bookkeeping, speculative acceptance. Everything that touches the device —
+model params, the paged KV pool, the per-slot penalty-count tensor, and the
+jitted step programs — lives behind a :class:`ModelBackend`. The engine talks
+to it in host numpy and plain Python; the backend decides placement, layout
+and compilation.
+
+Contract (one backend == one way to run the forward + lay out KV):
+
+- ``prefill(...)``       batched monolithic prompt prefill, samples token 0;
+- ``decode(...)``        multi-token decode for every running slot;
+- ``mixed_step(...)``    one ragged step of prefill chunks + decode tokens;
+- ``verify(...)``        speculative-decoding verify forward;
+- ``seed_counts``/``reset_counts``  per-slot penalty-count maintenance;
+- ``apply_cow(pairs)``   prefix-cache copy-on-write block copies in the pool;
+- ``describe()``         placement metadata for ``stats()``/the metrics plane.
+
+External weight updates (serving epochs, PPO rollouts) flow through the
+``params`` property: callers rebind ``model.params`` and the backend picks it
+up on the next step (the sharded backend re-places the tree on its mesh via
+an id check).
+
+Implementations:
+
+- :class:`SingleDeviceBackend` — the historical engine layout: everything on
+  the default device, ``PagedInferenceModel`` jits with no sharding
+  annotations.
+- ``ShardedBackend`` (sharded_backend.py) — weights + KV pool laid out with
+  ``jax.sharding.NamedSharding`` over a ``parallel.mesh`` Mesh; the same
+  scheduler runs unchanged on top.
+
+**MPMD stage-split seam.** A two-stage disaggregated prefill/decode backend
+(per *Scaling Deep Learning Training with MPMD Pipeline Parallelism*) is a
+THIRD implementation of this interface, not an engine rewrite: ``prefill`` /
+the chunk rows of ``mixed_step`` run on the prefill stage's mesh, ``decode`` /
+the decode rows on the decode stage's mesh, and the backend migrates a
+sequence's KV blocks between the two pools when its last chunk lands (the
+block-table indirection means the engine's tables stay valid — only the pool
+tensor behind them moves). Nothing in the engine assumes the four entry
+points share a device, a pool tensor, or even a process; the only cross-call
+state the engine relies on is that KV written by one call is readable by the
+next call *for the same sequence*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .inference_model import PagedInferenceModel
+from .paged_cache import copy_blocks, init_paged_pool
+
+__all__ = ["ModelBackend", "SingleDeviceBackend", "MixedRow", "samp_arrays"]
+
+
+def samp_arrays(sampling: Sequence, n: Optional[int] = None):
+    """Per-row sampling-parameter arrays for the device kernels.
+
+    ``sampling`` holds SamplingParams-shaped objects (duck-typed) or None for
+    padding rows; ``n`` pads/truncates to a fixed row count."""
+    rows = list(sampling)
+    if n is not None:
+        rows = (rows + [None] * n)[:n]
+    get = lambda f, d: np.asarray([getattr(s, f) if s is not None else d for s in rows])
+    return dict(
+        seeds=jnp.asarray(get("seed", 0), jnp.int32),
+        temperature=jnp.asarray(get("temperature", 1.0), jnp.float32),
+        top_k=jnp.asarray(get("top_k", 0), jnp.int32),
+        top_p=jnp.asarray(get("top_p", 1.0), jnp.float32),
+        do_sample=jnp.asarray(get("do_sample", False), bool),
+        repetition_penalty=jnp.asarray(get("repetition_penalty", 1.0), jnp.float32),
+        presence_penalty=jnp.asarray(get("presence_penalty", 0.0), jnp.float32),
+        frequency_penalty=jnp.asarray(get("frequency_penalty", 0.0), jnp.float32),
+    )
+
+
+@dataclasses.dataclass
+class MixedRow:
+    """One row of a ragged mixed step, as the scheduler sees it.
+
+    A prefill-chunk row feeds ``tokens`` (the next chunk of the prompt)
+    starting at absolute position ``start``; a decode row feeds exactly one
+    token (the slot's last sampled id). ``emit=True`` means the sampler's
+    token at position ``start + len(tokens)`` is kept (final chunks and
+    decode rows); non-final chunks discard it."""
+
+    slot: int
+    tokens: np.ndarray
+    start: int
+    table: np.ndarray
+    emit: bool
+    sampling: object
+    is_chunk: bool
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class ModelBackend:
+    """Interface base (see module docstring). Subclasses own params, the KV
+    pool, the penalty-count tensor and the compiled step functions."""
+
+    #: the PagedInferenceModel (or subclass) holding the jitted programs —
+    #: exposed because tests and tools flip ``infer.use_paged_kernel``
+    infer: PagedInferenceModel
+
+    def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
+                sampling, slot_idx) -> np.ndarray:
+        raise NotImplementedError
+
+    def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
+               sampling) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def mixed_step(self, chunk_rows: List[MixedRow], decode_rows: List[MixedRow]) -> np.ndarray:
+        raise NotImplementedError
+
+    def verify(self, tokens, block_tables, start_pos, need_logits: bool):
+        raise NotImplementedError
+
+    def seed_counts(self, slot_idx, cached_entries):
+        raise NotImplementedError
+
+    def reset_counts(self):
+        raise NotImplementedError
+
+    def apply_cow(self, pairs):
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        raise NotImplementedError
+
+
+class SingleDeviceBackend(ModelBackend):
+    """The historical engine layout: params/pool/counts on the default device,
+    no sharding annotations on the jitted steps."""
+
+    def __init__(self, model, *, max_batch_size: int, block_size: int, num_blocks: int,
+                 max_blocks_per_seq: int, dtype, decode_steps: int, eos_ids,
+                 kv_cache_quant: Optional[str] = None,
+                 token_flatten: Optional[bool] = None):
+        self.model = model
+        self.max_batch_size = max_batch_size
+        self.infer = self._build_infer(model, block_size, num_blocks, max_blocks_per_seq,
+                                       dtype, decode_steps, eos_ids)
+        self.pool = self._init_pool(model.config, num_blocks, block_size, dtype, kv_cache_quant)
+        self.counts = self._init_counts()
+        # None = auto: flatten on the XLA fallback (where decode rows padded to
+        # the chunk bucket dominate the mixed-step cost), keep the single
+        # padded launch when the Pallas ragged kernel is active
+        self.token_flatten = token_flatten
+
+    # ---------------------------------------------------------------- setup
+    def _build_infer(self, model, block_size, num_blocks, max_blocks_per_seq,
+                     dtype, decode_steps, eos_ids) -> PagedInferenceModel:
+        return PagedInferenceModel(
+            model, block_size, num_blocks, max_blocks_per_seq, dtype=dtype,
+            decode_steps=decode_steps, eos_ids=eos_ids,
+        )
+
+    def _init_pool(self, config, num_blocks, block_size, dtype, quant):
+        return init_paged_pool(config, num_blocks, block_size,
+                               dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32,
+                               quant=quant)
+
+    def _init_counts(self):
+        return jnp.zeros((self.max_batch_size, self.model.config.vocab_size), jnp.int32)
+
+    @property
+    def params(self):
+        return self.model.params
+
+    # ---------------------------------------------------------------- counts
+    def _cached_counts(self, cached_entries, n_rows: int) -> jnp.ndarray:
+        """Penalty counts for prefix-cache-hit prompt spans: the fed suffix is
+        counted on device, the cached span here via host bincount. Clipped: an
+        out-of-vocab id from a direct caller must degrade to a garbage count
+        (the old one_hot behavior), not crash the step. All-miss (or
+        cache-off) batches materialize the zeros on device instead of shipping
+        an n*vocab host buffer. ``cached_entries`` = [(row, prompt_ids,
+        n_cached)]; returns [n_rows, vocab] int32."""
+        vocab = self.model.config.vocab_size
+        counts_in = None
+        for row, prompt_ids, n_cached in cached_entries:
+            if n_cached > 0:
+                if counts_in is None:
+                    counts_in = np.zeros((n_rows, vocab), np.int32)
+                counts_in[row] = np.bincount(
+                    np.clip(prompt_ids[:n_cached], 0, vocab - 1),
+                    minlength=vocab)[:vocab]
+        if counts_in is None:
+            return jnp.zeros((n_rows, vocab), jnp.int32)
+        return jnp.asarray(counts_in)
+
+    def seed_counts(self, slot_idx, cached_entries):
+        rows = self._cached_counts(cached_entries, len(slot_idx))
+        self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(rows)
+
+    def reset_counts(self):
+        self.counts = jnp.zeros_like(self.counts)
+
+    # ---------------------------------------------------------------- steps
+    def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
+                sampling, slot_idx) -> np.ndarray:
+        n = input_ids.shape[0]
+        cached_lens = np.zeros(n, np.int32)
+        for row, _ids, n_cached in cached_entries:
+            cached_lens[row] = n_cached
+        counts_dev = self._cached_counts(cached_entries, n)
+        tokens, counts_rows, self.pool = self.infer.prefill(
+            self.params, self.pool, jnp.asarray(input_ids), jnp.asarray(block_tables),
+            jnp.asarray(suffix_lens), jnp.asarray(cached_lens), counts_dev,
+            samp_arrays(sampling, n),
+        )
+        self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(
+            counts_rows[: len(slot_idx)])
+        return np.asarray(tokens)
+
+    def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
+               sampling) -> Tuple[np.ndarray, np.ndarray]:
+        toks, valid, _, _, self.counts, self.pool = self.infer.decode(
+            self.params, self.pool, jnp.asarray(last_tokens), jnp.asarray(block_tables),
+            jnp.asarray(context_lens), jnp.asarray(done0), jnp.asarray(remaining),
+            self.counts, samp_arrays(sampling, len(sampling)),
+        )
+        return np.asarray(toks), np.asarray(valid)
+
+    def verify(self, tokens, block_tables, start_pos, need_logits: bool):
+        argmax, logits, self.pool = self.infer.verify(
+            self.params, self.pool, jnp.asarray(tokens), jnp.asarray(block_tables),
+            jnp.asarray(start_pos), need_logits=need_logits,
+        )
+        return np.asarray(argmax), (np.asarray(logits) if need_logits else None)
+
+    def apply_cow(self, pairs):
+        self.pool = copy_blocks(self.pool, pairs)
+
+    # ---------------------------------------------------------------- mixed
+    def mixed_step(self, chunk_rows: List[MixedRow], decode_rows: List[MixedRow]) -> np.ndarray:
+        """One ragged mixed step. Returns sampled tokens in row order
+        ``[*chunk_rows, *decode_rows]`` (the scheduler keeps them only where
+        ``emit``)."""
+        flat = self.token_flatten
+        if flat is None:
+            flat = not self.infer.use_paged_kernel
+        if flat:
+            return self._mixed_flat(chunk_rows, decode_rows)
+        return self._mixed_padded(chunk_rows, decode_rows)
+
+    def _mixed_padded(self, chunk_rows, decode_rows) -> np.ndarray:
+        """Legacy layout: one [B, T] launch, every row padded to the chunk
+        bucket — what the Pallas ragged kernel wants (a single grid covers
+        chunks, decodes and dead rows)."""
+        B = self.max_batch_size
+        T = _bucket(max([len(r.tokens) for r in chunk_rows], default=1), minimum=1)
+        ids = np.zeros((B, T), np.int32)
+        tables = np.zeros((B, chunk_rows[0].table.shape[0] if chunk_rows
+                           else decode_rows[0].table.shape[0]), np.int32)
+        q_lens = np.zeros(B, np.int32)
+        q_start = np.zeros(B, np.int32)
+        count_fed = np.zeros(B, bool)
+        emit = np.zeros(B, bool)
+        sampling: List = [None] * B
+        for r in chunk_rows + decode_rows:
+            n = len(r.tokens)
+            ids[r.slot, :n] = r.tokens
+            tables[r.slot] = r.table
+            q_lens[r.slot] = n
+            q_start[r.slot] = r.start
+            count_fed[r.slot] = r.is_chunk  # chunk tokens accumulate into counts
+            emit[r.slot] = r.emit
+            sampling[r.slot] = r.sampling
+        tokens, self.counts, self.pool = self.infer.mixed_step(
+            self.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
+            jnp.asarray(q_lens), jnp.asarray(q_start), self.counts,
+            jnp.asarray(count_fed), jnp.asarray(emit), samp_arrays(sampling, B),
+        )
+        tokens = np.asarray(tokens)
+        return np.asarray([tokens[r.slot] for r in chunk_rows + decode_rows])
+
+    def _mixed_flat(self, chunk_rows, decode_rows) -> np.ndarray:
+        """Token-flattened layout: chunk rows keep their [C, T] matrix, decode
+        rows collapse to a [D, 1] segment — per-step cost scales with the
+        tokens actually fed (bucketed per segment), not B x chunk. Both
+        segments run in ONE jit; token-identical to the padded layout (each
+        live row's math is a row-slice of the padded program's)."""
+        C = _bucket(len(chunk_rows), minimum=1)
+        T = _bucket(max([len(r.tokens) for r in chunk_rows], default=1), minimum=1)
+        D = _bucket(len(decode_rows), minimum=1)
+        M = (chunk_rows[0].table.shape[0] if chunk_rows else decode_rows[0].table.shape[0])
+        c_ids = np.zeros((C, T), np.int32)
+        c_tables = np.zeros((C, M), np.int32)
+        c_qlens = np.zeros(C, np.int32)
+        c_start = np.zeros(C, np.int32)
+        c_slots = np.zeros(C, np.int32)
+        c_emit = np.zeros(C, bool)
+        d_tokens = np.zeros(D, np.int32)
+        d_tables = np.zeros((D, M), np.int32)
+        d_start = np.zeros(D, np.int32)
+        d_slots = np.zeros(D, np.int32)
+        d_live = np.zeros(D, bool)
+        for j, r in enumerate(chunk_rows):
+            n = len(r.tokens)
+            c_ids[j, :n] = r.tokens
+            c_tables[j] = r.table
+            c_qlens[j] = n
+            c_start[j] = r.start
+            c_slots[j] = r.slot
+            c_emit[j] = r.emit
+        for j, r in enumerate(decode_rows):
+            d_tokens[j] = r.tokens[0]
+            d_tables[j] = r.table
+            d_start[j] = r.start
+            d_slots[j] = r.slot
+            d_live[j] = True
+        sampling = ([r.sampling for r in chunk_rows] + [None] * (C - len(chunk_rows))
+                    + [r.sampling for r in decode_rows] + [None] * (D - len(decode_rows)))
+        tokens, self.counts, self.pool = self.infer.mixed_step_flat(
+            self.params, self.pool,
+            jnp.asarray(c_ids), jnp.asarray(c_tables), jnp.asarray(c_qlens),
+            jnp.asarray(c_start), jnp.asarray(c_slots), jnp.asarray(c_emit),
+            jnp.asarray(d_tokens), jnp.asarray(d_tables), jnp.asarray(d_start),
+            jnp.asarray(d_slots), jnp.asarray(d_live),
+            self.counts, samp_arrays(sampling, C + D),
+        )
+        tokens = np.asarray(tokens)
+        return np.concatenate([tokens[: len(chunk_rows)],
+                               tokens[C : C + len(decode_rows)]])
+
+    # ---------------------------------------------------------------- misc
+    def describe(self) -> dict:
+        return {"kind": "single_device", "devices": 1, "tp_degree": 1, "mesh": None}

@@ -45,8 +45,8 @@ import numpy as np
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
-from .inference_model import PagedInferenceModel
-from .paged_cache import BlockManager, copy_blocks, init_paged_pool
+from .backend import MixedRow, ModelBackend, SingleDeviceBackend, _bucket
+from .paged_cache import BlockManager
 
 __all__ = ["InferenceEngine", "Request", "SamplingParams"]
 
@@ -124,13 +124,6 @@ class Request:
         return self.sampling.max_new_tokens - self.gen_offset - len(self.output_ids)
 
 
-def _bucket(n: int, minimum: int = 16) -> int:
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
-
-
 class InferenceEngine:
     def __init__(
         self,
@@ -157,18 +150,33 @@ class InferenceEngine:
         # interleaved with decode tokens (one ragged mixed step per chunk) so
         # no engine step does unbounded prefill. None/0 = monolithic prefill.
         prefill_chunk_tokens: Optional[int] = None,
+        # shard the forward + KV pool over a device mesh: int tp degree,
+        # (dp, tp) tuple, or a parallel.mesh.MeshConfig. None = single device.
+        mesh_shape=None,
+        # mixed-step layout: True = token-flattened segments, False = one
+        # padded [B, chunk] launch, None = auto (flatten on the XLA fallback)
+        token_flatten: Optional[bool] = None,
+        # a prebuilt ModelBackend instance overrides mesh_shape (tests /
+        # future MPMD stage-split backends plug in here)
+        backend: Optional[ModelBackend] = None,
     ):
         self.model = model
         self.tokenizer = tokenizer
         eos = eos_token_id if eos_token_id is not None else getattr(model.config, "eos_token_id", None)
         self.eos_ids = set(eos) if isinstance(eos, (list, tuple)) else ({eos} if eos is not None else set())
-        self.infer = PagedInferenceModel(
-            model, block_size, num_blocks, max_blocks_per_seq, dtype=dtype,
-            decode_steps=decode_steps, eos_ids=self.eos_ids,
+        backend_kw = dict(
+            max_batch_size=max_batch_size, block_size=block_size, num_blocks=num_blocks,
+            max_blocks_per_seq=max_blocks_per_seq, dtype=dtype, decode_steps=decode_steps,
+            eos_ids=self.eos_ids, kv_cache_quant=kv_cache_quant, token_flatten=token_flatten,
         )
-        self.pool = init_paged_pool(model.config, num_blocks, block_size,
-                                    dtype=jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32,
-                                    quant=kv_cache_quant)
+        if backend is not None:
+            self.backend = backend
+        elif mesh_shape is not None:
+            from .sharded_backend import ShardedBackend
+
+            self.backend = ShardedBackend(model, mesh_shape=mesh_shape, **backend_kw)
+        else:
+            self.backend = SingleDeviceBackend(model, **backend_kw)
         self.enable_prefix_cache = enable_prefix_cache
         self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq,
                                 enable_prefix_cache=enable_prefix_cache)
@@ -178,8 +186,6 @@ class InferenceEngine:
         self.slots: List[Optional[Request]] = [None] * max_batch_size
         self._next_id = itertools.count()
         self._last_token = np.zeros(max_batch_size, np.int32)
-        # device-resident per-slot token counts feeding the penalty kernels
-        self.counts = jnp.zeros((max_batch_size, model.config.vocab_size), jnp.int32)
         # speculative decoding: n-gram prompt-lookup OR draft-model proposer,
         # batched verify; greedy acceptance or rejection sampling
         self.use_speculative = use_speculative or draft_model is not None
@@ -209,6 +215,20 @@ class InferenceEngine:
         # depth, running slots, free KV blocks) — the metrics plane subscribes
         # here instead of monkey-patching the loop
         self.step_cb: Optional[Callable[[Dict], None]] = None
+
+    # device state lives in the backend; these stay as read paths for tests,
+    # tools and the metrics plane that predate the backend split
+    @property
+    def infer(self):
+        return self.backend.infer
+
+    @property
+    def pool(self):
+        return self.backend.pool
+
+    @property
+    def counts(self):
+        return self.backend.counts
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -297,7 +317,7 @@ class InferenceEngine:
                                 self.mgr.max_blocks_per_seq,
                                 enable_prefix_cache=self.enable_prefix_cache)
         self._last_token[:] = 0
-        self.counts = jnp.zeros_like(self.counts)
+        self.backend.reset_counts()
         self._spec_rngs.clear()
         logger.warning("inference engine reset: scheduler + KV allocator state dropped")
 
@@ -324,6 +344,7 @@ class InferenceEngine:
                 "chunks": self.chunk_stats["chunks"],
                 "chunk_tokens_total": self.chunk_stats["chunk_tokens"],
             },
+            "backend": self.backend.describe(),
         }
 
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
@@ -361,23 +382,6 @@ class InferenceEngine:
         if self.step_cb is not None:
             self.step_cb(self.stats())
         return finished
-
-    def _samp_arrays(self, reqs: List[Optional[Request]]):
-        """Per-slot sampling parameter arrays for the device kernels."""
-        n = len(reqs)
-        get = lambda f, d: np.asarray(
-            [getattr(r.sampling, f) if r is not None else d for r in reqs]
-        )
-        return dict(
-            seeds=jnp.asarray(get("seed", 0), jnp.int32),
-            temperature=jnp.asarray(get("temperature", 1.0), jnp.float32),
-            top_k=jnp.asarray(get("top_k", 0), jnp.int32),
-            top_p=jnp.asarray(get("top_p", 1.0), jnp.float32),
-            do_sample=jnp.asarray(get("do_sample", False), bool),
-            repetition_penalty=jnp.asarray(get("repetition_penalty", 1.0), jnp.float32),
-            presence_penalty=jnp.asarray(get("presence_penalty", 0.0), jnp.float32),
-            frequency_penalty=jnp.asarray(get("frequency_penalty", 0.0), jnp.float32),
-        )
 
     def _free_slot_indices(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
@@ -456,34 +460,13 @@ class InferenceEngine:
             pc_t0 = time.perf_counter()
             cow = self.mgr.drain_cow_pairs()
             if cow:
-                self.pool = copy_blocks(self.pool, cow)
+                self.backend.apply_cow(cow)
             TRACER.add_span("prefix_cache", TRACER.epoch_time(pc_t0),
                             time.perf_counter() - pc_t0, cat="engine",
                             hits=self.mgr.cache_hits - hits0,
                             cached_tokens=self.mgr.cached_tokens_total - cached0,
                             cow_copies=len(cow))
         return admitted
-
-    def _seed_cached_counts(self, entries: List[tuple], n_rows: int) -> jnp.ndarray:
-        """Penalty counts for prefix-cache-hit prompt spans: the fed suffix is
-        counted on device, the cached span here via host bincount. Clipped: an
-        out-of-vocab id from a direct caller must degrade to a garbage count
-        (the old one_hot behavior), not crash the step / allocate a
-        token-id-sized array. All-miss (or cache-off) batches materialize the
-        zeros on device instead of shipping an n*vocab host buffer.
-        ``entries`` = [(row, req, n_cached)]; returns [n_rows, vocab] int32."""
-        vocab = self.model.config.vocab_size
-        counts_in = None
-        for row, req, n_cached in entries:
-            if n_cached > 0:
-                if counts_in is None:
-                    counts_in = np.zeros((n_rows, vocab), np.int32)
-                counts_in[row] = np.bincount(
-                    np.clip(req.prompt_ids[:n_cached], 0, vocab - 1),
-                    minlength=vocab)[:vocab]
-        if counts_in is None:
-            return jnp.zeros((n_rows, vocab), jnp.int32)
-        return jnp.asarray(counts_in)
 
     def _admit(self, finished: List[Request]):
         admitted = self._admit_slots(finished)
@@ -501,28 +484,22 @@ class InferenceEngine:
             tables = np.zeros((n, self.mgr.max_blocks_per_seq), np.int32)
             suffix_lens = np.zeros(n, np.int32)
             cached_lens = np.zeros(n, np.int32)
-            reqs: List[Optional[Request]] = [None] * n
+            sampling: List = [None] * n
             for j, (slot, req, n_cached) in enumerate(group):
                 suffix = req.prompt_ids[n_cached:]
                 ids[j, : len(suffix)] = suffix
                 tables[j] = self.mgr.table_array(req.req_id)
                 suffix_lens[j] = len(suffix)
                 cached_lens[j] = n_cached
-                reqs[j] = req
-            counts_dev = self._seed_cached_counts(
-                [(j, req, c) for j, (_, req, c) in enumerate(group)], n)
+                sampling[j] = req.sampling
+            entries = [(j, req.prompt_ids, c) for j, (_, req, c) in enumerate(group)]
             with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
                              step=self._cur_step,
                              req_ids=[r.req_id for _, r, _ in group],
                              cached_tokens=int(cached_lens.sum())):
-                tokens, counts_rows, self.pool = self.infer.prefill(
-                    self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
-                    jnp.asarray(suffix_lens), jnp.asarray(cached_lens),
-                    counts_dev, self._samp_arrays(reqs),
-                )
-                tokens = np.asarray(tokens)
-            slot_idx = [slot for slot, _, _ in group]
-            self.counts = self.counts.at[jnp.asarray(slot_idx)].set(counts_rows[: len(group)])
+                tokens = self.backend.prefill(
+                    ids, tables, suffix_lens, entries, sampling,
+                    [slot for slot, _, _ in group])
             for j, (slot, req, _) in enumerate(group):
                 req.prefilled_len = len(req.prompt_ids)
                 self._settle_sampled(slot, req, int(tokens[j]), finished)
@@ -557,9 +534,8 @@ class InferenceEngine:
         # seed the device-side penalty counts: the cached span never rides
         # through a chunk forward, so its counts come from a host bincount
         # (zeros rows still land — the slot's previous occupant is stale)
-        rows = self._seed_cached_counts(
-            [(i, req, c) for i, (_, req, c) in enumerate(admitted)], len(admitted))
-        self.counts = self.counts.at[jnp.asarray(slot_idx)].set(rows)
+        self.backend.seed_counts(
+            slot_idx, [(i, req.prompt_ids, c) for i, (_, req, c) in enumerate(admitted)])
 
     def _mixed_step(self, finished: List[Request]):
         """One ragged mixed step: up to ``prefill_chunk_tokens`` prompt tokens
@@ -614,51 +590,35 @@ class InferenceEngine:
         if not chunk_rows and not decode_rows:
             return
         t0 = time.perf_counter()
-        B = self.max_batch_size
-        T = _bucket(max([n for _, _, n in chunk_rows], default=1), minimum=1)
-        ids = np.zeros((B, T), np.int32)
-        tables = np.zeros((B, self.mgr.max_blocks_per_seq), np.int32)
-        q_lens = np.zeros(B, np.int32)
-        q_start = np.zeros(B, np.int32)
-        count_fed = np.zeros(B, bool)
-        emit = np.zeros(B, bool)
-        reqs: List[Optional[Request]] = [None] * B
+        chunk_payload = []
         for slot, req, n in chunk_rows:
             p0 = req.prefilled_len
-            ids[slot, :n] = req.prompt_ids[p0 : p0 + n]
-            tables[slot] = self.mgr.table_array(req.req_id)
-            q_lens[slot] = n
-            q_start[slot] = p0
-            count_fed[slot] = True  # chunk tokens accumulate into the counts
-            emit[slot] = p0 + n == len(req.prompt_ids)  # sampler on last chunk
-            reqs[slot] = req
-        for slot, req in decode_rows:
-            ids[slot, 0] = self._last_token[slot]
-            tables[slot] = self.mgr.table_array(req.req_id)
-            q_lens[slot] = 1
-            q_start[slot] = req.total_len - 1  # position of the token being fed
-            emit[slot] = True
-            reqs[slot] = req
+            chunk_payload.append(MixedRow(
+                slot=slot, tokens=req.prompt_ids[p0 : p0 + n], start=p0,
+                table=self.mgr.table_array(req.req_id),
+                emit=p0 + n == len(req.prompt_ids),  # sampler on last chunk
+                sampling=req.sampling, is_chunk=True))
+        dec_payload = [
+            MixedRow(slot=slot, tokens=np.asarray([self._last_token[slot]], np.int32),
+                     start=req.total_len - 1,  # position of the token being fed
+                     table=self.mgr.table_array(req.req_id), emit=True,
+                     sampling=req.sampling, is_chunk=False)
+            for slot, req in decode_rows]
         with TRACER.span("mixed_step", cat="engine", step=self._cur_step,
-                         chunk=T, chunks=len(chunk_rows), decodes=len(decode_rows),
+                         chunks=len(chunk_rows), decodes=len(decode_rows),
                          chunk_tokens=int(sum(n for _, _, n in chunk_rows)),
                          req_ids=[r.req_id for _, r, _ in chunk_rows]):
-            tokens, self.counts, self.pool = self.infer.mixed_step(
-                self.model.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
-                jnp.asarray(q_lens), jnp.asarray(q_start), self.counts,
-                jnp.asarray(count_fed), jnp.asarray(emit), self._samp_arrays(reqs),
-            )
-            tokens = np.asarray(tokens)
+            tokens = self.backend.mixed_step(chunk_payload, dec_payload)
         dur = time.perf_counter() - t0
-        for slot, req, n in chunk_rows:
+        for j, (slot, req, n) in enumerate(chunk_rows):
             req.prefilled_len += n
             self.chunk_stats["chunks"] += 1
             self.chunk_stats["chunk_tokens"] += n
             self.recent_chunk_sizes.append((next(self._chunk_seq), n))
             if not req.needs_prefill:
-                self._settle_sampled(slot, req, int(tokens[slot]), finished)
-        for slot, req in decode_rows:
-            self._settle_sampled(slot, req, int(tokens[slot]), finished)
+                self._settle_sampled(slot, req, int(tokens[j]), finished)
+        for j, (slot, req) in enumerate(decode_rows):
+            self._settle_sampled(slot, req, int(tokens[len(chunk_rows) + j]), finished)
         if chunk_rows and decode_rows:
             # every decode token in this step waited out the chunk work: the
             # step duration IS the decode stall attributable to prefill
@@ -828,12 +788,8 @@ class InferenceEngine:
                          drafted=int(sum(len(d) for d in drafts))):
             # greedy acceptance never reads the logits: need_logits=False keeps
             # the [B, K+1, V] fp32 buffer from materializing at all
-            argmax_dev, logits_dev, self.pool = self.infer.verify(
-                self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
-                jnp.asarray(start), need_logits=mode == "sample",
-            )
-            logits = np.asarray(logits_dev) if mode == "sample" else None
-            argmax = np.asarray(argmax_dev)
+            argmax, logits = self.backend.verify(
+                tokens, tables, start, need_logits=mode == "sample")
         self.spec_stats["verify_steps"] += 1
         for i, req in enumerate(self.slots):
             if req is None:
@@ -943,14 +899,10 @@ class InferenceEngine:
             remaining[i] = req.remaining_new
         with TRACER.span("decode", cat="engine", steps=steps, step=self._cur_step,
                          active=int(sum(1 for r in self.slots if r is not None))):
-            toks, valid, _, _, self.counts, self.pool = self.infer.decode(
-                self.model.params, self.pool, jnp.asarray(tokens), jnp.asarray(tables),
-                jnp.asarray(ctx), jnp.asarray(done0), jnp.asarray(remaining),
-                self.counts, self._samp_arrays(self.slots),
-            )
             # ONE host transfer of ids + validity flags (no logits)
-            toks = np.asarray(toks)  # [steps, B]
-            valid = np.asarray(valid)
+            toks, valid = self.backend.decode(
+                tokens, tables, ctx, done0, remaining,
+                [None if r is None else r.sampling for r in self.slots])
         for s in range(toks.shape[0]):
             for i, req in enumerate(self.slots):
                 if req is None or req.done or not valid[s, i]:

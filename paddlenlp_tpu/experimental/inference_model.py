@@ -124,11 +124,26 @@ class PagedInferenceModel:
         # (stacked [L, ...] — lax.scan slices per layer); _mm dispatches per
         # projection (reference int8_gemm_with_cutlass serving path)
         self.quant_cfg = getattr(model, "quantization_config", None)
+        self._build_jits()
+
+    def _build_jits(self):
+        """Compile the step entry points. The sharded subclass overrides this
+        to attach explicit ``in_shardings``/``out_shardings``; the base keeps
+        the historical un-annotated jits."""
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._verify = jax.jit(self._verify_impl, donate_argnums=(1,),
                                static_argnames=("need_logits",))
         self._mixed = jax.jit(self._mixed_impl, donate_argnums=(1,))
+        self._mixed_flat = jax.jit(self._mixed_flat_impl, donate_argnums=(1,))
+
+    def _hint(self, x, kind: str):
+        """Activation-layout hook: identity here; the sharded subclass turns
+        ``kind`` ("heads" / "kv_heads" / "mlp" / "full") into
+        ``with_sharding_constraint`` anchors so GSPMD keeps per-head compute
+        local and gathers before every cross-shard contraction (the all-gather
+        layout keeps the sharded forward bitwise-identical to this one)."""
+        return x
 
     def _mm(self, p, x):
         """x @ kernel with quantized-leaf dispatch: a8w8 -> int8 x int8 MXU dot;
@@ -183,9 +198,9 @@ class PagedInferenceModel:
         def proj(p, x, heads):
             return self._mm(p, x).reshape(B, T, heads, self.head_dim)
 
-        q = proj(attn["q_proj"], x, self.n_heads)
-        k = proj(attn["k_proj"], x, self.n_kv)
-        v = proj(attn["v_proj"], x, self.n_kv)
+        q = self._hint(proj(attn["q_proj"], x, self.n_heads), "heads")
+        k = self._hint(proj(attn["k_proj"], x, self.n_kv), "kv_heads")
+        v = self._hint(proj(attn["v_proj"], x, self.n_kv), "kv_heads")
         cos, sin = rope_tables(q_positions, self.inv_freq)
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
@@ -215,13 +230,18 @@ class PagedInferenceModel:
             k_all, v_all = gather_kv(pool_layer, block_tables, scale_layer)
             attn_out = self._attend(q, k_all, v_all, q_positions, kv_len_mask)
         attn_out = attn_out.reshape(B, T, self.n_heads * self.head_dim)
-        h = h + self._mm(attn["o_proj"], attn_out)
+        # gather before the contraction (o_proj stays column-parallel: full
+        # dot per output column, no cross-shard partial sums), gather after
+        # so the residual/norms see a replicated stream
+        attn_out = self._hint(attn_out, "full")
+        h = h + self._hint(self._mm(attn["o_proj"], attn_out), "full")
 
         x = _rms(h, lp["post_attention_layernorm"]["scale"], self.eps)
         mlp = lp["mlp"]
-        gate = self._mm(mlp["gate_proj"], x)
-        up = self._mm(mlp["up_proj"], x)
-        h = h + self._mm(mlp["down_proj"], jax.nn.silu(gate) * up)
+        gate = self._hint(self._mm(mlp["gate_proj"], x), "mlp")
+        up = self._hint(self._mm(mlp["up_proj"], x), "mlp")
+        act = self._hint(jax.nn.silu(gate) * up, "full")
+        h = h + self._hint(self._mm(mlp["down_proj"], act), "full")
         if scale_layer is not None:
             return h, (pool_layer, scale_layer)
         return h, pool_layer
@@ -239,7 +259,7 @@ class PagedInferenceModel:
             q_lens = jnp.full((input_ids.shape[0],), input_ids.shape[1], jnp.int32)
         m = params["model"]
         embed = m["embed_tokens"]["embedding"]
-        h = embed[input_ids].astype(self.dtype)
+        h = self._hint(embed[input_ids].astype(self.dtype), "full")
         if getattr(self.config, "scale_embeddings", False):
             h = h * jnp.asarray(self.config.hidden_size**0.5, h.dtype)
 
@@ -261,8 +281,10 @@ class PagedInferenceModel:
             logits = last @ embed.T.astype(self.dtype)
         # logits stay in compute dtype: every consumer either casts to fp32
         # itself (sample_tokens) or explicitly opts out of the cast (greedy
-        # verify reads only the argmax, sparing the [B, T, V] fp32 buffer)
-        return logits, new_pool
+        # verify reads only the argmax, sparing the [B, T, V] fp32 buffer).
+        # Sharded layouts leave them vocab-sharded here; the gather to the
+        # replicated sampler happens once at this anchor.
+        return self._hint(logits, "full"), new_pool
 
     # ------------------------------------------------------------------ entry points
     def _prefill_impl(self, params, pool, input_ids, block_tables, suffix_lens,
@@ -347,6 +369,57 @@ class PagedInferenceModel:
         counts = counts + jax.nn.one_hot(tokens, V, dtype=jnp.int32) \
             * emit.astype(jnp.int32)[:, None]
         return tokens, counts, new_pool
+
+    def _mixed_flat_impl(self, params, pool, chunk_ids, chunk_tables, chunk_qlens,
+                         chunk_start, chunk_slots, chunk_emit, dec_tokens, dec_tables,
+                         dec_start, dec_slots, dec_live, counts, samp):
+        """Token-flattened ragged mixed step (the XLA-fallback layout).
+
+        :meth:`_mixed_impl` pads EVERY row — decode rows included — to the
+        chunk bucket, so a mixed step costs B x chunk query positions on the
+        XLA path however few tokens are actually fed. Here the step is two
+        packed segments inside one jit: prefill chunks keep their [C, T]
+        matrix (C = rows actually mid-prefill, bucketed) and decode rows
+        collapse to [D, 1]; cost scales with the tokens fed. Rows map to
+        engine slots through ``chunk_slots``/``dec_slots`` — the penalty-count
+        tensor stays slot-indexed, updated by scatter instead of dense adds.
+
+        Token-identical to the padded layout: each live row's computation is
+        a row-slice of the padded program (same contraction lengths, same
+        sampling keys ``(seed, position)``), and the count updates are the
+        same integers. Dead padding rows (``chunk_qlens = 0`` /
+        ``~dec_live``) write only into the sentinel block and add zeros.
+
+        Returns (tokens [C + D], counts', new pool) — tokens in segment
+        order, the caller slices live rows back out.
+        """
+        C, T = chunk_ids.shape
+        S = chunk_tables.shape[1] * self.block_size
+        positions_c = chunk_start[:, None] + jnp.arange(T)[None, :]
+        kv_mask_c = jnp.arange(S)[None, :] < (chunk_start + chunk_qlens)[:, None]
+        logits_c, pool = self._forward(
+            params, pool, chunk_ids, chunk_tables, positions_c, kv_mask_c,
+            chunk_start, jnp.maximum(chunk_qlens - 1, 0), q_lens=chunk_qlens,
+        )
+        D = dec_tokens.shape[0]
+        positions_d = dec_start[:, None]
+        kv_mask_d = jnp.arange(S)[None, :] <= dec_start[:, None]
+        logits_d, pool = self._forward(
+            params, pool, dec_tokens[:, None], dec_tables, positions_d, kv_mask_d,
+            dec_start, jnp.zeros((D,), jnp.int32), q_lens=dec_live.astype(jnp.int32),
+        )
+        V = counts.shape[-1]
+        valid = (jnp.arange(T)[None, :] < chunk_qlens[:, None]).astype(jnp.int32)
+        fed = (jax.nn.one_hot(chunk_ids, V, dtype=jnp.int32) * valid[..., None]).sum(axis=1)
+        counts = counts.at[chunk_slots].add(fed)
+        rows = jnp.concatenate([chunk_slots, dec_slots])
+        logits_all = jnp.concatenate([logits_c, logits_d], axis=0)
+        pos_all = jnp.concatenate([chunk_start + chunk_qlens, dec_start + 1])
+        tokens = sample_tokens(logits_all, positions=pos_all, counts=counts[rows], **samp)
+        emit_all = jnp.concatenate([chunk_emit, dec_live]).astype(jnp.int32)
+        counts = counts.at[rows].add(
+            jax.nn.one_hot(tokens, V, dtype=jnp.int32) * emit_all[:, None])
+        return tokens, counts, pool
 
     def _decode_impl(self, params, pool, tokens, block_tables, context_lens, done0,
                      remaining, counts, samp):
@@ -441,3 +514,10 @@ class PagedInferenceModel:
                    q_start, counts, count_fed, emit, samp):
         return self._mixed(params, pool, input_ids, block_tables, q_lens, q_start,
                            counts, count_fed, emit, samp)
+
+    def mixed_step_flat(self, params, pool: PagedKVPool, chunk_ids, chunk_tables,
+                        chunk_qlens, chunk_start, chunk_slots, chunk_emit, dec_tokens,
+                        dec_tables, dec_start, dec_slots, dec_live, counts, samp):
+        return self._mixed_flat(params, pool, chunk_ids, chunk_tables, chunk_qlens,
+                                chunk_start, chunk_slots, chunk_emit, dec_tokens,
+                                dec_tables, dec_start, dec_slots, dec_live, counts, samp)

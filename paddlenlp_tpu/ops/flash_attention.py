@@ -22,6 +22,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..parallel.mesh import shard_map as _shard_map
+
 __all__ = ["dot_product_attention", "make_causal_mask", "make_segment_mask"]
 
 
@@ -224,7 +226,7 @@ def _pallas_dispatch(query, key, value, segment_ids, scale, window):
     qkv_spec = PS(batch_ax or None, None, head_ax or None, None)
     fn = functools.partial(pallas_flash, scale=scale, causal=True, window=window)
     if segment_ids is None:
-        return jax.shard_map(
+        return _shard_map(
             lambda q, k, v: fn(q, k, v, None),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
@@ -232,7 +234,7 @@ def _pallas_dispatch(query, key, value, segment_ids, scale, window):
             check_vma=False,
         )(query, key, value)
     seg_spec = PS(batch_ax or None, None)
-    return jax.shard_map(
+    return _shard_map(
         lambda q, k, v, s: fn(q, k, v, s),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),

@@ -263,6 +263,13 @@ class ServingMetrics:
             "paddlenlp_serving_decode_stall_seconds",
             "Per-step decode gap attributable to concurrent prefill-chunk work "
             "(duration of mixed steps that carried both chunks and decodes)")
+        self.mesh_devices = r.gauge(
+            "paddlenlp_serving_mesh_devices",
+            "Devices this replica's engine backend spans (1 = single-chip)")
+        self.mesh_axis_size = r.gauge(
+            "paddlenlp_serving_mesh_axis_size",
+            "Device-mesh axis degree of the sharded serving backend, per named axis",
+            labelnames=("axis",))
         self.rebind(engine)
 
     def rebind(self, engine):
@@ -281,6 +288,19 @@ class ServingMetrics:
         self.spec_accept.set_function(
             lambda: engine.spec_stats["accepted"] / max(engine.spec_stats["drafted"], 1))
         self.kv_cached.set_function(lambda: getattr(mgr, "num_cached_blocks", 0))
+        # mesh placement is static per engine: stamped once per (re)bind, not
+        # pulled per scrape — a rebuilt engine may come up on a new layout, so
+        # axes the new engine doesn't report drop back to degree 1 (a label
+        # series, once exposed, must not keep reporting the dead layout)
+        backend = getattr(engine, "backend", None)
+        desc = backend.describe() if backend is not None else {}
+        self.mesh_devices.set(desc.get("devices", 1))
+        mesh_axes = desc.get("mesh") or {}
+        for axis in getattr(self, "_mesh_axes_stamped", set()) - set(mesh_axes):
+            self.mesh_axis_size.set(1, axis=axis)
+        for axis, size in mesh_axes.items():
+            self.mesh_axis_size.set(size, axis=axis)
+        self._mesh_axes_stamped = set(mesh_axes)
         # prefix-cache counters are deltas off the engine's monotone totals;
         # a rebuilt engine restarts its totals at 0, so rebaseline here
         self._pc_last = {

@@ -63,6 +63,12 @@ CATALOG: Dict[str, str] = {
                    "engine-loop supervisor must absorb (degrade, rebuild, requeue).",
     "engine.rebuild": "Inside the supervisor's engine-rebuild attempt — failing it "
                       "extends the DEGRADED window (503 + Retry-After) deterministically.",
+    "engine.shard_init": "Top of ShardedBackend.__init__, before the device mesh and "
+                         "NamedSharding layouts are built — a failure here makes a "
+                         "sharded-engine construction (including the supervisor's "
+                         "rebuild of one) fail deterministically: the loop must go "
+                         "DEGRADED, retry the rebuild and recover with zero stream "
+                         "loss.",
     "engine.prefill_chunk": "Top of the engine's ragged mixed prefill/decode step, "
                             "before the capacity pass and chunk schedule — a crash here leaves "
                             "requests partially prefilled (no token emitted) and must "

@@ -33,11 +33,22 @@ Usage::
                                                  # chunked-vs-monolithic tail is
                                                  # one flag flip to compare.
                                                  # (64 is the CPU-smoke sweet
-                                                 # spot: a mixed step pads every
-                                                 # row to the chunk bucket on the
-                                                 # XLA fallback, so the per-step
-                                                 # stall scales with B*chunk;
-                                                 # 256-512 suits real TPU runs)
+                                                 # spot; 256-512 suits real TPU
+                                                 # runs. Mixed steps default to
+                                                 # the token-flattened layout
+                                                 # off-TPU — cost scales with
+                                                 # tokens actually fed;
+                                                 # --token-flatten 0 forces the
+                                                 # old padded B*chunk launch
+                                                 # for an A/B)
+    python tools/bench_serve.py --mesh-shape 2,4 # tensor-parallel sharded
+                                                 # engine on a dp=2 x tp=4 mesh
+                                                 # of virtual CPU devices —
+                                                 # weights + KV pool sharded on
+                                                 # tp; JSON adds mesh_shape/
+                                                 # tp_degree (composes with
+                                                 # --prefill-chunk and
+                                                 # --prefix-share)
 """
 
 from __future__ import annotations
@@ -58,9 +69,31 @@ def _fail(reason: str) -> None:
     sys.exit(1)
 
 
+def _parse_mesh_shape():
+    """``--mesh-shape R,C`` (dp x tp) or ``--mesh-shape T`` (tp only)."""
+    if "--mesh-shape" not in sys.argv:
+        return None
+    raw = sys.argv[sys.argv.index("--mesh-shape") + 1]
+    parts = [int(x) for x in raw.split(",")]
+    if len(parts) == 1:
+        parts = [1, parts[0]]
+    if len(parts) != 2 or any(p < 1 for p in parts):
+        _fail(f"--mesh-shape must be T or R,C with positive degrees, got {raw!r}")
+    return tuple(parts)
+
+
 def _force_cpu() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    mesh = _parse_mesh_shape()
+    if mesh is not None:
+        # the host-device count must be pinned BEFORE jax loads; R*C virtual
+        # CPU devices back the sharded engine's mesh. Appended so any
+        # user-supplied XLA flags survive (last flag wins on duplicates)
+        extra = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{extra} --xla_force_host_platform_device_count={mesh[0] * mesh[1]}".strip())
+    else:
+        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
     sys.path[:] = [p for p in sys.path if "axon" not in p]
     if os.environ.get("PYTHONPATH"):
         os.environ["PYTHONPATH"] = os.pathsep.join(
@@ -101,13 +134,19 @@ def run() -> None:
     n_long = _arg("--long-prompts", 2)
     long_tokens = _arg("--long-prompt-tokens", 2048)
     prefill_chunk = _arg("--prefill-chunk", 0)
+    mesh_shape = _parse_mesh_shape()
+    token_flatten = (bool(_arg("--token-flatten", 1))
+                     if "--token-flatten" in sys.argv else None)
     if not 0.0 <= prefix_share <= 1.0:
         _fail(f"--prefix-share must be in [0, 1], got {prefix_share}")
     # 24 tokens = 6 full blocks at block_size=4: a warm hit skips all of them
     shared_prefix = [9, 8, 7, 6, 5, 4, 3, 2] * 3
 
+    # mesh runs use a head count the tp axis can divide (8 heads x head_dim 8
+    # instead of 4 x 16) so the KV pool and attention actually shard
+    n_heads, n_kv = (8, 8) if mesh_shape else (4, 2)
     cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
-                      num_attention_heads=4, num_key_value_heads=2,
+                      num_attention_heads=n_heads, num_key_value_heads=n_kv,
                       max_position_embeddings=4096 if long_mix else 256,
                       eos_token_id=None, pad_token_id=0, use_scan_layers=True)
     model = LlamaForCausalLM.from_config(cfg, seed=0)
@@ -127,6 +166,10 @@ def run() -> None:
                       max_blocks_per_seq=32, decode_steps=4)
     if prefill_chunk:
         eng_kw["prefill_chunk_tokens"] = prefill_chunk
+    if mesh_shape:
+        eng_kw["mesh_shape"] = mesh_shape
+    if token_flatten is not None:
+        eng_kw["token_flatten"] = token_flatten
     # which stream positions carry a long prompt (spread through the run so
     # chatty decodes are always in flight when one lands)
     long_every = max(n_requests // max(n_long, 1), 1)
@@ -171,10 +214,14 @@ def run() -> None:
         # prefix (a system prompt stand-in), so the prefix cache has something
         # to hit; the unique tail keeps every request distinct. The golden-
         # ratio stride spreads the P fraction evenly even for small N
-        if i < 0:
+        if i == -1:
             # dedicated long-prompt warmup: same length as the measured long
             # prompts but a distinct token stream (no prefix-cache overlap)
             prompt = [(5 + 3 * j) % 90 + 1 for j in range(long_tokens)]
+        elif i < -1:
+            # chatty warmup riders: distinct short prompts, never the shared
+            # prefix (they must not pre-warm the measured prefix cache)
+            prompt = [78 - i, 6, 7]
         elif is_long(i):
             prompt = [(7 * i + 3 * j) % 90 + 1 for j in range(long_tokens)]
         elif (i * 0.6180339887) % 1.0 < prefix_share:
@@ -220,8 +267,18 @@ def run() -> None:
     if long_mix:
         # compile the long-prefill path (mixed-step jit / long prefill bucket)
         # outside the measured window: the tail comparison is about steady-state
-        # scheduling, not one-time XLA compiles
+        # scheduling, not one-time XLA compiles. Short chatty streams ride along
+        # so mixed-step shapes with 1..3 concurrent decode rows (every
+        # token-flattened segment bucket the measured window will see) compile
+        # here too, not inside a measured decode gap
+        riders = [threading.Thread(
+            target=one_request, args=(-2 - r, {"ttft": [], "tokens": 0, "gaps_short": []}))
+            for r in range(3)]
+        for t in riders:
+            t.start()
         one_request(-1, warm)
+        for t in riders:
+            t.join()
 
     stats = {"ttft": [], "tokens": 0, "gaps_short": []}
     lock = threading.Lock()
@@ -322,6 +379,8 @@ def run() -> None:
         "kv_free_blocks": scalar_sum("paddlenlp_serving_kv_free_blocks"),
         "preemptions": scalar_sum("paddlenlp_serving_preemptions_total"),
         "tokens_generated": scalar_sum("paddlenlp_serving_tokens_generated_total"),
+        "mesh_shape": f"{mesh_shape[0]}x{mesh_shape[1]}" if mesh_shape else "1x1",
+        "tp_degree": mesh_shape[1] if mesh_shape else 1,
         "prefix_share": prefix_share,
         # hit rate over every request the engines saw (timed + warmup)
         "prefix_cache_hit_rate": round(
@@ -335,6 +394,10 @@ def run() -> None:
             "long_prompts": n_long_issued,
             "long_prompt_tokens": long_tokens,
             "prefill_chunk": prefill_chunk,
+            # which mixed-step layout ran: flat segments (cost ~ fed tokens)
+            # vs the padded B x chunk launch (--token-flatten 0)
+            "token_flatten": token_flatten if token_flatten is not None
+                             else bool(prefill_chunk),
             # client-observed tails: the chatty requests' inter-token gaps are
             # the decode stalls the chunked prefill bounds
             "client_p99_inter_token_ms": round(gp(0.99) * 1e3, 1),

@@ -43,6 +43,14 @@ def _stub_engine():
         cached_tokens_total = 0
         evictions = 0
 
+    class _Backend:
+        @staticmethod
+        def describe():
+            # a sharded-shaped describe() so the per-axis mesh gauge's labeled
+            # exposition path is linted too
+            return {"kind": "sharded", "devices": 8, "tp_degree": 4,
+                    "mesh": {"dp": 2, "tp": 4}}
+
     class _Engine:
         mgr = _Mgr()
         waiting = []
@@ -52,6 +60,7 @@ def _stub_engine():
         chunk_stats = {"chunks": 0, "chunk_tokens": 0}
         recent_chunk_sizes = []  # (seq, n_tokens) chunked-prefill event ring
         recent_decode_stalls = []  # (seq, seconds)
+        backend = _Backend()
 
     return _Engine()
 
