@@ -195,7 +195,7 @@ class SingleDeviceBackend(ModelBackend):
             if n_cached > 0:
                 if counts_in is None:
                     counts_in = np.zeros((n_rows, vocab), np.int32)
-                counts_in[row] = np.bincount(
+                counts_in[row] = np.bincount(  # sync-ok: bincount of HOST prompt ids over the cached span only (documented in the docstring)
                     np.clip(prompt_ids[:n_cached], 0, vocab - 1),
                     minlength=vocab)[:vocab]
         if counts_in is None:
@@ -204,7 +204,7 @@ class SingleDeviceBackend(ModelBackend):
 
     def seed_counts(self, slot_idx, cached_entries):
         rows = self._cached_counts(cached_entries, len(slot_idx))
-        self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(rows)
+        self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(rows)  # sync-ok: slot_idx is a host int list
 
     def reset_counts(self):
         self.counts = jnp.zeros_like(self.counts)
@@ -222,9 +222,9 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(suffix_lens), jnp.asarray(cached_lens), counts_dev,
             samp_arrays(sampling, n),
         )
-        self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(
+        self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(  # sync-ok: slot_idx is a host int list
             counts_rows[: len(slot_idx)])
-        return np.asarray(tokens)
+        return np.asarray(tokens)  # sync-ok: THE prefill sync point — sampled int32 ids only
 
     def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
                sampling) -> Tuple[np.ndarray, np.ndarray]:
@@ -233,14 +233,14 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(context_lens), jnp.asarray(done0), jnp.asarray(remaining),
             self.counts, samp_arrays(sampling, len(sampling)),
         )
-        return np.asarray(toks), np.asarray(valid)
+        return np.asarray(toks), np.asarray(valid)  # sync-ok: THE decode sync point — int32 ids + validity flags only
 
     def verify(self, tokens, block_tables, start_pos, need_logits: bool):
         argmax, logits, self.pool = self.infer.verify(
             self.params, self.pool, jnp.asarray(tokens), jnp.asarray(block_tables),
             jnp.asarray(start_pos), need_logits=need_logits,
         )
-        return np.asarray(argmax), (np.asarray(logits) if need_logits else None)
+        return np.asarray(argmax), (np.asarray(logits) if need_logits else None)  # sync-ok: THE verify sync point (logits only when rejection sampling asks)
 
     def apply_cow(self, pairs):
         self.pool = copy_blocks(self.pool, pairs)
@@ -285,8 +285,8 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(q_lens), jnp.asarray(q_start), self.counts,
             jnp.asarray(count_fed), jnp.asarray(emit), samp_arrays(sampling, B),
         )
-        tokens = np.asarray(tokens)
-        return np.asarray([tokens[r.slot] for r in chunk_rows + decode_rows])
+        tokens = np.asarray(tokens)  # sync-ok: THE mixed-step sync point — sampled int32 ids only
+        return np.asarray([tokens[r.slot] for r in chunk_rows + decode_rows])  # sync-ok: host reshuffle of already-synced ids
 
     def _mixed_flat(self, chunk_rows, decode_rows) -> np.ndarray:
         """Token-flattened layout: chunk rows keep their [C, T] matrix, decode
@@ -333,7 +333,7 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(d_slots), jnp.asarray(d_live),
             self.counts, samp_arrays(sampling, C + D),
         )
-        tokens = np.asarray(tokens)
+        tokens = np.asarray(tokens)  # sync-ok: THE flat mixed-step sync point — sampled int32 ids only
         return np.concatenate([tokens[: len(chunk_rows)],
                                tokens[C : C + len(decode_rows)]])
 

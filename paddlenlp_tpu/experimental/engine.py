@@ -496,13 +496,13 @@ class InferenceEngine:
             with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
                              step=self._cur_step,
                              req_ids=[r.req_id for _, r, _ in group],
-                             cached_tokens=int(cached_lens.sum())):
+                             cached_tokens=int(cached_lens.sum())):  # sync-ok: cached_lens is host numpy
                 tokens = self.backend.prefill(
                     ids, tables, suffix_lens, entries, sampling,
                     [slot for slot, _, _ in group])
             for j, (slot, req, _) in enumerate(group):
                 req.prefilled_len = len(req.prompt_ids)
-                self._settle_sampled(slot, req, int(tokens[j]), finished)
+                self._settle_sampled(slot, req, int(tokens[j]), finished)  # sync-ok: tokens already host (backend.prefill synced)
 
     def _settle_sampled(self, slot: int, req: Request, tok: int, finished: List[Request]):
         """Post-sample bookkeeping shared by every sampling site (monolithic
@@ -599,7 +599,7 @@ class InferenceEngine:
                 emit=p0 + n == len(req.prompt_ids),  # sampler on last chunk
                 sampling=req.sampling, is_chunk=True))
         dec_payload = [
-            MixedRow(slot=slot, tokens=np.asarray([self._last_token[slot]], np.int32),
+            MixedRow(slot=slot, tokens=np.asarray([self._last_token[slot]], np.int32),  # sync-ok: _last_token is a host array
                      start=req.total_len - 1,  # position of the token being fed
                      table=self.mgr.table_array(req.req_id), emit=True,
                      sampling=req.sampling, is_chunk=False)
@@ -616,9 +616,9 @@ class InferenceEngine:
             self.chunk_stats["chunk_tokens"] += n
             self.recent_chunk_sizes.append((next(self._chunk_seq), n))
             if not req.needs_prefill:
-                self._settle_sampled(slot, req, int(tokens[j]), finished)
+                self._settle_sampled(slot, req, int(tokens[j]), finished)  # sync-ok: tokens already host (backend.mixed_step synced)
         for j, (slot, req) in enumerate(decode_rows):
-            self._settle_sampled(slot, req, int(tokens[len(chunk_rows) + j]), finished)
+            self._settle_sampled(slot, req, int(tokens[len(chunk_rows) + j]), finished)  # sync-ok: tokens already host (backend.mixed_step synced)
         if chunk_rows and decode_rows:
             # every decode token in this step waited out the chunk work: the
             # step duration IS the decode stall attributable to prefill
@@ -735,7 +735,7 @@ class InferenceEngine:
                        generated=len(req.output_ids), free_blocks=self.mgr.num_free)
         self._free_kv(req)
         self.slots[slot] = None
-        req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])
+        req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])  # sync-ok: host-side id lists
         req.output_ids = []
         # a half-prefilled request's KV is gone with its blocks: re-admission
         # starts the chunk walk over (prefix-cache hits re-credit what they can)
@@ -805,7 +805,7 @@ class InferenceEngine:
                 n_acc = 0
                 while n_acc < len(d) and targets[n_acc] == d[n_acc]:
                     n_acc += 1
-                emitted = list(d[:n_acc]) + [int(targets[n_acc])]
+                emitted = list(d[:n_acc]) + [int(targets[n_acc])]  # sync-ok: argmax already host (backend.verify synced)
                 self.spec_stats["accepted"] += n_acc
             for tok in emitted:
                 self._emit(req, int(tok))
@@ -885,7 +885,7 @@ class InferenceEngine:
         if not any(r is not None for r in self.slots):
             return
         B = self.max_batch_size
-        tokens = np.array(self._last_token, np.int32)
+        tokens = np.array(self._last_token, np.int32)  # sync-ok: _last_token is a host array
         tables = np.zeros((B, self.mgr.max_blocks_per_seq), np.int32)
         ctx = np.zeros(B, np.int32)
         done0 = np.ones(B, bool)
@@ -907,8 +907,8 @@ class InferenceEngine:
             for i, req in enumerate(self.slots):
                 if req is None or req.done or not valid[s, i]:
                     continue
-                self._emit(req, int(toks[s, i]))
-                self._last_token[i] = int(toks[s, i])
+                self._emit(req, int(toks[s, i]))  # sync-ok: toks already host (backend.decode synced)
+                self._last_token[i] = int(toks[s, i])  # sync-ok: toks already host (backend.decode synced)
         for i, req in enumerate(self.slots):
             if req is None:
                 continue

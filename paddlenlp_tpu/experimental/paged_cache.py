@@ -192,6 +192,14 @@ class BlockManager:
     evicted only under allocation pressure, so the cache can never cause an
     admission failure the uncached allocator wouldn't have had: ``num_free``
     counts them as available.
+
+    **Concurrency model**: lock-free by thread confinement — the manager is
+    owned by the engine, which the serving stack drives from ONE loop thread
+    (engine_loop.py); ``generate()`` callers are single-threaded by contract.
+    Metrics/stats readers on HTTP threads only touch scalar counters
+    (``cache_hits``/``num_free``/...), where a stale read is harmless. Do not
+    add cross-thread mutation here; route it through the engine loop's
+    command queue instead.
     """
 
     def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int,

@@ -1,0 +1,61 @@
+"""Trace span/instant name catalog — the stable vocabulary of the tracer.
+
+Span names are string API the same way metric names and fault-point names
+are: Perfetto queries, ``/debug/trace?trace=`` tooling, the README's
+observability tables and the SLO runbooks all refer to spans by name, so a
+rename or an undocumented addition is a silent break for every saved query.
+``tools/analyze`` (the ``span-catalog`` checker) enforces both directions:
+every literal name passed to ``TRACER.span/instant/add_span`` in
+``paddlenlp_tpu/`` must have an entry here, and every entry must have a call
+site (a dynamic-name call site declares its names with an inline
+``# span-names: a b c`` comment).
+
+Grouped by emitting tier. Keep docs to one line — they are the catalog, not
+the design doc (that lives in the emitting module's docstring).
+
+This module must stay stdlib-only (no jax, no package-relative imports): the
+static-analysis suite loads it by file path without executing
+``paddlenlp_tpu.__init__``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["SPAN_CATALOG"]
+
+SPAN_CATALOG = {
+    # ------------------------------------------------------------- engine (cat="engine")
+    "admission": "waiting->slot binding + KV allocation for one engine step (also the scheduler-side admission span, cat=scheduler)",
+    "prefix_cache": "prefix-cache match/COW bookkeeping + owed device block copies during admission",
+    "prefill": "batched monolithic prompt prefill, one span per padded suffix-length bucket (also the retrospective per-request prefill phase)",
+    "mixed_step": "one ragged mixed prefill-chunk + decode forward (chunked prefill)",
+    "decode": "multi-token decode jit over all running slots (also the retrospective per-request decode phase)",
+    "spec_propose": "speculative-decoding draft proposal (ngram or draft model)",
+    "spec_verify": "speculative-decoding batched verify forward",
+    "sampling": "host-side rejection-sampling acceptance for one request (spec sample mode)",
+    "kv_alloc": "instant: KV blocks allocated for an admitted request (cached_tokens = prefix-cache hit)",
+    "kv_free": "instant: a request's KV blocks released (finish/abort/preempt)",
+    "preempt": "instant: KV exhaustion evicted the youngest sequence for recompute-requeue",
+    # ------------------------------------------------------------- engine loop / supervisor
+    "engine_failure": "instant: engine.step() raised; the loop is entering DEGRADED",
+    "engine_degraded": "one DEGRADED window: triage -> backoff -> rebuild -> requeue",
+    "request": "retrospective whole-request span (arrival -> finish) under the request's trace id",
+    "queue": "retrospective per-request wait from arrival to slot admission",
+    # ------------------------------------------------------------- scheduler
+    "admission_rejected": "instant: scheduler shed a submission (reason=draining|degraded|saturated)",
+    # ------------------------------------------------------------- router
+    "route": "routing decision for one request (snapshot + policy ordering)",
+    "router_request": "whole router-side request span (forward + stream relay)",
+    "reroute": "instant: attempt moved to the next candidate before anything was relayed",
+    "failover": "accepted-then-failed pre-token resubmission onto another replica",
+    "replica_state": "instant: pool state machine moved a replica (prev -> state)",
+    # ------------------------------------------------------------- serving api
+    "trace_adopted": "instant: replica adopted an inbound router traceparent instead of minting req-N",
+    # ------------------------------------------------------------- trainer
+    "train_step": "one optimizer step (forward/backward/update) on the trainer loop",
+    "evaluate": "one evaluation pass over the eval dataset",
+    "checkpoint": "checkpoint save (stage + manifest + commit rename)",
+    "block_until_ready": "device sync inside a trainer timer stop (host waited on the device here)",
+    # ------------------------------------------------------------- profiler
+    "profiler_window_start": "instant: jax.profiler capture window opened",
+    "profiler_window_stop": "instant: jax.profiler capture window closed",
+}

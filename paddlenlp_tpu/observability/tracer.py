@@ -31,6 +31,14 @@ costs one hash + dict probe per span, not a record. A tier ahead of this one
 after parsing the propagated traceparent header (:func:`parse_traceparent`).
 Trace-less spans (batch-level engine phases, trainer steps) are never sampled
 out.
+
+**Concurrency model.** Every public method may be called from any thread.
+The ring (``_buf``), the drop counter and the sampling-mark table are guarded
+by ``_lock`` (``# guarded-by:`` annotations, enforced by the
+``tools/analyze`` lock-discipline checker); the one deliberate unguarded read
+(`trace_is_sampled`'s mark probe) is marked ``# lock-ok`` with its rationale.
+``capacity``/``enabled``/``sample_every``/``_epoch0`` are set once at
+construction and read-only after.
 """
 
 from __future__ import annotations
@@ -202,13 +210,13 @@ class SpanTracer:
         self.capacity = capacity
         self.enabled = enabled
         self.sample_every = sample_every  # 1 = record every trace
-        self._buf: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
-        self.dropped = 0  # spans evicted by the ring since the last clear()
+        self._buf: deque = deque(maxlen=capacity)  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock — spans evicted by the ring since the last clear()
         # explicit per-trace decisions (propagated from an upstream tier);
         # bounded so a long-lived process cannot leak one entry per request —
         # an evicted entry just falls back to the deterministic hash
-        self._trace_marks: "OrderedDict[str, bool]" = OrderedDict()
+        self._trace_marks: "OrderedDict[str, bool]" = OrderedDict()  # guarded-by: _lock
         self._marks_cap = 4096
         # anchor perf_counter to the epoch once so spans from all threads share
         # one monotonic-but-absolute timeline (time.time() can step backwards)
@@ -243,7 +251,7 @@ class SpanTracer:
         deterministic hash against ``sample_every``."""
         if trace_id is None:
             return True
-        mark = self._trace_marks.get(trace_id)  # racy read is fine: bool or None
+        mark = self._trace_marks.get(trace_id)  # lock-ok: racy read is fine — stale bool/None only skews one sampling decision
         if mark is not None:
             return mark
         return self.sample_every <= 1 or trace_sampled(trace_id, self.sample_every)

@@ -31,6 +31,19 @@ admissions with 503 + ``Retry-After``. Restarts and retries are exported as
 ``paddlenlp_serving_engine_restarts_total`` /
 ``paddlenlp_serving_request_retries_total``, and each degraded window lands in
 the span tracer as an ``engine_degraded`` span.
+
+**Concurrency model.** The engine and everything it owns (scheduler state,
+``BlockManager``, device handles) are confined to the ONE loop thread — HTTP
+worker threads reach them only through the ``_cmds`` queue (thread-safe) and
+the per-request :class:`RequestHandle`. ``EngineLoop`` fields are therefore
+lock-free by confinement: ``_handles``/``_requeue``/``_last_token_t`` are
+written on the loop thread only; ``recent_finished`` is an append-only deque
+(atomic ops) that HTTP readers may see a few entries stale; ``_state``/
+``_phase``/``_stop`` are single-slot flags where a racy read returns a
+momentarily stale-but-valid value by design. The only lock in this module is
+``RequestHandle._cb_lock``, guarding the done/callback handoff between the
+loop thread and client threads — its fields carry ``# guarded-by:``
+annotations enforced by ``tools/analyze`` (lock-discipline checker).
 """
 
 from __future__ import annotations
@@ -115,8 +128,8 @@ class RequestHandle:
         self._request = None  # engine Request once finished
         self._error: Optional[BaseException] = None
         self._cancelled = False
-        self._callbacks: List = []
         self._cb_lock = threading.Lock()
+        self._callbacks: List = []  # guarded-by: _cb_lock
         # supervisor state: everything needed to resubmit after a rebuild
         self._streamed: List[int] = []  # every token delivered to the client
         self._stream_closed = False  # a done=True token was delivered (EOS/length)
@@ -788,7 +801,7 @@ class EngineLoop:
         if req.first_token_t is not None and req.finish_t is not None:
             phases["decode"] = (req.first_token_t, req.finish_t)
         for name, (t0, t1) in phases.items():
-            TRACER.add_span(name, t0, t1 - t0, cat="request", trace=trace,
+            TRACER.add_span(name, t0, t1 - t0, cat="request", trace=trace,  # span-names: queue prefill decode
                             wall=True, **meta)
         if req.finish_t is not None:
             TRACER.add_span("request", req.arrival_t, req.finish_t - req.arrival_t,

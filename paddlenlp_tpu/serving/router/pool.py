@@ -26,6 +26,16 @@ The proxy feeds forwarding observations back through
 :meth:`ReplicaPool.note_forward_failure` / :meth:`ReplicaPool.note_degraded`
 so a mid-stream incident demotes the replica immediately instead of waiting a
 poll interval.
+
+**Concurrency model.** Three kinds of thread touch the pool: the poller
+(``_run``/``poll_once``), HTTP proxy threads (``snapshots``/``get``/
+``note_*``), and whoever mutates membership (``add``). The replica list and
+id map are guarded by ``_lock`` (``# guarded-by:`` annotations, enforced by
+``tools/analyze``); per-``Replica`` fields are written ONLY inside
+``_apply`` under that same pool lock, and read by other threads only through
+:meth:`Replica.snapshot`, which ``snapshots()`` calls under the lock. The
+one exception is ``Replica.polls``/``_offset_samples``, touched solely by
+the poller thread inside ``_probe`` (single-thread confinement, no lock).
 """
 
 from __future__ import annotations
@@ -159,9 +169,9 @@ class ReplicaPool:
         self.down_after = down_after
         self.recovery_polls = recovery_polls
         self.kv_scrape_every = kv_scrape_every
-        self._replicas: List[Replica] = []
-        self._by_id: Dict[str, Replica] = {}
         self._lock = threading.Lock()
+        self._replicas: List[Replica] = []  # guarded-by: _lock
+        self._by_id: Dict[str, Replica] = {}  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 

@@ -13,6 +13,15 @@ up in host memory. The scheduler enforces:
 - circuit breaker: while the engine loop is DEGRADED (supervisor rebuilding
   the engine after a step failure), submissions raise :class:`DegradedError`
   (HTTP 503 with ``Retry-After``) instead of queueing behind a dead engine.
+
+**Concurrency model.** ``submit()`` is called from many HTTP worker threads
+at once, and ``_release`` fires on whichever thread resolves the handle (the
+engine loop, usually). The admission window (``_inflight``) and the drain
+flag (``_draining``) are therefore guarded by ``_lock`` — annotated with
+``# guarded-by:`` and enforced by ``tools/analyze`` (lock-discipline
+checker). The ``rejected_*`` counters are single-writer-ish int bumps read
+only by ``stats()``; a momentarily stale read is acceptable and they stay
+unguarded on purpose. ``_idle`` is a ``threading.Event`` (self-synchronized).
 """
 
 from __future__ import annotations
@@ -66,8 +75,8 @@ class Scheduler:
         self.loop = loop
         self.config = config or SchedulerConfig()
         self._lock = threading.Lock()
-        self._inflight = 0
-        self._draining = False
+        self._inflight = 0  # guarded-by: _lock
+        self._draining = False  # guarded-by: _lock
         self._idle = threading.Event()
         self._idle.set()
         self.rejected_saturated = 0
@@ -144,13 +153,14 @@ class Scheduler:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
 
     def stats(self) -> dict:
         return {
             "inflight": self.inflight,
             "max_inflight": self.config.max_inflight,
-            "draining": self._draining,
+            "draining": self.draining,
             "engine_state": self.loop.state,
             "rejected_saturated": self.rejected_saturated,
             "rejected_draining": self.rejected_draining,

@@ -43,7 +43,7 @@ class _Timer:
                             time.perf_counter() - t_sync, cat="trainer",
                             trace="train", phase=self.name)
         t_end = time.perf_counter()
-        TRACER.add_span(self.name, TRACER.epoch_time(self._started),
+        TRACER.add_span(self.name, TRACER.epoch_time(self._started),  # span-dynamic: spans are named by the caller's timer name (open phase vocabulary, e.g. "forward-backward")
                         t_end - self._started, cat="trainer", trace="train")
         self._elapsed += t_end - self._started
         self._started = None

@@ -1,0 +1,100 @@
+"""``python -m tools.analyze`` — run the static-analysis suite.
+
+Default output is ONE machine-readable JSON line (the same contract as
+``tools/check_metrics.py`` / ``tools/check_faults.py``), consumed by
+``tests/tools/test_analyze.py`` so tier-1 enforces the ratchet on every PR.
+Exit status: 0 = no new findings (stale baseline entries only warn),
+1 = new findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    # make `python tools/analyze/__main__.py` work too, not just -m
+    root_default = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if root_default not in sys.path:
+        sys.path.insert(0, root_default)
+    from tools.analyze import AnalysisContext, CHECKERS, run_checkers
+    from tools.analyze.baseline import (DEFAULT_BASELINE_PATH, apply_baseline,
+                                        load_baseline, write_baseline)
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="AST-based static-analysis suite (jit purity, host syncs, "
+                    "sharding contracts, lock discipline, catalogs)")
+    ap.add_argument("--root", default=root_default, help="repo root to analyze")
+    ap.add_argument("--checker", action="append", default=None,
+                    help="run only this checker (repeatable)")
+    ap.add_argument("--list", action="store_true", help="list checkers and exit")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE_PATH,
+                    help="baseline file (ratchet state)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding as new (ignore the ratchet)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings into the baseline file")
+    ap.add_argument("--format", choices=("json", "text"), default="json")
+    ap.add_argument("--max-new", type=int, default=50,
+                    help="cap on new findings echoed into the JSON line")
+    args = ap.parse_args(argv)
+
+    ctx = AnalysisContext(args.root)
+    if args.list:
+        from tools.analyze import checkers  # noqa: F401 — trigger registration
+        for name in sorted(CHECKERS):
+            print(f"{name:20s} {CHECKERS[name].description}")
+        return 0
+
+    t0 = time.perf_counter()
+    try:
+        findings, per = run_checkers(ctx, args.checker)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        # on a filtered run, entries belonging to checkers that did NOT run
+        # are preserved verbatim — freezing one checker must not wipe the
+        # rest of the ratchet (or its hand-written justifications)
+        ran = set(per) | {c for c in (args.checker or [])}
+        keep = (lambda e: e.get("rule") not in ran) if args.checker else None
+        write_baseline(findings, args.baseline, keep_entry=keep)
+        print(f"baseline written: {args.baseline} ({len(findings)} findings)",
+              file=sys.stderr)
+    baseline = {"version": 1, "entries": {}} if args.no_baseline \
+        else load_baseline(args.baseline)
+    new, baselined, stale = apply_baseline(findings, baseline)
+    dur = time.perf_counter() - t0
+
+    if args.format == "text":
+        for f in new:
+            print(f"NEW  {f.render()}")
+        for s in stale:
+            print(f"STALE baseline entry {s['fingerprint']}: "
+                  f"{s.get('file')}: {s.get('message')}")
+        print(f"{len(CHECKERS) if not args.checker else len(args.checker)} checkers, "
+              f"{len(findings)} findings ({len(new)} new, {baselined} baselined, "
+              f"{len(stale)} stale) in {dur:.2f}s")
+    else:
+        print(json.dumps({
+            "ok": not new,
+            "checkers": len(per),
+            "per_checker": per,
+            "findings": len(findings),
+            "new": len(new),
+            "baselined": baselined,
+            "stale": len(stale),
+            "new_findings": [f.to_dict() for f in new[: args.max_new]],
+            "stale_entries": stale,
+            "duration_s": round(dur, 3),
+        }))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Baseline ratchet: freeze existing findings, fail only on NEW ones.
+
+``BASELINE.json`` (committed next to this module) maps finding fingerprints to
+a one-line justification. The ratchet contract:
+
+- a finding whose fingerprint (+ occurrence slot, for repeated identical
+  constructs in one scope) appears in the baseline is **baselined** — reported
+  but not failing;
+- a finding not in the baseline is **new** — the run fails (rc=1);
+- a baseline entry no longer matched by any finding is **stale** — surfaced as
+  a warning so dead entries get pruned, never a failure (deleting fixed code
+  must not break the build).
+
+Fingerprints exclude line numbers (see :class:`tools.analyze.Finding`), so the
+ratchet survives unrelated edits; they include a snippet of the offending
+construct, so fixing the construct retires the entry.
+
+``--write-baseline`` regenerates the file from the current findings,
+preserving justifications for fingerprints that already had one and stamping
+``"TODO: justify"`` on new entries — the diff review is where the
+justification gets written, on purpose.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                     "BASELINE.json")
+_TODO = "TODO: justify"
+
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Dict:
+    """{"version": 1, "entries": {fingerprint: {"count", "justification",
+    "rule", "file", "scope", "message"}}} — missing file = empty baseline."""
+    if not os.path.isfile(path):
+        return {"version": 1, "entries": {}}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data.get("entries"), dict):
+        raise ValueError(f"malformed baseline {path}: no 'entries' object")
+    return data
+
+
+def apply_baseline(findings: List, baseline: Dict) -> Tuple[List, int, List[Dict]]:
+    """Split ``findings`` against the ratchet.
+
+    Returns ``(new_findings, baselined_count, stale_entries)``. An entry's
+    ``count`` allows that many identical-fingerprint findings (repeated
+    identical constructs in one scope hash alike); finding N+1 is new.
+    """
+    entries = baseline.get("entries", {})
+    seen: Dict[str, int] = {}
+    new, baselined = [], 0
+    for f in findings:
+        fp = f.fingerprint
+        seen[fp] = seen.get(fp, 0) + 1
+        allowed = int(entries.get(fp, {}).get("count", 0))
+        if seen[fp] <= allowed:
+            baselined += 1
+        else:
+            new.append(f)
+    stale = []
+    for fp, entry in entries.items():
+        missing = int(entry.get("count", 1)) - seen.get(fp, 0)
+        if missing > 0:
+            stale.append({"fingerprint": fp, "missing": missing,
+                          **{k: entry.get(k) for k in ("rule", "file", "scope", "message")}})
+    return new, baselined, stale
+
+
+def write_baseline(findings: List, path: str = DEFAULT_BASELINE_PATH,
+                   previous: Dict = None, keep_entry=None) -> Dict:
+    """Freeze ``findings`` as the new baseline, carrying over justifications
+    from ``previous`` (default: whatever is on disk) by fingerprint.
+
+    ``keep_entry(entry) -> bool`` preserves prior entries verbatim even when
+    no current finding matches them — the runner passes it on a filtered
+    ``--checker`` run so freezing one checker's findings cannot wipe every
+    other checker's (justified) entries."""
+    prev_entries = (previous if previous is not None else load_baseline(path)).get("entries", {})
+    entries: Dict[str, Dict] = {}
+    if keep_entry is not None:
+        for fp, entry in prev_entries.items():
+            if keep_entry(entry):
+                entries[fp] = dict(entry)
+    for f in findings:
+        fp = f.fingerprint
+        if fp in entries:
+            entries[fp]["count"] += 1
+            continue
+        just = prev_entries.get(fp, {}).get("justification", _TODO)
+        entries[fp] = {"rule": f.rule, "file": f.file, "scope": f.scope,
+                       "message": f.message, "count": 1, "justification": just}
+    data = {"version": 1, "entries": dict(sorted(entries.items()))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return data

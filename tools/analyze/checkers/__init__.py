@@ -1,0 +1,12 @@
+"""Checker modules. Importing this package registers every checker in
+``tools.analyze.CHECKERS`` — keep this import list as the single place a new
+checker gets wired in (add the module here and it rides every run, the
+tier-1 smoke test, and ``--list``)."""
+
+from . import (  # noqa: F401
+    catalogs,
+    host_sync,
+    jit_purity,
+    lock_discipline,
+    sharding_contract,
+)

@@ -1,0 +1,280 @@
+"""Catalog-consistency checkers: faults, trace spans, metric names.
+
+Three catalogs in this repo are stable string API — chaos tests arm fault
+points by name, trace tooling filters spans by name, dashboards and
+``bench_serve`` scrape metrics by name. Drift between the catalog and the
+call sites means a chaos test that silently never fires, a span rename that
+breaks every saved Perfetto query, a dashboard panel that flatlines. Each
+sub-checker enforces both directions (used ⊆ documented, documented ⊆ used):
+
+- **faults-catalog** — every ``FaultPoint("x")`` / ``FAULTS.arm|fire("x")``
+  under ``paddlenlp_tpu/`` names a ``utils.faults.CATALOG`` entry with a real
+  doc, and every entry has a call site (generalizes ``tools/check_faults.py``,
+  which is now a thin shim over this module);
+- **span-catalog** — every literal ``TRACER.span/instant/add_span`` name is
+  registered in ``observability/span_catalog.py`` (and vice versa); a call
+  site with a *dynamic* name declares its names with ``# span-names: a b c``;
+- **metrics-catalog** — the static half of the metrics lint (the runtime
+  HELP/TYPE/exposition lint stays in ``tools/check_metrics.py``, which needs
+  jax to instantiate the catalog): every literal metric name registered via
+  ``registry.counter/gauge/histogram`` is a valid Prometheus name, counters
+  end in ``_total``, and the name is documented in a README metrics table.
+
+All three load repo modules (``faults.py``, ``span_catalog.py``) by FILE PATH
+— importing through the package would execute ``paddlenlp_tpu.__init__``
+(jax and all); both modules are stdlib-only by contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .. import AnalysisContext, Finding, dotted_name, enclosing_scope, register, str_arg
+
+_RE_FAULT_POINT = re.compile(r'FaultPoint\(\s*[\'"]([\w.]+)[\'"]')
+_RE_FAULT_REG = re.compile(r'FAULTS\.(?:arm|fire)\(\s*[\'"]([\w.]+)[\'"]')
+_RE_SPAN_NAMES = re.compile(r"#\s*span-names:\s*([\w\- ]+)")
+_RE_SPAN_DYNAMIC = re.compile(r"#\s*span-dynamic:\s*\S")
+_RE_METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SPAN_METHODS = {"span", "instant", "add_span"}
+_REG_METHODS = {"counter", "gauge", "histogram"}
+
+
+def load_module_by_path(path: str, alias: str):
+    """Import a stdlib-only repo module by file path (no package __init__)."""
+    spec = importlib.util.spec_from_file_location(alias, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclass field resolution looks here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------------ faults
+def faults_scan_call_sites(ctx_or_none, src_dir: str, rel_to: str) -> Dict[str, List[str]]:
+    """name -> [relpath, ...] for every fault-point reference under
+    ``src_dir`` (absolute), relpaths relative to ``rel_to``. Kept
+    framework-free so the ``check_faults.py`` shim can call it directly."""
+    import os
+
+    sites: Dict[str, List[str]] = {}
+    for root, _dirs, names in os.walk(src_dir):
+        for name in names:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, rel_to)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for rx in (_RE_FAULT_POINT, _RE_FAULT_REG):
+                for m in rx.finditer(text):
+                    sites.setdefault(m.group(1), []).append(rel)
+    return sites
+
+
+def faults_problems(catalog: Dict[str, str], sites: Dict[str, List[str]]) -> List[str]:
+    """The check_faults.py contract, shared verbatim by shim and checker."""
+    problems = []
+    for used, where in sorted(sites.items()):
+        if used not in catalog:
+            problems.append(f"fault point {used!r} used in {sorted(set(where))} "
+                            "but not registered in faults.CATALOG")
+    for name, doc in sorted(catalog.items()):
+        if not doc or len(doc.strip()) < 20:
+            problems.append(f"catalog entry {name!r} has no meaningful doc")
+        if name not in sites:
+            problems.append(f"catalog entry {name!r} has no call site under paddlenlp_tpu/ "
+                            "(dead chaos coverage — wire it or drop it)")
+    return problems
+
+
+@register("faults-catalog", "fault points used == registered == documented")
+def check_faults(ctx: AnalysisContext) -> List[Finding]:
+    path = ctx.abspath(ctx.config["faults_module"])
+    try:
+        catalog = dict(load_module_by_path(path, "_analyze_faults").CATALOG)
+    except Exception as e:
+        return [Finding("faults-catalog", ctx.config["faults_module"], 0, "<module>",
+                        f"cannot load fault catalog: {e!r}")]
+    sites = faults_scan_call_sites(ctx, ctx.abspath(ctx.config["catalog_src_dir"]),
+                                   ctx.root)
+    return [Finding("faults-catalog", ctx.config["faults_module"], 0, "CATALOG", p)
+            for p in faults_problems(catalog, sites)]
+
+
+# ------------------------------------------------------------------ spans
+def _is_tracer_call(func: ast.AST) -> bool:
+    """TRACER.span / tracer.instant / self.tracer.add_span / pool.tracer.*"""
+    if not (isinstance(func, ast.Attribute) and func.attr in _SPAN_METHODS):
+        return False
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id in ("TRACER", "tracer")
+    if isinstance(v, ast.Attribute):
+        return v.attr in ("tracer", "_tracer")
+    return False
+
+
+def span_call_sites(ctx: AnalysisContext) -> Tuple[Dict[str, List[Tuple[str, int]]],
+                                                   List[Finding]]:
+    """Literal span names used under the catalog source dir (name ->
+    [(relpath, lineno), ...]), plus findings for dynamic-name call sites
+    missing a ``# span-names:`` declaration."""
+    used: Dict[str, List[Tuple[str, int]]] = {}
+    findings: List[Finding] = []
+    for rel in ctx.iter_py([ctx.config["catalog_src_dir"]]):
+        src = ctx.source(rel)
+        if "TRACER" not in src and "tracer" not in src:
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_tracer_call(node.func)):
+                continue
+            name = str_arg(node)
+            if name is not None:
+                used.setdefault(name, []).append((rel, node.lineno))
+                continue
+            declared = _declared_span_names(ctx, rel, node.lineno)
+            if declared:
+                for n in declared:
+                    used.setdefault(n, []).append((rel, node.lineno))
+            elif not _declared_span_dynamic(ctx, rel, node.lineno):
+                findings.append(Finding(
+                    "span-catalog", rel, node.lineno,
+                    enclosing_scope(tree, node.lineno),
+                    f"dynamic span name in {node.func.attr}() call — declare the "
+                    "possible names with `# span-names: a b c`, or mark a "
+                    "deliberately open namespace with `# span-dynamic: <reason>`"))
+    return used, findings
+
+
+def _annotation_lines(ctx: AnalysisContext, rel: str, line: int):
+    """The call line itself, plus the line above ONLY when it is comment-only
+    (a trailing annotation on the previous construct must not bleed down)."""
+    lines = ctx.lines(rel)
+    if 1 <= line <= len(lines):
+        yield lines[line - 1]
+    if 2 <= line <= len(lines) + 1 and lines[line - 2].strip().startswith("#"):
+        yield lines[line - 2]
+
+
+def _declared_span_names(ctx: AnalysisContext, rel: str, line: int) -> List[str]:
+    for text in _annotation_lines(ctx, rel, line):
+        m = _RE_SPAN_NAMES.search(text)
+        if m:
+            return m.group(1).split()
+    return []
+
+
+def _declared_span_dynamic(ctx: AnalysisContext, rel: str, line: int) -> bool:
+    return any(_RE_SPAN_DYNAMIC.search(text)
+               for text in _annotation_lines(ctx, rel, line))
+
+
+@register("span-catalog", "trace span/instant names used == documented in "
+                          "observability/span_catalog.py")
+def check_spans(ctx: AnalysisContext) -> List[Finding]:
+    rel = ctx.config["span_catalog_module"]
+    try:
+        catalog = dict(load_module_by_path(ctx.abspath(rel), "_analyze_spans").SPAN_CATALOG)
+    except Exception as e:
+        return [Finding("span-catalog", rel, 0, "<module>",
+                        f"cannot load span catalog: {e!r}")]
+    used, findings = span_call_sites(ctx)
+    for name, where in sorted(used.items()):
+        if name not in catalog:
+            # message stays line-number-free (fingerprint contract); the first
+            # call site's line rides in Finding.line for display only
+            files = sorted({f for f, _ in where})
+            findings.append(Finding(
+                "span-catalog", where[0][0], where[0][1], "SPAN_CATALOG",
+                f"span name {name!r} (used in {files[:3]}) not in "
+                "SPAN_CATALOG — trace names are stable API, register + document it"))
+    for name, doc in sorted(catalog.items()):
+        if not doc or len(doc.strip()) < 15:
+            findings.append(Finding("span-catalog", rel, 0, "SPAN_CATALOG",
+                                    f"span catalog entry {name!r} has no meaningful doc"))
+        if name not in used:
+            findings.append(Finding(
+                "span-catalog", rel, 0, "SPAN_CATALOG",
+                f"span catalog entry {name!r} has no call site — stale entry, "
+                "prune it or wire the span back in"))
+    return findings
+
+
+# ------------------------------------------------------------------ metrics
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def metric_registrations(ctx: AnalysisContext):
+    """Yield (rel, lineno, kind, name) for every static metric registration
+    under the catalog source dir. Module-level string constants used as names
+    (``registry.counter(TRACES_DROPPED_METRIC, ...)``) are resolved."""
+    for rel in ctx.iter_py([ctx.config["catalog_src_dir"]]):
+        src = ctx.source(rel)
+        if ".counter(" not in src and ".gauge(" not in src and ".histogram(" not in src:
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        consts = _module_str_constants(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REG_METHODS and node.args):
+                continue
+            name = str_arg(node)
+            if name is None and isinstance(node.args[0], ast.Name):
+                name = consts.get(node.args[0].id)
+                # a constant imported from another module resolves there; an
+                # unresolvable name arg is skipped (the runtime lint in
+                # check_metrics.py still covers whatever it registers)
+            if name is None:
+                continue
+            # heuristic guard: metric names in this codebase are snake_case
+            # with >= 1 underscore; skips unrelated .counter() methods
+            if "_" not in name:
+                continue
+            yield rel, node.lineno, node.func.attr, name
+
+
+@register("metrics-catalog", "registered metric names are valid, suffixed by "
+                             "convention, and documented in a README table")
+def check_metrics(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    readmes = [ctx.source(p) for p in ctx.config["readme_paths"] if ctx.exists(p)]
+    if not readmes:
+        return [Finding("metrics-catalog", "<config>", 0, "<config>",
+                        "no configured README found to check metric docs against")]
+    docs = "\n".join(readmes)
+    for rel, lineno, kind, name in metric_registrations(ctx):
+        scope = enclosing_scope(ctx.tree(rel), lineno)
+        if not _RE_METRIC_NAME.match(name):
+            findings.append(Finding(
+                "metrics-catalog", rel, lineno, scope,
+                f"metric name {name!r} is not a valid Prometheus name"))
+            continue
+        if kind == "counter" and not name.endswith("_total"):
+            findings.append(Finding(
+                "metrics-catalog", rel, lineno, scope,
+                f"counter {name!r} does not end in _total (Prometheus convention "
+                "this catalog follows everywhere else)"))
+        if f"`{name}`" not in docs and f"`{name}{{" not in docs:
+            findings.append(Finding(
+                "metrics-catalog", rel, lineno, scope,
+                f"metric {name!r} not documented in any README metrics table "
+                f"({', '.join(ctx.config['readme_paths'])}) — names are stable "
+                "API, add a row"))
+    return findings

@@ -1,0 +1,107 @@
+"""host-sync checker: no silent device→host syncs in the engine step path.
+
+The serving hot path's contract (engine.py module docstring, PR 1 onward) is
+that one engine step costs ONE device→host transfer of int32 ids + flags.
+Every extra sync — an ``.item()``, a stray ``np.asarray`` on a device array, a
+``float()`` on a logit — serializes the host against the device and has
+historically crept in silently (the PR 5/7 ``one_hot``/host-bincount
+regressions were caught by hand). This checker flags, inside the configured
+hot-path functions (``host_sync_paths`` config: file → function qualnames):
+
+- ``.item()`` / ``.block_until_ready()`` / ``jax.device_get(...)``;
+- ``np.asarray(...)`` / ``np.array(...)`` / ``np.bincount(...)`` — the
+  device→host materialization points (and the host-side O(vocab) work the
+  bincount regression rode in on);
+- ``int(x)`` / ``float(x)`` where ``x`` is a subscript or call — the classic
+  per-token device read (``int(tokens[i])`` on a live jax array syncs).
+
+Static analysis cannot see types, so host-side numpy hits too; that is the
+point — every sync-shaped construct on the hot path must be **documented**:
+mark the deliberate ones with ``# sync-ok: <reason>`` on (or directly above)
+the line. The allowlist is the documentation; an unmarked construct is a
+finding and fails the ratchet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import AnalysisContext, Finding, dotted_name, qualname_index, register
+
+RULE = "host-sync"
+
+_NP_SYNCS = {"np.asarray", "np.array", "np.bincount",
+             "numpy.asarray", "numpy.array", "numpy.bincount"}
+_ALWAYS = {"jax.device_get", "device_get", "jax.block_until_ready"}
+_METHOD_SYNCS = {"item", "block_until_ready"}
+
+
+def _is_host_builtin(node: ast.AST) -> bool:
+    """int(sum(...)) / float(len(...)) over Python builtins is host math on
+    host scalars, not a device read."""
+    return isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+        and node.func.id in ("sum", "len", "min", "max", "abs", "round")
+
+
+def _snippet(ctx: AnalysisContext, rel: str, lineno: int) -> str:
+    lines = ctx.lines(rel)
+    text = lines[lineno - 1].strip() if 1 <= lineno <= len(lines) else ""
+    return text.split("#")[0].strip()[:90]
+
+
+@register(RULE, "engine step path must not grow undocumented device->host syncs")
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, hot_quals in sorted(ctx.config["host_sync_paths"].items()):
+        if not ctx.exists(rel):
+            findings.append(Finding(RULE, rel, 0, "<config>",
+                                    "configured hot-path file does not exist"))
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        quals = qualname_index(tree)
+        hot = set(hot_quals)
+        matched = {q for q in quals.values() if q in hot}
+        for missing in sorted(hot - matched):
+            findings.append(Finding(
+                RULE, rel, 0, "<config>",
+                f"configured hot-path function {missing!r} not found "
+                "(renamed? update host_sync_paths)"))
+        for node, qual in quals.items():
+            if qual not in hot or not isinstance(node, (ast.FunctionDef,
+                                                        ast.AsyncFunctionDef)):
+                continue
+            findings.extend(_scan_function(ctx, rel, qual, node))
+    return findings
+
+
+def _scan_function(ctx: AnalysisContext, rel: str, qual: str, fn) -> List[Finding]:
+    out: List[Finding] = []
+
+    def flag(node, what):
+        if ctx.allowed(rel, node.lineno, "sync-ok"):
+            return
+        out.append(Finding(
+            RULE, rel, node.lineno, qual,
+            f"{what} in hot path `{_snippet(ctx, rel, node.lineno)}` — "
+            "document with `# sync-ok: <reason>` if deliberate"))
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        if name in _NP_SYNCS:
+            out_name = name.split(".")[-1]
+            flag(node, f"host materialization np.{out_name}()")
+        elif name in _ALWAYS:
+            flag(node, f"explicit device sync {name}()")
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in _METHOD_SYNCS \
+                and not node.args:
+            flag(node, f".{node.func.attr}() device sync")
+        elif name in ("int", "float") and len(node.args) == 1 \
+                and isinstance(node.args[0], (ast.Subscript, ast.Call)) \
+                and not _is_host_builtin(node.args[0]):
+            flag(node, f"{name}() on an array element (per-token device read)")
+    return out

@@ -1,0 +1,308 @@
+"""jit-purity checker: functions reachable from a jit/pjit/Pallas entry point
+must be side-effect free.
+
+Impurity inside traced code is the classic silent-wrong class of jax bug: the
+side effect runs once at trace time (so smoke tests pass) and never again, or
+— for instance-state mutation — runs at trace time against tracers and
+poisons host state with abstract values. Banned inside the traced set:
+
+- ``print`` / ``input`` / ``breakpoint`` / ``open`` / ``exec`` / ``eval``;
+- ``time.*`` (trace-time constant folded into the compiled program);
+- ``np.random.*`` / stdlib ``random.*`` (ditto — use ``jax.random`` keys);
+- ``logging.*`` / ``logger.*`` calls;
+- stores to ``self.<attr>`` and ``global``/``nonlocal`` declarations.
+
+Entry points (seeds) are discovered statically:
+
+- ``jax.jit(f, ...)`` / ``jit(f)`` / ``pjit(f)`` calls — including the
+  ``_build_jits`` pattern, ``jax.jit(self._x_impl, ...)``;
+- ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorators;
+- ``pl.pallas_call(kernel, ...)`` (a ``functools.partial(kernel, ...)`` first
+  arg unwraps to the kernel).
+
+Reachability is a name-based call graph over the configured ``jit_graph_dirs``
+(kept narrow on purpose — a whole-package name graph would alias unrelated
+helpers): ``self.x()`` resolves through the textual class hierarchy (the
+class, its ancestors AND descendants — an override must be as pure as the
+base), plain names resolve to same-module functions or relative-import
+targets inside the scanned set. External calls (jnp/jax/lax/...) are leaves.
+
+Suppress a deliberate trace-time effect with ``# jit-ok: <reason>`` on the
+offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import AnalysisContext, Finding, dotted_name, register
+
+RULE = "jit-purity"
+
+_BANNED_CALLS = {"print", "input", "breakpoint", "exec", "eval", "open"}
+_BANNED_ROOTS = ("time.", "logging.", "logger.", "random.")
+_BANNED_CHAINS = ("np.random.", "numpy.random.")
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+class _Func:
+    __slots__ = ("path", "qual", "cls", "name", "node")
+
+    def __init__(self, path, qual, cls, name, node):
+        self.path = path
+        self.qual = qual  # "Class.method" or "func"
+        self.cls = cls  # class name or None
+        self.name = name
+        self.node = node
+
+
+class _Graph:
+    """Name-indexed universe of functions/classes across the scanned files."""
+
+    def __init__(self):
+        self.funcs: Dict[Tuple[str, str], _Func] = {}  # (path, qual) -> _Func
+        #: module-level functions per path: path -> {name: _Func}
+        self.module_funcs: Dict[str, Dict[str, _Func]] = {}
+        #: class name -> [(path, {method: _Func}, [base names])]
+        self.classes: Dict[str, List[Tuple[str, Dict[str, _Func], List[str]]]] = {}
+        #: (path, imported name) -> (target path or None, source name)
+        self.imports: Dict[Tuple[str, str], Tuple[Optional[str], str]] = {}
+
+    def methods_named(self, cls: str, name: str) -> List[_Func]:
+        """Methods called ``name`` on ``cls``, its textual ancestors and its
+        descendants (conservative: an override anywhere must stay pure)."""
+        out, seen_cls = [], set()
+
+        def ancestors(c):
+            if c in seen_cls or c not in self.classes:
+                return
+            seen_cls.add(c)
+            for _path, methods, bases in self.classes[c]:
+                if name in methods:
+                    out.append(methods[name])
+                for b in bases:
+                    ancestors(b)
+
+        ancestors(cls)
+        for other, defs in self.classes.items():
+            if other in seen_cls:
+                continue
+            for _path, methods, bases in defs:
+                if any(b in seen_cls for b in bases) and name in methods:
+                    out.append(methods[name])
+        return out
+
+
+def _resolve_relative(path: str, level: int, module: Optional[str]) -> Optional[str]:
+    """'pkg/sub/mod.py' + ``from ..x.y import z`` -> 'pkg/x/y.py'."""
+    parts = path.split("/")[:-1]  # drop the module filename
+    if level > 1:
+        if level - 1 > len(parts):  # deeper than the path — unresolvable
+            return None
+        parts = parts[: len(parts) - (level - 1)]
+    if module:
+        parts = parts + module.split(".")
+    return "/".join(parts) + ".py"
+
+
+def _build_graph(ctx: AnalysisContext, paths: List[str]) -> _Graph:
+    g = _Graph()
+    path_set = set(paths)
+    for path in paths:
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        g.module_funcs[path] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _Func(path, node.name, None, node.name, node)
+                g.funcs[(path, node.name)] = fn
+                g.module_funcs[path][node.name] = fn
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fn = _Func(path, f"{node.name}.{sub.name}", node.name,
+                                   sub.name, sub)
+                        g.funcs[(path, fn.qual)] = fn
+                        methods[sub.name] = fn
+                bases = [dotted_name(b) or "" for b in node.bases]
+                bases = [b.split(".")[-1] for b in bases if b]
+                g.classes.setdefault(node.name, []).append((path, methods, bases))
+        # imports can be nested (function-level `from ..quantization...`): walk
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                target = _resolve_relative(path, node.level, node.module) \
+                    if node.level else ((node.module or "").replace(".", "/") + ".py")
+                target = target if target in path_set else None
+                for alias in node.names:
+                    g.imports[(path, alias.asname or alias.name)] = \
+                        (target, alias.name)
+    return g
+
+
+def _first_callable(call: ast.Call) -> Optional[ast.AST]:
+    """First positional arg, unwrapping ``functools.partial(f, ...)``."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Call) and dotted_name(arg.func) in _PARTIAL_NAMES:
+        return arg.args[0] if arg.args else None
+    return arg
+
+
+def _partial_aliases(tree: ast.Module) -> Dict[str, ast.AST]:
+    """``kernel = functools.partial(_fa_kernel, ...)`` anywhere in the file:
+    alias name -> the wrapped callable node."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call) \
+                and dotted_name(node.value.func) in _PARTIAL_NAMES \
+                and node.value.args:
+            out[node.targets[0].id] = node.value.args[0]
+    return out
+
+
+def _seed_targets(g: _Graph, path: str, cls: Optional[str],
+                  target: Optional[ast.AST],
+                  aliases: Optional[Dict[str, ast.AST]] = None) -> List[_Func]:
+    if target is None:
+        return []
+    if isinstance(target, ast.Name) and aliases and target.id in aliases:
+        target = aliases[target.id]
+    if isinstance(target, ast.Name):
+        fn = g.module_funcs.get(path, {}).get(target.id)
+        if fn is not None:
+            return [fn]
+        imp = g.imports.get((path, target.id))
+        if imp and imp[0]:
+            fn = g.module_funcs.get(imp[0], {}).get(imp[1])
+            return [fn] if fn else []
+        # a method referenced as a bare name inside its own class body
+        if cls:
+            return g.methods_named(cls, target.id)
+        return []
+    if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+            and target.value.id == "self" and cls:
+        return g.methods_named(cls, target.attr)
+    return []
+
+
+def _find_seeds(ctx: AnalysisContext, g: _Graph, paths: List[str]) -> List[_Func]:
+    seeds: List[_Func] = []
+    for path in paths:
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        # enclosing class for each node (one level: methods in classes)
+        cls_of: Dict[ast.AST, Optional[str]] = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    cls_of[sub] = node.name
+        aliases = _partial_aliases(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if name in _JIT_NAMES or name.endswith(".pallas_call") \
+                        or name == "pallas_call":
+                    seeds.extend(_seed_targets(
+                        g, path, cls_of.get(node), _first_callable(node), aliases))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dname = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+                    if dname in _JIT_NAMES:
+                        fn = g.funcs.get((path, node.name)) \
+                            or next((f for f in g.funcs.values()
+                                     if f.path == path and f.node is node), None)
+                        if fn:
+                            seeds.append(fn)
+                    elif isinstance(dec, ast.Call) and dname in _PARTIAL_NAMES \
+                            and dec.args and dotted_name(dec.args[0]) in _JIT_NAMES:
+                        fn = next((f for f in g.funcs.values()
+                                   if f.path == path and f.node is node), None)
+                        if fn:
+                            seeds.append(fn)
+    return seeds
+
+
+def _callees(g: _Graph, fn: _Func) -> List[_Func]:
+    out: List[_Func] = []
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            target = g.module_funcs.get(fn.path, {}).get(f.id)
+            if target is not None and target is not fn:
+                out.append(target)
+                continue
+            imp = g.imports.get((fn.path, f.id))
+            if imp and imp[0]:
+                t = g.module_funcs.get(imp[0], {}).get(imp[1])
+                if t:
+                    out.append(t)
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and fn.cls:
+            out.extend(g.methods_named(fn.cls, f.attr))
+    return out
+
+
+def _impurities(ctx: AnalysisContext, fn: _Func) -> List[Finding]:
+    out = []
+
+    def flag(node, msg):
+        if not ctx.allowed(fn.path, node.lineno, "jit-ok"):
+            out.append(Finding(RULE, fn.path, node.lineno, fn.qual, msg))
+
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name in _BANNED_CALLS:
+                flag(node, f"calls {name}() inside jit-traced code "
+                           "(runs at trace time only)")
+            elif name.startswith(_BANNED_ROOTS) or name.startswith(_BANNED_CHAINS):
+                flag(node, f"calls {name}() inside jit-traced code "
+                           "(trace-time side effect / constant-folded)")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)) and not (
+                        isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "self"):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    flag(node, f"mutates instance state self.{base.attr} inside "
+                               "jit-traced code")
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            flag(node, f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                       f"declaration ({', '.join(node.names)}) inside jit-traced code")
+    return out
+
+
+@register(RULE, "functions reachable from jax.jit/pjit/pallas_call must be pure")
+def check(ctx: AnalysisContext) -> List[Finding]:
+    paths = ctx.iter_py(ctx.config["jit_graph_dirs"])
+    g = _build_graph(ctx, paths)
+    seeds = _find_seeds(ctx, g, paths)
+    # BFS over the call graph
+    reach: Set[Tuple[str, str]] = set()
+    queue = list(seeds)
+    while queue:
+        fn = queue.pop()
+        key = (fn.path, fn.qual)
+        if key in reach:
+            continue
+        reach.add(key)
+        queue.extend(_callees(g, fn))
+    findings: List[Finding] = []
+    for path, qual in sorted(reach):
+        findings.extend(_impurities(ctx, g.funcs[(path, qual)]))
+    return findings

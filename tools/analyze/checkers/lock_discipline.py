@@ -1,0 +1,167 @@
+"""lock-discipline checker: annotated shared state is only touched under its
+lock.
+
+The serving stack's shared mutable state (Scheduler's admission window, the
+router pool's replica set, the tracer's span ring) is guarded by informal
+convention: "take ``self._lock`` around it". This checker makes the
+convention machine-checked via a tiny annotation language:
+
+- ``self._inflight = 0  # guarded-by: _lock`` in ``__init__`` registers the
+  attribute as protected by ``self._lock`` (any ``self.<lock>`` attribute);
+- every OTHER read/write of ``self._inflight`` inside the class must sit
+  lexically inside a ``with self._lock:`` block;
+- ``# lock-ok: <reason>`` on the access line (or above) documents a
+  deliberate unguarded access (e.g. a tolerated racy read);
+- a method whose ``def`` line (or the line above) carries
+  ``# holds-lock: _lock`` is treated as running with the lock held (callers
+  acquire it) — the annotation documents the calling convention.
+
+Scope is intra-class and lexical on purpose: cross-module aliasing and
+thread-confinement ("only the loop thread touches this") are documented in
+each module's "Concurrency model" docstring instead — this checker enforces
+exactly the part a machine can see, which is where the drift happens.
+
+``__init__`` is exempt (the object is not shared during construction).
+A ``guarded-by`` naming a lock the class never creates is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .. import AnalysisContext, Finding, register
+
+RULE = "lock-discipline"
+
+_RE_GUARD = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_RE_ATTR = re.compile(r"self\.([A-Za-z_]\w*)\s*[:=]")
+_RE_HOLDS = re.compile(r"#\s*holds-lock:\s*([A-Za-z_]\w*)")
+
+
+def _holds_lock(ctx: AnalysisContext, rel: str, fn) -> Optional[str]:
+    lines = ctx.lines(rel)
+    for ln, standalone in ((fn.lineno, False), (fn.lineno - 1, True)):
+        if not 1 <= ln <= len(lines):
+            continue
+        text = lines[ln - 1]
+        if standalone and not text.strip().startswith("#"):
+            continue  # a trailing comment on code above must not bleed down
+        m = _RE_HOLDS.search(text)
+        if m:
+            return m.group(1)
+    return None
+
+
+class _AccessVisitor(ast.NodeVisitor):
+    """Walk one method tracking the lexical stack of held ``self.X`` locks."""
+
+    def __init__(self, guarded: Dict[str, str], held: Set[str]):
+        self.guarded = guarded  # attr -> lock name
+        self.held = set(held)
+        self.violations: List[ast.Attribute] = []
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+                    and e.value.id == "self":
+                acquired.append(e.attr)
+            # also scan the context expressions themselves (e.g. a guarded
+            # attr used to *build* the cm) before the lock is held
+            self.generic_visit_expr(e)
+        self.held.update(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.difference_update(acquired)
+
+    visit_AsyncWith = visit_With
+
+    def generic_visit_expr(self, node):
+        for child in ast.walk(node):
+            self._check(child)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        self._check(node)
+        self.generic_visit(node)
+
+    def _check(self, node):
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and node.attr in self.guarded \
+                and self.guarded[node.attr] not in self.held:
+            self.violations.append(node)
+
+
+def _class_guards(ctx: AnalysisContext, rel: str, cls: ast.ClassDef):
+    """(attr -> lock, lock attrs created in the class, annotation findings)."""
+    lines = ctx.lines(rel)
+    guarded: Dict[str, str] = {}
+    findings: List[Finding] = []
+    end = getattr(cls, "end_lineno", cls.lineno)
+    for ln in range(cls.lineno, min(end, len(lines)) + 1):
+        m = _RE_GUARD.search(lines[ln - 1])
+        if not m:
+            continue
+        attr = _RE_ATTR.search(lines[ln - 1].split("#")[0])
+        if attr is None:
+            findings.append(Finding(
+                RULE, rel, ln, cls.name,
+                "malformed `# guarded-by:` annotation — must sit on a "
+                "`self.<attr> = ...` line"))
+            continue
+        guarded[attr.group(1)] = m.group(1)
+    locks_created: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    locks_created.add(t.attr)
+    return guarded, locks_created, findings
+
+
+@register(RULE, "attributes annotated `# guarded-by: <lock>` are only accessed "
+                "inside `with self.<lock>:`")
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py():
+        if "guarded-by:" not in ctx.source(rel):
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            guarded, created, notes = _class_guards(ctx, rel, cls)
+            findings.extend(notes)
+            if not guarded:
+                continue
+            for attr, lock in sorted(guarded.items()):
+                if lock not in created:
+                    findings.append(Finding(
+                        RULE, rel, cls.lineno, cls.name,
+                        f"`# guarded-by: {lock}` on self.{attr} but the class "
+                        f"never creates self.{lock}"))
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__":
+                    continue  # not shared during construction
+                held: Set[str] = set()
+                holds = _holds_lock(ctx, rel, fn)
+                if holds:
+                    held.add(holds)
+                v = _AccessVisitor(guarded, held)
+                for stmt in fn.body:
+                    v.visit(stmt)
+                for node in v.violations:
+                    if ctx.allowed(rel, node.lineno, "lock-ok"):
+                        continue
+                    lock = guarded[node.attr]
+                    findings.append(Finding(
+                        RULE, rel, node.lineno, f"{cls.name}.{fn.name}",
+                        f"self.{node.attr} (guarded-by {lock}) accessed outside "
+                        f"`with self.{lock}:` — annotate `# lock-ok: <reason>` "
+                        "if the race is deliberate"))
+    return findings

@@ -1,0 +1,158 @@
+"""sharding-contract checker: the PR 8 jit-compilation contract.
+
+The sharded serving backend's correctness story (sharded_backend.py module
+docstring) rests on every jitted step program being compiled with EXPLICIT
+placement — ``in_shardings`` + ``out_shardings`` so GSPMD never invents a
+layout, ``donate_argnums`` so the KV pool updates in place instead of
+doubling HBM. A new step program added to the base ``_build_jits`` without a
+sharded override compiles with default (replicated or GSPMD-chosen) layouts
+and *works*, slowly and only until a mesh-shape change — the silent-drift
+failure mode pjit-at-scale reports. Enforced:
+
+- every ``jax.jit`` call inside the sharded file (``sharding_sharded_file``,
+  classes overriding ``_build_jits``) declares ``in_shardings``,
+  ``out_shardings`` AND ``donate_argnums``;
+- every ``jax.jit`` call anywhere under ``sharding_extra_dirs`` (the
+  experimental engine tree) declares at least ``donate_argnums`` — a step
+  program that forgets donation doubles the pool per step;
+- the SET of ``_impl`` functions jitted by the sharded ``_build_jits``
+  equals the base class's set (``sharding_base_file``): adding a step to one
+  side only is the contract break this checker exists for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .. import AnalysisContext, Finding, dotted_name, qualname_index, register
+
+RULE = "sharding-contract"
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_REQUIRED_SHARDED = ("in_shardings", "out_shardings", "donate_argnums")
+
+
+def _jit_calls(tree: ast.Module):
+    """Yield (call, enclosing-qualname) for every jax.jit call, including
+    ``functools.partial(jax.jit, ...)`` decorator forms (as pseudo-calls)."""
+    quals = qualname_index(tree)
+
+    def scope_of(lineno: int) -> str:
+        best, span = "<module>", None
+        for node, q in quals.items():
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end and (span is None or end - node.lineno <= span):
+                best, span = q, end - node.lineno
+        return best
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES:
+            yield node, scope_of(node.lineno)
+        elif isinstance(node, ast.Call) and node.args \
+                and dotted_name(node.func) in ("functools.partial", "partial") \
+                and dotted_name(node.args[0]) in _JIT_NAMES:
+            yield node, scope_of(node.lineno)
+
+
+def _kwarg_names(call: ast.Call) -> Set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg}
+
+
+def _target_impl(call: ast.Call) -> Optional[str]:
+    """Name of the function being jitted (``self._prefill_impl`` -> that)."""
+    args = call.args
+    # partial(jax.jit, ...) has no target; jax.jit(target, ...) does
+    if args and dotted_name(args[0]) in _JIT_NAMES:
+        return None
+    if not args:
+        return None
+    t = args[0]
+    if isinstance(t, ast.Attribute):
+        return t.attr
+    if isinstance(t, ast.Name):
+        return t.id
+    return None
+
+
+def _build_jits_sets(tree: ast.Module) -> Dict[str, Set[str]]:
+    """class name -> set of impl names jitted inside its ``_build_jits``."""
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub.name == "_build_jits":
+                impls = set()
+                for call in ast.walk(sub):
+                    if isinstance(call, ast.Call) and dotted_name(call.func) in _JIT_NAMES:
+                        name = _target_impl(call)
+                        if name:
+                            impls.add(name)
+                out[node.name] = impls
+    return out
+
+
+@register(RULE, "sharded jitted steps declare in/out shardings + donation; "
+                "sharded and base jit sets stay in lockstep")
+def check(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    base_file = ctx.config["sharding_base_file"]
+    sharded_file = ctx.config["sharding_sharded_file"]
+
+    # 1) full contract inside the sharded file
+    if ctx.exists(sharded_file):
+        tree = ctx.tree(sharded_file)
+        if tree is not None:
+            for call, scope in _jit_calls(tree):
+                missing = [k for k in _REQUIRED_SHARDED if k not in _kwarg_names(call)]
+                if missing:
+                    target = _target_impl(call) or "<jit>"
+                    findings.append(Finding(
+                        RULE, sharded_file, call.lineno, scope,
+                        f"jax.jit({target}) missing explicit {', '.join(missing)} "
+                        "(every sharded step program compiles with declared "
+                        "placement + donation — PR 8 contract)"))
+    else:
+        findings.append(Finding(RULE, sharded_file, 0, "<config>",
+                                "configured sharded backend file does not exist"))
+
+    # 2) donation everywhere under the engine tree
+    for rel in ctx.iter_py(ctx.config["sharding_extra_dirs"]):
+        if rel == sharded_file:  # already held to the stricter rule above
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for call, scope in _jit_calls(tree):
+            if "donate_argnums" not in _kwarg_names(call):
+                target = _target_impl(call) or "<jit>"
+                findings.append(Finding(
+                    RULE, rel, call.lineno, scope,
+                    f"jax.jit({target}) without donate_argnums — an engine-tree "
+                    "jit that skips donation doubles its buffers per step"))
+
+    # 3) base vs sharded _build_jits set equality
+    base_sets = _build_jits_sets(ctx.tree(base_file)) if ctx.exists(base_file) \
+        and ctx.tree(base_file) is not None else {}
+    sharded_sets = _build_jits_sets(ctx.tree(sharded_file)) if ctx.exists(sharded_file) \
+        and ctx.tree(sharded_file) is not None else {}
+    if base_sets and sharded_sets:
+        # compare every sharded override against the union of base sets (the
+        # base file defines one canonical builder today; union keeps this
+        # stable if it ever splits)
+        base_all: Set[str] = set().union(*base_sets.values())
+        for cls, impls in sorted(sharded_sets.items()):
+            for name in sorted(base_all - impls):
+                findings.append(Finding(
+                    RULE, sharded_file, 0, f"{cls}._build_jits",
+                    f"base _build_jits compiles {name} but the sharded override "
+                    "does not — the new step program would run with implicit "
+                    "GSPMD layout"))
+            for name in sorted(impls - base_all):
+                findings.append(Finding(
+                    RULE, sharded_file, 0, f"{cls}._build_jits",
+                    f"sharded _build_jits compiles {name} with no base "
+                    "counterpart — single-device parity has no such step"))
+    return findings

@@ -1,0 +1,100 @@
+"""Runtime half of the metrics lint — the part that NEEDS the real catalog.
+
+The static ``metrics-catalog`` checker (checkers/catalogs.py) validates metric
+*names* without importing anything heavy; this module instantiates the actual
+metric objects — ``ServingMetrics`` on a stub engine, ``RouterMetrics``, the
+SLO tracker, the training catalog — renders the Prometheus exposition and
+lints it (HELP/TYPE present, bucket hygiene, federation merge). That requires
+importing ``paddlenlp_tpu`` (jax and all), so it is deliberately NOT a
+registered checker: ``python -m tools.analyze`` stays jax-free and <1s, while
+``tools/check_metrics.py`` (a thin shim over this module) runs the runtime
+lint in its own tier-1-enforced subprocess.
+"""
+
+from __future__ import annotations
+
+
+def _stub_engine():
+    """Just enough engine surface for ServingMetrics' pull-mode gauges."""
+
+    class _Mgr:
+        num_free = 42
+        total_usable_blocks = 64
+        max_blocks_per_seq = 8
+        num_cached_blocks = 3
+        cache_hits = 0
+        cached_tokens_total = 0
+        evictions = 0
+
+    class _Backend:
+        @staticmethod
+        def describe():
+            # a sharded-shaped describe() so the per-axis mesh gauge's labeled
+            # exposition path is linted too
+            return {"kind": "sharded", "devices": 8, "tp_degree": 4,
+                    "mesh": {"dp": 2, "tp": 4}}
+
+    class _Engine:
+        mgr = _Mgr()
+        waiting = []
+        slots = [None] * 4
+        max_batch_size = 4
+        spec_stats = {"drafted": 0, "accepted": 0}
+        chunk_stats = {"chunks": 0, "chunk_tokens": 0}
+        recent_chunk_sizes = []  # (seq, n_tokens) chunked-prefill event ring
+        recent_decode_stalls = []  # (seq, seconds)
+        backend = _Backend()
+
+    return _Engine()
+
+
+def catalog_exposition() -> str:
+    """Render the full serving + router + SLO + training metric catalog from a
+    fresh registry."""
+    from paddlenlp_tpu.observability.exporter import TRACES_DROPPED_METRIC
+    from paddlenlp_tpu.observability.slo import SLOInputs, SLOTracker
+    from paddlenlp_tpu.serving.engine_loop import ServingMetrics
+    from paddlenlp_tpu.serving.metrics import MetricsRegistry
+    from paddlenlp_tpu.serving.router.metrics import RouterMetrics
+    from paddlenlp_tpu.trainer.integrations import register_training_metrics
+
+    registry = MetricsRegistry()
+    ServingMetrics(_stub_engine(), registry=registry)
+    router = RouterMetrics(registry)
+    # labeled series expose no samples until touched — exercise one labelset
+    # of each so the lint sees real sample lines, not just HELP/TYPE headers
+    router.replica_healthy.set(1.0, replica="replica-0")
+    router.requests.inc(replica="replica-0", outcome="ok")
+    router.health_polls.inc(replica="replica-0", outcome="ok")
+    router.fleet_scrape_errors.inc(replica="replica-0")
+    slo = SLOTracker(registry=registry)
+    slo.observe(SLOInputs(total=10.0, errors=1.0, ttft_count=10.0,
+                          ttft_violations=2.0), now=100.0)
+    slo.report(now=100.0)  # populates the per-window gauge labelsets
+    registry.counter(TRACES_DROPPED_METRIC,
+                     "Spans evicted from the bounded trace ring (oldest-first overflow)")
+    register_training_metrics(registry)
+    return registry.expose()
+
+
+def federation_problems() -> list:
+    """Lint the federated-exposition path: merge two synthetic replica
+    catalogs through ``federate_expositions`` and run both the standard
+    exposition lint over the merge and ``lint_federation`` over the inputs
+    (duplicate-family TYPE conflicts, pre-existing ``replica`` labels)."""
+    from paddlenlp_tpu.observability import lint_exposition
+    from paddlenlp_tpu.serving.engine_loop import ServingMetrics
+    from paddlenlp_tpu.serving.metrics import MetricsRegistry
+    from paddlenlp_tpu.serving.router.metrics import federate_expositions, lint_federation
+
+    expositions = {}
+    for rid in ("replica-0", "replica-1"):
+        registry = MetricsRegistry()
+        metrics = ServingMetrics(_stub_engine(), registry=registry)
+        metrics.requests.inc(status="stop")
+        metrics.ttft.observe(0.05)
+        expositions[rid] = registry.expose()
+    problems = [f"federation: {p}" for p in lint_federation(expositions)]
+    merged = federate_expositions(expositions)
+    problems += [f"federated exposition: {p}" for p in lint_exposition(merged)]
+    return problems

@@ -1,22 +1,19 @@
-"""Fault-point catalog lint: every fault point used in source must be
-registered and documented, and every catalog entry must have a call site.
+"""Fault-point catalog lint — thin shim over the static-analysis suite.
 
-Same contract as ``tools/check_metrics.py`` for the metric catalog: the fault
-names are stable API (chaos tests and the ``PDNLP_TPU_FAULTS`` env spec refer
-to them by string), so drift between call sites and
-``paddlenlp_tpu.utils.faults.CATALOG`` means a chaos test that silently never
-fires. Checks:
+The implementation moved to ``tools/analyze/checkers/catalogs.py`` (the
+``faults-catalog`` checker), which also runs under ``python -m tools.analyze``
+with the baseline ratchet. This entry point is kept because the fault names
+are stable API and so is this tool's contract: chaos docs and
+``tests/robustness/test_check_faults.py`` invoke it directly and parse its
+ONE JSON line (``{"ok": ..., "catalog": N, "call_sites": M,
+"problems": [...]}``), exiting non-zero on problems.
+
+Checks (see the checker module for details):
 
 - every ``FaultPoint("name")`` / ``FAULTS.arm("name")`` / ``fire("name")``
   in ``paddlenlp_tpu/`` names a CATALOG entry;
 - every CATALOG entry has a real doc (>= 20 chars — "TODO" doesn't count);
-- every CATALOG entry has at least one ``FaultPoint`` call site in source
-  (a registered-but-unwired fault point is dead chaos coverage).
-
-Prints ONE JSON line (``{"ok": ..., "catalog": N, "call_sites": M,
-"problems": [...]}``) and exits non-zero on problems —
-``tests/robustness/test_check_faults.py`` runs it so tier-1 enforces the
-catalog on every PR.
+- every CATALOG entry has at least one call site in source.
 
 Usage::
 
@@ -25,64 +22,40 @@ Usage::
 
 from __future__ import annotations
 
-import importlib.util
 import json
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC_DIR = os.path.join(ROOT, "paddlenlp_tpu")
+
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.analyze.checkers.catalogs import (  # noqa: E402
+    faults_problems,
+    faults_scan_call_sites,
+    load_module_by_path,
+)
 
 
 def _load_catalog():
     """Load faults.py directly by path — importing it through the
     ``paddlenlp_tpu`` package would execute the package __init__ (jax and
     all); the module itself is stdlib-only so the lint stays dependency-free."""
-    path = os.path.join(SRC_DIR, "utils", "faults.py")
-    spec = importlib.util.spec_from_file_location("_pdnlp_faults_lint", path)
-    mod = importlib.util.module_from_spec(spec)
-    # dataclass field-type resolution looks the module up in sys.modules
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod.CATALOG
-
-# FaultPoint("x.y") declarations and registry-level uses of a literal name
-_RE_POINT = re.compile(r'FaultPoint\(\s*[\'"]([\w.]+)[\'"]')
-_RE_REGISTRY = re.compile(r'FAULTS\.(?:arm|fire)\(\s*[\'"]([\w.]+)[\'"]')
+    return load_module_by_path(os.path.join(SRC_DIR, "utils", "faults.py"),
+                               "_pdnlp_faults_lint").CATALOG
 
 
 def scan_call_sites(src_dir: str = SRC_DIR):
     """name → [relpath, ...] for every fault-point reference in source."""
-    sites = {}
-    for root, _dirs, names in os.walk(src_dir):
-        for name in names:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, ROOT)
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for rx in (_RE_POINT, _RE_REGISTRY):
-                for m in rx.finditer(text):
-                    sites.setdefault(m.group(1), []).append(rel)
-    return sites
+    return faults_scan_call_sites(None, src_dir, ROOT)
 
 
 def main() -> int:
     CATALOG = _load_catalog()
     sites = scan_call_sites()
-    problems = []
-    for used, where in sorted(sites.items()):
-        if used not in CATALOG:
-            problems.append(f"fault point {used!r} used in {sorted(set(where))} "
-                            "but not registered in faults.CATALOG")
-    for name, doc in sorted(CATALOG.items()):
-        if not doc or len(doc.strip()) < 20:
-            problems.append(f"catalog entry {name!r} has no meaningful doc")
-        if name not in sites:
-            problems.append(f"catalog entry {name!r} has no call site under paddlenlp_tpu/ "
-                            "(dead chaos coverage — wire it or drop it)")
+    problems = faults_problems(CATALOG, sites)
     print(json.dumps({
         "ok": not problems,
         "catalog": len(CATALOG),

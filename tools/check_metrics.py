@@ -1,22 +1,20 @@
-"""Metric-catalog lint: every registered metric must expose valid Prometheus
-text format with HELP/TYPE lines.
+"""Metric-catalog lint — thin shim over the static-analysis suite's runtime
+half.
 
-Instantiates the full catalog — the serving runtime's ``ServingMetrics`` (on a
-stub engine, no jax compute), the router front tier's ``RouterMetrics``, the
-SLO plane's ``paddlenlp_slo_*`` series, the tracer-overflow counter, and the
-trainer's ``register_training_metrics`` — into one fresh registry, renders the
-exposition, and runs ``observability.lint_exposition`` over it: missing HELP,
-missing TYPE, illegal names/labels, non-cumulative histogram buckets, negative
-counters all fail.
+The catalog builders moved to ``tools/analyze/runtime_metrics.py``; this
+entry point (and its ONE-JSON-line contract, enforced by
+``tests/observability/test_check_metrics.py``) stays put. Two layers now
+cover metrics:
 
-Also lints the *federated* exposition path: two synthetic replica expositions
-are merged through ``router.metrics.federate_expositions`` and checked with
-both the standard lint and ``lint_federation`` (duplicate-family TYPE
-conflicts, pre-existing ``replica`` label collisions across the merge).
-
-Prints ONE JSON line (``{"ok": ..., "families": N, "problems": [...]}``) and
-exits non-zero on problems — `tests/observability/test_check_metrics.py` runs
-it so tier-1 enforces catalog hygiene on every PR.
+- **static** (``python -m tools.analyze``, ``metrics-catalog`` checker, no
+  jax): registered metric *names* are valid Prometheus names, counters end in
+  ``_total``, every name is documented in a README metrics table;
+- **runtime** (this tool, needs jax to instantiate the catalog): the full
+  serving + router + SLO + training catalog renders a clean exposition —
+  missing HELP, missing TYPE, illegal names/labels, non-cumulative histogram
+  buckets, negative counters all fail — and the federated path
+  (``federate_expositions`` + ``lint_federation``) merges two synthetic
+  replicas cleanly.
 
 Usage::
 
@@ -30,95 +28,18 @@ import json
 import os
 import sys
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
-def _stub_engine():
-    """Just enough engine surface for ServingMetrics' pull-mode gauges."""
-
-    class _Mgr:
-        num_free = 42
-        total_usable_blocks = 64
-        max_blocks_per_seq = 8
-        num_cached_blocks = 3
-        cache_hits = 0
-        cached_tokens_total = 0
-        evictions = 0
-
-    class _Backend:
-        @staticmethod
-        def describe():
-            # a sharded-shaped describe() so the per-axis mesh gauge's labeled
-            # exposition path is linted too
-            return {"kind": "sharded", "devices": 8, "tp_degree": 4,
-                    "mesh": {"dp": 2, "tp": 4}}
-
-    class _Engine:
-        mgr = _Mgr()
-        waiting = []
-        slots = [None] * 4
-        max_batch_size = 4
-        spec_stats = {"drafted": 0, "accepted": 0}
-        chunk_stats = {"chunks": 0, "chunk_tokens": 0}
-        recent_chunk_sizes = []  # (seq, n_tokens) chunked-prefill event ring
-        recent_decode_stalls = []  # (seq, seconds)
-        backend = _Backend()
-
-    return _Engine()
-
-
-def catalog_exposition() -> str:
-    """Render the full serving + router + SLO + training metric catalog from a
-    fresh registry."""
-    from paddlenlp_tpu.observability.exporter import TRACES_DROPPED_METRIC
-    from paddlenlp_tpu.observability.slo import SLOInputs, SLOTracker
-    from paddlenlp_tpu.serving.engine_loop import ServingMetrics
-    from paddlenlp_tpu.serving.metrics import MetricsRegistry
-    from paddlenlp_tpu.serving.router.metrics import RouterMetrics
-    from paddlenlp_tpu.trainer.integrations import register_training_metrics
-
-    registry = MetricsRegistry()
-    ServingMetrics(_stub_engine(), registry=registry)
-    router = RouterMetrics(registry)
-    # labeled series expose no samples until touched — exercise one labelset
-    # of each so the lint sees real sample lines, not just HELP/TYPE headers
-    router.replica_healthy.set(1.0, replica="replica-0")
-    router.requests.inc(replica="replica-0", outcome="ok")
-    router.health_polls.inc(replica="replica-0", outcome="ok")
-    router.fleet_scrape_errors.inc(replica="replica-0")
-    slo = SLOTracker(registry=registry)
-    slo.observe(SLOInputs(total=10.0, errors=1.0, ttft_count=10.0,
-                          ttft_violations=2.0), now=100.0)
-    slo.report(now=100.0)  # populates the per-window gauge labelsets
-    registry.counter(TRACES_DROPPED_METRIC,
-                     "Spans evicted from the bounded trace ring (oldest-first overflow)")
-    register_training_metrics(registry)
-    return registry.expose()
-
-
-def federation_problems() -> list:
-    """Lint the federated-exposition path: merge two synthetic replica
-    catalogs through ``federate_expositions`` and run both the standard
-    exposition lint over the merge and ``lint_federation`` over the inputs
-    (duplicate-family TYPE conflicts, pre-existing ``replica`` labels)."""
-    from paddlenlp_tpu.observability import lint_exposition
-    from paddlenlp_tpu.serving.engine_loop import ServingMetrics
-    from paddlenlp_tpu.serving.metrics import MetricsRegistry
-    from paddlenlp_tpu.serving.router.metrics import federate_expositions, lint_federation
-
-    expositions = {}
-    for rid in ("replica-0", "replica-1"):
-        registry = MetricsRegistry()
-        metrics = ServingMetrics(_stub_engine(), registry=registry)
-        metrics.requests.inc(status="stop")
-        metrics.ttft.observe(0.05)
-        expositions[rid] = registry.expose()
-    problems = [f"federation: {p}" for p in lint_federation(expositions)]
-    merged = federate_expositions(expositions)
-    problems += [f"federated exposition: {p}" for p in lint_exposition(merged)]
-    return problems
+from tools.analyze.runtime_metrics import (  # noqa: E402,F401 — re-exported API
+    _stub_engine,
+    catalog_exposition,
+    federation_problems,
+)
 
 
 def main() -> int:
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
     from paddlenlp_tpu.observability import lint_exposition, parse_prometheus_text
 
     if "--file" in sys.argv:
