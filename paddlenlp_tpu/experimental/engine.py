@@ -296,6 +296,56 @@ class InferenceEngine:
         req.finish_t = time.time()
         self._spec_rngs.pop(req.req_id, None)
 
+    def release_request(self, req_id: int) -> bool:
+        """Drop a request from the scheduler (waiting queue or its slot) and
+        free its KV blocks WITHOUT touching its finish fields — the serving
+        supervisor's slot-level quarantine, where the supervisor (not the
+        engine) owns the request's resolution. Unlike :meth:`abort` this never
+        fabricates a ``finish_reason`` and fires no callback; unlike
+        :meth:`reset` it leaves every other slot untouched, so unaffected
+        streams keep decoding. Returns True iff the engine held the request."""
+        for i, req in enumerate(self.waiting):
+            if req.req_id == req_id:
+                # waiting requests hold no KV blocks (allocation happens at
+                # admission; preemption frees before requeue)
+                del self.waiting[i]
+                self._spec_rngs.pop(req_id, None)
+                return True
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.req_id == req_id:
+                self._free_kv(req)
+                self.slots[slot] = None
+                self._spec_rngs.pop(req_id, None)
+                return True
+        self._spec_rngs.pop(req_id, None)
+        if req_id in self.mgr.lengths:
+            # allocated but bound to no slot yet: the failure escaped mid-
+            # admission, between KV allocation and the slot write — the
+            # blocks are real even though the scheduler never saw the request
+            self.mgr.free_seq(req_id)
+            return True
+        # already retired (finish raced the failure): nothing held
+        return False
+
+    def resync_counts(self):
+        """Re-seed the device-side penalty counts of every live slot from
+        host-known token history (``prompt[:prefilled_len] + output_ids``).
+        The supervisor's slot quarantine calls this after releasing a
+        poisoned request: the failed step may have committed count updates
+        on device for tokens whose host-side emit never ran — those tokens
+        will be regenerated from host state, and without the resync a
+        penalty-sampling neighbor would see them double-counted."""
+        entries, slot_idx = [], []
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            hist = np.concatenate([req.prompt_ids[: req.prefilled_len],
+                                   np.asarray(req.output_ids, np.int32)])
+            entries.append((len(slot_idx), hist, len(hist)))
+            slot_idx.append(slot)
+        if slot_idx:
+            self.backend.seed_counts(slot_idx, entries)
+
     def clear_prefix_cache(self):
         """Invalidate every cached prefix block (idle ones return to the free
         list). Required after a weight update: cached KV is only valid under
@@ -921,14 +971,25 @@ class InferenceEngine:
                 self.mgr.shrink(req.req_id, req.total_len)
 
     def _emit(self, req: Request, tok: int):
-        if req.first_token_t is None:
-            req.first_token_t = time.time()
-        req.output_ids.append(tok)
-        is_eos = tok in self.eos_ids
-        hit_max = req.gen_offset + len(req.output_ids) >= req.sampling.max_new_tokens
-        req.done = is_eos or hit_max
-        if req.done:
-            req.finish_t = time.time()
-            req.finish_reason = "stop" if is_eos else "length"
-        if req.stream_cb is not None:
-            req.stream_cb(tok, req.done)
+        try:
+            if req.first_token_t is None:
+                req.first_token_t = time.time()
+            req.output_ids.append(tok)
+            is_eos = tok in self.eos_ids
+            hit_max = req.gen_offset + len(req.output_ids) >= req.sampling.max_new_tokens
+            req.done = is_eos or hit_max
+            if req.done:
+                req.finish_t = time.time()
+                req.finish_reason = "stop" if is_eos else "length"
+            if req.stream_cb is not None:
+                req.stream_cb(tok, req.done)
+        except Exception as e:
+            # per-request host failure (a poisoned stream callback, broken
+            # sampling bookkeeping): attribute it so the serving supervisor
+            # can quarantine THIS slot instead of rebuilding the whole engine
+            if getattr(e, "req_id", None) is None:
+                try:
+                    e.req_id = req.req_id
+                except Exception:
+                    pass
+            raise

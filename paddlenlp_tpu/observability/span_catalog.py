@@ -38,6 +38,7 @@ SPAN_CATALOG = {
     # ------------------------------------------------------------- engine loop / supervisor
     "engine_failure": "instant: engine.step() raised; the loop is entering DEGRADED",
     "engine_degraded": "one DEGRADED window: triage -> backoff -> rebuild -> requeue",
+    "slot_quarantine": "one slot-level partial recovery: poisoned request released + failed, engine kept running",
     "request": "retrospective whole-request span (arrival -> finish) under the request's trace id",
     "queue": "retrospective per-request wait from arrival to slot admission",
     # ------------------------------------------------------------- scheduler
@@ -48,6 +49,8 @@ SPAN_CATALOG = {
     "reroute": "instant: attempt moved to the next candidate before anything was relayed",
     "failover": "accepted-then-failed pre-token resubmission onto another replica",
     "replica_state": "instant: pool state machine moved a replica (prev -> state)",
+    "membership": "instant: replica membership event (op=add/drain/drained/drain_expired/drain_evict/remove)",
+    "hedge": "instant: hedged-stream lifecycle event (outcome=fired/capped/primary_won/hedge_won/failed)",
     # ------------------------------------------------------------- serving api
     "trace_adopted": "instant: replica adopted an inbound router traceparent instead of minting req-N",
     # ------------------------------------------------------------- trainer

@@ -66,6 +66,7 @@ __all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy"]
 _END = object()  # token-queue sentinel: stream closed
 
 _F_REBUILD = FaultPoint("engine.rebuild")
+_F_SLOT_REBUILD = FaultPoint("engine.slot_rebuild")
 
 
 @dataclasses.dataclass
@@ -78,13 +79,23 @@ class SupervisorPolicy:
     backoff is exponential in the consecutive-failure count, capped at
     ``backoff_max_s``; a healthy stretch of ``failure_reset_s`` resets the
     count. ``max_rebuild_attempts=None`` keeps trying forever — the circuit
-    breaker (503) is the pressure valve, not loop death."""
+    breaker (503) is the pressure valve, not loop death.
+
+    ``max_slot_quarantines`` bounds *partial* recovery: a step failure the
+    engine attributed to ONE request (the exception carries a ``req_id``)
+    quarantines only that slot — its KV blocks are released, its handle
+    resolves ``engine_error``, and the loop resumes without degrading — up to
+    this many consecutive quarantines inside a ``failure_reset_s`` window.
+    Past the bound (or when attribution is absent) the full degrade/rebuild
+    path runs: repeated "single bad request" failures in a tight window
+    usually mean the engine itself is poisoned."""
 
     max_retries: int = 2
     backoff_base_s: float = 0.25
     backoff_max_s: float = 10.0
     failure_reset_s: float = 60.0
     max_rebuild_attempts: Optional[int] = None
+    max_slot_quarantines: int = 3
 
 
 class _FailedRequest:
@@ -233,6 +244,10 @@ class ServingMetrics:
         self.request_retries = r.counter(
             "paddlenlp_serving_request_retries_total",
             "In-flight requests requeued across an engine rebuild")
+        self.slot_quarantines = r.counter(
+            "paddlenlp_serving_slot_quarantines_total",
+            "Poisoned requests quarantined by slot-level partial recovery "
+            "(KV released, handle failed, engine kept running)")
         self.ttft = r.histogram(
             "paddlenlp_serving_ttft_seconds", "Time from arrival to first token")
         self.queue_wait = r.histogram(
@@ -397,6 +412,12 @@ class EngineLoop:
         self._phase = "init"  # last loop phase (join-failure diagnostics)
         self._consecutive_failures = 0
         self._last_failure_t = 0.0
+        # slot-level quarantine accounting (loop-thread only, like the above):
+        # the streak escalates to a full rebuild at max_slot_quarantines;
+        # slot_quarantines is the monotone total /health reports
+        self._quarantine_streak = 0
+        self._last_quarantine_t = 0.0
+        self.slot_quarantines = 0
         self._retry_after_hint = self.policy.backoff_base_s
         self._trace_seq = itertools.count()
         # /debug/requests tail: finished-request summaries (appended only on
@@ -541,7 +562,11 @@ class EngineLoop:
 
     # ------------------------------------------------------------- supervisor
     def _supervise(self, exc: Exception):
-        """DEGRADED transition: triage in-flight work, rebuild, requeue, resume."""
+        """Recover from a step failure: slot-level quarantine when the engine
+        attributed it to one poisoned request, otherwise the full DEGRADED
+        transition (triage in-flight work, rebuild, requeue, resume)."""
+        if self._try_quarantine(exc):
+            return
         now = time.time()
         if now - self._last_failure_t > self.policy.failure_reset_s:
             self._consecutive_failures = 0
@@ -601,6 +626,90 @@ class EngineLoop:
                 f"(requeued {n_requeued}, failed {n_failed}, attempts {attempt + 1})")
             return
 
+    def _try_quarantine(self, exc: Exception) -> bool:
+        """Slot-level partial recovery: when the engine attributed the step
+        failure to ONE request (``exc.req_id``), release only that request's
+        slot + KV blocks, resolve its handle, sweep up any requests the same
+        step had already finished, and resume — the loop never leaves
+        ``running``, unaffected streams never pause, and the scheduler's 503
+        circuit breaker never trips. Returns True when fully handled; False
+        escalates to the full degrade/rebuild path."""
+        req_id = getattr(exc, "req_id", None)
+        release = getattr(self.engine, "release_request", None)
+        if req_id is None or release is None:
+            return False
+        t0 = time.time()
+        if t0 - self._last_quarantine_t > self.policy.failure_reset_s:
+            self._quarantine_streak = 0
+        if self._quarantine_streak >= self.policy.max_slot_quarantines:
+            logger.error(
+                f"req {req_id}: poisoned, but {self._quarantine_streak} slots were "
+                "already quarantined this window — escalating to a full rebuild")
+            return False
+        handle = self._handles.pop(req_id, None)
+        if handle is None:
+            return False
+        self._phase = "slot_quarantine"
+        try:
+            _F_SLOT_REBUILD.fire(req_id=req_id)
+            release(req_id)
+            # the failed step may have committed device-side penalty-count
+            # updates for tokens whose host emit never ran (they regenerate
+            # from host state next step) — resync survivors' counts from
+            # host truth so penalty-sampling neighbors don't double-count
+            resync = getattr(self.engine, "resync_counts", None)
+            if resync is not None:
+                resync()
+        except Exception as rebuild_exc:
+            # the slot itself cannot be rebuilt: put the handle back so the
+            # full path's triage owns its disposition
+            self._handles[req_id] = handle
+            logger.error(f"slot quarantine of req {req_id} failed: {rebuild_exc!r}; "
+                         "escalating to full rebuild")
+            return False
+        self._quarantine_streak += 1
+        self._last_quarantine_t = t0
+        self.slot_quarantines += 1
+        self.metrics.slot_quarantines.inc()
+        self._last_token_t.pop(req_id, None)
+        streamed = list(handle._streamed)
+        reason = self._closed_stream_reason(handle, streamed) \
+            or ("abort" if handle._cancelled else "engine_error")
+        self._resolve_failed(handle, streamed, finish_reason=reason)
+        # requests the failed step had already finished (done=True streamed,
+        # engine-side state retired) lost only their resolution when step()
+        # raised before returning them — resolve them as the completions
+        # their clients already saw, exactly triage's closed-stream rule
+        swept = 0
+        for h in list(self._handles.values()):
+            if h.done() or not h._stream_closed:
+                continue
+            release(h.req_id)  # no-op when the engine already retired it
+            self._handles.pop(h.req_id, None)
+            self._last_token_t.pop(h.req_id, None)
+            s = list(h._streamed)
+            self._resolve_failed(h, s, finish_reason=self._closed_stream_reason(h, s) or "stop")
+            swept += 1
+        TRACER.add_span("slot_quarantine", t0, time.time() - t0, cat="engine_loop",
+                        wall=True, req_id=req_id, error=repr(exc),
+                        streak=self._quarantine_streak, swept=swept)
+        logger.warning(
+            f"req {req_id}: quarantined after per-request failure ({exc!r}); "
+            f"slot rebuilt, engine kept running ({len(self._handles)} unaffected)")
+        return True
+
+    @staticmethod
+    def _closed_stream_reason(handle: RequestHandle, streamed: List[int]) -> Optional[str]:
+        """Terminal reason for a handle whose stream already delivered its
+        done=True token (or full budget) — the crash ate only the finish
+        bookkeeping. None when the stream is still open."""
+        max_new = getattr(handle._sampling, "max_new_tokens", None)
+        if max_new is not None and len(streamed) >= max_new:
+            return "length"
+        if handle._stream_closed:
+            return "stop"
+        return None
+
     def _triage(self, exc: Exception) -> int:
         """Split in-flight handles into the requeue stash and immediate
         ``engine_error`` resolutions, per the retry policy. Returns the number
@@ -612,14 +721,12 @@ class EngineLoop:
             limit = handle.max_retries if handle.max_retries is not None \
                 else self.policy.max_retries
             streamed = list(handle._streamed)
-            max_new = getattr(handle._sampling, "max_new_tokens", None)
             # a request whose stream already delivered its done=True token
             # (EOS or full budget) just needs its resolution — the crash ate
             # only the finish bookkeeping; requeueing it would generate PAST
             # the end of a completed sequence
-            if handle._stream_closed or (max_new is not None and len(streamed) >= max_new):
-                reason = "length" if (max_new is not None and len(streamed) >= max_new) \
-                    else "stop"
+            reason = self._closed_stream_reason(handle, streamed)
+            if reason is not None:
                 self._resolve_failed(handle, streamed, finish_reason=reason)
                 continue
             # a cancel that raced the crash is still a cancel, not an engine

@@ -138,6 +138,13 @@ class Gauge(_Metric):
             raise ValueError(f"gauge {self.name}: set_function needs a label-less gauge")
         self._fn = fn
 
+    def remove_series(self, **labels):
+        """Drop one labeled series from the exposition — for label values
+        that name entities with a bounded lifetime (a removed replica): a
+        gauge pinned to its last value would read as a live fact forever."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def value(self, **labels) -> float:
         if self._fn is not None:
             return float(self._fn())

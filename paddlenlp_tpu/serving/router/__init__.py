@@ -28,8 +28,12 @@ from .policy import (  # noqa: F401
 from .pool import (  # noqa: F401
     DEGRADED,
     DOWN,
+    DRAINED,
+    DRAINING,
     HEALTHY,
     RECOVERING,
+    REMOVED,
+    DrainPendingError,
     ProbeResult,
     Replica,
     ReplicaPool,
@@ -58,4 +62,8 @@ __all__ = [
     "DEGRADED",
     "DOWN",
     "RECOVERING",
+    "DRAINING",
+    "DRAINED",
+    "REMOVED",
+    "DrainPendingError",
 ]

@@ -70,6 +70,16 @@ class RouterMetrics:
             "paddlenlp_router_fleet_scrape_errors_total",
             "Replica /metrics scrapes that failed during federation",
             labelnames=("replica",))
+        self.hedges = r.counter(
+            "paddlenlp_router_hedges_total",
+            "Hedged stream attempts by outcome: primary_won/hedge_won (the "
+            "shadow fired and lost/won the first-token race), capped (the "
+            "in-flight-hedge cap suppressed it), failed (both legs died)",
+            labelnames=("outcome",))
+        self.membership_changes = r.counter(
+            "paddlenlp_router_membership_changes_total",
+            "Admin-plane replica membership mutations by op (add/drain/remove)",
+            labelnames=("op",))
 
 
 # ----------------------------------------------------------------- federation

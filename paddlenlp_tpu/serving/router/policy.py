@@ -49,7 +49,10 @@ def load_score(snap: ReplicaSnapshot) -> float:
 
 def _eligible(snapshots: Iterable[ReplicaSnapshot],
               exclude: FrozenSet[str]) -> List[ReplicaSnapshot]:
-    return [s for s in snapshots if s.state != DOWN and s.id not in exclude]
+    # DOWN is unreachable; a draining replica is healthy but leaving — it
+    # finishes its in-flight streams and must never be offered NEW requests
+    return [s for s in snapshots
+            if s.state != DOWN and not s.draining and s.id not in exclude]
 
 
 class LeastLoadedPolicy:

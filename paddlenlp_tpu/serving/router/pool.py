@@ -27,15 +27,34 @@ The proxy feeds forwarding observations back through
 so a mid-stream incident demotes the replica immediately instead of waiting a
 poll interval.
 
+**Live membership.** The replica set is no longer fixed at launch:
+:meth:`ReplicaPool.add` registers a replica at runtime, :meth:`start_drain`
+flips one to *draining* (the policy layer stops offering it; in-flight
+streams finish), and :meth:`remove` takes a drained (or DOWN, or ``force``)
+replica out, leaving a tombstone :meth:`drain_status` reports as
+``removed``. Drain progress is driven from the poll sweep
+(:meth:`_check_drains`): the owning router supplies ``drain_live`` (its own
+open-forward count per replica — authoritative for router-fronted traffic)
+and ``on_drain_deadline`` (called once when a drain outlives its deadline so
+the router can fail the stuck token-less streams over). All three mutation
+paths run through the ``router.membership`` fault point *before* touching
+state, so an injected failure leaves the set exactly as it was.
+
 **Concurrency model.** Three kinds of thread touch the pool: the poller
-(``_run``/``poll_once``), HTTP proxy threads (``snapshots``/``get``/
-``note_*``), and whoever mutates membership (``add``). The replica list and
-id map are guarded by ``_lock`` (``# guarded-by:`` annotations, enforced by
-``tools/analyze``); per-``Replica`` fields are written ONLY inside
-``_apply`` under that same pool lock, and read by other threads only through
+(``_run``/``poll_once``/``_check_drains``), HTTP proxy threads
+(``snapshots``/``get``/``note_*``), and whoever mutates membership
+(``add``/``start_drain``/``remove`` — admin-plane HTTP threads). The replica
+list, id map and removal tombstones are guarded by ``_lock`` (``#
+guarded-by:`` annotations, enforced by ``tools/analyze``); per-``Replica``
+fields are written ONLY under that same pool lock (``_apply`` for health
+fields, ``start_drain``/``_check_drains`` for the drain fields — including
+``drain_expired_notified``, whose locked check-and-set is what makes the
+deadline hook fire exactly once), and read by other threads only through
 :meth:`Replica.snapshot`, which ``snapshots()`` calls under the lock. The
-one exception is ``Replica.polls``/``_offset_samples``, touched solely by
-the poller thread inside ``_probe`` (single-thread confinement, no lock).
+exceptions are ``Replica.polls``/``_offset_samples``: normally
+poller-confined, but ``poll_once`` may also be driven by admin/launcher
+threads — both fields tolerate the rare concurrent sweep (a lost ``polls``
+increment only jitters the kv-scrape cadence; deque appends are atomic).
 """
 
 from __future__ import annotations
@@ -46,22 +65,33 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ...observability.tracer import TRACER
 from ...utils.faults import FaultPoint
 from ...utils.log import logger
 from .metrics import RouterMetrics
 
-__all__ = ["HEALTHY", "DEGRADED", "DOWN", "RECOVERING", "Replica",
-           "ReplicaSnapshot", "ProbeResult", "ReplicaPool"]
+__all__ = ["HEALTHY", "DEGRADED", "DOWN", "RECOVERING", "DRAINING", "DRAINED",
+           "REMOVED", "Replica", "ReplicaSnapshot", "ProbeResult", "ReplicaPool",
+           "DrainPendingError"]
 
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 DOWN = "down"
 RECOVERING = "recovering"
+# drain lifecycle strings (drain_status / admin plane; `draining` is a flag
+# ORTHOGONAL to the health state — a draining replica still health-polls)
+DRAINING = "draining"
+DRAINED = "drained"
+REMOVED = "removed"
 
 _F_HEALTH_POLL = FaultPoint("router.health_poll")
+_F_MEMBERSHIP = FaultPoint("router.membership")
+
+
+class DrainPendingError(RuntimeError):
+    """remove() refused: the replica has not finished draining (HTTP 409)."""
 
 KV_UTILIZATION_METRIC = "paddlenlp_serving_kv_utilization"
 
@@ -100,6 +130,8 @@ class ReplicaSnapshot:
     consecutive_failures: int
     last_poll_t: Optional[float]
     clock_offset_s: Optional[float] = None  # replica tracer time - router tracer time
+    draining: bool = False  # membership: no NEW requests; in-flight finish
+    drained: bool = False  # drain complete — safe to remove
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -126,6 +158,11 @@ class Replica:
         self.last_poll_t: Optional[float] = None
         self.last_error: Optional[str] = None
         self.polls = 0  # probe count (drives the kv-scrape cadence)
+        # drain lifecycle (written under the pool lock; see module docstring)
+        self.draining = False
+        self.drained = False
+        self.drain_deadline_t: Optional[float] = None
+        self.drain_expired_notified = False  # poller-thread confined
         # clock skew vs the router, for cross-tier trace stitching: each probe
         # yields (rtt, offset); the lowest-RTT sample in the window wins (the
         # midpoint assumption — request and response legs symmetric — is most
@@ -143,7 +180,8 @@ class Replica:
             inflight=self.inflight, queue_depth=self.queue_depth,
             kv_utilization=self.kv_utilization, retry_after_s=self.retry_after_s,
             consecutive_failures=self.consecutive_failures, last_poll_t=self.last_poll_t,
-            clock_offset_s=self.clock_offset_s)
+            clock_offset_s=self.clock_offset_s, draining=self.draining,
+            drained=self.drained)
 
 
 class ReplicaPool:
@@ -172,21 +210,127 @@ class ReplicaPool:
         self._lock = threading.Lock()
         self._replicas: List[Replica] = []  # guarded-by: _lock
         self._by_id: Dict[str, Replica] = {}  # guarded-by: _lock
+        self._removed: Dict[str, Dict] = {}  # guarded-by: _lock
+        # bounded: an autoscaler cycling replicas on ephemeral ports mints a
+        # fresh id per scale-down — without a cap the tombstones (and every
+        # GET /replicas response) would grow for the router's whole lifetime
+        self._removed_cap = 256
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # membership hooks the owning router wires up: drain_live(replica_id)
+        # -> the router's own open-forward count (authoritative for drain
+        # completion — probe inflight is the fallback for a poolside-only
+        # deployment); on_drain_deadline(replica_id) fires ONCE when a drain
+        # outlives its deadline (the router fails stuck streams over)
+        self.drain_live: Optional[Callable[[str], int]] = None
+        self.on_drain_deadline: Optional[Callable[[str], None]] = None
 
     # ------------------------------------------------------------- membership
     def add(self, host: str, port: int, replica_id: Optional[str] = None) -> Replica:
         rid = replica_id or f"{host}:{port}"
+        _F_MEMBERSHIP.fire(op="add", replica=rid)
         with self._lock:
             if rid in self._by_id:
                 raise ValueError(f"replica {rid!r} already registered")
             replica = Replica(rid, host, port)
             self._replicas.append(replica)
             self._by_id[rid] = replica
+            # re-adding a previously-removed id revives it: drop the tombstone
+            self._removed.pop(rid, None)
         if self.metrics is not None:
             self.metrics.replica_healthy.set(1.0, replica=rid)
+        self.tracer.instant("membership", cat="router", op="add", replica=rid)
         return replica
+
+    def start_drain(self, replica_id: str, deadline_s: float = 30.0) -> Dict:
+        """Flip a replica to draining: the policy layer stops offering it,
+        in-flight streams finish, and once the router reports zero live
+        forwards the poll sweep marks it ``drained`` (removable). Past
+        ``deadline_s`` the ``on_drain_deadline`` hook fires once so the owner
+        can fail stuck token-less streams over. Idempotent: re-draining an
+        already-draining replica only tightens/extends the deadline."""
+        _F_MEMBERSHIP.fire(op="drain", replica=replica_id)
+        with self._lock:
+            replica = self._by_id.get(replica_id)
+            if replica is None:
+                raise KeyError(f"unknown replica {replica_id!r}")
+            replica.draining = True
+            replica.drained = False
+            replica.drain_deadline_t = time.time() + max(float(deadline_s), 0.0)
+            replica.drain_expired_notified = False
+        logger.warning(f"router: replica {replica_id} draining "
+                       f"(deadline {deadline_s:.1f}s)")
+        self.tracer.instant("membership", cat="router", op="drain",
+                            replica=replica_id, deadline_s=deadline_s)
+        return self.drain_status(replica_id)
+
+    def remove(self, replica_id: str, force: bool = False) -> Dict:
+        """Take a replica out of the pool. Refused (:class:`DrainPendingError`)
+        unless it finished draining, is DOWN, or ``force`` — live streams on a
+        force-removed replica keep relaying (the router holds its own upstream
+        connections) but lose failover-by-exclusion bookkeeping. Leaves a
+        tombstone ``drain_status`` reports as ``removed``; idempotent on an
+        already-removed id."""
+        _F_MEMBERSHIP.fire(op="remove", replica=replica_id)
+        with self._lock:
+            replica = self._by_id.get(replica_id)
+            if replica is None:
+                if replica_id in self._removed:
+                    return dict(self._removed[replica_id])
+                raise KeyError(f"unknown replica {replica_id!r}")
+            if not (force or replica.drained or replica.state == DOWN):
+                raise DrainPendingError(
+                    f"replica {replica_id!r} is not drained "
+                    f"(draining={replica.draining}, state={replica.state}); "
+                    "drain it first or pass force")
+            self._replicas.remove(replica)
+            del self._by_id[replica_id]
+            tomb = {"id": replica_id, "state": REMOVED, "removed_t": time.time(),
+                    "was_drained": replica.drained, "forced": bool(force)}
+            self._removed[replica_id] = tomb
+            while len(self._removed) > self._removed_cap:  # oldest-first trim
+                self._removed.pop(next(iter(self._removed)))
+        if self.metrics is not None:
+            # drop, don't zero: a pinned replica_healthy{removed-id}=0 series
+            # would alert as "unhealthy replica" forever and leak one series
+            # per scale-down under autoscaler churn
+            self.metrics.replica_healthy.remove_series(replica=replica_id)
+        logger.warning(f"router: replica {replica_id} removed from the pool"
+                       + (" (forced)" if force else ""))
+        self.tracer.instant("membership", cat="router", op="remove",
+                            replica=replica_id, forced=force)
+        return dict(tomb)
+
+    def removed(self) -> List[Dict]:
+        """Removal tombstones (admin-plane listing)."""
+        with self._lock:
+            return [dict(t) for t in self._removed.values()]
+
+    def is_draining(self, replica_id: str) -> bool:
+        with self._lock:
+            replica = self._by_id.get(replica_id)
+            return replica is not None and replica.draining
+
+    def drain_status(self, replica_id: str) -> Optional[Dict]:
+        """Drain lifecycle view of one replica: ``draining`` → ``drained`` →
+        ``removed`` (tombstone), or the plain health state when no drain is in
+        progress. None for ids the pool has never seen."""
+        with self._lock:
+            if replica_id in self._removed:
+                return dict(self._removed[replica_id])
+            replica = self._by_id.get(replica_id)
+            if replica is None:
+                return None
+            if replica.draining:
+                state = DRAINED if replica.drained else DRAINING
+            else:
+                state = replica.state
+            return {
+                "id": replica_id, "state": state, "draining": replica.draining,
+                "drained": replica.drained,
+                "deadline_in_s": None if replica.drain_deadline_t is None
+                else replica.drain_deadline_t - time.time(),
+            }
 
     def __len__(self) -> int:
         with self._lock:
@@ -238,6 +382,69 @@ class ReplicaPool:
                 # junk body — all the same to the state machine: unreachable
                 result = ProbeResult(reachable=False, error=repr(e))
             self._apply(replica, result)
+        self._check_drains()
+
+    def probe_one(self, replica_id: str):
+        """Probe a single replica synchronously (the admin plane's
+        join-before-serve check) — unlike :meth:`poll_once` this does not
+        sweep drains, so an HTTP thread can call it without racing the
+        poller's drain bookkeeping."""
+        replica = self.get(replica_id)
+        if replica is None:
+            return
+        try:
+            result = self._probe(replica)
+        except Exception as e:
+            result = ProbeResult(reachable=False, error=repr(e))
+        self._apply(replica, result)
+
+    def _check_drains(self):
+        """Advance every in-progress drain: mark it ``drained`` when the owner
+        reports zero live forwards (probe inflight as the fallback), and fire
+        the deadline hook once when it has outlived its deadline. Runs on the
+        poller thread (or inside a manual ``poll_once``)."""
+        with self._lock:
+            draining = [r for r in self._replicas if r.draining and not r.drained]
+        now = time.time()
+        for replica in draining:
+            live = None
+            if self.drain_live is not None:
+                try:
+                    live = int(self.drain_live(replica.id))
+                except Exception as e:
+                    logger.warning(f"router: drain_live({replica.id}) failed: {e!r}")
+            if live is None:
+                # poolside fallback: the replica's own /health inflight — an
+                # unreachable replica reads 0 (its streams are breaking anyway
+                # and will fail over through the normal forward path)
+                live = replica.inflight if replica.state != DOWN else 0
+            if live == 0:
+                with self._lock:
+                    replica.drained = True
+                logger.warning(f"router: replica {replica.id} drained "
+                               "(no live streams); safe to remove")
+                self.tracer.instant("membership", cat="router", op="drained",
+                                    replica=replica.id)
+            elif (replica.drain_deadline_t is not None
+                  and now >= replica.drain_deadline_t):
+                # check-and-set under the pool lock: poll_once may be driven
+                # by the poller AND by admin/launcher threads, and the
+                # deadline hook must fire exactly once per drain
+                with self._lock:
+                    if replica.drain_expired_notified or not replica.draining:
+                        continue
+                    replica.drain_expired_notified = True
+                logger.warning(
+                    f"router: drain of {replica.id} outlived its deadline with "
+                    f"{live} live stream(s); failing stuck streams over")
+                self.tracer.instant("membership", cat="router", op="drain_expired",
+                                    replica=replica.id, live=live)
+                if self.on_drain_deadline is not None:
+                    try:
+                        self.on_drain_deadline(replica.id)
+                    except Exception as e:
+                        logger.warning(
+                            f"router: drain-deadline hook for {replica.id} failed: {e!r}")
 
     def _probe(self, replica: Replica) -> ProbeResult:
         """GET /health (+ /metrics kv_utilization) of one replica. Raises on

@@ -29,6 +29,26 @@ whichever replica currently owns the stream. The router's own observability
 plane (``/metrics``, ``/health``, ``/debug/trace``) rides on the shared
 registry/tracer machinery.
 
+**Elastic membership (admin plane).** ``GET/POST /replicas``, ``POST
+/replicas/drain`` and ``DELETE /replicas/{id}`` mutate the fleet live: a
+joined replica is probed before it serves, a draining replica stops
+receiving new requests while its in-flight streams finish (the router's own
+open-forward count is the completion signal; a drain that outlives its
+deadline fails the stuck token-less streams over via the ordinary pre-token
+resubmit path — the client's SSE connection never notices), and removal is
+refused with 409 until the drain lands. Membership mutations run through the
+``router.membership`` fault point before any state changes.
+
+**Request hedging.** With ``hedge_after_s`` set, a streaming request whose
+primary forward produced no first event inside the budget races a shadow
+forward on the next ring candidate: both legs parse into a shared queue,
+nothing reaches the client until one leg produces a usable event, the winner
+relays and the loser is aborted (socket close + ``/v1/abort``). Bounded by
+``max_hedges_inflight``; counted in ``paddlenlp_router_hedges_total{outcome}``.
+Deterministic (greedy / fixed-seed) sampling hedges token-exactly; hedging
+free-running sampled requests serves whichever stream wins (see the README
+for when not to hedge).
+
 **Fleet observability.** The router is where per-process planes become one:
 
 - every forward carries a traceparent-style header (trace id + parent span id
@@ -54,12 +74,15 @@ import dataclasses
 import http.client
 import itertools
 import json
+import math
+import queue
+import socket
 import threading
 import time
 from collections import OrderedDict
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
-from urllib.parse import parse_qs, quote, urlsplit
+from urllib.parse import parse_qs, quote, unquote, urlsplit
 
 from ...observability.exporter import route_observability
 from ...observability.slo import (
@@ -85,7 +108,15 @@ from ..metrics import REGISTRY, MetricsRegistry
 from ...observability.prometheus import parse_prometheus_text
 from .metrics import RouterMetrics, federate_families
 from .policy import resolve_policy
-from .pool import DEGRADED, DOWN, HEALTHY, RECOVERING, ReplicaPool, ReplicaSnapshot
+from .pool import (
+    DEGRADED,
+    DOWN,
+    HEALTHY,
+    RECOVERING,
+    DrainPendingError,
+    ReplicaPool,
+    ReplicaSnapshot,
+)
 
 __all__ = ["RouterServer"]
 
@@ -98,13 +129,77 @@ _F_FORWARD = FaultPoint("router.forward")
 _UPSTREAM_ERRORS = (OSError, http.client.HTTPException, InjectedFault)
 
 
+def _force_close(conn, resp=None):
+    """Tear down an upstream leg from ANOTHER thread. A plain ``close()``
+    only drops the fd — a reader blocked in ``recv`` stays blocked;
+    ``shutdown()`` is what actually wakes it with an error. The socket may
+    live on the connection (keep-alive) or — after ``getresponse()`` on a
+    will-close SSE response — only on the response's reader, so both are
+    tried."""
+    socks = [getattr(conn, "sock", None)] if conn is not None else []
+    if resp is not None:
+        raw = getattr(getattr(resp, "fp", None), "raw", None)
+        socks.append(getattr(raw, "_sock", None))
+    for sock in socks:
+        if sock is None:
+            continue
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+    for obj in (resp, conn):
+        if obj is None:
+            continue
+        try:
+            obj.close()
+        except Exception:
+            pass
+
+
+def _read_sse_events(resp):
+    """Parse one upstream SSE leg into ``("event", dict)`` / ``("done", None)``
+    / ``("broke", err|None)`` items. "broke" covers transport errors AND a
+    close without ``[DONE]`` (a crash, not a completion); the iterator always
+    ends with a non-"event" item. ValueError joins the transport errors here
+    because the connection may be closed under the reader on purpose (drain
+    eviction, hedge-loser teardown)."""
+    while True:
+        try:
+            line = resp.readline()
+        except _UPSTREAM_ERRORS + (ValueError, AttributeError) as e:
+            # ValueError/AttributeError: the response was closed UNDER the
+            # reader (concurrent teardown races http.client's own close)
+            yield ("broke", e)
+            return
+        if not line:
+            yield ("broke", None)
+            return
+        line = line.strip()
+        if not line.startswith(b"data: "):
+            continue
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            yield ("done", None)
+            return
+        try:
+            ev = json.loads(data)
+        except ValueError:
+            continue
+        yield ("event", ev)
+
+
 class _RelayState:
     """Per-request relay bookkeeping shared across forward attempts. One
-    instance per client request, touched only by that request's handler
-    thread — no locking needed."""
+    instance per client request, written only by that request's handler
+    thread. The drain enforcer (poller thread) additionally READS
+    ``replica_id``/``tokens_relayed`` and closes ``upstream_conn`` to break a
+    stuck read on a past-deadline draining replica — closing a socket that
+    just finished or was replaced is a benign no-op, so these cross-thread
+    touches need no lock."""
 
     __slots__ = ("rid", "stream", "headers_sent", "tokens_relayed", "arrival_t",
-                 "attempts", "finished", "sampled")
+                 "attempts", "finished", "sampled", "replica_id", "upstream_conn",
+                 "upstream_resp", "upstream_cid")
 
     def __init__(self, rid: str, stream: bool, sampled: bool = True):
         self.rid = rid
@@ -115,6 +210,10 @@ class _RelayState:
         self.attempts = 0
         self.finished = False  # a finish_reason chunk was relayed to the client
         self.sampled = sampled  # head-based trace sampling decision
+        self.replica_id: Optional[str] = None  # replica of the current attempt
+        self.upstream_conn = None  # live upstream HTTPConnection (drain eviction)
+        self.upstream_resp = None  # its HTTPResponse (owns the socket once read)
+        self.upstream_cid: Optional[str] = None  # upstream cmpl-N id once seen
 
 
 class RouterServer:
@@ -129,11 +228,17 @@ class RouterServer:
                  tracer: Optional[SpanTracer] = None,
                  slo_objectives: Optional[SLOObjectives] = None,
                  slo_windows_s: Sequence[float] = DEFAULT_WINDOWS_S,
-                 scrape_timeout_s: float = 5.0):
+                 scrape_timeout_s: float = 5.0,
+                 hedge_after_s: Optional[float] = None,
+                 max_hedges_inflight: int = 4):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if trace_sample_every < 1:
             raise ValueError("trace_sample_every must be >= 1")
+        if hedge_after_s is not None and hedge_after_s <= 0:
+            raise ValueError("hedge_after_s must be > 0 (None disables hedging)")
+        if max_hedges_inflight < 0:
+            raise ValueError("max_hedges_inflight must be >= 0")
         self.registry = registry or REGISTRY
         # a private tracer keeps router spans out of in-process replicas' rings
         # (the launcher passes one); a dedicated router process uses the global
@@ -154,9 +259,23 @@ class RouterServer:
         self.max_attempts = max_attempts
         self.max_body_bytes = max_body_bytes
         self.upstream_timeout_s = upstream_timeout_s
+        # hedging: after hedge_after_s with no first token, race a shadow
+        # request on the next candidate (None = off); the cap bounds how many
+        # shadows the router may have open at once fleet-wide
+        self.hedge_after_s = hedge_after_s
+        self.max_hedges_inflight = max_hedges_inflight
+        self._hedge_lock = threading.Lock()
+        self._hedges_inflight = 0  # guarded-by: _hedge_lock
         self._ids = itertools.count()
         self._live: Dict[str, Tuple[str, str]] = {}  # rid -> (replica_id, upstream cid)
         self._live_lock = threading.Lock()
+        # relay states with an attempt in flight (drain-deadline eviction
+        # walks this to find token-less streams on the draining replica)
+        self._active: set = set()  # guarded-by: _live_lock
+        # membership hooks: drain completion tracks the router's own open
+        # forwards; the deadline hook fails stuck token-less streams over
+        self.pool.drain_live = self._open_forwards_on
+        self.pool.on_drain_deadline = self._drain_deadline_failover
         # trace id -> owning replica, SURVIVING request finish (stitching a
         # trace is most useful after the request completed); bounded LRU
         self._trace_owner: "OrderedDict[str, str]" = OrderedDict()
@@ -194,8 +313,86 @@ class RouterServer:
 
     def _inflight_delta(self, replica_id: str, delta: int):
         with self._inflight_lock:
-            self._forward_inflight[replica_id] = \
-                self._forward_inflight.get(replica_id, 0) + delta
+            cur = self._forward_inflight.get(replica_id)
+            if cur is None and delta < 0:
+                # the replica was force-removed (entry popped) while this
+                # forward was still open: recreating the key at a negative
+                # value would poison the drain-completion signal for a
+                # re-added id of the same name
+                return
+            self._forward_inflight[replica_id] = max((cur or 0) + delta, 0)
+
+    def _open_forwards_on(self, replica_id: str) -> int:
+        """Forwards the router currently has open against one replica — the
+        pool's drain-completion signal (covers streams from accept to finish,
+        including legs that have not produced an event yet)."""
+        with self._inflight_lock:
+            return self._forward_inflight.get(replica_id, 0)
+
+    # ------------------------------------------------------------- drain eviction
+    def _drain_deadline_failover(self, replica_id: str):
+        """A drain outlived its deadline: break every TOKEN-LESS stream still
+        pinned to the draining replica so its relay takes the ordinary
+        pre-token resubmit path onto a surviving candidate (the client's SSE
+        connection never notices). Streams that already relayed tokens are
+        actively progressing and are left to finish — regenerating them
+        elsewhere would diverge the stream. Runs on the pool's poller thread."""
+        with self._live_lock:
+            victims = [(st, st.upstream_conn, st.upstream_resp, st.upstream_cid)
+                       for st in self._active
+                       if st.replica_id == replica_id and st.tokens_relayed == 0]
+        for st, conn, resp, cid in victims:
+            if st.replica_id != replica_id or st.tokens_relayed != 0:
+                # the relay moved on between the snapshot and now — failed
+                # over to a survivor, or relayed its first token (the abort
+                # call for an earlier victim can take seconds): a token-
+                # bearing stream is exactly what the drain promises to leave
+                # alone, and a failed-over one owns a new leg we must not break
+                continue
+            # relay read breaks -> pre-token failover
+            _force_close(conn, resp)
+            if cid is not None:
+                # also free the replica-side slot/KV promptly (a queued
+                # request would otherwise only notice on its first write).
+                # Off-thread: this runs on the pool's POLLER thread, and a
+                # wedged replica — the usual reason a deadline fired — would
+                # otherwise stall every health probe for the abort timeout
+                replica = self.pool.get(replica_id)
+                if replica is not None:
+                    threading.Thread(
+                        target=self._abort_replica_request,
+                        args=(replica.host, replica.port, cid),
+                        daemon=True, name=f"drain-abort-{st.rid}").start()
+            self.tracer.instant("membership", cat="router", op="drain_evict",
+                                trace=st.rid, replica=replica_id)
+
+    def _abort_replica_request(self, host: str, port: int, upstream_cid: str) -> bool:
+        """POST /v1/abort for one upstream completion id (best effort)."""
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            try:
+                conn.request("POST", "/v1/abort",
+                             body=json.dumps({"id": upstream_cid}).encode(),
+                             headers={"Content-Type": "application/json"})
+                body = json.loads(conn.getresponse().read() or b"{}")
+            finally:
+                conn.close()
+            return bool(body.get("cancelled"))
+        except _UPSTREAM_ERRORS + (ValueError,) as e:
+            logger.debug(f"router: upstream abort of {upstream_cid} failed: {e!r}")
+            return False
+
+    # ------------------------------------------------------------- hedge slots
+    def _try_start_hedge(self) -> bool:
+        with self._hedge_lock:
+            if self._hedges_inflight >= self.max_hedges_inflight:
+                return False
+            self._hedges_inflight += 1
+            return True
+
+    def _release_hedge(self):
+        with self._hedge_lock:
+            self._hedges_inflight -= 1
 
     def _finish(self, state: _RelayState, replica_id: str, outcome: str):
         self.metrics.requests.inc(replica=replica_id, outcome=outcome)
@@ -233,20 +430,10 @@ class RouterServer:
         replica = self.pool.get(replica_id)
         if replica is None:
             return False
-        try:
-            conn = http.client.HTTPConnection(replica.host, replica.port, timeout=10)
-            try:
-                conn.request("POST", "/v1/abort",
-                             body=json.dumps({"id": upstream_cid}).encode(),
-                             headers={"Content-Type": "application/json"})
-                resp = conn.getresponse()
-                body = json.loads(resp.read() or b"{}")
-            finally:
-                conn.close()
-            return bool(body.get("cancelled"))
-        except _UPSTREAM_ERRORS + (ValueError,) as e:
-            logger.warning(f"router: abort of {rid} on {replica_id} failed: {e!r}")
-            return False
+        ok = self._abort_replica_request(replica.host, replica.port, upstream_cid)
+        if not ok:
+            logger.warning(f"router: abort of {rid} on {replica_id} failed")
+        return ok
 
     # ------------------------------------------------------------- http plumbing
     def _make_httpd(self, host: str, port: int) -> ThreadingHTTPServer:
@@ -284,6 +471,9 @@ class RouterServer:
                     if parts.path == "/fleet/slo":
                         self._send_json(200, router.fleet_slo())
                         return
+                    if parts.path == "/replicas":
+                        self._send_json(200, router.admin_list_replicas())
+                        return
                     routed = route_observability(self.path, router.registry, router.tracer)
                     if routed is not None:
                         self._send_raw(routed[0], routed[2], routed[1])
@@ -310,10 +500,43 @@ class RouterServer:
                         if payload is not None:
                             ok = router.abort(str(payload.get("id", "")))
                             self._send_json(200, {"id": payload.get("id"), "cancelled": ok})
+                    elif self.path == "/replicas":
+                        payload = self._read_body()
+                        if payload is not None:
+                            code, doc = router.admin_add_replica(payload)
+                            self._send_json(code, doc)
+                    elif self.path == "/replicas/drain":
+                        payload = self._read_body()
+                        if payload is not None:
+                            code, doc = router.admin_drain_replica(payload)
+                            self._send_json(code, doc)
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")
                 except (BrokenPipeError, ConnectionResetError):
                     logger.debug("router: client disconnected during POST")
+                except Exception as e:
+                    # includes an injected router.membership fault: the admin
+                    # mutation fired BEFORE any state change, so a clean 500
+                    # here means the pool is exactly as it was
+                    logger.warning(f"router: error on {self.path}: {e!r}")
+                    try:
+                        self._send_error_json(500, str(e), "internal_error")
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
+            def do_DELETE(self):
+                try:
+                    parts = urlsplit(self.path)
+                    if parts.path.startswith("/replicas/"):
+                        rid = unquote(parts.path[len("/replicas/"):])
+                        force = parse_qs(parts.query).get("force", ["0"])[0] \
+                            in ("1", "true")
+                        code, doc = router.admin_remove_replica(rid, force=force)
+                        self._send_json(code, doc)
+                    else:
+                        self._send_error_json(404, f"no route {self.path}", "not_found")
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.debug("router: client disconnected during DELETE")
                 except Exception as e:
                     logger.warning(f"router: error on {self.path}: {e!r}")
                     try:
@@ -324,6 +547,84 @@ class RouterServer:
         httpd = ThreadingHTTPServer((host, port), Handler)
         httpd.daemon_threads = True
         return httpd
+
+    # ------------------------------------------------------------- admin plane
+    def admin_list_replicas(self) -> Dict:
+        """Live membership view: every pooled replica's snapshot + drain
+        status + the router's own open forwards, plus removal tombstones."""
+        replicas = []
+        for snap in self.pool.snapshots():
+            doc = snap.to_dict()
+            doc["drain"] = self.pool.drain_status(snap.id)
+            doc["open_forwards"] = self._open_forwards_on(snap.id)
+            replicas.append(doc)
+        return {"replicas": replicas, "removed": self.pool.removed()}
+
+    def admin_add_replica(self, payload: dict) -> Tuple[int, Dict]:
+        """POST /replicas {"host", "port", "id"?}: join a replica to the pool.
+        One synchronous poll sweep runs before the 200 so the first routing
+        decision already sees the newcomer's real health/load."""
+        host, port = payload.get("host"), payload.get("port")
+        if not host or not port:
+            return 400, {"error": {"message": "host and port are required",
+                                   "type": "invalid_request", "code": 400}}
+        try:
+            port = int(port)
+        except (TypeError, ValueError):
+            # validated BEFORE pool.add so a malformed port cannot masquerade
+            # as the duplicate-id 409 (an autoscaler treats 409 as "present")
+            return 400, {"error": {"message": f"port must be an integer, got {port!r}",
+                                   "type": "invalid_request", "code": 400}}
+        try:
+            replica = self.pool.add(str(host), port,
+                                    str(payload["id"]) if payload.get("id") else None)
+        except ValueError as e:
+            return 409, {"error": {"message": str(e),
+                                   "type": "already_registered", "code": 409}}
+        self.metrics.membership_changes.inc(op="add")
+        self.pool.probe_one(replica.id)
+        return 200, {"replica": replica.snapshot().to_dict()}
+
+    def admin_drain_replica(self, payload: dict) -> Tuple[int, Dict]:
+        """POST /replicas/drain {"id", "deadline_s"?}: stop offering the
+        replica new requests; in-flight streams finish (token-less ones are
+        failed over once the deadline expires). DELETE completes the exit."""
+        rid = str(payload.get("id", ""))
+        try:
+            deadline_s = float(payload.get("deadline_s", 30.0))
+            if not math.isfinite(deadline_s):
+                # json.loads admits NaN/Infinity, and a NaN deadline never
+                # compares past due — the drain would be un-completable
+                raise ValueError
+        except (TypeError, ValueError):
+            return 400, {"error": {
+                "message": f"deadline_s must be a finite number, got {payload.get('deadline_s')!r}",
+                "type": "invalid_request", "code": 400}}
+        try:
+            status = self.pool.start_drain(rid, deadline_s=deadline_s)
+        except KeyError:
+            return 404, {"error": {"message": f"unknown replica {rid!r}",
+                                   "type": "unknown_replica", "code": 404}}
+        self.metrics.membership_changes.inc(op="drain")
+        return 200, {"drain": status}
+
+    def admin_remove_replica(self, rid: str, force: bool = False) -> Tuple[int, Dict]:
+        """DELETE /replicas/{id}[?force=1]: take a drained (or DOWN) replica
+        out of the pool; 409 while its drain is still in progress."""
+        try:
+            tomb = self.pool.remove(rid, force=force)
+        except KeyError:
+            return 404, {"error": {"message": f"unknown replica {rid!r}",
+                                   "type": "unknown_replica", "code": 404}}
+        except DrainPendingError as e:
+            return 409, {"error": {"message": str(e),
+                                   "type": "drain_pending", "code": 409}}
+        self.metrics.membership_changes.inc(op="remove")
+        with self._inflight_lock:
+            # drop the (zero, by drain-completion) accounting entry — one
+            # leaked key per scale-down would accumulate under churn
+            self._forward_inflight.pop(rid, None)
+        return 200, {"replica": tomb}
 
     def health_status(self) -> Tuple[str, int]:
         states = {s.state for s in self.pool.snapshots()}
@@ -466,14 +767,35 @@ class RouterServer:
                 break
             cand = candidates[0]
             state.attempts += 1
-            self._inflight_delta(cand.id, +1)
+            # hedging applies to token-less streams with somewhere to hedge TO
+            hedge_cand = candidates[1] if (
+                self.hedge_after_s is not None and state.stream
+                and state.tokens_relayed == 0 and len(candidates) > 1) else None
+            state.replica_id = cand.id
+            # a fresh attempt must not inherit the previous replica's
+            # completion id: replicas mint cmpl-N independently, and a stale
+            # cid paired with the NEW replica would abort a stranger's request
+            state.upstream_cid = None
+            with self._live_lock:
+                self._active.add(state)
             try:
-                if state.stream:
-                    outcome = self._attempt_stream(handler, state, cand, body)
+                if hedge_cand is not None:
+                    # the hedged attempt owns both legs' inflight accounting
+                    # and may re-attribute the attempt to the hedge replica
+                    outcome, cand = self._attempt_stream_hedged(
+                        handler, state, cand, hedge_cand, body, exclude)
                 else:
-                    outcome = self._attempt_batch(handler, state, cand, body)
+                    self._inflight_delta(cand.id, +1)
+                    try:
+                        if state.stream:
+                            outcome = self._attempt_stream(handler, state, cand, body)
+                        else:
+                            outcome = self._attempt_batch(handler, state, cand, body)
+                    finally:
+                        self._inflight_delta(cand.id, -1)
             finally:
-                self._inflight_delta(cand.id, -1)
+                with self._live_lock:
+                    self._active.discard(state)
             if outcome == "done":
                 return
             if outcome == "reroute":
@@ -484,9 +806,12 @@ class RouterServer:
                                     replica=cand.id)
                 continue
             if outcome == "failover":
-                # accepted then failed pre-token: transparent resubmission
+                # accepted then failed pre-token: transparent resubmission. A
+                # drain-evicted stream takes this same path, but its replica
+                # is leaving on purpose — demoting it would lie to the pool
                 exclude.add(cand.id)
-                self.pool.note_forward_failure(cand.id)
+                if not self.pool.is_draining(cand.id):
+                    self.pool.note_forward_failure(cand.id)
                 self.metrics.failovers.inc()
                 self.tracer.add_span("failover", self.tracer.epoch_time(state.arrival_t),
                                      time.perf_counter() - state.arrival_t, cat="router",
@@ -533,16 +858,24 @@ class RouterServer:
                        body: bytes) -> str:
         conn = http.client.HTTPConnection(cand.host, cand.port,
                                           timeout=self.upstream_timeout_s)
+        # registered for drain eviction like the stream leg: nothing has been
+        # relayed until the whole body arrives, so a forced close simply
+        # re-routes the request to a survivor
+        state.upstream_conn = conn
         try:
             try:
                 _F_FORWARD.fire(replica=cand.id)
                 conn.request("POST", "/v1/completions", body=body,
                              headers=self._forward_headers(state))
                 resp = conn.getresponse()
+                state.upstream_resp = resp
                 raw = resp.read()
             except _UPSTREAM_ERRORS as e:
                 logger.warning(f"router: forward to {cand.id} failed: {e!r}")
-                self.pool.note_forward_failure(cand.id)
+                # a drain-deadline eviction lands here too — a deliberately
+                # leaving replica must not be demoted as if it had failed
+                if not self.pool.is_draining(cand.id):
+                    self.pool.note_forward_failure(cand.id)
                 return "reroute"
             if resp.status in (429, 503):
                 self._note_reject(cand, resp)
@@ -574,7 +907,12 @@ class RouterServer:
             self._relay_raw(handler, 200, json.dumps(doc).encode())
             return "done"
         finally:
-            conn.close()
+            state.upstream_conn = None
+            state.upstream_resp = None
+            try:
+                conn.close()
+            except Exception:
+                pass  # may race the drain enforcer's forced close
 
     def _note_reject(self, cand: ReplicaSnapshot, resp):
         retry_after = resp.getheader("Retry-After")
@@ -593,15 +931,22 @@ class RouterServer:
                         body: bytes) -> str:
         conn = http.client.HTTPConnection(cand.host, cand.port,
                                           timeout=self.upstream_timeout_s)
+        # published for the drain enforcer: a past-deadline drain closes this
+        # connection to break the relay read into a pre-token failover
+        state.upstream_conn = conn
         try:
             try:
                 _F_FORWARD.fire(replica=cand.id)
                 conn.request("POST", "/v1/completions", body=body,
                              headers=self._forward_headers(state))
                 resp = conn.getresponse()
+                state.upstream_resp = resp
             except _UPSTREAM_ERRORS as e:
                 logger.warning(f"router: forward to {cand.id} failed: {e!r}")
-                self.pool.note_forward_failure(cand.id)
+                # same draining guard as the failover branch: an evicted leg
+                # on a deliberately leaving replica is not a health incident
+                if not self.pool.is_draining(cand.id):
+                    self.pool.note_forward_failure(cand.id)
                 return "reroute"
             if resp.status in (429, 503):
                 self._note_reject(cand, resp)
@@ -619,14 +964,23 @@ class RouterServer:
                 self._finish(state, cand.id, "error")
                 self._relay_raw(handler, resp.status, raw)
                 return "done"
-            return self._relay_sse(handler, state, cand, resp)
+            return self._relay_sse(handler, state, cand, _read_sse_events(resp))
         finally:
-            conn.close()
+            state.upstream_conn = None
+            state.upstream_resp = None
+            try:
+                conn.close()
+            except Exception:
+                # closing a connection the drain enforcer already tore down
+                # can trip http.client's own (unsynchronized) close path
+                pass
 
     def _relay_sse(self, handler, state: _RelayState, cand: ReplicaSnapshot,
-                   resp) -> str:
-        """Relay one upstream SSE leg. Returns done / failover /
-        midstream_failed / client_gone."""
+                   events) -> str:
+        """Relay one upstream SSE leg, already parsed into
+        ``("event"|"done"|"broke", payload)`` items (:func:`_read_sse_events`
+        for a plain leg, the committed-leg queue for a hedged one). Returns
+        done / failover / midstream_failed / client_gone."""
         if not state.headers_sent:
             handler.send_response(200)
             handler.send_header("Content-Type", "text/event-stream")
@@ -635,51 +989,39 @@ class RouterServer:
             handler.end_headers()
             state.headers_sent = True
 
-        def upstream_broke() -> str:
-            if state.finished:
-                # the client already has its terminal chunk; only [DONE] was
-                # lost — close out the stream ourselves
-                try:
-                    handler.wfile.write(b"data: [DONE]\n\n")
-                    handler.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError):
-                    return "client_gone"
-                self._finish(state, cand.id, "ok")
-                return "done"
-            return "failover" if state.tokens_relayed == 0 else "midstream_failed"
+        def close_out() -> str:
+            # terminal bookkeeping BEFORE the final client write: the moment
+            # the client sees [DONE], every router-side counter/span must
+            # already reflect this request — a client asserting on /metrics
+            # right after its stream closes must never observe the old value.
+            # A client that vanishes on this very last write already received
+            # the entire stream, so "ok"/"error" (not client_gone) stands.
+            self._finish(state, cand.id, "ok" if state.finished else "error")
+            try:
+                handler.wfile.write(b"data: [DONE]\n\n")
+                handler.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return "done"
 
-        while True:
-            try:
-                line = resp.readline()
-            except _UPSTREAM_ERRORS as e:
-                logger.warning(f"router: stream from {cand.id} broke: {e!r}")
-                return upstream_broke()
-            if not line:
-                # upstream closed without [DONE]: a crash, not a completion
-                return upstream_broke()
-            line = line.strip()
-            if not line.startswith(b"data: "):
-                continue
-            data = line[len(b"data: "):]
-            if data == b"[DONE]":
-                # the terminal chunk was already relayed on a previous line
-                try:
-                    handler.wfile.write(b"data: [DONE]\n\n")
-                    handler.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError):
-                    return "client_gone"
-                self._finish(state, cand.id, "ok" if state.finished else "error")
-                return "done"
-            try:
-                ev = json.loads(data)
-            except ValueError:
-                continue
-            if ev.get("object") == "error":
-                # upstream's in-band internal error (its headers were already
-                # sent too) — same disposition as a transport drop
-                return upstream_broke()
+        for kind, payload in events:
+            if kind == "done":
+                # the terminal chunk was already relayed on a previous item
+                return close_out()
+            if kind == "broke" or payload.get("object") == "error":
+                # transport drop / close without [DONE] / upstream's in-band
+                # internal error — all the same disposition
+                if kind == "broke" and payload is not None:
+                    logger.warning(f"router: stream from {cand.id} broke: {payload!r}")
+                if state.finished:
+                    # the client already has its terminal chunk; only [DONE]
+                    # was lost — close out the stream ourselves
+                    return close_out()
+                return "failover" if state.tokens_relayed == 0 else "midstream_failed"
+            ev = payload
             upstream_cid = ev.get("id")
             if upstream_cid:
+                state.upstream_cid = str(upstream_cid)
                 self._track(state, cand.id, str(upstream_cid))
             choice = (ev.get("choices") or [{}])[0]
             finish = choice.get("finish_reason")
@@ -702,6 +1044,269 @@ class RouterServer:
                 state.finished = True
             elif "token" in choice:
                 state.tokens_relayed += 1
+        # iterator exhausted without a terminal item (defensive)
+        return "failover" if state.tokens_relayed == 0 else "midstream_failed"
+
+    # ------------------------------------------------------------- hedged leg
+    def _attempt_stream_hedged(self, handler, state: _RelayState,
+                               cand: ReplicaSnapshot, hedge_cand: ReplicaSnapshot,
+                               body: bytes, exclude: set):
+        """One hedged stream attempt. The primary forward starts immediately;
+        when no leg has produced a first event within ``hedge_after_s`` a
+        shadow forward races it on ``hedge_cand`` (bounded by the
+        in-flight-hedge cap). Each leg's reader thread parses its SSE stream
+        into a shared queue; the first leg to produce a *usable* event (a
+        token or a clean terminal — not an engine_error) is **committed** and
+        relays through the ordinary SSE path, and the loser is torn down
+        (socket closed + ``/v1/abort`` when its upstream id is known). Nothing
+        reaches the client before commit, so a losing leg is invisible.
+
+        Returns ``(outcome, replica)`` — ``replica`` is the leg the outcome
+        belongs to, so the caller's exclusion/health bookkeeping follows the
+        replica that actually failed or served."""
+        # bounded: the committed leg's reader is paced by how fast the client
+        # drains (TCP backpressure all the way to the replica) instead of
+        # buffering a whole generation in router memory for a slow client
+        q: "queue.Queue" = queue.Queue(maxsize=64)
+        legs = {0: cand, 1: hedge_cand}
+        conns: Dict[int, object] = {}
+        resps: Dict[int, object] = {}
+        cids: Dict[int, Optional[str]] = {0: None, 1: None}
+        abandoned: Dict[int, bool] = {}
+
+        def put_item(leg: int, kind: str, payload) -> bool:
+            """Bounded put with liveness: blocks while the queue is full
+            (backpressure) but re-checks abandonment each second so a
+            torn-down loser's reader exits instead of wedging on a queue
+            nobody will drain."""
+            while not abandoned.get(leg):
+                try:
+                    q.put((leg, kind, payload), timeout=1.0)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader(leg: int, snap: ReplicaSnapshot):
+            conn = http.client.HTTPConnection(snap.host, snap.port,
+                                              timeout=self.upstream_timeout_s)
+            conns[leg] = conn
+            if leg == 0:
+                # published pre-commit so a drain-deadline eviction of the
+                # (token-less, primary-pinned) stream can break this leg too;
+                # the commit re-points these at the winning leg
+                state.upstream_conn = conn
+            try:
+                try:
+                    _F_FORWARD.fire(replica=snap.id)
+                    conn.request("POST", "/v1/completions", body=body,
+                                 headers=self._forward_headers(state))
+                    resp = conn.getresponse()
+                    resps[leg] = resp
+                    if leg == 0:
+                        state.upstream_resp = resp
+                except _UPSTREAM_ERRORS as e:
+                    put_item(leg, "connect_failed", e)
+                    return
+                if resp.status != 200:
+                    try:
+                        raw = resp.read()
+                    except _UPSTREAM_ERRORS:
+                        raw = b""
+                    put_item(leg, "status",
+                             (resp.status, raw, resp.getheader("Retry-After")))
+                    return
+                for kind, payload in _read_sse_events(resp):
+                    if not put_item(leg, kind, payload):
+                        return  # loser: closing the conn frees the replica
+                    if kind != "event":
+                        return
+            finally:
+                conn.close()
+
+        self._inflight_delta(cand.id, +1)
+        hedge_started = False
+        hedge_capped = False
+        committed: Optional[int] = None
+        first_item = None  # the committing ("event", ev) item
+        failures: Dict[int, Tuple[str, object]] = {}
+        threading.Thread(target=reader, args=(0, cand), daemon=True,
+                         name=f"hedge-primary-{state.rid}").start()
+        hedge_deadline = time.perf_counter() + float(self.hedge_after_s)
+        try:
+            while committed is None:
+                deciding = not hedge_started and not hedge_capped
+                timeout = (max(hedge_deadline - time.perf_counter(), 0.001)
+                           if deciding else self.upstream_timeout_s)
+                try:
+                    leg, kind, payload = q.get(timeout=timeout)
+                except queue.Empty:
+                    if deciding and time.perf_counter() >= hedge_deadline:
+                        # latency budget blown with no first event: hedge
+                        if self._try_start_hedge():
+                            hedge_started = True
+                            self.tracer.instant("hedge", cat="router",
+                                                trace=state.rid, outcome="fired",
+                                                replica=hedge_cand.id)
+                            self._inflight_delta(hedge_cand.id, +1)
+                            threading.Thread(
+                                target=reader, args=(1, hedge_cand), daemon=True,
+                                name=f"hedge-shadow-{state.rid}").start()
+                        else:
+                            hedge_capped = True
+                            self.metrics.hedges.inc(outcome="capped")
+                            self.tracer.instant("hedge", cat="router",
+                                                trace=state.rid, outcome="capped")
+                        continue
+                    if deciding:
+                        continue  # spurious early wake
+                    # silence past the upstream timeout: every racing leg is
+                    # wedged — treat them as broken AND tear them down like
+                    # hedge losers, or their readers would stay blocked for
+                    # another full upstream timeout while both replicas keep
+                    # generating the orphaned request
+                    for wedged in (0, 1) if hedge_started else (0,):
+                        failures.setdefault(wedged, ("broke", None))
+                        abandoned[wedged] = True
+                        _force_close(conns.get(wedged), resps.get(wedged))
+                    break
+                if kind == "event":
+                    ev = payload
+                    if ev.get("id"):
+                        cids[leg] = str(ev["id"])
+                    choice = (ev.get("choices") or [{}])[0]
+                    if ev.get("object") == "error" \
+                            or choice.get("finish_reason") == "engine_error":
+                        failures[leg] = ("engine_error", None)
+                    else:
+                        committed = leg
+                        first_item = ("event", ev)
+                        break
+                else:
+                    failures[leg] = (kind, payload)
+                if 0 in failures and not hedge_started:
+                    # primary failed inside the hedge budget: nothing to race —
+                    # the ordinary candidate walk owns the resubmission
+                    return (self._leg_failure_outcome(handler, state, cand,
+                                                     failures[0]), cand)
+                if 0 in failures and 1 in failures:
+                    break
+                # one leg died but the other is still racing: keep waiting
+
+            if committed is None:
+                # every started leg is dead; attribute the attempt to the
+                # primary, book the shadow's failure separately
+                if hedge_started:
+                    self.metrics.hedges.inc(outcome="failed")
+                    self.tracer.instant("hedge", cat="router", trace=state.rid,
+                                        outcome="failed")
+                    if 1 in failures:
+                        self._note_dead_leg(hedge_cand, failures[1], exclude)
+                return (self._leg_failure_outcome(
+                    handler, state, cand, failures.get(0, ("broke", None))), cand)
+
+            committed_cand = legs[committed]
+            loser = 1 - committed
+            if loser == 0 or hedge_started:  # the loser leg actually ran
+                if loser in failures:
+                    self._note_dead_leg(legs[loser], failures[loser], exclude)
+                else:
+                    # still racing: tear it down — the closed socket stops its
+                    # reader, the explicit abort frees replica-side slot/KV
+                    # (a leg with no event yet has no id to abort by; the
+                    # replica notices the disconnect on its first write)
+                    abandoned[loser] = True
+                    _force_close(conns.get(loser), resps.get(loser))
+                    if cids[loser] is not None:
+                        self._abort_replica_request(
+                            legs[loser].host, legs[loser].port, cids[loser])
+            if hedge_started:
+                label = "hedge_won" if committed == 1 else "primary_won"
+                self.metrics.hedges.inc(outcome=label)
+                self.tracer.instant("hedge", cat="router", trace=state.rid,
+                                    outcome=label, replica=committed_cand.id)
+            state.replica_id = committed_cand.id
+            state.upstream_conn = conns.get(committed)
+            state.upstream_resp = resps.get(committed)
+
+            def committed_events():
+                yield first_item
+                while True:
+                    try:
+                        lg, kind, payload = q.get(timeout=self.upstream_timeout_s)
+                    except queue.Empty:
+                        yield ("broke", None)
+                        return
+                    if lg != committed:
+                        continue
+                    yield (kind, payload)
+                    if kind != "event":
+                        return
+
+            return (self._relay_sse(handler, state, committed_cand,
+                                    committed_events()), committed_cand)
+        finally:
+            # whatever happened, no reader may stay blocked on the queue once
+            # nobody drains it (put_item re-checks this within a second)
+            abandoned[0] = abandoned[1] = True
+            state.upstream_conn = None
+            state.upstream_resp = None
+            self._inflight_delta(cand.id, -1)
+            if hedge_started:
+                self._inflight_delta(hedge_cand.id, -1)
+                self._release_hedge()
+
+    def _leg_failure_outcome(self, handler, state: _RelayState,
+                             cand: ReplicaSnapshot, failure: Tuple) -> str:
+        """Map one dead hedge leg's failure onto the ordinary attempt-outcome
+        vocabulary (the caller's outcome switch owns exclusion/bookkeeping)."""
+        kind, payload = failure
+        if kind == "connect_failed":
+            logger.warning(f"router: forward to {cand.id} failed: {payload!r}")
+            self.pool.note_forward_failure(cand.id)
+            return "reroute"
+        if kind == "status":
+            status, raw, retry_after = payload
+            if status in (429, 503):
+                if status == 503:
+                    self.pool.note_degraded(
+                        cand.id,
+                        retry_after_s=float(retry_after) if retry_after else None)
+                return "reroute"
+            if status >= 500:
+                logger.warning(f"router: {cand.id} answered {status}")
+                return "failover"
+            # the replica judged the request itself bad: relay verbatim
+            if state.headers_sent:
+                return "failover"
+            self._finish(state, cand.id, "error")
+            self._relay_raw(handler, status, raw)
+            return "done"
+        # engine_error / broke / done-without-events: accepted, then failed
+        # before anything was relayed
+        return "failover"
+
+    def _note_dead_leg(self, cand: ReplicaSnapshot, failure: Tuple, exclude: set):
+        """Health/metrics bookkeeping for a hedged leg that died while the
+        OTHER leg carried the request (the outcome switch never sees it)."""
+        kind, payload = failure
+        exclude.add(cand.id)
+        if kind == "status":
+            status, _raw, retry_after = payload
+            if status == 503:
+                self.pool.note_degraded(
+                    cand.id, retry_after_s=float(retry_after) if retry_after else None)
+            if status in (429, 503):
+                self.metrics.rerouted.inc()
+            else:
+                self.metrics.failovers.inc()
+            return
+        if not self.pool.is_draining(cand.id):
+            self.pool.note_forward_failure(cand.id)
+        if kind == "connect_failed":
+            self.metrics.rerouted.inc()
+        else:
+            self.metrics.failovers.inc()
 
     def _abort_upstream(self, state: _RelayState, cand: ReplicaSnapshot):
         with self._live_lock:

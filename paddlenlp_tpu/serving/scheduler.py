@@ -162,6 +162,10 @@ class Scheduler:
             "max_inflight": self.config.max_inflight,
             "draining": self.draining,
             "engine_state": self.loop.state,
+            # slot-level partial recoveries (poisoned requests quarantined
+            # without a full rebuild) — surfaced on /health so operators can
+            # see a replica absorbing poison before it escalates
+            "slot_quarantines": getattr(self.loop, "slot_quarantines", 0),
             "rejected_saturated": self.rejected_saturated,
             "rejected_draining": self.rejected_draining,
             "rejected_degraded": self.rejected_degraded,

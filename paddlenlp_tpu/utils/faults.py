@@ -83,6 +83,14 @@ CATALOG: Dict[str, str] = {
     "router.health_poll": "Inside the ReplicaPool prober before the /health scrape of "
                           "one replica — injected failures drive the HEALTHY → DEGRADED "
                           "→ DOWN demotion deterministically without killing a server.",
+    "router.membership": "Top of a ReplicaPool membership mutation (add / drain / "
+                         "remove), before any state changes — a failure here must "
+                         "leave the replica set exactly as it was (the admin plane "
+                         "returns 5xx, the pool stays consistent, traffic unaffected).",
+    "engine.slot_rebuild": "Inside the supervisor's slot-level quarantine of one "
+                           "poisoned request, before its KV blocks are released — a "
+                           "failure here escalates to the full engine rebuild path "
+                           "(DEGRADED, triage, rebuild) deterministically.",
 }
 
 

@@ -126,6 +126,20 @@ class StubEngine:
         req.finish_t = time.time()
         return req
 
+    def release_request(self, req_id):
+        """Slot-quarantine support, mirroring the real engine: drop the
+        request + free its KV without touching finish fields."""
+        for i, req in enumerate(self.waiting):
+            if req.req_id == req_id:
+                del self.waiting[i]
+                return True
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.req_id == req_id:
+                self.mgr.free_seq(req_id)
+                self.slots[slot] = None
+                return True
+        return False
+
     def stats(self):
         return {"queue_depth": len(self.waiting),
                 "running": sum(1 for r in self.slots if r is not None),
@@ -161,7 +175,14 @@ class StubEngine:
             req.done = (len(req.output_ids) >= req.sampling.max_new_tokens
                         or (eos_after and len(req.output_ids) >= eos_after))
             if req.stream_cb is not None:
-                req.stream_cb(tok, req.done)
+                try:
+                    req.stream_cb(tok, req.done)
+                except Exception as e:
+                    # per-request attribution, mirroring the real engine's
+                    # _emit: a poisoned callback names its request
+                    if getattr(e, "req_id", None) is None:
+                        e.req_id = req.req_id
+                    raise
             if req.done:
                 req.finish_reason = "length"
                 req.finish_t = time.time()
@@ -444,5 +465,139 @@ class TestDeadlineCompletionRace:
             req = h.result(timeout=10)
             assert h.timed_out and req.aborted and req.finish_reason == "abort"
             assert engine.mgr.free_calls[req.req_id] <= 1
+        finally:
+            loop.stop(drain=False)
+
+
+class TestSlotQuarantine:
+    """Slot-level partial recovery (ISSUE 11): a failure the engine attributed
+    to ONE request quarantines only that slot — unaffected streams never
+    pause, the engine is never rebuilt, the 503 breaker never trips — with a
+    bounded escalation ladder back to the full rebuild path."""
+
+    @staticmethod
+    def _poison(handle, after=0):
+        """Make the handle's stream callback raise once ``after`` tokens have
+        been delivered (the engine attributes the failure to this request)."""
+        orig = handle._on_token
+        seen = {"n": 0}
+
+        def boom(tok, done):
+            if seen["n"] >= after:
+                raise RuntimeError("poisoned stream callback")
+            seen["n"] += 1
+            orig(tok, done)
+
+        handle._on_token = boom
+
+    def test_poisoned_request_quarantined_not_rebuilt(self):
+        loop, made = make_loop()
+        loop.start()
+        try:
+            healthy = loop.submit([1, 2], Sampling(max_new_tokens=6))
+            bad = loop.submit([9], Sampling(max_new_tokens=6))
+            self._poison(bad)
+            # the poisoned request fails alone, in-band
+            bad_req = bad.result(timeout=30)
+            assert bad_req.finish_reason == "engine_error"
+            # the healthy stream never paused: full token-exact output, no
+            # requeue, no rebuild, loop still running
+            req = healthy.result(timeout=30)
+            assert req.finish_reason == "length"
+            assert list(healthy.output_ids) == expected_tokens([1, 2], 6)
+            assert healthy.retries == 0
+            assert len(made) == 1  # the factory never ran again
+            assert loop.state == "running"
+            assert loop.slot_quarantines == 1
+            reg = loop.metrics.registry
+            assert reg.get("paddlenlp_serving_slot_quarantines_total").value() == 1
+            assert reg.get("paddlenlp_serving_engine_restarts_total").value() == 0
+            # engine-side: the poisoned slot + its KV were released
+            eng = made[0]
+            assert all(r is None for r in eng.slots)
+            assert eng.mgr.lengths == {}
+        finally:
+            loop.stop(drain=False)
+
+    def test_finished_request_swept_not_blamed(self):
+        """A request that finished in the SAME step the poison killed must
+        resolve as its completion (the crash only ate the bookkeeping)."""
+        loop, made = make_loop()
+        loop.start()
+        try:
+            done_h = loop.submit([1, 2, 3], Sampling(max_new_tokens=1))
+            bad = loop.submit([9], Sampling(max_new_tokens=6))
+            self._poison(bad)
+            assert bad.result(timeout=30).finish_reason == "engine_error"
+            req = done_h.result(timeout=30)
+            assert req.finish_reason == "length"
+            assert list(done_h.output_ids) == expected_tokens([1, 2, 3], 1)
+            assert len(made) == 1 and loop.state == "running"
+        finally:
+            loop.stop(drain=False)
+
+    def test_quarantine_budget_escalates_to_full_rebuild(self):
+        loop, made = make_loop(policy=SupervisorPolicy(
+            max_slot_quarantines=1, max_retries=0,
+            backoff_base_s=0.02, backoff_max_s=0.1))
+        loop.start()
+        try:
+            h1 = loop.submit([1], Sampling(max_new_tokens=4))
+            self._poison(h1)
+            assert h1.result(timeout=30).finish_reason == "engine_error"
+            assert len(made) == 1 and loop.slot_quarantines == 1
+            # second poison inside the window: budget spent -> full rebuild
+            h2 = loop.submit([2], Sampling(max_new_tokens=4))
+            self._poison(h2)
+            assert h2.result(timeout=30).finish_reason == "engine_error"
+            deadline = time.time() + 10
+            while time.time() < deadline and not (len(made) == 2
+                                                  and loop.state == "running"):
+                time.sleep(0.01)
+            assert len(made) == 2  # escalation really rebuilt the engine
+            assert loop.slot_quarantines == 1  # no second quarantine
+            reg = loop.metrics.registry
+            assert reg.get("paddlenlp_serving_engine_restarts_total").value() == 1
+        finally:
+            loop.stop(drain=False)
+
+    def test_slot_rebuild_fault_escalates(self):
+        """engine.slot_rebuild armed: the quarantine itself fails (before KV
+        release) and the supervisor falls back to the full rebuild path."""
+        FAULTS.arm("engine.slot_rebuild", nth=1)
+        loop, made = make_loop(policy=SupervisorPolicy(
+            max_retries=0, backoff_base_s=0.02, backoff_max_s=0.1))
+        loop.start()
+        try:
+            h = loop.submit([1], Sampling(max_new_tokens=4))
+            self._poison(h)
+            assert h.result(timeout=30).finish_reason == "engine_error"
+            assert FAULTS.fired("engine.slot_rebuild") == 1
+            deadline = time.time() + 10
+            while time.time() < deadline and len(made) < 2:
+                time.sleep(0.01)
+            assert len(made) == 2  # escalated: engine rebuilt
+            assert loop.slot_quarantines == 0  # the quarantine never landed
+        finally:
+            loop.stop(drain=False)
+
+    def test_unaffected_stream_tokens_flow_during_quarantine(self):
+        """Stream continuity: the healthy handle's token queue keeps draining
+        while the poisoned slot is quarantined (no degraded pause, no 503)."""
+        loop, made = make_loop()
+        loop.start()
+        scheduler = Scheduler(loop, SchedulerConfig(max_inflight=8))
+        try:
+            healthy = scheduler.submit([1, 2], Sampling(max_new_tokens=8))
+            bad = scheduler.submit([9], Sampling(max_new_tokens=8))
+            self._poison(bad, after=1)
+            toks = list(healthy.tokens(timeout=30))
+            assert toks == expected_tokens([1, 2], 8)
+            assert bad.result(timeout=30).finish_reason == "engine_error"
+            # the breaker never tripped: a new admission sails through
+            extra = scheduler.submit([3], Sampling(max_new_tokens=2))
+            assert extra.result(timeout=30).finish_reason == "length"
+            assert scheduler.stats()["slot_quarantines"] == 1
+            assert loop.state == "running"
         finally:
             loop.stop(drain=False)

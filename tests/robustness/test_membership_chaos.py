@@ -1,0 +1,309 @@
+"""Elastic-fleet chaos (ISSUE 11 acceptance): scale-down under fire, the
+hedge race, and quarantine-vs-rebuild, all against REAL in-process replicas
+(tiny CPU model — tier-1 speed).
+
+- **Drain under fire**: a replica is drained mid-traffic while ``engine.step``
+  faults are armed on it. Every stream must finish token-exact (failover or
+  completion), no client may see a 5xx, the pool's drain state machine must
+  land on ``removed``, and neither replica may leak a KV block.
+- **Hedge race (both respond)**: the pinned replica's steps are slowed past
+  the hedge budget so a shadow forward races it; whichever leg wins, the
+  client's stream is token-exact (greedy decoding makes the legs identical)
+  and the loser is torn down invisibly.
+- **Quarantine vs rebuild**: a poisoned request on a real engine triggers a
+  slot quarantine — the healthy concurrent stream never pauses and is
+  token-exact, and ``engine_restarts_total`` stays 0.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import (
+    MetricsRegistry,
+    SchedulerConfig,
+    ServingServer,
+    SupervisorPolicy,
+)
+from paddlenlp_tpu.serving.router import PrefixAffinityPolicy, launch_fleet
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine_factory(model):
+    def make_engine():
+        return InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
+                               max_blocks_per_seq=32, decode_steps=4)
+    return make_engine
+
+
+def post_json(port, path, payload, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def stream_request(port, prompt, max_tokens, out, key, timeout=600, **extra):
+    """Collect one SSE stream into ``out[key]`` = (status, tokens, finish)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions",
+                     body=json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                                      "stream": True, **extra}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        toks, finish = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            c = ev["choices"][0]
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+            elif "token" in c:
+                toks.append(c["token"])
+        out[key] = (resp.status, toks, finish)
+    finally:
+        conn.close()
+
+
+def assert_no_kv_leak(server):
+    mgr = server.loop.engine.mgr
+    assert mgr.num_free == mgr.total_usable_blocks, \
+        f"KV leak: {mgr.total_usable_blocks - mgr.num_free} blocks still held"
+
+
+GEN_LEN = 16
+PREFIX = [5, 6, 7]  # prefix_tokens=3 below: all PREFIX+tail prompts co-locate
+
+
+class TestDrainUnderFire:
+    def test_drain_with_step_faults_zero_stream_loss(self, model):
+        factory = make_engine_factory(model)
+        fleet = launch_fleet(
+            2, factory, policy=PrefixAffinityPolicy(prefix_tokens=3),
+            poll_interval_s=0.05,
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0),
+            supervisor_policy=SupervisorPolicy(backoff_base_s=0.1, backoff_max_s=0.5))
+        router, port = fleet.router, fleet.router_port
+        try:
+            pinned = router.policy.select(
+                router.pool.snapshots(), prompt=PREFIX + [0])[0].id
+            survivor = next(s.id for s in router.pool.snapshots() if s.id != pinned)
+            pinned_idx = next(i for i in range(2) if fleet.replica_id(i) == pinned)
+            pinned_server = fleet.servers[pinned_idx]
+            survivor_server = fleet.servers[1 - pinned_idx]
+
+            n_stream = 3  # < max_batch_size: all decode concurrently on pinned
+            results = {}
+            threads = [threading.Thread(
+                target=stream_request, args=(port, PREFIX + [40 + i], GEN_LEN,
+                                             results, i))
+                for i in range(n_stream)]
+            for t in threads:
+                t.start()
+            deadline = time.time() + 120
+            while time.time() < deadline and router._open_forwards_on(pinned) < n_stream:
+                time.sleep(0.005)
+            assert router._open_forwards_on(pinned) == n_stream
+
+            # ---- drain the pinned replica while its streams are mid-flight
+            router.pool.start_drain(pinned, deadline_s=60.0)
+            # new pinned-prefix traffic immediately lands on the survivor
+            status, body = post_json(port, "/v1/completions",
+                                     {"prompt": PREFIX + [90], "max_tokens": 4})
+            assert status == 200, body
+            assert body["replica"] == survivor
+            assert len(body["choices"][0]["token_ids"]) == 4
+
+            # ---- now set the draining replica's engine on fire: its next
+            # step fails; the supervisor must recover WITHOUT dropping the
+            # draining streams (they are the only thing keeping it alive)
+            FAULTS.arm("engine.step", nth=1)
+            for t in threads:
+                t.join(timeout=600)
+            assert not any(t.is_alive() for t in threads)
+            assert FAULTS.fired("engine.step") == 1
+
+            # ---- zero stream loss, token-exact
+            solo_engine = factory()
+            for i in range(n_stream):
+                status, toks, finish = results[i]
+                assert status == 200, (i, results[i])
+                assert finish == "length", (i, results[i])
+                solo = solo_engine.generate(
+                    [PREFIX + [40 + i]], SamplingParams(max_new_tokens=GEN_LEN))[0]
+                np.testing.assert_array_equal(toks, solo)
+
+            # ---- the drain completes, the replica leaves, state -> removed
+            drained = fleet.drain_replica(pinned, deadline_s=30.0, wait_timeout_s=60.0)
+            assert drained is True
+            assert router.pool.drain_status(pinned)["state"] == "removed"
+            assert len(router.pool) == 1
+
+            # ---- traffic keeps flowing on the shrunken fleet
+            status, body = post_json(port, "/v1/completions",
+                                     {"prompt": PREFIX + [91], "max_tokens": 4})
+            assert status == 200 and body["replica"] == survivor
+
+            # ---- no KV block leaked on either replica
+            assert_no_kv_leak(pinned_server)
+            assert_no_kv_leak(survivor_server)
+        finally:
+            fleet.shutdown(drain_timeout_s=5)
+
+
+class TestHedgeRaceChaos:
+    def test_hedge_both_respond_token_exact(self, model):
+        factory = make_engine_factory(model)
+        fleet = launch_fleet(
+            2, factory, policy=PrefixAffinityPolicy(prefix_tokens=3),
+            poll_interval_s=0.05, hedge_after_s=0.2,
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0))
+        router, port = fleet.router, fleet.router_port
+        try:
+            # warm BOTH replicas directly (jit compiles outside the race) with
+            # the same prompt-length bucket and decode budget the race uses
+            for i, p in enumerate(fleet.ports):
+                status, _ = post_json(p, "/v1/completions",
+                                      {"prompt": PREFIX + [90 + i],
+                                       "max_tokens": GEN_LEN})
+                assert status == 200
+
+            # slow the next engine steps past the hedge budget: the pinned
+            # replica's first step eats fire #1 (no first token inside 0.2s),
+            # the shadow's first step eats fire #2 — BOTH legs then respond,
+            # and the router serves whichever wins the race
+            FAULTS.arm("engine.step", action="delay", delay_s=0.6, times=2)
+            results = {}
+            stream_request(port, PREFIX + [40], GEN_LEN, results, "race")
+            status, toks, finish = results["race"]
+            assert status == 200 and finish == "length"
+            solo = factory().generate(
+                [PREFIX + [40]], SamplingParams(max_new_tokens=GEN_LEN))[0]
+            np.testing.assert_array_equal(toks, solo)
+
+            reg = router.registry
+            won = (reg.get("paddlenlp_router_hedges_total").value(outcome="hedge_won")
+                   + reg.get("paddlenlp_router_hedges_total").value(outcome="primary_won"))
+            assert won == 1, "exactly one leg must win the fired hedge race"
+            assert reg.get("paddlenlp_router_hedges_total").value(outcome="failed") == 0
+            # both replicas saw the request (the loser leg really ran)
+            n_seen = sum(
+                1 for s in fleet.servers
+                if (s.registry.get("paddlenlp_serving_requests_total") is not None))
+            assert n_seen == 2
+        finally:
+            fleet.shutdown(drain_timeout_s=5)
+
+
+class TestQuarantineVsRebuild:
+    def test_poisoned_request_quarantines_without_restarting_streams(self, model):
+        factory = make_engine_factory(model)
+        registry = MetricsRegistry()
+        server = ServingServer(
+            factory(), registry=registry, engine_factory=factory,
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0))
+        port = server.start_in_thread()
+        try:
+            results = {}
+            # the healthy stream decodes with a frequency penalty: its logits
+            # READ the device-side counts, so a quarantine that left the
+            # failed step's uncommitted count updates behind would make the
+            # regenerated tokens diverge — this pins the resync_counts path
+            t = threading.Thread(
+                target=stream_request, args=(port, PREFIX + [1], 24, results, "healthy"),
+                kwargs={"frequency_penalty": 0.6})
+            t.start()
+            # wait until the healthy stream is visibly decoding
+            deadline = time.time() + 120
+            flowing = False
+            while time.time() < deadline and not flowing:
+                flowing = any(r.get("output_tokens", 0) > 0
+                              for r in server.loop.inflight_info())
+                time.sleep(0.005)
+            assert flowing, "healthy stream never started"
+
+            # a poisoned request: its stream callback raises on its THIRD
+            # token — i.e. inside a multi-token decode step it shares with
+            # the healthy slot, after the healthy slot's earlier-in-sweep
+            # emits, so the step dies with healthy tokens already counted on
+            # device but never emitted (the exact replay-double-count case).
+            # The long prompt lands in an uncompiled prefill bucket, so the
+            # poison is installed long before its first token can fire.
+            bad_prompt = [(3 + 7 * j) % 90 + 1 for j in range(40)]
+            bad = server.scheduler.submit(bad_prompt,
+                                          SamplingParams(max_new_tokens=8))
+            seen = {"n": 0}
+            orig = bad._on_token
+
+            def boom(tok, done):
+                if seen["n"] >= 2:
+                    raise RuntimeError("poisoned stream callback")
+                seen["n"] += 1
+                orig(tok, done)
+
+            bad._on_token = boom
+            req = bad.result(timeout=120)
+            assert req.finish_reason == "engine_error"
+
+            # the healthy stream never paused, token-exact vs a solo run
+            t.join(timeout=600)
+            status, toks, finish = results["healthy"]
+            assert status == 200 and finish == "length"
+            solo = factory().generate(
+                [PREFIX + [1]],
+                SamplingParams(max_new_tokens=24, frequency_penalty=0.6))[0]
+            np.testing.assert_array_equal(toks, solo)
+
+            # quarantine, not rebuild: the loop never left running
+            assert server.loop.state == "running"
+            assert registry.get("paddlenlp_serving_slot_quarantines_total").value() == 1
+            assert registry.get("paddlenlp_serving_engine_restarts_total").value() == 0
+
+            # /health surfaces the quarantine count
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            health = json.loads(resp.read())
+            conn.close()
+            assert resp.status == 200
+            assert health["scheduler"]["slot_quarantines"] == 1
+
+            # the poisoned slot's KV was released; nothing leaked
+            assert_no_kv_leak(server)
+        finally:
+            server.shutdown(drain_timeout_s=5)

@@ -349,6 +349,14 @@ class StubReplica:
                                            "completion_tokens": len(toks)}})
 
             def _stream(self, cid, payload):
+                try:
+                    self._stream_inner(cid, payload)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the router tore this leg down on purpose (hedge loser,
+                    # drain eviction): not an error worth a stack trace
+                    pass
+
+            def _stream_inner(self, cid, payload):
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Connection", "close")
@@ -665,6 +673,44 @@ class TestProxy:
         assert "router_request" in names and "route" in names
         assert "request" not in names
 
+    def test_drain_deadline_fails_over_token_less_stream(self, stub_router):
+        """A drain that outlives its deadline must fail the still-token-less
+        stream over to a survivor via the pre-token resubmit path: same SSE
+        connection, full token stream, zero 5xx."""
+        a = StubReplica(tokens=(1, 2, 3), token_delay_s=5.0)  # token-less for 5s
+        b = StubReplica(tokens=(7, 8, 9))
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        got = {}
+
+        def worker():
+            got["resp"] = post_completion(
+                port, {"prompt": [1], "max_tokens": 3, "stream": True}, timeout=60)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and router._open_forwards_on("a") == 0:
+            time.sleep(0.005)
+        assert router._open_forwards_on("a") == 1
+        status, doc, _ = admin_post(port, "/replicas/drain",
+                                    {"id": "a", "deadline_s": 0.0})
+        assert status == 200 and doc["drain"]["state"] == "draining"
+        time.sleep(0.02)
+        router.pool.poll_once()  # sweep: deadline expired -> eviction hook
+        t.join(timeout=30)
+        assert not t.is_alive()
+        status, body, _ = got["resp"]
+        assert status == 200
+        assert body["tokens"] == [7, 8, 9] and body["finish"] == "length"
+        assert reg.get("paddlenlp_router_failovers_total").value() == 1
+        # a draining replica's eviction is deliberate, not a health incident
+        assert {s.id: s for s in router.pool.snapshots()}["a"].state == HEALTHY
+        router.pool.poll_once()  # live forwards now 0 -> drained
+        assert router.pool.drain_status("a")["drained"] is True
+        status, doc, _ = admin_delete(port, "/replicas/a")
+        assert status == 200 and doc["replica"]["state"] == "removed"
+        assert router.pool.drain_status("a")["state"] == "removed"
+
     def test_health_and_metrics_planes(self, stub_router):
         a = StubReplica(kv=0.75)
         router, port, reg = stub_router([("a", a)])
@@ -687,3 +733,211 @@ class TestProxy:
         from paddlenlp_tpu.observability import lint_exposition
 
         assert lint_exposition(text) == []
+
+
+# --------------------------------------------------------------------- admin plane
+def admin_post(port, path, payload, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def admin_delete(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("DELETE", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def admin_get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+class TestMembership:
+    def test_add_replica_live(self, stub_router):
+        """POST /replicas joins a replica at runtime; it is probed before the
+        200 returns, so the very next request can route on real health."""
+        a = StubReplica(mode="reject429")  # saturated: traffic must move on
+        router, port, reg = stub_router([("a", a)])
+        c = StubReplica(tokens=(5, 6))
+        try:
+            status, doc, _ = admin_post(port, "/replicas",
+                                        {"host": "127.0.0.1", "port": c.port, "id": "c"})
+            assert status == 200 and doc["replica"]["id"] == "c"
+            assert doc["replica"]["state"] == HEALTHY
+            status, body, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+            assert status == 200 and body["replica"] == "c"
+            assert body["choices"][0]["token_ids"] == [5, 6]
+            assert reg.get("paddlenlp_router_membership_changes_total").value(op="add") == 1
+            # duplicate id: clean 409, pool unchanged
+            status, doc, _ = admin_post(port, "/replicas",
+                                        {"host": "127.0.0.1", "port": c.port, "id": "c"})
+            assert status == 409 and doc["error"]["type"] == "already_registered"
+            assert len(router.pool) == 2
+        finally:
+            c.stop()
+
+    def test_add_replica_validates_body(self, stub_router):
+        a = StubReplica()
+        router, port, reg = stub_router([("a", a)])
+        status, doc, _ = admin_post(port, "/replicas", {"host": "127.0.0.1"})
+        assert status == 400 and doc["error"]["type"] == "invalid_request"
+        assert len(router.pool) == 1
+
+    def test_drain_excludes_new_traffic_and_delete_409_until_drained(self, stub_router):
+        a, b = StubReplica(tokens=(1, 2)), StubReplica(tokens=(7, 8))
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, doc, _ = admin_post(port, "/replicas/drain", {"id": "a"})
+        assert status == 200 and doc["drain"]["state"] == "draining"
+        # a draining replica receives NO new requests
+        status, body, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200 and body["replica"] == "b"
+        assert len(a.requests) == 0
+        # removal refused until the drain lands (no sweep has run yet)
+        status, doc, _ = admin_delete(port, "/replicas/a")
+        assert status == 409 and doc["error"]["type"] == "drain_pending"
+        assert len(router.pool) == 2
+        # one sweep with zero live forwards completes the drain
+        router.pool.poll_once()
+        status, doc, _ = admin_delete(port, "/replicas/a")
+        assert status == 200 and doc["replica"]["state"] == "removed"
+        assert len(router.pool) == 1
+        status, listing = admin_get(port, "/replicas")
+        assert status == 200
+        assert [r["id"] for r in listing["replicas"]] == ["b"]
+        assert [t["id"] for t in listing["removed"]] == ["a"]
+        assert reg.get("paddlenlp_router_membership_changes_total").value(op="remove") == 1
+
+    def test_drain_unknown_replica_404(self, stub_router):
+        a = StubReplica()
+        router, port, reg = stub_router([("a", a)])
+        status, doc, _ = admin_post(port, "/replicas/drain", {"id": "nope"})
+        assert status == 404 and doc["error"]["type"] == "unknown_replica"
+        status, doc, _ = admin_delete(port, "/replicas/nope")
+        assert status == 404
+
+    def test_force_delete_skips_drain(self, stub_router):
+        a, b = StubReplica(), StubReplica()
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        status, doc, _ = admin_delete(port, "/replicas/a?force=1")
+        assert status == 200 and doc["replica"]["forced"] is True
+        assert len(router.pool) == 1
+
+    def test_membership_fault_point_leaves_pool_unchanged(self, stub_router):
+        """router.membership armed: the mutation fails BEFORE any state change
+        — clean 500, nothing draining, and the retry (fault spent) succeeds."""
+        a, b = StubReplica(), StubReplica()
+        router, port, reg = stub_router([("a", a), ("b", b)])
+        FAULTS.arm("router.membership", nth=1)
+        status, doc, _ = admin_post(port, "/replicas/drain", {"id": "a"})
+        assert status == 500
+        assert FAULTS.fired("router.membership") == 1
+        assert router.pool.is_draining("a") is False
+        status, doc, _ = admin_post(port, "/replicas/drain", {"id": "a"})
+        assert status == 200
+        assert router.pool.is_draining("a") is True
+
+    def test_ring_repins_bounded_on_live_add(self, stub_router):
+        """Adding a replica to a prefix-affinity router moves only ~1/N of
+        prefixes (consistent hashing over live membership churn)."""
+        stubs = [(f"r{i}", StubReplica()) for i in range(3)]
+        router, port, reg = stub_router(stubs, policy="prefix_affinity")
+        snaps_before = router.pool.snapshots()
+        pins_before = {k: router.policy.select(snaps_before, prompt=[k, 3, 9])[0].id
+                       for k in range(200)}
+        d = StubReplica()
+        try:
+            status, doc, _ = admin_post(port, "/replicas",
+                                        {"host": "127.0.0.1", "port": d.port, "id": "r3"})
+            assert status == 200
+            snaps_after = router.pool.snapshots()
+            moved = sum(
+                1 for k in range(200)
+                if router.policy.select(snaps_after, prompt=[k, 3, 9])[0].id
+                != pins_before[k])
+            assert 0 < moved / 200 < 0.5, f"{moved}/200 prefixes re-pinned"
+        finally:
+            d.stop()
+
+
+# --------------------------------------------------------------------- hedging
+class TestHedging:
+    def test_hedge_fires_and_wins_race(self, stub_router):
+        """Primary stalls past the budget; the shadow answers first and the
+        client gets ITS stream under one router id — the both-respond race
+        (the primary eventually produces tokens too, into a torn-down leg)."""
+        a = StubReplica(tokens=(1, 2, 3), token_delay_s=0.6)
+        b = StubReplica(tokens=(7, 8, 9))
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=0.08)
+        status, body, _ = post_completion(
+            port, {"prompt": [1], "max_tokens": 3, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [7, 8, 9] and body["finish"] == "length"
+        assert len(body["ids"]) == 1 and body["ids"].pop().startswith("rtr-")
+        assert reg.get("paddlenlp_router_hedges_total").value(outcome="hedge_won") == 1
+        assert len(a.requests) == 1 and len(b.requests) == 1
+        assert reg.get("paddlenlp_router_requests_total").value(
+            replica="b", outcome="ok") == 1
+        # losing is not a health incident: the slow replica stays offered
+        assert {s.id: s for s in router.pool.snapshots()}["a"].state == HEALTHY
+
+    def test_primary_wins_after_hedge_fired(self, stub_router):
+        a = StubReplica(tokens=(1, 2), token_delay_s=0.25)
+        b = StubReplica(tokens=(7, 8), token_delay_s=2.0)
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=0.08)
+        status, body, _ = post_completion(
+            port, {"prompt": [1], "max_tokens": 2, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [1, 2] and body["finish"] == "length"
+        assert reg.get("paddlenlp_router_hedges_total").value(outcome="primary_won") == 1
+        assert len(b.requests) == 1  # the shadow really fired ...
+        assert reg.get("paddlenlp_router_requests_total").value(
+            replica="a", outcome="ok") == 1  # ... but the primary served
+
+    def test_no_hedge_inside_budget(self, stub_router):
+        a, b = StubReplica(tokens=(1, 2)), StubReplica(tokens=(7, 8))
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=5.0)
+        status, body, _ = post_completion(
+            port, {"prompt": [1], "max_tokens": 2, "stream": True})
+        assert status == 200 and body["tokens"] == [1, 2]
+        assert len(b.requests) == 0
+        for outcome in ("fired", "primary_won", "hedge_won", "capped", "failed"):
+            assert reg.get("paddlenlp_router_hedges_total").value(outcome=outcome) == 0
+
+    def test_hedge_cap_suppresses_shadow(self, stub_router):
+        a = StubReplica(tokens=(1, 2), token_delay_s=0.3)
+        b = StubReplica(tokens=(7, 8))
+        router, port, reg = stub_router([("a", a), ("b", b)],
+                                        hedge_after_s=0.05, max_hedges_inflight=0)
+        status, body, _ = post_completion(
+            port, {"prompt": [1], "max_tokens": 2, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [1, 2]  # primary still serves, just slowly
+        assert len(b.requests) == 0
+        assert reg.get("paddlenlp_router_hedges_total").value(outcome="capped") == 1
+
+    def test_hedge_survives_primary_engine_error(self, stub_router):
+        """Primary dies pre-token while the shadow is racing: the shadow's
+        stream serves, the dead replica is excluded and demoted."""
+        a = StubReplica(mode="engine_error_pre")
+        b = StubReplica(tokens=(7, 8, 9), token_delay_s=0.2)
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=0.05)
+        status, body, _ = post_completion(
+            port, {"prompt": [1], "max_tokens": 3, "stream": True})
+        assert status == 200
+        assert body["tokens"] == [7, 8, 9] and body["finish"] == "length"
+        assert {s.id: s for s in router.pool.snapshots()}["a"].state != HEALTHY

@@ -49,6 +49,22 @@ Usage::
                                                  # tp_degree (composes with
                                                  # --prefill-chunk and
                                                  # --prefix-share)
+    python tools/bench_serve.py --replicas 3 --drain-mid-run
+                                                 # halfway through the request
+                                                 # stream, drain one replica via
+                                                 # the router admin plane (POST
+                                                 # /replicas/drain → DELETE) —
+                                                 # the JSON line adds drained_ok
+                                                 # plus the failovers/hedges the
+                                                 # churn caused, so elasticity
+                                                 # shows up in the bench
+                                                 # trajectory
+    python tools/bench_serve.py --replicas 2 --hedge-after-ms 250
+                                                 # arm request hedging: a stream
+                                                 # with no first token inside
+                                                 # the budget races a shadow on
+                                                 # the next replica; JSON adds
+                                                 # hedges (total fired/capped)
 """
 
 from __future__ import annotations
@@ -129,7 +145,11 @@ def run() -> None:
     concurrency = _arg("--concurrency", 8)
     max_tokens = _arg("--max-tokens", 16)
     n_replicas = _arg("--replicas", 1)
+    drain_mid_run = "--drain-mid-run" in sys.argv
+    hedge_after_ms = _farg("--hedge-after-ms", 0.0)
     prefix_share = _farg("--prefix-share", 0.0)
+    if drain_mid_run and n_replicas < 2:
+        _fail("--drain-mid-run needs --replicas >= 2 (one replica must survive)")
     long_mix = "--long-prompt-mix" in sys.argv
     n_long = _arg("--long-prompts", 2)
     long_tokens = _arg("--long-prompt-tokens", 2048)
@@ -195,6 +215,7 @@ def run() -> None:
         fleet = launch_fleet(
             n_replicas, make_engine, policy="least_loaded", router_registry=registry,
             poll_interval_s=0.2,
+            hedge_after_s=hedge_after_ms / 1e3 if hedge_after_ms > 0 else None,
             scheduler_config=SchedulerConfig(max_inflight=2 * n_requests))
         port = fleet.router_port
     else:
@@ -285,6 +306,51 @@ def run() -> None:
     errors: list = []
     sem = threading.Semaphore(concurrency)
 
+    # --drain-mid-run: halfway through the request stream, drain the last
+    # replica through the router's admin plane (the same POST /replicas/drain
+    # → poll → DELETE sequence an autoscaler would issue) while the remaining
+    # requests keep flowing — elasticity becomes part of the measured window.
+    drain_result: dict = {}
+
+    def drain_worker():
+        victim = f"127.0.0.1:{fleet.ports[-1]}"
+        t_drain = time.time()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/replicas/drain",
+                         body=json.dumps({"id": victim, "deadline_s": 30.0}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            if resp.status != 200:
+                drain_result["drained_ok"] = False
+                drain_result["error"] = f"drain POST: HTTP {resp.status}"
+                return
+            # the poller drives drain progress; wait for "drained" then DELETE
+            drained = False
+            deadline = time.time() + 60
+            while time.time() < deadline and not drained:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+                conn.request("GET", "/replicas")
+                doc = json.loads(conn.getresponse().read())
+                conn.close()
+                drained = any(r["id"] == victim and (r.get("drain") or {}).get("drained")
+                              for r in doc.get("replicas", []))
+                if not drained:
+                    time.sleep(0.1)
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("DELETE", f"/replicas/{victim}" + ("" if drained else "?force=1"))
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            drain_result["drained_ok"] = bool(drained and resp.status == 200)
+            drain_result["drain_wall_s"] = round(time.time() - t_drain, 3)
+            drain_result["drained_replica"] = victim
+        except Exception as e:
+            drain_result["drained_ok"] = False
+            drain_result["error"] = repr(e)
+
     def worker(i: int):
         local = {"ttft": [], "tokens": 0, "gaps_short": []}
         try:
@@ -302,13 +368,19 @@ def run() -> None:
 
     t0 = time.time()
     threads = []
+    drain_thread = None
     for i in range(n_requests):
         sem.acquire()
+        if drain_mid_run and drain_thread is None and i >= n_requests // 2:
+            drain_thread = threading.Thread(target=drain_worker, daemon=True)
+            drain_thread.start()
         t = threading.Thread(target=worker, args=(i,))
         t.start()
         threads.append(t)
     for t in threads:
         t.join()
+    if drain_thread is not None:
+        drain_thread.join(timeout=90)
     dt = time.time() - t0
 
     # scrape /metrics over HTTP (the same path a real Prometheus takes) BEFORE
@@ -418,6 +490,22 @@ def run() -> None:
         record["request_share"] = {k: int(v) for k, v in sorted(share.items())}
         record["failovers"] = int(rscalar("paddlenlp_router_failovers_total"))
         record["rerouted"] = int(rscalar("paddlenlp_router_rerouted_total"))
+        # hedges_total is labeled by outcome: fold the fired ones (and capped
+        # separately — a capped hedge is latency NOT bought back)
+        hedge_fam = router_fams.get("paddlenlp_router_hedges_total")
+        hedge_by = {}
+        if hedge_fam is not None:
+            for (_sample, labels), v in hedge_fam.samples.items():
+                hedge_by[dict(labels).get("outcome", "?")] = int(v)
+        record["hedges"] = sum(v for k, v in hedge_by.items() if k != "capped")
+        if hedge_by.get("capped"):
+            record["hedges_capped"] = hedge_by["capped"]
+        if drain_mid_run:
+            record["drained_ok"] = bool(drain_result.get("drained_ok"))
+            if "drain_wall_s" in drain_result:
+                record["drain_wall_s"] = drain_result["drain_wall_s"]
+            if "error" in drain_result:
+                record["drain_error"] = drain_result["error"]
         if fleet_slo is not None and fleet_slo.get("windows"):
             # the longest window covers the whole bench run (process lifetime)
             widest = fleet_slo["windows"][max(
