@@ -77,6 +77,14 @@ class PredictorArgument:
                           "replica spans several chips (CPU smoke: "
                           "XLA_FLAGS=--xla_force_host_platform_device_count=N). "
                           "None = single device."})
+    disagg_stages: Optional[str] = field(
+        default=None,
+        metadata={"help": "disaggregated prefill/decode serving: 'P,D' device counts "
+                          "— prompt work runs on a P-device prefill stage, decode on "
+                          "a D-device decode stage, KV blocks migrating between the "
+                          "stage pools (mutually exclusive with --mesh_shape; CPU "
+                          "smoke: XLA_FLAGS=--xla_force_host_platform_device_count="
+                          "P+D). None = single-stage."})
     data_file: Optional[str] = None
     output_file: Optional[str] = None
     benchmark: bool = False
@@ -182,6 +190,7 @@ class BlockPredictor(BasePredictor):
             enable_prefix_cache=args.enable_prefix_cache,
             prefill_chunk_tokens=args.prefill_chunk_tokens,
             mesh_shape=self._parse_mesh_shape(args.mesh_shape),
+            disagg_stages=self._parse_disagg_stages(args.disagg_stages),
             use_speculative=args.speculate_method == "ngram",
             spec_draft_len=args.speculate_max_draft_tokens,
             draft_model=draft_model,
@@ -205,6 +214,17 @@ class BlockPredictor(BasePredictor):
         if len(parts) != 2 or any(p < 1 for p in parts):
             raise ValueError(
                 f"--mesh_shape must be 'T' or 'R,C' with positive degrees, got {raw!r}")
+        return tuple(parts)
+
+    @staticmethod
+    def _parse_disagg_stages(raw: Optional[str]):
+        """'P,D' -> (prefill_devices, decode_devices); None stays single-stage."""
+        if not raw:
+            return None
+        parts = [int(x) for x in str(raw).split(",")]
+        if len(parts) != 2 or any(p < 1 for p in parts):
+            raise ValueError(
+                f"--disagg_stages must be 'P,D' with positive device counts, got {raw!r}")
         return tuple(parts)
 
     @staticmethod

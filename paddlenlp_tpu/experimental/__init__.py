@@ -1,4 +1,5 @@
 from .backend import MixedRow, ModelBackend, SingleDeviceBackend  # noqa: F401
+from .disagg_backend import DisaggBackend  # noqa: F401
 from .engine import InferenceEngine, Request, SamplingParams  # noqa: F401
 from .inference_model import PagedInferenceModel  # noqa: F401
 from .paged_cache import BlockManager, PagedKVPool, init_paged_pool  # noqa: F401

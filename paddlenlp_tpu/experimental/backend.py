@@ -42,13 +42,18 @@ block-table indirection means the engine's tables stay valid — only the pool
 tensor behind them moves). Nothing in the engine assumes the four entry
 points share a device, a pool tensor, or even a process; the only cross-call
 state the engine relies on is that KV written by one call is readable by the
-next call *for the same sequence*.
+next call *for the same sequence*. ``DisaggBackend`` (disagg_backend.py)
+implements it: backends that set ``staged = True`` additionally expose
+``kv_migrate(seq_id, blocks, slot, token_hist)`` → ticket and
+``migration_ready(ticket)``, and the engine gates a sequence's
+decode-eligibility on the landed migration (the scheduler still never
+touches the device — it only polls tickets).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -113,6 +118,11 @@ class ModelBackend:
     #: the PagedInferenceModel (or subclass) holding the jitted programs —
     #: exposed because tests and tools flip ``infer.use_paged_kernel``
     infer: PagedInferenceModel
+
+    #: True for stage-split (disaggregated) backends: the engine then routes
+    #: finished prefills through kv_migrate/migration_ready before treating
+    #: the sequence as decode-eligible
+    staged = False
 
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
                 sampling, slot_idx) -> np.ndarray:
@@ -250,17 +260,32 @@ class SingleDeviceBackend(ModelBackend):
         """One ragged mixed step. Returns sampled tokens in row order
         ``[*chunk_rows, *decode_rows]`` (the scheduler keeps them only where
         ``emit``)."""
+        return self.mixed_step_begin(chunk_rows, decode_rows)()
+
+    def mixed_step_begin(self, chunk_rows: List[MixedRow],
+                         decode_rows: List[MixedRow]) -> Callable[[], np.ndarray]:
+        """Dispatch the ragged step WITHOUT syncing; returns a zero-arg
+        collector yielding the sampled ids in ``[*chunk_rows, *decode_rows]``
+        order. The split exists for staged (MPMD) backends: they dispatch the
+        prefill-stage and decode-stage programs back to back and only then
+        collect, so the two device groups compute concurrently instead of the
+        host serializing them at the first sync."""
         flat = self.token_flatten
         if flat is None:
             flat = not self.infer.use_paged_kernel
-        if flat:
-            return self._mixed_flat(chunk_rows, decode_rows)
-        return self._mixed_padded(chunk_rows, decode_rows)
+        launch = self._mixed_flat_launch if flat else self._mixed_padded_launch
+        tokens_dev, mapper = launch(chunk_rows, decode_rows)
 
-    def _mixed_padded(self, chunk_rows, decode_rows) -> np.ndarray:
+        def collect() -> np.ndarray:
+            return mapper(np.asarray(tokens_dev))  # sync-ok: THE mixed-step sync point — sampled int32 ids only
+
+        return collect
+
+    def _mixed_padded_launch(self, chunk_rows, decode_rows):
         """Legacy layout: one [B, T] launch, every row padded to the chunk
         bucket — what the Pallas ragged kernel wants (a single grid covers
-        chunks, decodes and dead rows)."""
+        chunks, decodes and dead rows). Returns (device tokens, host-order
+        mapper)."""
         B = self.max_batch_size
         T = _bucket(max([len(r.tokens) for r in chunk_rows], default=1), minimum=1)
         ids = np.zeros((B, T), np.int32)
@@ -285,15 +310,16 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(q_lens), jnp.asarray(q_start), self.counts,
             jnp.asarray(count_fed), jnp.asarray(emit), samp_arrays(sampling, B),
         )
-        tokens = np.asarray(tokens)  # sync-ok: THE mixed-step sync point — sampled int32 ids only
-        return np.asarray([tokens[r.slot] for r in chunk_rows + decode_rows])  # sync-ok: host reshuffle of already-synced ids
+        rows = chunk_rows + decode_rows
+        return tokens, lambda host: np.asarray([host[r.slot] for r in rows])  # sync-ok: host reshuffle of already-synced ids
 
-    def _mixed_flat(self, chunk_rows, decode_rows) -> np.ndarray:
+    def _mixed_flat_launch(self, chunk_rows, decode_rows):
         """Token-flattened layout: chunk rows keep their [C, T] matrix, decode
         rows collapse to a [D, 1] segment — per-step cost scales with the
         tokens actually fed (bucketed per segment), not B x chunk. Both
         segments run in ONE jit; token-identical to the padded layout (each
-        live row's math is a row-slice of the padded program's)."""
+        live row's math is a row-slice of the padded program's). Returns
+        (device tokens, host-order mapper)."""
         C = _bucket(len(chunk_rows), minimum=1)
         T = _bucket(max([len(r.tokens) for r in chunk_rows], default=1), minimum=1)
         D = _bucket(len(decode_rows), minimum=1)
@@ -333,9 +359,8 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(d_slots), jnp.asarray(d_live),
             self.counts, samp_arrays(sampling, C + D),
         )
-        tokens = np.asarray(tokens)  # sync-ok: THE flat mixed-step sync point — sampled int32 ids only
-        return np.concatenate([tokens[: len(chunk_rows)],
-                               tokens[C : C + len(decode_rows)]])
+        n_c, n_d = len(chunk_rows), len(decode_rows)
+        return tokens, lambda host: np.concatenate([host[:n_c], host[C : C + n_d]])
 
     # ---------------------------------------------------------------- misc
     def describe(self) -> dict:

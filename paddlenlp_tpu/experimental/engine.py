@@ -52,6 +52,7 @@ __all__ = ["InferenceEngine", "Request", "SamplingParams"]
 
 _F_STEP = FaultPoint("engine.step")
 _F_CHUNK = FaultPoint("engine.prefill_chunk")
+_F_MIGRATE = FaultPoint("engine.kv_migrate")
 
 
 @dataclasses.dataclass
@@ -84,6 +85,10 @@ class Request:
     base_prompt_len: int = 0  # original prompt length (preemption grows prompt_ids)
     trace: Optional[str] = None  # observability trace id (serving request context)
     prefilled_len: int = 0  # prompt tokens whose KV is in the pool (chunked prefill)
+    # which stage's pool holds this sequence's KV (disaggregated backends):
+    # "prefill" while chunks run, "migrating" while blocks move between stage
+    # pools, "decode" once landed (single-pool backends stay "decode" always)
+    kv_stage: str = "decode"
 
     @property
     def needs_prefill(self) -> bool:
@@ -153,6 +158,21 @@ class InferenceEngine:
         # shard the forward + KV pool over a device mesh: int tp degree,
         # (dp, tp) tuple, or a parallel.mesh.MeshConfig. None = single device.
         mesh_shape=None,
+        # disaggregated prefill/decode serving: (P, D) device counts — prompt
+        # work runs on a P-device prefill stage, decode on a D-device decode
+        # stage, KV blocks migrating between the stage pools. Overrides
+        # mesh_shape. None = single-stage.
+        disagg_stages=None,
+        # migration scheduling knobs (staged backends only): at most this many
+        # block migrations in flight at once ...
+        migration_inflight_limit: int = 4,
+        # ... and new migrations are deferred while the decode stage's share
+        # of KV blocks exceeds this fraction (decode pressure gates handoff)
+        decode_pressure_gate: float = 0.92,
+        # stage-aware admission: new prompts stop admitting while the prefill
+        # stage's share of KV blocks (mid-prefill + migrating sequences)
+        # would exceed this fraction
+        prefill_pressure_gate: float = 0.95,
         # mixed-step layout: True = token-flattened segments, False = one
         # padded [B, chunk] launch, None = auto (flatten on the XLA fallback)
         token_flatten: Optional[bool] = None,
@@ -169,14 +189,34 @@ class InferenceEngine:
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype, decode_steps=decode_steps,
             eos_ids=self.eos_ids, kv_cache_quant=kv_cache_quant, token_flatten=token_flatten,
         )
+        if disagg_stages is not None and mesh_shape is not None:
+            raise ValueError(
+                "mesh_shape and disagg_stages are mutually exclusive: a disagg "
+                "stage is itself a sharded device group (sized by disagg_stages)")
         if backend is not None:
             self.backend = backend
+        elif disagg_stages is not None:
+            from .disagg_backend import DisaggBackend
+
+            self.backend = DisaggBackend(model, stages=disagg_stages, **backend_kw)
         elif mesh_shape is not None:
             from .sharded_backend import ShardedBackend
 
             self.backend = ShardedBackend(model, mesh_shape=mesh_shape, **backend_kw)
         else:
             self.backend = SingleDeviceBackend(model, **backend_kw)
+        # stage-split scheduling state (engine-owned; the backend only copies
+        # blocks): req_id -> in-flight MigrationTicket, plus the deferred
+        # queue migrations wait on while the decode stage is under pressure
+        self.staged = bool(getattr(self.backend, "staged", False))
+        self.migration_inflight_limit = migration_inflight_limit
+        self.decode_pressure_gate = decode_pressure_gate
+        self.prefill_pressure_gate = prefill_pressure_gate
+        # is_ready-less runtimes: force-land a migration after this many polls
+        # (the functional pool threading already guarantees correctness)
+        self.migration_force_land_polls = 8
+        self._migrating: Dict[int, object] = {}
+        self._migrate_pending: deque = deque()
         self.enable_prefix_cache = enable_prefix_cache
         self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq,
                                 enable_prefix_cache=enable_prefix_cache)
@@ -268,6 +308,7 @@ class InferenceEngine:
             if req is not None and req.req_id == req_id:
                 self._free_kv(req)
                 self.slots[slot] = None
+                self._drop_migration(req_id)
                 self._finish_abort(req)
                 return req
         return None
@@ -315,6 +356,7 @@ class InferenceEngine:
             if req is not None and req.req_id == req_id:
                 self._free_kv(req)
                 self.slots[slot] = None
+                self._drop_migration(req_id)
                 self._spec_rngs.pop(req_id, None)
                 return True
         self._spec_rngs.pop(req_id, None)
@@ -352,6 +394,83 @@ class InferenceEngine:
         the params that produced it."""
         self.mgr.clear_prefix_cache()
 
+    # ------------------------------------------------------------------ stage migration
+    def _slot_of(self, req_id: int) -> Optional[int]:
+        for slot, r in enumerate(self.slots):
+            if r is not None and r.req_id == req_id:
+                return slot
+        return None
+
+    def _stage_blocks(self) -> Dict[str, int]:
+        """KV blocks held per stage (host bookkeeping off the single shared
+        block-id space): ``prefill`` = sequences mid-prefill or migrating,
+        ``decode`` = decode-eligible sequences. The pressure inputs for
+        stage-aware admission and the migration gate."""
+        held = {"prefill": 0, "decode": 0}
+        for r in self.slots:
+            if r is None or r.req_id not in self.mgr.tables:
+                continue
+            key = "decode" if r.kv_stage == "decode" else "prefill"
+            held[key] += len(self.mgr.tables[r.req_id])
+        return held
+
+    def _drop_migration(self, req_id: int):
+        """Forget a request's migration state (abort / preempt / quarantine).
+        An already-dispatched copy needs no cancellation: it only wrote the
+        request's own blocks, which are about to be freed — any future owner
+        re-prefills and re-migrates over them."""
+        self._migrating.pop(req_id, None)
+        try:
+            self._migrate_pending.remove(req_id)
+        except ValueError:
+            pass
+
+    def _advance_migrations(self):
+        """Poll in-flight prefill→decode block migrations and start deferred
+        ones. Landing flips the sequence to ``kv_stage="decode"`` — the only
+        thing that makes it decode-eligible. Starts are gated by the in-flight
+        bound and by decode-stage KV pressure (a saturated decode pool must
+        drain before it accepts more handoffs — the backpressure that keeps
+        the two SLOs decoupled instead of re-coupling them through the pool)."""
+        for req_id, ticket in list(self._migrating.items()):
+            ticket.polls += 1
+            if not (self.backend.migration_ready(ticket)
+                    or ticket.polls >= self.migration_force_land_polls):
+                continue
+            del self._migrating[req_id]
+            slot = self._slot_of(req_id)
+            if slot is None:
+                continue  # aborted/preempted while the blocks were in flight
+            req = self.slots[slot]
+            req.kv_stage = "decode"
+            TRACER.instant("kv_migrated", cat="engine", trace=req.trace,
+                           req_id=req_id, blocks=ticket.n_blocks,
+                           polls=ticket.polls)
+        total = max(self.mgr.total_usable_blocks, 1)
+        while self._migrate_pending and len(self._migrating) < self.migration_inflight_limit:
+            if self._stage_blocks()["decode"] / total > self.decode_pressure_gate:
+                break  # decode pressure gates handoff; finishing seqs free it
+            req_id = self._migrate_pending[0]
+            slot = self._slot_of(req_id)
+            if slot is None or self.slots[slot].kv_stage != "migrating":
+                self._migrate_pending.popleft()
+                continue  # retired/preempted while deferred
+            req = self.slots[slot]
+            # fired BEFORE the queue pop: an injected failure leaves the
+            # handoff queued, so recovery (or a bare retry) finds it intact
+            _F_MIGRATE.fire(req_id=req_id)
+            self._migrate_pending.popleft()
+            blocks = self.mgr.tables[req_id]
+            hist = np.concatenate([req.prompt_ids[: req.prefilled_len],
+                                   np.asarray(req.output_ids, np.int32)])  # sync-ok: host-side id lists (decode-stage count seed)
+            t0 = time.perf_counter()
+            self._migrating[req_id] = self.backend.kv_migrate(
+                req_id, list(blocks), slot, hist)
+            TRACER.add_span("kv_migrate", TRACER.epoch_time(t0),
+                            time.perf_counter() - t0, cat="engine",
+                            trace=req.trace, req_id=req_id, blocks=len(blocks),
+                            inflight=len(self._migrating))
+
     def reset(self):
         """Drop ALL scheduler/allocator state after a failed step — the
         in-place recovery the serving supervisor uses when it has no
@@ -369,11 +488,13 @@ class InferenceEngine:
         self._last_token[:] = 0
         self.backend.reset_counts()
         self._spec_rngs.clear()
+        self._migrating.clear()
+        self._migrate_pending.clear()
         logger.warning("inference engine reset: scheduler + KV allocator state dropped")
 
     def stats(self) -> Dict:
         """Point-in-time scheduler/allocator stats (the step_cb payload)."""
-        return {
+        out = {
             "queue_depth": len(self.waiting),
             "running": sum(1 for r in self.slots if r is not None),
             "max_batch_size": self.max_batch_size,
@@ -396,6 +517,32 @@ class InferenceEngine:
             },
             "backend": self.backend.describe(),
         }
+        if self.staged:
+            held = self._stage_blocks()
+            total = max(self.mgr.total_usable_blocks, 1)
+            n_prefilling = sum(1 for r in self.slots
+                               if r is not None and r.needs_prefill)
+            n_migrating = sum(1 for r in self.slots
+                              if r is not None and r.kv_stage == "migrating")
+            out["disagg"] = {
+                # TTFT comes from this pool ...
+                "prefill_stage": {
+                    "kv_blocks": held["prefill"],
+                    "kv_utilization": held["prefill"] / total,
+                    "queue_depth": len(self.waiting) + n_prefilling,
+                },
+                # ... inter-token latency from this one
+                "decode_stage": {
+                    "kv_blocks": held["decode"],
+                    "kv_utilization": held["decode"] / total,
+                    "queue_depth": n_migrating,
+                },
+                "migrations": dict(getattr(self.backend, "migration_stats",
+                                           {"migrations": 0, "blocks": 0, "bytes": 0})),
+                "migrations_inflight": len(self._migrating),
+                "migrations_pending": len(self._migrate_pending),
+            }
+        return out
 
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
         """Submit a batch and run to completion (convenience API)."""
@@ -417,6 +564,11 @@ class InferenceEngine:
         # whose step_num matches the step= arg on the host prefill/decode
         # spans — host stall or device stall is one cross-reference away
         with jax.profiler.StepTraceAnnotation("engine_step", step_num=self._cur_step):
+            if self.staged:
+                # land finished prefill→decode block copies and start deferred
+                # ones BEFORE row selection, so a landed sequence decodes in
+                # this very step
+                self._advance_migrations()
             if self.prefill_chunk_tokens:
                 self._admit_chunked(finished)
                 if any(r is not None and r.needs_prefill for r in self.slots):
@@ -450,6 +602,11 @@ class InferenceEngine:
         cache_on = self.enable_prefix_cache
         hits0, cached0 = self.mgr.cache_hits, self.mgr.cached_tokens_total
         admitted: List[tuple] = []  # (slot, req, n_cached)
+        # stage-aware admission (staged backends): new prompts are prefill-
+        # stage work, so their gate is PREFILL-stage KV pressure — blocks held
+        # by mid-prefill + migrating sequences — not the shared total alone
+        held_prefill = self._stage_blocks()["prefill"] if self.staged else 0
+        total_blocks = max(self.mgr.total_usable_blocks, 1)
         while self.waiting and free:
             req = self.waiting[0]
             prompt_len = len(req.prompt_ids)
@@ -466,6 +623,15 @@ class InferenceEngine:
                 logger.warning(f"req {req.req_id}: needs {need} KV blocks (> capacity); rejected")
                 finished.append(req)
                 continue
+            # the gate charges only what admission actually reserves
+            # (prompt + 1; decode growth happens on the decode stage), and an
+            # IDLE prefill stage always admits at least one request — a lone
+            # prompt larger than the gate fraction must run, not head-of-line
+            # block the queue forever
+            admit_need = self.mgr.blocks_needed(prompt_len + 1)
+            if self.staged and held_prefill > 0 \
+                    and held_prefill + admit_need > self.prefill_pressure_gate * total_blocks:
+                break  # prefill stage saturated: admitting would starve handoff
             # reserve prompt + 1 so the first decode never immediately preempts;
             # cached prefix blocks need no fresh capacity, so a warm request
             # can be admitted where a cold one of the same length must wait.
@@ -495,6 +661,11 @@ class InferenceEngine:
                            req_id=req.req_id, tokens=prompt_len,
                            cached_tokens=n_cached,
                            free_blocks=self.mgr.num_free)
+            if self.staged:
+                # the sequence's KV is prefill-stage-resident until its last
+                # chunk lands and the blocks migrate to the decode pool
+                req.kv_stage = "prefill"
+                held_prefill += len(self.mgr.tables[req.req_id])
             admitted.append((free.pop(0), req, n_cached))
         # admission span closes BEFORE prefill (sibling phases, not nested) and
         # only when something happened — a blocked queue spinning admitted=0
@@ -567,6 +738,12 @@ class InferenceEngine:
         else:
             self.slots[slot] = req
             self._last_token[slot] = tok
+            if self.staged and req.kv_stage == "prefill" and not req.needs_prefill:
+                # prefill done (first token sampled on the prefill stage):
+                # the sequence decodes only after its blocks land in the
+                # decode pool — queue the migration, don't block the step
+                req.kv_stage = "migrating"
+                self._migrate_pending.append(req.req_id)
 
     # ------------------------------------------------------------------ chunked prefill
     def _admit_chunked(self, finished: List[Request]):
@@ -602,7 +779,8 @@ class InferenceEngine:
         # their full-prompt blocks were reserved at admission).
         for slot in sorted(
                 [s for s, r in enumerate(self.slots)
-                 if r is not None and not r.needs_prefill],
+                 if r is not None and not r.needs_prefill
+                 and r.kv_stage == "decode"],
                 key=lambda s: self.slots[s].req_id):
             req = self.slots[slot]
             if req is None or req.needs_prefill:
@@ -625,8 +803,9 @@ class InferenceEngine:
                 continue
             if req.needs_prefill:
                 prefilling.append(slot)
-            else:
+            elif req.kv_stage == "decode":
                 decode_rows.append((slot, req))
+            # else: migrating — contributes no row until its blocks land
         # the OLDEST mid-prefill request drinks the chunk budget first: slot
         # order would let a newly-admitted prompt landing in a lower slot
         # starve an older one indefinitely under sustained admissions
@@ -790,6 +969,11 @@ class InferenceEngine:
         # a half-prefilled request's KV is gone with its blocks: re-admission
         # starts the chunk walk over (prefix-cache hits re-credit what they can)
         req.prefilled_len = 0
+        if self.staged:
+            # any in-flight/deferred migration is moot: re-admission
+            # re-prefills on the prefill stage and re-migrates
+            self._drop_migration(req.req_id)
+            req.kv_stage = "prefill"
         self.waiting.appendleft(req)
 
     def _req_rng(self, req) -> np.random.Generator:
@@ -901,9 +1085,17 @@ class InferenceEngine:
         return emitted
 
     def _decode_running(self, finished: List[Request]):
-        if not any(r is not None for r in self.slots):
+        # migrating slots (staged backends) hold KV that has not landed in the
+        # decode pool yet: they ride no decode row this step — a step with
+        # ONLY migrating slots launches nothing and just re-polls next step
+        if not any(r is not None and r.kv_stage == "decode" for r in self.slots):
             return
-        mode = self._spec_mode() if self.use_speculative else None
+        # speculative decoding needs every active slot advancing in lockstep;
+        # a mid-migration slot would verify against un-landed KV, so the spec
+        # path waits for an all-decode-ready batch (the chunked-prefill
+        # carve-out, extended to the stage handoff window)
+        all_ready = all(r is None or r.kv_stage == "decode" for r in self.slots)
+        mode = self._spec_mode() if (self.use_speculative and all_ready) else None
         if mode is not None:
             # propose first: when NO slot has a draft, a verify forward would
             # emit 1 token/seq for (K+1)x the compute — use the multi-step
@@ -923,7 +1115,8 @@ class InferenceEngine:
         # grow tables for up to `steps` tokens; preempt (recompute-requeue)
         # youngest on exhaustion. Surplus is shrunk back after the device call.
         start_len: Dict[int, int] = {}
-        active = [s for s in range(len(self.slots)) if self.slots[s] is not None]
+        active = [s for s in range(len(self.slots))
+                  if self.slots[s] is not None and self.slots[s].kv_stage == "decode"]
         for slot in sorted(active, key=lambda s: -self.slots[s].req_id):
             req = self.slots[slot]
             needed = min(steps, req.remaining_new)
@@ -932,7 +1125,7 @@ class InferenceEngine:
                 start_len.pop(req.req_id, None)
                 self._preempt(slot)
 
-        if not any(r is not None for r in self.slots):
+        if not any(r is not None and r.kv_stage == "decode" for r in self.slots):
             return
         B = self.max_batch_size
         tokens = np.array(self._last_token, np.int32)  # sync-ok: _last_token is a host array
@@ -941,8 +1134,8 @@ class InferenceEngine:
         done0 = np.ones(B, bool)
         remaining = np.zeros(B, np.int32)
         for i, req in enumerate(self.slots):
-            if req is None:
-                continue
+            if req is None or req.kv_stage != "decode":
+                continue  # migrating rows stay frozen (done0) like empty slots
             tables[i] = self.mgr.table_array(req.req_id)
             ctx[i] = req.total_len - 1  # position of the token being fed
             done0[i] = False

@@ -185,13 +185,19 @@ class ShardedBackend(SingleDeviceBackend):
     rebound (a serving weight update); the pool and counts live sharded /
     replicated on the mesh for their whole life."""
 
-    def __init__(self, model, *, mesh_shape, **kw):
+    def __init__(self, model, *, mesh_shape, devices=None, stage=None, **kw):
         # surfaced as a named fault point: mesh/layout init is the first
         # thing a supervisor rebuild of a sharded engine replays, and chaos
-        # coverage needs it to fail deterministically
-        _F_SHARD_INIT.fire()
+        # coverage needs it to fail deterministically. Staged (disagg)
+        # backends construct one ShardedBackend per stage, so the fault fires
+        # once per stage rebuild — `stage` labels which one.
+        _F_SHARD_INIT.fire(stage=stage or "all")
+        self.stage = stage  # None = whole-replica backend; "prefill"/"decode" = disagg stage
         config = _normalize_mesh_shape(mesh_shape)
-        devices = jax.devices()
+        if devices is None:
+            devices = jax.devices()
+        else:
+            devices = list(devices)
         if config.dp == -1:  # MeshConfig callers may leave dp to absorb
             config = config.resolve(len(devices))
         n_dev = config.dp * config.fsdp * config.pp * config.sep * config.cp * config.tp
@@ -239,7 +245,7 @@ class ShardedBackend(SingleDeviceBackend):
 
     def describe(self) -> dict:
         axes = {k: int(v) for k, v in self.mesh.shape.items()}
-        return {
+        out = {
             "kind": "sharded",
             "devices": int(self.mesh.size),
             "tp_degree": axes.get("tp", 1),
@@ -247,4 +253,7 @@ class ShardedBackend(SingleDeviceBackend):
             "mesh_shape": [self.mesh_config.dp, self.mesh_config.tp],
             "kv_pool_sharded": self.infer.pool_spec != P(),
         }
+        if self.stage is not None:
+            out["stage"] = self.stage
+        return out
 

@@ -35,6 +35,8 @@ SPAN_CATALOG = {
     "kv_alloc": "instant: KV blocks allocated for an admitted request (cached_tokens = prefix-cache hit)",
     "kv_free": "instant: a request's KV blocks released (finish/abort/preempt)",
     "preempt": "instant: KV exhaustion evicted the youngest sequence for recompute-requeue",
+    "kv_migrate": "dispatch of one sequence's prefill->decode KV-block migration (disaggregated backend)",
+    "kv_migrated": "instant: a sequence's migrated blocks landed in the decode pool; it is now decode-eligible",
     # ------------------------------------------------------------- engine loop / supervisor
     "engine_failure": "instant: engine.step() raised; the loop is entering DEGRADED",
     "engine_degraded": "one DEGRADED window: triage -> backoff -> rebuild -> requeue",
@@ -49,7 +51,7 @@ SPAN_CATALOG = {
     "reroute": "instant: attempt moved to the next candidate before anything was relayed",
     "failover": "accepted-then-failed pre-token resubmission onto another replica",
     "replica_state": "instant: pool state machine moved a replica (prev -> state)",
-    "membership": "instant: replica membership event (op=add/drain/drained/drain_expired/drain_evict/remove)",
+    "membership": "instant: replica membership event (op=add/drain/drained/drain_expired/drain_evict/remove; op=drain_direct on the replica's own scheduler)",
     "hedge": "instant: hedged-stream lifecycle event (outcome=fired/capped/primary_won/hedge_won/failed)",
     # ------------------------------------------------------------- serving api
     "trace_adopted": "instant: replica adopted an inbound router traceparent instead of minting req-N",

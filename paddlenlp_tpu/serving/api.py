@@ -104,6 +104,9 @@ class ServingServer:
         self._ids = itertools.count()
         self._live: Dict[str, RequestHandle] = {}
         self._live_lock = threading.Lock()
+        # Retry-After hint stamped on drain rejections (503): set by
+        # start_drain(), defaults to a short generic backoff
+        self._drain_retry_after = 5.0
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------- submission
@@ -182,6 +185,20 @@ class ServingServer:
         self.scheduler.cancel(handle)
         return True
 
+    def start_drain(self, retry_after_s: Optional[float] = None) -> dict:
+        """Replica-side drain: stop admitting NEW requests (direct traffic
+        included — they 503 with ``Retry-After``) while in-flight streams
+        finish. The router propagates its admin-plane drains here so a
+        drained replica rejects clients that bypass the router, not just
+        router-routed traffic. The engine loop keeps running until
+        :meth:`shutdown`."""
+        if retry_after_s is not None:
+            retry_after_s = float(retry_after_s)
+            if retry_after_s > 0:
+                self._drain_retry_after = retry_after_s
+        self.scheduler.start_drain()
+        return {"draining": True, "retry_after_s": self._drain_retry_after}
+
     def _decode_delta(self, toks, emitted: int, final: bool = False):
         """Incremental detokenization: full-decode + diff. A trailing U+FFFD
         means a codepoint is still split across tokens — hold it back until the
@@ -225,6 +242,8 @@ class ServingServer:
                         headers = None
                         if status == "degraded":
                             headers = {"Retry-After": max(1, int(round(server.loop.retry_after_hint())))}
+                        elif status == "draining":
+                            headers = {"Retry-After": max(1, int(round(server._drain_retry_after)))}
                         self._send_json(200 if status == "ok" else 503, {
                             "status": status,
                             "scheduler": server.scheduler.stats(),
@@ -264,6 +283,19 @@ class ServingServer:
                         if payload is not None:
                             ok = server.abort(str(payload.get("id", "")))
                             self._send_json(200, {"id": payload.get("id"), "cancelled": ok})
+                    elif self.path == "/admin/drain":
+                        payload = self._read_body()
+                        if payload is not None:
+                            try:
+                                doc = server.start_drain(payload.get("retry_after_s"))
+                            except (TypeError, ValueError):
+                                self._send_error_json(
+                                    400,
+                                    f"retry_after_s must be a number, got "
+                                    f"{payload.get('retry_after_s')!r}",
+                                    "invalid_request")
+                            else:
+                                self._send_json(200, doc)
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")
                 except (BrokenPipeError, ConnectionResetError):
@@ -291,7 +323,12 @@ class ServingServer:
                         headers={"Retry-After": max(1, int(round(e.retry_after_s)))})
                     return
                 except ShuttingDownError as e:
-                    self._send_error_json(503, str(e), "shutting_down")
+                    # draining replica: a clean 503 WITH a retry hint so a
+                    # direct client backs off instead of hammering a server
+                    # that is leaving the fleet
+                    self._send_error_json(
+                        503, str(e), "shutting_down",
+                        headers={"Retry-After": max(1, int(round(server._drain_retry_after)))})
                     return
                 except (ValueError, TypeError) as e:
                     self._send_error_json(400, str(e), "invalid_request")

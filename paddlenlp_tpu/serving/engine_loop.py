@@ -291,6 +291,28 @@ class ServingMetrics:
             "paddlenlp_serving_decode_stall_seconds",
             "Per-step decode gap attributable to concurrent prefill-chunk work "
             "(duration of mixed steps that carried both chunks and decodes)")
+        self.stage_kv_util = r.gauge(
+            "paddlenlp_serving_stage_kv_utilization",
+            "Per-stage share of KV blocks held (disaggregated backends: TTFT "
+            "pressure lives on the prefill stage, inter-token on decode)",
+            labelnames=("stage",))
+        self.stage_queue_depth = r.gauge(
+            "paddlenlp_serving_stage_queue_depth",
+            "Per-stage queue depth of the disaggregated backend (prefill: "
+            "waiting + mid-prefill requests; decode: migrated-pending)",
+            labelnames=("stage",))
+        self.kv_migrations = r.counter(
+            "paddlenlp_serving_kv_migrations_total",
+            "Sequences whose KV blocks migrated prefill->decode (disaggregated backend)")
+        self.kv_migrated_blocks = r.counter(
+            "paddlenlp_serving_kv_migrated_blocks_total",
+            "KV blocks copied prefill->decode across stage pools")
+        self.kv_migrated_bytes = r.counter(
+            "paddlenlp_serving_kv_migrated_bytes_total",
+            "Bytes of KV copied prefill->decode (the migration-bandwidth series)")
+        self.kv_migration_inflight = r.gauge(
+            "paddlenlp_serving_kv_migration_inflight",
+            "Prefill->decode block migrations currently in flight")
         self.mesh_devices = r.gauge(
             "paddlenlp_serving_mesh_devices",
             "Devices this replica's engine backend spans (1 = single-chip)")
@@ -338,6 +360,10 @@ class ServingMetrics:
         }
         self._engine = engine
         self._chunk_last = dict(getattr(engine, "chunk_stats", {"chunks": 0}))
+        # migration counters are deltas off the backend's monotone totals; a
+        # rebuilt engine's backend restarts at 0, so rebaseline like the rest
+        self._mig_last = dict(getattr(backend, "migration_stats", None)
+                              or {"migrations": 0, "blocks": 0, "bytes": 0})
         # chunked-prefill histograms consume the engine's (seq, value) event
         # rings; start past whatever the (possibly reset-in-place) engine
         # already recorded so a rebuild never re-observes old events
@@ -386,6 +412,21 @@ class ServingMetrics:
                 if seq > seen:
                     self.decode_stall.observe(dur)
                     self._chunk_seq_seen = max(self._chunk_seq_seen, seq)
+        dg = stats.get("disagg")
+        if dg:
+            for stage in ("prefill", "decode"):
+                st = dg.get(f"{stage}_stage", {})
+                self.stage_kv_util.set(st.get("kv_utilization", 0.0), stage=stage)
+                self.stage_queue_depth.set(st.get("queue_depth", 0), stage=stage)
+            self.kv_migration_inflight.set(dg.get("migrations_inflight", 0))
+            mig = dg.get("migrations", {})
+            for key, counter in (("migrations", self.kv_migrations),
+                                 ("blocks", self.kv_migrated_blocks),
+                                 ("bytes", self.kv_migrated_bytes)):
+                delta = mig.get(key, 0) - self._mig_last.get(key, 0)
+                if delta > 0:
+                    counter.inc(delta)
+                self._mig_last[key] = mig.get(key, 0)
 
 
 class EngineLoop:

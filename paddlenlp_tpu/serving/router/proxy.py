@@ -39,12 +39,14 @@ resubmit path — the client's SSE connection never notices), and removal is
 refused with 409 until the drain lands. Membership mutations run through the
 ``router.membership`` fault point before any state changes.
 
-**Request hedging.** With ``hedge_after_s`` set, a streaming request whose
-primary forward produced no first event inside the budget races a shadow
-forward on the next ring candidate: both legs parse into a shared queue,
-nothing reaches the client until one leg produces a usable event, the winner
-relays and the loser is aborted (socket close + ``/v1/abort``). Bounded by
-``max_hedges_inflight``; counted in ``paddlenlp_router_hedges_total{outcome}``.
+**Request hedging.** With ``hedge_after_s`` set, a request whose primary
+forward produced no first event (stream) or response (batch) inside the
+budget races a shadow forward on the next ring candidate: both legs feed a
+shared queue, nothing reaches the client until one leg produces a usable
+event, the winner relays and the loser is aborted (socket close +
+``/v1/abort`` for streams with a known upstream id; batch losers are freed by
+their failed response write). Bounded by ``max_hedges_inflight``; counted in
+``paddlenlp_router_hedges_total{outcome}``.
 Deterministic (greedy / fixed-seed) sampling hedges token-exactly; hedging
 free-running sampled requests serves whichever stream wins (see the README
 for when not to hedge).
@@ -186,6 +188,66 @@ def _read_sse_events(resp):
         except ValueError:
             continue
         yield ("event", ev)
+
+
+@dataclasses.dataclass
+class _Disposition:
+    """How one upstream failure maps onto the router's attempt vocabulary."""
+
+    outcome: str  # "reroute" | "failover" | "relay"
+    replica_fault: bool = False  # demote the replica (skipped while draining)
+    is_degraded: bool = False  # the replica said 503: note_degraded
+    degraded_retry_after: Optional[str] = None  # raw Retry-After header, if any
+    status: Optional[int] = None  # relay: verbatim status ...
+    raw: bytes = b""  # ... and body
+
+    def retry_after_s(self) -> Optional[float]:
+        # RFC 7231 also allows an HTTP-date here; a non-numeric value from a
+        # proxy in front of the replica degrades to "no hint", never a crash
+        # on the relay path
+        try:
+            return float(self.degraded_retry_after) if self.degraded_retry_after else None
+        except (TypeError, ValueError):
+            return None
+
+
+def _classify_upstream_failure(kind: str, payload) -> _Disposition:
+    """THE single upstream-failure → disposition mapper.
+
+    Every way a replica can fail the router — batch or stream, plain or
+    hedged leg — funnels through here with one of four failure kinds:
+
+    - ``connect_failed``: transport error before/at the response (payload =
+      the exception). Replica fault → re-route, demote (unless draining).
+    - ``status``: non-200 HTTP status (payload = ``(status, raw_body,
+      retry_after_header)``). 429/503 are *backpressure*, not fault →
+      re-route and (503) mark degraded; ≥500 means accepted-then-failed →
+      failover; anything else is the replica judging the REQUEST itself bad
+      (400/413) → relay verbatim, another replica would say the same.
+    - ``engine_error``: in-band supervisor give-up or an unparseable body.
+      Accepted-then-failed → failover, and a replica fault for dead-leg
+      accounting.
+    - ``broke``: transport drop / close without ``[DONE]``. Same disposition
+      as ``engine_error``.
+
+    The *application* differs by context — an attempt's outcome switch
+    already demotes on "failover" (:meth:`RouterServer._apply_failure`), a
+    dead hedge leg never reaches that switch so it applies the
+    ``replica_fault`` flag itself (:meth:`RouterServer._note_dead_leg`) —
+    but the classification is written exactly once."""
+    if kind == "connect_failed":
+        return _Disposition("reroute", replica_fault=True)
+    if kind == "status":
+        status, raw, retry_after = payload
+        if status in (429, 503):
+            return _Disposition("reroute", is_degraded=status == 503,
+                                degraded_retry_after=retry_after)
+        if status >= 500:
+            return _Disposition("failover", replica_fault=True, status=status)
+        return _Disposition("relay", status=status, raw=raw or b"")
+    # engine_error / broke: the replica accepted the request, then failed it
+    # before anything usable was relayed
+    return _Disposition("failover", replica_fault=True)
 
 
 class _RelayState:
@@ -606,7 +668,34 @@ class RouterServer:
             return 404, {"error": {"message": f"unknown replica {rid!r}",
                                    "type": "unknown_replica", "code": 404}}
         self.metrics.membership_changes.inc(op="drain")
+        # replica-side propagation: tell the ServingServer itself so DIRECT
+        # traffic (clients bypassing the router) also sees 503 + Retry-After.
+        # Best-effort off-thread — a wedged replica must not stall the admin
+        # plane, and the router-side drain is already in force either way
+        replica = self.pool.get(rid)
+        if replica is not None:
+            threading.Thread(
+                target=self._propagate_drain,
+                args=(replica.host, replica.port, deadline_s),
+                daemon=True, name=f"drain-propagate-{rid}").start()
         return 200, {"drain": status}
+
+    def _propagate_drain(self, host: str, port: int, deadline_s: float) -> bool:
+        """POST /admin/drain on the draining replica (best effort)."""
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            try:
+                conn.request("POST", "/admin/drain",
+                             body=json.dumps({"retry_after_s": deadline_s}).encode(),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+            finally:
+                conn.close()
+            return resp.status == 200
+        except _UPSTREAM_ERRORS + (ValueError,) as e:
+            logger.debug(f"router: drain propagation to {host}:{port} failed: {e!r}")
+            return False
 
     def admin_remove_replica(self, rid: str, force: bool = False) -> Tuple[int, Dict]:
         """DELETE /replicas/{id}[?force=1]: take a drained (or DOWN) replica
@@ -704,7 +793,37 @@ class RouterServer:
         report = self.slo.report(now=now)
         report["replicas"] = sorted(parsed)
         report["skipped"] = skipped
+        stages = self._fold_stage_series(parsed)
+        if stages:
+            # disaggregated replicas: TTFT and inter-token latency come from
+            # different pools — surface both pressures in the SLO view so an
+            # operator sees WHICH stage is burning budget
+            report["stages"] = stages
         return report
+
+    @staticmethod
+    def _fold_stage_series(parsed: Dict[str, Dict]) -> Dict:
+        """Fleet fold of the per-stage gauges disaggregated replicas expose
+        (`paddlenlp_serving_stage_kv_utilization` / `_stage_queue_depth`):
+        worst + mean per stage across replicas. Empty for uniform fleets."""
+        folds = {"kv_utilization": "paddlenlp_serving_stage_kv_utilization",
+                 "queue_depth": "paddlenlp_serving_stage_queue_depth"}
+        out: Dict[str, Dict] = {}
+        for key, fam_name in folds.items():
+            per_stage: Dict[str, list] = {}
+            for fams in parsed.values():
+                fam = fams.get(fam_name)
+                if fam is None:
+                    continue
+                for (_sample, labels), v in fam.samples.items():
+                    stage = dict(labels).get("stage")
+                    if stage:
+                        per_stage.setdefault(stage, []).append(v)
+            for stage, vals in per_stage.items():
+                doc = out.setdefault(stage, {})
+                doc[f"{key}_max"] = max(vals)
+                doc[f"{key}_mean"] = sum(vals) / len(vals)
+        return {k: out[k] for k in sorted(out)}
 
     # ------------------------------------------------------------- trace stitch
     def stitched_trace(self, trace_id: str) -> Dict:
@@ -767,9 +886,11 @@ class RouterServer:
                 break
             cand = candidates[0]
             state.attempts += 1
-            # hedging applies to token-less streams with somewhere to hedge TO
+            # hedging applies to token-less attempts (streams that relayed
+            # nothing yet; batch requests always, nothing reaches the client
+            # before the whole body) with somewhere to hedge TO
             hedge_cand = candidates[1] if (
-                self.hedge_after_s is not None and state.stream
+                self.hedge_after_s is not None
                 and state.tokens_relayed == 0 and len(candidates) > 1) else None
             state.replica_id = cand.id
             # a fresh attempt must not inherit the previous replica's
@@ -782,7 +903,9 @@ class RouterServer:
                 if hedge_cand is not None:
                     # the hedged attempt owns both legs' inflight accounting
                     # and may re-attribute the attempt to the hedge replica
-                    outcome, cand = self._attempt_stream_hedged(
+                    hedged = (self._attempt_stream_hedged if state.stream
+                              else self._attempt_batch_hedged)
+                    outcome, cand = hedged(
                         handler, state, cand, hedge_cand, body, exclude)
                 else:
                     self._inflight_delta(cand.id, +1)
@@ -853,6 +976,39 @@ class RouterServer:
                 state.rid, f"{state.rid}@router", state.sampled),
         }
 
+    # ------------------------------------------------------------- failure plane
+    def _apply_failure(self, handler, state: _RelayState, cand: ReplicaSnapshot,
+                       failure: Tuple) -> str:
+        """Apply one classified upstream failure in *attempt* context and
+        return the outcome for the caller's switch. Demotion on "failover"
+        is deliberately left to that switch (it owns exclusion + the
+        failover span); only re-route-class replica faults demote here."""
+        kind, payload = failure
+        d = _classify_upstream_failure(kind, payload)
+        if kind == "connect_failed":
+            logger.warning(f"router: forward to {cand.id} failed: {payload!r}")
+        elif d.status is not None and d.outcome == "failover":
+            logger.warning(f"router: {cand.id} answered {d.status}")
+        if d.is_degraded:
+            self.pool.note_degraded(cand.id, retry_after_s=d.retry_after_s())
+        if d.outcome == "reroute":
+            # a drain-deadline eviction lands here too — a deliberately
+            # leaving replica must not be demoted as if it had failed
+            if d.replica_fault and not self.pool.is_draining(cand.id):
+                self.pool.note_forward_failure(cand.id)
+            return "reroute"
+        if d.outcome == "failover":
+            return "failover"
+        # relay: the replica judged the request itself bad (400/413) — relay
+        # verbatim, another replica would say the same … unless SSE headers
+        # already went out, in which case a status line would corrupt the
+        # stream and the only move left is trying elsewhere
+        if state.headers_sent:
+            return "failover"
+        self._finish(state, cand.id, "error")
+        self._relay_raw(handler, d.status, d.raw)
+        return "done"
+
     # ------------------------------------------------------------- batch leg
     def _attempt_batch(self, handler, state: _RelayState, cand: ReplicaSnapshot,
                        body: bytes) -> str:
@@ -871,27 +1027,10 @@ class RouterServer:
                 state.upstream_resp = resp
                 raw = resp.read()
             except _UPSTREAM_ERRORS as e:
-                logger.warning(f"router: forward to {cand.id} failed: {e!r}")
-                # a drain-deadline eviction lands here too — a deliberately
-                # leaving replica must not be demoted as if it had failed
-                if not self.pool.is_draining(cand.id):
-                    self.pool.note_forward_failure(cand.id)
-                return "reroute"
-            if resp.status in (429, 503):
-                self._note_reject(cand, resp)
-                return "reroute"
-            if resp.status >= 500:
-                # replica-internal failure (api.py maps unexpected exceptions
-                # to 500): the request was accepted then failed — another
-                # replica may well serve it
-                logger.warning(f"router: {cand.id} answered {resp.status}")
-                return "failover"
+                return self._apply_failure(handler, state, cand, ("connect_failed", e))
             if resp.status != 200:
-                # the replica judged the request itself bad (400/413): relay
-                # verbatim — another replica would say the same thing
-                self._finish(state, cand.id, "error")
-                self._relay_raw(handler, resp.status, raw)
-                return "done"
+                return self._apply_failure(handler, state, cand, (
+                    "status", (resp.status, raw, resp.getheader("Retry-After"))))
             try:
                 doc = json.loads(raw)
                 finish = (doc.get("choices") or [{}])[0].get("finish_reason")
@@ -900,7 +1039,7 @@ class RouterServer:
             if doc is None or finish == "engine_error":
                 # the replica accepted then failed it (or returned junk);
                 # nothing reached the client — resubmit elsewhere
-                return "failover"
+                return self._apply_failure(handler, state, cand, ("engine_error", None))
             doc["id"] = state.rid
             doc["replica"] = cand.id
             self._finish(state, cand.id, "ok")
@@ -913,12 +1052,6 @@ class RouterServer:
                 conn.close()
             except Exception:
                 pass  # may race the drain enforcer's forced close
-
-    def _note_reject(self, cand: ReplicaSnapshot, resp):
-        retry_after = resp.getheader("Retry-After")
-        if resp.status == 503:
-            self.pool.note_degraded(
-                cand.id, retry_after_s=float(retry_after) if retry_after else None)
 
     def _relay_raw(self, handler, status: int, raw: bytes):
         try:
@@ -942,28 +1075,11 @@ class RouterServer:
                 resp = conn.getresponse()
                 state.upstream_resp = resp
             except _UPSTREAM_ERRORS as e:
-                logger.warning(f"router: forward to {cand.id} failed: {e!r}")
-                # same draining guard as the failover branch: an evicted leg
-                # on a deliberately leaving replica is not a health incident
-                if not self.pool.is_draining(cand.id):
-                    self.pool.note_forward_failure(cand.id)
-                return "reroute"
-            if resp.status in (429, 503):
-                self._note_reject(cand, resp)
-                resp.read()
-                return "reroute"
-            if resp.status >= 500:
-                # replica-internal failure: accepted then failed, retryable
-                logger.warning(f"router: {cand.id} answered {resp.status}")
-                resp.read()
-                return "failover"
+                return self._apply_failure(handler, state, cand, ("connect_failed", e))
             if resp.status != 200:
                 raw = resp.read()
-                if state.headers_sent:
-                    return "failover"  # can't restate the status; try elsewhere
-                self._finish(state, cand.id, "error")
-                self._relay_raw(handler, resp.status, raw)
-                return "done"
+                return self._apply_failure(handler, state, cand, (
+                    "status", (resp.status, raw, resp.getheader("Retry-After"))))
             return self._relay_sse(handler, state, cand, _read_sse_events(resp))
         finally:
             state.upstream_conn = None
@@ -1063,7 +1179,11 @@ class RouterServer:
 
         Returns ``(outcome, replica)`` — ``replica`` is the leg the outcome
         belongs to, so the caller's exclusion/health bookkeeping follows the
-        replica that actually failed or served."""
+        replica that actually failed or served.
+
+        NOTE: :meth:`_attempt_batch_hedged` is this method's batch twin —
+        same race scaffolding over whole responses instead of SSE events; the
+        two are kept in deliberate lockstep, change both or neither."""
         # bounded: the committed leg's reader is paced by how fast the client
         # drains (TCP backpressure all the way to the replica) instead of
         # buffering a whole generation in router memory for a slow client
@@ -1187,8 +1307,8 @@ class RouterServer:
                 if 0 in failures and not hedge_started:
                     # primary failed inside the hedge budget: nothing to race —
                     # the ordinary candidate walk owns the resubmission
-                    return (self._leg_failure_outcome(handler, state, cand,
-                                                     failures[0]), cand)
+                    return (self._apply_failure(handler, state, cand,
+                                                failures[0]), cand)
                 if 0 in failures and 1 in failures:
                     break
                 # one leg died but the other is still racing: keep waiting
@@ -1202,7 +1322,7 @@ class RouterServer:
                                         outcome="failed")
                     if 1 in failures:
                         self._note_dead_leg(hedge_cand, failures[1], exclude)
-                return (self._leg_failure_outcome(
+                return (self._apply_failure(
                     handler, state, cand, failures.get(0, ("broke", None))), cand)
 
             committed_cand = legs[committed]
@@ -1256,54 +1376,171 @@ class RouterServer:
                 self._inflight_delta(hedge_cand.id, -1)
                 self._release_hedge()
 
-    def _leg_failure_outcome(self, handler, state: _RelayState,
-                             cand: ReplicaSnapshot, failure: Tuple) -> str:
-        """Map one dead hedge leg's failure onto the ordinary attempt-outcome
-        vocabulary (the caller's outcome switch owns exclusion/bookkeeping)."""
-        kind, payload = failure
-        if kind == "connect_failed":
-            logger.warning(f"router: forward to {cand.id} failed: {payload!r}")
-            self.pool.note_forward_failure(cand.id)
-            return "reroute"
-        if kind == "status":
-            status, raw, retry_after = payload
-            if status in (429, 503):
-                if status == 503:
-                    self.pool.note_degraded(
-                        cand.id,
-                        retry_after_s=float(retry_after) if retry_after else None)
-                return "reroute"
-            if status >= 500:
-                logger.warning(f"router: {cand.id} answered {status}")
-                return "failover"
-            # the replica judged the request itself bad: relay verbatim
-            if state.headers_sent:
-                return "failover"
-            self._finish(state, cand.id, "error")
-            self._relay_raw(handler, status, raw)
-            return "done"
-        # engine_error / broke / done-without-events: accepted, then failed
-        # before anything was relayed
-        return "failover"
+    def _attempt_batch_hedged(self, handler, state: _RelayState,
+                              cand: ReplicaSnapshot, hedge_cand: ReplicaSnapshot,
+                              body: bytes, exclude: set):
+        """One hedged *batch* attempt — the same loser-abort race as the
+        stream path, over whole responses instead of SSE events. The primary
+        forward starts immediately; if no leg has produced its response
+        within ``hedge_after_s`` a shadow races it on ``hedge_cand`` (bounded
+        by the same in-flight cap, counted in the same
+        ``hedges_total{outcome}``). The first leg to return a *usable* 200
+        (parseable, not an in-band ``engine_error``) is committed and relayed
+        under the router's id; the loser's socket is force-closed — a batch
+        loser has no upstream id to abort by until its body arrives, which is
+        exactly what we are not waiting for, so the replica frees the request
+        when its final write hits the dead connection.
+
+        Returns ``(outcome, replica)`` like the stream twin
+        (:meth:`_attempt_stream_hedged`) — the race scaffolding is kept in
+        deliberate lockstep with it; change both or neither."""
+        q: "queue.Queue" = queue.Queue()  # ≤1 item per leg: no bound needed
+        legs = {0: cand, 1: hedge_cand}
+        conns: Dict[int, object] = {}
+        resps: Dict[int, object] = {}
+
+        def reader(leg: int, snap: ReplicaSnapshot):
+            conn = http.client.HTTPConnection(snap.host, snap.port,
+                                              timeout=self.upstream_timeout_s)
+            conns[leg] = conn
+            if leg == 0:
+                # published pre-commit for drain-deadline eviction, exactly
+                # like the stream primary
+                state.upstream_conn = conn
+            try:
+                try:
+                    _F_FORWARD.fire(replica=snap.id)
+                    conn.request("POST", "/v1/completions", body=body,
+                                 headers=self._forward_headers(state))
+                    resp = conn.getresponse()
+                    resps[leg] = resp
+                    if leg == 0:
+                        state.upstream_resp = resp
+                    raw = resp.read()
+                except _UPSTREAM_ERRORS as e:
+                    q.put((leg, "connect_failed", e))
+                    return
+                q.put((leg, "response",
+                       (resp.status, raw, resp.getheader("Retry-After"))))
+            finally:
+                conn.close()
+
+        self._inflight_delta(cand.id, +1)
+        hedge_started = False
+        hedge_capped = False
+        committed = None  # (leg, parsed response doc)
+        failures: Dict[int, Tuple[str, object]] = {}
+        threading.Thread(target=reader, args=(0, cand), daemon=True,
+                         name=f"hedge-batch-primary-{state.rid}").start()
+        hedge_deadline = time.perf_counter() + float(self.hedge_after_s)
+        try:
+            while committed is None:
+                deciding = not hedge_started and not hedge_capped
+                timeout = (max(hedge_deadline - time.perf_counter(), 0.001)
+                           if deciding else self.upstream_timeout_s)
+                try:
+                    leg, kind, payload = q.get(timeout=timeout)
+                except queue.Empty:
+                    if deciding and time.perf_counter() >= hedge_deadline:
+                        if self._try_start_hedge():
+                            hedge_started = True
+                            self.tracer.instant("hedge", cat="router",
+                                                trace=state.rid, outcome="fired",
+                                                replica=hedge_cand.id)
+                            self._inflight_delta(hedge_cand.id, +1)
+                            threading.Thread(
+                                target=reader, args=(1, hedge_cand), daemon=True,
+                                name=f"hedge-batch-shadow-{state.rid}").start()
+                        else:
+                            hedge_capped = True
+                            self.metrics.hedges.inc(outcome="capped")
+                            self.tracer.instant("hedge", cat="router",
+                                                trace=state.rid, outcome="capped")
+                        continue
+                    if deciding:
+                        continue  # spurious early wake
+                    # silence past the upstream timeout: every racing leg is
+                    # wedged — tear them down so the replicas notice
+                    for wedged in (0, 1) if hedge_started else (0,):
+                        failures.setdefault(wedged, ("broke", None))
+                        _force_close(conns.get(wedged), resps.get(wedged))
+                    break
+                if kind == "response":
+                    status, raw, retry_after = payload
+                    if status == 200:
+                        try:
+                            doc = json.loads(raw)
+                            finish = (doc.get("choices") or [{}])[0].get("finish_reason")
+                        except (ValueError, AttributeError, IndexError):
+                            doc, finish = None, None
+                        if doc is not None and finish != "engine_error":
+                            committed = (leg, doc)
+                            break
+                        failures[leg] = ("engine_error", None)
+                    else:
+                        failures[leg] = ("status", (status, raw, retry_after))
+                else:
+                    failures[leg] = (kind, payload)
+                if 0 in failures and not hedge_started:
+                    # primary failed inside the hedge budget: nothing to race
+                    return (self._apply_failure(handler, state, cand,
+                                                failures[0]), cand)
+                if 0 in failures and 1 in failures:
+                    break
+
+            if committed is None:
+                if hedge_started:
+                    self.metrics.hedges.inc(outcome="failed")
+                    self.tracer.instant("hedge", cat="router", trace=state.rid,
+                                        outcome="failed")
+                    if 1 in failures:
+                        self._note_dead_leg(hedge_cand, failures[1], exclude)
+                return (self._apply_failure(
+                    handler, state, cand, failures.get(0, ("broke", None))), cand)
+
+            win_leg, doc = committed
+            committed_cand = legs[win_leg]
+            loser = 1 - win_leg
+            if loser == 0 or hedge_started:  # the loser leg actually ran
+                if loser in failures:
+                    self._note_dead_leg(legs[loser], failures[loser], exclude)
+                else:
+                    # still generating: closing its socket is the abort — the
+                    # replica frees slot + KV when its response write fails
+                    _force_close(conns.get(loser), resps.get(loser))
+            if hedge_started:
+                label = "hedge_won" if win_leg == 1 else "primary_won"
+                self.metrics.hedges.inc(outcome=label)
+                self.tracer.instant("hedge", cat="router", trace=state.rid,
+                                    outcome=label, replica=committed_cand.id)
+            state.replica_id = committed_cand.id
+            doc["id"] = state.rid
+            doc["replica"] = committed_cand.id
+            self._finish(state, committed_cand.id, "ok")
+            self._relay_raw(handler, 200, json.dumps(doc).encode())
+            return ("done", committed_cand)
+        finally:
+            state.upstream_conn = None
+            state.upstream_resp = None
+            self._inflight_delta(cand.id, -1)
+            if hedge_started:
+                self._inflight_delta(hedge_cand.id, -1)
+                self._release_hedge()
 
     def _note_dead_leg(self, cand: ReplicaSnapshot, failure: Tuple, exclude: set):
         """Health/metrics bookkeeping for a hedged leg that died while the
-        OTHER leg carried the request (the outcome switch never sees it)."""
+        OTHER leg carried the request: same classification as every attempt
+        (:func:`_classify_upstream_failure`), dead-leg application — the
+        outcome switch never sees this leg, so exclusion, the re-route/
+        failover counters and the replica-fault demotion apply here."""
         kind, payload = failure
+        d = _classify_upstream_failure(kind, payload)
         exclude.add(cand.id)
-        if kind == "status":
-            status, _raw, retry_after = payload
-            if status == 503:
-                self.pool.note_degraded(
-                    cand.id, retry_after_s=float(retry_after) if retry_after else None)
-            if status in (429, 503):
-                self.metrics.rerouted.inc()
-            else:
-                self.metrics.failovers.inc()
-            return
-        if not self.pool.is_draining(cand.id):
+        if d.is_degraded:
+            self.pool.note_degraded(cand.id, retry_after_s=d.retry_after_s())
+        if d.replica_fault and not self.pool.is_draining(cand.id):
             self.pool.note_forward_failure(cand.id)
-        if kind == "connect_failed":
+        if d.outcome == "reroute":
             self.metrics.rerouted.inc()
         else:
             self.metrics.failovers.inc()

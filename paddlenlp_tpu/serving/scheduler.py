@@ -171,10 +171,18 @@ class Scheduler:
             "rejected_degraded": self.rejected_degraded,
         }
 
-    def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
-        """Stop admitting; wait for in-flight work. Returns True if empty."""
+    def start_drain(self):
+        """Flip to rejecting new work WITHOUT waiting for in-flight requests
+        — replica-side drain propagation: the router (or an operator) tells
+        this server it is leaving the fleet, new direct traffic 503s
+        immediately while accepted streams keep finishing."""
         with self._lock:
             self._draining = True
+        TRACER.instant("membership", cat="scheduler", op="drain_direct")
+
+    def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
+        """Stop admitting; wait for in-flight work. Returns True if empty."""
+        self.start_drain()
         ok = self._idle.wait(timeout=timeout_s)
         if not ok:
             logger.warning(f"scheduler drain timed out with {self.inflight} in flight")

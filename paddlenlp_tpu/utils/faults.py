@@ -74,6 +74,12 @@ CATALOG: Dict[str, str] = {
                             "requests partially prefilled (no token emitted) and must "
                             "triage through the supervisor with token-exact retry and "
                             "no leaked KV blocks.",
+    "engine.kv_migrate": "Immediately before the engine dispatches one sequence's "
+                         "prefill→decode KV-block migration (disaggregated backend) — "
+                         "a failure here hits a request whose first token already "
+                         "streamed; the supervisor must degrade, rebuild both stages "
+                         "and requeue token-exactly with no block leaked in either "
+                         "pool.",
     "serving.submit": "Inside Scheduler.submit after the admission slot is taken — "
                       "exercises the release-on-error path and HTTP 500 mapping.",
     "router.forward": "Immediately before the router opens the upstream connection for "

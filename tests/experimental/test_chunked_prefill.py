@@ -37,6 +37,14 @@ def eng_chunk(model):
     return InferenceEngine(model, prefill_chunk_tokens=8, **KW)
 
 
+@pytest.fixture(scope="module")
+def eng_chunk4(model):
+    """Shared chunk-budget-4 engine for the interleave/bounding tests; each
+    test uses prompts with unique leading blocks so cross-test prefix-cache
+    hits can't change the chunk walk under test."""
+    return InferenceEngine(model, prefill_chunk_tokens=4, **KW)
+
+
 class TestChunkedParity:
     def test_greedy_token_identical(self, eng_mono, eng_chunk):
         want = eng_mono.generate(PROMPTS, SamplingParams(max_new_tokens=8))
@@ -84,18 +92,19 @@ class TestChunkedParity:
         eng.infer.use_paged_kernel = True  # interpret mode on CPU
         assert eng.generate(PROMPTS, SamplingParams(max_new_tokens=6)) == want
 
-    def test_prefix_cache_fed_suffix_chunked(self, model):
+    def test_prefix_cache_fed_suffix_chunked(self, model, eng_mono):
         """Warm admissions start chunking at the cached length; outputs match
-        monolithic with the cache AND chunked without it. Fresh engines: the
-        test asserts exact hit counts, so the cache must start empty."""
+        monolithic with the cache AND chunked without it. The chunked arms use
+        fresh engines (the test asserts exact hit counts, so their caches must
+        start empty); the monolithic arm rides the shared engine — a warm
+        cache must not change its outputs, which is the invariant itself."""
         shared = list(range(5, 21))  # 16 tokens = 4 full blocks
         first = [shared + [50]]
         warm = [shared + [60, 61, 62]]
-        results = {}
-        for key, chunk, cache in (("mono_cache", None, True),
-                                  ("chunk_cache", 8, True),
-                                  ("chunk_nocache", 8, False)):
-            eng = InferenceEngine(model, prefill_chunk_tokens=chunk,
+        eng_mono.generate(first, SamplingParams(max_new_tokens=4))
+        results = {"mono_cache": eng_mono.generate(warm, SamplingParams(max_new_tokens=6))}
+        for key, cache in (("chunk_cache", True), ("chunk_nocache", False)):
+            eng = InferenceEngine(model, prefill_chunk_tokens=8,
                                   enable_prefix_cache=cache, **KW)
             eng.generate(first, SamplingParams(max_new_tokens=4))
             results[key] = eng.generate(warm, SamplingParams(max_new_tokens=6))
@@ -106,9 +115,9 @@ class TestChunkedParity:
         assert results["chunk_cache"] == results["mono_cache"]
         assert results["chunk_nocache"] == results["mono_cache"]
 
-    def test_per_step_prefill_bounded(self, model):
+    def test_per_step_prefill_bounded(self, eng_chunk4):
         """No engine step feeds more prompt tokens than the chunk budget."""
-        eng = InferenceEngine(model, prefill_chunk_tokens=4, **KW)
+        eng = eng_chunk4
         eng.add_request(list(range(5, 35)), SamplingParams(max_new_tokens=2))
         fed_per_step = []
         while eng.has_work():
@@ -120,35 +129,38 @@ class TestChunkedParity:
 
 
 class TestChunkedInterleave:
-    def test_decode_flows_during_long_prefill(self, eng_mono, model):
+    def test_decode_flows_during_long_prefill(self, eng_mono, eng_chunk4):
         """The serving property itself: a running request keeps emitting
         tokens on the very steps a long prompt is chunk-prefilling."""
         want = eng_mono.generate([[5, 6, 7, 8]], SamplingParams(max_new_tokens=12))[0]
 
-        eng = InferenceEngine(model, prefill_chunk_tokens=4, **KW)
-        eng.add_request([5, 6, 7, 8], SamplingParams(max_new_tokens=12))
-        done = list(eng.step())  # prefill chunk + first token
+        eng = eng_chunk4
+        stalls0 = len(eng.recent_decode_stalls)
+        short = eng.add_request([5, 6, 7, 8], SamplingParams(max_new_tokens=12))
+        done = list(eng.step())  # prefill chunk(s) + first token
+        chunks0 = eng.chunk_stats["chunks"]  # long-prompt chunking not started
         eng.add_request(list(range(10, 40)), SamplingParams(max_new_tokens=4))
         interleaved = 0
         while eng.has_work():
-            running = next((r for r in eng.slots if r is not None and r.req_id == 0), None)
+            running = next((r for r in eng.slots
+                            if r is not None and r.req_id == short), None)
             n_before = len(running.output_ids) if running is not None else None
             done += eng.step()
             if n_before is not None and len(running.output_ids) > n_before \
-                    and eng.chunk_stats["chunks"] > 1:
+                    and eng.chunk_stats["chunks"] > chunks0:
                 interleaved += 1
         res = {r.req_id: r.output_ids for r in done}
-        assert res[0] == list(want)
+        assert res[short] == list(want)
         assert interleaved > 0  # decode advanced while the long prompt filled
-        assert len(eng.recent_decode_stalls) > 0  # stall events recorded
+        assert len(eng.recent_decode_stalls) > stalls0  # stall events recorded
 
-    def test_preempt_half_prefilled_folds_state(self, model):
+    def test_preempt_half_prefilled_folds_state(self, model, eng_mono):
         """Pool pressure evicts the youngest slot mid-prefill; after requeue +
-        re-admission the stream is token-exact and no KV block leaks."""
+        re-admission the stream is token-exact and no KV block leaks. The
+        reference run rides the shared monolithic engine — both requests fit
+        its batch at once, so the outputs are batch-capacity-independent."""
         long_p = list(range(10, 34))  # 24 tokens
-        ref_eng = InferenceEngine(model, max_batch_size=2, block_size=4,
-                                  num_blocks=128, max_blocks_per_seq=32)
-        want = ref_eng.generate([[5, 6, 7], long_p], SamplingParams(max_new_tokens=10))
+        want = eng_mono.generate([[5, 6, 7], long_p], SamplingParams(max_new_tokens=10))
 
         eng = InferenceEngine(model, prefill_chunk_tokens=4, max_batch_size=2,
                               block_size=4, num_blocks=11, max_blocks_per_seq=32)
@@ -164,17 +176,17 @@ class TestChunkedInterleave:
         assert streams[1] == want[1]
         assert eng.mgr.num_free == eng.mgr.total_usable_blocks  # no leak
 
-    def test_oldest_prefill_gets_budget_first(self, model):
+    def test_oldest_prefill_gets_budget_first(self, eng_chunk4):
         """A newly-admitted prompt landing in a lower slot index must not
         starve an older mid-prefill request: the chunk budget is handed out
         oldest-request-first, not in slot order."""
-        eng = InferenceEngine(model, prefill_chunk_tokens=4, **KW)
-        eng.add_request([5, 6, 7], SamplingParams(max_new_tokens=2))  # slot 0
+        eng = eng_chunk4
+        eng.add_request([65, 66, 67], SamplingParams(max_new_tokens=2))  # slot 0
         eng.step()  # chunk + first token
-        a = eng.add_request(list(range(10, 40)), SamplingParams(max_new_tokens=2))
+        a = eng.add_request(list(range(36, 66)), SamplingParams(max_new_tokens=2))
         eng.step()  # A -> slot 1, first chunk; the short request finishes
         assert eng.slots[0] is None  # a free slot BELOW mid-prefill A
-        b = eng.add_request(list(range(40, 70)), SamplingParams(max_new_tokens=2))
+        b = eng.add_request(list(range(48, 78)), SamplingParams(max_new_tokens=2))
         eng.step()  # B admitted into slot 0, younger than A
         req_a = next(r for r in eng.slots if r is not None and r.req_id == a)
         req_b = next(r for r in eng.slots if r is not None and r.req_id == b)
@@ -184,9 +196,9 @@ class TestChunkedInterleave:
         while eng.has_work():
             eng.step()
 
-    def test_abort_mid_prefill_frees_blocks(self, model):
-        eng = InferenceEngine(model, prefill_chunk_tokens=4, **KW)
-        rid = eng.add_request(list(range(5, 35)), SamplingParams(max_new_tokens=4))
+    def test_abort_mid_prefill_frees_blocks(self, eng_chunk4):
+        eng = eng_chunk4
+        rid = eng.add_request(list(range(2, 32)), SamplingParams(max_new_tokens=4))
         eng.step()  # admitted, one chunk in
         req = next(r for r in eng.slots if r is not None)
         assert req.needs_prefill and req.prefilled_len > 0
@@ -270,28 +282,33 @@ class TestTokenFlattenedLayout:
             outs[flat] = eng.generate(prompts, SamplingParams(max_new_tokens=10))
         assert outs[True] == outs[False]
 
-    def test_flat_feeds_fewer_padded_rows(self, model):
+    def test_flat_feeds_fewer_padded_rows(self, eng_chunk):
         """The point of the layout: with one long prompt chunking while three
         short requests decode, the flat step's chunk segment holds 1 row, not
-        max_batch_size — assert via the backend's segment shapes."""
-        eng = InferenceEngine(model, prefill_chunk_tokens=8, **KW)
+        max_batch_size — assert via the backend's segment shapes. Rides the
+        shared chunk engine (the spy is restored); the long prompt's leading
+        block is unique to this test so no cache hit shortens the chunk walk."""
+        eng = eng_chunk
         seen = []
-        orig = eng.backend._mixed_flat
+        orig = eng.backend._mixed_flat_launch
 
         def spy(chunk_rows, decode_rows):
             seen.append((len(chunk_rows), len(decode_rows)))
             return orig(chunk_rows, decode_rows)
 
-        eng.backend._mixed_flat = spy
-        for p in ([40 + i] for i in range(3)):
-            eng.add_request(list(p) + [7, 8], SamplingParams(max_new_tokens=24))
-        for _ in range(3):
-            eng.step()  # the shorties admit + start decoding
-        eng.add_request(list(range(5, 37)), SamplingParams(max_new_tokens=4))
-        for _ in range(4):
-            eng.step()
-        while eng.has_work():
-            eng.step()
+        eng.backend._mixed_flat_launch = spy
+        try:
+            for p in ([40 + i] for i in range(3)):
+                eng.add_request(list(p) + [7, 8], SamplingParams(max_new_tokens=24))
+            for _ in range(3):
+                eng.step()  # the shorties admit + start decoding
+            eng.add_request(list(range(41, 73)), SamplingParams(max_new_tokens=4))
+            for _ in range(4):
+                eng.step()
+            while eng.has_work():
+                eng.step()
+        finally:
+            eng.backend._mixed_flat_launch = orig
         mixed = [s for s in seen if s[0] and s[1]]
         assert mixed, "no step carried chunks and decodes together"
         # every mixed step fed exactly the live rows: 1 chunk row + <=3 decodes

@@ -1,0 +1,225 @@
+"""Disaggregated-backend stage-loss chaos: migration and stage-init faults.
+
+A disagg replica has two new ways to die that a single-pool one doesn't:
+
+- the prefill→decode KV-block migration dispatch (``engine.kv_migrate``) —
+  it hits a request whose FIRST token already streamed, so recovery must
+  fold that token into the requeue prompt and continue token-exactly;
+- either stage's mesh/layout construction during a supervisor rebuild
+  (``engine.shard_init``, fired once per stage) — a failed stage init must
+  extend the DEGRADED window, not crash-loop, and the next attempt must
+  bring BOTH stages back.
+
+With concurrent SSE streams in flight and both faults armed, the run must
+end with zero stream loss, token-exact outputs vs a solo disagg run, and no
+KV block leaked in either pool. Runs on the conftest's 8 virtual CPU devices
+(1+1 stages keep compiles cheap)."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import (
+    MetricsRegistry,
+    SchedulerConfig,
+    ServingServer,
+    SupervisorPolicy,
+)
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+def get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def post_json(port, path, payload, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}"), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+class SSEStream:
+    def __init__(self, port, payload, timeout=300):
+        self.conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        self.conn.request("POST", "/v1/completions", body=json.dumps(payload),
+                          headers={"Content-Type": "application/json"})
+        self.resp = self.conn.getresponse()
+        self.status = self.resp.status
+
+    def events(self):
+        while True:
+            line = self.resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                return
+            yield json.loads(data)
+
+    def close(self):
+        self.conn.close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model(eight_devices):
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+                      max_position_embeddings=256, eos_token_id=None, pad_token_id=0,
+                      use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine(model):
+    return InferenceEngine(model, disagg_stages=(1, 1), max_batch_size=4,
+                           block_size=4, num_blocks=128, max_blocks_per_seq=32,
+                           decode_steps=4)
+
+
+GEN_LEN = 12
+
+
+class TestDisaggStageLoss:
+    def test_migrate_fault_then_shard_init_fault_zero_stream_loss(self, model):
+        """engine.kv_migrate kills a step whose victims already streamed their
+        first token; rebuild attempt 1 dies inside a stage's mesh init
+        (engine.shard_init); attempt 2 recovers — every stream finishes
+        token-exact, nothing leaks in either pool."""
+        n_stream = 4
+        registry = MetricsRegistry()
+        srv = ServingServer(
+            make_engine(model),
+            engine_factory=lambda: make_engine(model),
+            supervisor_policy=SupervisorPolicy(max_retries=2, backoff_base_s=0.5,
+                                               backoff_max_s=1.5),
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0),
+            registry=registry,
+        )
+        port = srv.start_in_thread()
+        try:
+            # armed AFTER the first engine exists: the first migration attempt
+            # dies (the victims have exactly their prefill-sampled token
+            # streamed), then the rebuild's FIRST stage construction dies too
+            FAULTS.arm("engine.kv_migrate", nth=1)
+            FAULTS.arm("engine.shard_init", nth=1)
+
+            results = {}
+
+            def stream_worker(i):
+                s = SSEStream(port, {"prompt": [5 + i, 6 + i, 7 + i],
+                                     "max_tokens": GEN_LEN, "stream": True})
+                assert s.status == 200
+                toks, finish = [], None
+                for ev in s.events():
+                    c = ev["choices"][0]
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+                    elif "token" in c:
+                        toks.append(c["token"])
+                results[i] = (toks, finish)
+                s.close()
+
+            threads = [threading.Thread(target=stream_worker, args=(i,))
+                       for i in range(n_stream)]
+            for t in threads:
+                t.start()
+
+            deadline = time.time() + 120
+            while time.time() < deadline and not srv.loop.degraded:
+                time.sleep(0.01)
+            assert srv.loop.degraded, "engine.kv_migrate fault never tripped the supervisor"
+            status, health, _ = get_json(port, "/health")
+            assert status == 503 and health["status"] == "degraded"
+            status, body, headers = post_json(
+                port, "/v1/completions", {"prompt": [1, 2, 3], "max_tokens": 2})
+            assert status == 503
+            assert int(headers.get("Retry-After", 0)) >= 1
+
+            for t in threads:
+                t.join(timeout=600)
+            assert not any(t.is_alive() for t in threads)
+
+            # both faults actually happened: the migration died, then one
+            # stage's mesh init killed rebuild attempt 1
+            assert FAULTS.fired("engine.kv_migrate") == 1
+            assert FAULTS.fired("engine.shard_init") == 1
+            assert registry.get("paddlenlp_serving_engine_restarts_total").value() >= 1
+
+            # zero stream loss, token-exact vs a solo disagg run
+            assert len(results) == n_stream
+            for i, (toks, finish) in results.items():
+                assert finish == "length", (i, finish)
+                assert len(toks) == GEN_LEN, (i, len(toks))
+            solo = make_engine(model).generate(
+                [[5, 6, 7]], SamplingParams(max_new_tokens=GEN_LEN))[0]
+            np.testing.assert_array_equal(results[0][0], solo)
+
+            # no KV leak in either pool: the shared block-id space is whole,
+            # every requeued stream re-migrated on the rebuilt engine, and no
+            # migration state is stranded
+            eng = srv.loop.engine
+            assert eng.mgr.num_free == eng.mgr.total_usable_blocks
+            assert not eng._migrating and not eng._migrate_pending
+            assert eng.stats()["backend"]["kind"] == "disagg"
+            assert eng.backend.migration_stats["migrations"] >= n_stream
+            # the migration series made it to the metrics plane
+            assert registry.get("paddlenlp_serving_kv_migrations_total").value() >= n_stream
+        finally:
+            srv.shutdown(drain_timeout_s=10)
+
+    def test_direct_engine_migrate_fault_partial_state_and_abort(self, model):
+        """Engine-level view of the same fault: step() raises at the
+        migration dispatch, the handoff stays QUEUED (pre-pop fire), a bare
+        retry step completes it, and aborting instead leaks nothing."""
+        eng = make_engine(model)
+        FAULTS.arm("engine.kv_migrate", nth=1)
+        rid = eng.add_request([5, 6, 7, 8], SamplingParams(max_new_tokens=4))
+        eng.step()  # admit + prefill: first token sampled, migration queued
+        with pytest.raises(Exception, match="injected fault"):
+            while eng.has_work():
+                eng.step()
+        req = next(r for r in eng.slots if r is not None)
+        assert req.kv_stage == "migrating"
+        assert list(eng._migrate_pending) == [rid]  # handoff still queued
+        # bare retry (the fault fires once): the queued migration completes
+        while eng.has_work():
+            eng.step()
+        assert len(req.output_ids) == 4
+        assert eng.mgr.num_free == eng.mgr.total_usable_blocks
+
+        # abort-instead variant: release mid-migration, nothing leaks
+        FAULTS.arm("engine.kv_migrate", nth=1)
+        rid2 = eng.add_request([50, 51, 52, 53], SamplingParams(max_new_tokens=4))
+        eng.step()
+        with pytest.raises(Exception, match="injected fault"):
+            while eng.has_work():
+                eng.step()
+        assert eng.abort(rid2) is not None
+        assert not eng._migrating and not eng._migrate_pending
+        assert eng.mgr.num_free == eng.mgr.total_usable_blocks

@@ -268,6 +268,7 @@ class StubReplica:
         self.token_delay_s = token_delay_s
         self.requests = []  # /v1/completions payloads received
         self.aborts = []  # /v1/abort payloads received
+        self.drains = []  # /admin/drain payloads received (drain propagation)
         self._ids = iter(range(10_000))
         stub = self
 
@@ -279,13 +280,18 @@ class StubReplica:
 
             def _json(self, code, payload, headers=None):
                 body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, str(v))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, str(v))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # the router tore this leg down on purpose (batch hedge
+                    # loser): not an error worth a stack trace
+                    pass
 
             def do_GET(self):
                 if self.path == "/health":
@@ -312,6 +318,12 @@ class StubReplica:
                 if self.path == "/v1/abort":
                     stub.aborts.append(payload)
                     self._json(200, {"id": payload.get("id"), "cancelled": True})
+                    return
+                if self.path == "/admin/drain":
+                    # replica-side drain propagation (the real server flips
+                    # its scheduler to draining here)
+                    stub.drains.append(payload)
+                    self._json(200, {"draining": True})
                     return
                 stub.requests.append(payload)
                 if "prompt" not in payload:  # mirror the real server's validation
@@ -342,6 +354,9 @@ class StubReplica:
                                                   "token_ids": []}]})
                     return
                 toks = stub.tokens[: int(payload.get("max_tokens", 16))]
+                if stub.token_delay_s:
+                    # batch "generation time": the whole response arrives late
+                    time.sleep(stub.token_delay_s * len(toks))
                 self._json(200, {"id": cid, "object": "text_completion",
                                  "choices": [{"index": 0, "finish_reason": "length",
                                               "token_ids": toks}],
@@ -807,6 +822,12 @@ class TestMembership:
         status, body, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
         assert status == 200 and body["replica"] == "b"
         assert len(a.requests) == 0
+        # … and the drain PROPAGATED to the replica itself (best-effort
+        # off-thread POST /admin/drain), so direct traffic 503s there too
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.drains:
+            time.sleep(0.01)
+        assert a.drains and a.drains[0].get("retry_after_s") == 30.0
         # removal refused until the drain lands (no sweep has run yet)
         status, doc, _ = admin_delete(port, "/replicas/a")
         assert status == 409 and doc["error"]["type"] == "drain_pending"
@@ -941,3 +962,129 @@ class TestHedging:
         assert status == 200
         assert body["tokens"] == [7, 8, 9] and body["finish"] == "length"
         assert {s.id: s for s in router.pool.snapshots()}["a"].state != HEALTHY
+
+
+class TestBatchHedging:
+    """First-token hedging extended to non-stream /v1/completions: same
+    loser-abort race and hedges_total accounting, over whole responses."""
+
+    def test_batch_hedge_fires_and_wins(self, stub_router):
+        a = StubReplica(tokens=(1, 2, 3), token_delay_s=0.3)  # ~0.9s to respond
+        b = StubReplica(tokens=(7, 8, 9))
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=0.08)
+        status, doc, _ = post_completion(port, {"prompt": [1], "max_tokens": 3})
+        assert status == 200
+        assert doc["choices"][0]["token_ids"] == [7, 8, 9]
+        assert doc["id"].startswith("rtr-") and doc["replica"] == "b"
+        assert reg.get("paddlenlp_router_hedges_total").value(outcome="hedge_won") == 1
+        assert len(a.requests) == 1 and len(b.requests) == 1
+        assert reg.get("paddlenlp_router_requests_total").value(
+            replica="b", outcome="ok") == 1
+        # losing is not a health incident: the slow replica stays offered
+        assert {s.id: s for s in router.pool.snapshots()}["a"].state == HEALTHY
+
+    def test_batch_primary_wins_after_hedge_fired(self, stub_router):
+        a = StubReplica(tokens=(1, 2), token_delay_s=0.15)
+        b = StubReplica(tokens=(7, 8), token_delay_s=2.0)
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=0.08)
+        status, doc, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200
+        assert doc["choices"][0]["token_ids"] == [1, 2] and doc["replica"] == "a"
+        assert reg.get("paddlenlp_router_hedges_total").value(outcome="primary_won") == 1
+        assert len(b.requests) == 1  # the shadow really fired ...
+        assert reg.get("paddlenlp_router_requests_total").value(
+            replica="a", outcome="ok") == 1  # ... but the primary served
+
+    def test_batch_no_hedge_inside_budget(self, stub_router):
+        a, b = StubReplica(tokens=(1, 2)), StubReplica(tokens=(7, 8))
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=5.0)
+        status, doc, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200 and doc["choices"][0]["token_ids"] == [1, 2]
+        assert len(b.requests) == 0
+        for outcome in ("fired", "primary_won", "hedge_won", "capped", "failed"):
+            assert reg.get("paddlenlp_router_hedges_total").value(outcome=outcome) == 0
+
+    def test_batch_hedge_cap_suppresses_shadow(self, stub_router):
+        a = StubReplica(tokens=(1, 2), token_delay_s=0.15)
+        b = StubReplica(tokens=(7, 8))
+        router, port, reg = stub_router([("a", a), ("b", b)],
+                                        hedge_after_s=0.05, max_hedges_inflight=0)
+        status, doc, _ = post_completion(port, {"prompt": [1], "max_tokens": 2})
+        assert status == 200
+        assert doc["choices"][0]["token_ids"] == [1, 2]  # primary, just slowly
+        assert len(b.requests) == 0
+        assert reg.get("paddlenlp_router_hedges_total").value(outcome="capped") == 1
+
+    def test_batch_hedge_survives_primary_engine_error(self, stub_router):
+        """Primary answers an in-band engine_error while the shadow races: the
+        shadow's response serves and the dead replica is excluded/demoted —
+        classified by the same failure→disposition mapper as every leg."""
+        a = StubReplica(mode="engine_error_pre")
+        b = StubReplica(tokens=(7, 8, 9), token_delay_s=0.1)
+        router, port, reg = stub_router([("a", a), ("b", b)], hedge_after_s=0.05)
+        status, doc, _ = post_completion(port, {"prompt": [1], "max_tokens": 3})
+        assert status == 200
+        assert doc["choices"][0]["token_ids"] == [7, 8, 9] and doc["replica"] == "b"
+        assert {s.id: s for s in router.pool.snapshots()}["a"].state != HEALTHY
+
+
+class TestFailureClassification:
+    """The single upstream-failure → disposition mapper (unit level)."""
+
+    def test_http_date_retry_after_does_not_crash(self):
+        from paddlenlp_tpu.serving.router.proxy import _classify_upstream_failure
+
+        d = _classify_upstream_failure(
+            "status", (503, b"", "Fri, 07 Aug 2026 07:28:00 GMT"))
+        assert d.outcome == "reroute" and d.is_degraded
+        assert d.retry_after_s() is None  # RFC 7231 date form: no hint, no crash
+        assert _classify_upstream_failure(
+            "status", (503, b"", "7")).retry_after_s() == 7.0
+
+    def test_classification_table(self):
+        from paddlenlp_tpu.serving.router.proxy import _classify_upstream_failure
+
+        assert _classify_upstream_failure("connect_failed", OSError()).outcome == "reroute"
+        assert _classify_upstream_failure("status", (429, b"", None)).outcome == "reroute"
+        five = _classify_upstream_failure("status", (500, b"", None))
+        assert five.outcome == "failover" and five.replica_fault
+        relay = _classify_upstream_failure("status", (400, b"x", None))
+        assert relay.outcome == "relay" and relay.raw == b"x" and not relay.replica_fault
+        for kind in ("engine_error", "broke"):
+            d = _classify_upstream_failure(kind, None)
+            assert d.outcome == "failover" and d.replica_fault
+
+
+class TestStageFold:
+    """Fleet fold of disaggregated replicas' per-stage gauges into /fleet/slo."""
+
+    def test_fold_stage_series(self):
+        from paddlenlp_tpu.observability import parse_prometheus_text
+
+        def expo(p_util, d_util, p_q):
+            return (
+                "# HELP paddlenlp_serving_stage_kv_utilization x\n"
+                "# TYPE paddlenlp_serving_stage_kv_utilization gauge\n"
+                f'paddlenlp_serving_stage_kv_utilization{{stage="prefill"}} {p_util}\n'
+                f'paddlenlp_serving_stage_kv_utilization{{stage="decode"}} {d_util}\n'
+                "# HELP paddlenlp_serving_stage_queue_depth x\n"
+                "# TYPE paddlenlp_serving_stage_queue_depth gauge\n"
+                f'paddlenlp_serving_stage_queue_depth{{stage="prefill"}} {p_q}\n')
+
+        parsed = {"r0": parse_prometheus_text(expo(0.8, 0.2, 5)),
+                  "r1": parse_prometheus_text(expo(0.4, 0.6, 1))}
+        out = RouterServer._fold_stage_series(parsed)
+        assert out["prefill"]["kv_utilization_max"] == 0.8
+        assert out["prefill"]["kv_utilization_mean"] == pytest.approx(0.6)
+        assert out["decode"]["kv_utilization_max"] == 0.6
+        assert out["prefill"]["queue_depth_max"] == 5
+        assert "queue_depth_max" not in out["decode"]  # series absent → no key
+
+    def test_fold_empty_for_uniform_fleet(self):
+        from paddlenlp_tpu.observability import parse_prometheus_text
+
+        uniform = parse_prometheus_text(
+            "# HELP paddlenlp_serving_kv_utilization x\n"
+            "# TYPE paddlenlp_serving_kv_utilization gauge\n"
+            "paddlenlp_serving_kv_utilization 0.5\n")
+        assert RouterServer._fold_stage_series({"r0": uniform}) == {}

@@ -384,3 +384,66 @@ class TestSimpleServerHardening:
             assert status == 200 and body["result"] == "hi"
         finally:
             srv.shutdown()
+
+
+# --------------------------------------------------------------------- drain propagation
+class TestReplicaSideDrain:
+    """POST /admin/drain: a drained ServingServer 503s new DIRECT traffic
+    (with Retry-After) while accepted streams finish — the replica-side half
+    of the router's admin-plane drain."""
+
+    def test_direct_traffic_503_while_inflight_finishes(self, model):
+        import http.client as hc
+
+        srv = ServingServer(
+            make_engine(model),
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=300.0),
+            registry=MetricsRegistry(),
+        )
+        port = srv.start_in_thread()
+        try:
+            # open a stream BEFORE the drain: it must finish normally
+            s = SSEStream(port, {"prompt": [5, 6, 7], "max_tokens": 6, "stream": True})
+            assert s.status == 200
+            status, doc = post_json(port, "/admin/drain", {"retry_after_s": 12})
+            assert status == 200 and doc["draining"] is True
+            assert doc["retry_after_s"] == 12.0
+            # new direct traffic: clean 503 + Retry-After, no connection reset
+            conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": [1, 2], "max_tokens": 2}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 503
+            assert body["error"]["type"] == "shutting_down"
+            assert int(resp.getheader("Retry-After")) == 12
+            conn.close()
+            # /health reports draining with the same hint
+            conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            health = json.loads(resp.read())
+            assert resp.status == 503 and health["status"] == "draining"
+            assert int(resp.getheader("Retry-After")) == 12
+            conn.close()
+            # the pre-drain stream still completes token-for-token
+            toks = [ev["choices"][0]["token"] for ev in s.events()
+                    if "token" in ev["choices"][0]]
+            s.close()
+            assert len(toks) == 6
+        finally:
+            srv.shutdown(drain_timeout_s=5)
+
+    def test_admin_drain_validates_body(self, model):
+        srv = ServingServer(make_engine(model), registry=MetricsRegistry())
+        port = srv.start_in_thread()
+        try:
+            status, doc = post_json(port, "/admin/drain", {"retry_after_s": "soon"})
+            assert status == 400 and doc["error"]["type"] == "invalid_request"
+            # the malformed request must NOT have drained the server
+            status, doc = post_json(port, "/v1/completions",
+                                    {"prompt": [1, 2], "max_tokens": 2})
+            assert status == 200
+        finally:
+            srv.shutdown(drain_timeout_s=5)

@@ -77,18 +77,28 @@ DEFAULT_CONFIG: Dict = {
             "InferenceEngine._admit_slots", "InferenceEngine._admit_chunked",
             "InferenceEngine._mixed_step", "InferenceEngine._decode_running",
             "InferenceEngine._decode_spec", "InferenceEngine._settle_sampled",
+            "InferenceEngine._advance_migrations",
             "InferenceEngine._emit", "InferenceEngine._free_kv",
             "InferenceEngine._preempt",
         ],
         "paddlenlp_tpu/experimental/backend.py": [
             "SingleDeviceBackend.prefill", "SingleDeviceBackend.decode",
             "SingleDeviceBackend.verify", "SingleDeviceBackend.mixed_step",
-            "SingleDeviceBackend._mixed_padded", "SingleDeviceBackend._mixed_flat",
+            "SingleDeviceBackend.mixed_step_begin",
+            "SingleDeviceBackend._mixed_padded_launch",
+            "SingleDeviceBackend._mixed_flat_launch",
             "SingleDeviceBackend._cached_counts", "SingleDeviceBackend.seed_counts",
             "SingleDeviceBackend.reset_counts", "SingleDeviceBackend.apply_cow",
         ],
         "paddlenlp_tpu/experimental/sharded_backend.py": [
             "ShardedBackend.params",
+        ],
+        "paddlenlp_tpu/experimental/disagg_backend.py": [
+            "DisaggBackend.prefill", "DisaggBackend.decode",
+            "DisaggBackend.verify", "DisaggBackend.mixed_step",
+            "DisaggBackend.seed_counts", "DisaggBackend.reset_counts",
+            "DisaggBackend.apply_cow", "DisaggBackend.kv_migrate",
+            "DisaggBackend.migration_ready",
         ],
         "paddlenlp_tpu/serving/engine_loop.py": [
             "EngineLoop._run_iteration", "EngineLoop._drain_cmds",
@@ -98,6 +108,14 @@ DEFAULT_CONFIG: Dict = {
     # sharding_contract: the base jit builder and the sharded overrides
     "sharding_base_file": "paddlenlp_tpu/experimental/inference_model.py",
     "sharding_sharded_file": "paddlenlp_tpu/experimental/sharded_backend.py",
+    # files held to the FULL contract (in/out shardings + donation on every
+    # jit): the sharded backend's step programs and the disagg backend's
+    # migration gather/scatter programs (both stages' step programs are the
+    # sharded file's — each stage IS a ShardedBackend)
+    "sharding_strict_files": [
+        "paddlenlp_tpu/experimental/sharded_backend.py",
+        "paddlenlp_tpu/experimental/disagg_backend.py",
+    ],
     "sharding_extra_dirs": ["paddlenlp_tpu/experimental"],
     # lock_discipline scans every file in scan_dirs for "# guarded-by:" lines
     # catalogs

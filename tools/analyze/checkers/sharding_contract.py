@@ -100,27 +100,38 @@ def check(ctx: AnalysisContext) -> List[Finding]:
     findings: List[Finding] = []
     base_file = ctx.config["sharding_base_file"]
     sharded_file = ctx.config["sharding_sharded_file"]
+    # files under the FULL contract: the sharded backend plus the disagg
+    # backend (whose migration gather/scatter programs move KV between the
+    # two stage pools — an implicit-layout migration copy would silently
+    # reshard the whole pool per handoff). The primary sharded_file is ALWAYS
+    # strict, whatever the configured list says — a context that overrides
+    # only sharding_sharded_file (tests) keeps the historical behavior.
+    strict_files = list(dict.fromkeys(
+        [sharded_file, *ctx.config.get("sharding_strict_files", [])]))
 
-    # 1) full contract inside the sharded file
-    if ctx.exists(sharded_file):
-        tree = ctx.tree(sharded_file)
-        if tree is not None:
-            for call, scope in _jit_calls(tree):
-                missing = [k for k in _REQUIRED_SHARDED if k not in _kwarg_names(call)]
-                if missing:
-                    target = _target_impl(call) or "<jit>"
-                    findings.append(Finding(
-                        RULE, sharded_file, call.lineno, scope,
-                        f"jax.jit({target}) missing explicit {', '.join(missing)} "
-                        "(every sharded step program compiles with declared "
-                        "placement + donation — PR 8 contract)"))
-    else:
-        findings.append(Finding(RULE, sharded_file, 0, "<config>",
-                                "configured sharded backend file does not exist"))
+    # 1) full contract inside every strict file
+    for strict in strict_files:
+        if not ctx.exists(strict):
+            if strict == sharded_file:
+                findings.append(Finding(RULE, strict, 0, "<config>",
+                                        "configured sharded backend file does not exist"))
+            continue
+        tree = ctx.tree(strict)
+        if tree is None:
+            continue
+        for call, scope in _jit_calls(tree):
+            missing = [k for k in _REQUIRED_SHARDED if k not in _kwarg_names(call)]
+            if missing:
+                target = _target_impl(call) or "<jit>"
+                findings.append(Finding(
+                    RULE, strict, call.lineno, scope,
+                    f"jax.jit({target}) missing explicit {', '.join(missing)} "
+                    "(every sharded step program compiles with declared "
+                    "placement + donation — PR 8 contract)"))
 
     # 2) donation everywhere under the engine tree
     for rel in ctx.iter_py(ctx.config["sharding_extra_dirs"]):
-        if rel == sharded_file:  # already held to the stricter rule above
+        if rel in strict_files:  # already held to the stricter rule above
             continue
         tree = ctx.tree(rel)
         if tree is None:

@@ -61,10 +61,24 @@ Usage::
                                                  # trajectory
     python tools/bench_serve.py --replicas 2 --hedge-after-ms 250
                                                  # arm request hedging: a stream
-                                                 # with no first token inside
-                                                 # the budget races a shadow on
-                                                 # the next replica; JSON adds
-                                                 # hedges (total fired/capped)
+                                                 # (or batch request) with no
+                                                 # first token inside the budget
+                                                 # races a shadow on the next
+                                                 # replica; JSON adds hedges
+                                                 # (total fired/capped)
+    python tools/bench_serve.py --disagg 2,2 --long-prompt-mix --prefill-chunk 64
+                                                 # disaggregated prefill/decode
+                                                 # engine: prompt work on a
+                                                 # 2-device prefill stage,
+                                                 # decode on a 2-device decode
+                                                 # stage, KV blocks migrating
+                                                 # between stage pools. JSON
+                                                 # adds a disagg record with
+                                                 # per-stage TTFT / inter-token
+                                                 # tails + migration counts —
+                                                 # compare against
+                                                 # --mesh-shape 1,4 (shared
+                                                 # pool) with one flag flip
 """
 
 from __future__ import annotations
@@ -98,16 +112,36 @@ def _parse_mesh_shape():
     return tuple(parts)
 
 
+def _parse_disagg():
+    """``--disagg P,D``: device counts for the prefill / decode stages."""
+    if "--disagg" not in sys.argv:
+        return None
+    raw = sys.argv[sys.argv.index("--disagg") + 1]
+    parts = [int(x) for x in raw.split(",")]
+    if len(parts) != 2 or any(p < 1 for p in parts):
+        _fail(f"--disagg must be P,D with positive device counts, got {raw!r}")
+    return tuple(parts)
+
+
 def _force_cpu() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     mesh = _parse_mesh_shape()
+    disagg = _parse_disagg()
+    if mesh is not None and disagg is not None:
+        _fail("--mesh-shape and --disagg are mutually exclusive (a disagg "
+              "stage is itself a sharded device group)")
+    n_dev = None
     if mesh is not None:
-        # the host-device count must be pinned BEFORE jax loads; R*C virtual
-        # CPU devices back the sharded engine's mesh. Appended so any
-        # user-supplied XLA flags survive (last flag wins on duplicates)
+        n_dev = mesh[0] * mesh[1]
+    elif disagg is not None:
+        n_dev = disagg[0] + disagg[1]
+    if n_dev is not None:
+        # the host-device count must be pinned BEFORE jax loads; the virtual
+        # CPU devices back the sharded/disagg engine's meshes. Appended so
+        # any user-supplied XLA flags survive (last flag wins on duplicates)
         extra = os.environ.get("XLA_FLAGS", "")
         os.environ["XLA_FLAGS"] = (
-            f"{extra} --xla_force_host_platform_device_count={mesh[0] * mesh[1]}".strip())
+            f"{extra} --xla_force_host_platform_device_count={n_dev}".strip())
     else:
         os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
     sys.path[:] = [p for p in sys.path if "axon" not in p]
@@ -155,6 +189,7 @@ def run() -> None:
     long_tokens = _arg("--long-prompt-tokens", 2048)
     prefill_chunk = _arg("--prefill-chunk", 0)
     mesh_shape = _parse_mesh_shape()
+    disagg = _parse_disagg()
     token_flatten = (bool(_arg("--token-flatten", 1))
                      if "--token-flatten" in sys.argv else None)
     if not 0.0 <= prefix_share <= 1.0:
@@ -162,9 +197,9 @@ def run() -> None:
     # 24 tokens = 6 full blocks at block_size=4: a warm hit skips all of them
     shared_prefix = [9, 8, 7, 6, 5, 4, 3, 2] * 3
 
-    # mesh runs use a head count the tp axis can divide (8 heads x head_dim 8
-    # instead of 4 x 16) so the KV pool and attention actually shard
-    n_heads, n_kv = (8, 8) if mesh_shape else (4, 2)
+    # mesh/disagg runs use a head count the tp axes can divide (8 heads x
+    # head_dim 8 instead of 4 x 16) so the KV pool and attention actually shard
+    n_heads, n_kv = (8, 8) if (mesh_shape or disagg) else (4, 2)
     cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
                       num_attention_heads=n_heads, num_key_value_heads=n_kv,
                       max_position_embeddings=4096 if long_mix else 256,
@@ -188,6 +223,8 @@ def run() -> None:
         eng_kw["prefill_chunk_tokens"] = prefill_chunk
     if mesh_shape:
         eng_kw["mesh_shape"] = mesh_shape
+    if disagg:
+        eng_kw["disagg_stages"] = disagg
     if token_flatten is not None:
         eng_kw["token_flatten"] = token_flatten
     # which stream positions carry a long prompt (spread through the run so
@@ -477,6 +514,43 @@ def run() -> None:
             "prefill_chunks": int(scalar_sum("paddlenlp_serving_prefill_chunks_total")),
             "decode_stall_p99_ms": round(
                 quantile_max("paddlenlp_serving_decode_stall_seconds", 0.99) * 1e3, 1),
+        }
+    if disagg:
+        # per-stage view: TTFT is prefill-stage latency, the chatty client
+        # inter-token tail is decode-stage latency, and the migration series
+        # is the traffic between them
+        def stage_gauge(name, stage):
+            total = 0.0
+            for f in replica_fams:
+                fam = f.get(name)
+                if fam is None:
+                    continue
+                for (_sample, labels), v in fam.samples.items():
+                    if dict(labels).get("stage") == stage:
+                        total += v
+            return total / max(len(replica_fams), 1)
+
+        dgaps = sorted(stats["gaps_short"])
+        dgp = lambda q: dgaps[min(int(q * len(dgaps)), len(dgaps) - 1)] if dgaps else 0.0
+        record["disagg"] = {
+            "stages": f"{disagg[0]},{disagg[1]}",
+            "prefill_stage": {
+                "ttft_p50_ms": round(p(0.50) * 1e3, 1),
+                "ttft_p99_ms": round(p(0.99) * 1e3, 1),
+                "kv_utilization": round(
+                    stage_gauge("paddlenlp_serving_stage_kv_utilization", "prefill"), 4),
+            },
+            "decode_stage": {
+                "client_p50_inter_token_ms": round(dgp(0.50) * 1e3, 1),
+                "client_p99_inter_token_ms": round(dgp(0.99) * 1e3, 1),
+                "kv_utilization": round(
+                    stage_gauge("paddlenlp_serving_stage_kv_utilization", "decode"), 4),
+            },
+            "migrations": int(scalar_sum("paddlenlp_serving_kv_migrations_total")),
+            "migrated_blocks": int(
+                scalar_sum("paddlenlp_serving_kv_migrated_blocks_total")),
+            "migrated_bytes": int(
+                scalar_sum("paddlenlp_serving_kv_migrated_bytes_total")),
         }
     if fleet is not None:
         router_fams = parse_prometheus_text(scraped)
