@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.flight_recorder import RECORDER
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
@@ -89,6 +90,17 @@ class Request:
     # "prefill" while chunks run, "migrating" while blocks move between stage
     # pools, "decode" once landed (single-pool backends stay "decode" always)
     kv_stage: str = "decode"
+    # latency-attribution bookkeeping (engine_loop.request_attribution):
+    # first time the request was head-of-queue but deferred by an admission
+    # gate (splits queue_wait into pure-queue vs admission-gate) ...
+    gated_t: Optional[float] = None
+    # ... decode-window seconds spent riding mixed steps that also carried
+    # other requests' prefill chunks (the per-request decode-stall share) ...
+    chunk_stall_s: float = 0.0
+    # ... and seconds spent waiting for prefill->decode block migration
+    # (accumulated on land; migrate_start_t marks an episode still open)
+    migration_wait_s: float = 0.0
+    migrate_start_t: Optional[float] = None
 
     @property
     def needs_prefill(self) -> bool:
@@ -217,6 +229,9 @@ class InferenceEngine:
         self.migration_force_land_polls = 8
         self._migrating: Dict[int, object] = {}
         self._migrate_pending: deque = deque()
+        # req_ids whose migration deferral was already recorded this episode
+        # (one migrate.defer event per wait, not one per engine step)
+        self._migrate_defer_noted: set = set()
         self.enable_prefix_cache = enable_prefix_cache
         self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq,
                                 enable_prefix_cache=enable_prefix_cache)
@@ -420,6 +435,7 @@ class InferenceEngine:
         request's own blocks, which are about to be freed — any future owner
         re-prefills and re-migrates over them."""
         self._migrating.pop(req_id, None)
+        self._migrate_defer_noted.discard(req_id)
         try:
             self._migrate_pending.remove(req_id)
         except ValueError:
@@ -443,12 +459,21 @@ class InferenceEngine:
                 continue  # aborted/preempted while the blocks were in flight
             req = self.slots[slot]
             req.kv_stage = "decode"
+            if req.migrate_start_t is not None:
+                # the migration-wait episode closes: bank it for attribution
+                req.migration_wait_s += time.time() - req.migrate_start_t
+                req.migrate_start_t = None
+            RECORDER.record("migrate.land", req_id=req_id, trace=req.trace,
+                            blocks=ticket.n_blocks, polls=ticket.polls)
             TRACER.instant("kv_migrated", cat="engine", trace=req.trace,
                            req_id=req_id, blocks=ticket.n_blocks,
                            polls=ticket.polls)
         total = max(self.mgr.total_usable_blocks, 1)
+        if self._migrate_pending and len(self._migrating) >= self.migration_inflight_limit:
+            self._note_migrate_deferred(self._migrate_pending[0], "inflight_limit")
         while self._migrate_pending and len(self._migrating) < self.migration_inflight_limit:
             if self._stage_blocks()["decode"] / total > self.decode_pressure_gate:
+                self._note_migrate_deferred(self._migrate_pending[0], "decode_pressure")
                 break  # decode pressure gates handoff; finishing seqs free it
             req_id = self._migrate_pending[0]
             slot = self._slot_of(req_id)
@@ -466,10 +491,25 @@ class InferenceEngine:
             t0 = time.perf_counter()
             self._migrating[req_id] = self.backend.kv_migrate(
                 req_id, list(blocks), slot, hist)
+            self._migrate_defer_noted.discard(req_id)
+            RECORDER.record("migrate.start", req_id=req_id, trace=req.trace,
+                            blocks=len(blocks), inflight=len(self._migrating))
             TRACER.add_span("kv_migrate", TRACER.epoch_time(t0),
                             time.perf_counter() - t0, cat="engine",
                             trace=req.trace, req_id=req_id, blocks=len(blocks),
                             inflight=len(self._migrating))
+
+    def _note_migrate_deferred(self, req_id: int, reason: str):
+        """One migrate.defer event per wait episode for the head pending
+        handoff (the gate re-evaluates every step; the recorder must not)."""
+        if req_id in self._migrate_defer_noted:
+            return
+        self._migrate_defer_noted.add(req_id)
+        slot = self._slot_of(req_id)
+        trace = self.slots[slot].trace if slot is not None else None
+        RECORDER.record("migrate.defer", req_id=req_id, trace=trace,
+                        reason=reason, inflight=len(self._migrating),
+                        pending=len(self._migrate_pending))
 
     def reset(self):
         """Drop ALL scheduler/allocator state after a failed step — the
@@ -490,6 +530,7 @@ class InferenceEngine:
         self._spec_rngs.clear()
         self._migrating.clear()
         self._migrate_pending.clear()
+        self._migrate_defer_noted.clear()
         logger.warning("inference engine reset: scheduler + KV allocator state dropped")
 
     def stats(self) -> Dict:
@@ -588,6 +629,22 @@ class InferenceEngine:
     def _free_slot_indices(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
 
+    def _note_gated(self, req: Request, reason: str):
+        """Mark the head-of-queue request as gate-deferred, ONCE per wait
+        episode: the timestamp splits its eventual queue_wait into pure-queue
+        vs admission-gate time (latency attribution), and the single decision
+        event keeps a blocked queue from flooding the flight recorder with
+        one identical record per engine step."""
+        if req.gated_t is not None:
+            return
+        # stamped even on a preemption-requeue (sched_t already set) so the
+        # event fires once, not per step; attribution only *uses* the stamp
+        # when it falls inside the arrival -> first-admission window
+        req.gated_t = time.time()
+        RECORDER.record("admit.defer", req_id=req.req_id, trace=req.trace,
+                        reason=reason, queue_depth=len(self.waiting),
+                        free_blocks=self.mgr.num_free)
+
     def _admit_slots(self, finished: List[Request]) -> List[tuple]:
         """Shared admission front half: bind waiting requests to free slots and
         allocate their KV blocks (prefix-cache match + COW included). Returns
@@ -620,6 +677,9 @@ class InferenceEngine:
                 req.done = True
                 req.finish_reason = "capacity"
                 req.finish_t = time.time()
+                RECORDER.record("admit.reject", req_id=req.req_id, trace=req.trace,
+                                reason="capacity", blocks_needed=need,
+                                prompt_len=prompt_len)
                 logger.warning(f"req {req.req_id}: needs {need} KV blocks (> capacity); rejected")
                 finished.append(req)
                 continue
@@ -631,6 +691,7 @@ class InferenceEngine:
             admit_need = self.mgr.blocks_needed(prompt_len + 1)
             if self.staged and held_prefill > 0 \
                     and held_prefill + admit_need > self.prefill_pressure_gate * total_blocks:
+                self._note_gated(req, "prefill_gate")
                 break  # prefill stage saturated: admitting would starve handoff
             # reserve prompt + 1 so the first decode never immediately preempts;
             # cached prefix blocks need no fresh capacity, so a warm request
@@ -644,9 +705,11 @@ class InferenceEngine:
                 best_need = self.mgr.blocks_needed(prompt_len + 1) \
                     - prompt_len // self.mgr.block_size
                 if best_need > self.mgr.num_free:
+                    self._note_gated(req, "kv_pressure")
                     break
                 match = self.mgr.match_prefix(req.prompt_ids, prompt_len)
             if not self.mgr.can_admit(prompt_len + 1, match=match):
+                self._note_gated(req, "kv_pressure")
                 break
             self.waiting.popleft()
             if req.sched_t is None:  # preserved across preemption-requeues
@@ -666,7 +729,11 @@ class InferenceEngine:
                 # chunk lands and the blocks migrate to the decode pool
                 req.kv_stage = "prefill"
                 held_prefill += len(self.mgr.tables[req.req_id])
-            admitted.append((free.pop(0), req, n_cached))
+            slot = free.pop(0)
+            RECORDER.record("admit.accept", req_id=req.req_id, trace=req.trace,
+                            slot=slot, prompt_len=prompt_len,
+                            cached_tokens=n_cached)
+            admitted.append((slot, req, n_cached))
         # admission span closes BEFORE prefill (sibling phases, not nested) and
         # only when something happened — a blocked queue spinning admitted=0
         # every step must not flood the span ring
@@ -743,6 +810,7 @@ class InferenceEngine:
                 # the sequence decodes only after its blocks land in the
                 # decode pool — queue the migration, don't block the step
                 req.kv_stage = "migrating"
+                req.migrate_start_t = time.time()  # migration-wait episode opens
                 self._migrate_pending.append(req.req_id)
 
     # ------------------------------------------------------------------ chunked prefill
@@ -791,7 +859,7 @@ class InferenceEngine:
                     break
                 active = [s for s, r in enumerate(self.slots) if r is not None]
                 victim = max(active, key=lambda s: self.slots[s].req_id)
-                self._preempt(victim)
+                self._preempt(victim, cause="mixed_capacity")
                 if victim == slot:
                     break
         budget = self.prefill_chunk_tokens
@@ -816,6 +884,8 @@ class InferenceEngine:
             n = min(budget, len(req.prompt_ids) - req.prefilled_len)
             chunk_rows.append((slot, req, n))
             budget -= n
+            RECORDER.record("chunk.grant", req_id=req.req_id, trace=req.trace,
+                            tokens=n, budget_left=budget, step=self._cur_step)
         if not chunk_rows and not decode_rows:
             return
         t0 = time.perf_counter()
@@ -839,6 +909,13 @@ class InferenceEngine:
                          req_ids=[r.req_id for _, r, _ in chunk_rows]):
             tokens = self.backend.mixed_step(chunk_payload, dec_payload)
         dur = time.perf_counter() - t0
+        if chunk_rows:
+            # every decode token in this step waited out the chunk work: the
+            # step duration is each riding request's decode-stall share
+            # (accumulated BEFORE settle so a request finishing this very
+            # step still carries it into its attribution)
+            for _slot, req in decode_rows:
+                req.chunk_stall_s += dur
         for j, (slot, req, n) in enumerate(chunk_rows):
             req.prefilled_len += n
             self.chunk_stats["chunks"] += 1
@@ -954,14 +1031,24 @@ class InferenceEngine:
                  for i in range(len(self.slots))]
         return out_d, out_q
 
-    def _preempt(self, slot: int):
+    def _preempt(self, slot: int, cause: str = "decode_growth"):
         """Evict + requeue with prompt+generated as the new prompt (recompute
-        recovery, the step.cu is_block_step/recover list)."""
+        recovery, the step.cu is_block_step/recover list). ``cause`` names
+        which capacity pass chose the victim (decode table growth, a mixed
+        step's capacity pass, or the speculative K+1 reservation)."""
         req = self.slots[slot]
         logger.warning(f"req {req.req_id}: KV blocks exhausted; preempting (recompute)")
         self.num_preemptions += 1
+        RECORDER.record("preempt", req_id=req.req_id, trace=req.trace,
+                        reason=cause, generated=len(req.output_ids),
+                        free_blocks=self.mgr.num_free)
         TRACER.instant("preempt", cat="engine", trace=req.trace, req_id=req.req_id,
                        generated=len(req.output_ids), free_blocks=self.mgr.num_free)
+        if req.migrate_start_t is not None:
+            # an open migration-wait episode ends here (the blocks are gone;
+            # re-admission restarts the walk) — bank the wait for attribution
+            req.migration_wait_s += time.time() - req.migrate_start_t
+            req.migrate_start_t = None
         self._free_kv(req)
         self.slots[slot] = None
         req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])  # sync-ok: host-side id lists
@@ -1001,7 +1088,7 @@ class InferenceEngine:
             req = self.slots[slot]
             grow = req.total_len + K - self.mgr.lengths[req.req_id]
             if grow > 0 and self.mgr.extend(req.req_id, grow) is None:
-                self._preempt(slot)
+                self._preempt(slot, cause="spec_reserve")
         if not any(r is not None for r in self.slots):
             return
 

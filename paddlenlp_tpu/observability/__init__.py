@@ -1,6 +1,6 @@
 """Unified observability layer shared by serving and training.
 
-Four pieces, all stdlib-only at import time:
+All pieces stdlib-only at import time:
 
 - :mod:`.tracer` — thread-safe span tracing into a bounded ring buffer,
   exportable as Chrome trace-event JSON (Perfetto) or JSONL; the process-wide
@@ -12,14 +12,24 @@ Four pieces, all stdlib-only at import time:
 - :mod:`.prometheus` — text-format parsing + exposition lint for scrapers and
   ``tools/check_metrics.py``.
 - :mod:`.slo` — multi-window availability/TTFT burn rates over federated
-  replica counters (the router's ``/fleet/slo`` plane).
+  replica counters (the router's ``/fleet/slo`` plane), with a fast-burn
+  trigger hook.
+- :mod:`.flight_recorder` — always-on bounded ring of structured *decision*
+  events (why the scheduler admitted/deferred/preempted/hedged), names
+  validated against :mod:`.event_catalog`.
+- :mod:`.postmortem` — auto-dumped incident bundles (events + spans + health
+  + metrics + config) behind ``PDNLP_TPU_POSTMORTEM_DIR`` and
+  ``POST /debug/postmortem``; analyzed offline by ``tools/postmortem.py``.
 
 The metric registry itself lives in :mod:`paddlenlp_tpu.serving.metrics`
 (predates this package; its names are stable API) — this package is the
 tracing/exposition layer around it.
 """
 
+from .event_catalog import EVENT_CATALOG, EVENT_REASONS  # noqa: F401
 from .exporter import ObservabilityExporter, ProfileCapture  # noqa: F401
+from .flight_recorder import RECORDER, FlightEvent, FlightRecorder  # noqa: F401
+from .postmortem import PostmortemDumper, handle_postmortem_request  # noqa: F401
 from .prometheus import (  # noqa: F401
     MetricFamily,
     histogram_quantile,
@@ -58,4 +68,11 @@ __all__ = [
     "SLOObjectives",
     "SLOTracker",
     "slo_inputs_from_families",
+    "EVENT_CATALOG",
+    "EVENT_REASONS",
+    "FlightEvent",
+    "FlightRecorder",
+    "RECORDER",
+    "PostmortemDumper",
+    "handle_postmortem_request",
 ]

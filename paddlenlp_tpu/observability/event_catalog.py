@@ -1,0 +1,62 @@
+"""Flight-recorder decision-event catalog — the stable vocabulary of *why*.
+
+Spans and metrics record *that* phases happened; the flight recorder records
+*why* scheduling decisions went the way they did (admission deferred, victim
+preempted, migration gated, request hedged). Event names are string API the
+same way span names, metric names and fault-point names are: postmortem
+bundles are grepped by event name, ``tools/postmortem.py`` renders decision
+trails from them, and runbooks refer to them — so every literal name passed
+to ``RECORDER.record(...)`` must have an entry here, and every entry must
+have a call site. ``tools/analyze`` (the ``event-catalog`` checker) enforces
+both directions, exactly like the span catalog.
+
+Events that carry a ``reason`` field draw it from a closed enum
+(:data:`EVENT_REASONS`) — the recorder validates membership at record time so
+a typo'd reason fails a test instead of silently forking the vocabulary a
+dashboard filters on.
+
+This module must stay stdlib-only (no jax, no package-relative imports): the
+static-analysis suite loads it by file path without executing
+``paddlenlp_tpu.__init__``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["EVENT_CATALOG", "EVENT_REASONS"]
+
+EVENT_CATALOG: Dict[str, str] = {
+    # ------------------------------------------------------------- engine scheduling
+    "admit.accept": "a waiting request was bound to a slot and its KV blocks allocated (fields: slot, prompt_len, cached_tokens)",
+    "admit.defer": "the head-of-queue request was deferred by an admission gate; recorded once per wait episode (reason=kv_pressure|prefill_gate)",
+    "admit.reject": "a request that can never fit was rejected terminally with finish_reason=capacity (reason=capacity)",
+    "preempt": "KV exhaustion evicted the youngest sequence for recompute-requeue (reason=decode_growth|mixed_capacity|spec_reserve)",
+    "chunk.grant": "one mid-prefill slot drew prompt tokens from the mixed-step chunk budget (fields: tokens, budget_left)",
+    "migrate.start": "one sequence's prefill->decode KV-block migration was dispatched (fields: blocks, inflight)",
+    "migrate.defer": "the head pending migration was deferred; recorded once per wait episode (reason=decode_pressure|inflight_limit)",
+    "migrate.land": "a sequence's migrated blocks landed in the decode pool; it is now decode-eligible (fields: blocks, polls)",
+    # ------------------------------------------------------------- scheduler (admission control)
+    "sched.reject": "the scheduler shed a submission before it reached the engine (reason=saturated|draining|degraded -> HTTP 429/503)",
+    # ------------------------------------------------------------- engine loop / supervisor
+    "supervisor.degraded": "engine.step() raised without per-request attribution; the loop entered DEGRADED and triaged in-flight work",
+    "supervisor.recovered": "the engine was rebuilt and stashed requests requeued; the loop left DEGRADED (fields: attempts, requeued, failed)",
+    "supervisor.quarantine": "a poisoned request was quarantined at slot level (KV released, handle failed, engine kept running)",
+    # ------------------------------------------------------------- router
+    "router.reroute": "a forward attempt moved to the next candidate before anything was relayed (429/503/connect failure)",
+    "router.failover": "an accepted-then-failed request was transparently resubmitted to another replica pre-token",
+    "router.hedge_fire": "the first-token budget expired with no usable event; a shadow leg was launched on the next candidate",
+    "router.hedge_commit": "one hedged leg produced the first usable event and was committed (fields: outcome=primary_won|hedge_won)",
+    "router.hedge_abort": "the losing hedged leg was torn down (socket closed; /v1/abort when its upstream id was known)",
+    "router.drain_evict": "a drain outlived its deadline; a token-less stream pinned to the draining replica was broken into pre-token failover",
+}
+
+#: closed ``reason`` vocabularies for events that carry one. The recorder
+#: validates membership at record time; events absent here take no reason.
+EVENT_REASONS: Dict[str, Tuple[str, ...]] = {
+    "admit.defer": ("kv_pressure", "prefill_gate"),
+    "admit.reject": ("capacity",),
+    "preempt": ("decode_growth", "mixed_capacity", "spec_reserve"),
+    "migrate.defer": ("decode_pressure", "inflight_limit"),
+    "sched.reject": ("saturated", "draining", "degraded"),
+}

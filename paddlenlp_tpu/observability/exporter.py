@@ -10,6 +10,7 @@ read-only so it can ride inside ``Trainer`` without touching the step loop:
     GET  /debug/spans    span ring buffer as structured JSONL
     POST /debug/profile  on-demand jax.profiler capture (?seconds=S; 409 while
                          another capture runs — the profiler is process-global)
+    POST /debug/postmortem  force a postmortem bundle dump; returns its path
 
 Stdlib ``ThreadingHTTPServer`` on a daemon thread; ``port=0`` binds an
 ephemeral port (tests), and a crashed exporter can never take training down —
@@ -191,13 +192,23 @@ class ObservabilityExporter:
 
     def __init__(self, registry=None, tracer: Optional[SpanTracer] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
-                 profile: Optional[ProfileCapture] = None):
+                 profile: Optional[ProfileCapture] = None,
+                 postmortem=None):
         if registry is None:
             from ..serving.metrics import REGISTRY as registry  # stdlib-only module
         self.registry = registry
-        self.tracer = tracer or TRACER
+        # explicit None check: SpanTracer defines __len__, so an EMPTY tracer
+        # passed here is falsy and `tracer or TRACER` would silently serve
+        # the process-wide ring instead of the caller's
+        self.tracer = tracer if tracer is not None else TRACER
         self.health_fn = health_fn
         self.profile = profile or PROFILE_CAPTURE
+        if postmortem is None:
+            from .postmortem import PostmortemDumper  # avoid import cycle at module load
+
+            postmortem = PostmortemDumper(registry=self.registry, tracer=self.tracer,
+                                          health_fn=health_fn, tier="training")
+        self.postmortem = postmortem
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -257,6 +268,11 @@ class ObservabilityExporter:
                     if n:
                         self.rfile.read(n)
                     routed = handle_profile_request(self.path, exporter.profile)
+                    if routed is None:
+                        from .postmortem import handle_postmortem_request
+
+                        routed = handle_postmortem_request(self.path,
+                                                           exporter.postmortem)
                     if routed is not None:
                         self._send(routed[0], routed[2], routed[1])
                     else:

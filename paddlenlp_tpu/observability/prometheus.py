@@ -190,6 +190,10 @@ def lint_exposition(text: str) -> List[str]:
 
 def _lint_histogram(name: str, fam: MetricFamily) -> List[str]:
     problems = []
+    if not fam.samples:
+        # a declared-but-unused labeled histogram (TYPE/HELP, zero series) is
+        # valid Prometheus — labeled families expose nothing until observed
+        return problems
     sample_names = {s for s, _ in fam.samples}
     for required in (f"{name}_sum", f"{name}_count"):
         if required not in sample_names:

@@ -32,7 +32,7 @@ import dataclasses
 import math
 import threading
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["SLOObjectives", "SLOTracker", "SLOInputs", "slo_inputs_from_families",
            "ERROR_STATUSES", "DEFAULT_WINDOWS_S"]
@@ -133,12 +133,20 @@ class SLOTracker:
 
     def __init__(self, objectives: Optional[SLOObjectives] = None,
                  windows_s: Sequence[float] = DEFAULT_WINDOWS_S,
-                 registry=None, max_points: int = 4096):
+                 registry=None, max_points: int = 4096,
+                 fast_burn_threshold: float = 10.0):
         if not windows_s or any(w <= 0 for w in windows_s):
             raise ValueError(f"windows_s must be positive, got {windows_s}")
         self.objectives = objectives or SLOObjectives()
         self.windows_s = tuple(sorted(float(w) for w in windows_s))
         self.max_points = max_points
+        # fast-burn trigger hook: when the SHORTEST window's burn rate crosses
+        # the threshold (the SRE-workbook "page now" line), report() invokes
+        # ``on_fast_burn(kind, burn_rate, window_label)`` — the router wires a
+        # postmortem dump here so the incident snapshots itself (the dumper
+        # owns rate limiting; a sustained burn re-fires every report)
+        self.fast_burn_threshold = fast_burn_threshold
+        self.on_fast_burn: Optional[Callable[[str, float, str], None]] = None
         self._history: deque = deque()  # (t, SLOInputs), oldest first
         self._baseline = SLOInputs()  # process start: all-zero counters
         self._reset_pending = False  # one unconfirmed total-shrink seen
@@ -259,8 +267,32 @@ class SLOTracker:
                 self.g_avail_burn.set(row["availability_burn_rate"], window=label)
                 self.g_ttft_violation.set(row["ttft_violation_rate"], window=label)
                 self.g_ttft_burn.set(row["ttft_burn_rate"], window=label)
+        self._check_fast_burn(windows)
         return {
             "objectives": dataclasses.asdict(self.objectives),
             "totals": dataclasses.asdict(latest),
             "windows": windows,
         }
+
+    def _check_fast_burn(self, windows: Dict[str, Dict]):
+        """Invoke the fast-burn hook when the shortest window is burning past
+        the threshold. Best-effort: a broken hook must never take down the
+        SLO plane it is meant to explain."""
+        if self.on_fast_burn is None or not windows:
+            return
+        label = f"{int(self.windows_s[0])}s"
+        row = windows.get(label)
+        if row is None:
+            return
+        for kind, key in (("availability", "availability_burn_rate"),
+                          ("ttft", "ttft_burn_rate")):
+            burn = row.get(key, 0.0)
+            if burn >= self.fast_burn_threshold:
+                try:
+                    self.on_fast_burn(kind, burn, label)
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        f"SLO fast-burn hook failed: {e!r}")
+                break  # one trigger per report; the dumper's bundle covers both

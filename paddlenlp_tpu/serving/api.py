@@ -19,6 +19,8 @@ Routes::
     GET  /debug/spans      span ring buffer as structured JSONL
     POST /debug/profile    on-demand jax.profiler capture (?seconds=S; 409
                            while another capture runs)
+    POST /debug/postmortem force a postmortem bundle dump (events + spans +
+                           health + metrics + config); returns its path
 
 Backpressure maps to HTTP: 429 when the admission window is full (retryable),
 503 while draining, 413 for oversized bodies. A client disconnect mid-stream
@@ -39,6 +41,7 @@ from http.server import ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ..observability.exporter import handle_profile_request, route_observability
+from ..observability.postmortem import handle_postmortem_request
 from ..observability.tracer import TRACEPARENT_HEADER, TRACER, parse_traceparent, use_trace
 from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
@@ -265,14 +268,16 @@ class ServingServer:
             # --------------------------------------------------------- POST
             def do_POST(self):
                 try:
-                    if self.path.split("?", 1)[0] == "/debug/profile":
+                    if self.path.split("?", 1)[0] in ("/debug/profile",
+                                                      "/debug/postmortem"):
                         # drain any request body before responding: leftover
                         # bytes would desync the next request on this
                         # keep-alive connection
                         n = int(self.headers.get("Content-Length") or 0)
                         if n:
                             self.rfile.read(n)
-                        routed = handle_profile_request(self.path)
+                        routed = handle_profile_request(self.path) \
+                            or handle_postmortem_request(self.path, server.loop.postmortem)
                         self._send_raw(routed[0], routed[2], routed[1])
                     elif self.path == "/v1/completions":
                         payload = self._read_body()

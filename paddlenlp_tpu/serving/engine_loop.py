@@ -56,12 +56,66 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..observability.flight_recorder import RECORDER
+from ..observability.postmortem import PostmortemDumper
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .metrics import REGISTRY, MetricsRegistry
 
-__all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy"]
+__all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy",
+           "ATTRIBUTION_PHASES", "request_attribution"]
+
+#: the per-request latency-attribution phase vocabulary. Non-overlapping by
+#: construction: queue + admission_gate span arrival -> first admission,
+#: prefill spans admission -> first token, and the decode window
+#: (first token -> finish) splits into chunk_stall + migration_wait + decode
+#: remainder — so the phases always sum to e2e exactly when the timeline is
+#: complete. The router adds a seventh phase, ``hedge_race``, to the same
+#: histogram family for its first-token races.
+ATTRIBUTION_PHASES = ("queue", "admission_gate", "prefill", "chunk_stall",
+                      "migration_wait", "decode")
+
+
+def request_attribution(req) -> Optional[Dict[str, float]]:
+    """Decompose one finished request's e2e latency into the attribution
+    phases (seconds). Works on engine ``Request``s and ``_FailedRequest``
+    shims alike (missing bookkeeping degrades to coarser phases, never an
+    error); returns None when the request has no measurable timeline."""
+    arrival = getattr(req, "arrival_t", None)
+    finish = getattr(req, "finish_t", None)
+    if arrival is None or finish is None:
+        return None
+    sched = getattr(req, "sched_t", None)
+    first = getattr(req, "first_token_t", None)
+    gated = getattr(req, "gated_t", None)
+    out = {p: 0.0 for p in ATTRIBUTION_PHASES}
+    end_queue = sched if sched is not None else finish
+    if sched is not None and gated is not None and arrival <= gated <= sched:
+        # the engine marked the moment the request hit an admission gate at
+        # the head of the queue: waiting *behind* others vs waiting *on a
+        # gate* are different operator actions (scale out vs retune gates)
+        out["queue"] = gated - arrival
+        out["admission_gate"] = sched - gated
+    else:
+        out["queue"] = max(end_queue - arrival, 0.0)
+    if sched is not None:
+        end_prefill = first if first is not None else finish
+        out["prefill"] = max(end_prefill - sched, 0.0)
+    if first is not None:
+        decode_raw = max(finish - first, 0.0)
+        stall = min(max(getattr(req, "chunk_stall_s", 0.0), 0.0), decode_raw)
+        mig = max(getattr(req, "migration_wait_s", 0.0), 0.0)
+        open_mig = getattr(req, "migrate_start_t", None)
+        if open_mig is not None:
+            # the request finished (abort/quarantine) with a migration still
+            # in flight: the open episode ends at finish
+            mig += max(finish - open_mig, 0.0)
+        mig = min(mig, decode_raw - stall)
+        out["chunk_stall"] = stall
+        out["migration_wait"] = mig
+        out["decode"] = decode_raw - stall - mig
+    return out
 
 _END = object()  # token-queue sentinel: stream closed
 
@@ -248,6 +302,12 @@ class ServingMetrics:
             "paddlenlp_serving_slot_quarantines_total",
             "Poisoned requests quarantined by slot-level partial recovery "
             "(KV released, handle failed, engine kept running)")
+        self.latency_attribution = r.histogram(
+            "paddlenlp_serving_latency_attribution_seconds",
+            "Per-request e2e latency decomposed by phase (queue/"
+            "admission_gate/prefill/chunk_stall/migration_wait/decode on "
+            "replicas; hedge_race on the router) — phases sum to e2e",
+            labelnames=("phase",))
         self.ttft = r.histogram(
             "paddlenlp_serving_ttft_seconds", "Time from arrival to first token")
         self.queue_wait = r.histogram(
@@ -435,12 +495,19 @@ class EngineLoop:
     def __init__(self, engine, metrics: Optional[ServingMetrics] = None,
                  registry: Optional[MetricsRegistry] = None, idle_wait_s: float = 0.05,
                  engine_factory: Optional[Callable[[], object]] = None,
-                 policy: Optional[SupervisorPolicy] = None):
+                 policy: Optional[SupervisorPolicy] = None,
+                 postmortem: Optional[PostmortemDumper] = None):
         self.engine = engine
         self.metrics = metrics or ServingMetrics(engine, registry)
         self.idle_wait_s = idle_wait_s
         self.engine_factory = engine_factory
         self.policy = policy or SupervisorPolicy()
+        # incident black box: supervisor degrades and slot quarantines
+        # auto-dump a bundle (events + spans + health + metrics + config) to
+        # PDNLP_TPU_POSTMORTEM_DIR; POST /debug/postmortem forces one
+        self.postmortem = postmortem or PostmortemDumper(
+            registry=self.metrics.registry, health_fn=self._postmortem_health,
+            config_fn=self._postmortem_config)
         self._cmds: "queue.Queue" = queue.Queue()
         self._wake = threading.Event()
         self._handles: Dict[int, RequestHandle] = {}
@@ -618,10 +685,20 @@ class EngineLoop:
         logger.error(
             f"engine step failed (consecutive failure {self._consecutive_failures}): {exc!r}; "
             "entering DEGRADED state")
+        RECORDER.record("supervisor.degraded", error=repr(exc)[:200],
+                        consecutive=self._consecutive_failures,
+                        inflight=len(self._handles))
         TRACER.instant("engine_failure", cat="engine_loop", error=repr(exc),
                        consecutive=self._consecutive_failures,
                        inflight=len(self._handles))
         n_failed = self._triage(exc)
+        # black box: snapshot the incident AFTER triage so the bundle's
+        # health/events already reflect the dispositions (rate-limited;
+        # opt-in via PDNLP_TPU_POSTMORTEM_DIR)
+        self.postmortem.dump("supervisor_degraded", detail={
+            "error": repr(exc)[:500],
+            "consecutive_failures": self._consecutive_failures,
+            "failed": n_failed, "requeued": len(self._requeue)})
 
         attempt = 0
         while not self._stop:
@@ -658,6 +735,8 @@ class EngineLoop:
             self.metrics.engine_restarts.inc()
             n_requeued = self._resubmit_stashed()
             self._state = "running"
+            RECORDER.record("supervisor.recovered", attempts=attempt + 1,
+                            requeued=n_requeued, failed=n_failed)
             dur = time.time() - degraded_t0
             TRACER.add_span("engine_degraded", degraded_t0, dur, cat="engine_loop",
                             wall=True, error=repr(exc), requeued=n_requeued,
@@ -731,9 +810,15 @@ class EngineLoop:
             s = list(h._streamed)
             self._resolve_failed(h, s, finish_reason=self._closed_stream_reason(h, s) or "stop")
             swept += 1
+        RECORDER.record("supervisor.quarantine", req_id=req_id,
+                        trace=handle.trace, streak=self._quarantine_streak,
+                        swept=swept, error=repr(exc)[:200])
         TRACER.add_span("slot_quarantine", t0, time.time() - t0, cat="engine_loop",
                         wall=True, req_id=req_id, error=repr(exc),
                         streak=self._quarantine_streak, swept=swept)
+        self.postmortem.dump("slot_quarantine", detail={
+            "req_id": req_id, "trace": handle.trace,
+            "error": repr(exc)[:500], "streak": self._quarantine_streak})
         logger.warning(
             f"req {req_id}: quarantined after per-request failure ({exc!r}); "
             f"slot rebuilt, engine kept running ({len(self._handles)} unaffected)")
@@ -956,6 +1041,13 @@ class EngineLoop:
                             cat="request", trace=trace, wall=True,
                             finish_reason=req.finish_reason,
                             tokens=len(req.output_ids), **meta)
+        # latency attribution: every finished request's e2e decomposed into
+        # the phase vocabulary, observed into the {phase} histogram family
+        # and surfaced on /debug/requests + in postmortem bundles
+        attribution = request_attribution(req)
+        if attribution is not None:
+            for phase, seconds in attribution.items():
+                self.metrics.latency_attribution.observe(seconds, phase=phase)
         self.recent_finished.append({
             "trace": trace,
             "req_id": req.req_id,
@@ -969,6 +1061,7 @@ class EngineLoop:
             "ttft_s": req.ttft,
             "decode_time_s": req.decode_time,
             "finish_t": req.finish_t,
+            "attribution": attribution,
         })
 
     def inflight_info(self) -> List[Dict]:
@@ -1013,8 +1106,55 @@ class EngineLoop:
                 info["output_tokens"] = len(req.output_ids)
                 info["queue_wait_s"] = req.queue_wait
                 info["ttft_s"] = req.ttft
+                # disagg visibility: which stage pool holds the KV, and how
+                # long the request has been waiting on block migration so far
+                # — a stuck migration is visible LIVE, not just postmortem
+                info["kv_stage"] = getattr(req, "kv_stage", None)
+                mig_wait = getattr(req, "migration_wait_s", 0.0)
+                open_t = getattr(req, "migrate_start_t", None)
+                if open_t is not None:
+                    mig_wait += max(now - open_t, 0.0)
+                info["migration_wait_s"] = mig_wait
             out.append(info)
         return out
+
+    # ------------------------------------------------------------- postmortem
+    def _postmortem_health(self) -> Dict:
+        """Bundle health snapshot: loop + scheduler-visible state, engine
+        stats, the in-flight view and the finished tail (which carries each
+        request's latency attribution — the offline analyzer reads it)."""
+        return {
+            "loop_state": self._state,
+            "phase": self._phase,
+            "pending": self.pending_count(),
+            "slot_quarantines": self.slot_quarantines,
+            "engine": self.engine.stats(),
+            "inflight": self.inflight_info(),
+            "recent_finished": list(self.recent_finished),
+        }
+
+    def _postmortem_config(self) -> Dict:
+        """Bundle config snapshot: the engine/supervisor knobs that shaped
+        the decisions in the event trail."""
+        eng = self.engine
+        return {
+            "max_batch_size": getattr(eng, "max_batch_size", None),
+            "decode_steps": getattr(eng, "decode_steps", None),
+            "prefill_chunk_tokens": getattr(eng, "prefill_chunk_tokens", None),
+            "enable_prefix_cache": getattr(eng, "enable_prefix_cache", None),
+            "staged": getattr(eng, "staged", False),
+            "migration_inflight_limit": getattr(eng, "migration_inflight_limit", None),
+            "decode_pressure_gate": getattr(eng, "decode_pressure_gate", None),
+            "prefill_pressure_gate": getattr(eng, "prefill_pressure_gate", None),
+            "backend": self._guarded_describe(),
+            "supervisor_policy": dataclasses.asdict(self.policy),
+        }
+
+    def _guarded_describe(self) -> Dict:
+        try:
+            return self.engine.backend.describe()
+        except Exception as e:
+            return {"error": repr(e)}
 
     def _shutdown_cleanup(self):
         for handle in list(self._handles.values()):

@@ -80,6 +80,16 @@ class RouterMetrics:
             "paddlenlp_router_membership_changes_total",
             "Admin-plane replica membership mutations by op (add/drain/remove)",
             labelnames=("op",))
+        # same family name the replicas' ServingMetrics registers: the router
+        # contributes the hedge_race phase (time from shadow launch to the
+        # first usable event) so one histogram family carries the whole
+        # attribution vocabulary across tiers
+        self.latency_attribution = r.histogram(
+            "paddlenlp_serving_latency_attribution_seconds",
+            "Per-request e2e latency decomposed by phase (queue/"
+            "admission_gate/prefill/chunk_stall/migration_wait/decode on "
+            "replicas; hedge_race on the router) — phases sum to e2e",
+            labelnames=("phase",))
 
 
 # ----------------------------------------------------------------- federation

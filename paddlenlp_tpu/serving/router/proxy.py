@@ -87,6 +87,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import parse_qs, quote, unquote, urlsplit
 
 from ...observability.exporter import route_observability
+from ...observability.flight_recorder import RECORDER
+from ...observability.postmortem import PostmortemDumper, handle_postmortem_request
 from ...observability.slo import (
     DEFAULT_WINDOWS_S,
     SLOInputs,
@@ -310,6 +312,15 @@ class RouterServer:
         self.metrics = RouterMetrics(self.registry)
         self.slo = SLOTracker(objectives=slo_objectives, windows_s=slo_windows_s,
                               registry=self.registry)
+        # router-tier black box: drain-deadline evictions and SLO fast burns
+        # auto-dump a bundle (opt-in via PDNLP_TPU_POSTMORTEM_DIR); on demand
+        # via POST /debug/postmortem. The process-wide flight recorder is
+        # shared with in-process replicas, so a router bundle already joins
+        # both tiers' decision events on the trace id.
+        self.postmortem = PostmortemDumper(
+            registry=self.registry, tracer=self.tracer, tier="router",
+            health_fn=self._postmortem_health, config_fn=self._postmortem_config)
+        self.slo.on_fast_burn = self._on_fast_burn
         self.pool = pool if pool is not None else ReplicaPool(
             metrics=self.metrics, poll_interval_s=poll_interval_s,
             probe_timeout_s=probe_timeout_s, tracer=self.tracer)
@@ -403,6 +414,7 @@ class RouterServer:
             victims = [(st, st.upstream_conn, st.upstream_resp, st.upstream_cid)
                        for st in self._active
                        if st.replica_id == replica_id and st.tokens_relayed == 0]
+        evicted = 0
         for st, conn, resp, cid in victims:
             if st.replica_id != replica_id or st.tokens_relayed != 0:
                 # the relay moved on between the snapshot and now — failed
@@ -425,8 +437,15 @@ class RouterServer:
                         target=self._abort_replica_request,
                         args=(replica.host, replica.port, cid),
                         daemon=True, name=f"drain-abort-{st.rid}").start()
+            evicted += 1
+            RECORDER.record("router.drain_evict", trace=st.rid, replica=replica_id)
             self.tracer.instant("membership", cat="router", op="drain_evict",
                                 trace=st.rid, replica=replica_id)
+        if evicted:
+            # a drain that had to break streams is an incident worth a black
+            # box (rate-limited; opt-in via PDNLP_TPU_POSTMORTEM_DIR)
+            self.postmortem.dump("drain_evict", detail={
+                "replica": replica_id, "evicted_streams": evicted})
 
     def _abort_replica_request(self, host: str, port: int, upstream_cid: str) -> bool:
         """POST /v1/abort for one upstream completion id (best effort)."""
@@ -572,6 +591,13 @@ class RouterServer:
                         if payload is not None:
                             code, doc = router.admin_drain_replica(payload)
                             self._send_json(code, doc)
+                    elif self.path.split("?", 1)[0] == "/debug/postmortem":
+                        # drain any request body first (keep-alive hygiene)
+                        n = int(self.headers.get("Content-Length") or 0)
+                        if n:
+                            self.rfile.read(n)
+                        routed = handle_postmortem_request(self.path, router.postmortem)
+                        self._send_raw(routed[0], routed[2], routed[1])
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")
                 except (BrokenPipeError, ConnectionResetError):
@@ -825,6 +851,35 @@ class RouterServer:
                 doc[f"{key}_mean"] = sum(vals) / len(vals)
         return {k: out[k] for k in sorted(out)}
 
+    # ------------------------------------------------------------- postmortem
+    def _postmortem_health(self) -> Dict:
+        """Router-tier bundle health: pool snapshots + drain status + the
+        router's own open forwards — the placement facts behind the decision
+        events in the trail."""
+        return {
+            "policy": getattr(self.policy, "name", type(self.policy).__name__),
+            "replicas": self.admin_list_replicas()["replicas"],
+            "hedges_inflight": self._hedges_inflight,  # lock-ok: point-in-time snapshot for a diagnostic dump
+        }
+
+    def _postmortem_config(self) -> Dict:
+        return {
+            "max_attempts": self.max_attempts,
+            "hedge_after_s": self.hedge_after_s,
+            "max_hedges_inflight": self.max_hedges_inflight,
+            "trace_sample_every": self.trace_sample_every,
+            "upstream_timeout_s": self.upstream_timeout_s,
+            "slo_objectives": dataclasses.asdict(self.slo.objectives),
+        }
+
+    def _on_fast_burn(self, kind: str, burn_rate: float, window: str):
+        """SLO fast-burn trigger (wired into the tracker at construction): a
+        shortest-window burn past the page-now threshold snapshots the fleet
+        state that produced it. The dumper rate-limits, so a sustained burn
+        costs one bundle per window, not one per /fleet/slo scrape."""
+        self.postmortem.dump("slo_fast_burn", detail={
+            "kind": kind, "burn_rate": burn_rate, "window": window})
+
     # ------------------------------------------------------------- trace stitch
     def stitched_trace(self, trace_id: str) -> Dict:
         """One request's two-tier timeline: the router's spans plus the owning
@@ -925,6 +980,8 @@ class RouterServer:
                 # nothing relayed; 429/503/connect failure — next candidate
                 exclude.add(cand.id)
                 self.metrics.rerouted.inc()
+                RECORDER.record("router.reroute", trace=state.rid,
+                                replica=cand.id, attempt=state.attempts)
                 self.tracer.instant("reroute", cat="router", trace=state.rid,
                                     replica=cand.id)
                 continue
@@ -936,6 +993,8 @@ class RouterServer:
                 if not self.pool.is_draining(cand.id):
                     self.pool.note_forward_failure(cand.id)
                 self.metrics.failovers.inc()
+                RECORDER.record("router.failover", trace=state.rid,
+                                replica=cand.id, attempt=state.attempts)
                 self.tracer.add_span("failover", self.tracer.epoch_time(state.arrival_t),
                                      time.perf_counter() - state.arrival_t, cat="router",
                                      trace=state.rid, replica=cand.id,
@@ -1247,6 +1306,7 @@ class RouterServer:
         self._inflight_delta(cand.id, +1)
         hedge_started = False
         hedge_capped = False
+        hedge_fired_t = 0.0  # perf_counter at shadow launch (hedge_race phase)
         committed: Optional[int] = None
         first_item = None  # the committing ("event", ev) item
         failures: Dict[int, Tuple[str, object]] = {}
@@ -1265,6 +1325,9 @@ class RouterServer:
                         # latency budget blown with no first event: hedge
                         if self._try_start_hedge():
                             hedge_started = True
+                            hedge_fired_t = time.perf_counter()
+                            RECORDER.record("router.hedge_fire", trace=state.rid,
+                                            replica=hedge_cand.id)
                             self.tracer.instant("hedge", cat="router",
                                                 trace=state.rid, outcome="fired",
                                                 replica=hedge_cand.id)
@@ -1336,6 +1399,8 @@ class RouterServer:
                     # (a leg with no event yet has no id to abort by; the
                     # replica notices the disconnect on its first write)
                     abandoned[loser] = True
+                    RECORDER.record("router.hedge_abort", trace=state.rid,
+                                    replica=legs[loser].id)
                     _force_close(conns.get(loser), resps.get(loser))
                     if cids[loser] is not None:
                         self._abort_replica_request(
@@ -1343,6 +1408,12 @@ class RouterServer:
             if hedge_started:
                 label = "hedge_won" if committed == 1 else "primary_won"
                 self.metrics.hedges.inc(outcome=label)
+                RECORDER.record("router.hedge_commit", trace=state.rid,
+                                replica=committed_cand.id, outcome=label)
+                # the hedge-race phase: time between firing the shadow and the
+                # first usable event — the latency the race bought (or not)
+                self.metrics.latency_attribution.observe(
+                    time.perf_counter() - hedge_fired_t, phase="hedge_race")
                 self.tracer.instant("hedge", cat="router", trace=state.rid,
                                     outcome=label, replica=committed_cand.id)
             state.replica_id = committed_cand.id
@@ -1428,6 +1499,7 @@ class RouterServer:
         self._inflight_delta(cand.id, +1)
         hedge_started = False
         hedge_capped = False
+        hedge_fired_t = 0.0  # perf_counter at shadow launch (hedge_race phase)
         committed = None  # (leg, parsed response doc)
         failures: Dict[int, Tuple[str, object]] = {}
         threading.Thread(target=reader, args=(0, cand), daemon=True,
@@ -1444,6 +1516,9 @@ class RouterServer:
                     if deciding and time.perf_counter() >= hedge_deadline:
                         if self._try_start_hedge():
                             hedge_started = True
+                            hedge_fired_t = time.perf_counter()
+                            RECORDER.record("router.hedge_fire", trace=state.rid,
+                                            replica=hedge_cand.id)
                             self.tracer.instant("hedge", cat="router",
                                                 trace=state.rid, outcome="fired",
                                                 replica=hedge_cand.id)
@@ -1507,10 +1582,16 @@ class RouterServer:
                 else:
                     # still generating: closing its socket is the abort — the
                     # replica frees slot + KV when its response write fails
+                    RECORDER.record("router.hedge_abort", trace=state.rid,
+                                    replica=legs[loser].id)
                     _force_close(conns.get(loser), resps.get(loser))
             if hedge_started:
                 label = "hedge_won" if win_leg == 1 else "primary_won"
                 self.metrics.hedges.inc(outcome=label)
+                RECORDER.record("router.hedge_commit", trace=state.rid,
+                                replica=committed_cand.id, outcome=label)
+                self.metrics.latency_attribution.observe(
+                    time.perf_counter() - hedge_fired_t, phase="hedge_race")
                 self.tracer.instant("hedge", cat="router", trace=state.rid,
                                     outcome=label, replica=committed_cand.id)
             state.replica_id = committed_cand.id

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from ..observability.flight_recorder import RECORDER
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
@@ -98,6 +99,7 @@ class Scheduler:
         with self._lock:
             if self._draining or not self.loop.running:
                 self.rejected_draining += 1
+                RECORDER.record("sched.reject", trace=trace, reason="draining")
                 TRACER.instant("admission_rejected", cat="scheduler", reason="draining")
                 raise ShuttingDownError("server is draining; retry against another replica")
             if self.loop.degraded:
@@ -105,6 +107,8 @@ class Scheduler:
                 # with a recovery hint instead of piling work on a dead engine
                 self.rejected_degraded += 1
                 retry_after = self.loop.retry_after_hint()
+                RECORDER.record("sched.reject", trace=trace, reason="degraded",
+                                retry_after_s=retry_after)
                 TRACER.instant("admission_rejected", cat="scheduler", reason="degraded",
                                retry_after_s=retry_after)
                 raise DegradedError(
@@ -112,6 +116,8 @@ class Scheduler:
                     retry_after_s=retry_after)
             if self._inflight >= cfg.max_inflight:
                 self.rejected_saturated += 1
+                RECORDER.record("sched.reject", trace=trace, reason="saturated",
+                                inflight=self._inflight)
                 TRACER.instant("admission_rejected", cat="scheduler", reason="saturated",
                                inflight=self._inflight)
                 raise SaturatedError(

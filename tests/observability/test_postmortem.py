@@ -1,0 +1,303 @@
+"""Postmortem bundles (ISSUE 13): the dumper writes self-contained JSON
+bundles (events + spans + health + metrics + config), rate-limits auto
+triggers, gates them on PDNLP_TPU_POSTMORTEM_DIR, and the offline analyzer
+(tools/postmortem.py) reconstructs per-request cross-tier timelines from
+them. SLO fast burns fire the tracker's trigger hook."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from paddlenlp_tpu.observability import (  # noqa: E402
+    FlightRecorder,
+    PostmortemDumper,
+    SLOObjectives,
+    SLOTracker,
+    SpanTracer,
+    handle_postmortem_request,
+)
+from paddlenlp_tpu.observability.postmortem import ENV_DIR  # noqa: E402
+from paddlenlp_tpu.observability.slo import SLOInputs  # noqa: E402
+from paddlenlp_tpu.serving.metrics import MetricsRegistry  # noqa: E402
+from tools.postmortem import (  # noqa: E402
+    attribution_for,
+    load_bundles,
+    main as postmortem_main,
+    merged_events,
+    render_timeline,
+    request_ids,
+    timeline_for,
+)
+
+
+def make_dumper(tmp_path, tier="replica", **kw):
+    registry = MetricsRegistry()
+    registry.counter("demo_total", "a demo counter").inc(3)
+    tracer = SpanTracer(capacity=64)
+    recorder = FlightRecorder(capacity=64, enabled=True)
+    kw.setdefault("out_dir", str(tmp_path))
+    kw.setdefault("min_interval_s", 30.0)
+    dumper = PostmortemDumper(
+        registry=registry, tracer=tracer, recorder=recorder, tier=tier,
+        health_fn=kw.pop("health_fn", lambda: {"loop_state": "running"}),
+        config_fn=kw.pop("config_fn", lambda: {"max_batch_size": 4}), **kw)
+    return dumper, recorder, tracer
+
+
+class TestDumper:
+    def test_bundle_is_self_contained_valid_json(self, tmp_path):
+        dumper, recorder, tracer = make_dumper(tmp_path)
+        recorder.record("admit.accept", req_id=0, trace="req-0", slot=0)
+        with tracer.span("prefill", cat="engine", trace="req-0"):
+            pass
+        path = dumper.dump("supervisor_degraded", detail={"error": "boom"})
+        assert path is not None and os.path.isfile(path)
+        assert os.path.basename(path).startswith("postmortem-replica-supervisor_degraded-")
+        bundle = json.load(open(path))
+        assert bundle["version"] == 1 and bundle["tier"] == "replica"
+        assert bundle["trigger"] == "supervisor_degraded"
+        assert bundle["detail"] == {"error": "boom"}
+        assert bundle["events"][0]["name"] == "admit.accept"
+        assert any(s["name"] == "prefill" for s in bundle["spans"])
+        assert bundle["health"]["loop_state"] == "running"
+        assert bundle["config"]["max_batch_size"] == 4
+        assert "demo_total 3" in bundle["metrics"]
+        assert dumper.dumps == 1 and dumper.last_path == path
+
+    def test_rate_limit_suppresses_auto_but_not_forced(self, tmp_path):
+        dumper, _, _ = make_dumper(tmp_path, min_interval_s=3600.0)
+        assert dumper.dump("supervisor_degraded") is not None
+        assert dumper.dump("supervisor_degraded") is None  # inside the window
+        assert dumper.suppressed == 1
+        assert dumper.dump("on_demand", force=True) is not None  # force bypasses
+        assert dumper.dumps == 2
+
+    def test_forced_dump_does_not_consume_rate_limit_slot(self, tmp_path):
+        # an operator curl (or periodic monitoring scrape) of the on-demand
+        # endpoint must never suppress the NEXT incident's auto bundle
+        dumper, _, _ = make_dumper(tmp_path, min_interval_s=3600.0)
+        assert dumper.dump("on_demand", force=True) is not None
+        assert dumper.dump("supervisor_degraded") is not None  # auto still fires
+        assert dumper.suppressed == 0
+
+    def test_failed_write_releases_rate_limit_slot(self, tmp_path):
+        blocker = tmp_path / "blocked"
+        blocker.write_text("a file where the out dir should be")
+        dumper, _, _ = make_dumper(tmp_path, min_interval_s=3600.0,
+                                   out_dir=str(blocker))
+        assert dumper.dump("supervisor_degraded") is None  # makedirs fails
+        dumper._out_dir = str(tmp_path)
+        # the failed attempt must not have claimed the 1h window
+        assert dumper.dump("supervisor_degraded") is not None
+
+    def test_filenames_unique_across_dumpers_same_second(self, tmp_path):
+        d1, _, _ = make_dumper(tmp_path)
+        d2, _, _ = make_dumper(tmp_path)
+        p1 = d1.dump("supervisor_degraded", force=True)
+        p2 = d2.dump("supervisor_degraded", force=True)
+        assert p1 != p2 and os.path.isfile(p1) and os.path.isfile(p2)
+
+    def test_trigger_label_sanitized_in_filename(self, tmp_path):
+        dumper, _, _ = make_dumper(tmp_path)
+        status, _, body = handle_postmortem_request(
+            "/debug/postmortem?trigger=a/b%20drill", dumper)
+        assert status == 200
+        doc = json.loads(body)
+        assert os.path.isfile(doc["path"])
+        assert "/" not in os.path.basename(doc["path"])
+        # the bundle keeps the original label; only the filename is sanitized
+        assert json.load(open(doc["path"]))["trigger"] == "a/b drill"
+
+    def test_auto_dump_gated_on_env_dir(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(ENV_DIR, raising=False)
+        dumper = PostmortemDumper(registry=MetricsRegistry(),
+                                  tracer=SpanTracer(capacity=8),
+                                  recorder=FlightRecorder(capacity=8))
+        # no out_dir, no env var: auto triggers are opt-in -> suppressed
+        assert dumper.dump("supervisor_degraded") is None
+        assert dumper.suppressed == 1
+        # env var set: the same trigger writes
+        monkeypatch.setenv(ENV_DIR, str(tmp_path))
+        path = dumper.dump("slot_quarantine")
+        assert path is not None and path.startswith(str(tmp_path))
+
+    def test_broken_providers_do_not_kill_the_dump(self, tmp_path):
+        def bad():
+            raise RuntimeError("provider exploded")
+
+        dumper, _, _ = make_dumper(tmp_path, health_fn=bad, config_fn=bad)
+        path = dumper.dump("drain_evict", force=True)
+        bundle = json.load(open(path))
+        assert "provider exploded" in bundle["health"]["error"]
+        assert "provider exploded" in bundle["config"]["error"]
+
+    def test_http_handler_contract(self, tmp_path):
+        dumper, _, _ = make_dumper(tmp_path)
+        assert handle_postmortem_request("/not/postmortem", dumper) is None
+        status, ctype, body = handle_postmortem_request(
+            "/debug/postmortem?trigger=drill", dumper)
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["trigger"] == "drill" and os.path.isfile(doc["path"])
+        assert json.load(open(doc["path"]))["trigger"] == "drill"
+
+
+class TestSLOFastBurn:
+    def _observe_burning(self, tracker, errors_frac):
+        tracker.observe(SLOInputs(total=0, errors=0, ttft_count=0,
+                                  ttft_violations=0), now=1000.0)
+        tracker.observe(SLOInputs(total=100, errors=100 * errors_frac,
+                                  ttft_count=100, ttft_violations=0), now=1030.0)
+
+    def test_hook_fires_on_fast_burn(self):
+        tracker = SLOTracker(objectives=SLOObjectives(availability=0.999),
+                             windows_s=(60.0, 300.0), fast_burn_threshold=10.0)
+        fired = []
+        tracker.on_fast_burn = lambda kind, burn, window: fired.append(
+            (kind, burn, window))
+        self._observe_burning(tracker, errors_frac=0.5)  # burn 500x budget
+        tracker.report(now=1030.0)
+        assert fired and fired[0][0] == "availability"
+        assert fired[0][1] >= 10.0 and fired[0][2] == "60s"
+
+    def test_hook_quiet_below_threshold_and_guarded(self):
+        tracker = SLOTracker(objectives=SLOObjectives(availability=0.9),
+                             windows_s=(60.0,), fast_burn_threshold=10.0)
+        fired = []
+        tracker.on_fast_burn = lambda *a: fired.append(a)
+        self._observe_burning(tracker, errors_frac=0.0)
+        tracker.report(now=1030.0)
+        assert not fired
+        # a broken hook never breaks report()
+        tracker2 = SLOTracker(objectives=SLOObjectives(availability=0.999),
+                              windows_s=(60.0,), fast_burn_threshold=1.0)
+
+        def boom(*a):
+            raise RuntimeError("hook exploded")
+
+        tracker2.on_fast_burn = boom
+        self._observe_burning(tracker2, errors_frac=0.5)
+        assert "windows" in tracker2.report(now=1030.0)
+
+
+class TestOfflineAnalyzer:
+    """tools/postmortem.py over synthetic two-tier bundles: the router's
+    hedge/reroute events and the replica's engine events join on one trace id
+    into a monotonic timeline, and the attribution row is found."""
+
+    def _two_tier_bundles(self, tmp_path):
+        # one shared recorder = the in-process-fleet case; the router bundle
+        # and replica bundle snapshot the same ring at different moments
+        recorder = FlightRecorder(capacity=64)
+        tracer = SpanTracer(capacity=64)
+        recorder.record("router.reroute", trace="rtr-7", replica="a")
+        recorder.record("admit.accept", req_id=0, trace="rtr-7", slot=0)
+        recorder.record("chunk.grant", req_id=0, trace="rtr-7", tokens=8)
+        recorder.record("router.hedge_fire", trace="rtr-7", replica="b")
+        recorder.record("router.hedge_commit", trace="rtr-7", replica="b",
+                        outcome="hedge_won")
+        recorder.record("admit.accept", req_id=1, trace="rtr-8", slot=1)
+        tracer.add_span("prefill", tracer.now() - 0.01, 0.01, cat="engine",
+                        trace="rtr-7")
+        row = {"trace": "rtr-7", "req_id": 0, "finish_reason": "length",
+               "arrival_t": 100.0, "finish_t": 100.5,
+               "attribution": {"queue": 0.1, "admission_gate": 0.0,
+                               "prefill": 0.2, "chunk_stall": 0.0,
+                               "migration_wait": 0.0, "decode": 0.2}}
+        registry = MetricsRegistry()
+        router = PostmortemDumper(registry=registry, tracer=tracer,
+                                  recorder=recorder, tier="router",
+                                  out_dir=str(tmp_path),
+                                  health_fn=lambda: {"policy": "least_loaded"})
+        replica = PostmortemDumper(registry=registry, tracer=tracer,
+                                   recorder=recorder, tier="replica",
+                                   out_dir=str(tmp_path),
+                                   health_fn=lambda: {"recent_finished": [row]})
+        return [router.dump("drain_evict", force=True),
+                replica.dump("on_demand", force=True)]
+
+    def test_cross_tier_timeline_joined_and_monotonic(self, tmp_path):
+        paths = self._two_tier_bundles(tmp_path)
+        bundles = load_bundles(paths)
+        # duplicate events across the two overlapping bundles collapse
+        assert len(merged_events(bundles)) == 6
+        entries = timeline_for(bundles, "rtr-7")
+        names = [e["name"] for e in entries if e["kind"] == "event"]
+        assert names == ["router.reroute", "admit.accept", "chunk.grant",
+                         "router.hedge_fire", "router.hedge_commit"]
+        tiers = {e["name"]: e["tier"] for e in entries if e["kind"] == "event"}
+        assert tiers["router.hedge_fire"] == "router"
+        assert tiers["admit.accept"] == "engine"
+        assert any(e["kind"] == "span" and e["name"] == "prefill" for e in entries)
+        ts = [e["t"] for e in entries]
+        assert ts == sorted(ts)  # monotonic timeline
+        # the other request's events stay out
+        assert not any(e.get("req_id") == 1 for e in entries)
+        lines = render_timeline(entries)
+        assert len(lines) == len(entries)
+        assert "router.hedge_commit" in "".join(lines)
+
+    def test_request_listing_and_attribution(self, tmp_path):
+        paths = self._two_tier_bundles(tmp_path)
+        bundles = load_bundles(paths)
+        ids = request_ids(bundles)
+        assert set(ids) == {"rtr-7", "rtr-8"}
+        assert ids["rtr-7"]["router"] == 3 and ids["rtr-7"]["engine"] == 2
+        row = attribution_for(bundles, "rtr-7")
+        assert row is not None
+        assert abs(sum(row["attribution"].values()) - 0.5) < 1e-9
+        assert attribution_for(bundles, "rtr-404") is None
+
+    def test_cli_modes(self, tmp_path, capsys):
+        paths = self._two_tier_bundles(tmp_path)
+        assert postmortem_main(paths) == 0
+        out = capsys.readouterr().out
+        assert "tier=router" in out and "trigger=drain_evict" in out
+        assert postmortem_main(paths + ["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "rtr-7" in out and "rtr-8" in out
+        assert postmortem_main(paths + ["--req", "rtr-7"]) == 0
+        out = capsys.readouterr().out
+        assert "decision trail for rtr-7" in out
+        assert "router.hedge_fire" in out and "admit.accept" in out
+        assert "latency attribution" in out and "migration_wait" in out
+        assert postmortem_main([]) == 2
+
+    def test_traceless_listing_key_round_trips_through_req(self, tmp_path):
+        # a trace-less event is listed as "req_id:N" — that exact selector
+        # must work with --req (the tool's own output is a valid input)
+        recorder = FlightRecorder(capacity=8)
+        recorder.record("migrate.defer", req_id=5, reason="decode_pressure")
+        dumper = PostmortemDumper(registry=MetricsRegistry(),
+                                  tracer=SpanTracer(capacity=8),
+                                  recorder=recorder, out_dir=str(tmp_path))
+        bundles = load_bundles([dumper.dump("on_demand", force=True)])
+        assert "req_id:5" in request_ids(bundles)
+        entries = timeline_for(bundles, "req_id:5")
+        assert [e["name"] for e in entries] == ["migrate.defer"]
+
+    def test_pid_collision_does_not_collapse_distinct_events(self, tmp_path):
+        # two bundles from different processes that happen to share a pid:
+        # same seq numbers but different timestamps must NOT dedup
+        paths = self._two_tier_bundles(tmp_path)
+        bundles = load_bundles(paths)
+        other = json.loads(json.dumps(bundles[0]))  # deep copy, same "pid"
+        for ev in other["events"]:
+            ev["t"] += 50.0  # a different process's clock
+        assert len(merged_events(bundles + [other])) == 12
+
+    def test_req_flag_without_value_is_usage_error(self, tmp_path, capsys):
+        paths = self._two_tier_bundles(tmp_path)
+        assert postmortem_main(paths + ["--req"]) == 2
+
+    def test_rejects_non_bundle(self, tmp_path):
+        p = tmp_path / "junk.json"
+        p.write_text(json.dumps({"hello": 1}))
+        with pytest.raises(ValueError, match="not a postmortem bundle"):
+            load_bundles([str(p)])

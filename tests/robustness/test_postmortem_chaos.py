@@ -1,0 +1,270 @@
+"""Postmortem chaos (ISSUE 13 acceptance): under injected faults a bundle is
+auto-dumped, is valid JSON, contains the poisoned request's decision trail,
+and tools/postmortem.py reconstructs a monotonic cross-tier timeline.
+
+Two scenarios:
+
+- **disagg**: ``engine.kv_migrate`` + ``engine.step`` armed on a
+  disaggregated (1,1) engine behind a supervised serving server — each fault
+  trips a supervisor degrade that auto-dumps a bundle to
+  ``PDNLP_TPU_POSTMORTEM_DIR``; after recovery an on-demand bundle carries
+  the victim's full trail (admission → chunk grants → migration → requeue)
+  and the offline analyzer renders it end to end;
+- **router join**: a hedged fleet request and a failed-over request leave
+  router-tier events (hedge_fire/commit/abort, failover) that join the
+  replica's engine events (admit.accept) on ONE trace id in the router's
+  bundle — the cross-tier decision trail the flight recorder exists for.
+"""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams  # noqa: E402
+from paddlenlp_tpu.observability import RECORDER  # noqa: E402
+from paddlenlp_tpu.observability.postmortem import ENV_DIR  # noqa: E402
+from paddlenlp_tpu.serving import (  # noqa: E402
+    MetricsRegistry,
+    SchedulerConfig,
+    ServingServer,
+    SupervisorPolicy,
+)
+from paddlenlp_tpu.serving.router import launch_fleet  # noqa: E402
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from paddlenlp_tpu.utils.faults import FAULTS  # noqa: E402
+from tools.postmortem import (  # noqa: E402
+    attribution_for,
+    load_bundles,
+    timeline_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    FAULTS.reset()
+    RECORDER.clear()
+    RECORDER.set_enabled(True)
+    yield
+    FAULTS.reset()
+    RECORDER.clear()
+
+
+@pytest.fixture(scope="module")
+def model(eight_devices):
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+                      max_position_embeddings=256, eos_token_id=None, pad_token_id=0,
+                      use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def post_json(port, path, payload, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+GEN_LEN = 10
+
+
+class TestDisaggPostmortem:
+    def test_bundles_auto_dumped_and_trail_reconstructed(self, model, tmp_path,
+                                                         monkeypatch):
+        """engine.kv_migrate kills a step whose victim already streamed its
+        first token; after recovery engine.step kills another step. Each
+        degrade auto-dumps a bundle; the analyzer reconstructs the victim's
+        decision trail as one monotonic timeline with its attribution."""
+        monkeypatch.setenv(ENV_DIR, str(tmp_path))
+        registry = MetricsRegistry()
+
+        def make_engine():
+            return InferenceEngine(model, disagg_stages=(1, 1), max_batch_size=4,
+                                   block_size=4, num_blocks=128,
+                                   max_blocks_per_seq=32, decode_steps=4)
+
+        srv = ServingServer(
+            make_engine(), engine_factory=make_engine,
+            supervisor_policy=SupervisorPolicy(max_retries=2, backoff_base_s=0.3,
+                                               backoff_max_s=1.0),
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0),
+            registry=registry)
+        srv.loop.postmortem.min_interval_s = 0.0  # both incidents must dump
+        port = srv.start_in_thread()
+        try:
+            FAULTS.arm("engine.kv_migrate", nth=1)
+            results = {}
+
+            def stream_worker(i):
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+                conn.request("POST", "/v1/completions",
+                             body=json.dumps({"prompt": [5 + i, 6 + i, 7 + i],
+                                              "max_tokens": GEN_LEN, "stream": True}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                toks, finish = [], None
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    line = line.strip()
+                    if not line.startswith(b"data: ") or line == b"data: [DONE]":
+                        if line == b"data: [DONE]":
+                            break
+                        continue
+                    ev = json.loads(line[len(b"data: "):])
+                    c = ev["choices"][0]
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+                    elif "token" in c:
+                        toks.append(c["token"])
+                results[i] = (toks, finish)
+                conn.close()
+
+            threads = [threading.Thread(target=stream_worker, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            deadline = time.time() + 120
+            while time.time() < deadline and srv.loop.postmortem.dumps < 1:
+                time.sleep(0.01)
+            assert srv.loop.postmortem.dumps >= 1, \
+                "kv_migrate degrade never auto-dumped a bundle"
+            for t in threads:
+                t.join(timeout=600)
+            assert FAULTS.fired("engine.kv_migrate") == 1
+
+            # incident 2: a plain step fault after recovery — second bundle
+            FAULTS.arm("engine.step", nth=1)
+            t = threading.Thread(target=stream_worker, args=(50,))
+            t.start()
+            t.join(timeout=600)
+            assert FAULTS.fired("engine.step") == 1
+
+            # zero stream loss, token-exact vs a solo run (recovery honest)
+            assert len(results) == 4
+            for i, (toks, finish) in results.items():
+                assert finish == "length" and len(toks) == GEN_LEN, (i, finish)
+            solo = make_engine().generate([[5, 6, 7]],
+                                          SamplingParams(max_new_tokens=GEN_LEN))[0]
+            np.testing.assert_array_equal(results[0][0], solo)
+
+            # ---- the auto-dumped bundles: valid JSON, right trigger, and the
+            # first one carries the poisoned request's trail-so-far
+            auto = sorted(p for p in os.listdir(tmp_path)
+                          if p.startswith("postmortem-replica-supervisor_degraded-"))
+            assert len(auto) >= 2, auto
+            first = json.load(open(tmp_path / auto[0]))
+            assert first["version"] == 1 and first["trigger"] == "supervisor_degraded"
+            assert "kv_migrate" in first["detail"]["error"]
+            ev_names = {e["name"] for e in first["events"]}
+            assert "supervisor.degraded" in ev_names
+            assert "admit.accept" in ev_names
+            # the poisoned request (the migration fault fires on the first
+            # admitted sequence's handoff) is identifiable in the bundle
+            victims = {e.get("trace") for e in first["events"]
+                       if e["name"] == "admit.accept"}
+            assert len(victims) >= 1
+            assert first["health"]["engine"]["backend"]["kind"] == "disagg"
+            assert first["config"]["staged"] is True
+
+            # ---- on-demand bundle after recovery: the analyzer reconstructs
+            # one victim's FULL decision trail, monotonic, with attribution
+            status, doc = post_json(port, "/debug/postmortem", {})
+            assert status == 200
+            bundles = load_bundles([doc["path"]])
+            victim = sorted(victims)[0]
+            entries = timeline_for(bundles, victim)
+            names = [e["name"] for e in entries if e["kind"] == "event"]
+            assert "admit.accept" in names
+            assert "migrate.start" in names and "migrate.land" in names
+            ts = [e["t"] for e in entries]
+            assert ts == sorted(ts) and len(ts) >= 3  # monotonic timeline
+            row = attribution_for(bundles, victim)
+            assert row is not None and row["finish_reason"] == "length"
+            attr = row["attribution"]
+            e2e = row["finish_t"] - row["arrival_t"]
+            assert abs(sum(attr.values()) - e2e) <= 0.05 * e2e
+            assert attr["migration_wait"] > 0.0
+        finally:
+            srv.shutdown(drain_timeout_s=10)
+
+
+class TestRouterJoinPostmortem:
+    def test_hedge_and_failover_events_join_replica_events_on_trace(self, model,
+                                                                    tmp_path):
+        """Router hedge/failover events and replica engine events share one
+        trace id in a single bundle (in-process fleet = shared recorder) and
+        the analyzer joins them into one monotonic trail."""
+        def make_engine():
+            return InferenceEngine(model, max_batch_size=4, block_size=4,
+                                   num_blocks=128, max_blocks_per_seq=32,
+                                   decode_steps=4)
+
+        fleet = launch_fleet(
+            2, make_engine, router_registry=MetricsRegistry(),
+            poll_interval_s=0.2, hedge_after_s=0.2,
+            scheduler_config=SchedulerConfig(max_inflight=16))
+        port = fleet.router_port
+        try:
+            # ---- hedge: delay the primary leg's forward past the budget so
+            # the shadow fires and wins; the loser is torn down
+            FAULTS.arm("router.forward", action="delay", delay_s=1.5, nth=1)
+            status, doc = post_json(port, "/v1/completions",
+                                    {"prompt": [5, 6, 7], "max_tokens": 4})
+            assert status == 200
+            hedged_rid = doc["id"]
+            hedge_names = [e.name for e in RECORDER.snapshot(
+                trace=hedged_rid, name_prefix="router.hedge_")]
+            # fire first; the loser is torn down before the commit is booked
+            assert hedge_names[0] == "router.hedge_fire"
+            assert {"router.hedge_commit", "router.hedge_abort"} <= set(hedge_names)
+            # the hedge_race phase landed in the shared histogram family
+            hist = fleet.router.registry.get(
+                "paddlenlp_serving_latency_attribution_seconds")
+            assert hist.count(phase="hedge_race") == 1
+
+            # ---- failover: the first accepting replica 500s the submission
+            # (serving.submit fault) -> the router resubmits elsewhere
+            FAULTS.reset()
+            FAULTS.arm("serving.submit", nth=1)
+            status, doc = post_json(port, "/v1/completions",
+                                    {"prompt": [8, 9, 10], "max_tokens": 4})
+            assert status == 200
+            failed_rid = doc["id"]
+            assert any(e.name == "router.failover" for e in
+                       RECORDER.snapshot(trace=failed_rid))
+
+            # ---- one router bundle joins both tiers on the trace ids
+            status, pm = post_json(port, "/debug/postmortem", {})
+            assert status == 200 and pm["tier"] == "router"
+            bundles = load_bundles([pm["path"]])
+            for rid, router_event in ((hedged_rid, "router.hedge_commit"),
+                                      (failed_rid, "router.failover")):
+                entries = timeline_for(bundles, rid)
+                names = [e["name"] for e in entries if e["kind"] == "event"]
+                tiers = {e["name"]: e["tier"] for e in entries
+                         if e["kind"] == "event"}
+                assert router_event in names, (rid, names)
+                assert "admit.accept" in names, (rid, names)
+                assert tiers[router_event] == "router"
+                assert tiers["admit.accept"] == "engine"
+                ts = [e["t"] for e in entries]
+                assert ts == sorted(ts)  # joined timeline stays monotonic
+        finally:
+            fleet.shutdown(drain_timeout_s=10)

@@ -332,6 +332,51 @@ class TestCatalogs:
         msgs = [f.message for f in _run("span-catalog", ctx)]
         assert any("dynamic span name" in m for m in msgs), msgs
 
+    def test_event_catalog_fixture(self, tmp_path):
+        ctx = _ctx(tmp_path, {
+            "cat/events.py": """
+                EVENT_CATALOG = {
+                    "good.used": "a documented decision event used by the fixture",
+                    "stale.dead": "documented but recorded from nowhere any more",
+                    "dyn.a": "declared via an event-names annotation below",
+                    "short.doc": "TODO",
+                }
+                EVENT_REASONS = {
+                    "good.used": ("ok",),
+                    "ghost.event": ("oops",),
+                }
+                """,
+            "src/mod.py": """
+                RECORDER.record("good.used", reason="ok")
+                RECORDER.record("undocumented.event", req_id=1)
+                recorder.record(name)  # event-names: dyn.a
+                self.recorder.record(other)
+                RECORDER.record("short.doc")
+                unrelated.record("not_a_decision_event")
+                """,
+        }, scan_dirs=["src"], event_catalog_module="cat/events.py",
+           catalog_src_dir="src")
+        findings = _run("event-catalog", ctx)
+        msgs = [f.message for f in findings]
+        assert any("'undocumented.event'" in m and "not in" in m for m in msgs)
+        assert any("'stale.dead' has no call site" in m for m in msgs)
+        assert any("'short.doc' has no meaningful doc" in m for m in msgs)
+        assert any("dynamic decision-event name" in m for m in msgs)
+        assert any("'ghost.event'" in m and "EVENT_CATALOG" in m for m in msgs)
+        assert not any("'good.used'" in m for m in msgs)
+        assert not any("dyn.a" in m for m in msgs)
+        # the narrow receiver set keeps unrelated .record() methods out
+        assert not any("not_a_decision_event" in m for m in msgs)
+        # fingerprint contract: undocumented-name messages stay line-free
+        undoc = next(f for f in findings if "'undocumented.event'" in f.message)
+        assert ":3" not in undoc.message and undoc.line > 0
+
+    def test_event_catalog_real_tree_is_clean(self):
+        """Both directions hold on the actual repo: every RECORDER.record name
+        is cataloged and every catalog entry has a live call site."""
+        ctx = AnalysisContext(REPO)
+        assert _run("event-catalog", ctx) == []
+
     def test_metrics_catalog_fixture(self, tmp_path):
         ctx = _ctx(tmp_path, {
             "DOCS.md": "| `app_documented_total` | counter | fine |\n",

@@ -121,6 +121,7 @@ DEFAULT_CONFIG: Dict = {
     # catalogs
     "faults_module": "paddlenlp_tpu/utils/faults.py",
     "span_catalog_module": "paddlenlp_tpu/observability/span_catalog.py",
+    "event_catalog_module": "paddlenlp_tpu/observability/event_catalog.py",
     "catalog_src_dir": "paddlenlp_tpu",
     "readme_paths": ["README.md", "paddlenlp_tpu/serving/README.md"],
 }

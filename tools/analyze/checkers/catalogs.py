@@ -14,6 +14,10 @@ sub-checker enforces both directions (used ⊆ documented, documented ⊆ used):
 - **span-catalog** — every literal ``TRACER.span/instant/add_span`` name is
   registered in ``observability/span_catalog.py`` (and vice versa); a call
   site with a *dynamic* name declares its names with ``# span-names: a b c``;
+- **event-catalog** — every literal ``RECORDER.record`` decision-event name
+  is registered + documented in ``observability/event_catalog.py`` (and vice
+  versa) — the same both-directions contract as the span catalog, for the
+  flight recorder's postmortem vocabulary;
 - **metrics-catalog** — the static half of the metrics lint (the runtime
   HELP/TYPE/exposition lint stays in ``tools/check_metrics.py``, which needs
   jax to instantiate the catalog): every literal metric name registered via
@@ -204,6 +208,105 @@ def check_spans(ctx: AnalysisContext) -> List[Finding]:
                 "span-catalog", rel, 0, "SPAN_CATALOG",
                 f"span catalog entry {name!r} has no call site — stale entry, "
                 "prune it or wire the span back in"))
+    return findings
+
+
+# ------------------------------------------------------------------ events
+def _is_recorder_call(func: ast.AST) -> bool:
+    """RECORDER.record / recorder.record / self.recorder.record — the flight
+    recorder's one recording entry point. The deliberately narrow receiver
+    set keeps unrelated ``.record()`` methods out of the checker."""
+    if not (isinstance(func, ast.Attribute) and func.attr == "record"):
+        return False
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id in ("RECORDER", "recorder")
+    if isinstance(v, ast.Attribute):
+        return v.attr in ("recorder", "_recorder")
+    return False
+
+
+def event_call_sites(ctx: AnalysisContext) -> Tuple[Dict[str, List[Tuple[str, int]]],
+                                                    List[Finding]]:
+    """Literal decision-event names used under the catalog source dir, plus
+    findings for dynamic-name call sites (declare with ``# event-names:``)."""
+    used: Dict[str, List[Tuple[str, int]]] = {}
+    findings: List[Finding] = []
+    for rel in ctx.iter_py([ctx.config["catalog_src_dir"]]):
+        src = ctx.source(rel)
+        if "RECORDER" not in src and "recorder" not in src:
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_recorder_call(node.func)):
+                continue
+            name = str_arg(node)
+            if name is not None:
+                used.setdefault(name, []).append((rel, node.lineno))
+                continue
+            declared = _declared_event_names(ctx, rel, node.lineno)
+            if declared:
+                for n in declared:
+                    used.setdefault(n, []).append((rel, node.lineno))
+            else:
+                findings.append(Finding(
+                    "event-catalog", rel, node.lineno,
+                    enclosing_scope(tree, node.lineno),
+                    "dynamic decision-event name in record() call — declare "
+                    "the possible names with `# event-names: a b c`"))
+    return used, findings
+
+
+_RE_EVENT_NAMES = re.compile(r"#\s*event-names:\s*([\w.\- ]+)")
+
+
+def _declared_event_names(ctx: AnalysisContext, rel: str, line: int) -> List[str]:
+    for text in _annotation_lines(ctx, rel, line):
+        m = _RE_EVENT_NAMES.search(text)
+        if m:
+            return m.group(1).split()
+    return []
+
+
+@register("event-catalog", "flight-recorder decision-event names used == "
+                           "documented in observability/event_catalog.py")
+def check_events(ctx: AnalysisContext) -> List[Finding]:
+    rel = ctx.config["event_catalog_module"]
+    try:
+        mod = load_module_by_path(ctx.abspath(rel), "_analyze_events")
+        catalog = dict(mod.EVENT_CATALOG)
+        reasons = dict(getattr(mod, "EVENT_REASONS", {}))
+    except Exception as e:
+        return [Finding("event-catalog", rel, 0, "<module>",
+                        f"cannot load event catalog: {e!r}")]
+    used, findings = event_call_sites(ctx)
+    for name, where in sorted(used.items()):
+        if name not in catalog:
+            # message stays line-number-free (fingerprint contract); the first
+            # call site's line rides in Finding.line for display only
+            files = sorted({f for f, _ in where})
+            findings.append(Finding(
+                "event-catalog", where[0][0], where[0][1], "EVENT_CATALOG",
+                f"decision event {name!r} (used in {files[:3]}) not in "
+                "EVENT_CATALOG — event names are stable postmortem API, "
+                "register + document it"))
+    for name, doc in sorted(catalog.items()):
+        if not doc or len(doc.strip()) < 15:
+            findings.append(Finding("event-catalog", rel, 0, "EVENT_CATALOG",
+                                    f"event catalog entry {name!r} has no meaningful doc"))
+        if name not in used:
+            findings.append(Finding(
+                "event-catalog", rel, 0, "EVENT_CATALOG",
+                f"event catalog entry {name!r} has no call site — stale "
+                "entry, prune it or wire the event back in"))
+    for name in sorted(reasons):
+        if name not in catalog:
+            findings.append(Finding(
+                "event-catalog", rel, 0, "EVENT_REASONS",
+                f"EVENT_REASONS entry {name!r} names an event missing from "
+                "EVENT_CATALOG"))
     return findings
 
 

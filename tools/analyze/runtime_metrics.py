@@ -59,10 +59,12 @@ def catalog_exposition() -> str:
     from paddlenlp_tpu.trainer.integrations import register_training_metrics
 
     registry = MetricsRegistry()
-    ServingMetrics(_stub_engine(), registry=registry)
+    serving = ServingMetrics(_stub_engine(), registry=registry)
     router = RouterMetrics(registry)
     # labeled series expose no samples until touched — exercise one labelset
     # of each so the lint sees real sample lines, not just HELP/TYPE headers
+    serving.latency_attribution.observe(0.01, phase="queue")
+    router.latency_attribution.observe(0.02, phase="hedge_race")
     router.replica_healthy.set(1.0, replica="replica-0")
     router.requests.inc(replica="replica-0", outcome="ok")
     router.health_polls.inc(replica="replica-0", outcome="ok")

@@ -66,6 +66,21 @@ Usage::
                                                  # races a shadow on the next
                                                  # replica; JSON adds hedges
                                                  # (total fired/capped)
+    PDNLP_TPU_FLIGHT_RECORDER=0 python tools/bench_serve.py
+                                                 # flight recorder disabled:
+                                                 # rerun without the env var
+                                                 # and diff value/tails — the
+                                                 # recorder-overhead A/B. The
+                                                 # JSON line always carries
+                                                 # flight_recorder (on/off) +
+                                                 # flight_events, and an
+                                                 # `attribution` record with
+                                                 # per-phase p50/p99 (queue/
+                                                 # admission_gate/prefill/
+                                                 # chunk_stall/migration_wait/
+                                                 # decode) so a BENCH_r*
+                                                 # regression localizes to a
+                                                 # phase, not just a number
     python tools/bench_serve.py --disagg 2,2 --long-prompt-mix --prefill-chunk 64
                                                  # disaggregated prefill/decode
                                                  # engine: prompt work on a
@@ -496,6 +511,25 @@ def run() -> None:
             scalar_sum("paddlenlp_serving_prefix_cache_hits_total") / (n_requests + 1), 4),
         "cached_tokens": int(scalar_sum("paddlenlp_serving_prefix_cache_cached_tokens_total")),
     }
+    # per-phase latency attribution (worst replica's quantiles, like the other
+    # tail readouts): a BENCH_r* regression now names the phase that moved
+    from paddlenlp_tpu.observability import RECORDER
+
+    attr_name = "paddlenlp_serving_latency_attribution_seconds"
+    attribution = {}
+    for phase in ("queue", "admission_gate", "prefill", "chunk_stall",
+                  "migration_wait", "decode"):
+        p50 = max([histogram_quantile(f[attr_name], 0.5, phase=phase)
+                   for f in replica_fams if attr_name in f] or [0.0])
+        p99 = max([histogram_quantile(f[attr_name], 0.99, phase=phase)
+                   for f in replica_fams if attr_name in f] or [0.0])
+        attribution[phase] = {"p50_ms": round(p50 * 1e3, 1),
+                              "p99_ms": round(p99 * 1e3, 1)}
+    record["attribution"] = attribution
+    # recorder-overhead A/B facts: run once with PDNLP_TPU_FLIGHT_RECORDER=0
+    # and once without, diff value/tails — these two fields label the arms
+    record["flight_recorder"] = RECORDER.enabled
+    record["flight_events"] = len(RECORDER)
     if long_mix:
         gaps = sorted(stats["gaps_short"])
         gp = lambda q: gaps[min(int(q * len(gaps)), len(gaps) - 1)] if gaps else 0.0
