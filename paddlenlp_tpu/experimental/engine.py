@@ -69,6 +69,11 @@ class SamplingParams:
     frequency_penalty: float = 0.0
 
 
+#: admission preference rank per serving priority class (lower admits first);
+#: unknown strings rank as interactive so a bare engine user can ignore this
+_PRIORITY_RANK = {"interactive": 0, "batch": 1, "best_effort": 2}
+
+
 @dataclasses.dataclass
 class Request:
     req_id: int
@@ -85,6 +90,11 @@ class Request:
     aborted: bool = False
     base_prompt_len: int = 0  # original prompt length (preemption grows prompt_ids)
     trace: Optional[str] = None  # observability trace id (serving request context)
+    # serving request priority ("interactive" | "batch" | "best_effort"):
+    # orders the waiting queue under load — interactive admits ahead of batch,
+    # batch ahead of best_effort; FIFO within a class (0/1/2 rank, see
+    # InferenceEngine.add_request)
+    priority: str = "interactive"
     prefilled_len: int = 0  # prompt tokens whose KV is in the pool (chunked prefill)
     # which stage's pool holds this sequence's KV (disaggregated backends):
     # "prefill" while chunks run, "migrating" while blocks move between stage
@@ -287,7 +297,8 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
-                    stream_cb: Optional[Callable] = None, trace: Optional[str] = None) -> int:
+                    stream_cb: Optional[Callable] = None, trace: Optional[str] = None,
+                    priority: str = "interactive") -> int:
         sampling = sampling or SamplingParams()
         req = Request(
             req_id=next(self._next_id),
@@ -296,9 +307,22 @@ class InferenceEngine:
             stream_cb=stream_cb,
             arrival_t=time.time(),
             trace=trace,
+            priority=priority,
         )
         req.base_prompt_len = len(req.prompt_ids)
-        self.waiting.append(req)
+        # priority-ordered admission: insert before the first waiting request
+        # of a STRICTLY lower class so interactive work overtakes queued batch/
+        # best-effort prompts under load, while same-class order stays FIFO
+        # (the default "interactive"-everywhere case degenerates to append).
+        # Preemption-requeues keep their appendleft fast path untouched.
+        rank = _PRIORITY_RANK.get(priority, 0)
+        if not self.waiting or _PRIORITY_RANK.get(self.waiting[-1].priority, 0) <= rank:
+            self.waiting.append(req)
+        else:
+            for i, queued in enumerate(self.waiting):
+                if _PRIORITY_RANK.get(queued.priority, 0) > rank:
+                    self.waiting.insert(i, req)
+                    break
         return req.req_id
 
     def has_work(self) -> bool:

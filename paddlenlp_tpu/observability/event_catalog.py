@@ -37,7 +37,11 @@ EVENT_CATALOG: Dict[str, str] = {
     "migrate.defer": "the head pending migration was deferred; recorded once per wait episode (reason=decode_pressure|inflight_limit)",
     "migrate.land": "a sequence's migrated blocks landed in the decode pool; it is now decode-eligible (fields: blocks, polls)",
     # ------------------------------------------------------------- scheduler (admission control)
-    "sched.reject": "the scheduler shed a submission before it reached the engine (reason=saturated|draining|degraded -> HTTP 429/503)",
+    "sched.reject": "the scheduler shed a submission before it reached the engine (reason=saturated|draining|degraded|deadline|shed -> HTTP 429/503)",
+    # ------------------------------------------------------------- brownout (overload degradation ladder)
+    "brownout.enter": "the replica entered brownout level 1+ from normal operation (reason=saturation|slo_fast_burn)",
+    "brownout.step": "the brownout ladder moved one level while already browned out (fields: prev, level, direction)",
+    "brownout.exit": "sustained calm de-escalated the replica back to normal operation (hysteresis-guarded; fields: held_s)",
     # ------------------------------------------------------------- engine loop / supervisor
     "supervisor.degraded": "engine.step() raised without per-request attribution; the loop entered DEGRADED and triaged in-flight work",
     "supervisor.recovered": "the engine was rebuilt and stashed requests requeued; the loop left DEGRADED (fields: attempts, requeued, failed)",
@@ -49,6 +53,11 @@ EVENT_CATALOG: Dict[str, str] = {
     "router.hedge_commit": "one hedged leg produced the first usable event and was committed (fields: outcome=primary_won|hedge_won)",
     "router.hedge_abort": "the losing hedged leg was torn down (socket closed; /v1/abort when its upstream id was known)",
     "router.drain_evict": "a drain outlived its deadline; a token-less stream pinned to the draining replica was broken into pre-token failover",
+    # ------------------------------------------------------------- autoscaler (fleet policy loop)
+    "scale.up": "the autoscaler grew the fleet after sustained overload (fields: added, replicas)",
+    "scale.down": "the autoscaler drained + removed replicas after sustained underload (fields: removed, replicas)",
+    "scale.replace": "a DOWN replica was force-removed and a replacement provisioned (fields: replica)",
+    "scale.hold": "a scale action was suppressed; recorded once per episode (reason=cooldown|hysteresis|max_envelope|min_envelope|provision_backoff)",
 }
 
 #: closed ``reason`` vocabularies for events that carry one. The recorder
@@ -58,5 +67,8 @@ EVENT_REASONS: Dict[str, Tuple[str, ...]] = {
     "admit.reject": ("capacity",),
     "preempt": ("decode_growth", "mixed_capacity", "spec_reserve"),
     "migrate.defer": ("decode_pressure", "inflight_limit"),
-    "sched.reject": ("saturated", "draining", "degraded"),
+    "sched.reject": ("saturated", "draining", "degraded", "deadline", "shed"),
+    "brownout.enter": ("saturation", "slo_fast_burn"),
+    "scale.hold": ("cooldown", "hysteresis", "max_envelope", "min_envelope",
+                   "provision_backoff"),
 }

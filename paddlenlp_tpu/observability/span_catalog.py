@@ -44,7 +44,8 @@ SPAN_CATALOG = {
     "request": "retrospective whole-request span (arrival -> finish) under the request's trace id",
     "queue": "retrospective per-request wait from arrival to slot admission",
     # ------------------------------------------------------------- scheduler
-    "admission_rejected": "instant: scheduler shed a submission (reason=draining|degraded|saturated)",
+    "admission_rejected": "instant: scheduler shed a submission (reason=draining|degraded|saturated|deadline|shed)",
+    "brownout": "instant: the overload-brownout ladder changed effective level (prev -> level, reason=saturation|slo_fast_burn|push)",
     # ------------------------------------------------------------- router
     "route": "routing decision for one request (snapshot + policy ordering)",
     "router_request": "whole router-side request span (forward + stream relay)",

@@ -8,6 +8,7 @@ jax-backed engine lazily at construction time.
 
 from . import router  # noqa: F401  (multi-replica front tier; stdlib-only)
 from .api import ServingServer  # noqa: F401
+from .brownout import BrownoutController, BrownoutPolicy, PRIORITIES  # noqa: F401
 from .engine_loop import (  # noqa: F401
     EngineLoop,
     RequestHandle,
@@ -16,10 +17,12 @@ from .engine_loop import (  # noqa: F401
 )
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .scheduler import (  # noqa: F401
+    DeadlineUnmetError,
     DegradedError,
     SaturatedError,
     Scheduler,
     SchedulerConfig,
+    ShedError,
     ShuttingDownError,
 )
 
@@ -35,6 +38,11 @@ __all__ = [
     "SaturatedError",
     "ShuttingDownError",
     "DegradedError",
+    "ShedError",
+    "DeadlineUnmetError",
+    "BrownoutController",
+    "BrownoutPolicy",
+    "PRIORITIES",
     "MetricsRegistry",
     "Counter",
     "Gauge",

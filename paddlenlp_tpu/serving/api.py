@@ -10,7 +10,9 @@ Routes::
 
     POST /v1/completions   {"prompt": str | [int], "max_tokens": int,
                             "stream": bool, "temperature"/"top_p"/"top_k"/
-                            "seed"/"do_sample", "timeout": float}
+                            "seed"/"do_sample", "timeout": float,
+                            "priority": "interactive"|"batch"|"best_effort",
+                            "deadline_ms": float}
     POST /v1/abort         {"id": "cmpl-N"}        — cancel an in-flight request
     GET  /metrics          Prometheus text exposition
     GET  /health           liveness + scheduler/engine stats + tracer clock
@@ -21,6 +23,8 @@ Routes::
                            while another capture runs)
     POST /debug/postmortem force a postmortem bundle dump (events + spans +
                            health + metrics + config); returns its path
+    POST /admin/brownout   router/autoscaler-pushed overload-brownout floor
+                           {"level": 0..3, "reason"?, "ttl_s"?}
 
 Backpressure maps to HTTP: 429 when the admission window is full (retryable),
 503 while draining, 413 for oversized bodies. A client disconnect mid-stream
@@ -47,11 +51,14 @@ from ..utils.log import logger
 from .engine_loop import EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
 from .httputil import JsonRequestHandler
 from .metrics import REGISTRY, MetricsRegistry
+from .brownout import PRIORITIES
 from .scheduler import (
+    DeadlineUnmetError,
     DegradedError,
     SaturatedError,
     Scheduler,
     SchedulerConfig,
+    ShedError,
     ShuttingDownError,
 )
 
@@ -104,6 +111,15 @@ class ServingServer:
         self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry),
                                engine_factory=engine_factory, policy=supervisor_policy)
         self.scheduler = Scheduler(self.loop, scheduler_config)
+        # brownout side effects: level >= 2 turns speculative decode off on
+        # the live engine (conserve device cycles for committed tokens); the
+        # baseline is captured here so exit restores the configured behavior.
+        # A supervisor rebuild comes up with factory defaults — the next level
+        # transition re-applies.
+        self._spec_baseline = bool(getattr(engine, "use_speculative", False))
+        self.scheduler.brownout.on_level_change = self._apply_brownout_level
+        self.loop.metrics.brownout_level.set_function(
+            lambda: self.scheduler.brownout.level)
         self._ids = itertools.count()
         self._live: Dict[str, RequestHandle] = {}
         self._live_lock = threading.Lock()
@@ -158,6 +174,15 @@ class ServingServer:
             max_retries = int(max_retries)
             if max_retries < 0:
                 raise ValueError("max_retries must be >= 0")
+        priority = str(payload.get("priority", "interactive"))
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {'/'.join(PRIORITIES)}, got {priority!r}")
+        deadline_s = payload.get("deadline_ms")
+        if deadline_s is not None:
+            deadline_s = float(deadline_s) / 1e3
+            if deadline_s <= 0:
+                raise ValueError("deadline_ms must be > 0 milliseconds")
         trace_id = None
         ctx = parse_traceparent(traceparent)
         if ctx is not None:
@@ -169,7 +194,8 @@ class ServingServer:
             self.tracer.instant("trace_adopted", cat="serving", trace=trace_id,
                                 parent=parent_id)
         handle = self.scheduler.submit(ids, sampling, timeout_s=timeout_s,
-                                       max_retries=max_retries, trace=trace_id)
+                                       max_retries=max_retries, trace=trace_id,
+                                       priority=priority, deadline_s=deadline_s)
         cid = f"cmpl-{next(self._ids)}"
         with self._live_lock:
             self._live[cid] = handle
@@ -201,6 +227,33 @@ class ServingServer:
                 self._drain_retry_after = retry_after_s
         self.scheduler.start_drain()
         return {"draining": True, "retry_after_s": self._drain_retry_after}
+
+    def _apply_brownout_level(self, level: int):
+        """Brownout ladder side effects on the live engine: level >= 2
+        disables speculative decode (spend device time on committed tokens
+        only); exit restores the construction-time baseline."""
+        engine = self.loop.engine
+        if hasattr(engine, "use_speculative"):
+            engine.use_speculative = False if level >= 2 else self._spec_baseline
+
+    def push_brownout(self, payload: dict) -> dict:
+        """Router/autoscaler-pushed brownout floor (POST /admin/brownout):
+        the fleet tier saw SLO fast burn or is pinned at its max scale
+        envelope, so this replica must start shedding even if its local
+        pressure signal has not tripped yet. ``{"level": 0..3, "reason"?,
+        "ttl_s"?}`` — level 0 lifts the floor."""
+        level = int(payload.get("level", 1))
+        if not 0 <= level <= 3:
+            raise ValueError(f"level must be in [0, 3], got {level}")
+        ttl_s = payload.get("ttl_s")
+        if ttl_s is not None:
+            ttl_s = float(ttl_s)
+            if not (ttl_s > 0):
+                raise ValueError("ttl_s must be > 0 seconds")
+        reason = str(payload.get("reason", "slo_fast_burn"))
+        effective = self.scheduler.brownout.push(level, reason=reason, ttl_s=ttl_s)
+        return {"level": effective, "pushed": level,
+                "brownout": self.scheduler.brownout.stats()}
 
     def _decode_delta(self, toks, emitted: int, final: bool = False):
         """Incremental detokenization: full-decode + diff. A trailing U+FFFD
@@ -251,6 +304,10 @@ class ServingServer:
                             "status": status,
                             "scheduler": server.scheduler.stats(),
                             "engine": server.loop.engine.stats(),
+                            # overload ladder level, top-level so the router's
+                            # health poller can read it without digging into
+                            # scheduler stats (>= 2 suppresses hedging here)
+                            "brownout": server.scheduler.brownout.level,
                             # tracer-timeline clock, piggybacked for the
                             # router's RTT-midpoint clock-skew estimate
                             "now": server.tracer.now(),
@@ -301,6 +358,15 @@ class ServingServer:
                                     "invalid_request")
                             else:
                                 self._send_json(200, doc)
+                    elif self.path == "/admin/brownout":
+                        payload = self._read_body()
+                        if payload is not None:
+                            try:
+                                doc = server.push_brownout(payload)
+                            except (TypeError, ValueError) as e:
+                                self._send_error_json(400, str(e), "invalid_request")
+                            else:
+                                self._send_json(200, doc)
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")
                 except (BrokenPipeError, ConnectionResetError):
@@ -318,7 +384,25 @@ class ServingServer:
                     cid, handle = server.submit(
                         payload, traceparent=self.headers.get(TRACEPARENT_HEADER))
                 except SaturatedError as e:
-                    self._send_error_json(429, str(e), "rate_limit_exceeded")
+                    # Retry-After from the live queue-wait estimate: the hint
+                    # tracks how deep the backlog actually is right now
+                    self._send_error_json(
+                        429, str(e), "rate_limit_exceeded",
+                        headers={"Retry-After": max(1, int(round(
+                            getattr(e, "retry_after_s", 1.0))))})
+                    return
+                except ShedError as e:
+                    # brownout priority shed: clean 503 + the live hint — the
+                    # client (or router) backs off instead of re-queueing work
+                    # the ladder will keep rejecting
+                    self._send_error_json(
+                        503, str(e), "overloaded_shed",
+                        headers={"Retry-After": max(1, int(round(e.retry_after_s)))})
+                    return
+                except DeadlineUnmetError as e:
+                    self._send_error_json(
+                        503, str(e), "deadline_unmet",
+                        headers={"Retry-After": max(1, int(round(e.retry_after_s)))})
                     return
                 except DegradedError as e:
                     # circuit breaker: engine rebuild in progress — a clean 503

@@ -179,10 +179,13 @@ class RequestHandle:
     """Client-side view of one in-flight request (future + token stream)."""
 
     def __init__(self, prompt_len: int, deadline_t: Optional[float] = None,
-                 trace: Optional[str] = None, max_retries: Optional[int] = None):
+                 trace: Optional[str] = None, max_retries: Optional[int] = None,
+                 priority: str = "interactive"):
         self.req_id: Optional[int] = None  # assigned on the loop thread
         self.trace = trace  # span-tracer trace id linking this request's phases
         self.prompt_len = prompt_len
+        self.priority = priority  # serving priority class (brownout shed order)
+        self.depth_at_submit = 0  # engine backlog when submitted (queue-wait norm)
         self.deadline_t = deadline_t
         self.submitted_t = time.time()
         self.timed_out = False
@@ -302,6 +305,16 @@ class ServingMetrics:
             "paddlenlp_serving_slot_quarantines_total",
             "Poisoned requests quarantined by slot-level partial recovery "
             "(KV released, handle failed, engine kept running)")
+        self.shed = r.counter(
+            "paddlenlp_serving_requests_shed_total",
+            "Submissions rejected on arrival by overload controls, by reason "
+            "(shed = brownout priority shed; deadline = queue-wait estimate "
+            "already blew the request's deadline_ms)",
+            labelnames=("reason",))
+        self.brownout_level = r.gauge(
+            "paddlenlp_serving_brownout_level",
+            "Current overload-brownout ladder level (0 normal, 1 shed "
+            "best-effort, 2 conserve, 3 clamp max_tokens)")
         self.latency_attribution = r.histogram(
             "paddlenlp_serving_latency_attribution_seconds",
             "Per-request e2e latency decomposed by phase (queue/"
@@ -528,6 +541,23 @@ class EngineLoop:
         self.slot_quarantines = 0
         self._retry_after_hint = self.policy.backoff_base_s
         self._trace_seq = itertools.count()
+        # live queue-wait estimator: per-backlog-slot queue+gate seconds of
+        # recently finished requests (PR-13 attribution), appended on the loop
+        # thread, read (sorted) by HTTP threads computing Retry-After hints —
+        # iterating a deque concurrently with an append raises RuntimeError,
+        # so BOTH sides take the lock (appends are per-finished-request, reads
+        # per-rejection: cold path either way). Scaled by the CURRENT backlog
+        # at estimate time, the p50 becomes the hint that tracks queue depth.
+        self._qw_lock = threading.Lock()
+        self._queue_wait_samples: deque = deque(maxlen=64)  # guarded-by: _qw_lock
+        # samples only refresh when admitted requests FINISH — if overload
+        # leaves a high estimate and then everything is shed/deadline-rejected
+        # on arrival, nothing ever refreshes it and the rejection latches on
+        # an idle replica. Stale samples (no finish for this long) are
+        # dropped, falling back to the cold-start default.
+        self.queue_wait_sample_ttl_s = 60.0
+        self._qw_fresh_t = 0.0  # guarded-by: _qw_lock — last sample append
+        self._default_queue_wait_s = 0.05
         # /debug/requests tail: finished-request summaries (appended only on
         # the loop thread; deque ops are atomic so HTTP readers need no lock)
         self.recent_finished: deque = deque(maxlen=64)
@@ -606,20 +636,23 @@ class EngineLoop:
     # ------------------------------------------------------------- client api
     def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None,
                max_retries: Optional[int] = None,
-               trace: Optional[str] = None) -> RequestHandle:
+               trace: Optional[str] = None,
+               priority: str = "interactive") -> RequestHandle:
         """Thread-safe request submission; returns immediately with a handle.
 
         ``max_retries`` overrides the supervisor policy's per-request requeue
         budget (0 = never requeue across an engine rebuild: fail fast with
         ``finish_reason="engine_error"``). ``trace`` adopts an inbound trace id
         (the router's ``rtr-N`` from the traceparent header) instead of minting
-        a local ``req-N`` — the key to cross-tier trace stitching."""
+        a local ``req-N`` — the key to cross-tier trace stitching.
+        ``priority`` orders the engine's waiting queue (interactive ahead of
+        batch ahead of best_effort) and selects the brownout shed class."""
         if not self.running:
             raise RuntimeError("engine loop is not running")
         deadline_t = None if deadline_s is None else time.time() + deadline_s
         handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t,
                                trace=trace if trace is not None else f"req-{next(self._trace_seq)}",
-                               max_retries=max_retries)
+                               max_retries=max_retries, priority=priority)
         handle._prompt_ids = [int(t) for t in prompt_ids]
         handle._sampling = sampling
         self._cmds.put(("submit", handle, prompt_ids, sampling))
@@ -909,8 +942,8 @@ class EngineLoop:
             handle._retry_prefix = streamed
             stream_cb = self._make_stream_cb(handle)
             try:
-                handle.req_id = self.engine.add_request(
-                    prompt, sampling, stream_cb=stream_cb, trace=handle.trace)
+                handle.req_id = self._add_to_engine(handle, prompt, sampling,
+                                                    stream_cb)
             except Exception as e:
                 # the rebuilt engine rejected the requeue: fail THIS request
                 # rather than losing it (a poisoned engine will re-trip the
@@ -959,10 +992,11 @@ class EngineLoop:
                 if handle._cancelled:
                     handle._resolve(None)
                     continue
+                handle.depth_at_submit = self._engine_backlog()
                 stream_cb = self._make_stream_cb(handle)
                 try:
-                    handle.req_id = self.engine.add_request(
-                        prompt_ids, sampling, stream_cb=stream_cb, trace=handle.trace)
+                    handle.req_id = self._add_to_engine(handle, prompt_ids,
+                                                        sampling, stream_cb)
                 except BaseException as e:
                     # the command is consumed — resolve the waiter before the
                     # supervisor takes over, or the client blocks forever
@@ -971,6 +1005,44 @@ class EngineLoop:
                 self._handles[handle.req_id] = handle
             elif kind == "abort":
                 self._abort_handle(handle)
+
+    def _add_to_engine(self, handle: RequestHandle, prompt_ids, sampling,
+                       stream_cb) -> int:
+        """One engine submission. ``priority`` is forwarded only when it is
+        non-default so engine stand-ins (chaos-test stubs, older backends)
+        with the narrower ``add_request`` signature keep working."""
+        kw = {}
+        if handle.priority != "interactive":
+            kw["priority"] = handle.priority
+        return self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb,
+                                       trace=handle.trace, **kw)
+
+    def _engine_backlog(self) -> int:
+        """Requests ahead of a new arrival: engine waiting queue + running
+        slots. Falls back to the handle count for engines without the standard
+        scheduler surface (test stubs). Tolerates concurrent mutation — a
+        slightly stale count only jitters the Retry-After hint."""
+        try:
+            running = sum(1 for s in list(self.engine.slots) if s is not None)
+            return len(self.engine.waiting) + running
+        except Exception:
+            return len(self._handles)
+
+    def queue_wait_estimate(self, backlog: Optional[int] = None) -> float:
+        """Live estimate (seconds) of how long a newly arriving request would
+        wait for a slot: the p50 of recent per-backlog-slot queue+gate waits
+        (PR-13 attribution) scaled by the CURRENT engine backlog — so 429/503
+        ``Retry-After`` hints and deadline-aware admission track queue depth
+        instead of quoting a constant. Callable from any thread."""
+        if backlog is None:
+            backlog = self._engine_backlog()
+        with self._qw_lock:
+            if self._queue_wait_samples and \
+                    time.time() - self._qw_fresh_t > self.queue_wait_sample_ttl_s:
+                self._queue_wait_samples.clear()
+            samples = sorted(self._queue_wait_samples)
+        per_slot = samples[len(samples) // 2] if samples else self._default_queue_wait_s
+        return per_slot * (backlog + 1)
 
     def _make_stream_cb(self, handle: RequestHandle):
         def cb(tok: int, done: bool):
@@ -1048,6 +1120,15 @@ class EngineLoop:
         if attribution is not None:
             for phase, seconds in attribution.items():
                 self.metrics.latency_attribution.observe(seconds, phase=phase)
+            if handle is not None:
+                # feed the live queue-wait estimator: this request's observed
+                # pre-admission wait, normalized by the backlog it arrived
+                # behind (loop-thread append; see queue_wait_estimate)
+                wait = attribution["queue"] + attribution["admission_gate"]
+                with self._qw_lock:
+                    self._queue_wait_samples.append(
+                        wait / (max(handle.depth_at_submit, 0) + 1))
+                    self._qw_fresh_t = time.time()
         self.recent_finished.append({
             "trace": trace,
             "req_id": req.req_id,

@@ -13,11 +13,30 @@ stdlib-only HTTP router that fronts N ``ServingServer`` replicas —
 - :mod:`.proxy` — ``RouterServer``: SSE passthrough, 429/503 re-routing,
   pre-token failover, in-band ``replica_error`` mid-stream terminal;
 - :mod:`.metrics` — the ``paddlenlp_router_*`` catalog;
-- :mod:`.launcher` — in-process fleet helpers for tests and the CPU bench.
+- :mod:`.launcher` — in-process fleet helpers for tests and the CPU bench;
+- :mod:`.autoscaler` — the closed-loop policy thread that watches
+  ``/fleet/slo`` + ``/replicas`` and drives the admin plane (scale up/down,
+  replace DOWN replicas, brownout handoff at the max envelope).
 """
 
+from .autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerPolicy,
+    FleetObservation,
+    InProcessProvisioner,
+    ProvisionedReplica,
+    ReplicaObservation,
+    ReplicaProvisioner,
+    RouterAdminClient,
+    SubprocessProvisioner,
+)
 from .launcher import ReplicaFleet, launch_fleet, launch_replicas  # noqa: F401
-from .metrics import RouterMetrics, federate_expositions, lint_federation  # noqa: F401
+from .metrics import (  # noqa: F401
+    AutoscalerMetrics,
+    RouterMetrics,
+    federate_expositions,
+    lint_federation,
+)
 from .policy import (  # noqa: F401
     HashRing,
     LeastLoadedPolicy,
@@ -43,6 +62,16 @@ from .proxy import RouterServer  # noqa: F401
 
 __all__ = [
     "RouterServer",
+    "Autoscaler",
+    "AutoscalerPolicy",
+    "AutoscalerMetrics",
+    "FleetObservation",
+    "ReplicaObservation",
+    "ReplicaProvisioner",
+    "ProvisionedReplica",
+    "InProcessProvisioner",
+    "SubprocessProvisioner",
+    "RouterAdminClient",
     "ReplicaPool",
     "Replica",
     "ReplicaSnapshot",

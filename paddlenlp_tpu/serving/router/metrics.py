@@ -22,8 +22,8 @@ from typing import Dict, List, Mapping, Optional, Tuple
 from ...observability.prometheus import parse_prometheus_text
 from ..metrics import REGISTRY, MetricsRegistry, _format_labels, _format_value
 
-__all__ = ["RouterMetrics", "ROUTE_DECISION_BUCKETS", "federate_expositions",
-           "federate_families", "lint_federation"]
+__all__ = ["RouterMetrics", "AutoscalerMetrics", "ROUTE_DECISION_BUCKETS",
+           "federate_expositions", "federate_families", "lint_federation"]
 
 # seconds; routing decisions are pure host work (snapshot + sort/hash), so the
 # interesting range is tens of microseconds to a few milliseconds — the default
@@ -74,7 +74,10 @@ class RouterMetrics:
             "paddlenlp_router_hedges_total",
             "Hedged stream attempts by outcome: primary_won/hedge_won (the "
             "shadow fired and lost/won the first-token race), capped (the "
-            "in-flight-hedge cap suppressed it), failed (both legs died)",
+            "in-flight-hedge cap suppressed it, counted at hedge-fire time), "
+            "brownout (a leg's brownout level >= 2 suppressed the race, "
+            "counted once per request at candidate selection), failed (both "
+            "legs died)",
             labelnames=("outcome",))
         self.membership_changes = r.counter(
             "paddlenlp_router_membership_changes_total",
@@ -90,6 +93,36 @@ class RouterMetrics:
             "admission_gate/prefill/chunk_stall/migration_wait/decode on "
             "replicas; hedge_race on the router) — phases sum to e2e",
             labelnames=("phase",))
+
+
+class AutoscalerMetrics:
+    """The ``paddlenlp_router_autoscaler_*`` catalog — one instance per
+    :class:`~.autoscaler.Autoscaler` control loop. Push-mode: the loop stamps
+    every decision; the replica gauges track the last observation."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = r = registry or REGISTRY
+        self.decisions = r.counter(
+            "paddlenlp_router_autoscaler_decisions_total",
+            "Autoscaler control-loop decisions by action "
+            "(up/down/replace/hold)",
+            labelnames=("action",))
+        self.replicas = r.gauge(
+            "paddlenlp_router_autoscaler_replicas",
+            "Live (non-draining) replicas the autoscaler observed on its "
+            "last evaluation")
+        self.target_envelope = r.gauge(
+            "paddlenlp_router_autoscaler_envelope",
+            "Configured min/max replica envelope bounds",
+            labelnames=("bound",))
+        self.provision_failures = r.counter(
+            "paddlenlp_router_autoscaler_provision_failures_total",
+            "Provision attempts that failed (each retries with backoff on a "
+            "later control-loop tick)")
+        self.brownout_pushes = r.counter(
+            "paddlenlp_router_autoscaler_brownout_pushes_total",
+            "Brownout floors pushed to replicas while holding at the max "
+            "envelope under sustained overload")
 
 
 # ----------------------------------------------------------------- federation

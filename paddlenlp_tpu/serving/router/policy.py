@@ -115,15 +115,30 @@ class PrefixAffinityPolicy:
     ``prefix_tokens`` bounds the affinity key: the first N token ids (or, for
     raw string prompts, the first ``4 * N`` characters — roughly the same text
     span) so that requests differing only in their tail still co-locate. The
-    ring is rebuilt only when the replica id set changes."""
+    ring is rebuilt only when the replica id set changes.
+
+    **Weighted spill.** A popular prefix can turn its pinned replica into a
+    hot spot — and an autoscaler that just grew the fleet would watch the new
+    replicas idle while the pin melts. ``spill_load_score`` bounds how hot a
+    pin may run: when the pinned replica's :func:`load_score` exceeds it, the
+    request spills to the next ring candidate whose score is still under the
+    threshold (the *agreed* failover order, so every client of the prefix
+    spills to the SAME replica — the prefix stays co-located on two replicas
+    instead of scattering). When every candidate is equally hot the pin
+    stands: bouncing between uniformly-loaded replicas would only shed the
+    cache benefit. ``None`` disables spilling."""
 
     name = "prefix_affinity"
 
-    def __init__(self, prefix_tokens: int = 16, vnodes: int = 64):
+    def __init__(self, prefix_tokens: int = 16, vnodes: int = 64,
+                 spill_load_score: Optional[float] = 8.0):
         if prefix_tokens < 1:
             raise ValueError("prefix_tokens must be >= 1")
+        if spill_load_score is not None and spill_load_score <= 0:
+            raise ValueError("spill_load_score must be > 0 (None disables)")
         self.prefix_tokens = prefix_tokens
         self.vnodes = vnodes
+        self.spill_load_score = spill_load_score
         self._ring: Optional[HashRing] = None
         self._ring_ids: Optional[Tuple[str, ...]] = None
         self._fallback = LeastLoadedPolicy()
@@ -157,9 +172,25 @@ class PrefixAffinityPolicy:
         eligible = _eligible(snapshots, exclude)
         # the ring walk is the affinity chain; state rank still outranks it so
         # a DEGRADED pinned replica yields to the next healthy ring member
-        return sorted(eligible,
-                      key=lambda s: (_STATE_RANK.get(s.state, 3),
-                                     ring_order.get(s.id, len(ring_order)), s.id))
+        ordered = sorted(eligible,
+                         key=lambda s: (_STATE_RANK.get(s.state, 3),
+                                        ring_order.get(s.id, len(ring_order)), s.id))
+        # weighted spill: a too-hot pin yields to the FIRST ring successor
+        # still under the threshold (same state rank — a spill must not trade
+        # cache warmth for a degraded replica); the successor moves to the
+        # front and the rest of the walk keeps its order, so the failover
+        # chain stays agreed across clients
+        spill = self.spill_load_score
+        if spill is not None and len(ordered) > 1 and load_score(ordered[0]) > spill:
+            pinned_rank = _STATE_RANK.get(ordered[0].state, 3)
+            for i in range(1, len(ordered)):
+                cand = ordered[i]
+                if _STATE_RANK.get(cand.state, 3) != pinned_rank:
+                    break  # never spill onto a worse-state replica
+                if load_score(cand) <= spill:
+                    ordered.insert(0, ordered.pop(i))
+                    break
+        return ordered
 
 
 def resolve_policy(policy) -> object:

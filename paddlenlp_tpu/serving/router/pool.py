@@ -74,7 +74,7 @@ from .metrics import RouterMetrics
 
 __all__ = ["HEALTHY", "DEGRADED", "DOWN", "RECOVERING", "DRAINING", "DRAINED",
            "REMOVED", "Replica", "ReplicaSnapshot", "ProbeResult", "ReplicaPool",
-           "DrainPendingError"]
+           "DrainPendingError", "push_brownout"]
 
 HEALTHY = "healthy"
 DEGRADED = "degraded"
@@ -96,6 +96,33 @@ class DrainPendingError(RuntimeError):
 KV_UTILIZATION_METRIC = "paddlenlp_serving_kv_utilization"
 
 
+def push_brownout(host: str, port: int, level: int,
+                  reason: str = "slo_fast_burn",
+                  ttl_s: Optional[float] = None,
+                  timeout_s: float = 10.0) -> bool:
+    """POST a brownout floor to one replica's ``/admin/brownout`` (best
+    effort: False on any transport/HTTP failure, never raises). The ONE
+    client for this route — the router's SLO fast-burn hook and the
+    autoscaler's max-envelope handoff both go through here."""
+    payload = {"level": int(level), "reason": reason}
+    if ttl_s is not None:
+        payload["ttl_s"] = float(ttl_s)
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            conn.request("POST", "/admin/brownout",
+                         body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+        finally:
+            conn.close()
+        return resp.status == 200
+    except (OSError, http.client.HTTPException, ValueError) as e:
+        logger.debug(f"brownout push to {host}:{port} failed: {e!r}")
+        return False
+
+
 @dataclasses.dataclass
 class ProbeResult:
     """Outcome of one health probe. ``reachable`` separates a live replica
@@ -108,6 +135,7 @@ class ProbeResult:
     queue_depth: int = 0
     kv_utilization: Optional[float] = None
     retry_after_s: Optional[float] = None
+    brownout_level: int = 0  # the replica's overload-brownout ladder level
     error: Optional[str] = None
     # clock-sync piggyback: the replica's tracer-timeline "now" plus the
     # probe's RTT — one offset estimate per probe (NTP-style midpoint)
@@ -132,6 +160,9 @@ class ReplicaSnapshot:
     clock_offset_s: Optional[float] = None  # replica tracer time - router tracer time
     draining: bool = False  # membership: no NEW requests; in-flight finish
     drained: bool = False  # drain complete — safe to remove
+    # the replica's overload-brownout level (0 normal .. 3 clamp): >= 2 means
+    # the replica asked the fleet to stop racing hedge shadows against it
+    brownout_level: int = 0
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -153,6 +184,7 @@ class Replica:
         self.queue_depth = 0
         self.kv_utilization = 0.0
         self.retry_after_s: Optional[float] = None
+        self.brownout_level = 0
         self.consecutive_failures = 0
         self.recovery_streak = 0
         self.last_poll_t: Optional[float] = None
@@ -181,7 +213,7 @@ class Replica:
             kv_utilization=self.kv_utilization, retry_after_s=self.retry_after_s,
             consecutive_failures=self.consecutive_failures, last_poll_t=self.last_poll_t,
             clock_offset_s=self.clock_offset_s, draining=self.draining,
-            drained=self.drained)
+            drained=self.drained, brownout_level=self.brownout_level)
 
 
 class ReplicaPool:
@@ -463,12 +495,14 @@ class ReplicaPool:
         t1 = self.tracer.now()
         sched = body.get("scheduler") or {}
         engine = body.get("engine") or {}
+        brownout = body.get("brownout")
         result = ProbeResult(
             reachable=True,
             status=body.get("status"),
             inflight=int(sched.get("inflight", 0)),
             queue_depth=int(engine.get("queue_depth", 0)),
             retry_after_s=float(retry_after) if retry_after else None,
+            brownout_level=int(brownout) if isinstance(brownout, (int, float)) else 0,
         )
         # clock-offset estimate for trace stitching: the replica stamped its
         # tracer-timeline "now" somewhere inside [t0, t1]; assume the midpoint
@@ -550,6 +584,7 @@ class ReplicaPool:
             if result.reachable:
                 replica.inflight = result.inflight
                 replica.queue_depth = result.queue_depth
+                replica.brownout_level = result.brownout_level
                 if result.kv_utilization is not None:
                     replica.kv_utilization = result.kv_utilization
                 if result.clock_offset_s is not None and result.rtt_s is not None:
@@ -582,7 +617,8 @@ class ReplicaPool:
             self._apply(replica, ProbeResult(reachable=True, status="degraded",
                                              inflight=replica.inflight,
                                              queue_depth=replica.queue_depth,
-                                             retry_after_s=retry_after_s),
+                                             retry_after_s=retry_after_s,
+                                             brownout_level=replica.brownout_level),
                         probed=False)
 
     def clock_offset(self, replica_id: str) -> float:

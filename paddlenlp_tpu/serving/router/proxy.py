@@ -121,6 +121,7 @@ from .pool import (
     ReplicaPool,
     ReplicaSnapshot,
 )
+from .pool import push_brownout as pool_push_brownout
 
 __all__ = ["RouterServer"]
 
@@ -213,6 +214,18 @@ class _Disposition:
             return None
 
 
+def _is_request_level_503(raw) -> bool:
+    """True when a 503 body says the replica rejected THIS request's class
+    (brownout shed / deadline-unmet), not that the replica itself is
+    draining/degraded. Unparseable bodies count as replica-level (the
+    conservative reading)."""
+    try:
+        etype = json.loads(raw or b"").get("error", {}).get("type")
+    except (ValueError, AttributeError):
+        return False
+    return etype in ("overloaded_shed", "deadline_unmet")
+
+
 def _classify_upstream_failure(kind: str, payload) -> _Disposition:
     """THE single upstream-failure → disposition mapper.
 
@@ -242,8 +255,15 @@ def _classify_upstream_failure(kind: str, payload) -> _Disposition:
     if kind == "status":
         status, raw, retry_after = payload
         if status in (429, 503):
-            return _Disposition("reroute", is_degraded=status == 503,
-                                degraded_retry_after=retry_after)
+            # per-REQUEST rejections (brownout shed of this priority class,
+            # deadline-unmet on arrival) come from a healthy replica doing
+            # its job — re-route in case another replica isn't browned out,
+            # but never mark the replica degraded: a fleet-wide brownout
+            # must not flap every healthy replica to DEGRADED
+            return _Disposition(
+                "reroute",
+                is_degraded=status == 503 and not _is_request_level_503(raw),
+                degraded_retry_after=retry_after)
         if status >= 500:
             return _Disposition("failover", replica_fault=True, status=status)
         return _Disposition("relay", status=status, raw=raw or b"")
@@ -294,7 +314,8 @@ class RouterServer:
                  slo_windows_s: Sequence[float] = DEFAULT_WINDOWS_S,
                  scrape_timeout_s: float = 5.0,
                  hedge_after_s: Optional[float] = None,
-                 max_hedges_inflight: int = 4):
+                 max_hedges_inflight: int = 4,
+                 brownout_push_level: Optional[int] = 1):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if trace_sample_every < 1:
@@ -337,6 +358,12 @@ class RouterServer:
         # shadows the router may have open at once fleet-wide
         self.hedge_after_s = hedge_after_s
         self.max_hedges_inflight = max_hedges_inflight
+        # SLO fast burn -> replica brownout push: the same best-effort
+        # propagation channel drains use (None disables). Rate-limited so a
+        # sustained burn costs one push per window, not one per scrape.
+        self.brownout_push_level = brownout_push_level
+        self._brownout_push_lock = threading.Lock()
+        self._last_brownout_push_t = 0.0  # guarded-by: _brownout_push_lock
         self._hedge_lock = threading.Lock()
         self._hedges_inflight = 0  # guarded-by: _hedge_lock
         self._ids = itertools.count()
@@ -875,10 +902,39 @@ class RouterServer:
     def _on_fast_burn(self, kind: str, burn_rate: float, window: str):
         """SLO fast-burn trigger (wired into the tracker at construction): a
         shortest-window burn past the page-now threshold snapshots the fleet
-        state that produced it. The dumper rate-limits, so a sustained burn
-        costs one bundle per window, not one per /fleet/slo scrape."""
+        state that produced it — and pushes a brownout floor to the replicas,
+        so the fleet starts degrading selectively (shed best-effort first)
+        instead of timing out uniformly while the autoscaler catches up. The
+        dumper rate-limits, so a sustained burn costs one bundle per window,
+        not one per /fleet/slo scrape."""
         self.postmortem.dump("slo_fast_burn", detail={
             "kind": kind, "burn_rate": burn_rate, "window": window})
+        if self.brownout_push_level:
+            self.push_brownout(self.brownout_push_level, reason="slo_fast_burn")
+
+    def push_brownout(self, level: int, reason: str = "slo_fast_burn",
+                      min_interval_s: float = 10.0) -> bool:
+        """Push a brownout floor to every live replica (best-effort,
+        off-thread — the same propagation channel drains use). Returns False
+        when suppressed by the rate limit."""
+        now = time.time()
+        with self._brownout_push_lock:
+            if now - self._last_brownout_push_t < min_interval_s:
+                return False
+            self._last_brownout_push_t = now
+        targets = [(s.host, s.port) for s in self.pool.snapshots()
+                   if s.state != DOWN and not s.draining]
+        logger.warning(
+            f"router: pushing brownout level {level} ({reason}) to "
+            f"{len(targets)} replica(s)")
+        for host, port in targets:
+            # pool.push_brownout is the shared /admin/brownout client (the
+            # autoscaler's max-envelope handoff uses the same one)
+            threading.Thread(
+                target=pool_push_brownout, args=(host, port, level),
+                kwargs={"reason": reason}, daemon=True,
+                name=f"brownout-push-{host}:{port}").start()
+        return True
 
     # ------------------------------------------------------------- trace stitch
     def stitched_trace(self, trace_id: str) -> Dict:
@@ -943,10 +999,20 @@ class RouterServer:
             state.attempts += 1
             # hedging applies to token-less attempts (streams that relayed
             # nothing yet; batch requests always, nothing reaches the client
-            # before the whole body) with somewhere to hedge TO
+            # before the whole body) with somewhere to hedge TO. A browned-out
+            # fleet (level >= 2 on either leg) suppresses the race: a hedge is
+            # deliberate extra load, exactly what the brownout ladder is
+            # shedding. Counted once per REQUEST at candidate selection
+            # (whether or not the race would have fired) — unlike "capped",
+            # which counts at hedge-fire time
             hedge_cand = candidates[1] if (
                 self.hedge_after_s is not None
                 and state.tokens_relayed == 0 and len(candidates) > 1) else None
+            if hedge_cand is not None and max(cand.brownout_level,
+                                              hedge_cand.brownout_level) >= 2:
+                hedge_cand = None
+                if state.attempts == 1:
+                    self.metrics.hedges.inc(outcome="brownout")
             state.replica_id = cand.id
             # a fresh attempt must not inherit the previous replica's
             # completion id: replicas mint cmpl-N independently, and a stale

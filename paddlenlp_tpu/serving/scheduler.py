@@ -26,6 +26,7 @@ unguarded on purpose. ``_idle`` is a ``threading.Event`` (self-synchronized).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Optional
@@ -34,20 +35,52 @@ from ..observability.flight_recorder import RECORDER
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
+from .brownout import PRIORITIES, BrownoutController, BrownoutPolicy
 from .engine_loop import EngineLoop, RequestHandle
 
 __all__ = ["Scheduler", "SchedulerConfig", "SaturatedError", "ShuttingDownError",
-           "DegradedError"]
+           "DegradedError", "ShedError", "DeadlineUnmetError"]
 
 _F_SUBMIT = FaultPoint("serving.submit")
+_F_SHED = FaultPoint("sched.shed")
 
 
 class SaturatedError(Exception):
-    """In-flight window full — shed load (HTTP 429)."""
+    """In-flight window full — shed load (HTTP 429 + ``Retry-After`` from the
+    live queue-wait estimate)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 class ShuttingDownError(Exception):
     """Scheduler draining/stopped — not accepting work (HTTP 503)."""
+
+
+class ShedError(Exception):
+    """Brownout priority shed: the replica is overloaded and this request's
+    priority class is below the current ladder level (HTTP 503 +
+    ``Retry-After``)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 priority: str = "best_effort"):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.priority = priority
+
+
+class DeadlineUnmetError(Exception):
+    """Deadline-aware admission rejected on arrival: the live queue-wait
+    estimate already exceeds the request's ``deadline_ms`` budget, so
+    admitting it would only burn a slot on a guaranteed timeout (HTTP 503 +
+    ``Retry-After``)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 estimate_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.estimate_s = estimate_s
 
 
 class DegradedError(Exception):
@@ -72,7 +105,9 @@ class SchedulerConfig:
 class Scheduler:
     """Bounded admission window around an :class:`EngineLoop`."""
 
-    def __init__(self, loop: EngineLoop, config: Optional[SchedulerConfig] = None):
+    def __init__(self, loop: EngineLoop, config: Optional[SchedulerConfig] = None,
+                 brownout: Optional[BrownoutController] = None,
+                 brownout_policy: Optional[BrownoutPolicy] = None):
         self.loop = loop
         self.config = config or SchedulerConfig()
         self._lock = threading.Lock()
@@ -83,55 +118,140 @@ class Scheduler:
         self.rejected_saturated = 0
         self.rejected_draining = 0
         self.rejected_degraded = 0
+        self.rejected_shed = 0
+        self.rejected_deadline = 0
+        # overload-brownout ladder: evaluated on every submission against the
+        # local saturation signal (window occupancy vs the live queue-wait
+        # estimate); the router/autoscaler can push a level floor on top
+        self.brownout = brownout if brownout is not None else BrownoutController(
+            policy=brownout_policy, pressure_fn=self._pressure)
+        if self.brownout.pressure_fn is None:
+            self.brownout.pressure_fn = self._pressure
+
+    def _reject_if_unavailable(self, trace):  # holds-lock: _lock
+        """Caller holds ``_lock``. Raise when this scheduler cannot accept
+        work at all — draining/stopped (``ShuttingDownError``) or engine
+        DEGRADED (``DegradedError`` with a recovery hint: shed load NOW
+        instead of piling work on a dead engine)."""
+        if self._draining or not self.loop.running:
+            self.rejected_draining += 1
+            RECORDER.record("sched.reject", trace=trace, reason="draining")
+            TRACER.instant("admission_rejected", cat="scheduler", reason="draining")
+            raise ShuttingDownError("server is draining; retry against another replica")
+        if self.loop.degraded:
+            self.rejected_degraded += 1
+            retry_after = self.loop.retry_after_hint()
+            RECORDER.record("sched.reject", trace=trace, reason="degraded",
+                            retry_after_s=retry_after)
+            TRACER.instant("admission_rejected", cat="scheduler", reason="degraded",
+                           retry_after_s=retry_after)
+            raise DegradedError(
+                "engine is recovering from a failure; retry shortly",
+                retry_after_s=retry_after)
+
+    def _pressure(self) -> float:
+        """Local saturation signal for the brownout ladder: the worse of
+        admission-window occupancy and the queue-wait estimate relative to the
+        policy's saturation threshold (>= 1.0 means overloaded)."""
+        occupancy = self.inflight / max(self.config.max_inflight, 1)
+        wait = self.loop.queue_wait_estimate()
+        return max(occupancy,
+                   wait / max(self.brownout.policy.saturation_wait_s, 1e-9))
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt_ids, sampling=None, timeout_s: Optional[float] = None,
                max_retries: Optional[int] = None,
-               trace: Optional[str] = None) -> RequestHandle:
+               trace: Optional[str] = None,
+               priority: str = "interactive",
+               deadline_s: Optional[float] = None) -> RequestHandle:
         """Admit one request or raise (SaturatedError / ShuttingDownError /
-        DegradedError). ``max_retries`` is the per-request engine-rebuild
-        requeue budget (None = supervisor policy default); ``trace`` adopts an
-        inbound cross-tier trace id (None = the loop mints ``req-N``)."""
+        DegradedError / ShedError / DeadlineUnmetError). ``max_retries`` is
+        the per-request engine-rebuild requeue budget (None = supervisor
+        policy default); ``trace`` adopts an inbound cross-tier trace id
+        (None = the loop mints ``req-N``). ``priority`` selects the brownout
+        shed class and the engine's admission order; ``deadline_s`` is the
+        request's total latency budget — rejected on arrival when the live
+        queue-wait estimate already exceeds it, and enforced as the engine
+        deadline otherwise."""
         cfg = self.config
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, got {priority!r}")
         if cfg.max_prompt_tokens is not None and len(prompt_ids) > cfg.max_prompt_tokens:
             raise ValueError(
                 f"prompt of {len(prompt_ids)} tokens exceeds max_prompt_tokens={cfg.max_prompt_tokens}")
+        # availability checks come FIRST: a draining/degraded replica must
+        # report draining/degraded (the signal the router's failure
+        # classification keys on), not a brownout shed — and drain-induced
+        # occupancy must never walk the brownout ladder
         with self._lock:
-            if self._draining or not self.loop.running:
-                self.rejected_draining += 1
-                RECORDER.record("sched.reject", trace=trace, reason="draining")
-                TRACER.instant("admission_rejected", cat="scheduler", reason="draining")
-                raise ShuttingDownError("server is draining; retry against another replica")
-            if self.loop.degraded:
-                # circuit breaker: the engine is being rebuilt — shed load NOW
-                # with a recovery hint instead of piling work on a dead engine
-                self.rejected_degraded += 1
-                retry_after = self.loop.retry_after_hint()
-                RECORDER.record("sched.reject", trace=trace, reason="degraded",
-                                retry_after_s=retry_after)
-                TRACER.instant("admission_rejected", cat="scheduler", reason="degraded",
-                               retry_after_s=retry_after)
-                raise DegradedError(
-                    "engine is recovering from a failure; retry shortly",
-                    retry_after_s=retry_after)
+            self._reject_if_unavailable(trace)
+        # overload controls run before the admission window: they shed work
+        # the window would only queue toward a guaranteed-bad outcome
+        level = self.brownout.evaluate()
+        if self.brownout.should_shed(priority):
+            self.rejected_shed += 1
+            _F_SHED.fire(priority=priority)
+            self.loop.metrics.shed.inc(reason="shed")
+            retry_after = self.loop.queue_wait_estimate()
+            RECORDER.record("sched.reject", trace=trace, reason="shed",
+                            level=level)
+            TRACER.instant("admission_rejected", cat="scheduler", reason="shed",
+                           level=level)
+            raise ShedError(
+                f"replica browned out (level {level}); {priority} traffic is "
+                "being shed — retry later or elsewhere",
+                retry_after_s=retry_after, priority=priority)
+        if deadline_s is not None:
+            estimate = self.loop.queue_wait_estimate()
+            if estimate > deadline_s:
+                self.rejected_deadline += 1
+                self.loop.metrics.shed.inc(reason="deadline")
+                RECORDER.record("sched.reject", trace=trace, reason="deadline",
+                                estimate_s=round(estimate, 4))
+                TRACER.instant("admission_rejected", cat="scheduler",
+                               reason="deadline", estimate_s=estimate)
+                raise DeadlineUnmetError(
+                    f"queue-wait estimate {estimate:.3f}s already exceeds the "
+                    f"{deadline_s:.3f}s deadline; rejecting on arrival",
+                    retry_after_s=estimate, estimate_s=estimate)
+        cap = self.brownout.max_tokens_cap()
+        if cap is not None and sampling is not None \
+                and getattr(sampling, "max_new_tokens", 0) > cap:
+            # level-3 clamp: shorter completions for everyone beats timeouts
+            # for everyone — documented in the brownout ladder
+            sampling = dataclasses.replace(sampling, max_new_tokens=cap)
+        with self._lock:
+            # re-checked: a drain/degrade may have started while the overload
+            # controls ran outside the lock
+            self._reject_if_unavailable(trace)
             if self._inflight >= cfg.max_inflight:
                 self.rejected_saturated += 1
+                # Retry-After tracks the live backlog, not a constant: a
+                # deep queue quotes a longer backoff than a momentary blip
+                retry_after = self.loop.queue_wait_estimate()
                 RECORDER.record("sched.reject", trace=trace, reason="saturated",
                                 inflight=self._inflight)
                 TRACER.instant("admission_rejected", cat="scheduler", reason="saturated",
                                inflight=self._inflight)
                 raise SaturatedError(
-                    f"in-flight window full ({self._inflight}/{cfg.max_inflight}); retry later")
+                    f"in-flight window full ({self._inflight}/{cfg.max_inflight}); retry later",
+                    retry_after_s=retry_after)
             self._inflight += 1
             self._idle.clear()
         deadline = timeout_s if timeout_s is not None else cfg.default_timeout_s
+        if deadline_s is not None:
+            # the deadline is a TOTAL latency budget: it also bounds the
+            # engine-side abort deadline so an admitted-then-stuck request
+            # frees its slot at the deadline, not at the generic timeout
+            deadline = deadline_s if deadline is None else min(deadline, deadline_s)
         try:
             _F_SUBMIT.fire(prompt_len=len(prompt_ids))
             # recorded retrospectively so Span.trace carries the request's id
             # (assigned by submit) and trace-filtered timelines include admission
             t0 = time.perf_counter()
             handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline,
-                                      max_retries=max_retries, trace=trace)
+                                      max_retries=max_retries, trace=trace,
+                                      priority=priority)
             TRACER.add_span("admission", TRACER.epoch_time(t0),
                             time.perf_counter() - t0, cat="scheduler",
                             trace=handle.trace, prompt_len=len(prompt_ids))
@@ -175,6 +295,12 @@ class Scheduler:
             "rejected_saturated": self.rejected_saturated,
             "rejected_draining": self.rejected_draining,
             "rejected_degraded": self.rejected_degraded,
+            "rejected_shed": self.rejected_shed,
+            "rejected_deadline": self.rejected_deadline,
+            # the overload ladder, surfaced on /health so the router's pool
+            # snapshots (and operators) see a replica shedding before it 503s
+            "brownout": self.brownout.stats(),
+            "queue_wait_estimate_s": round(self.loop.queue_wait_estimate(), 4),
         }
 
     def start_drain(self):

@@ -93,6 +93,15 @@ CATALOG: Dict[str, str] = {
                          "remove), before any state changes — a failure here must "
                          "leave the replica set exactly as it was (the admin plane "
                          "returns 5xx, the pool stays consistent, traffic unaffected).",
+    "router.provision": "Top of one autoscaler provision attempt, before the "
+                        "ReplicaProvisioner starts a new replica — a failure here must "
+                        "retry with backoff on later control-loop ticks, never strand a "
+                        "tombstoned (force-removed DOWN) replica unreplaced, and never "
+                        "leave a half-joined replica in the pool.",
+    "sched.shed": "Inside the scheduler's brownout shed path, after the shed decision "
+                  "but before the rejection is raised — an injected failure here must "
+                  "surface as a clean 500 with no admission-window slot taken and no "
+                  "engine-side state.",
     "engine.slot_rebuild": "Inside the supervisor's slot-level quarantine of one "
                            "poisoned request, before its KV blocks are released — a "
                            "failure here escalates to the full engine rebuild path "

@@ -1,0 +1,327 @@
+"""Closed-loop fleet chaos (ISSUE 14 acceptance): the full reflex arc against
+REAL in-process replicas (tiny CPU model — tier-1 speed).
+
+- **Kill → replace**: a replica takes an ``engine.step`` fault (its stream
+  recovers token-exact through the supervisor), then its whole HTTP plane
+  dies mid-run — the crashed-process case the supervisor cannot absorb. The
+  health poller demotes it to DOWN, and the running autoscaler force-removes
+  the tombstone and provisions + joins a replacement, while concurrent
+  streams on the survivor finish token-exact (zero stream loss, zero client
+  5xx) and the fleet's availability burn stays bounded.
+- **Max-envelope hold → brownout handoff**: an autoscaler pinned at its max
+  envelope under overload cannot scale; it must record ``scale.hold
+  {max_envelope}`` and push a brownout floor to the replicas, after which
+  best-effort traffic sheds with a clean 503 + Retry-After while interactive
+  requests keep completing — the fleet degrades selectively instead of
+  timing out uniformly.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, SupervisorPolicy
+from paddlenlp_tpu.serving.router import PrefixAffinityPolicy, launch_fleet
+from paddlenlp_tpu.serving.router.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+    InProcessProvisioner,
+)
+from paddlenlp_tpu.serving.router.pool import DOWN
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine_factory(model):
+    def make_engine():
+        return InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
+                               max_blocks_per_seq=32, decode_steps=4)
+    return make_engine
+
+
+def post_json(port, path, payload, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def stream_request(port, prompt, max_tokens, out, key, timeout=600, **extra):
+    """Collect one SSE stream into ``out[key]`` = (status, tokens, finish)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions",
+                     body=json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                                      "stream": True, **extra}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        toks, finish = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            c = ev["choices"][0]
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+            elif "token" in c:
+                toks.append(c["token"])
+        out[key] = (resp.status, toks, finish)
+    finally:
+        conn.close()
+
+
+def prefix_pinned_to(router, replica_id, avoid=()):
+    """A 3-token prefix the affinity ring pins to ``replica_id``."""
+    for k in range(8, 200):
+        prefix = [k, k + 1, 7]
+        if tuple(prefix) in avoid:
+            continue
+        pin = router.policy.select(router.pool.snapshots(), prompt=prefix)[0].id
+        if pin == replica_id:
+            return prefix
+    raise AssertionError(f"no prefix pins to {replica_id}")
+
+
+GEN_LEN = 16
+
+
+class TestKillAndReplace:
+    def test_dead_replica_replaced_with_zero_stream_loss(self, model):
+        factory = make_engine_factory(model)
+        fleet = launch_fleet(
+            2, factory, policy=PrefixAffinityPolicy(prefix_tokens=3),
+            poll_interval_s=0.05,
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0),
+            supervisor_policy=SupervisorPolicy(backoff_base_s=0.1, backoff_max_s=0.5))
+        router, port = fleet.router, fleet.router_port
+        provisioner = InProcessProvisioner(
+            factory, replica_kw=dict(
+                scheduler_config=SchedulerConfig(max_inflight=16,
+                                                 default_timeout_s=600.0)))
+        # min == max pins the envelope at 2: the ONLY thing this loop may do
+        # is replace the dead replica (up/down thresholds set unreachable so
+        # CPU-speed TTFT noise cannot trigger a surprise scale action)
+        scaler = Autoscaler(
+            ("127.0.0.1", port), provisioner,
+            policy=AutoscalerPolicy(
+                min_replicas=2, max_replicas=2,
+                scale_up_kv_utilization=2.0, scale_up_queue_depth=1e9,
+                scale_up_burn_rate=1e18, brownout_push_level=0,
+                provision_backoff_base_s=0.1),
+            registry=MetricsRegistry(), interval_s=0.1)
+        try:
+            victim = fleet.replica_id(0)
+            survivor = fleet.replica_id(1)
+            victim_server, survivor_server = fleet.servers[0], fleet.servers[1]
+            victim_prefix = prefix_pinned_to(router, victim)
+            survivor_prefix = prefix_pinned_to(router, survivor,
+                                               avoid=(tuple(victim_prefix),))
+
+            # ---- the incident starts as an engine fault on the victim: its
+            # in-flight stream rides the supervisor rebuild token-exact (the
+            # recovery ladder below a process death)
+            FAULTS.arm("engine.step", nth=1)
+            results = {}
+            stream_request(port, victim_prefix + [40], GEN_LEN, results, "victim")
+            assert FAULTS.fired("engine.step") == 1
+            solo_engine = factory()
+            status, toks, finish = results["victim"]
+            assert status == 200 and finish == "length"
+            np.testing.assert_array_equal(
+                toks, solo_engine.generate([victim_prefix + [40]],
+                                           SamplingParams(max_new_tokens=GEN_LEN))[0])
+
+            scaler.start()
+            # the control loop observes a healthy fleet first: no actions
+            time.sleep(0.3)
+            assert not [e for e in scaler.events if e[1] != "hold"]
+
+            # ---- concurrent streams on the survivor, in flight through the
+            # kill + replacement window
+            threads = [threading.Thread(
+                target=stream_request,
+                args=(port, survivor_prefix + [50 + i], GEN_LEN, results, i))
+                for i in range(3)]
+            for t in threads:
+                t.start()
+            deadline = time.time() + 120
+            while time.time() < deadline and router._open_forwards_on(survivor) < 3:
+                time.sleep(0.005)
+            assert router._open_forwards_on(survivor) == 3
+
+            # ---- now the victim's whole HTTP plane dies (crashed process:
+            # the supervisor can't absorb this one) -> poller demotes to DOWN
+            victim_host_port = f"127.0.0.1:{fleet.ports[0]}"
+            victim_server._httpd.shutdown()
+            victim_server._httpd.server_close()  # refuse, don't hang, probes
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                rows = {s.id: s.state for s in router.pool.snapshots()}
+                if rows.get(victim) == DOWN or victim not in rows:
+                    break
+                time.sleep(0.02)
+
+            # ---- the autoscaler force-removes the tombstone and provisions
+            # + joins a replacement: fleet back at 2 live replicas
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                ids = {s.id for s in router.pool.snapshots()}
+                if (victim not in ids and len(ids) == 2
+                        and any(a == "provisioned" for _t, a, _d in scaler.events)):
+                    break
+                time.sleep(0.05)
+            ids = {s.id for s in router.pool.snapshots()}
+            assert victim not in ids and len(ids) == 2, ids
+            assert any(r["id"] == victim_host_port
+                       for r in router.pool.removed())
+            acted = [a for _t, a, _d in scaler.events]
+            assert "replace" in acted and "provisioned" in acted, scaler.events
+            assert scaler.metrics.decisions.value(action="replace") == 1.0
+            replacement = next(iter(ids - {survivor}))
+            assert (replacement.split(":")[0], int(replacement.split(":")[1])) \
+                in provisioner.servers
+
+            # ---- zero stream loss: every survivor stream finished 200 and
+            # token-exact (no 5xx, no replica_error, no truncation)
+            for t in threads:
+                t.join(timeout=600)
+            assert not any(t.is_alive() for t in threads)
+            for i in range(3):
+                status, toks, finish = results[i]
+                assert status == 200 and finish == "length", (i, results[i])
+                np.testing.assert_array_equal(
+                    toks, solo_engine.generate(
+                        [survivor_prefix + [50 + i]],
+                        SamplingParams(max_new_tokens=GEN_LEN))[0])
+
+            # ---- the replacement actually serves: the victim's old prefix
+            # re-pins somewhere live and completes
+            status, _h, body = post_json(port, "/v1/completions",
+                                         {"prompt": victim_prefix + [41],
+                                          "max_tokens": 4})
+            assert status == 200 and len(body["choices"][0]["token_ids"]) == 4
+
+            # ---- bounded SLO burn: the incident produced no client-visible
+            # errors, so the shortest-window availability burn stays below
+            # the page-now threshold
+            status, slo = get_json(port, "/fleet/slo")
+            assert status == 200
+            shortest = slo["windows"][min(slo["windows"],
+                                          key=lambda w: int(w.rstrip("s")))]
+            assert shortest["availability_burn_rate"] < 10.0, slo
+
+            # ---- no KV block leaked on the replicas that served
+            for server in (survivor_server, *provisioner.servers.values()):
+                mgr = server.loop.engine.mgr
+                assert mgr.num_free == mgr.total_usable_blocks
+        finally:
+            scaler.stop()
+            fleet.shutdown(drain_timeout_s=5)
+            provisioner.close()
+
+
+class TestMaxEnvelopeBrownoutHandoff:
+    def test_hold_pushes_brownout_and_sheds_best_effort_only(self, model):
+        factory = make_engine_factory(model)
+        fleet = launch_fleet(
+            1, factory, poll_interval_s=0.05,
+            scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0))
+        router, port = fleet.router, fleet.router_port
+        provisioner = InProcessProvisioner(factory)
+        # queue threshold 0.0 makes every observation "overloaded"; the
+        # envelope is pinned at 1, so the only legal reflex is the hold +
+        # brownout handoff
+        scaler = Autoscaler(
+            ("127.0.0.1", port), provisioner,
+            policy=AutoscalerPolicy(
+                min_replicas=1, max_replicas=1, hysteresis_up=1,
+                scale_up_queue_depth=0.0, brownout_push_level=1,
+                brownout_push_ttl_s=60.0),
+            registry=MetricsRegistry())
+        try:
+            summary = scaler.evaluate_once()
+            assert summary["overloaded"] is True
+            assert ("hold", {"reason": "max_envelope"}) in summary["actions"]
+            pushed = [a for a in summary["actions"] if a[0] == "brownout_push"]
+            assert pushed and pushed[0][1]["replicas"] == 1
+            assert scaler.metrics.brownout_pushes.value() == 1.0
+            assert len(router.pool) == 1  # hold means HOLD: no scale action
+
+            # the replica is now floored at level 1: best-effort sheds with a
+            # clean 503 + Retry-After ...
+            status, headers, doc = post_json(
+                port, "/v1/completions",
+                {"prompt": [5, 6, 7], "max_tokens": 4, "priority": "best_effort"})
+            assert status == 503, doc
+            assert doc["error"]["type"] in ("overloaded_shed", "no_replica_available")
+            assert int(headers.get("Retry-After", "1")) >= 1
+            # ... while interactive traffic keeps completing
+            status, _h, doc = post_json(
+                port, "/v1/completions",
+                {"prompt": [5, 6, 7], "max_tokens": 4, "priority": "interactive"})
+            assert status == 200, doc
+            assert len(doc["choices"][0]["token_ids"]) == 4
+            # the replica advertises its level through the health poller
+            replica_server = fleet.servers[0]
+            assert replica_server.scheduler.brownout.level >= 1
+            assert replica_server.scheduler.rejected_shed >= 1
+
+            # pushes refresh per tick while the condition persists
+            scaler.evaluate_once()
+            assert scaler.metrics.brownout_pushes.value() == 2.0
+
+            # ---- condition clears: the floor lifts (level-0 push), traffic
+            # classes equalize again
+            ok = scaler.admin.push_brownout("127.0.0.1", fleet.ports[0], 0,
+                                            reason="slo_fast_burn")
+            assert ok
+            assert replica_server.scheduler.brownout.level == 0
+            status, _h, doc = post_json(
+                port, "/v1/completions",
+                {"prompt": [5, 6, 7], "max_tokens": 4, "priority": "best_effort"})
+            assert status == 200, doc
+        finally:
+            fleet.shutdown(drain_timeout_s=5)
+            provisioner.close()

@@ -1,0 +1,321 @@
+"""Autoscaler policy-loop unit tests: decisions driven by synthetic
+observations against a stub admin plane / provisioner — no engines, no HTTP.
+
+Covers the damping contracts the chaos test can't isolate: hysteresis (an
+oscillating signal never flaps the fleet), cooldown spacing, the
+max-envelope hold + brownout handoff, min-envelope repair, DOWN replacement,
+and provision-failure retry with backoff (the tombstoned-replica guarantee).
+"""
+
+import pytest
+
+from paddlenlp_tpu.serving import MetricsRegistry
+from paddlenlp_tpu.serving.router.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+    FleetObservation,
+    ProvisionedReplica,
+    ReplicaObservation,
+    ReplicaProvisioner,
+)
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+class StubAdmin:
+    """Records admin-plane calls; drains complete instantly."""
+
+    def __init__(self):
+        self.added = []
+        self.drained = []
+        self.removed = []
+        self.brownout_pushes = []
+        self.fail_add = False
+
+    def list_replicas(self):
+        return {"replicas": []}
+
+    def slo(self):
+        return {"windows": {}}
+
+    def add_replica(self, host, port):
+        if self.fail_add:
+            raise RuntimeError("join refused")
+        self.added.append((host, port))
+        return {"replica": {"id": f"{host}:{port}"}}
+
+    def drain_replica(self, replica_id, deadline_s):
+        self.drained.append(replica_id)
+        return {"drain": {"id": replica_id}}
+
+    def remove_replica(self, replica_id, force=False):
+        self.removed.append((replica_id, force))
+        return {"replica": {"id": replica_id}}
+
+    def push_brownout(self, host, port, level, reason="slo_fast_burn", ttl_s=None):
+        self.brownout_pushes.append((host, port, level))
+        return True
+
+
+class StubProvisioner(ReplicaProvisioner):
+    def __init__(self):
+        self.provisioned = []
+        self.deprovisioned = []
+        self.fail_next = 0
+
+    def provision(self):
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("provider quota")
+        port = 9000 + len(self.provisioned)
+        self.provisioned.append(port)
+        return ProvisionedReplica("127.0.0.1", port)
+
+    def deprovision(self, host, port):
+        self.deprovisioned.append((host, port))
+
+
+def replica(rid, state="healthy", kv=0.1, queue=0.0, draining=False,
+            drained=False):
+    return ReplicaObservation(id=rid, state=state, draining=draining,
+                              drained=drained,
+                              kv_utilization=kv, queue_depth=queue,
+                              host="127.0.0.1", port=int(rid.split(":")[-1]))
+
+
+def fleet_obs(n=2, kv=0.1, queue=0.0, burn=0.0, down_ids=()):
+    reps = [replica(f"127.0.0.1:{8000 + i}",
+                    state="down" if f"127.0.0.1:{8000 + i}" in down_ids else "healthy",
+                    kv=kv, queue=queue) for i in range(n)]
+    return FleetObservation(replicas=reps, availability_burn=burn, ttft_burn=0.0)
+
+
+def make_scaler(policy=None, admin=None, prov=None):
+    admin = admin or StubAdmin()
+    prov = prov or StubProvisioner()
+    scaler = Autoscaler(admin, prov, policy=policy or AutoscalerPolicy(
+        min_replicas=1, max_replicas=4, hysteresis_up=2, hysteresis_down=3,
+        cooldown_up_s=10.0, cooldown_down_s=20.0, max_step_up=1,
+        scale_up_queue_depth=4.0, scale_down_queue_depth=0.5),
+        registry=MetricsRegistry())
+    return scaler, admin, prov
+
+
+def actions_of(summary, kind):
+    return [d for a, d in summary["actions"] if a == kind]
+
+
+class TestScaleUp:
+    def test_sustained_overload_scales_up_after_hysteresis(self):
+        scaler, admin, prov = make_scaler()
+        hot = fleet_obs(n=2, queue=8.0)
+        s1 = scaler.evaluate_once(now=100.0, observation=hot)
+        assert not actions_of(s1, "up")  # streak 1 < hysteresis 2
+        assert actions_of(s1, "hold") == [{"reason": "hysteresis"}]
+        s2 = scaler.evaluate_once(now=101.0, observation=hot)
+        assert actions_of(s2, "up") == [{"added": 1, "target": 3}]
+        assert admin.added == [("127.0.0.1", 9000)]
+        assert prov.provisioned == [9000]
+
+    def test_oscillating_signal_never_scales(self):
+        """Hysteresis: a signal flapping hot/cold on alternate ticks resets
+        the streak — the fleet never moves, in either direction."""
+        scaler, admin, prov = make_scaler()
+        hot = fleet_obs(n=2, queue=8.0)
+        cold = fleet_obs(n=2, queue=0.0)
+        for i in range(12):
+            scaler.evaluate_once(now=100.0 + i,
+                                 observation=hot if i % 2 == 0 else cold)
+        assert admin.added == []
+        assert admin.drained == []
+        assert prov.provisioned == []
+
+    def test_cooldown_spaces_scale_ups(self):
+        scaler, admin, _ = make_scaler()
+        hot = fleet_obs(n=2, queue=8.0)
+        scaler.evaluate_once(now=100.0, observation=hot)
+        s = scaler.evaluate_once(now=101.0, observation=hot)
+        assert actions_of(s, "up")
+        # still overloaded: next qualifying streak lands inside the cooldown
+        obs3 = fleet_obs(n=3, queue=8.0)
+        scaler.evaluate_once(now=102.0, observation=obs3)
+        s4 = scaler.evaluate_once(now=103.0, observation=obs3)
+        assert not actions_of(s4, "up")
+        assert {"reason": "cooldown"} in actions_of(s4, "hold")
+        # past the cooldown the same pressure scales again
+        s5 = scaler.evaluate_once(now=112.0, observation=obs3)
+        assert actions_of(s5, "up")
+
+    def test_burn_rate_alone_triggers_scale_up(self):
+        scaler, admin, _ = make_scaler()
+        burning = fleet_obs(n=2, queue=0.0, burn=25.0)
+        scaler.evaluate_once(now=100.0, observation=burning)
+        s = scaler.evaluate_once(now=101.0, observation=burning)
+        assert actions_of(s, "up")
+
+    def test_max_envelope_hold_hands_off_to_brownout(self):
+        policy = AutoscalerPolicy(min_replicas=1, max_replicas=2,
+                                  hysteresis_up=1, brownout_push_level=1)
+        scaler, admin, _ = make_scaler(policy=policy)
+        pinned = fleet_obs(n=2, queue=9.0)
+        s = scaler.evaluate_once(now=100.0, observation=pinned)
+        assert not actions_of(s, "up")
+        assert {"reason": "max_envelope"} in actions_of(s, "hold")
+        # brownout handoff: every live replica got the floor
+        assert len(admin.brownout_pushes) == 2
+        assert all(level == 1 for _h, _p, level in admin.brownout_pushes)
+        assert actions_of(s, "brownout_push")
+        # the hold event dedupes per episode, the push refreshes per tick
+        s2 = scaler.evaluate_once(now=101.0, observation=pinned)
+        assert len(admin.brownout_pushes) == 4
+        holds = [e for _t, a, _d in scaler.events if a == "hold"
+                 for e in [_d] if e.get("reason") == "max_envelope"]
+        assert len(holds) == 1
+
+
+class TestScaleDown:
+    def test_sustained_calm_scales_down_least_loaded(self):
+        scaler, admin, prov = make_scaler()
+        reps = [replica("127.0.0.1:8000", kv=0.2, queue=0.0),
+                replica("127.0.0.1:8001", kv=0.05, queue=0.0)]
+        calm = FleetObservation(replicas=reps)
+        for i in range(2):
+            s = scaler.evaluate_once(now=100.0 + i, observation=calm)
+            assert not actions_of(s, "down")
+        s = scaler.evaluate_once(now=102.0, observation=calm)
+        assert actions_of(s, "down") == [{"removed": 1, "target": 1}]
+        assert admin.drained == ["127.0.0.1:8001"]  # least loaded drains
+        # the drain is finalized on a LATER tick — this one never blocks
+        assert admin.removed == []
+        done = FleetObservation(replicas=[
+            reps[0], replica("127.0.0.1:8001", kv=0.05, queue=0.0,
+                             draining=True, drained=True)])
+        s = scaler.evaluate_once(now=102.5, observation=done)
+        assert admin.removed == [("127.0.0.1:8001", False)]
+        assert prov.deprovisioned == [("127.0.0.1", 8001)]
+        assert actions_of(s, "drained") == [
+            {"replica": "127.0.0.1:8001", "forced": False}]
+
+    def test_stuck_drain_force_removed_at_deadline(self):
+        scaler, admin, prov = make_scaler()
+        reps = [replica("127.0.0.1:8000", kv=0.2, queue=0.0),
+                replica("127.0.0.1:8001", kv=0.05, queue=0.0)]
+        calm = FleetObservation(replicas=reps)
+        for i in range(3):
+            scaler.evaluate_once(now=100.0 + i, observation=calm)
+        assert admin.drained == ["127.0.0.1:8001"]
+        # the victim keeps reporting not-drained (a wedged stream): pending
+        # until the drain deadline, then force-removed — never stranded
+        stuck = FleetObservation(replicas=[
+            reps[0], replica("127.0.0.1:8001", kv=0.05, queue=0.0,
+                             draining=True)])
+        s = scaler.evaluate_once(now=110.0, observation=stuck)
+        assert not actions_of(s, "drained")
+        deadline = 102.0 + scaler.policy.drain_deadline_s + 10.0
+        s = scaler.evaluate_once(now=deadline + 1.0, observation=stuck)
+        assert admin.removed == [("127.0.0.1:8001", True)]
+        assert actions_of(s, "drained") == [
+            {"replica": "127.0.0.1:8001", "forced": True}]
+        assert prov.deprovisioned == [("127.0.0.1", 8001)]
+
+    def test_never_below_min_envelope(self):
+        scaler, admin, _ = make_scaler()
+        calm = fleet_obs(n=1, queue=0.0)
+        for i in range(6):
+            scaler.evaluate_once(now=100.0 + i, observation=calm)
+        assert admin.drained == []
+        assert any(a == "hold" and d.get("reason") == "min_envelope"
+                   for _t, a, d in scaler.events)
+
+
+class TestReplaceAndRepair:
+    def test_down_replica_replaced_without_hysteresis(self):
+        scaler, admin, prov = make_scaler()
+        obs = fleet_obs(n=2, down_ids=("127.0.0.1:8001",))
+        s = scaler.evaluate_once(now=100.0, observation=obs)
+        assert actions_of(s, "replace") == [{"replica": "127.0.0.1:8001"}]
+        assert ("127.0.0.1:8001", True) in admin.removed  # forced
+        # the replacement provisioned on the same tick
+        assert prov.provisioned == [9000]
+        assert admin.added == [("127.0.0.1", 9000)]
+        assert s["deficit"] == 0
+
+    def test_failed_provision_retries_with_backoff(self):
+        """The tombstoned-replica guarantee: a DOWN replica whose replacement
+        provision fails stays OWED — retried after backoff, never forgotten."""
+        scaler, admin, prov = make_scaler()
+        prov.fail_next = 2
+        obs = fleet_obs(n=2, down_ids=("127.0.0.1:8001",))
+        s = scaler.evaluate_once(now=100.0, observation=obs)
+        assert s["deficit"] == 1  # provision failed, debt recorded
+        assert scaler.metrics.provision_failures.value() == 1.0
+        # inside the backoff window: held, not retried
+        healthy = fleet_obs(n=1)
+        s2 = scaler.evaluate_once(now=100.1, observation=healthy)
+        assert s2["deficit"] == 1
+        assert {"reason": "provision_backoff"} in actions_of(s2, "hold")
+        # past the backoff: retried (fails once more, backoff doubles)
+        s3 = scaler.evaluate_once(now=101.0, observation=healthy)
+        assert s3["deficit"] == 1
+        # and eventually succeeds
+        s4 = scaler.evaluate_once(now=103.0, observation=healthy)
+        assert s4["deficit"] == 0
+        assert prov.provisioned == [9000]
+        assert admin.added == [("127.0.0.1", 9000)]
+
+    def test_failed_join_tears_down_orphan(self):
+        scaler, admin, prov = make_scaler()
+        admin.fail_add = True
+        obs = fleet_obs(n=1, down_ids=("127.0.0.1:8000",))
+        s = scaler.evaluate_once(now=100.0, observation=obs)
+        assert s["deficit"] >= 1
+        # the provisioned-but-unjoined replica was torn back down
+        assert prov.deprovisioned[-1] == ("127.0.0.1", 9000)
+
+    def test_injected_provision_fault_is_retried(self):
+        """router.provision fault point: an injected failure behaves exactly
+        like a provider error — backoff + retry, no strand."""
+        FAULTS.arm("router.provision", times=1)
+        scaler, admin, prov = make_scaler()
+        obs = fleet_obs(n=2, down_ids=("127.0.0.1:8001",))
+        s = scaler.evaluate_once(now=100.0, observation=obs)
+        assert s["deficit"] == 1
+        assert prov.provisioned == []  # fault fired BEFORE the provider call
+        s2 = scaler.evaluate_once(now=102.0, observation=fleet_obs(n=1))
+        assert s2["deficit"] == 0
+        assert prov.provisioned == [9000]
+
+
+class TestObservationParsing:
+    def test_observe_folds_admin_planes(self):
+        class Admin(StubAdmin):
+            def list_replicas(self):
+                return {"replicas": [
+                    {"id": "a", "state": "healthy", "draining": False,
+                     "kv_utilization": 0.5, "queue_depth": 3,
+                     "host": "127.0.0.1", "port": 8000},
+                    {"id": "b", "state": "down", "draining": True,
+                     "kv_utilization": None, "queue_depth": 0,
+                     "host": "127.0.0.1", "port": 8001},
+                ]}
+
+            def slo(self):
+                return {"windows": {
+                    "60s": {"availability_burn_rate": 2.5, "ttft_burn_rate": 7.0},
+                    "300s": {"availability_burn_rate": 99.0, "ttft_burn_rate": 99.0},
+                }}
+
+        scaler, _admin, _prov = make_scaler(admin=Admin())
+        obs = scaler.observe()
+        assert [r.id for r in obs.replicas] == ["a", "b"]
+        assert obs.replicas[1].draining is True
+        assert obs.replicas[1].kv_utilization == 0.0  # None -> 0.0
+        # the SHORTEST window's burns are the fast signal
+        assert obs.availability_burn == 2.5
+        assert obs.ttft_burn == 7.0

@@ -1,0 +1,399 @@
+"""Brownout ladder + overload-admission unit tests.
+
+Controller-level: ladder escalation/de-escalation with hysteresis, the push
+floor + TTL, and per-level decision surface (shed / spec-disable / clamp).
+Scheduler-level (real tiny engine behind a real EngineLoop): priority-shed
+ordering, deadline-aware reject-on-arrival, the queue-wait-driven Retry-After
+hint tracking queue depth, and the /admin/brownout + /health HTTP contract.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine
+from paddlenlp_tpu.serving import (
+    BrownoutController,
+    BrownoutPolicy,
+    MetricsRegistry,
+    Scheduler,
+    SchedulerConfig,
+    ServingServer,
+)
+from paddlenlp_tpu.serving.scheduler import (
+    DeadlineUnmetError,
+    SaturatedError,
+    ShedError,
+    ShuttingDownError,
+)
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ---------------------------------------------------------------- controller
+def make_controller(pressure, **policy_kw):
+    state = {"p": pressure}
+    policy = BrownoutPolicy(**{**dict(step_hold_s=1.0, exit_hold_s=2.0), **policy_kw})
+    ctl = BrownoutController(policy=policy, pressure_fn=lambda: state["p"])
+    return ctl, state
+
+
+class TestControllerLadder:
+    def test_escalates_one_level_per_hold_window(self):
+        ctl, state = make_controller(2.0)
+        assert ctl.evaluate(now=100.0) == 1
+        # inside step_hold_s: no second escalation yet
+        assert ctl.evaluate(now=100.5) == 1
+        assert ctl.evaluate(now=101.1) == 2
+        assert ctl.evaluate(now=102.2) == 3
+        # max_level clamps
+        assert ctl.evaluate(now=103.3) == 3
+
+    def test_exit_needs_sustained_calm_per_level(self):
+        ctl, state = make_controller(2.0)
+        ctl.evaluate(now=100.0)
+        ctl.evaluate(now=101.1)
+        assert ctl.level == 2
+        state["p"] = 0.1
+        assert ctl.evaluate(now=102.0) == 2  # calm clock starts
+        assert ctl.evaluate(now=103.0) == 2  # 1s < exit_hold 2s
+        assert ctl.evaluate(now=104.1) == 1  # one step down
+        assert ctl.evaluate(now=105.0) == 1  # clock restarted per level
+        assert ctl.evaluate(now=106.2) == 0
+
+    def test_flapping_pressure_never_exits(self):
+        """Exit hysteresis: pressure bouncing into the band resets the calm
+        clock — the ladder holds instead of oscillating."""
+        ctl, state = make_controller(2.0)
+        ctl.evaluate(now=100.0)
+        assert ctl.level == 1
+        for i in range(10):
+            state["p"] = 0.1 if i % 2 == 0 else 0.8  # calm / inside band
+            ctl.evaluate(now=101.0 + i)
+        assert ctl.level == 1  # never exited, never escalated
+
+    def test_push_floors_level_with_ttl(self):
+        ctl, _state = make_controller(0.0)
+        assert ctl.push(2, now=100.0, ttl_s=10.0) == 2
+        assert ctl._effective_level(105.0) == 2  # floor active within the TTL
+        assert ctl.spec_disabled(now=105.0)  # decision surface sees the floor
+        # effective level falls back once the TTL lapses
+        assert ctl._effective_level(111.0) == 0
+        # refresh extends
+        ctl.push(1, now=111.0, ttl_s=10.0)
+        assert ctl._effective_level(120.0) == 1
+
+    def test_decision_surface_per_level(self):
+        ctl, state = make_controller(2.0, max_tokens_cap=8)
+        now = 100.0
+        assert not ctl.should_shed("best_effort", now=now)
+        ctl.evaluate(now=now)
+        assert ctl.should_shed("best_effort", now=now)
+        assert not ctl.should_shed("interactive", now=now)
+        assert not ctl.should_shed("batch", now=now)
+        assert not ctl.spec_disabled(now=now)
+        ctl.evaluate(now=now + 1.1)  # level 2
+        assert ctl.spec_disabled(now=now + 1.1)
+        assert ctl.max_tokens_cap(now=now + 1.1) is None
+        ctl.evaluate(now=now + 2.2)  # level 3
+        assert ctl.max_tokens_cap(now=now + 2.2) == 8
+
+    def test_ttl_expiry_fires_exit_hook_on_next_evaluate(self):
+        """A floor lapsing via TTL between calls must still fire the exit
+        transition on the next evaluate() — otherwise on_level_change side
+        effects (spec decode off) would outlive the brownout silently."""
+        seen = []
+        ctl = BrownoutController(policy=BrownoutPolicy(),
+                                 pressure_fn=lambda: 0.0,
+                                 on_level_change=seen.append)
+        ctl.push(2, now=100.0, ttl_s=5.0)
+        assert seen == [2]
+        assert ctl.evaluate(now=106.0) == 0  # floor expired at 105
+        assert seen == [2, 0]
+
+    def test_level_changes_fire_hook_and_stats(self):
+        seen = []
+        ctl = BrownoutController(
+            policy=BrownoutPolicy(step_hold_s=1.0, exit_hold_s=1.0),
+            pressure_fn=lambda: 2.0, on_level_change=seen.append)
+        ctl.evaluate(now=100.0)
+        ctl.evaluate(now=101.1)
+        assert seen == [1, 2]
+        st = ctl.stats()
+        assert st["level"] == 2 and st["entries"] == 1
+
+
+# ---------------------------------------------------------------- scheduler-level
+@pytest.fixture(scope="module")
+def server():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    model = LlamaForCausalLM.from_config(cfg, seed=0)
+    engine = InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=256,
+                             max_blocks_per_seq=32, decode_steps=4)
+    srv = ServingServer(engine, registry=MetricsRegistry(),
+                        scheduler_config=SchedulerConfig(max_inflight=8))
+    port = srv.start_in_thread()
+    yield srv, port
+    srv.shutdown(drain_timeout_s=5)
+
+
+def post_json(port, path, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def seed_queue_wait(loop, per_slot, n=9):
+    """Seed the live queue-wait estimator (samples + freshness stamp — stale
+    samples are dropped by queue_wait_estimate)."""
+    loop._queue_wait_samples.extend([per_slot] * n)
+    loop._qw_fresh_t = time.time()
+
+
+def get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+class TestPriorityShedOverHTTP:
+    def test_pushed_brownout_sheds_best_effort_only(self, server):
+        srv, port = server
+        status, _h, doc = post_json(port, "/admin/brownout",
+                                    {"level": 1, "reason": "slo_fast_burn",
+                                     "ttl_s": 60.0})
+        assert status == 200 and doc["level"] >= 1
+        try:
+            # best_effort sheds with a clean 503 + Retry-After
+            status, headers, doc = post_json(port, "/v1/completions", {
+                "prompt": [5, 6, 7], "max_tokens": 4, "priority": "best_effort"})
+            assert status == 503
+            assert doc["error"]["type"] == "overloaded_shed"
+            assert int(headers["Retry-After"]) >= 1
+            # interactive and batch keep flowing
+            for prio in ("interactive", "batch"):
+                status, _h, doc = post_json(port, "/v1/completions", {
+                    "prompt": [5, 6, 7], "max_tokens": 4, "priority": prio})
+                assert status == 200, (prio, doc)
+                assert len(doc["choices"][0]["token_ids"]) == 4
+            # the shed is visible on /health and in the metrics plane
+            _s, health = get_json(port, "/health")
+            assert health["brownout"] >= 1
+            assert health["scheduler"]["rejected_shed"] == 1
+            assert srv.loop.metrics.shed.value(reason="shed") == 1.0
+        finally:
+            post_json(port, "/admin/brownout", {"level": 0})
+        assert srv.scheduler.brownout.level == 0
+
+    def test_level2_disables_spec_decode_and_restores(self, server):
+        srv, port = server
+        baseline = srv.loop.engine.use_speculative
+        post_json(port, "/admin/brownout", {"level": 2, "ttl_s": 60.0})
+        try:
+            assert srv.loop.engine.use_speculative is False
+        finally:
+            post_json(port, "/admin/brownout", {"level": 0})
+        assert srv.loop.engine.use_speculative == baseline
+
+    def test_level3_clamps_max_tokens(self, server):
+        srv, port = server
+        post_json(port, "/admin/brownout", {"level": 3, "ttl_s": 60.0})
+        try:
+            status, _h, doc = post_json(port, "/v1/completions", {
+                "prompt": [5, 6, 7], "max_tokens": 64, "priority": "interactive"})
+            assert status == 200
+            cap = srv.scheduler.brownout.policy.max_tokens_cap
+            assert len(doc["choices"][0]["token_ids"]) == cap
+        finally:
+            post_json(port, "/admin/brownout", {"level": 0})
+
+    def test_invalid_priority_and_brownout_payloads_400(self, server):
+        _srv, port = server
+        status, _h, doc = post_json(port, "/v1/completions", {
+            "prompt": [5, 6, 7], "max_tokens": 4, "priority": "urgent"})
+        assert status == 400 and doc["error"]["type"] == "invalid_request"
+        status, _h, _doc = post_json(port, "/v1/completions", {
+            "prompt": [5, 6, 7], "max_tokens": 4, "deadline_ms": -5})
+        assert status == 400
+        status, _h, _doc = post_json(port, "/admin/brownout", {"level": 9})
+        assert status == 400
+        status, _h, _doc = post_json(port, "/admin/brownout", {"level": "junk"})
+        assert status == 400
+
+
+class TestDeadlineAdmission:
+    def test_deadline_reject_on_arrival_tracks_estimate(self, server):
+        srv, port = server
+        loop = srv.loop
+        # seed the estimator with known per-slot waits and a deep fake backlog
+        seed_queue_wait(loop, 0.2)
+        try:
+            est = loop.queue_wait_estimate(backlog=9)
+            assert est == pytest.approx(2.0)
+            # a deadline under the estimate rejects on arrival
+            with pytest.raises(DeadlineUnmetError) as ei:
+                srv.scheduler.submit([5, 6, 7], deadline_s=0.001)
+            # generous deadline admits (engine is idle: live backlog ~0)
+            handle = srv.scheduler.submit([5, 6, 7], deadline_s=60.0)
+            handle.result(timeout=60)
+            assert ei.value.retry_after_s > 0
+            assert srv.scheduler.rejected_deadline == 1
+        finally:
+            loop._queue_wait_samples.clear()
+
+    def test_deadline_over_http_maps_503_with_retry_after(self, server):
+        srv, port = server
+        seed_queue_wait(srv.loop, 5.0)
+        try:
+            status, headers, doc = post_json(port, "/v1/completions", {
+                "prompt": [5, 6, 7], "max_tokens": 4, "deadline_ms": 1.0})
+            assert status == 503
+            assert doc["error"]["type"] == "deadline_unmet"
+            assert int(headers["Retry-After"]) >= 1
+        finally:
+            srv.loop._queue_wait_samples.clear()
+
+
+class TestRetryAfterTracksQueueDepth:
+    def test_estimate_scales_with_backlog(self, server):
+        srv, _port = server
+        loop = srv.loop
+        seed_queue_wait(loop, 0.1)
+        try:
+            shallow = loop.queue_wait_estimate(backlog=1)
+            deep = loop.queue_wait_estimate(backlog=19)
+            assert deep == pytest.approx(10 * shallow)
+            assert deep == pytest.approx(2.0)
+        finally:
+            loop._queue_wait_samples.clear()
+
+    def test_saturated_retry_after_hint_tracks_queue_depth(self, server):
+        """Satellite contract: the 429 hint is the LIVE estimate, so a deeper
+        engine backlog quotes a longer backoff — not a fixed constant."""
+        srv, _port = server
+        sched = srv.scheduler
+        loop = srv.loop
+        seed_queue_wait(loop, 0.5)
+        # force the window shut so submit raises SaturatedError immediately
+        with sched._lock:
+            saved, sched._inflight = sched._inflight, sched.config.max_inflight
+        try:
+            import unittest.mock as mock
+
+            with mock.patch.object(loop, "_engine_backlog", return_value=1):
+                with pytest.raises(SaturatedError) as shallow:
+                    sched.submit([5, 6, 7])
+            with mock.patch.object(loop, "_engine_backlog", return_value=15):
+                with pytest.raises(SaturatedError) as deep:
+                    sched.submit([5, 6, 7])
+            assert deep.value.retry_after_s == pytest.approx(
+                8 * shallow.value.retry_after_s)
+        finally:
+            with sched._lock:
+                sched._inflight = saved
+            loop._queue_wait_samples.clear()
+
+    def test_stale_samples_expire_instead_of_latching(self, server):
+        """A frozen-high estimate from a past overload must not latch
+        shedding/deadline rejection forever on an idle replica: samples with
+        no finish for queue_wait_sample_ttl_s fall back to the default."""
+        srv, _port = server
+        loop = srv.loop
+        seed_queue_wait(loop, 5.0)
+        assert loop.queue_wait_estimate(backlog=0) == pytest.approx(5.0)
+        loop._qw_fresh_t -= loop.queue_wait_sample_ttl_s + 1  # age the ring
+        assert loop.queue_wait_estimate(backlog=0) == pytest.approx(
+            loop._default_queue_wait_s)
+        assert not loop._queue_wait_samples  # dropped, not just ignored
+
+    def test_estimator_feeds_from_finished_requests(self, server):
+        """The sample ring fills from real finished requests' attribution."""
+        srv, port = server
+        before = len(srv.loop._queue_wait_samples)
+        status, _h, _doc = post_json(port, "/v1/completions", {
+            "prompt": [9, 8, 7], "max_tokens": 4})
+        assert status == 200
+        deadline = time.time() + 5
+        while len(srv.loop._queue_wait_samples) <= before and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(srv.loop._queue_wait_samples) > before
+
+
+class TestDrainingBeatsBrownout:
+    def test_draining_replica_reports_draining_not_shed(self, server):
+        """Availability checks outrank overload controls: a draining replica
+        must answer with the draining 503 (the signal the router's failure
+        classification keys on), not a brownout shed — and drain-induced
+        occupancy must not walk the brownout ladder."""
+        srv, _port = server
+        sched = Scheduler(srv.loop, SchedulerConfig(max_inflight=8))
+        sched.brownout.push(1, ttl_s=60.0)
+        sched.start_drain()
+        with pytest.raises(ShuttingDownError):
+            sched.submit([5, 6, 7], priority="best_effort")
+        assert sched.rejected_shed == 0 and sched.rejected_draining == 1
+
+
+class TestShedFaultPoint:
+    def test_injected_shed_fault_maps_to_clean_500(self, server):
+        srv, port = server
+        post_json(port, "/admin/brownout", {"level": 1, "ttl_s": 60.0})
+        FAULTS.arm("sched.shed", times=1)
+        try:
+            status, _h, _doc = post_json(port, "/v1/completions", {
+                "prompt": [5, 6, 7], "max_tokens": 4, "priority": "best_effort"})
+            assert status == 500
+            # no admission-window slot leaked
+            assert srv.scheduler.inflight == 0
+            # the NEXT best_effort submission sheds normally (fault consumed)
+            status, _h, doc = post_json(port, "/v1/completions", {
+                "prompt": [5, 6, 7], "max_tokens": 4, "priority": "best_effort"})
+            assert status == 503 and doc["error"]["type"] == "overloaded_shed"
+        finally:
+            post_json(port, "/admin/brownout", {"level": 0})
+
+
+class TestEnginePriorityOrder:
+    def test_waiting_queue_orders_by_priority_class(self):
+        from paddlenlp_tpu.experimental.engine import _PRIORITY_RANK
+
+        assert _PRIORITY_RANK == {"interactive": 0, "batch": 1, "best_effort": 2}
+        cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=256,
+                          eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+        model = LlamaForCausalLM.from_config(cfg, seed=0)
+        engine = InferenceEngine(model, max_batch_size=2, block_size=4,
+                                 num_blocks=64, max_blocks_per_seq=16)
+        engine.add_request([5, 6, 7], priority="best_effort")
+        engine.add_request([5, 6, 8], priority="batch")
+        engine.add_request([5, 6, 9])  # interactive default
+        engine.add_request([5, 6, 10], priority="batch")
+        engine.add_request([5, 6, 11], priority="interactive")
+        order = [r.priority for r in engine.waiting]
+        assert order == ["interactive", "interactive", "batch", "batch",
+                         "best_effort"]
+        # FIFO within a class
+        prompts = [int(r.prompt_ids[-1]) for r in engine.waiting]
+        assert prompts == [9, 11, 8, 10, 7]

@@ -92,12 +92,17 @@ class TestPrefixAffinity:
         for tail in ([1], [42, 43], list(range(50))):
             assert p.select(self.snaps(), prompt=base + tail)[0].id == pin
 
-    def test_pin_ignores_load(self):
+    def test_pin_ignores_load_below_spill_threshold(self):
         p = PrefixAffinityPolicy(prefix_tokens=3)
         prompt = [5, 6, 7, 8]
         pin = p.select(self.snaps(), prompt=prompt)[0].id
-        loaded = [snap(i, inflight=30 if i == pin else 0) for i in self.IDS]
+        # warm-but-under-threshold pin holds: affinity beats mild imbalance
+        loaded = [snap(i, inflight=5 if i == pin else 0) for i in self.IDS]
         assert p.select(loaded, prompt=prompt)[0].id == pin
+        # spilling disabled: the pin holds no matter how hot it runs
+        p_off = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=None)
+        melted = [snap(i, inflight=30 if i == pin else 0) for i in self.IDS]
+        assert p_off.select(melted, prompt=prompt)[0].id == pin
 
     def test_distribution_covers_all_replicas(self):
         p = PrefixAffinityPolicy(prefix_tokens=2)
@@ -150,6 +155,66 @@ class TestPrefixAffinity:
         assert isinstance(resolve_policy("prefix_affinity"), PrefixAffinityPolicy)
         with pytest.raises(ValueError):
             resolve_policy("round_robin")
+
+
+class TestWeightedSpill:
+    """Satellite contract: a too-hot pinned replica spills its prefix to the
+    agreed ring successor instead of hot-spotting — without scattering the
+    prefix or trading cache warmth for a degraded replica."""
+
+    IDS = ["r0", "r1", "r2", "r3"]
+
+    def ring_order(self, prompt):
+        p = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=None)
+        return [s.id for s in p.select(
+            [snap(i) for i in self.IDS], prompt=prompt)]
+
+    def test_hot_pin_spills_to_agreed_ring_successor(self):
+        prompt = [5, 6, 7, 8]
+        pin, successor = self.ring_order(prompt)[:2]
+        p = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=8.0)
+        hot = [snap(i, inflight=20 if i == pin else 0) for i in self.IDS]
+        got = [s.id for s in p.select(hot, prompt=prompt)]
+        assert got[0] == successor
+        # the rest of the walk keeps ring order: every client of the prefix
+        # spills to the SAME replica (co-located on two, not scattered)
+        fresh = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=8.0)
+        assert [s.id for s in fresh.select(hot, prompt=prompt)] == got
+
+    def test_spill_skips_hot_successor_for_next_cool_candidate(self):
+        prompt = [5, 6, 7, 8]
+        order = self.ring_order(prompt)
+        pin, successor, third = order[0], order[1], order[2]
+        p = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=8.0)
+        loads = {pin: 20, successor: 15}
+        hot = [snap(i, inflight=loads.get(i, 0)) for i in self.IDS]
+        assert p.select(hot, prompt=prompt)[0].id == third
+
+    def test_uniformly_hot_fleet_keeps_pin(self):
+        """When every candidate is past the threshold the pin stands —
+        bouncing between equally-loaded replicas only sheds cache warmth."""
+        prompt = [5, 6, 7, 8]
+        pin = self.ring_order(prompt)[0]
+        p = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=8.0)
+        hot = [snap(i, inflight=20) for i in self.IDS]
+        assert p.select(hot, prompt=prompt)[0].id == pin
+
+    def test_never_spills_onto_worse_state_replica(self):
+        prompt = [5, 6, 7, 8]
+        pin = self.ring_order(prompt)[0]
+        p = PrefixAffinityPolicy(prefix_tokens=3, spill_load_score=8.0)
+        snaps = [snap(i, inflight=20 if i == pin else 0,
+                      state=HEALTHY if i == pin else DEGRADED)
+                 for i in self.IDS]
+        # every same-state alternative is missing: the hot pin stands rather
+        # than trading cache warmth for a DEGRADED replica
+        assert p.select(snaps, prompt=prompt)[0].id == pin
+
+    def test_spill_threshold_validation(self):
+        with pytest.raises(ValueError):
+            PrefixAffinityPolicy(spill_load_score=0.0)
+        with pytest.raises(ValueError):
+            PrefixAffinityPolicy(spill_load_score=-1.0)
 
 
 # --------------------------------------------------------------------- pool
@@ -1053,6 +1118,25 @@ class TestFailureClassification:
         for kind in ("engine_error", "broke"):
             d = _classify_upstream_failure(kind, None)
             assert d.outcome == "failover" and d.replica_fault
+
+    def test_request_level_503_does_not_degrade_replica(self):
+        """A brownout shed / deadline reject is a healthy replica declining
+        ONE request's class — re-route, but never mark it degraded (a
+        fleet-wide brownout must not flap every replica to DEGRADED)."""
+        import json as _json
+
+        from paddlenlp_tpu.serving.router.proxy import _classify_upstream_failure
+
+        for etype in ("overloaded_shed", "deadline_unmet"):
+            body = _json.dumps({"error": {"type": etype, "message": "x"}}).encode()
+            d = _classify_upstream_failure("status", (503, body, "2"))
+            assert d.outcome == "reroute" and not d.is_degraded, etype
+            assert d.retry_after_s() == 2.0
+        # replica-level 503s (draining/degraded) still note degradation, and
+        # an unparseable body reads as replica-level (conservative)
+        drain = _json.dumps({"error": {"type": "shutting_down"}}).encode()
+        assert _classify_upstream_failure("status", (503, drain, None)).is_degraded
+        assert _classify_upstream_failure("status", (503, b"junk{", None)).is_degraded
 
 
 class TestStageFold:

@@ -55,20 +55,24 @@ def catalog_exposition() -> str:
     from paddlenlp_tpu.observability.slo import SLOInputs, SLOTracker
     from paddlenlp_tpu.serving.engine_loop import ServingMetrics
     from paddlenlp_tpu.serving.metrics import MetricsRegistry
-    from paddlenlp_tpu.serving.router.metrics import RouterMetrics
+    from paddlenlp_tpu.serving.router.metrics import AutoscalerMetrics, RouterMetrics
     from paddlenlp_tpu.trainer.integrations import register_training_metrics
 
     registry = MetricsRegistry()
     serving = ServingMetrics(_stub_engine(), registry=registry)
     router = RouterMetrics(registry)
+    autoscaler = AutoscalerMetrics(registry)
     # labeled series expose no samples until touched — exercise one labelset
     # of each so the lint sees real sample lines, not just HELP/TYPE headers
     serving.latency_attribution.observe(0.01, phase="queue")
+    serving.shed.inc(reason="shed")
     router.latency_attribution.observe(0.02, phase="hedge_race")
     router.replica_healthy.set(1.0, replica="replica-0")
     router.requests.inc(replica="replica-0", outcome="ok")
     router.health_polls.inc(replica="replica-0", outcome="ok")
     router.fleet_scrape_errors.inc(replica="replica-0")
+    router.hedges.inc(outcome="brownout")
+    autoscaler.decisions.inc(action="up")
     slo = SLOTracker(registry=registry)
     slo.observe(SLOInputs(total=10.0, errors=1.0, ttft_count=10.0,
                           ttft_violations=2.0), now=100.0)

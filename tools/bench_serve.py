@@ -81,6 +81,26 @@ Usage::
                                                  # decode) so a BENCH_r*
                                                  # regression localizes to a
                                                  # phase, not just a number
+    python tools/bench_serve.py --surge 1,6,8 --autoscale 1,3
+                                                 # closed-loop demo: open-loop
+                                                 # arrivals ramp 1 -> 6 req/s
+                                                 # over 8s (flat shoulders
+                                                 # before/after) while the
+                                                 # in-process autoscaler
+                                                 # watches /fleet/slo +
+                                                 # /replicas and drives the
+                                                 # admin plane inside a 1..3
+                                                 # replica envelope. 1 in 4
+                                                 # requests is best_effort —
+                                                 # at the max envelope the
+                                                 # brownout ladder sheds them
+                                                 # while interactive TTFT
+                                                 # holds. JSON adds surge
+                                                 # (per-phase p99 TTFT, shed/
+                                                 # rejected counts, SLO burn
+                                                 # trajectory) + autoscale
+                                                 # (scale events, final
+                                                 # replica count)
     python tools/bench_serve.py --disagg 2,2 --long-prompt-mix --prefill-chunk 64
                                                  # disaggregated prefill/decode
                                                  # engine: prompt work on a
@@ -135,6 +155,29 @@ def _parse_disagg():
     parts = [int(x) for x in raw.split(",")]
     if len(parts) != 2 or any(p < 1 for p in parts):
         _fail(f"--disagg must be P,D with positive device counts, got {raw!r}")
+    return tuple(parts)
+
+
+def _parse_surge():
+    """``--surge R1,R2,T``: open-loop arrival rate ramping R1 -> R2 req/s
+    over T seconds (flat R1 shoulders of T/2 before and after)."""
+    if "--surge" not in sys.argv:
+        return None
+    raw = sys.argv[sys.argv.index("--surge") + 1]
+    parts = [float(x) for x in raw.split(",")]
+    if len(parts) != 3 or parts[0] <= 0 or parts[1] <= 0 or parts[2] <= 0:
+        _fail(f"--surge must be R1,R2,T with positive values, got {raw!r}")
+    return tuple(parts)
+
+
+def _parse_autoscale():
+    """``--autoscale MIN,MAX``: run the in-process autoscaler in the loop."""
+    if "--autoscale" not in sys.argv:
+        return None
+    raw = sys.argv[sys.argv.index("--autoscale") + 1]
+    parts = [int(x) for x in raw.split(",")]
+    if len(parts) != 2 or not 1 <= parts[0] <= parts[1]:
+        _fail(f"--autoscale must be MIN,MAX with 1 <= MIN <= MAX, got {raw!r}")
     return tuple(parts)
 
 
@@ -197,8 +240,41 @@ def run() -> None:
     drain_mid_run = "--drain-mid-run" in sys.argv
     hedge_after_ms = _farg("--hedge-after-ms", 0.0)
     prefix_share = _farg("--prefix-share", 0.0)
+    surge = _parse_surge()
+    autoscale = _parse_autoscale()
+    if autoscale and not surge:
+        _fail("--autoscale needs --surge (the control loop reacts to the ramp)")
+    if autoscale:
+        # the fleet starts at the envelope floor; the autoscaler grows it
+        n_replicas = autoscale[0]
     if drain_mid_run and n_replicas < 2:
         _fail("--drain-mid-run needs --replicas >= 2 (one replica must survive)")
+    # --surge R1,R2,T: precompute the open-loop arrival schedule (the ramp
+    # integrates the linear rate; flat R1 shoulders bracket it so the JSON
+    # can report p99 TTFT before/during/after)
+    surge_schedule = []  # (t_offset_s, phase, priority)
+    if surge:
+        r1, r2, ramp_s = surge
+        shoulder = max(ramp_s / 2.0, 2.0)
+        t = 0.0
+        i = 0
+        while t < shoulder:
+            surge_schedule.append((t, "before"))
+            t += 1.0 / r1
+        ramp_t0 = t
+        while t - ramp_t0 < ramp_s:
+            frac = (t - ramp_t0) / ramp_s
+            surge_schedule.append((t, "during"))
+            t += 1.0 / (r1 + (r2 - r1) * frac)
+        tail_t0 = t
+        while t - tail_t0 < shoulder:
+            surge_schedule.append((t, "after"))
+            t += 1.0 / r1
+        # 1 in 4 requests is best_effort: the shed class the brownout ladder
+        # drops first when the envelope pins
+        surge_schedule = [(off, phase, "best_effort" if i % 4 == 3 else "interactive")
+                          for i, (off, phase) in enumerate(surge_schedule)]
+        n_requests = len(surge_schedule)
     long_mix = "--long-prompt-mix" in sys.argv
     n_long = _arg("--long-prompts", 2)
     long_tokens = _arg("--long-prompt-tokens", 2048)
@@ -259,7 +335,7 @@ def run() -> None:
 
     registry = MetricsRegistry()
     fleet = server = None
-    if n_replicas > 1:
+    if n_replicas > 1 or autoscale:
         # multi-replica mode: the timed window goes through the router front
         # tier, so the measured path includes routing + SSE passthrough
         from paddlenlp_tpu.serving.router import launch_fleet
@@ -353,7 +429,36 @@ def run() -> None:
         for t in riders:
             t.join()
 
+    # --autoscale: the in-process provisioner + control loop, started after
+    # warmup so compile stalls don't read as overload
+    scaler = provisioner = None
+    if autoscale:
+        from paddlenlp_tpu.serving.router.autoscaler import (
+            Autoscaler,
+            AutoscalerPolicy,
+            InProcessProvisioner,
+        )
+
+        provisioner = InProcessProvisioner(
+            make_engine, replica_kw=dict(
+                scheduler_config=SchedulerConfig(max_inflight=2 * n_requests)))
+        scaler = Autoscaler(
+            ("127.0.0.1", port), provisioner,
+            policy=AutoscalerPolicy(
+                min_replicas=autoscale[0], max_replicas=autoscale[1],
+                scale_up_queue_depth=2.0, scale_up_kv_utilization=0.7,
+                scale_down_queue_depth=0.5, scale_down_kv_utilization=0.3,
+                hysteresis_up=2, hysteresis_down=4,
+                cooldown_up_s=2.0, cooldown_down_s=4.0,
+                max_step_up=1, drain_deadline_s=15.0),
+            interval_s=0.5)
+        scaler.start()
+
     stats = {"ttft": [], "tokens": 0, "gaps_short": []}
+    surge_stats = {"shed": 0, "shed_best_effort": 0, "rejected": 0,
+                   "phase_ttft": {"before": [], "during": [], "after": []},
+                   "interactive_ttft": []}
+    slo_samples: list = []
     lock = threading.Lock()
     errors: list = []
     sem = threading.Semaphore(concurrency)
@@ -418,19 +523,131 @@ def run() -> None:
             stats["tokens"] += local["tokens"]
             stats["gaps_short"].extend(local["gaps_short"])
 
+    def surge_request(i: int, phase: str, priority: str):
+        """One open-loop surge request: sheds (503 overloaded_shed) and
+        backpressure rejections are COUNTED, not errors — graceful
+        degradation is the behavior under measurement."""
+        t_start = time.time()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=RUN_TIMEOUT_S)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps({"prompt": [5 + i % 8, 6, 7],
+                                          "max_tokens": max_tokens,
+                                          "stream": True, "priority": priority}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raw = resp.read()
+                conn.close()
+                try:
+                    etype = json.loads(raw).get("error", {}).get("type", "")
+                except ValueError:
+                    etype = ""
+                with lock:
+                    # a replica-level shed reaches the client directly
+                    # (overloaded_shed) or wrapped by the router after every
+                    # candidate shed it (no_replica_available); the replicas'
+                    # shed counter in the JSON is the authoritative total
+                    if etype == "overloaded_shed" or (
+                            etype == "no_replica_available"
+                            and priority == "best_effort"):
+                        surge_stats["shed"] += 1
+                        if priority == "best_effort":
+                            surge_stats["shed_best_effort"] += 1
+                    else:
+                        surge_stats["rejected"] += 1
+                return
+            ttft, n_toks = None, 0
+            while True:
+                line = resp.readline()
+                if not line or line.strip() == b"data: [DONE]":
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[len(b"data: "):])
+                if "token" in ev["choices"][0]:
+                    if ttft is None:
+                        ttft = time.time() - t_start
+                    n_toks += 1
+            conn.close()
+            with lock:
+                stats["tokens"] += n_toks
+                if ttft is not None:
+                    stats["ttft"].append(ttft)
+                    surge_stats["phase_ttft"][phase].append(ttft)
+                    if priority == "interactive":
+                        surge_stats["interactive_ttft"].append(ttft)
+        except Exception as e:
+            with lock:
+                errors.append(f"surge req {i}: {e!r}")
+
     t0 = time.time()
     threads = []
     drain_thread = None
-    for i in range(n_requests):
-        sem.acquire()
-        if drain_mid_run and drain_thread is None and i >= n_requests // 2:
-            drain_thread = threading.Thread(target=drain_worker, daemon=True)
-            drain_thread.start()
-        t = threading.Thread(target=worker, args=(i,))
-        t.start()
-        threads.append(t)
-    for t in threads:
-        t.join()
+    if surge:
+        # SLO burn trajectory: sampled like an on-call dashboard would, once
+        # a second over the whole run (router mode only)
+        stop_sampler = threading.Event()
+
+        def slo_sampler():
+            while not stop_sampler.is_set():
+                try:
+                    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+                    conn.request("GET", "/fleet/slo")
+                    doc = json.loads(conn.getresponse().read())
+                    conn.close()
+                    windows = doc.get("windows") or {}
+                    if windows:
+                        w = windows[min(windows, key=lambda k: int(k.rstrip("s")))]
+                        slo_samples.append({
+                            "t_s": round(time.time() - t0, 2),
+                            "availability_burn": round(
+                                w["availability_burn_rate"], 3),
+                            "ttft_burn": round(w["ttft_burn_rate"], 3)})
+                except Exception:
+                    pass
+                stop_sampler.wait(1.0)
+
+        sampler = None
+        if fleet is not None:
+            sampler = threading.Thread(target=slo_sampler, daemon=True)
+            sampler.start()
+        # open loop: each request fires at its scheduled offset regardless of
+        # how many are still in flight — arrival pressure is the experiment
+        for i, (off, phase, priority) in enumerate(surge_schedule):
+            delay = t0 + off - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=surge_request, args=(i, phase, priority))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        if scaler is not None:
+            # post-surge settle window: give the loop a chance to observe the
+            # calm, scale back down, AND finalize the drain (removal happens
+            # on a later tick than the down decision) before the verdict
+            settle_deadline = time.time() + 15.0
+            while time.time() < settle_deadline:
+                if any(a == "drained" for _t, a, _d in scaler.events):
+                    break
+                time.sleep(0.25)
+            scaler.stop()
+        if sampler is not None:
+            stop_sampler.set()
+            sampler.join(timeout=5)
+    else:
+        for i in range(n_requests):
+            sem.acquire()
+            if drain_mid_run and drain_thread is None and i >= n_requests // 2:
+                drain_thread = threading.Thread(target=drain_worker, daemon=True)
+                drain_thread.start()
+            t = threading.Thread(target=worker, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
     if drain_thread is not None:
         drain_thread.join(timeout=90)
     dt = time.time() - t0
@@ -448,6 +665,11 @@ def run() -> None:
         _fail(f"/metrics scrape failed: HTTP {resp.status}")
     replica_expositions = [r.expose() for r in fleet.registries()] if fleet is not None \
         else [scraped]
+    if provisioner is not None:
+        # autoscaler-provisioned replicas live outside the launch-time fleet;
+        # their serving planes fold into the same readouts
+        replica_expositions += [s.registry.expose()
+                                for s in provisioner.servers.values()]
     fleet_slo = None
     if fleet is not None:
         # fleet SLO plane: federated availability + TTFT burn rates, scraped
@@ -459,10 +681,16 @@ def run() -> None:
         conn.close()
         if resp.status == 200:
             fleet_slo = json.loads(slo_raw)
+    final_replicas = None
+    if scaler is not None:
+        scaler.stop()  # no-op when the settle window already stopped it
+        final_replicas = len(fleet.router.pool)
     if fleet is not None:
         fleet.shutdown(drain_timeout_s=10)
     else:
         server.shutdown(drain_timeout_s=10)
+    if provisioner is not None:
+        provisioner.close()
 
     if errors:
         _fail(f"{len(errors)}/{n_requests} requests failed: {errors[:3]}")
@@ -475,6 +703,18 @@ def run() -> None:
 
     def scalar_sum(name):
         return sum((f[name].value() or 0.0) for f in replica_fams if name in f)
+
+    def labeled_sum(name):
+        # sum across every labelset (Family.value() is unlabeled-only)
+        total = 0.0
+        for f in replica_fams:
+            fam = f.get(name)
+            if fam is None:
+                continue
+            for (sample_name, _labels), v in fam.samples.items():
+                if sample_name == name:
+                    total += v
+        return total
 
     def quantile_max(name, q):
         # worst replica's quantile: merging bucket vectors across registries
@@ -530,6 +770,38 @@ def run() -> None:
     # and once without, diff value/tails — these two fields label the arms
     record["flight_recorder"] = RECORDER.enabled
     record["flight_events"] = len(RECORDER)
+    if surge:
+        pq = lambda arr, q: (sorted(arr)[min(int(q * len(arr)), len(arr) - 1)]
+                             if arr else 0.0)
+        pt = surge_stats["phase_ttft"]
+        record["surge"] = {
+            "rate_from": surge[0], "rate_to": surge[1], "ramp_s": surge[2],
+            "requests": n_requests,
+            "shed": surge_stats["shed"],
+            "shed_best_effort": surge_stats["shed_best_effort"],
+            "rejected": surge_stats["rejected"],
+            # the replicas' own shed counter (brownout + deadline rejects),
+            # covering direct sheds the router re-routed around
+            "replica_shed_total": int(
+                labeled_sum("paddlenlp_serving_requests_shed_total")),
+            "p99_ttft_before_ms": round(pq(pt["before"], 0.99) * 1e3, 1),
+            "p99_ttft_during_ms": round(pq(pt["during"], 0.99) * 1e3, 1),
+            "p99_ttft_after_ms": round(pq(pt["after"], 0.99) * 1e3, 1),
+            "interactive_p99_ttft_ms": round(
+                pq(surge_stats["interactive_ttft"], 0.99) * 1e3, 1),
+            "slo_trajectory": slo_samples[-20:],
+        }
+    if scaler is not None:
+        ev = list(scaler.events)
+        record["autoscale"] = {
+            "min": autoscale[0], "max": autoscale[1],
+            "scale_ups": sum(1 for _t, a, _d in ev if a == "up"),
+            "scale_downs": sum(1 for _t, a, _d in ev if a == "down"),
+            "replaces": sum(1 for _t, a, _d in ev if a == "replace"),
+            "holds": sum(1 for _t, a, _d in ev if a == "hold"),
+            "final_replicas": final_replicas,
+            "events": [[round(t - t0, 2), a, d] for t, a, d in ev][-30:],
+        }
     if long_mix:
         gaps = sorted(stats["gaps_short"])
         gp = lambda q: gaps[min(int(q * len(gaps)), len(gaps) - 1)] if gaps else 0.0
