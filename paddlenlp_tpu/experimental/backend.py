@@ -18,6 +18,16 @@ Contract (one backend == one way to run the forward + lay out KV):
 - ``apply_cow(pairs)``   prefix-cache copy-on-write block copies in the pool;
 - ``describe()``         placement metadata for ``stats()``/the metrics plane.
 
+Every step entry point additionally stamps ``self.step_accounting`` —
+``{"fed": <token positions the launch processed>, "shape": <launch-geometry
+key>}`` — immediately before dispatch. The engine reads it right after the
+call to feed the goodput ledger (observability/goodput.py): ``fed`` is the
+*padded* geometry (``n_rows * bucket_width``), which is what the device
+actually burnt cycles on, and ``shape`` keys the live shape-bucket
+cardinality gauge. Backends never decompose fed into useful/padding/rework —
+that split needs scheduler knowledge (prefix hits, preemption history,
+speculative acceptance) the backend deliberately does not have.
+
 External weight updates (serving epochs, PPO rollouts) flow through the
 ``params`` property: callers rebind ``model.params`` and the backend picks it
 up on the next step (the sharded backend re-places the tree on its mesh via
@@ -124,6 +134,13 @@ class ModelBackend:
     #: the sequence as decode-eligible
     staged = False
 
+    #: the last launch's padded token geometry for the goodput ledger (see
+    #: module docstring) — stamped (REASSIGNED, never mutated in place: the
+    #: engine may hold a reference across its accounting read) by every step
+    #: entry point before dispatch. Instance state — initialized per backend
+    #: in __init__ so fleets of in-process engines never share one dict.
+    step_accounting: dict
+
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
                 sampling, slot_idx) -> np.ndarray:
         raise NotImplementedError
@@ -161,6 +178,7 @@ class SingleDeviceBackend(ModelBackend):
                  token_flatten: Optional[bool] = None):
         self.model = model
         self.max_batch_size = max_batch_size
+        self.step_accounting = {"fed": 0, "shape": ()}
         self.infer = self._build_infer(model, block_size, num_blocks, max_blocks_per_seq,
                                        dtype, decode_steps, eos_ids)
         self.pool = self._init_pool(model.config, num_blocks, block_size, dtype, kv_cache_quant)
@@ -223,6 +241,8 @@ class SingleDeviceBackend(ModelBackend):
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
                 sampling, slot_idx) -> np.ndarray:
         n = input_ids.shape[0]
+        self.step_accounting = {"fed": n * input_ids.shape[1],
+                                "shape": ("prefill", n, input_ids.shape[1])}
         cached_lens = np.zeros(n, np.int32)
         for row, _ids, n_cached in cached_entries:
             cached_lens[row] = n_cached
@@ -238,6 +258,8 @@ class SingleDeviceBackend(ModelBackend):
 
     def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
                sampling) -> Tuple[np.ndarray, np.ndarray]:
+        B, steps = last_tokens.shape[0], self.infer.decode_steps
+        self.step_accounting = {"fed": B * steps, "shape": ("decode", B, steps)}
         toks, valid, _, _, self.counts, self.pool = self.infer.decode(
             self.params, self.pool, jnp.asarray(last_tokens), jnp.asarray(block_tables),
             jnp.asarray(context_lens), jnp.asarray(done0), jnp.asarray(remaining),
@@ -246,6 +268,9 @@ class SingleDeviceBackend(ModelBackend):
         return np.asarray(toks), np.asarray(valid)  # sync-ok: THE decode sync point — int32 ids + validity flags only
 
     def verify(self, tokens, block_tables, start_pos, need_logits: bool):
+        self.step_accounting = {
+            "fed": tokens.shape[0] * tokens.shape[1],
+            "shape": ("verify", tokens.shape[0], tokens.shape[1])}
         argmax, logits, self.pool = self.infer.verify(
             self.params, self.pool, jnp.asarray(tokens), jnp.asarray(block_tables),
             jnp.asarray(start_pos), need_logits=need_logits,
@@ -288,6 +313,7 @@ class SingleDeviceBackend(ModelBackend):
         mapper)."""
         B = self.max_batch_size
         T = _bucket(max([len(r.tokens) for r in chunk_rows], default=1), minimum=1)
+        self.step_accounting = {"fed": B * T, "shape": ("mixed_padded", B, T)}
         ids = np.zeros((B, T), np.int32)
         tables = np.zeros((B, chunk_rows[0].table.shape[0] if chunk_rows
                            else decode_rows[0].table.shape[0]), np.int32)
@@ -323,6 +349,7 @@ class SingleDeviceBackend(ModelBackend):
         C = _bucket(len(chunk_rows), minimum=1)
         T = _bucket(max([len(r.tokens) for r in chunk_rows], default=1), minimum=1)
         D = _bucket(len(decode_rows), minimum=1)
+        self.step_accounting = {"fed": C * T + D, "shape": ("mixed_flat", C, T, D)}
         M = (chunk_rows[0].table.shape[0] if chunk_rows else decode_rows[0].table.shape[0])
         c_ids = np.zeros((C, T), np.int32)
         c_tables = np.zeros((C, M), np.int32)

@@ -128,6 +128,7 @@ class DisaggBackend(ModelBackend):
                 f"XLA_FLAGS=--xla_force_host_platform_device_count={p_devs + d_devs})")
         self.model = model
         self.max_batch_size = kw["max_batch_size"]
+        self.step_accounting = {"fed": 0, "shape": ()}
         # two disjoint sub-meshes: each stage is a full ShardedBackend over its
         # own device slice (engine.shard_init fires once per stage, so a
         # supervisor rebuild of either stage is chaos-coverable)
@@ -208,16 +209,22 @@ class DisaggBackend(ModelBackend):
     # ------------------------------------------------------------- steps
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
                 sampling, slot_idx):
-        return self.prefill_stage.prefill(
+        out = self.prefill_stage.prefill(
             input_ids, block_tables, suffix_lens, cached_entries, sampling, slot_idx)
+        self.step_accounting = self.prefill_stage.step_accounting
+        return out
 
     def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
                sampling):
-        return self.decode_stage.decode(
+        out = self.decode_stage.decode(
             last_tokens, block_tables, context_lens, done0, remaining, sampling)
+        self.step_accounting = self.decode_stage.step_accounting
+        return out
 
     def verify(self, tokens, block_tables, start_pos, need_logits: bool):
-        return self.decode_stage.verify(tokens, block_tables, start_pos, need_logits)
+        out = self.decode_stage.verify(tokens, block_tables, start_pos, need_logits)
+        self.step_accounting = self.decode_stage.step_accounting
+        return out
 
     def mixed_step(self, chunk_rows: List[MixedRow], decode_rows: List[MixedRow]):
         """One engine mixed step = up to TWO stage programs: chunk rows on the
@@ -229,10 +236,19 @@ class DisaggBackend(ModelBackend):
         Returns tokens in ``[*chunk_rows, *decode_rows]`` order, the
         single-backend contract."""
         collectors = []
+        fed = 0
+        shapes = []
         if chunk_rows:
             collectors.append(self.prefill_stage.mixed_step_begin(chunk_rows, []))
+            fed += self.prefill_stage.step_accounting["fed"]
+            shapes.append(("stage_prefill",) + self.prefill_stage.step_accounting["shape"])
         if decode_rows:
             collectors.append(self.decode_stage.mixed_step_begin([], decode_rows))
+            fed += self.decode_stage.step_accounting["fed"]
+            shapes.append(("stage_decode",) + self.decode_stage.step_accounting["shape"])
+        # one engine mixed step = the SUM of both stage launches: the goodput
+        # ledger accounts device positions burnt fleet-of-stages-wide
+        self.step_accounting = {"fed": fed, "shape": tuple(shapes)}
         if not collectors:
             return np.zeros(0, np.int32)
         return np.concatenate([collect() for collect in collectors])

@@ -43,6 +43,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability.flight_recorder import RECORDER
+from ..observability.goodput import (
+    GoodputLedger,
+    compile_attribution,
+    device_peak_flops,
+    efficiency_doc,
+    estimate_model_flops_per_token,
+    install_compile_listener,
+)
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
@@ -111,6 +119,16 @@ class Request:
     # (accumulated on land; migrate_start_t marks an episode still open)
     migration_wait_s: float = 0.0
     migrate_start_t: Optional[float] = None
+    # goodput-ledger bookkeeping: highest absolute position ever fed through
+    # a forward for this request (prompt+output indexing survives the
+    # preemption fold) — re-feeding below the mark is rework, not useful ...
+    fed_hwm: int = 0
+    # ... COW tail tokens owed by a full-cover prefix-cache admission (they
+    # re-prefill KV another request already built: rework kind "cow_token") ...
+    cow_pending: int = 0
+    # ... and which rework bucket this request's re-fed positions land in
+    # (preemption recompute vs a supervisor requeue across a rebuild)
+    rework_src: str = "preempt_refill"
 
     @property
     def needs_prefill(self) -> bool:
@@ -276,6 +294,23 @@ class InferenceEngine:
         # an XLA op in a device profile join on the same number
         self._step_seq = itertools.count()
         self._cur_step = -1
+        # goodput ledger: per-step token-conservation accounting
+        # (fed == useful + padding + spec_rejected + rework, exact) + compile
+        # telemetry + step anatomy. Loop-thread-confined like chunk_stats;
+        # totals survive reset() (monotone engine totals, rebaselined by the
+        # metrics plane on rebind exactly like the chunk counters)
+        self.ledger = GoodputLedger(
+            flops_per_token=estimate_model_flops_per_token(model.config),
+            peak_flops=device_peak_flops()
+            * max(self.backend.describe().get("devices", 1), 1))
+        install_compile_listener()
+        # step-time anatomy event ring, drained by seq like the chunk rings:
+        # (seq, gap_s, device_s, host_s); gap_s < 0 = unmeasured (post-idle)
+        self.recent_step_times: deque = deque(maxlen=512)
+        self._step_time_seq = itertools.count(1)
+        self._last_step_end: Optional[float] = None
+        self._prev_step_busy = False
+        self._step_device_s = 0.0
         # serving hook: called after every step() with a stats dict (queue
         # depth, running slots, free KV blocks) — the metrics plane subscribes
         # here instead of monkey-patching the loop
@@ -298,7 +333,11 @@ class InferenceEngine:
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                     stream_cb: Optional[Callable] = None, trace: Optional[str] = None,
-                    priority: str = "interactive") -> int:
+                    priority: str = "interactive", rework_hwm: int = 0) -> int:
+        """``rework_hwm`` marks the first ``rework_hwm`` prompt positions as
+        already-fed-once (a supervisor requeue resubmitting a folded prompt
+        after an engine rebuild): the goodput ledger then books their
+        re-prefill as ``requeue_refill`` rework instead of useful work."""
         sampling = sampling or SamplingParams()
         req = Request(
             req_id=next(self._next_id),
@@ -310,6 +349,9 @@ class InferenceEngine:
             priority=priority,
         )
         req.base_prompt_len = len(req.prompt_ids)
+        if rework_hwm > 0:
+            req.fed_hwm = min(int(rework_hwm), len(req.prompt_ids))
+            req.rework_src = "requeue_refill"
         # priority-ordered admission: insert before the first waiting request
         # of a STRICTLY lower class so interactive work overtakes queued batch/
         # best-effort prompts under load, while same-class order stays FIFO
@@ -515,6 +557,10 @@ class InferenceEngine:
             t0 = time.perf_counter()
             self._migrating[req_id] = self.backend.kv_migrate(
                 req_id, list(blocks), slot, hist)
+            # goodput: the decode-stage penalty-count re-seed re-processes the
+            # sequence's whole token history — pure rework, zero useful
+            self.ledger.record("reseed", len(hist), 0, rework=len(hist),
+                               rework_by={"migration_reseed": len(hist)})
             self._migrate_defer_noted.discard(req_id)
             RECORDER.record("migrate.start", req_id=req_id, trace=req.trace,
                             blocks=len(blocks), inflight=len(self._migrating))
@@ -555,6 +601,11 @@ class InferenceEngine:
         self._migrating.clear()
         self._migrate_pending.clear()
         self._migrate_defer_noted.clear()
+        # the failed step never ran its anatomy tail: without this, the first
+        # post-recovery step would book the whole outage (triage + reset) as
+        # a "step gap" and pollute the histogram the bench gate reads
+        self._last_step_end = None
+        self._prev_step_busy = False
         logger.warning("inference engine reset: scheduler + KV allocator state dropped")
 
     def stats(self) -> Dict:
@@ -581,6 +632,9 @@ class InferenceEngine:
                 "chunk_tokens_total": self.chunk_stats["chunk_tokens"],
             },
             "backend": self.backend.describe(),
+            # the goodput ledger rides stats() so the step_cb metrics plane,
+            # /health and postmortem bundles all carry the waste accounting
+            "goodput": self.ledger.snapshot(),
         }
         if self.staged:
             held = self._stage_blocks()
@@ -609,6 +663,54 @@ class InferenceEngine:
             }
         return out
 
+    def kv_fragmentation(self) -> float:
+        """Internal fragmentation of allocated KV blocks: 1 - held tokens /
+        (held blocks * block_size). 0.0 when nothing is allocated. Block-
+        granular allocation always strands the tail of the last block; this
+        gauge is how much of the allocated pool that amounts to right now.
+
+        Called from HTTP scrape threads while the loop thread mutates the
+        BlockManager: the dict snapshots are taken via ``list()`` (atomic in
+        CPython) and a mid-resize race degrades to one stale scrape, never a
+        500."""
+        try:
+            tables = list(self.mgr.tables.values())
+            lengths = list(self.mgr.lengths.values())
+        except RuntimeError:  # dict resized mid-copy by the loop thread
+            return 0.0
+        blocks = sum(len(t) for t in tables)
+        if not blocks:
+            return 0.0
+        return max(0.0, 1.0 - sum(lengths) / (blocks * self.mgr.block_size))
+
+    def efficiency(self) -> Dict:
+        """The ``GET /debug/efficiency`` document: ledger snapshot, MFU /
+        FLOPs model, percentiled step anatomy, occupancy and KV
+        fragmentation. Readable from any thread (plain attribute reads; at
+        worst one step stale — the stats() contract)."""
+        running = sum(1 for r in self.slots if r is not None)
+        try:
+            step_times = list(self.recent_step_times)
+        except RuntimeError:  # loop thread appended mid-copy: drop one window
+            step_times = []
+        return efficiency_doc(
+            self.ledger, step_times, tier="serving",
+            extra={
+                "occupancy": {
+                    "running": running,
+                    "max_batch_size": self.max_batch_size,
+                    "slot_occupancy": running / max(self.max_batch_size, 1),
+                },
+                "kv_fragmentation": round(self.kv_fragmentation(), 6),
+                "spec": {
+                    "drafted": self.spec_stats["drafted"],
+                    "accepted": self.spec_stats["accepted"],
+                    "acceptance_rate": self.spec_stats["accepted"]
+                    / max(self.spec_stats["drafted"], 1),
+                },
+                "backend": self.backend.describe(),
+            })
+
     def generate(self, prompts: List, sampling: Optional[SamplingParams] = None) -> List[List[int]]:
         """Submit a batch and run to completion (convenience API)."""
         ids = [self.add_request(p, sampling) for p in prompts]
@@ -623,6 +725,15 @@ class InferenceEngine:
         """One engine iteration: admit + decode. Returns requests finished this step."""
         _F_STEP.fire()
         self._cur_step = next(self._step_seq)
+        # step anatomy: host gap since the previous BUSY step ended (loop
+        # overhead between steps) vs device time inside backend calls vs the
+        # step's own host scheduling time. Post-idle steps have no meaningful
+        # gap (the loop slept on purpose) — marked unmeasured (-1)
+        t_step0 = time.perf_counter()
+        gap_s = (t_step0 - self._last_step_end
+                 if self._last_step_end is not None and self._prev_step_busy
+                 else -1.0)
+        self._step_device_s = 0.0
         finished: List[Request] = []
         # StepTraceAnnotation brackets this step on the device timeline: a
         # jax.profiler capture (POST /debug/profile) shows per-step lanes
@@ -646,12 +757,44 @@ class InferenceEngine:
             else:
                 self._admit(finished)
                 self._decode_running(finished)
+        t_end = time.perf_counter()
+        host_s = max(t_end - t_step0 - self._step_device_s, 0.0)
+        self.ledger.note_step(max(gap_s, 0.0), self._step_device_s, host_s)
+        self.recent_step_times.append(
+            (next(self._step_time_seq), gap_s, self._step_device_s, host_s))
+        self._last_step_end = t_end
+        self._prev_step_busy = self.has_work()
         if self.step_cb is not None:
             self.step_cb(self.stats())
         return finished
 
     def _free_slot_indices(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
+
+    def _note_fed_span(self, req: Request, start: int, n: int):
+        """Goodput split of one fed span ``[start, start+n)``: positions below
+        the request's fed high-water mark (re-prefill after preemption or a
+        supervisor requeue) plus owed COW tail tokens are rework; the rest is
+        useful. Advances the mark. Returns ``(rework, rework_by|None)``."""
+        if n <= 0:
+            return 0, None
+        overlap = min(max(req.fed_hwm - start, 0), n)
+        by = {}
+        if overlap:
+            by[req.rework_src] = overlap
+        cow = min(req.cow_pending, n - overlap)
+        if cow:
+            by["cow_token"] = cow
+            req.cow_pending -= cow
+        req.fed_hwm = max(req.fed_hwm, start + n)
+        rework = overlap + cow
+        return rework, (by or None)
+
+    @staticmethod
+    def _merge_rework(total_by: Dict[str, int], by: Optional[Dict[str, int]]):
+        if by:
+            for k, v in by.items():
+                total_by[k] = total_by.get(k, 0) + v
 
     def _note_gated(self, req: Request, reason: str):
         """Mark the head-of-queue request as gate-deferred, ONCE per wait
@@ -744,6 +887,12 @@ class InferenceEngine:
             else:
                 self.mgr.allocate(req.req_id, prompt_len)
                 n_cached = 0
+            # full-cover COW admissions owe a tail re-prefill of KV another
+            # request already built: the ledger books it as cow_token rework.
+            # Set, not accumulated — a preemption re-admission must not leak
+            # a stale pending count into later spans
+            req.cow_pending = (prompt_len - n_cached
+                               if (match is not None and match[2] is not None) else 0)
             TRACER.instant("kv_alloc", cat="engine", trace=req.trace,
                            req_id=req.req_id, tokens=prompt_len,
                            cached_tokens=n_cached,
@@ -805,13 +954,33 @@ class InferenceEngine:
                 cached_lens[j] = n_cached
                 sampling[j] = req.sampling
             entries = [(j, req.prompt_ids, c) for j, (_, req, c) in enumerate(group)]
+            cached_total = int(cached_lens.sum())  # sync-ok: cached_lens is host numpy
             with TRACER.span("prefill", cat="engine", bucket=padded, batch=len(group),
                              step=self._cur_step,
                              req_ids=[r.req_id for _, r, _ in group],
-                             cached_tokens=int(cached_lens.sum())):  # sync-ok: cached_lens is host numpy
+                             cached_tokens=cached_total), \
+                    compile_attribution(self.ledger, "prefill"):
+                t_dev = time.perf_counter()
                 tokens = self.backend.prefill(
                     ids, tables, suffix_lens, entries, sampling,
                     [slot for slot, _, _ in group])
+                self._step_device_s += time.perf_counter() - t_dev
+            # goodput: fed = the padded launch geometry; useful = the uncached
+            # suffixes minus any re-fed (post-preemption/requeue/COW) positions
+            acct = self.backend.step_accounting
+            g_useful = g_rework = 0
+            g_by: Dict[str, int] = {}
+            for slot, req, n_cached in group:
+                n_fed = len(req.prompt_ids) - n_cached
+                rw, by = self._note_fed_span(req, n_cached, n_fed)
+                g_useful += n_fed - rw
+                g_rework += rw
+                self._merge_rework(g_by, by)
+            self.ledger.note_shape(acct["shape"])
+            self.ledger.record(
+                "prefill", acct["fed"], g_useful,
+                padding=acct["fed"] - g_useful - g_rework,
+                rework=g_rework, rework_by=g_by or None)
             for j, (slot, req, _) in enumerate(group):
                 req.prefilled_len = len(req.prompt_ids)
                 self._settle_sampled(slot, req, int(tokens[j]), finished)  # sync-ok: tokens already host (backend.prefill synced)
@@ -930,9 +1099,33 @@ class InferenceEngine:
         with TRACER.span("mixed_step", cat="engine", step=self._cur_step,
                          chunks=len(chunk_rows), decodes=len(decode_rows),
                          chunk_tokens=int(sum(n for _, _, n in chunk_rows)),
-                         req_ids=[r.req_id for _, r, _ in chunk_rows]):
+                         req_ids=[r.req_id for _, r, _ in chunk_rows]), \
+                compile_attribution(self.ledger, "mixed"):
+            t_dev = time.perf_counter()
             tokens = self.backend.mixed_step(chunk_payload, dec_payload)
+            self._step_device_s += time.perf_counter() - t_dev
         dur = time.perf_counter() - t0
+        # goodput accounting BEFORE settle mutates prefilled_len/total_len:
+        # chunk tokens + the one fed token per decode row are useful (minus
+        # re-fed positions); the padded launch remainder is padding
+        acct = self.backend.step_accounting
+        g_useful = g_rework = 0
+        g_by: Dict[str, int] = {}
+        for _slot, req, n in chunk_rows:
+            rw, by = self._note_fed_span(req, req.prefilled_len, n)
+            g_useful += n - rw
+            g_rework += rw
+            self._merge_rework(g_by, by)
+        for _slot, req in decode_rows:
+            rw, by = self._note_fed_span(req, req.total_len - 1, 1)
+            g_useful += 1 - rw
+            g_rework += rw
+            self._merge_rework(g_by, by)
+        self.ledger.note_shape(acct["shape"])
+        self.ledger.record(
+            "mixed", acct["fed"], g_useful,
+            padding=acct["fed"] - g_useful - g_rework,
+            rework=g_rework, rework_by=g_by or None)
         if chunk_rows:
             # every decode token in this step waited out the chunk work: the
             # step duration is each riding request's decode-stall share
@@ -1080,6 +1273,9 @@ class InferenceEngine:
         # a half-prefilled request's KV is gone with its blocks: re-admission
         # starts the chunk walk over (prefix-cache hits re-credit what they can)
         req.prefilled_len = 0
+        # from here on, re-fed positions are THIS preemption's recompute —
+        # even for a request that originally arrived as a supervisor requeue
+        req.rework_src = "preempt_refill"
         if self.staged:
             # any in-flight/deferred migration is moot: re-admission
             # re-prefills on the prefill stage and re-migrates
@@ -1130,16 +1326,24 @@ class InferenceEngine:
             tables[i] = self.mgr.table_array(req.req_id)
             start[i] = req.total_len - 1  # position of the token being fed
         with TRACER.span("spec_verify", cat="engine", mode=mode, step=self._cur_step,
-                         drafted=int(sum(len(d) for d in drafts))):
+                         drafted=int(sum(len(d) for d in drafts))), \
+                compile_attribution(self.ledger, "verify"):
             # greedy acceptance never reads the logits: need_logits=False keeps
             # the [B, K+1, V] fp32 buffer from materializing at all
+            t_dev = time.perf_counter()
             argmax, logits = self.backend.verify(
                 tokens, tables, start, need_logits=mode == "sample")
+            self._step_device_s += time.perf_counter() - t_dev
         self.spec_stats["verify_steps"] += 1
+        # goodput: drafted-but-rejected positions are the spec_rejected waste
+        # bucket; emitted (accepted + correction/bonus) positions are useful
+        g_acc0 = self.spec_stats["accepted"]
+        g_drafted = g_emitted = 0
         for i, req in enumerate(self.slots):
             if req is None:
                 continue
             d = drafts[i]
+            g_drafted += len(d)
             self.spec_stats["drafted"] += len(d)
             if mode == "sample":
                 with TRACER.span("sampling", cat="engine", trace=req.trace,
@@ -1156,8 +1360,11 @@ class InferenceEngine:
                 self._emit(req, int(tok))
                 self._last_token[i] = int(tok)
                 self.spec_stats["tokens_emitted"] += 1
+                g_emitted += 1
                 if req.done:
                     break
+            # the last emitted token was sampled, not fed: mark to total-1
+            req.fed_hwm = max(req.fed_hwm, req.total_len - 1)
             if req.done:
                 self._free_kv(req, cache=True)
                 self.slots[i] = None
@@ -1165,6 +1372,13 @@ class InferenceEngine:
             else:
                 # release the optimistic blocks past the accepted tokens
                 self.mgr.shrink(req.req_id, req.total_len)
+        g_rejected = g_drafted - (self.spec_stats["accepted"] - g_acc0)
+        acct = self.backend.step_accounting
+        self.ledger.note_shape(acct["shape"])
+        self.ledger.record(
+            "verify", acct["fed"], g_emitted,
+            padding=acct["fed"] - g_emitted - g_rejected,
+            spec_rejected=g_rejected)
 
     def _accept_rejection(self, slot: int, req, d: np.ndarray, logits_row: np.ndarray,
                           q: Optional[np.ndarray]) -> List[int]:
@@ -1252,17 +1466,33 @@ class InferenceEngine:
             done0[i] = False
             remaining[i] = req.remaining_new
         with TRACER.span("decode", cat="engine", steps=steps, step=self._cur_step,
-                         active=int(sum(1 for r in self.slots if r is not None))):
+                         active=int(sum(1 for r in self.slots if r is not None))), \
+                compile_attribution(self.ledger, "decode"):
             # ONE host transfer of ids + validity flags (no logits)
+            t_dev = time.perf_counter()
             toks, valid = self.backend.decode(
                 tokens, tables, ctx, done0, remaining,
                 [None if r is None else r.sampling for r in self.slots])
+            self._step_device_s += time.perf_counter() - t_dev
+        n_emitted = 0
         for s in range(toks.shape[0]):
             for i, req in enumerate(self.slots):
                 if req is None or req.done or not valid[s, i]:
                     continue
                 self._emit(req, int(toks[s, i]))  # sync-ok: toks already host (backend.decode synced)
                 self._last_token[i] = int(toks[s, i])  # sync-ok: toks already host (backend.decode synced)
+                n_emitted += 1
+        # goodput: the decode jit always burns B x decode_steps positions;
+        # every emitted token is one useful fed position, the rest (idle
+        # slots, post-EOS sub-steps, unconsumed budget) is padding
+        acct = self.backend.step_accounting
+        for req in self.slots:
+            if req is not None and req.kv_stage == "decode":
+                # the last emitted token was sampled, not fed: mark to total-1
+                req.fed_hwm = max(req.fed_hwm, req.total_len - 1)
+        self.ledger.note_shape(acct["shape"])
+        self.ledger.record("decode", acct["fed"], n_emitted,
+                           padding=acct["fed"] - n_emitted)
         for i, req in enumerate(self.slots):
             if req is None:
                 continue

@@ -20,6 +20,10 @@ All pieces stdlib-only at import time:
 - :mod:`.postmortem` — auto-dumped incident bundles (events + spans + health
   + metrics + config) behind ``PDNLP_TPU_POSTMORTEM_DIR`` and
   ``POST /debug/postmortem``; analyzed offline by ``tools/postmortem.py``.
+- :mod:`.goodput` — the per-step device-efficiency ledger (exact
+  ``fed == useful + padding + spec_rejected + rework`` conservation),
+  compile-cache telemetry, step anatomy and the serving MFU estimator behind
+  ``GET /debug/efficiency``.
 
 The metric registry itself lives in :mod:`paddlenlp_tpu.serving.metrics`
 (predates this package; its names are stable API) — this package is the
@@ -29,6 +33,12 @@ tracing/exposition layer around it.
 from .event_catalog import EVENT_CATALOG, EVENT_REASONS  # noqa: F401
 from .exporter import ObservabilityExporter, ProfileCapture  # noqa: F401
 from .flight_recorder import RECORDER, FlightEvent, FlightRecorder  # noqa: F401
+from .goodput import (  # noqa: F401
+    GoodputLedger,
+    device_peak_flops,
+    efficiency_doc,
+    estimate_model_flops_per_token,
+)
 from .postmortem import PostmortemDumper, handle_postmortem_request  # noqa: F401
 from .prometheus import (  # noqa: F401
     MetricFamily,
@@ -75,4 +85,8 @@ __all__ = [
     "RECORDER",
     "PostmortemDumper",
     "handle_postmortem_request",
+    "GoodputLedger",
+    "efficiency_doc",
+    "estimate_model_flops_per_token",
+    "device_peak_flops",
 ]

@@ -8,6 +8,9 @@ read-only so it can ride inside ``Trainer`` without touching the step loop:
     GET  /health         liveness JSON (+ caller-provided stats)
     GET  /debug/trace    span ring buffer as Chrome trace-event JSON (Perfetto)
     GET  /debug/spans    span ring buffer as structured JSONL
+    GET  /debug/efficiency  efficiency/goodput doc (caller-provided
+                         ``efficiency_fn``; default = the process compile
+                         counters, so training jobs answer the endpoint too)
     POST /debug/profile  on-demand jax.profiler capture (?seconds=S; 409 while
                          another capture runs — the profiler is process-global)
     POST /debug/postmortem  force a postmortem bundle dump; returns its path
@@ -193,10 +196,12 @@ class ObservabilityExporter:
     def __init__(self, registry=None, tracer: Optional[SpanTracer] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
                  profile: Optional[ProfileCapture] = None,
-                 postmortem=None):
+                 postmortem=None,
+                 efficiency_fn: Optional[Callable[[], Dict]] = None):
         if registry is None:
             from ..serving.metrics import REGISTRY as registry  # stdlib-only module
         self.registry = registry
+        self.efficiency_fn = efficiency_fn
         # explicit None check: SpanTracer defines __len__, so an EMPTY tracer
         # passed here is falsy and `tracer or TRACER` would silently serve
         # the process-wide ring instead of the caller's
@@ -215,6 +220,24 @@ class ObservabilityExporter:
     @property
     def port(self) -> Optional[int]:
         return self._httpd.server_address[1] if self._httpd is not None else None
+
+    def efficiency(self) -> Dict:
+        """``GET /debug/efficiency`` for this plane: the caller-provided doc
+        (a serving process passes its engine's), else a training-tier default
+        carrying the process compile counters — every plane answers the
+        route, even ones without a goodput ledger."""
+        if self.efficiency_fn is not None:
+            return self.efficiency_fn()
+        doc: Dict = {"tier": "training", "ledger": None}
+        for key, name in (("compiles", "jax_jit_compile_total"),
+                          ("compile_seconds", "jax_jit_compile_seconds_total")):
+            metric = self.registry.get(name)
+            if metric is not None:
+                try:
+                    doc[key] = metric.value()
+                except Exception:
+                    pass
+        return doc
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Bind + serve in the background; returns the bound port."""
@@ -246,6 +269,10 @@ class ObservabilityExporter:
                         if exporter.health_fn is not None:
                             payload.update(exporter.health_fn())
                         self._send(200, json.dumps(payload, default=str).encode(),
+                                   "application/json")
+                    elif self.path == "/debug/efficiency":
+                        self._send(200,
+                                   json.dumps(exporter.efficiency(), default=str).encode(),
                                    "application/json")
                     else:
                         self._send(404, json.dumps({"error": f"no route {self.path}"}).encode(),

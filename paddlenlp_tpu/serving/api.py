@@ -17,6 +17,8 @@ Routes::
     GET  /metrics          Prometheus text exposition
     GET  /health           liveness + scheduler/engine stats + tracer clock
     GET  /debug/requests   in-flight + recently finished request timelines
+    GET  /debug/efficiency goodput ledger + step anatomy + compile telemetry
+                           (what fraction of each device step was useful work)
     GET  /debug/trace      span ring buffer as Chrome trace JSON (Perfetto)
     GET  /debug/spans      span ring buffer as structured JSONL
     POST /debug/profile    on-demand jax.profiler capture (?seconds=S; 409
@@ -228,6 +230,17 @@ class ServingServer:
         self.scheduler.start_drain()
         return {"draining": True, "retry_after_s": self._drain_retry_after}
 
+    def efficiency(self) -> dict:
+        """The ``GET /debug/efficiency`` document: the live engine's goodput
+        ledger + step anatomy (the loop swaps engines on rebuild, so this
+        always reads through ``loop.engine``). Engines without a ledger
+        (stand-ins) report a minimal doc instead of a 500."""
+        engine = self.loop.engine
+        eff = getattr(engine, "efficiency", None)
+        doc = eff() if eff is not None else {"tier": "serving", "ledger": None}
+        doc["engine_state"] = self.loop.state
+        return doc
+
     def _apply_brownout_level(self, level: int):
         """Brownout ladder side effects on the live engine: level >= 2
         disables speculative decode (spend device time on committed tokens
@@ -317,6 +330,8 @@ class ServingServer:
                             "inflight": server.loop.inflight_info(),
                             "recent": list(server.loop.recent_finished),
                         })
+                    elif self.path == "/debug/efficiency":
+                        self._send_json(200, server.efficiency())
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")
                 except (BrokenPipeError, ConnectionResetError):

@@ -57,6 +57,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from ..observability.flight_recorder import RECORDER
+from ..observability.goodput import WASTE_KINDS
 from ..observability.postmortem import PostmortemDumper
 from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
@@ -205,6 +206,10 @@ class RequestHandle:
         self._retry_prefix: List[int] = []  # tokens emitted before the last rebuild
         self._prompt_ids: Optional[List[int]] = None
         self._sampling = None
+        # prompt tokens the dying engine had already prefilled when this
+        # handle was stashed (goodput: a zero-streamed requeue's re-prefill
+        # of those positions is rework, not useful — captured at triage)
+        self._prefilled_hint = 0
 
     # ------------------------------------------------------------- futures
     def done(self) -> bool:
@@ -289,8 +294,9 @@ class ServingMetrics:
     def __init__(self, engine, registry: Optional[MetricsRegistry] = None):
         self.registry = r = registry or REGISTRY
         self.requests = r.counter(
-            "paddlenlp_serving_requests_total", "Finished requests by terminal state",
-            labelnames=("status",))
+            "paddlenlp_serving_requests_total",
+            "Finished requests by terminal state and serving priority class",
+            labelnames=("status", "priority"))
         self.tokens = r.counter(
             "paddlenlp_serving_tokens_generated_total", "Generated tokens (all requests)")
         self.preemptions = r.counter(
@@ -309,8 +315,9 @@ class ServingMetrics:
             "paddlenlp_serving_requests_shed_total",
             "Submissions rejected on arrival by overload controls, by reason "
             "(shed = brownout priority shed; deadline = queue-wait estimate "
-            "already blew the request's deadline_ms)",
-            labelnames=("reason",))
+            "already blew the request's deadline_ms) and priority class — "
+            "the per-class view of the brownout ladder's shed order",
+            labelnames=("reason", "priority"))
         self.brownout_level = r.gauge(
             "paddlenlp_serving_brownout_level",
             "Current overload-brownout ladder level (0 normal, 1 shed "
@@ -393,6 +400,63 @@ class ServingMetrics:
             "paddlenlp_serving_mesh_axis_size",
             "Device-mesh axis degree of the sharded serving backend, per named axis",
             labelnames=("axis",))
+        # ---- goodput ledger (observability/goodput.py): per-step device-
+        # efficiency accounting with the exact conservation invariant
+        # fed == useful + padding + spec_rejected + rework
+        self.fed_tokens = r.counter(
+            "paddlenlp_serving_fed_tokens_total",
+            "Token positions the device step programs processed (padded "
+            "launch geometry, the goodput denominator)")
+        self.useful_tokens = r.counter(
+            "paddlenlp_serving_useful_tokens_total",
+            "Fed positions that built new KV or emitted a kept token "
+            "(the goodput numerator)")
+        self.wasted_tokens = r.counter(
+            "paddlenlp_serving_wasted_tokens_total",
+            "Non-useful fed positions by waste kind (padding = bucket pads + "
+            "dead rows + idle decode slots; spec_rejected = drafted-rejected "
+            "speculative positions; rework = re-fed positions after "
+            "preemption/requeue, COW tails, migration re-seeds)",
+            labelnames=("kind",))
+        self.goodput_ratio = r.gauge(
+            "paddlenlp_serving_goodput_ratio",
+            "Lifetime useful/fed token ratio of the engine's device steps")
+        self.serving_mfu = r.gauge(
+            "paddlenlp_serving_mfu",
+            "Estimated model-FLOPs utilization of the serving engine "
+            "(useful tokens * flops-per-token / wall / device peak; NaN off-TPU)")
+        self.step_gap = r.histogram(
+            "paddlenlp_serving_step_gap_seconds",
+            "Host gap between consecutive busy engine steps (loop overhead: "
+            "command drain, deadlines, metrics) — the host-bound half of "
+            "step-time anatomy",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0))
+        self.compiles = r.counter(
+            "paddlenlp_serving_compiles_total",
+            "XLA backend compilations attributed to a serving step program "
+            "(jax.monitoring, per program that triggered the trace)",
+            labelnames=("program",))
+        self.compile_seconds = r.counter(
+            "paddlenlp_serving_compile_seconds_total",
+            "Seconds spent in XLA compilation attributed per serving step program",
+            labelnames=("program",))
+        self.shape_buckets = r.gauge(
+            "paddlenlp_serving_jit_shape_buckets",
+            "Distinct jit launch geometries seen by the engine (live "
+            "shape-bucket cardinality — growth without bound is a retrace storm)")
+        self.kv_fragmentation = r.gauge(
+            "paddlenlp_serving_kv_fragmentation",
+            "Internal fragmentation of allocated KV blocks "
+            "(1 - held tokens / (held blocks * block_size))")
+        # spec-decode acceptance as first-class counters (the rate gauge's
+        # inputs, and the ledger's spec_rejected bucket = drafted - accepted)
+        self.spec_drafted = r.counter(
+            "paddlenlp_serving_spec_drafted_tokens_total",
+            "Speculative tokens proposed (n-gram or draft-model) for verification")
+        self.spec_accepted = r.counter(
+            "paddlenlp_serving_spec_accepted_tokens_total",
+            "Speculative tokens accepted by the verify forward")
         self.rebind(engine)
 
     def rebind(self, engine):
@@ -411,6 +475,18 @@ class ServingMetrics:
         self.spec_accept.set_function(
             lambda: engine.spec_stats["accepted"] / max(engine.spec_stats["drafted"], 1))
         self.kv_cached.set_function(lambda: getattr(mgr, "num_cached_blocks", 0))
+        # goodput pull gauges ride the engine's ledger (stand-in engines
+        # without one read as idle: ratio 1.0, NaN MFU, zero cardinality)
+        ledger = getattr(engine, "ledger", None)
+        self.goodput_ratio.set_function(
+            lambda: ledger.ratio() if ledger is not None else 1.0)
+        self.serving_mfu.set_function(
+            lambda: ledger.mfu() if ledger is not None else float("nan"))
+        self.shape_buckets.set_function(
+            lambda: len(ledger.shape_buckets) if ledger is not None else 0)
+        self.kv_fragmentation.set_function(
+            lambda: engine.kv_fragmentation()
+            if hasattr(engine, "kv_fragmentation") else 0.0)
         # mesh placement is static per engine: stamped once per (re)bind, not
         # pulled per scrape — a rebuilt engine may come up on a new layout, so
         # axes the new engine doesn't report drop back to degree 1 (a label
@@ -444,10 +520,20 @@ class ServingMetrics:
             [s for s, _ in getattr(engine, "recent_chunk_sizes", ())]
             + [s for s, _ in getattr(engine, "recent_decode_stalls", ())]
             + [0])
+        # goodput counters are deltas off the ledger's monotone totals; same
+        # rebaseline-on-rebind contract as the prefix-cache/migration deltas
+        self._gp_last = dict(ledger.totals) if ledger is not None else {}
+        self._compile_last = dict(ledger.compiles) if ledger is not None else {}
+        self._compile_s_last = dict(ledger.compile_seconds) if ledger is not None else {}
+        self._spec_last = dict(getattr(engine, "spec_stats", None)
+                               or {"drafted": 0, "accepted": 0})
+        self._step_time_seen = max(
+            [s for s, *_ in getattr(engine, "recent_step_times", ())] + [0])
 
     def on_finished(self, req):
         status = req.finish_reason or ("abort" if req.aborted else "unknown")
-        self.requests.inc(status=status)
+        self.requests.inc(status=status,
+                          priority=getattr(req, "priority", "interactive"))
         self.tokens.inc(len(req.output_ids))
         if req.ttft is not None:
             self.ttft.observe(req.ttft)
@@ -485,6 +571,48 @@ class ServingMetrics:
                 if seq > seen:
                     self.decode_stall.observe(dur)
                     self._chunk_seq_seen = max(self._chunk_seq_seen, seq)
+        gp = stats.get("goodput")
+        if gp:
+            totals = gp.get("totals", {})
+            delta_fed = totals.get("fed", 0) - self._gp_last.get("fed", 0)
+            if delta_fed > 0:
+                self.fed_tokens.inc(delta_fed)
+            delta_useful = totals.get("useful", 0) - self._gp_last.get("useful", 0)
+            if delta_useful > 0:
+                self.useful_tokens.inc(delta_useful)
+            for kind in WASTE_KINDS:
+                delta = totals.get(kind, 0) - self._gp_last.get(kind, 0)
+                if delta > 0:
+                    self.wasted_tokens.inc(delta, kind=kind)
+            self._gp_last = dict(totals)
+            for program, n in gp.get("compiles", {}).items():
+                delta = n - self._compile_last.get(program, 0)
+                if delta > 0:
+                    self.compiles.inc(delta, program=program)
+                self._compile_last[program] = n
+            for program, secs in gp.get("compile_seconds", {}).items():
+                delta = secs - self._compile_s_last.get(program, 0.0)
+                if delta > 0:
+                    self.compile_seconds.inc(delta, program=program)
+                self._compile_s_last[program] = secs
+            # step-gap observations from the engine's bounded event ring
+            # (loop thread, the only writer — the chunk-ring contract); gaps
+            # marked unmeasured (< 0: first/post-idle steps) are skipped
+            seen = self._step_time_seen
+            for seq, gap_s, _dev, _host in getattr(self._engine,
+                                                   "recent_step_times", ()):
+                if seq > seen:
+                    if gap_s >= 0:
+                        self.step_gap.observe(gap_s)
+                    self._step_time_seen = max(self._step_time_seen, seq)
+        sp = stats.get("spec_stats")
+        if sp:
+            for key, counter in (("drafted", self.spec_drafted),
+                                 ("accepted", self.spec_accepted)):
+                delta = sp.get(key, 0) - self._spec_last.get(key, 0)
+                if delta > 0:
+                    counter.inc(delta)
+                self._spec_last[key] = sp.get(key, 0)
         dg = stats.get("disagg")
         if dg:
             for stage in ("prefill", "decode"):
@@ -901,6 +1029,7 @@ class EngineLoop:
             )
             if retryable:
                 handle.retries += 1
+                handle._prefilled_hint = self._prefilled_len_of(handle.req_id)
                 self.metrics.request_retries.inc()
                 self._requeue.append(handle)
             else:
@@ -910,11 +1039,25 @@ class EngineLoop:
         self._last_token_t.clear()
         return n_failed
 
+    def _prefilled_len_of(self, req_id) -> int:
+        """How many prompt tokens the (possibly poisoned) engine had already
+        prefilled for ``req_id`` — read defensively at triage time so the
+        requeue's goodput hint covers partial chunk walks too. 0 on any
+        stand-in engine without the scheduler surface."""
+        try:
+            for r in list(self.engine.slots):
+                if r is not None and r.req_id == req_id:
+                    return int(getattr(r, "prefilled_len", 0))
+        except Exception:
+            pass
+        return 0
+
     def _resolve_failed(self, handle: RequestHandle, streamed: List[int],
                         finish_reason: str = "engine_error"):
         req = _FailedRequest(handle.req_id, handle._prompt_ids or [], streamed,
                              handle.trace, handle.submitted_t, finish_reason=finish_reason)
         req.aborted = finish_reason == "abort"
+        req.priority = handle.priority  # requests_total{priority} label
         if handle._first_token_t is not None:
             req.first_token_t = handle._first_token_t
             req.ttft = handle._first_token_t - req.arrival_t
@@ -941,9 +1084,17 @@ class EngineLoop:
                     sampling, max_new_tokens=sampling.max_new_tokens - len(streamed))
             handle._retry_prefix = streamed
             stream_cb = self._make_stream_cb(handle)
+            # goodput: a requeue with streamed tokens re-prefills a prompt the
+            # dead engine had fully processed (all but the final sampled
+            # token); a zero-streamed requeue may still have been mid-chunk-
+            # walk — either way the re-fed span is requeue_refill rework,
+            # never useful a second time
+            rework_hwm = (len(prompt) - 1 if streamed
+                          else min(handle._prefilled_hint, len(prompt)))
             try:
                 handle.req_id = self._add_to_engine(handle, prompt, sampling,
-                                                    stream_cb)
+                                                    stream_cb,
+                                                    rework_hwm=rework_hwm)
             except Exception as e:
                 # the rebuilt engine rejected the requeue: fail THIS request
                 # rather than losing it (a poisoned engine will re-trip the
@@ -1007,15 +1158,26 @@ class EngineLoop:
                 self._abort_handle(handle)
 
     def _add_to_engine(self, handle: RequestHandle, prompt_ids, sampling,
-                       stream_cb) -> int:
-        """One engine submission. ``priority`` is forwarded only when it is
-        non-default so engine stand-ins (chaos-test stubs, older backends)
-        with the narrower ``add_request`` signature keep working."""
+                       stream_cb, rework_hwm: int = 0) -> int:
+        """One engine submission. ``priority`` / ``rework_hwm`` are forwarded
+        only when non-default so engine stand-ins (chaos-test stubs, older
+        backends) with the narrower ``add_request`` signature keep working."""
         kw = {}
         if handle.priority != "interactive":
             kw["priority"] = handle.priority
-        return self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb,
-                                       trace=handle.trace, **kw)
+        if rework_hwm > 0:
+            kw["rework_hwm"] = rework_hwm
+        try:
+            return self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb,
+                                           trace=handle.trace, **kw)
+        except TypeError:
+            if "rework_hwm" not in kw:
+                raise
+            # engine stand-in without the goodput kwarg: the accounting hint
+            # is best-effort, the resubmission is not
+            kw.pop("rework_hwm")
+            return self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb,
+                                           trace=handle.trace, **kw)
 
     def _engine_backlog(self) -> int:
         """Requests ahead of a new arrival: engine waiting queue + running

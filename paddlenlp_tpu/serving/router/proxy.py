@@ -88,6 +88,7 @@ from urllib.parse import parse_qs, quote, unquote, urlsplit
 
 from ...observability.exporter import route_observability
 from ...observability.flight_recorder import RECORDER
+from ...observability.goodput import WASTE_KINDS
 from ...observability.postmortem import PostmortemDumper, handle_postmortem_request
 from ...observability.slo import (
     DEFAULT_WINDOWS_S,
@@ -579,6 +580,9 @@ class RouterServer:
                     if parts.path == "/fleet/slo":
                         self._send_json(200, router.fleet_slo())
                         return
+                    if parts.path == "/debug/efficiency":
+                        self._send_json(200, router.fleet_efficiency())
+                        return
                     if parts.path == "/replicas":
                         self._send_json(200, router.admin_list_replicas())
                         return
@@ -852,7 +856,94 @@ class RouterServer:
             # different pools — surface both pressures in the SLO view so an
             # operator sees WHICH stage is burning budget
             report["stages"] = stages
+        goodput = self._fold_goodput_series(parsed)
+        if goodput:
+            # per-replica device efficiency in the same fleet view the
+            # autoscaler and on-call dashboards already scrape: an SLO burn
+            # with a healthy goodput is a capacity problem; one with a
+            # collapsing goodput is a padding/retrace/waste problem
+            report["goodput"] = goodput
         return report
+
+    @staticmethod
+    def _fold_goodput_series(parsed: Dict[str, Dict]) -> Dict:
+        """Fleet fold of the goodput-ledger counters each replica exports:
+        per-replica useful/fed ratio + the fleet-wide waste decomposition.
+        Empty when no replica exposes the ledger series (mixed-version
+        fleets degrade to the old report shape)."""
+        per_replica: Dict[str, Dict] = {}
+        fleet_fed = fleet_useful = 0.0
+        wasted: Dict[str, float] = {}
+        for rid, fams in parsed.items():
+            fed_fam = fams.get("paddlenlp_serving_fed_tokens_total")
+            if fed_fam is None:
+                continue
+            fed = fed_fam.value() or 0.0
+            useful_fam = fams.get("paddlenlp_serving_useful_tokens_total")
+            useful = (useful_fam.value() or 0.0) if useful_fam is not None else 0.0
+            per_replica[rid] = {
+                "fed_tokens": fed,
+                "useful_tokens": useful,
+                "goodput_ratio": round(useful / fed, 6) if fed else 1.0,
+            }
+            fleet_fed += fed
+            fleet_useful += useful
+            waste_fam = fams.get("paddlenlp_serving_wasted_tokens_total")
+            if waste_fam is not None:
+                for (_sample, labels), v in waste_fam.samples.items():
+                    kind = dict(labels).get("kind")
+                    if kind:
+                        wasted[kind] = wasted.get(kind, 0.0) + v
+        if not per_replica:
+            return {}
+        return {
+            "replicas": per_replica,
+            "fleet": {
+                "fed_tokens": fleet_fed,
+                "useful_tokens": fleet_useful,
+                "goodput_ratio": round(fleet_useful / fleet_fed, 6) if fleet_fed else 1.0,
+                "wasted_tokens": {k: wasted[k] for k in sorted(wasted)},
+            },
+        }
+
+    def fleet_efficiency(self) -> Dict:
+        """Router-tier ``GET /debug/efficiency``: every live replica's
+        efficiency doc plus a fed-token-weighted fleet goodput summary. A
+        replica that fails the scrape is listed in ``skipped`` — the fold
+        degrades, it never 500s (the /fleet/metrics contract)."""
+        docs: Dict[str, Dict] = {}
+        skipped: List[str] = []
+        for snap in self.pool.snapshots():
+            if snap.state == DOWN:
+                skipped.append(snap.id)
+                continue
+            try:
+                docs[snap.id] = json.loads(
+                    self._scrape_replica(snap, "/debug/efficiency"))
+            except Exception as e:
+                logger.warning(
+                    f"router: efficiency scrape of {snap.id} failed: {e!r}")
+                skipped.append(snap.id)
+        fed = useful = 0
+        wasted: Dict[str, int] = {}
+        for doc in docs.values():
+            totals = ((doc.get("ledger") or {}).get("totals")) or {}
+            fed += totals.get("fed", 0)
+            useful += totals.get("useful", 0)
+            for kind in WASTE_KINDS:
+                if totals.get(kind):
+                    wasted[kind] = wasted.get(kind, 0) + totals[kind]
+        return {
+            "tier": "router",
+            "replicas": docs,
+            "skipped": skipped,
+            "fleet": {
+                "fed_tokens": fed,
+                "useful_tokens": useful,
+                "goodput_ratio": round(useful / fed, 6) if fed else 1.0,
+                "wasted_tokens": wasted,
+            },
+        }
 
     @staticmethod
     def _fold_stage_series(parsed: Dict[str, Dict]) -> Dict:

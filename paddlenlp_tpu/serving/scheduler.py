@@ -191,7 +191,7 @@ class Scheduler:
         if self.brownout.should_shed(priority):
             self.rejected_shed += 1
             _F_SHED.fire(priority=priority)
-            self.loop.metrics.shed.inc(reason="shed")
+            self.loop.metrics.shed.inc(reason="shed", priority=priority)
             retry_after = self.loop.queue_wait_estimate()
             RECORDER.record("sched.reject", trace=trace, reason="shed",
                             level=level)
@@ -205,7 +205,7 @@ class Scheduler:
             estimate = self.loop.queue_wait_estimate()
             if estimate > deadline_s:
                 self.rejected_deadline += 1
-                self.loop.metrics.shed.inc(reason="deadline")
+                self.loop.metrics.shed.inc(reason="deadline", priority=priority)
                 RECORDER.record("sched.reject", trace=trace, reason="deadline",
                                 estimate_s=round(estimate, 4))
                 TRACER.instant("admission_rejected", cat="scheduler",

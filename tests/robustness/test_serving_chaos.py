@@ -203,8 +203,15 @@ class TestServingRecovery:
 
             assert metric_value("paddlenlp_serving_engine_restarts_total") >= 1
             assert metric_value("paddlenlp_serving_request_retries_total") >= n_stream
-            assert 'paddlenlp_serving_requests_total{status="engine_error"}' in text
-            assert 'paddlenlp_serving_requests_total{status="length"}' in text
+            # goodput ledger: the requeue re-prefill of already-streamed work
+            # on the rebuilt engine is booked as rework, and the rebuilt
+            # engine's ledger stays exactly conserved through the incident
+            assert metric_value(
+                'paddlenlp_serving_wasted_tokens_total{kind="rework"}') >= 1
+            assert srv.loop.engine.ledger.verify_conservation()
+            assert srv.loop.engine.ledger.rework_by["requeue_refill"] >= 1
+            assert 'paddlenlp_serving_requests_total{status="engine_error",priority="interactive"}' in text
+            assert 'paddlenlp_serving_requests_total{status="length",priority="interactive"}' in text
 
             # ---- post-recovery health + fresh traffic ----
             status, health, _ = get_json(port, "/health")

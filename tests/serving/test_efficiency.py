@@ -1,0 +1,209 @@
+"""``GET /debug/efficiency`` + goodput federation round-trip (ISSUE 15).
+
+The replica serves its engine's goodput ledger; the router folds every
+replica's doc plus per-replica goodput into ``/fleet/slo`` and federates the
+new counter families through ``/fleet/metrics``; the training exporter
+answers the same route with its compile counters. Also covers the
+``priority`` label satellite on ``requests_total``/``requests_shed_total``."""
+
+import http.client
+import json
+
+import pytest
+
+from paddlenlp_tpu.observability import parse_prometheus_text
+from paddlenlp_tpu.observability.exporter import ObservabilityExporter
+from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, ServingServer
+from paddlenlp_tpu.serving.metrics import MetricsRegistry as _MR  # noqa: F401
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine_factory(model):
+    from paddlenlp_tpu.experimental import InferenceEngine
+
+    def make_engine():
+        return InferenceEngine(model, max_batch_size=4, block_size=4,
+                               num_blocks=128, max_blocks_per_seq=32,
+                               decode_steps=4)
+    return make_engine
+
+
+def get_json(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def get_text(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def post_completion(port, payload, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+class TestReplicaEndpoint:
+    @pytest.fixture(scope="class")
+    def server(self, model):
+        srv = ServingServer(make_engine_factory(model)(),
+                            registry=MetricsRegistry(),
+                            scheduler_config=SchedulerConfig(max_inflight=8))
+        port = srv.start_in_thread()
+        yield srv, port
+        srv.shutdown(drain_timeout_s=5)
+
+    def test_efficiency_doc_after_traffic(self, server):
+        srv, port = server
+        status, _ = post_completion(
+            port, {"prompt": [5, 6, 7], "max_tokens": 4, "priority": "batch"})
+        assert status == 200
+        status, doc = get_json(port, "/debug/efficiency")
+        assert status == 200
+        assert doc["tier"] == "serving" and doc["engine_state"] == "running"
+        totals = doc["ledger"]["totals"]
+        assert totals["fed"] == sum(
+            (totals[k] for k in ("useful", "padding", "spec_rejected", "rework")))
+        assert totals["useful"] > 0
+        assert doc["mfu"] is None  # CPU run must not fake an MFU
+        assert 0.0 < doc["goodput_ratio"] <= 1.0
+        assert doc["step_anatomy"]["window_steps"] >= 1
+        assert doc["ledger"]["compiles"].get("prefill", 0) >= 1
+        json.dumps(doc)  # strictly serializable end to end
+
+    def test_metrics_carry_ledger_and_priority_labels(self, server):
+        srv, port = server
+        status, text = get_text(port, "/metrics")
+        assert status == 200
+        fams = parse_prometheus_text(text)
+        fed = fams["paddlenlp_serving_fed_tokens_total"].value()
+        useful = fams["paddlenlp_serving_useful_tokens_total"].value()
+        assert fed > 0 and 0 < useful <= fed
+        waste = sum(
+            v for (_s, labels), v in
+            fams["paddlenlp_serving_wasted_tokens_total"].samples.items())
+        assert fed == useful + waste  # conservation survives the metrics hop
+        assert fams["paddlenlp_serving_goodput_ratio"].value() == \
+            pytest.approx(useful / fed)
+        # the batch-priority request is visible per class (PR-14 brownout
+        # ladder observability satellite)
+        assert fams["paddlenlp_serving_requests_total"].value(
+            status="length", priority="batch") >= 1
+        assert "paddlenlp_serving_step_gap_seconds_bucket" in text
+        assert "paddlenlp_serving_jit_shape_buckets" in text
+
+    def test_shed_counter_labeled_by_priority(self, server):
+        srv, port = server
+        srv.scheduler.brownout.push(1, reason="slo_fast_burn", ttl_s=30.0)
+        try:
+            status, body = post_completion(
+                port, {"prompt": [5, 6, 7], "max_tokens": 4,
+                       "priority": "best_effort"})
+            assert status == 503
+            assert body["error"]["type"] == "overloaded_shed"
+            assert srv.loop.metrics.shed.value(
+                reason="shed", priority="best_effort") >= 1
+        finally:
+            srv.scheduler.brownout.push(0, reason="slo_fast_burn")
+
+
+class TestFleetRoundTrip:
+    @pytest.fixture(scope="class")
+    def fleet(self, model):
+        from paddlenlp_tpu.serving.router import launch_fleet
+
+        fleet = launch_fleet(2, make_engine_factory(model), poll_interval_s=0.2)
+        for i in range(6):
+            status, _ = post_completion(
+                fleet.router_port,
+                {"prompt": [30 + i, 6, 7], "max_tokens": 4})
+            assert status == 200
+        yield fleet
+        fleet.shutdown(drain_timeout_s=5)
+
+    def test_router_folds_replica_docs(self, fleet):
+        status, doc = get_json(fleet.router_port, "/debug/efficiency")
+        assert status == 200
+        assert doc["tier"] == "router" and doc["skipped"] == []
+        assert len(doc["replicas"]) == 2
+        fed = useful = 0
+        for rid, rdoc in doc["replicas"].items():
+            totals = rdoc["ledger"]["totals"]
+            assert totals["fed"] >= totals["useful"]
+            fed += totals["fed"]
+            useful += totals["useful"]
+        assert doc["fleet"]["fed_tokens"] == fed
+        assert doc["fleet"]["useful_tokens"] == useful
+        assert doc["fleet"]["goodput_ratio"] == pytest.approx(
+            useful / fed) if fed else True
+
+    def test_fleet_slo_carries_goodput_fold(self, fleet):
+        status, doc = get_json(fleet.router_port, "/fleet/slo")
+        assert status == 200
+        gp = doc["goodput"]
+        assert set(gp["replicas"]) == set(doc["replicas"])
+        for rdoc in gp["replicas"].values():
+            assert 0.0 < rdoc["goodput_ratio"] <= 1.0
+        assert gp["fleet"]["fed_tokens"] == sum(
+            r["fed_tokens"] for r in gp["replicas"].values())
+        assert "padding" in gp["fleet"]["wasted_tokens"]
+
+    def test_fleet_metrics_federate_ledger_series(self, fleet):
+        status, text = get_text(fleet.router_port, "/fleet/metrics")
+        assert status == 200
+        fams = parse_prometheus_text(text)
+        fed_fam = fams["paddlenlp_serving_fed_tokens_total"]
+        replicas = {dict(labels)["replica"]
+                    for (_s, labels), _v in fed_fam.samples.items()}
+        assert len(replicas) == 2  # one series per replica, re-labeled
+
+
+class TestTrainingExporter:
+    def test_exporter_answers_efficiency(self):
+        registry = MetricsRegistry()
+        registry.counter("jax_jit_compile_total", "compiles").inc(3)
+        exp = ObservabilityExporter(registry=registry)
+        port = exp.start()
+        try:
+            status, doc = get_json(port, "/debug/efficiency")
+            assert status == 200
+            assert doc["tier"] == "training" and doc["ledger"] is None
+            assert doc["compiles"] == 3
+        finally:
+            exp.shutdown()
+
+    def test_exporter_efficiency_fn_override(self):
+        exp = ObservabilityExporter(registry=MetricsRegistry(),
+                                    efficiency_fn=lambda: {"tier": "custom", "x": 1})
+        port = exp.start()
+        try:
+            status, doc = get_json(port, "/debug/efficiency")
+            assert status == 200 and doc == {"tier": "custom", "x": 1}
+        finally:
+            exp.shutdown()

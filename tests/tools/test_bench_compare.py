@@ -1,0 +1,138 @@
+"""tools/bench_compare.py: the bench perf-regression gate (ISSUE 15).
+
+Drives the pure ``compare()`` core on synthetic bench records (a tier-1 run
+cannot afford two real bench runs) and the CLI contract (rc 0 pass / rc 1
+regression / rc 2 usage) through a subprocess. The committed
+``tools/BENCH_BASELINE.json`` must itself be a loadable, self-consistent
+record — the gate's default baseline cannot be allowed to rot."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from tools.bench_compare import DEFAULT_BASELINE, compare, load_record  # noqa: E402
+
+TOOL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "..", "tools", "bench_compare.py")
+
+
+def record(value=4.0, tps=50.0, ttft=2000.0, inter=30.0, ratio=0.45,
+           wasted=None, compiles=30, gap=1.0):
+    return {
+        "metric": "serve_smoke_requests_per_sec",
+        "value": value,
+        "tokens_per_sec": tps,
+        "p99_ttft_ms": ttft,
+        "p99_inter_token_ms": inter,
+        "goodput": {
+            "ratio": ratio,
+            "fed_tokens": 400,
+            "useful_tokens": int(400 * ratio),
+            "wasted_tokens": wasted if wasted is not None
+            else {"padding": 400 - int(400 * ratio)},
+            "compiles": compiles,
+            "step_gap_p99_ms": gap,
+        },
+    }
+
+
+class TestCompareCore:
+    def test_identical_records_pass(self):
+        regs, skipped, compared = compare(record(), record())
+        assert regs == [] and skipped == [] and compared == 8
+
+    def test_throughput_collapse_fails(self):
+        regs, _s, _c = compare(record(value=1.0, tps=10.0), record())
+        fields = {r["field"] for r in regs}
+        assert {"value", "tokens_per_sec"} <= fields
+
+    def test_goodput_ratio_drop_fails_even_with_good_latency(self):
+        # the deterministic gate: padding doubled, wall-clock unchanged
+        regs, _s, _c = compare(record(ratio=0.20), record(ratio=0.45))
+        assert [r["field"] for r in regs] == ["goodput.ratio", "goodput.waste_share"]
+
+    def test_compile_storm_fails(self):
+        regs, _s, _c = compare(record(compiles=200), record(compiles=30))
+        assert [r["field"] for r in regs] == ["goodput.compiles"]
+
+    def test_latency_band_has_absolute_slack(self):
+        # a 1ms -> 40ms step-gap move is scheduler noise, not a regression
+        regs, _s, _c = compare(record(gap=40.0), record(gap=1.0))
+        assert regs == []
+        regs, _s, _c = compare(record(gap=80.0), record(gap=1.0))
+        assert [r["field"] for r in regs] == ["goodput.step_gap_p99_ms"]
+
+    def test_missing_fields_skip_not_fail(self):
+        cand = record()
+        del cand["goodput"]
+        regs, skipped, compared = compare(cand, record())
+        assert regs == []
+        assert "goodput.ratio" in skipped and "goodput.compiles" in skipped
+        assert compared == 4
+
+    def test_tolerances_are_tunable(self):
+        regs, _s, _c = compare(record(value=2.5), record(value=4.0),
+                               min_throughput_ratio=0.9)
+        assert [r["field"] for r in regs] == ["value"]
+
+
+class TestCommittedBaseline:
+    def test_baseline_loads_and_self_compares_clean(self):
+        base = load_record(DEFAULT_BASELINE)
+        assert base.get("error") is None
+        assert base["goodput"]["fed_tokens"] >= base["goodput"]["useful_tokens"]
+        regs, _s, compared = compare(base, base)
+        assert regs == [] and compared == 8
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run([sys.executable, TOOL, *args],
+                              capture_output=True, text=True, timeout=60)
+
+    def test_pass_and_regress_and_usage(self, tmp_path):
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(record()) + "\n")
+        good = tmp_path / "good.json"
+        good.write_text("some log line\n" + json.dumps(record(value=3.9)) + "\n")
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(record(value=0.5, ratio=0.1)) + "\n")
+
+        ok = self.run_cli(str(good), str(base))
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        doc = json.loads(ok.stdout)
+        assert doc["ok"] is True and doc["compared"] == 8
+
+        regressed = self.run_cli(str(bad), str(base))
+        assert regressed.returncode == 1
+        doc = json.loads(regressed.stdout)
+        assert doc["ok"] is False
+        assert {r["field"] for r in doc["regressions"]} >= {"value", "goodput.ratio"}
+
+        usage = self.run_cli()
+        assert usage.returncode == 2
+
+        # a typo'd tolerance flag must be rc 2, not a gate silently running
+        # with defaults (and --flag=value must work like every other tool)
+        typo = self.run_cli(str(good), str(base), "--max-goodput-dro", "0.05")
+        assert typo.returncode == 2
+        assert "unrecognized" in json.loads(typo.stdout)["error"]
+        eq_form = self.run_cli(str(good), str(base), "--max-goodput-drop=0.05")
+        assert eq_form.returncode == 0
+
+        # zero comparable fields = the gate never ran -> rc 2, never a pass
+        alien = tmp_path / "alien.json"
+        alien.write_text(json.dumps({"event": "shutdown"}) + "\n")
+        never_ran = self.run_cli(str(alien), str(base))
+        assert never_ran.returncode == 2
+        assert "no comparable fields" in json.loads(never_ran.stdout)["error"]
+
+        errored = tmp_path / "err.json"
+        errored.write_text(json.dumps({"error": "boom", "value": 0.0}) + "\n")
+        rc = self.run_cli(str(errored), str(base))
+        assert rc.returncode == 2  # failed bench record is a usage error, not a pass

@@ -43,7 +43,19 @@ def _stub_engine():
         chunk_stats = {"chunks": 0, "chunk_tokens": 0}
         recent_chunk_sizes = []  # (seq, n_tokens) chunked-prefill event ring
         recent_decode_stalls = []  # (seq, seconds)
+        recent_step_times = []  # (seq, gap_s, device_s, host_s) anatomy ring
         backend = _Backend()
+
+        def __init__(self):
+            # a real ledger so the goodput pull gauges exercise their actual
+            # read paths (ratio / NaN-MFU / shape-bucket cardinality)
+            from paddlenlp_tpu.observability.goodput import GoodputLedger
+
+            self.ledger = GoodputLedger()
+
+        @staticmethod
+        def kv_fragmentation():
+            return 0.25
 
     return _Engine()
 
@@ -65,7 +77,12 @@ def catalog_exposition() -> str:
     # labeled series expose no samples until touched — exercise one labelset
     # of each so the lint sees real sample lines, not just HELP/TYPE headers
     serving.latency_attribution.observe(0.01, phase="queue")
-    serving.shed.inc(reason="shed")
+    serving.shed.inc(reason="shed", priority="best_effort")
+    serving.requests.inc(status="stop", priority="interactive")
+    serving.wasted_tokens.inc(3, kind="padding")
+    serving.compiles.inc(program="prefill")
+    serving.compile_seconds.inc(0.5, program="prefill")
+    serving.step_gap.observe(0.002)
     router.latency_attribution.observe(0.02, phase="hedge_race")
     router.replica_healthy.set(1.0, replica="replica-0")
     router.requests.inc(replica="replica-0", outcome="ok")
@@ -97,7 +114,7 @@ def federation_problems() -> list:
     for rid in ("replica-0", "replica-1"):
         registry = MetricsRegistry()
         metrics = ServingMetrics(_stub_engine(), registry=registry)
-        metrics.requests.inc(status="stop")
+        metrics.requests.inc(status="stop", priority="interactive")
         metrics.ttft.observe(0.05)
         expositions[rid] = registry.expose()
     problems = [f"federation: {p}" for p in lint_federation(expositions)]

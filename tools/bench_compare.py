@@ -1,0 +1,203 @@
+"""Perf-regression gate over two ``bench_serve.py`` JSON lines.
+
+Compares a CANDIDATE bench record against a BASELINE (default: the committed
+``tools/BENCH_BASELINE.json``) with per-field tolerance bands and exits
+nonzero on regression — the first consumer of the goodput-ledger fields and
+the seed of the BENCH trajectory gate:
+
+- throughput (``value`` req/s, ``tokens_per_sec``) must hold a fraction of
+  baseline (``--min-throughput-ratio``, default 0.5 — CPU smoke numbers are
+  noisy; the gate catches collapses, not jitter);
+- latency tails (``p99_ttft_ms``, ``p99_inter_token_ms``,
+  ``goodput.step_gap_p99_ms``) may grow by ``--max-latency-ratio`` (default
+  2.5x) plus an absolute ``--latency-slack-ms`` floor (tiny baselines must
+  not gate on scheduler noise);
+- ``goodput.ratio`` may drop at most ``--max-goodput-drop`` (default 0.10,
+  absolute) — the deterministic device-efficiency gate: a chunk-size or
+  bucketing change that silently doubles padding fails here even when
+  wall-clock noise hides it;
+- the waste share (``sum(goodput.wasted_tokens) / goodput.fed_tokens``) may
+  grow at most ``--max-waste-growth`` (default 0.10, absolute);
+- ``goodput.compiles`` may grow to ``max(2x baseline, baseline + 8)`` —
+  the compile-cache regression gate (a retrace storm fails before it ever
+  shows up in latency).
+
+Usage::
+
+    python tools/bench_serve.py > /tmp/candidate.json
+    python tools/bench_compare.py /tmp/candidate.json            # vs committed baseline
+    python tools/bench_compare.py /tmp/candidate.json /tmp/base.json
+    python tools/bench_serve.py | python tools/bench_compare.py -  # stdin candidate
+
+Prints ONE JSON line ``{"ok": bool, "compared": N, "regressions": [...],
+"skipped": [...]}``; rc 0 = pass, rc 1 = regression, rc 2 = usage/parse
+error. Fields missing on either side are skipped (reported, not fatal) so
+the gate tolerates bench-flag drift between the two records — but ZERO
+comparable fields is rc 2: a gate that never ran must never read as passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_BASELINE.json")
+
+
+def _fail_usage(msg: str) -> None:
+    print(json.dumps({"ok": False, "error": msg}))
+    sys.exit(2)
+
+
+class _JsonArgumentParser(argparse.ArgumentParser):
+    """argparse with the tool's one-JSON-line error contract: an unknown or
+    malformed flag prints ``{"ok": false, "error": ...}`` and exits 2 (a
+    typo'd tolerance must never run the gate with defaults)."""
+
+    def error(self, message):
+        _fail_usage(message)
+
+
+def load_record(source: str) -> Dict:
+    """A bench record from a file path (last JSON-looking line wins — the
+    bench prints exactly one, but logs may precede it) or '-' for stdin."""
+    if source == "-":
+        text = sys.stdin.read()
+    else:
+        try:
+            with open(source, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            _fail_usage(f"cannot read {source!r}: {e}")
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError as e:
+                _fail_usage(f"{source!r}: bad JSON line: {e}")
+    _fail_usage(f"{source!r} contains no JSON line")
+    raise AssertionError  # unreachable
+
+
+def _get(record: Dict, dotted: str) -> Optional[float]:
+    node = record
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def _waste_share(record: Dict) -> Optional[float]:
+    fed = _get(record, "goodput.fed_tokens")
+    wasted = record.get("goodput", {}).get("wasted_tokens")
+    if fed is None or fed <= 0 or not isinstance(wasted, dict):
+        return None
+    return sum(v for v in wasted.values() if isinstance(v, (int, float))) / fed
+
+
+def compare(candidate: Dict, baseline: Dict,
+            min_throughput_ratio: float = 0.5,
+            max_latency_ratio: float = 2.5,
+            latency_slack_ms: float = 50.0,
+            max_goodput_drop: float = 0.10,
+            max_waste_growth: float = 0.10,
+            ) -> Tuple[List[Dict], List[str], int]:
+    """Returns ``(regressions, skipped_fields, compared_count)``. Pure so the
+    tier-1 gate test drives it directly on synthetic records."""
+    regressions: List[Dict] = []
+    skipped: List[str] = []
+    compared = 0
+
+    def check(field: str, limit: float, direction: str,
+              cand: Optional[float], base: Optional[float]):
+        nonlocal compared
+        if cand is None or base is None:
+            skipped.append(field)
+            return
+        compared += 1
+        bad = cand < limit if direction == "min" else cand > limit
+        if bad:
+            regressions.append({
+                "field": field, "baseline": base, "candidate": cand,
+                "limit": round(limit, 6),
+                "direction": "below" if direction == "min" else "above"})
+
+    for field in ("value", "tokens_per_sec"):
+        base = _get(baseline, field)
+        check(field, (base or 0.0) * min_throughput_ratio, "min",
+              _get(candidate, field), base)
+    for field in ("p99_ttft_ms", "p99_inter_token_ms", "goodput.step_gap_p99_ms"):
+        base = _get(baseline, field)
+        if base is not None:
+            limit = base * max_latency_ratio + latency_slack_ms
+        else:
+            limit = 0.0
+        check(field, limit, "max", _get(candidate, field), base)
+    base_ratio = _get(baseline, "goodput.ratio")
+    check("goodput.ratio", (base_ratio or 0.0) - max_goodput_drop, "min",
+          _get(candidate, "goodput.ratio"), base_ratio)
+    base_waste = _waste_share(baseline)
+    check("goodput.waste_share",
+          (base_waste if base_waste is not None else 0.0) + max_waste_growth,
+          "max", _waste_share(candidate), base_waste)
+    base_compiles = _get(baseline, "goodput.compiles")
+    if base_compiles is not None:
+        limit = max(base_compiles * 2.0, base_compiles + 8.0)
+    else:
+        limit = 0.0
+    check("goodput.compiles", limit, "max",
+          _get(candidate, "goodput.compiles"), base_compiles)
+    return regressions, skipped, compared
+
+
+def main() -> None:
+    parser = _JsonArgumentParser(
+        prog="bench_compare.py", allow_abbrev=False,
+        description="Gate a bench_serve JSON line against a baseline record.")
+    parser.add_argument("candidate", help="candidate record file, or - for stdin")
+    parser.add_argument("baseline", nargs="?", default=DEFAULT_BASELINE,
+                        help=f"baseline record file (default: {DEFAULT_BASELINE})")
+    parser.add_argument("--min-throughput-ratio", type=float, default=0.5)
+    parser.add_argument("--max-latency-ratio", type=float, default=2.5)
+    parser.add_argument("--latency-slack-ms", type=float, default=50.0)
+    parser.add_argument("--max-goodput-drop", type=float, default=0.10)
+    parser.add_argument("--max-waste-growth", type=float, default=0.10)
+    opts = parser.parse_args()
+    candidate = load_record(opts.candidate)
+    baseline = load_record(opts.baseline)
+    if candidate.get("error") or baseline.get("error"):
+        _fail_usage("cannot gate on a failed bench record "
+                    f"(candidate error={candidate.get('error')!r}, "
+                    f"baseline error={baseline.get('error')!r})")
+    regressions, skipped, compared = compare(
+        candidate, baseline,
+        min_throughput_ratio=opts.min_throughput_ratio,
+        max_latency_ratio=opts.max_latency_ratio,
+        latency_slack_ms=opts.latency_slack_ms,
+        max_goodput_drop=opts.max_goodput_drop,
+        max_waste_growth=opts.max_waste_growth)
+    if compared == 0:
+        # zero overlapping fields = the gate never ran (schema drift, wrong
+        # artifact piped in) — that must be a loud failure, not a green pass
+        _fail_usage("no comparable fields between candidate and baseline "
+                    f"(skipped: {skipped}) — wrong artifact or schema drift")
+    print(json.dumps({
+        "ok": not regressions,
+        "compared": compared,
+        "baseline": opts.baseline,
+        "regressions": regressions,
+        "skipped": skipped,
+    }))
+    sys.exit(1 if regressions else 0)
+
+
+if __name__ == "__main__":
+    main()

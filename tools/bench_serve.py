@@ -766,6 +766,37 @@ def run() -> None:
         attribution[phase] = {"p50_ms": round(p50 * 1e3, 1),
                               "p99_ms": round(p99 * 1e3, 1)}
     record["attribution"] = attribution
+    # goodput ledger readout: what fraction of the run's device positions was
+    # useful work, the waste decomposition, compile count and the host-gap
+    # tail — the fields tools/bench_compare.py gates regressions on
+    def labeled_by(name, label):
+        out = {}
+        for f in replica_fams:
+            fam = f.get(name)
+            if fam is None:
+                continue
+            for (_sample, labels), v in fam.samples.items():
+                key = dict(labels).get(label)
+                if key is not None:
+                    out[key] = out.get(key, 0.0) + v
+        return out
+
+    gp_fed = scalar_sum("paddlenlp_serving_fed_tokens_total")
+    gp_useful = scalar_sum("paddlenlp_serving_useful_tokens_total")
+    record["goodput"] = {
+        "ratio": round(gp_useful / gp_fed, 6) if gp_fed else 1.0,
+        "fed_tokens": int(gp_fed),
+        "useful_tokens": int(gp_useful),
+        "wasted_tokens": {k: int(v) for k, v in sorted(
+            labeled_by("paddlenlp_serving_wasted_tokens_total", "kind").items())},
+        "compiles": int(sum(
+            labeled_by("paddlenlp_serving_compiles_total", "program").values())),
+        "compile_seconds": round(sum(
+            labeled_by("paddlenlp_serving_compile_seconds_total", "program").values()), 3),
+        "step_gap_p99_ms": round(
+            quantile_max("paddlenlp_serving_step_gap_seconds", 0.99) * 1e3, 3),
+        "shape_buckets": int(scalar_sum("paddlenlp_serving_jit_shape_buckets")),
+    }
     # recorder-overhead A/B facts: run once with PDNLP_TPU_FLIGHT_RECORDER=0
     # and once without, diff value/tails — these two fields label the arms
     record["flight_recorder"] = RECORDER.enabled
