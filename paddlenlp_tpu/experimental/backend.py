@@ -65,6 +65,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -112,6 +113,9 @@ class MixedRow:
     emit: bool
     sampling: object
     is_chunk: bool
+    #: adapter-pool slot this row's LoRA delta gathers from (0 = identity —
+    #: the no-adapter row); the engine fills it from Request.adapter_slot
+    adapter: int = 0
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -142,17 +146,18 @@ class ModelBackend:
     step_accounting: dict
 
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
-                sampling, slot_idx) -> np.ndarray:
+                sampling, slot_idx, adapter_table=None) -> np.ndarray:
         raise NotImplementedError
 
     def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
-               sampling) -> Tuple[np.ndarray, np.ndarray]:
+               sampling, adapter_table=None) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
     def mixed_step(self, chunk_rows: List[MixedRow], decode_rows: List[MixedRow]) -> np.ndarray:
         raise NotImplementedError
 
-    def verify(self, tokens, block_tables, start_pos, need_logits: bool):
+    def verify(self, tokens, block_tables, start_pos, need_logits: bool,
+               adapter_table=None):
         raise NotImplementedError
 
     def seed_counts(self, slot_idx, cached_entries):
@@ -175,10 +180,20 @@ class SingleDeviceBackend(ModelBackend):
     def __init__(self, model, *, max_batch_size: int, block_size: int, num_blocks: int,
                  max_blocks_per_seq: int, dtype, decode_steps: int, eos_ids,
                  kv_cache_quant: Optional[str] = None,
-                 token_flatten: Optional[bool] = None):
+                 token_flatten: Optional[bool] = None,
+                 adapter_registry=None):
         self.model = model
         self.max_batch_size = max_batch_size
         self.step_accounting = {"fed": 0, "shape": ()}
+        # multi-LoRA: with a registry attached, EVERY step passes the device
+        # adapter pool + a per-row slot index (identity rows gather slot 0's
+        # zeros) — one program serves mixed adapter/no-adapter batches. No
+        # registry -> lora=None everywhere: the historical programs, untouched.
+        # Set BEFORE _build_infer: the sharded infer reads it to decide the
+        # lora leg of its in_shardings at jit-build time.
+        self.adapter_registry = adapter_registry
+        self._lora_dev = None
+        self._lora_version = None
         self.infer = self._build_infer(model, block_size, num_blocks, max_blocks_per_seq,
                                        dtype, decode_steps, eos_ids)
         self.pool = self._init_pool(model.config, num_blocks, block_size, dtype, kv_cache_quant)
@@ -207,6 +222,41 @@ class SingleDeviceBackend(ModelBackend):
     @property
     def params(self):
         return self.model.params
+
+    # ---------------------------------------------------------------- lora
+    def _place_lora(self, host_pool):
+        """Place the host adapter pool on device (the sharded backend overrides
+        this with NamedSharding placement)."""
+        return jax.tree_util.tree_map(jnp.asarray, host_pool)
+
+    def _lora_tree(self):
+        """Device copy of the registry's adapter pool, re-placed ONLY when the
+        registry's content version moved (adapter load/evict) — the sharded
+        params-rebind id-check pattern applied to the adapter pool."""
+        reg = self.adapter_registry
+        if reg is None:
+            return None
+        host, version = reg.pool_arrays()
+        if version != self._lora_version:
+            self._lora_dev = self._place_lora(host)
+            self._lora_version = version
+        return self._lora_dev
+
+    def _adapter_idx(self, adapter_table, n: int):
+        """Per-row pool-slot indices for an n-row launch (None -> identity).
+        Raises when adapters are requested without a registry attached — a
+        scheduler bug that must not silently serve base-model tokens."""
+        if adapter_table is None:
+            idx = np.zeros(n, np.int32)
+        else:
+            idx = np.zeros(n, np.int32)
+            idx[: len(adapter_table)] = np.asarray(adapter_table, np.int32)
+        if self.adapter_registry is None:
+            if idx.any():
+                raise ValueError("adapter_table has non-identity rows but the "
+                                 "backend has no adapter_registry")
+            return None
+        return jnp.asarray(idx)
 
     # ---------------------------------------------------------------- counts
     def _cached_counts(self, cached_entries, n_rows: int) -> jnp.ndarray:
@@ -239,7 +289,7 @@ class SingleDeviceBackend(ModelBackend):
 
     # ---------------------------------------------------------------- steps
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
-                sampling, slot_idx) -> np.ndarray:
+                sampling, slot_idx, adapter_table=None) -> np.ndarray:
         n = input_ids.shape[0]
         self.step_accounting = {"fed": n * input_ids.shape[1],
                                 "shape": ("prefill", n, input_ids.shape[1])}
@@ -251,29 +301,35 @@ class SingleDeviceBackend(ModelBackend):
             self.params, self.pool, jnp.asarray(input_ids), jnp.asarray(block_tables),
             jnp.asarray(suffix_lens), jnp.asarray(cached_lens), counts_dev,
             samp_arrays(sampling, n),
+            lora=self._lora_tree(), adapter_idx=self._adapter_idx(adapter_table, n),
         )
         self.counts = self.counts.at[jnp.asarray(np.asarray(slot_idx))].set(  # sync-ok: slot_idx is a host int list
             counts_rows[: len(slot_idx)])
         return np.asarray(tokens)  # sync-ok: THE prefill sync point — sampled int32 ids only
 
     def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
-               sampling) -> Tuple[np.ndarray, np.ndarray]:
+               sampling, adapter_table=None) -> Tuple[np.ndarray, np.ndarray]:
         B, steps = last_tokens.shape[0], self.infer.decode_steps
         self.step_accounting = {"fed": B * steps, "shape": ("decode", B, steps)}
         toks, valid, _, _, self.counts, self.pool = self.infer.decode(
             self.params, self.pool, jnp.asarray(last_tokens), jnp.asarray(block_tables),
             jnp.asarray(context_lens), jnp.asarray(done0), jnp.asarray(remaining),
             self.counts, samp_arrays(sampling, len(sampling)),
+            lora=self._lora_tree(), adapter_idx=self._adapter_idx(adapter_table, B),
         )
         return np.asarray(toks), np.asarray(valid)  # sync-ok: THE decode sync point — int32 ids + validity flags only
 
-    def verify(self, tokens, block_tables, start_pos, need_logits: bool):
+    def verify(self, tokens, block_tables, start_pos, need_logits: bool,
+               adapter_table=None):
         self.step_accounting = {
             "fed": tokens.shape[0] * tokens.shape[1],
             "shape": ("verify", tokens.shape[0], tokens.shape[1])}
         argmax, logits, self.pool = self.infer.verify(
             self.params, self.pool, jnp.asarray(tokens), jnp.asarray(block_tables),
-            jnp.asarray(start_pos), need_logits=need_logits,
+            jnp.asarray(start_pos),
+            lora=self._lora_tree(),
+            adapter_idx=self._adapter_idx(adapter_table, tokens.shape[0]),
+            need_logits=need_logits,
         )
         return np.asarray(argmax), (np.asarray(logits) if need_logits else None)  # sync-ok: THE verify sync point (logits only when rejection sampling asks)
 
@@ -321,6 +377,7 @@ class SingleDeviceBackend(ModelBackend):
         q_start = np.zeros(B, np.int32)
         count_fed = np.zeros(B, bool)
         emit = np.zeros(B, bool)
+        adapter = np.zeros(B, np.int32)
         sampling: List = [None] * B
         for r in chunk_rows + decode_rows:
             n = len(r.tokens)
@@ -330,11 +387,13 @@ class SingleDeviceBackend(ModelBackend):
             q_start[r.slot] = r.start
             count_fed[r.slot] = r.is_chunk  # chunk tokens accumulate into counts
             emit[r.slot] = r.emit
+            adapter[r.slot] = r.adapter
             sampling[r.slot] = r.sampling
         tokens, self.counts, self.pool = self.infer.mixed_step(
             self.params, self.pool, jnp.asarray(ids), jnp.asarray(tables),
             jnp.asarray(q_lens), jnp.asarray(q_start), self.counts,
             jnp.asarray(count_fed), jnp.asarray(emit), samp_arrays(sampling, B),
+            lora=self._lora_tree(), adapter_idx=self._adapter_idx(adapter, B),
         )
         rows = chunk_rows + decode_rows
         return tokens, lambda host: np.asarray([host[r.slot] for r in rows])  # sync-ok: host reshuffle of already-synced ids
@@ -357,11 +416,13 @@ class SingleDeviceBackend(ModelBackend):
         c_start = np.zeros(C, np.int32)
         c_slots = np.zeros(C, np.int32)
         c_emit = np.zeros(C, bool)
+        c_adapter = np.zeros(C, np.int32)
         d_tokens = np.zeros(D, np.int32)
         d_tables = np.zeros((D, M), np.int32)
         d_start = np.zeros(D, np.int32)
         d_slots = np.zeros(D, np.int32)
         d_live = np.zeros(D, bool)
+        d_adapter = np.zeros(D, np.int32)
         for j, r in enumerate(chunk_rows):
             n = len(r.tokens)
             c_ids[j, :n] = r.tokens
@@ -370,12 +431,14 @@ class SingleDeviceBackend(ModelBackend):
             c_start[j] = r.start
             c_slots[j] = r.slot
             c_emit[j] = r.emit
+            c_adapter[j] = r.adapter
         for j, r in enumerate(decode_rows):
             d_tokens[j] = r.tokens[0]
             d_tables[j] = r.table
             d_start[j] = r.start
             d_slots[j] = r.slot
             d_live[j] = True
+            d_adapter[j] = r.adapter
         sampling = ([r.sampling for r in chunk_rows] + [None] * (C - len(chunk_rows))
                     + [r.sampling for r in decode_rows] + [None] * (D - len(decode_rows)))
         tokens, self.counts, self.pool = self.infer.mixed_step_flat(
@@ -385,6 +448,8 @@ class SingleDeviceBackend(ModelBackend):
             jnp.asarray(d_tokens), jnp.asarray(d_tables), jnp.asarray(d_start),
             jnp.asarray(d_slots), jnp.asarray(d_live),
             self.counts, samp_arrays(sampling, C + D),
+            lora=self._lora_tree(), chunk_adapter=self._adapter_idx(c_adapter, C),
+            dec_adapter=self._adapter_idx(d_adapter, D),
         )
         n_c, n_d = len(chunk_rows), len(decode_rows)
         return tokens, lambda host: np.concatenate([host[:n_c], host[C : C + n_d]])

@@ -208,21 +208,25 @@ class DisaggBackend(ModelBackend):
 
     # ------------------------------------------------------------- steps
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
-                sampling, slot_idx):
+                sampling, slot_idx, adapter_table=None):
         out = self.prefill_stage.prefill(
-            input_ids, block_tables, suffix_lens, cached_entries, sampling, slot_idx)
+            input_ids, block_tables, suffix_lens, cached_entries, sampling, slot_idx,
+            adapter_table=adapter_table)
         self.step_accounting = self.prefill_stage.step_accounting
         return out
 
     def decode(self, last_tokens, block_tables, context_lens, done0, remaining,
-               sampling):
+               sampling, adapter_table=None):
         out = self.decode_stage.decode(
-            last_tokens, block_tables, context_lens, done0, remaining, sampling)
+            last_tokens, block_tables, context_lens, done0, remaining, sampling,
+            adapter_table=adapter_table)
         self.step_accounting = self.decode_stage.step_accounting
         return out
 
-    def verify(self, tokens, block_tables, start_pos, need_logits: bool):
-        out = self.decode_stage.verify(tokens, block_tables, start_pos, need_logits)
+    def verify(self, tokens, block_tables, start_pos, need_logits: bool,
+               adapter_table=None):
+        out = self.decode_stage.verify(tokens, block_tables, start_pos, need_logits,
+                                       adapter_table=adapter_table)
         self.step_accounting = self.decode_stage.step_accounting
         return out
 

@@ -52,6 +52,8 @@ from ..observability.goodput import (
     install_compile_listener,
 )
 from ..observability.tracer import TRACER
+from ..serving.tenancy.adapters import AdapterPressure, UnknownAdapterError
+from ..serving.tenancy.quotas import DEFAULT_TENANT, TenantQuotas, tenant_goodput_fold
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .backend import MixedRow, ModelBackend, SingleDeviceBackend, _bucket
@@ -103,6 +105,16 @@ class Request:
     # batch ahead of best_effort; FIFO within a class (0/1/2 rank, see
     # InferenceEngine.add_request)
     priority: str = "interactive"
+    # multi-tenant serving: which tenant the request bills to (quotas, the
+    # per-tenant goodput fold, shed/served metric labels) ...
+    tenant: str = DEFAULT_TENANT
+    # ... which registered LoRA adapter its rows gather (None = base model;
+    # also the prefix-cache salt, so adapter outputs never share KV) ...
+    adapter_id: Optional[str] = None
+    # ... and the adapter-pool slot held while admitted (0 = identity slot;
+    # a real slot carries a registry refcount, released with the KV blocks
+    # in _free_kv and re-acquired on re-admission)
+    adapter_slot: int = 0
     prefilled_len: int = 0  # prompt tokens whose KV is in the pool (chunked prefill)
     # which stage's pool holds this sequence's KV (disaggregated backends):
     # "prefill" while chunks run, "migrating" while blocks move between stage
@@ -219,6 +231,14 @@ class InferenceEngine:
         # a prebuilt ModelBackend instance overrides mesh_shape (tests /
         # future MPMD stage-split backends plug in here)
         backend: Optional[ModelBackend] = None,
+        # multi-LoRA serving: a tenancy.AdapterRegistry whose device pool the
+        # backend gathers per-row deltas from. None = base model only (the
+        # historical jit programs, untouched).
+        adapter_registry=None,
+        # per-tenant KV-block share limits: a tenancy.TenantQuotas (or its
+        # dict form). The max_inflight leg is enforced upstream by the
+        # serving scheduler; the engine owns the block-share admission gate.
+        tenant_quotas=None,
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -228,6 +248,7 @@ class InferenceEngine:
             max_batch_size=max_batch_size, block_size=block_size, num_blocks=num_blocks,
             max_blocks_per_seq=max_blocks_per_seq, dtype=dtype, decode_steps=decode_steps,
             eos_ids=self.eos_ids, kv_cache_quant=kv_cache_quant, token_flatten=token_flatten,
+            adapter_registry=adapter_registry,
         )
         if disagg_stages is not None and mesh_shape is not None:
             raise ValueError(
@@ -245,6 +266,18 @@ class InferenceEngine:
             self.backend = ShardedBackend(model, mesh_shape=mesh_shape, **backend_kw)
         else:
             self.backend = SingleDeviceBackend(model, **backend_kw)
+        # the backend's registry is authoritative (a prebuilt backend carries
+        # its own); the engine uses it for slot acquire/release at admission
+        self.adapter_registry = (getattr(self.backend, "adapter_registry", None)
+                                 or adapter_registry)
+        self.tenant_quotas = (
+            tenant_quotas
+            if tenant_quotas is None or isinstance(tenant_quotas, TenantQuotas)
+            else TenantQuotas(tenant_quotas))
+        # per-tenant attributable-token accounting (the tenancy fold over the
+        # PR 15 ledger): monotone engine totals, surviving reset() like the
+        # ledger's — the metrics plane rebaselines on rebind
+        self.tenant_goodput: Dict[str, Dict[str, int]] = {}
         # stage-split scheduling state (engine-owned; the backend only copies
         # blocks): req_id -> in-flight MigrationTicket, plus the deferred
         # queue migrations wait on while the decode stage is under pressure
@@ -333,12 +366,28 @@ class InferenceEngine:
     # ------------------------------------------------------------------ api
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                     stream_cb: Optional[Callable] = None, trace: Optional[str] = None,
-                    priority: str = "interactive", rework_hwm: int = 0) -> int:
+                    priority: str = "interactive", rework_hwm: int = 0,
+                    adapter_id: Optional[str] = None,
+                    tenant: str = DEFAULT_TENANT) -> int:
         """``rework_hwm`` marks the first ``rework_hwm`` prompt positions as
         already-fed-once (a supervisor requeue resubmitting a folded prompt
         after an engine rebuild): the goodput ledger then books their
-        re-prefill as ``requeue_refill`` rework instead of useful work."""
+        re-prefill as ``requeue_refill`` rework instead of useful work.
+
+        ``adapter_id`` selects a LoRA adapter registered with the engine's
+        :class:`~..serving.tenancy.AdapterRegistry` (validated HERE so an
+        unknown id fails at submit, not mid-batch); ``tenant`` names the
+        billing/quota identity the request's work is attributed to."""
         sampling = sampling or SamplingParams()
+        if adapter_id is not None:
+            if self.adapter_registry is None:
+                raise UnknownAdapterError(
+                    f"adapter {adapter_id!r} requested but the engine has no "
+                    "adapter_registry")
+            if adapter_id not in self.adapter_registry:
+                raise UnknownAdapterError(
+                    f"adapter {adapter_id!r} is not registered "
+                    f"(known: {sorted(self.adapter_registry.ids())})")
         req = Request(
             req_id=next(self._next_id),
             prompt_ids=np.asarray(prompt_ids, dtype=np.int32).reshape(-1),
@@ -347,8 +396,11 @@ class InferenceEngine:
             arrival_t=time.time(),
             trace=trace,
             priority=priority,
+            tenant=tenant,
+            adapter_id=adapter_id,
         )
         req.base_prompt_len = len(req.prompt_ids)
+        self._tenant_counts(tenant)["requests"] += 1
         if rework_hwm > 0:
             req.fed_hwm = min(int(rework_hwm), len(req.prompt_ids))
             req.rework_src = "requeue_refill"
@@ -403,9 +455,18 @@ class InferenceEngine:
         preemptions release by refcount without registering."""
         freed = self.mgr.lengths.get(req.req_id)
         if cache and self.enable_prefix_cache and req.finish_reason in ("stop", "length"):
-            self.mgr.finish_seq_cached(req.req_id, req.prompt_ids)
+            # salt = adapter_id: an adapter's KV is the product of base+delta
+            # forwards, so cached prefixes are only shareable within the SAME
+            # adapter (base-model requests keep the historical unsalted hashes)
+            self.mgr.finish_seq_cached(req.req_id, req.prompt_ids, salt=req.adapter_id)
         else:
             self.mgr.free_seq(req.req_id)
+        if req.adapter_slot:
+            # the adapter-pool refcount travels with the KV blocks: finish,
+            # abort, preemption and quarantine all pass through here, and
+            # re-admission re-acquires (content-addressed => token-exact)
+            self.adapter_registry.release(req.adapter_id)
+            req.adapter_slot = 0
         TRACER.instant("kv_free", cat="engine", trace=req.trace,
                        req_id=req.req_id, tokens_held=freed,
                        free_blocks=self.mgr.num_free,
@@ -597,6 +658,10 @@ class InferenceEngine:
                                 enable_prefix_cache=self.enable_prefix_cache)
         self._last_token[:] = 0
         self.backend.reset_counts()
+        if self.adapter_registry is not None:
+            # dropped requests can no longer release their pool refcounts;
+            # adapters stay RESIDENT (content intact for re-acquisition)
+            self.adapter_registry.reset_refs()
         self._spec_rngs.clear()
         self._migrating.clear()
         self._migrate_pending.clear()
@@ -636,6 +701,16 @@ class InferenceEngine:
             # /health and postmortem bundles all carry the waste accounting
             "goodput": self.ledger.snapshot(),
         }
+        if self.adapter_registry is not None or self.tenant_goodput:
+            out["tenancy"] = {
+                # per-tenant goodput fold over the engine's attributable-token
+                # accounting (the tenancy leg of the PR 15 ledger)
+                "tenants": tenant_goodput_fold(self.tenant_goodput),
+                "adapters": (self.adapter_registry.stats()
+                             if self.adapter_registry is not None else None),
+                "quotas": (self.tenant_quotas.describe()
+                           if self.tenant_quotas is not None else None),
+            }
         if self.staged:
             held = self._stage_blocks()
             total = max(self.mgr.total_usable_blocks, 1)
@@ -771,6 +846,20 @@ class InferenceEngine:
     def _free_slot_indices(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
 
+    def _tenant_counts(self, tenant: str) -> Dict[str, int]:
+        tg = self.tenant_goodput.get(tenant)
+        if tg is None:
+            tg = self.tenant_goodput[tenant] = {
+                "useful": 0, "rework": 0, "requests": 0, "tokens_out": 0}
+        return tg
+
+    def _tenant_held_blocks(self, tenant: str) -> int:
+        """KV blocks currently held by a tenant's admitted requests (the
+        engine-side input to the per-tenant block-share gate)."""
+        return sum(len(self.mgr.tables[r.req_id]) for r in self.slots
+                   if r is not None and r.tenant == tenant
+                   and r.req_id in self.mgr.tables)
+
     def _note_fed_span(self, req: Request, start: int, n: int):
         """Goodput split of one fed span ``[start, start+n)``: positions below
         the request's fed high-water mark (re-prefill after preemption or a
@@ -788,6 +877,11 @@ class InferenceEngine:
             req.cow_pending -= cow
         req.fed_hwm = max(req.fed_hwm, start + n)
         rework = overlap + cow
+        # the per-tenant fold: this request's attributable positions (padding
+        # and speculative rejection are step-global, deliberately not here)
+        tg = self._tenant_counts(req.tenant)
+        tg["useful"] += n - rework
+        tg["rework"] += rework
         return rework, (by or None)
 
     @staticmethod
@@ -831,6 +925,10 @@ class InferenceEngine:
         # by mid-prefill + migrating sequences — not the shared total alone
         held_prefill = self._stage_blocks()["prefill"] if self.staged else 0
         total_blocks = max(self.mgr.total_usable_blocks, 1)
+        # requests deferred by their TENANT's block-share cap step aside for
+        # the rest of this pass (re-queued in order afterwards): one capped
+        # tenant must not head-of-line block every other tenant's admissions
+        tenant_deferred: List[Request] = []
         while self.waiting and free:
             req = self.waiting[0]
             prompt_len = len(req.prompt_ids)
@@ -860,6 +958,17 @@ class InferenceEngine:
                     and held_prefill + admit_need > self.prefill_pressure_gate * total_blocks:
                 self._note_gated(req, "prefill_gate")
                 break  # prefill stage saturated: admitting would starve handoff
+            if self.tenant_quotas is not None:
+                cap = self.tenant_quotas.kv_block_cap(req.tenant,
+                                                      self.mgr.total_usable_blocks)
+                if cap is not None \
+                        and self._tenant_held_blocks(req.tenant) + admit_need > cap:
+                    # the tenant waits for its own requests to finish; it is
+                    # deferred (not shed) and other tenants keep admitting
+                    self._note_gated(req, "tenant_kv_share")
+                    self.waiting.popleft()
+                    tenant_deferred.append(req)
+                    continue
             # reserve prompt + 1 so the first decode never immediately preempts;
             # cached prefix blocks need no fresh capacity, so a warm request
             # can be admitted where a cold one of the same length must wait.
@@ -874,11 +983,35 @@ class InferenceEngine:
                 if best_need > self.mgr.num_free:
                     self._note_gated(req, "kv_pressure")
                     break
-                match = self.mgr.match_prefix(req.prompt_ids, prompt_len)
+                match = self.mgr.match_prefix(req.prompt_ids, prompt_len,
+                                              salt=req.adapter_id)
             if not self.mgr.can_admit(prompt_len + 1, match=match):
                 self._note_gated(req, "kv_pressure")
                 break
+            adapter_slot = 0
+            if req.adapter_id is not None:
+                # acquire BEFORE the queue pop and KV allocation: a failed
+                # hot-load leaves queue and allocator untouched (no KV or
+                # pool-slot leak), and AdapterPressure just waits like
+                # kv_pressure for a running adapter's refcount to drop
+                try:
+                    adapter_slot = self.adapter_registry.acquire(req.adapter_id)
+                except AdapterPressure:
+                    self._note_gated(req, "adapter_pressure")
+                    break
+                except Exception as e:
+                    # a poisoned load (the engine.adapter_load fault point, a
+                    # corrupt source): attribute it so the serving supervisor
+                    # quarantines ONLY this request (engine_error/retry) while
+                    # every other tenant's stream keeps decoding
+                    if getattr(e, "req_id", None) is None:
+                        try:
+                            e.req_id = req.req_id
+                        except Exception:
+                            pass
+                    raise
             self.waiting.popleft()
+            req.adapter_slot = adapter_slot
             if req.sched_t is None:  # preserved across preemption-requeues
                 req.sched_t = time.time()
             if cache_on:
@@ -907,6 +1040,10 @@ class InferenceEngine:
                             slot=slot, prompt_len=prompt_len,
                             cached_tokens=n_cached)
             admitted.append((slot, req, n_cached))
+        # capped-tenant requests return to the FRONT in their original order
+        # (they were popped from the head before anything behind them)
+        for r in reversed(tenant_deferred):
+            self.waiting.appendleft(r)
         # admission span closes BEFORE prefill (sibling phases, not nested) and
         # only when something happened — a blocked queue spinning admitted=0
         # every step must not flood the span ring
@@ -961,9 +1098,13 @@ class InferenceEngine:
                              cached_tokens=cached_total), \
                     compile_attribution(self.ledger, "prefill"):
                 t_dev = time.perf_counter()
+                # adapter_table only with a registry attached: prebuilt test
+                # backends predating the kwarg keep working registry-off
+                extra = ({"adapter_table": [r.adapter_slot for _, r, _ in group]}
+                         if self.adapter_registry is not None else {})
                 tokens = self.backend.prefill(
                     ids, tables, suffix_lens, entries, sampling,
-                    [slot for slot, _, _ in group])
+                    [slot for slot, _, _ in group], **extra)
                 self._step_device_s += time.perf_counter() - t_dev
             # goodput: fed = the padded launch geometry; useful = the uncached
             # suffixes minus any re-fed (post-preemption/requeue/COW) positions
@@ -1089,12 +1230,14 @@ class InferenceEngine:
                 slot=slot, tokens=req.prompt_ids[p0 : p0 + n], start=p0,
                 table=self.mgr.table_array(req.req_id),
                 emit=p0 + n == len(req.prompt_ids),  # sampler on last chunk
-                sampling=req.sampling, is_chunk=True))
+                sampling=req.sampling, is_chunk=True,
+                adapter=req.adapter_slot))
         dec_payload = [
             MixedRow(slot=slot, tokens=np.asarray([self._last_token[slot]], np.int32),  # sync-ok: _last_token is a host array
                      start=req.total_len - 1,  # position of the token being fed
                      table=self.mgr.table_array(req.req_id), emit=True,
-                     sampling=req.sampling, is_chunk=False)
+                     sampling=req.sampling, is_chunk=False,
+                     adapter=req.adapter_slot)
             for slot, req in decode_rows]
         with TRACER.span("mixed_step", cat="engine", step=self._cur_step,
                          chunks=len(chunk_rows), decodes=len(decode_rows),
@@ -1331,8 +1474,11 @@ class InferenceEngine:
             # greedy acceptance never reads the logits: need_logits=False keeps
             # the [B, K+1, V] fp32 buffer from materializing at all
             t_dev = time.perf_counter()
+            extra = ({"adapter_table": [0 if r is None else r.adapter_slot
+                                        for r in self.slots]}
+                     if self.adapter_registry is not None else {})
             argmax, logits = self.backend.verify(
-                tokens, tables, start, need_logits=mode == "sample")
+                tokens, tables, start, need_logits=mode == "sample", **extra)
             self._step_device_s += time.perf_counter() - t_dev
         self.spec_stats["verify_steps"] += 1
         # goodput: drafted-but-rejected positions are the spec_rejected waste
@@ -1361,6 +1507,9 @@ class InferenceEngine:
                 self._last_token[i] = int(tok)
                 self.spec_stats["tokens_emitted"] += 1
                 g_emitted += 1
+                # per-tenant fold: accepted/bonus tokens are the useful verify
+                # positions (rejected drafts are step-global spec waste)
+                self._tenant_counts(req.tenant)["useful"] += 1
                 if req.done:
                     break
             # the last emitted token was sampled, not fed: mark to total-1
@@ -1470,9 +1619,12 @@ class InferenceEngine:
                 compile_attribution(self.ledger, "decode"):
             # ONE host transfer of ids + validity flags (no logits)
             t_dev = time.perf_counter()
+            extra = ({"adapter_table": [0 if r is None else r.adapter_slot
+                                        for r in self.slots]}
+                     if self.adapter_registry is not None else {})
             toks, valid = self.backend.decode(
                 tokens, tables, ctx, done0, remaining,
-                [None if r is None else r.sampling for r in self.slots])
+                [None if r is None else r.sampling for r in self.slots], **extra)
             self._step_device_s += time.perf_counter() - t_dev
         n_emitted = 0
         for s in range(toks.shape[0]):
@@ -1482,6 +1634,9 @@ class InferenceEngine:
                 self._emit(req, int(toks[s, i]))  # sync-ok: toks already host (backend.decode synced)
                 self._last_token[i] = int(toks[s, i])  # sync-ok: toks already host (backend.decode synced)
                 n_emitted += 1
+                # per-tenant fold: each emitted decode token consumed one fed
+                # position (this path bypasses _note_fed_span)
+                self._tenant_counts(req.tenant)["useful"] += 1
         # goodput: the decode jit always burns B x decode_steps positions;
         # every emitted token is one useful fed position, the rest (idle
         # slots, post-EOS sub-steps, unconsumed budget) is padding
@@ -1509,6 +1664,7 @@ class InferenceEngine:
             if req.first_token_t is None:
                 req.first_token_t = time.time()
             req.output_ids.append(tok)
+            self._tenant_counts(req.tenant)["tokens_out"] += 1
             is_eos = tok in self.eos_ids
             hit_max = req.gen_offset + len(req.output_ids) >= req.sampling.max_new_tokens
             req.done = is_eos or hit_max

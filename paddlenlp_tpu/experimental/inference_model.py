@@ -164,6 +164,26 @@ class PagedInferenceModel:
             y = y + p["bias"].astype(self.dtype)
         return y
 
+    def _lora_mm(self, p, x, lora_layer, adapter_idx, name: str):
+        """Base matmul + per-row LoRA delta gathered from the adapter pool.
+
+        ``lora_layer`` is one layer's slice of the pool: ``{proj: {"A":
+        [P, d_in, r], "B": [P, r, d_out]}}`` (P = slots, slot 0 = identity
+        zeros, scaling pre-folded into B); ``adapter_idx`` [B] maps each batch
+        row to its slot. The delta is per-row — ``base(x) + B[idx] @ (A[idx]
+        @ x)`` computed row-independently — so a row's tokens are bitwise
+        identical whether its adapter shares the batch with others or runs
+        solo, the same independence the sampler's (seed, position) keying
+        provides. fp32 accumulation matches the merged-LoRA training math."""
+        y = self._mm(p, x)
+        if lora_layer is None or name not in lora_layer:
+            return y
+        a = lora_layer[name]["A"][adapter_idx].astype(jnp.float32)  # [B, d_in, r]
+        b = lora_layer[name]["B"][adapter_idx].astype(jnp.float32)  # [B, r, d_out]
+        xr = jnp.einsum("btd,bdr->btr", x.astype(jnp.float32), a)
+        delta = jnp.einsum("btr,bro->bto", xr, b)
+        return y + delta.astype(y.dtype)
+
     # ------------------------------------------------------------------ forward core
     def _attend(self, q, k, v, q_positions, kv_len_mask):
         """q [B,T,N,H]; k/v [B,S,K,H]; causal by absolute position + length mask."""
@@ -181,26 +201,25 @@ class PagedInferenceModel:
         return out.astype(q.dtype)
 
     def _layer(self, carry, scanned, block_tables, q_positions, kv_len_mask, write_pos,
-               q_lens):
-        """One decoder layer inside lax.scan: scanned = (layer_params, pool_layer
-        [, scale_layer] for quantized caches)."""
+               q_lens, adapter_idx):
+        """One decoder layer inside lax.scan: scanned = (layer_params, pool_layer,
+        scale_layer-or-None for quantized caches, lora_layer-or-None for
+        multi-LoRA batches)."""
         h = carry
-        if len(scanned) == 3:
-            lp, pool_layer, scale_layer = scanned
-        else:
-            (lp, pool_layer), scale_layer = scanned, None
+        lp, pool_layer, scale_layer, lora_layer = scanned
         cfg = self.config
         B, T, D = h.shape
 
         x = _rms(h, lp["input_layernorm"]["scale"], self.eps)
         attn = lp["self_attn"]
 
-        def proj(p, x, heads):
-            return self._mm(p, x).reshape(B, T, heads, self.head_dim)
+        def proj(p, x, heads, name):
+            return self._lora_mm(p, x, lora_layer, adapter_idx, name) \
+                .reshape(B, T, heads, self.head_dim)
 
-        q = self._hint(proj(attn["q_proj"], x, self.n_heads), "heads")
-        k = self._hint(proj(attn["k_proj"], x, self.n_kv), "kv_heads")
-        v = self._hint(proj(attn["v_proj"], x, self.n_kv), "kv_heads")
+        q = self._hint(proj(attn["q_proj"], x, self.n_heads, "q_proj"), "heads")
+        k = self._hint(proj(attn["k_proj"], x, self.n_kv, "k_proj"), "kv_heads")
+        v = self._hint(proj(attn["v_proj"], x, self.n_kv, "v_proj"), "kv_heads")
         cos, sin = rope_tables(q_positions, self.inv_freq)
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
@@ -234,29 +253,45 @@ class PagedInferenceModel:
         # dot per output column, no cross-shard partial sums), gather after
         # so the residual/norms see a replicated stream
         attn_out = self._hint(attn_out, "full")
-        h = h + self._hint(self._mm(attn["o_proj"], attn_out), "full")
+        h = h + self._hint(
+            self._lora_mm(attn["o_proj"], attn_out, lora_layer, adapter_idx, "o_proj"),
+            "full")
 
         x = _rms(h, lp["post_attention_layernorm"]["scale"], self.eps)
         mlp = lp["mlp"]
-        gate = self._hint(self._mm(mlp["gate_proj"], x), "mlp")
-        up = self._hint(self._mm(mlp["up_proj"], x), "mlp")
+        gate = self._hint(
+            self._lora_mm(mlp["gate_proj"], x, lora_layer, adapter_idx, "gate_proj"), "mlp")
+        up = self._hint(
+            self._lora_mm(mlp["up_proj"], x, lora_layer, adapter_idx, "up_proj"), "mlp")
         act = self._hint(jax.nn.silu(gate) * up, "full")
-        h = h + self._hint(self._mm(mlp["down_proj"], act), "full")
+        h = h + self._hint(
+            self._lora_mm(mlp["down_proj"], act, lora_layer, adapter_idx, "down_proj"),
+            "full")
         if scale_layer is not None:
             return h, (pool_layer, scale_layer)
         return h, pool_layer
 
     def _forward(self, params, pool: PagedKVPool, input_ids, block_tables, q_positions,
-                 kv_len_mask, write_pos, last_pos, q_lens=None):
+                 kv_len_mask, write_pos, last_pos, q_lens=None, lora=None,
+                 adapter_idx=None):
         """input_ids [B,T]; returns (logits at last_pos [B,V], new PagedKVPool).
 
         ``last_pos=None`` returns full-sequence logits [B,T,V] (the speculative
         verify step needs the model's prediction after EVERY draft position).
         ``q_lens`` [B] = valid new tokens per row (defaults to T everywhere);
         only the Pallas ragged kernel consumes it — the XLA path masks padded
-        rows implicitly (their outputs are never read)."""
+        rows implicitly (their outputs are never read).
+
+        ``lora`` is the adapter pool tree ``{proj: {"A": [L, P, d_in, r],
+        "B": [L, P, r, d_out]}}`` (or None for an adapter-free program);
+        ``adapter_idx`` [B] maps each row to a pool slot (0 = identity). Both
+        ride the layer scan: the pool's [L] axis slices per layer alongside
+        the params, and None is a valid empty pytree — the adapter-free
+        program carries no extra operands at all."""
         if q_lens is None:
             q_lens = jnp.full((input_ids.shape[0],), input_ids.shape[1], jnp.int32)
+        if lora is not None and adapter_idx is None:
+            adapter_idx = jnp.zeros((input_ids.shape[0],), jnp.int32)
         m = params["model"]
         embed = m["embed_tokens"]["embedding"]
         h = self._hint(embed[input_ids].astype(self.dtype), "full")
@@ -265,9 +300,11 @@ class PagedInferenceModel:
 
         def body(carry, scanned):
             return self._layer(carry, scanned, block_tables, q_positions, kv_len_mask,
-                               write_pos, q_lens)
+                               write_pos, q_lens, adapter_idx)
 
-        scanned = (m["layers"], pool.kv) if pool.scale is None else (m["layers"], pool.kv, pool.scale)
+        # uniform 4-tuple xs: None entries are empty pytrees lax.scan slices
+        # to None per layer — the quant-off / lora-off programs are unchanged
+        scanned = (m["layers"], pool.kv, pool.scale, lora)
         h, new_pool = jax.lax.scan(body, h, scanned)
         if pool.scale is None:
             new_pool = PagedKVPool(kv=new_pool)
@@ -288,7 +325,7 @@ class PagedInferenceModel:
 
     # ------------------------------------------------------------------ entry points
     def _prefill_impl(self, params, pool, input_ids, block_tables, suffix_lens,
-                      cached_lens, cached_counts, samp):
+                      cached_lens, cached_counts, samp, lora=None, adapter_idx=None):
         """Batched prefill: [n, T_pad] SUFFIX sequences; samples the first token
         on device.
 
@@ -313,7 +350,7 @@ class PagedInferenceModel:
             params, pool, input_ids, block_tables, positions,
             kv_len_mask, cached_lens,
             jnp.maximum(suffix_lens - 1, 0),  # last VALID token (input may be padded)
-            q_lens=suffix_lens,
+            q_lens=suffix_lens, lora=lora, adapter_idx=adapter_idx,
         )
         V = cached_counts.shape[-1]
         valid = (jnp.arange(T)[None, :] < suffix_lens[:, None]).astype(jnp.int32)
@@ -326,7 +363,7 @@ class PagedInferenceModel:
         return tokens, counts, new_pool
 
     def _mixed_impl(self, params, pool, input_ids, block_tables, q_lens, q_start,
-                    counts, count_fed, emit, samp):
+                    counts, count_fed, emit, samp, lora=None, adapter_idx=None):
         """One ragged mixed prefill/decode step: every row feeds ``q_lens[j]``
         new tokens starting at absolute position ``q_start[j]`` — a prefill
         CHUNK (``q_start`` = tokens already prefilled, ``q_lens`` up to the
@@ -360,6 +397,7 @@ class PagedInferenceModel:
         logits, new_pool = self._forward(
             params, pool, input_ids, block_tables, positions, kv_len_mask,
             q_start, jnp.maximum(q_lens - 1, 0), q_lens=q_lens,
+            lora=lora, adapter_idx=adapter_idx,
         )
         V = counts.shape[-1]
         valid = (jnp.arange(T)[None, :] < q_lens[:, None]).astype(jnp.int32)
@@ -372,7 +410,8 @@ class PagedInferenceModel:
 
     def _mixed_flat_impl(self, params, pool, chunk_ids, chunk_tables, chunk_qlens,
                          chunk_start, chunk_slots, chunk_emit, dec_tokens, dec_tables,
-                         dec_start, dec_slots, dec_live, counts, samp):
+                         dec_start, dec_slots, dec_live, counts, samp, lora=None,
+                         chunk_adapter=None, dec_adapter=None):
         """Token-flattened ragged mixed step (the XLA-fallback layout).
 
         :meth:`_mixed_impl` pads EVERY row — decode rows included — to the
@@ -400,6 +439,7 @@ class PagedInferenceModel:
         logits_c, pool = self._forward(
             params, pool, chunk_ids, chunk_tables, positions_c, kv_mask_c,
             chunk_start, jnp.maximum(chunk_qlens - 1, 0), q_lens=chunk_qlens,
+            lora=lora, adapter_idx=chunk_adapter,
         )
         D = dec_tokens.shape[0]
         positions_d = dec_start[:, None]
@@ -407,6 +447,7 @@ class PagedInferenceModel:
         logits_d, pool = self._forward(
             params, pool, dec_tokens[:, None], dec_tables, positions_d, kv_mask_d,
             dec_start, jnp.zeros((D,), jnp.int32), q_lens=dec_live.astype(jnp.int32),
+            lora=lora, adapter_idx=dec_adapter,
         )
         V = counts.shape[-1]
         valid = (jnp.arange(T)[None, :] < chunk_qlens[:, None]).astype(jnp.int32)
@@ -422,7 +463,7 @@ class PagedInferenceModel:
         return tokens, counts, pool
 
     def _decode_impl(self, params, pool, tokens, block_tables, context_lens, done0,
-                     remaining, counts, samp):
+                     remaining, counts, samp, lora=None, adapter_idx=None):
         """Multi-step decode: advance every slot up to ``decode_steps`` tokens in ONE
         jit — the host round-trip carries ids and flags only (the reference's whole
         per-token op chain ``update_inputs.cu``/``stop_generation_multi_ends.cu``/
@@ -441,6 +482,7 @@ class PagedInferenceModel:
             logits, pool_c = self._forward(
                 params, pool_c, tok[:, None], block_tables, ctx[:, None],
                 kv_mask, ctx, jnp.zeros((B,), jnp.int32),
+                lora=lora, adapter_idx=adapter_idx,
             )
             nxt = sample_tokens(logits, positions=ctx + 1, counts=counts, **samp)
             emit = ~done
@@ -461,7 +503,7 @@ class PagedInferenceModel:
         return toks, valid, done, ctx, counts, pool
 
     def _verify_impl(self, params, pool, tokens, block_tables, start_pos,
-                     need_logits: bool = True):
+                     lora=None, adapter_idx=None, need_logits: bool = True):
         """Speculative-decoding verify: one forward over ``[last_token, d_1..d_K]``.
 
         Counterpart of the reference's speculative write path
@@ -487,7 +529,7 @@ class PagedInferenceModel:
         kv_len_mask = jnp.arange(S)[None, :] <= (start_pos[:, None] + T - 1)
         logits, new_pool = self._forward(
             params, pool, tokens, block_tables, positions, kv_len_mask,
-            start_pos, last_pos=None,
+            start_pos, last_pos=None, lora=lora, adapter_idx=adapter_idx,
         )
         argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         if not need_logits:
@@ -495,29 +537,32 @@ class PagedInferenceModel:
         return argmax, logits.astype(jnp.float32), new_pool
 
     def verify(self, params, pool: PagedKVPool, tokens, block_tables, start_pos,
-               need_logits: bool = True):
+               lora=None, adapter_idx=None, need_logits: bool = True):
         return self._verify(params, pool, tokens, block_tables, start_pos,
-                            need_logits=need_logits)
+                            lora, adapter_idx, need_logits=need_logits)
 
     def prefill(self, params, pool: PagedKVPool, input_ids, block_tables, suffix_lens,
-                cached_lens, cached_counts, samp):
+                cached_lens, cached_counts, samp, lora=None, adapter_idx=None):
         return self._prefill(params, pool, input_ids, block_tables, suffix_lens,
-                             cached_lens, cached_counts, samp)
+                             cached_lens, cached_counts, samp, lora, adapter_idx)
 
     def decode(self, params, pool: PagedKVPool, tokens, block_tables, context_lens, done0,
-               remaining, counts, samp):
+               remaining, counts, samp, lora=None, adapter_idx=None):
         return self._decode(
-            params, pool, tokens, block_tables, context_lens, done0, remaining, counts, samp
+            params, pool, tokens, block_tables, context_lens, done0, remaining, counts,
+            samp, lora, adapter_idx
         )
 
     def mixed_step(self, params, pool: PagedKVPool, input_ids, block_tables, q_lens,
-                   q_start, counts, count_fed, emit, samp):
+                   q_start, counts, count_fed, emit, samp, lora=None, adapter_idx=None):
         return self._mixed(params, pool, input_ids, block_tables, q_lens, q_start,
-                           counts, count_fed, emit, samp)
+                           counts, count_fed, emit, samp, lora, adapter_idx)
 
     def mixed_step_flat(self, params, pool: PagedKVPool, chunk_ids, chunk_tables,
                         chunk_qlens, chunk_start, chunk_slots, chunk_emit, dec_tokens,
-                        dec_tables, dec_start, dec_slots, dec_live, counts, samp):
+                        dec_tables, dec_start, dec_slots, dec_live, counts, samp,
+                        lora=None, chunk_adapter=None, dec_adapter=None):
         return self._mixed_flat(params, pool, chunk_ids, chunk_tables, chunk_qlens,
                                 chunk_start, chunk_slots, chunk_emit, dec_tokens,
-                                dec_tables, dec_start, dec_slots, dec_live, counts, samp)
+                                dec_tables, dec_start, dec_slots, dec_live, counts, samp,
+                                lora, chunk_adapter, dec_adapter)

@@ -239,7 +239,8 @@ class BlockManager:
     def can_allocate(self, n_tokens: int) -> bool:
         return self.blocks_needed(n_tokens) <= self.num_free
 
-    def can_admit(self, n_tokens: int, token_ids=None, match=None) -> bool:
+    def can_admit(self, n_tokens: int, token_ids=None, match=None,
+                  salt: Optional[str] = None) -> bool:
         """Like :meth:`can_allocate`, but cached prefix blocks don't need fresh
         capacity — the scheduler admits a warm request a cold one must wait for.
 
@@ -248,7 +249,8 @@ class BlockManager:
         subtracted from available capacity — they can't be both "no fresh
         block needed" AND "evictable free capacity" at once."""
         if match is None and token_ids is not None:
-            match = self.match_prefix(token_ids, min(len(token_ids), n_tokens))
+            match = self.match_prefix(token_ids, min(len(token_ids), n_tokens),
+                                      salt=salt)
         matched = match[0] if match is not None else []
         need = self.blocks_needed(n_tokens) - len(matched)
         return need <= self.num_free - self._idle_count(matched)
@@ -258,13 +260,19 @@ class BlockManager:
         """How many of ``blocks`` currently sit on the (counted-as-free) LRU."""
         return sum(1 for b in blocks if b in self._lru)
 
-    def _chain_hashes(self, token_ids, nb_full: int):
+    def _chain_hashes(self, token_ids, nb_full: int, salt: Optional[str] = None):
         """Chained sha256 content digests of the first ``nb_full`` full blocks.
 
         Cryptographic on purpose: the index serves another prompt's KV on a
         key collision with no further check, so a non-collision-resistant
-        hash would be a silent-wrong-output (and cross-request leak) channel."""
-        h = b""
+        hash would be a silent-wrong-output (and cross-request leak) channel.
+
+        ``salt`` seeds the chain (multi-LoRA: the adapter_id) so two tenants
+        with identical prompts but different adapters never share KV — a LoRA
+        delta changes every hidden state, so cross-adapter cache hits would be
+        silently wrong. ``salt=None`` keeps the historical hash values: the
+        no-adapter cache population is untouched."""
+        h = hashlib.sha256(salt.encode()).digest() if salt else b""
         bs = self.block_size
         arr = np.ascontiguousarray(
             np.asarray(token_ids[: nb_full * bs], dtype=np.int64))
@@ -274,20 +282,21 @@ class BlockManager:
             out.append(h)
         return out
 
-    def match_prefix(self, token_ids, n_tokens: int):
+    def match_prefix(self, token_ids, n_tokens: int, salt: Optional[str] = None):
         """Longest cached full-block prefix of ``token_ids``.
 
         Returns ``(shared_blocks, n_cached_tokens, cow_src)``: blocks to attach
         by reference, tokens covered, and — when the match would cover the whole
         prompt (leaving nothing to prefill) — the tail block to copy-on-write
         instead of sharing, so the re-prefilled last token never mutates a
-        shared block. Pure lookup: acquires nothing."""
+        shared block. Pure lookup: acquires nothing. ``salt`` must match the
+        salt the blocks were registered under (see :meth:`_chain_hashes`)."""
         if not self.enable_prefix_cache:
             return [], 0, None
         bs = self.block_size
         nb_full = min(len(token_ids), n_tokens) // bs
         matched: List[int] = []
-        for h in self._chain_hashes(token_ids, nb_full):
+        for h in self._chain_hashes(token_ids, nb_full, salt=salt):
             b = self._index.get(h)
             if b is None:
                 break
@@ -337,7 +346,8 @@ class BlockManager:
         return pairs
 
     # ------------------------------------------------------------- allocation
-    def allocate(self, seq_id: int, n_tokens: int, token_ids=None, match=None):
+    def allocate(self, seq_id: int, n_tokens: int, token_ids=None, match=None,
+                 salt: Optional[str] = None):
         """Allocate a sequence's blocks.
 
         Plain call (``token_ids=None``): the uncached path — returns the block
@@ -354,7 +364,7 @@ class BlockManager:
         if need > self.max_blocks_per_seq:
             raise ValueError(f"sequence needs {need} blocks > max_blocks_per_seq {self.max_blocks_per_seq}")
         if match is None and token_ids is not None:
-            match = self.match_prefix(token_ids, n_tokens)
+            match = self.match_prefix(token_ids, n_tokens, salt=salt)
         shared, n_cached, cow_src = match if match is not None else ([], 0, None)
         n_fresh = need - len(shared)
         # matched idle blocks are about to leave the LRU: they can't double as
@@ -420,7 +430,7 @@ class BlockManager:
         for b in blocks:
             self._release_block(b)
 
-    def finish_seq_cached(self, seq_id: int, token_ids):
+    def finish_seq_cached(self, seq_id: int, token_ids, salt: Optional[str] = None):
         """Release a finished sequence, registering its full prompt blocks in
         the prefix index so later requests skip their prefill.
 
@@ -439,7 +449,7 @@ class BlockManager:
         if self.enable_prefix_cache and token_ids is not None and epoch == self._cache_epoch:
             bs = self.block_size
             nb_full = min(len(token_ids) // bs, len(blocks))
-            for i, h in enumerate(self._chain_hashes(token_ids, nb_full)):
+            for i, h in enumerate(self._chain_hashes(token_ids, nb_full, salt=salt)):
                 b = blocks[i]
                 if h not in self._index and b not in self._block_hash:
                     self._index[h] = b

@@ -122,7 +122,8 @@ class ShardedPagedInferenceModel(PagedInferenceModel):
     ``_hint`` anchors implement the all-gather layout described in the
     module docstring."""
 
-    def __init__(self, model, *args, mesh, kv_quantized: bool = False, **kw):
+    def __init__(self, model, *args, mesh, kv_quantized: bool = False,
+                 lora_enabled: bool = False, **kw):
         self.mesh = mesh
         self.tp = int(mesh.shape["tp"])
         self._repl = NamedSharding(mesh, P())
@@ -134,7 +135,34 @@ class ShardedPagedInferenceModel(PagedInferenceModel):
                           if n_kv % self.tp == 0 else P())
         pool_ns = NamedSharding(mesh, self.pool_spec)
         self.pool_shardings = PagedKVPool(kv=pool_ns, scale=pool_ns if kv_quantized else None)
+        self.lora_specs, self.lora_shardings = self._lora_layout(model.config, lora_enabled)
         super().__init__(model, *args, **kw)
+
+    def _lora_layout(self, config, lora_enabled: bool):
+        """(spec tree, sharding tree) for the adapter pool argument.
+
+        Adapter weights follow the column-parallel rules of the projections
+        they patch: ``B`` [L, P, r, d_out] shards its output dim on ``tp``
+        exactly when the base kernel's output dim does (else replication —
+        the same fallback `serving_partition_rules` uses), and ``A`` is
+        always replicated (its output dim is the tiny rank r). ``x @ A``
+        then reads a replicated operand, and ``(xA) @ B`` produces a
+        tp-sharded delta that lands on ``base(x)``'s identical layout before
+        the `_hint` anchors re-gather — the reduction ORDER matches the
+        single-device program, preserving bitwise token identity.
+
+        LoRA off -> the lora argument is always None (an empty pytree), and
+        a single replicated leaf serves as its universal tree prefix."""
+        if not lora_enabled:
+            return None, self._repl
+        from ..serving.tenancy.adapters import adapter_dims_from_config
+        specs = {}
+        for proj, (_d_in, d_out) in adapter_dims_from_config(config).items():
+            b_spec = P(None, None, None, "tp") if d_out % self.tp == 0 else P()
+            specs[proj] = {"A": P(), "B": b_spec}
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        return specs, shardings
 
     def _hint(self, x, kind: str):
         if self.tp == 1:
@@ -154,26 +182,31 @@ class ShardedPagedInferenceModel(PagedInferenceModel):
         return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
 
     def _build_jits(self):
+        # every step's trailing args are the multi-LoRA pair(s): the adapter
+        # pool (column-parallel / replicated per _lora_layout; a replicated
+        # prefix when LoRA is off and the arg is always None) and the
+        # replicated per-row slot indices.
         ps, pool_s, r = self.param_shardings, self.pool_shardings, self._repl
+        lora_s = self.lora_shardings
         self._prefill = jax.jit(
             self._prefill_impl, donate_argnums=(1,),
-            in_shardings=(ps, pool_s) + (r,) * 6,
+            in_shardings=(ps, pool_s) + (r,) * 6 + (lora_s, r),
             out_shardings=(r, r, pool_s))
         self._decode = jax.jit(
             self._decode_impl, donate_argnums=(1,),
-            in_shardings=(ps, pool_s) + (r,) * 7,
+            in_shardings=(ps, pool_s) + (r,) * 7 + (lora_s, r),
             out_shardings=(r, r, r, r, r, pool_s))
         self._verify = jax.jit(
             self._verify_impl, donate_argnums=(1,), static_argnames=("need_logits",),
-            in_shardings=(ps, pool_s) + (r,) * 3,
+            in_shardings=(ps, pool_s) + (r,) * 3 + (lora_s, r),
             out_shardings=(r, r, pool_s))
         self._mixed = jax.jit(
             self._mixed_impl, donate_argnums=(1,),
-            in_shardings=(ps, pool_s) + (r,) * 8,
+            in_shardings=(ps, pool_s) + (r,) * 8 + (lora_s, r),
             out_shardings=(r, r, pool_s))
         self._mixed_flat = jax.jit(
             self._mixed_flat_impl, donate_argnums=(1,),
-            in_shardings=(ps, pool_s) + (r,) * 13,
+            in_shardings=(ps, pool_s) + (r,) * 13 + (lora_s, r, r),
             out_shardings=(r, r, pool_s))
 
 
@@ -225,11 +258,17 @@ class ShardedBackend(SingleDeviceBackend):
             model, block_size, num_blocks, max_blocks_per_seq, dtype=dtype,
             decode_steps=decode_steps, eos_ids=eos_ids,
             mesh=self.mesh, kv_quantized=self._kv_quantized,
+            lora_enabled=self.adapter_registry is not None,
         )
 
     def _init_pool(self, config, num_blocks, block_size, dtype, quant):
         pool = super()._init_pool(config, num_blocks, block_size, dtype, quant)
         return jax.device_put(pool, self.infer.pool_shardings)
+
+    def _place_lora(self, host_pool):
+        # adapter pool lands with its column-parallel/replicated layout so
+        # dispatch never re-shards it against the jits' in_shardings
+        return jax.device_put(host_pool, self.infer.lora_shardings)
 
     def _init_counts(self):
         return jax.device_put(super()._init_counts(), self.infer._repl)

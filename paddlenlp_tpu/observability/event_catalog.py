@@ -29,7 +29,7 @@ __all__ = ["EVENT_CATALOG", "EVENT_REASONS"]
 EVENT_CATALOG: Dict[str, str] = {
     # ------------------------------------------------------------- engine scheduling
     "admit.accept": "a waiting request was bound to a slot and its KV blocks allocated (fields: slot, prompt_len, cached_tokens)",
-    "admit.defer": "the head-of-queue request was deferred by an admission gate; recorded once per wait episode (reason=kv_pressure|prefill_gate)",
+    "admit.defer": "the head-of-queue request was deferred by an admission gate; recorded once per wait episode (reason=kv_pressure|prefill_gate|adapter_pressure|tenant_kv_share)",
     "admit.reject": "a request that can never fit was rejected terminally with finish_reason=capacity (reason=capacity)",
     "preempt": "KV exhaustion evicted the youngest sequence for recompute-requeue (reason=decode_growth|mixed_capacity|spec_reserve)",
     "chunk.grant": "one mid-prefill slot drew prompt tokens from the mixed-step chunk budget (fields: tokens, budget_left)",
@@ -37,7 +37,7 @@ EVENT_CATALOG: Dict[str, str] = {
     "migrate.defer": "the head pending migration was deferred; recorded once per wait episode (reason=decode_pressure|inflight_limit)",
     "migrate.land": "a sequence's migrated blocks landed in the decode pool; it is now decode-eligible (fields: blocks, polls)",
     # ------------------------------------------------------------- scheduler (admission control)
-    "sched.reject": "the scheduler shed a submission before it reached the engine (reason=saturated|draining|degraded|deadline|shed -> HTTP 429/503)",
+    "sched.reject": "the scheduler shed a submission before it reached the engine (reason=saturated|draining|degraded|deadline|shed|tenant_quota -> HTTP 429/503)",
     # ------------------------------------------------------------- brownout (overload degradation ladder)
     "brownout.enter": "the replica entered brownout level 1+ from normal operation (reason=saturation|slo_fast_burn)",
     "brownout.step": "the brownout ladder moved one level while already browned out (fields: prev, level, direction)",
@@ -63,11 +63,13 @@ EVENT_CATALOG: Dict[str, str] = {
 #: closed ``reason`` vocabularies for events that carry one. The recorder
 #: validates membership at record time; events absent here take no reason.
 EVENT_REASONS: Dict[str, Tuple[str, ...]] = {
-    "admit.defer": ("kv_pressure", "prefill_gate"),
+    "admit.defer": ("kv_pressure", "prefill_gate", "adapter_pressure",
+                    "tenant_kv_share"),
     "admit.reject": ("capacity",),
     "preempt": ("decode_growth", "mixed_capacity", "spec_reserve"),
     "migrate.defer": ("decode_pressure", "inflight_limit"),
-    "sched.reject": ("saturated", "draining", "degraded", "deadline", "shed"),
+    "sched.reject": ("saturated", "draining", "degraded", "deadline", "shed",
+                     "tenant_quota"),
     "brownout.enter": ("saturation", "slo_fast_burn"),
     "scale.hold": ("cooldown", "hysteresis", "max_envelope", "min_envelope",
                    "provision_backoff"),

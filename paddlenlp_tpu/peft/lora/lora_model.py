@@ -204,6 +204,48 @@ class LoRAModel:
         save_file(tensors, os.path.join(save_directory, LORA_WEIGHTS_NAME), metadata={"format": "np"})
         logger.info(f"LoRA adapters saved to {save_directory}")
 
+    def export_adapter(self, path: Optional[str] = None) -> Dict[str, np.ndarray]:
+        """Flatten the trained adapters into the serving exchange format:
+        flat ``{proj}.lora_A`` [L, d_in, r] / ``{proj}.lora_B`` [L, r, d_out]
+        arrays keyed by projection name (``q_proj`` ... ``down_proj``) — the
+        scanned layout is exported as-is, per-layer trees are stacked in layer
+        order. With ``path``, writes a safetensors file carrying ``scaling``
+        in its metadata; either the returned dict or the file is a direct
+        ``AdapterRegistry.add`` source, so a trained adapter drops into the
+        multi-tenant serving pool without a conversion step."""
+        flat = flatten_params(self.params)
+        layer_re = re.compile(r"/layers_(\d+)/")
+        by_key: Dict[str, Dict[Optional[int], np.ndarray]] = {}
+        for p, v in flat.items():
+            part = p.rsplit("/", 1)[-1]
+            if part not in ("lora_A", "lora_B"):
+                continue
+            proj = p.rsplit("/", 2)[-2]
+            m = layer_re.search(p)
+            layer = int(m.group(1)) if m else None
+            arr = np.asarray(jax.device_get(v), dtype=np.float32)
+            by_key.setdefault(f"{proj}.{part}", {})[layer] = arr
+        if not by_key:
+            raise ValueError("no LoRA adapters to export")
+        L = int(self.config.num_hidden_layers)
+        out: Dict[str, np.ndarray] = {}
+        for key in sorted(by_key):
+            layers = by_key[key]
+            if None in layers:  # scanned: already [L, d, r]
+                out[key] = layers[None]
+            else:
+                if sorted(layers) != list(range(L)):
+                    raise ValueError(
+                        f"adapter {key} covers layers {sorted(layers)}; "
+                        f"want all of 0..{L - 1}")
+                out[key] = np.stack([layers[i] for i in range(L)])
+        if path is not None:
+            save_file(out, path, metadata={"format": "np",
+                                           "scaling": str(self.lora_config.scaling)})
+            logger.info(f"LoRA adapter exported to {path} "
+                        f"({len(out)} tensors, scaling {self.lora_config.scaling:.3f})")
+        return out
+
     @classmethod
     def from_pretrained(cls, model, lora_path: str) -> "LoRAModel":
         config = LoRAConfig.from_pretrained(lora_path)

@@ -12,7 +12,8 @@ Routes::
                             "stream": bool, "temperature"/"top_p"/"top_k"/
                             "seed"/"do_sample", "timeout": float,
                             "priority": "interactive"|"batch"|"best_effort",
-                            "deadline_ms": float}
+                            "deadline_ms": float, "tenant": str,
+                            "adapter_id": str}
     POST /v1/abort         {"id": "cmpl-N"}        — cancel an in-flight request
     GET  /metrics          Prometheus text exposition
     GET  /health           liveness + scheduler/engine stats + tracer clock
@@ -27,6 +28,10 @@ Routes::
                            health + metrics + config); returns its path
     POST /admin/brownout   router/autoscaler-pushed overload-brownout floor
                            {"level": 0..3, "reason"?, "ttl_s"?}
+    POST /admin/adapters   LoRA adapter hot-load/unload against the engine's
+                           AdapterRegistry: {"op": "load", "adapter_id",
+                           "path" | "weights", "scaling"?} | {"op": "unload",
+                           "adapter_id"} | {"op": "list"}
 
 Backpressure maps to HTTP: 429 when the admission window is full (retryable),
 503 while draining, 413 for oversized bodies. A client disconnect mid-stream
@@ -63,6 +68,8 @@ from .scheduler import (
     ShedError,
     ShuttingDownError,
 )
+from .tenancy.adapters import UnknownAdapterError
+from .tenancy.quotas import DEFAULT_TENANT, TenantQuotas
 
 __all__ = ["ServingServer"]
 
@@ -99,7 +106,8 @@ class ServingServer:
                  max_src_tokens: Optional[int] = None,
                  engine_factory=None,
                  supervisor_policy: Optional[SupervisorPolicy] = None,
-                 trace_sample_every: Optional[int] = None):
+                 trace_sample_every: Optional[int] = None,
+                 tenant_quotas: Optional[TenantQuotas] = None):
         self.engine = engine
         self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
         self.registry = registry or REGISTRY
@@ -112,7 +120,8 @@ class ServingServer:
         self.max_src_tokens = max_src_tokens
         self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry),
                                engine_factory=engine_factory, policy=supervisor_policy)
-        self.scheduler = Scheduler(self.loop, scheduler_config)
+        self.scheduler = Scheduler(self.loop, scheduler_config,
+                                   tenant_quotas=tenant_quotas)
         # brownout side effects: level >= 2 turns speculative decode off on
         # the live engine (conserve device cycles for committed tokens); the
         # baseline is captured here so exit restores the configured behavior.
@@ -185,6 +194,23 @@ class ServingServer:
             deadline_s = float(deadline_s) / 1e3
             if deadline_s <= 0:
                 raise ValueError("deadline_ms must be > 0 milliseconds")
+        tenant = str(payload.get("tenant", DEFAULT_TENANT))
+        if not tenant:
+            raise ValueError("tenant must be a non-empty string")
+        adapter_id = payload.get("adapter_id")
+        if adapter_id is not None:
+            adapter_id = str(adapter_id)
+            # reject unknown adapters at the door (400) instead of letting the
+            # submission die on the loop thread; the engine re-checks under
+            # its own registry view, so a hot-unload race still fails safely
+            registry = getattr(self.loop.engine, "adapter_registry", None)
+            if registry is None:
+                raise ValueError("this replica serves no LoRA adapters "
+                                 "(engine has no adapter registry)")
+            if adapter_id not in registry:
+                raise ValueError(
+                    f"unknown adapter_id {adapter_id!r}; load it first via "
+                    f"POST /admin/adapters (registered: {registry.ids()})")
         trace_id = None
         ctx = parse_traceparent(traceparent)
         if ctx is not None:
@@ -197,7 +223,8 @@ class ServingServer:
                                 parent=parent_id)
         handle = self.scheduler.submit(ids, sampling, timeout_s=timeout_s,
                                        max_retries=max_retries, trace=trace_id,
-                                       priority=priority, deadline_s=deadline_s)
+                                       priority=priority, deadline_s=deadline_s,
+                                       tenant=tenant, adapter_id=adapter_id)
         cid = f"cmpl-{next(self._ids)}"
         with self._live_lock:
             self._live[cid] = handle
@@ -267,6 +294,49 @@ class ServingServer:
         effective = self.scheduler.brownout.push(level, reason=reason, ttl_s=ttl_s)
         return {"level": effective, "pushed": level,
                 "brownout": self.scheduler.brownout.stats()}
+
+    def admin_adapters(self, payload: dict) -> dict:
+        """LoRA adapter hot-load/unload (POST /admin/adapters) against the
+        live engine's :class:`AdapterRegistry`. Ops::
+
+            {"op": "load", "adapter_id": str,
+             "path": str | "weights": {"<proj>.lora_A": [[...]], ...},
+             "scaling"?: float}     -> registers (idempotent on same bytes)
+            {"op": "unload", "adapter_id": str}  -> drops store + pool slot
+            {"op": "list"}                       -> ids + pool stats only
+
+        Loading only registers in the host store; the device pool slot is
+        taken lazily by the first request that decodes with the adapter.
+        Unload is refused (409 via ValueError) while any request holds it."""
+        registry = getattr(self.loop.engine, "adapter_registry", None)
+        if registry is None:
+            raise ValueError("this replica serves no LoRA adapters "
+                             "(engine has no adapter registry)")
+        op = str(payload.get("op", "list"))
+        doc: dict = {"op": op}
+        if op == "load":
+            adapter_id = str(payload.get("adapter_id") or "")
+            source = payload.get("path") if payload.get("path") is not None \
+                else payload.get("weights")
+            if source is None:
+                raise ValueError("load needs 'path' (safetensors) or 'weights'")
+            if isinstance(source, dict):
+                # JSON bodies carry nested lists; the registry wants arrays
+                source = {k: v for k, v in source.items()}
+            scaling = payload.get("scaling")
+            doc["digest"] = registry.add(
+                adapter_id, source,
+                scaling=None if scaling is None else float(scaling))
+            doc["adapter_id"] = adapter_id
+        elif op == "unload":
+            adapter_id = str(payload.get("adapter_id") or "")
+            registry.remove(adapter_id)
+            doc["adapter_id"] = adapter_id
+        elif op != "list":
+            raise ValueError(f"op must be load/unload/list, got {op!r}")
+        doc["adapters"] = registry.ids()
+        doc["stats"] = registry.stats()
+        return doc
 
     def _decode_delta(self, toks, emitted: int, final: bool = False):
         """Incremental detokenization: full-decode + diff. A trailing U+FFFD
@@ -373,6 +443,17 @@ class ServingServer:
                                     "invalid_request")
                             else:
                                 self._send_json(200, doc)
+                    elif self.path == "/admin/adapters":
+                        payload = self._read_body()
+                        if payload is not None:
+                            try:
+                                doc = server.admin_adapters(payload)
+                            except UnknownAdapterError as e:
+                                self._send_error_json(404, str(e), "unknown_adapter")
+                            except (TypeError, ValueError) as e:
+                                self._send_error_json(400, str(e), "invalid_request")
+                            else:
+                                self._send_json(200, doc)
                     elif self.path == "/admin/brownout":
                         payload = self._read_body()
                         if payload is not None:
@@ -446,7 +527,13 @@ class ServingServer:
                         self._batch_response(cid, handle)
 
             def _batch_response(self, cid: str, handle):
-                req = handle.result()  # deadline enforced by the loop
+                try:
+                    req = handle.result()  # deadline enforced by the loop
+                except UnknownAdapterError as e:
+                    # adapter hot-unloaded between the door check and engine
+                    # admission: still a client-visible 4xx, not a 500
+                    self._send_error_json(400, str(e), "unknown_adapter")
+                    return
                 choice = {"index": 0, "finish_reason": req.finish_reason if req else "abort"}
                 toks = list(req.output_ids) if req is not None else []
                 choice["token_ids"] = toks

@@ -63,6 +63,8 @@ from ..observability.tracer import TRACER
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .metrics import REGISTRY, MetricsRegistry
+from .tenancy.adapters import UnknownAdapterError
+from .tenancy.quotas import DEFAULT_TENANT
 
 __all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy",
            "ATTRIBUTION_PHASES", "request_attribution"]
@@ -159,11 +161,13 @@ class _FailedRequest:
     fields the metrics plane, the trace emitter, and the HTTP layer read."""
 
     def __init__(self, req_id, prompt_ids, output_ids, trace,
-                 arrival_t, finish_reason="engine_error"):
+                 arrival_t, finish_reason="engine_error",
+                 tenant: str = DEFAULT_TENANT):
         self.req_id = req_id if req_id is not None else -1
         self.prompt_ids = list(prompt_ids)
         self.output_ids = list(output_ids)
         self.trace = trace
+        self.tenant = tenant
         self.aborted = False
         self.done = True
         self.finish_reason = finish_reason
@@ -181,11 +185,14 @@ class RequestHandle:
 
     def __init__(self, prompt_len: int, deadline_t: Optional[float] = None,
                  trace: Optional[str] = None, max_retries: Optional[int] = None,
-                 priority: str = "interactive"):
+                 priority: str = "interactive", tenant: str = DEFAULT_TENANT,
+                 adapter_id: Optional[str] = None):
         self.req_id: Optional[int] = None  # assigned on the loop thread
         self.trace = trace  # span-tracer trace id linking this request's phases
         self.prompt_len = prompt_len
         self.priority = priority  # serving priority class (brownout shed order)
+        self.tenant = tenant  # isolation/accounting key (requests_total label)
+        self.adapter_id = adapter_id  # LoRA adapter this request decodes with
         self.depth_at_submit = 0  # engine backlog when submitted (queue-wait norm)
         self.deadline_t = deadline_t
         self.submitted_t = time.time()
@@ -295,8 +302,9 @@ class ServingMetrics:
         self.registry = r = registry or REGISTRY
         self.requests = r.counter(
             "paddlenlp_serving_requests_total",
-            "Finished requests by terminal state and serving priority class",
-            labelnames=("status", "priority"))
+            "Finished requests by terminal state, serving priority class, "
+            "and tenant",
+            labelnames=("status", "priority", "tenant"))
         self.tokens = r.counter(
             "paddlenlp_serving_tokens_generated_total", "Generated tokens (all requests)")
         self.preemptions = r.counter(
@@ -315,9 +323,11 @@ class ServingMetrics:
             "paddlenlp_serving_requests_shed_total",
             "Submissions rejected on arrival by overload controls, by reason "
             "(shed = brownout priority shed; deadline = queue-wait estimate "
-            "already blew the request's deadline_ms) and priority class — "
-            "the per-class view of the brownout ladder's shed order",
-            labelnames=("reason", "priority"))
+            "already blew the request's deadline_ms; tenant_quota = the "
+            "tenant's max_inflight admission quota was full), priority class, "
+            "and tenant — the per-class view of the brownout ladder's shed "
+            "order and the per-tenant view of isolation pushback",
+            labelnames=("reason", "priority", "tenant"))
         self.brownout_level = r.gauge(
             "paddlenlp_serving_brownout_level",
             "Current overload-brownout ladder level (0 normal, 1 shed "
@@ -533,7 +543,8 @@ class ServingMetrics:
     def on_finished(self, req):
         status = req.finish_reason or ("abort" if req.aborted else "unknown")
         self.requests.inc(status=status,
-                          priority=getattr(req, "priority", "interactive"))
+                          priority=getattr(req, "priority", "interactive"),
+                          tenant=getattr(req, "tenant", DEFAULT_TENANT))
         self.tokens.inc(len(req.output_ids))
         if req.ttft is not None:
             self.ttft.observe(req.ttft)
@@ -765,7 +776,9 @@ class EngineLoop:
     def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None,
                max_retries: Optional[int] = None,
                trace: Optional[str] = None,
-               priority: str = "interactive") -> RequestHandle:
+               priority: str = "interactive",
+               tenant: str = DEFAULT_TENANT,
+               adapter_id: Optional[str] = None) -> RequestHandle:
         """Thread-safe request submission; returns immediately with a handle.
 
         ``max_retries`` overrides the supervisor policy's per-request requeue
@@ -774,13 +787,17 @@ class EngineLoop:
         (the router's ``rtr-N`` from the traceparent header) instead of minting
         a local ``req-N`` — the key to cross-tier trace stitching.
         ``priority`` orders the engine's waiting queue (interactive ahead of
-        batch ahead of best_effort) and selects the brownout shed class."""
+        batch ahead of best_effort) and selects the brownout shed class.
+        ``tenant`` keys per-tenant quotas and metric labels; ``adapter_id``
+        selects the LoRA adapter (registry-resident or hot-loadable) the
+        engine decodes this request with — None runs the shared base model."""
         if not self.running:
             raise RuntimeError("engine loop is not running")
         deadline_t = None if deadline_s is None else time.time() + deadline_s
         handle = RequestHandle(prompt_len=len(prompt_ids), deadline_t=deadline_t,
                                trace=trace if trace is not None else f"req-{next(self._trace_seq)}",
-                               max_retries=max_retries, priority=priority)
+                               max_retries=max_retries, priority=priority,
+                               tenant=tenant, adapter_id=adapter_id)
         handle._prompt_ids = [int(t) for t in prompt_ids]
         handle._sampling = sampling
         self._cmds.put(("submit", handle, prompt_ids, sampling))
@@ -1055,7 +1072,8 @@ class EngineLoop:
     def _resolve_failed(self, handle: RequestHandle, streamed: List[int],
                         finish_reason: str = "engine_error"):
         req = _FailedRequest(handle.req_id, handle._prompt_ids or [], streamed,
-                             handle.trace, handle.submitted_t, finish_reason=finish_reason)
+                             handle.trace, handle.submitted_t,
+                             finish_reason=finish_reason, tenant=handle.tenant)
         req.aborted = finish_reason == "abort"
         req.priority = handle.priority  # requests_total{priority} label
         if handle._first_token_t is not None:
@@ -1148,6 +1166,12 @@ class EngineLoop:
                 try:
                     handle.req_id = self._add_to_engine(handle, prompt_ids,
                                                         sampling, stream_cb)
+                except UnknownAdapterError as e:
+                    # a client error (bad adapter_id), not an engine failure:
+                    # resolve the waiter without tripping the supervisor into
+                    # a degrade/rebuild cycle
+                    handle._resolve(None, error=e)
+                    continue
                 except BaseException as e:
                     # the command is consumed — resolve the waiter before the
                     # supervisor takes over, or the client blocks forever
@@ -1159,23 +1183,32 @@ class EngineLoop:
 
     def _add_to_engine(self, handle: RequestHandle, prompt_ids, sampling,
                        stream_cb, rework_hwm: int = 0) -> int:
-        """One engine submission. ``priority`` / ``rework_hwm`` are forwarded
-        only when non-default so engine stand-ins (chaos-test stubs, older
-        backends) with the narrower ``add_request`` signature keep working."""
+        """One engine submission. ``priority`` / ``rework_hwm`` / ``tenant`` /
+        ``adapter_id`` are forwarded only when non-default so engine stand-ins
+        (chaos-test stubs, older backends) with the narrower ``add_request``
+        signature keep working."""
         kw = {}
         if handle.priority != "interactive":
             kw["priority"] = handle.priority
         if rework_hwm > 0:
             kw["rework_hwm"] = rework_hwm
+        if handle.tenant != DEFAULT_TENANT:
+            kw["tenant"] = handle.tenant
+        if handle.adapter_id is not None:
+            # never dropped on TypeError: silently serving an adapter request
+            # from the base model would be a cross-tenant correctness bug
+            kw["adapter_id"] = handle.adapter_id
         try:
             return self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb,
                                            trace=handle.trace, **kw)
         except TypeError:
-            if "rework_hwm" not in kw:
+            dropped = [k for k in ("rework_hwm", "tenant") if k in kw]
+            if not dropped:
                 raise
-            # engine stand-in without the goodput kwarg: the accounting hint
-            # is best-effort, the resubmission is not
-            kw.pop("rework_hwm")
+            # engine stand-in without the goodput/tenancy kwargs: those hints
+            # are best-effort accounting, the resubmission is not
+            for k in dropped:
+                kw.pop(k)
             return self.engine.add_request(prompt_ids, sampling, stream_cb=stream_cb,
                                            trace=handle.trace, **kw)
 

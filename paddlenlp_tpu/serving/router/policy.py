@@ -64,7 +64,8 @@ class LeastLoadedPolicy:
     name = "least_loaded"
 
     def select(self, snapshots: Sequence[ReplicaSnapshot], prompt: Prompt = None,
-               exclude: FrozenSet[str] = frozenset()) -> List[ReplicaSnapshot]:
+               exclude: FrozenSet[str] = frozenset(),
+               adapter_id: Optional[str] = None) -> List[ReplicaSnapshot]:
         return sorted(_eligible(snapshots, exclude),
                       key=lambda s: (_STATE_RANK.get(s.state, 3), load_score(s), s.id))
 
@@ -126,7 +127,16 @@ class PrefixAffinityPolicy:
     spills to the SAME replica — the prefix stays co-located on two replicas
     instead of scattering). When every candidate is equally hot the pin
     stands: bouncing between uniformly-loaded replicas would only shed the
-    cache benefit. ``None`` disables spilling."""
+    cache benefit. ``None`` disables spilling.
+
+    **Adapter affinity.** A request carrying an ``adapter_id`` hashes on
+    ``a:<adapter_id>`` instead of its prompt prefix: every request for one
+    LoRA adapter lands on the same replica, whose registry pool then serves
+    the adapter warm (one hot-load instead of N, and the replica's prefix
+    cache — keyed ``(adapter_id, tokens)`` — stays coherent per adapter).
+    The same weighted spill bounds a hot adapter pin, and the ring walk is
+    the agreed failover/spill order, so a melting pin co-locates the adapter
+    on exactly one more replica."""
 
     name = "prefix_affinity"
 
@@ -143,7 +153,10 @@ class PrefixAffinityPolicy:
         self._ring_ids: Optional[Tuple[str, ...]] = None
         self._fallback = LeastLoadedPolicy()
 
-    def prefix_key(self, prompt: Prompt) -> Optional[str]:
+    def prefix_key(self, prompt: Prompt,
+                   adapter_id: Optional[str] = None) -> Optional[str]:
+        if adapter_id:
+            return "a:" + adapter_id
         if prompt is None:
             return None
         if isinstance(prompt, str):
@@ -161,8 +174,9 @@ class PrefixAffinityPolicy:
         return self._ring
 
     def select(self, snapshots: Sequence[ReplicaSnapshot], prompt: Prompt = None,
-               exclude: FrozenSet[str] = frozenset()) -> List[ReplicaSnapshot]:
-        key = self.prefix_key(prompt)
+               exclude: FrozenSet[str] = frozenset(),
+               adapter_id: Optional[str] = None) -> List[ReplicaSnapshot]:
+        key = self.prefix_key(prompt, adapter_id)
         if key is None:
             return self._fallback.select(snapshots, prompt, exclude)
         # ring membership is computed over ALL replicas (not just eligible

@@ -390,16 +390,27 @@ class RouterServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------- routing
-    def _candidates(self, prompt, exclude: set, state: _RelayState) -> List[ReplicaSnapshot]:
+    def _candidates(self, prompt, exclude: set, state: _RelayState,
+                    adapter_id: Optional[str] = None) -> List[ReplicaSnapshot]:
         """One routing decision: snapshot the pool, let the policy order it.
         Re-run per attempt so health transitions observed mid-request (a
-        candidate marked DOWN by the poller) are honored immediately."""
+        candidate marked DOWN by the poller) are honored immediately.
+        ``adapter_id`` feeds adapter affinity (forwarded only when present,
+        and dropped for policies predating the kwarg)."""
         t0 = time.perf_counter()
         with self.tracer.span("route", cat="router", trace=state.rid,
                               attempt=state.attempts, excluded=len(exclude)) as sp:
             snaps = self._adjusted_snapshots()
-            candidates = self.policy.select(snaps, prompt=prompt,
-                                            exclude=frozenset(exclude))
+            kw = {"adapter_id": adapter_id} if adapter_id is not None else {}
+            try:
+                candidates = self.policy.select(snaps, prompt=prompt,
+                                                exclude=frozenset(exclude), **kw)
+            except TypeError:
+                if not kw:
+                    raise
+                # custom policy without adapter affinity: route on prompt only
+                candidates = self.policy.select(snaps, prompt=prompt,
+                                                exclude=frozenset(exclude))
             sp.set(candidates=[c.id for c in candidates[:4]])
         self.metrics.route_decision.observe(time.perf_counter() - t0)
         return candidates
@@ -1074,16 +1085,20 @@ class RouterServer:
             self.tracer.mark_trace(rid, sampled)
         state = _RelayState(rid, bool(payload.get("stream")), sampled=sampled)
         prompt = payload.get("prompt")
+        adapter_id = payload.get("adapter_id")
+        adapter_id = str(adapter_id) if adapter_id is not None else None
         body = json.dumps(payload).encode()
         exclude: set = set()
 
         with use_trace(rid):
-            self._relay_attempts(handler, state, payload, prompt, body, exclude)
+            self._relay_attempts(handler, state, payload, prompt, body, exclude,
+                                 adapter_id=adapter_id)
 
     def _relay_attempts(self, handler, state: _RelayState, payload: dict,
-                        prompt, body: bytes, exclude: set):
+                        prompt, body: bytes, exclude: set,
+                        adapter_id: Optional[str] = None):
         while state.attempts < self.max_attempts:
-            candidates = self._candidates(prompt, exclude, state)
+            candidates = self._candidates(prompt, exclude, state, adapter_id)
             if not candidates:
                 break
             cand = candidates[0]

@@ -37,9 +37,10 @@ from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .brownout import PRIORITIES, BrownoutController, BrownoutPolicy
 from .engine_loop import EngineLoop, RequestHandle
+from .tenancy.quotas import DEFAULT_TENANT, TenantQuotas
 
 __all__ = ["Scheduler", "SchedulerConfig", "SaturatedError", "ShuttingDownError",
-           "DegradedError", "ShedError", "DeadlineUnmetError"]
+           "DegradedError", "ShedError", "DeadlineUnmetError", "TenantQuotaError"]
 
 _F_SUBMIT = FaultPoint("serving.submit")
 _F_SHED = FaultPoint("sched.shed")
@@ -52,6 +53,18 @@ class SaturatedError(Exception):
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class TenantQuotaError(SaturatedError):
+    """One tenant's ``max_inflight`` admission quota is full — shed only that
+    tenant's traffic (HTTP 429 + ``Retry-After``) while the shared window
+    stays open to everyone else. Subclasses :class:`SaturatedError` so every
+    429 path handles it without knowing about tenancy."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 tenant: str = DEFAULT_TENANT):
+        super().__init__(message, retry_after_s=retry_after_s)
+        self.tenant = tenant
 
 
 class ShuttingDownError(Exception):
@@ -107,11 +120,14 @@ class Scheduler:
 
     def __init__(self, loop: EngineLoop, config: Optional[SchedulerConfig] = None,
                  brownout: Optional[BrownoutController] = None,
-                 brownout_policy: Optional[BrownoutPolicy] = None):
+                 brownout_policy: Optional[BrownoutPolicy] = None,
+                 tenant_quotas: Optional[TenantQuotas] = None):
         self.loop = loop
         self.config = config or SchedulerConfig()
+        self.tenant_quotas = tenant_quotas
         self._lock = threading.Lock()
         self._inflight = 0  # guarded-by: _lock
+        self._tenant_inflight: dict = {}  # guarded-by: _lock
         self._draining = False  # guarded-by: _lock
         self._idle = threading.Event()
         self._idle.set()
@@ -120,6 +136,7 @@ class Scheduler:
         self.rejected_degraded = 0
         self.rejected_shed = 0
         self.rejected_deadline = 0
+        self.rejected_tenant_quota = 0
         # overload-brownout ladder: evaluated on every submission against the
         # local saturation signal (window occupancy vs the live queue-wait
         # estimate); the router/autoscaler can push a level floor on top
@@ -163,16 +180,21 @@ class Scheduler:
                max_retries: Optional[int] = None,
                trace: Optional[str] = None,
                priority: str = "interactive",
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               tenant: str = DEFAULT_TENANT,
+               adapter_id: Optional[str] = None) -> RequestHandle:
         """Admit one request or raise (SaturatedError / ShuttingDownError /
-        DegradedError / ShedError / DeadlineUnmetError). ``max_retries`` is
-        the per-request engine-rebuild requeue budget (None = supervisor
-        policy default); ``trace`` adopts an inbound cross-tier trace id
-        (None = the loop mints ``req-N``). ``priority`` selects the brownout
-        shed class and the engine's admission order; ``deadline_s`` is the
-        request's total latency budget — rejected on arrival when the live
-        queue-wait estimate already exceeds it, and enforced as the engine
-        deadline otherwise."""
+        DegradedError / ShedError / DeadlineUnmetError / TenantQuotaError).
+        ``max_retries`` is the per-request engine-rebuild requeue budget
+        (None = supervisor policy default); ``trace`` adopts an inbound
+        cross-tier trace id (None = the loop mints ``req-N``). ``priority``
+        selects the brownout shed class and the engine's admission order;
+        ``deadline_s`` is the request's total latency budget — rejected on
+        arrival when the live queue-wait estimate already exceeds it, and
+        enforced as the engine deadline otherwise. ``tenant`` keys the
+        per-tenant ``max_inflight`` quota (a full quota sheds only that
+        tenant) and the tenant label on every shed/finish metric;
+        ``adapter_id`` selects the LoRA adapter the engine decodes with."""
         cfg = self.config
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, got {priority!r}")
@@ -191,7 +213,8 @@ class Scheduler:
         if self.brownout.should_shed(priority):
             self.rejected_shed += 1
             _F_SHED.fire(priority=priority)
-            self.loop.metrics.shed.inc(reason="shed", priority=priority)
+            self.loop.metrics.shed.inc(reason="shed", priority=priority,
+                                       tenant=tenant)
             retry_after = self.loop.queue_wait_estimate()
             RECORDER.record("sched.reject", trace=trace, reason="shed",
                             level=level)
@@ -205,7 +228,8 @@ class Scheduler:
             estimate = self.loop.queue_wait_estimate()
             if estimate > deadline_s:
                 self.rejected_deadline += 1
-                self.loop.metrics.shed.inc(reason="deadline", priority=priority)
+                self.loop.metrics.shed.inc(reason="deadline", priority=priority,
+                                           tenant=tenant)
                 RECORDER.record("sched.reject", trace=trace, reason="deadline",
                                 estimate_s=round(estimate, 4))
                 TRACER.instant("admission_rejected", cat="scheduler",
@@ -236,7 +260,25 @@ class Scheduler:
                 raise SaturatedError(
                     f"in-flight window full ({self._inflight}/{cfg.max_inflight}); retry later",
                     retry_after_s=retry_after)
+            tcap = None if self.tenant_quotas is None \
+                else self.tenant_quotas.max_inflight(tenant)
+            if tcap is not None and self._tenant_inflight.get(tenant, 0) >= tcap:
+                # per-tenant isolation: one tenant at its quota sheds only its
+                # OWN traffic — the shared window stays open to everyone else
+                self.rejected_tenant_quota += 1
+                self.loop.metrics.shed.inc(reason="tenant_quota",
+                                           priority=priority, tenant=tenant)
+                retry_after = self.loop.queue_wait_estimate()
+                RECORDER.record("sched.reject", trace=trace, reason="tenant_quota",
+                                tenant=tenant, inflight=self._tenant_inflight.get(tenant, 0))
+                TRACER.instant("admission_rejected", cat="scheduler",
+                               reason="tenant_quota", tenant=tenant)
+                raise TenantQuotaError(
+                    f"tenant {tenant!r} at its max_inflight quota "
+                    f"({self._tenant_inflight.get(tenant, 0)}/{tcap}); retry later",
+                    retry_after_s=retry_after, tenant=tenant)
             self._inflight += 1
+            self._tenant_inflight[tenant] = self._tenant_inflight.get(tenant, 0) + 1
             self._idle.clear()
         deadline = timeout_s if timeout_s is not None else cfg.default_timeout_s
         if deadline_s is not None:
@@ -251,23 +293,29 @@ class Scheduler:
             t0 = time.perf_counter()
             handle = self.loop.submit(prompt_ids, sampling, deadline_s=deadline,
                                       max_retries=max_retries, trace=trace,
-                                      priority=priority)
+                                      priority=priority, tenant=tenant,
+                                      adapter_id=adapter_id)
             TRACER.add_span("admission", TRACER.epoch_time(t0),
                             time.perf_counter() - t0, cat="scheduler",
                             trace=handle.trace, prompt_len=len(prompt_ids))
         except BaseException:
-            self._release()
+            self._release(tenant)
             raise
         # release the window slot the moment the request resolves (any reason)
-        handle.add_done_callback(lambda _h: self._release())
+        handle.add_done_callback(lambda _h: self._release(tenant))
         return handle
 
     def cancel(self, handle: RequestHandle):
         self.loop.cancel(handle)
 
-    def _release(self):
+    def _release(self, tenant: str = DEFAULT_TENANT):
         with self._lock:
             self._inflight -= 1
+            n = self._tenant_inflight.get(tenant, 0) - 1
+            if n > 0:
+                self._tenant_inflight[tenant] = n
+            else:
+                self._tenant_inflight.pop(tenant, None)
             if self._inflight <= 0:
                 self._idle.set()
 
@@ -276,6 +324,11 @@ class Scheduler:
     def inflight(self) -> int:
         with self._lock:
             return self._inflight
+
+    def tenant_inflight(self) -> dict:
+        """Snapshot of in-flight counts by tenant (quota bookkeeping view)."""
+        with self._lock:
+            return dict(self._tenant_inflight)
 
     @property
     def draining(self) -> bool:
@@ -297,6 +350,14 @@ class Scheduler:
             "rejected_degraded": self.rejected_degraded,
             "rejected_shed": self.rejected_shed,
             "rejected_deadline": self.rejected_deadline,
+            "rejected_tenant_quota": self.rejected_tenant_quota,
+            # per-tenant occupancy of the shared window (tenants currently at
+            # zero drop out) + the configured quotas, for /health visibility
+            "tenants": {
+                "inflight": self.tenant_inflight(),
+                "quotas": self.tenant_quotas.describe()
+                if self.tenant_quotas is not None else None,
+            },
             # the overload ladder, surfaced on /health so the router's pool
             # snapshots (and operators) see a replica shedding before it 503s
             "brownout": self.brownout.stats(),

@@ -1,0 +1,32 @@
+"""Multi-tenant serving: batched multi-LoRA adapters + per-tenant isolation.
+
+Two halves, deliberately separable:
+
+- :mod:`.adapters` — the :class:`AdapterRegistry`: a content-addressed host
+  store of LoRA A/B weight pairs plus a fixed-size device-resident adapter
+  pool with refcount + LRU eviction (``paged_cache.BlockManager``'s block
+  discipline applied to adapter slots). The engine acquires a pool slot per
+  admitted request and the backend gathers per-row deltas from the pool
+  inside the unchanged jitted step programs.
+- :mod:`.quotas` — :class:`TenantQuotas`: per-tenant admission limits (max
+  inflight, KV-block share) that ride the existing priority classes, plus
+  the per-tenant goodput fold over the engine's request-attributed token
+  accounting.
+"""
+
+from .adapters import (AdapterPressure, AdapterRegistry, UnknownAdapterError,
+                       adapter_dims_from_config, PROJ_NAMES)
+from .quotas import (DEFAULT_TENANT, TenantQuota, TenantQuotas,
+                     tenant_goodput_fold)
+
+__all__ = [
+    "AdapterPressure",
+    "AdapterRegistry",
+    "UnknownAdapterError",
+    "adapter_dims_from_config",
+    "PROJ_NAMES",
+    "DEFAULT_TENANT",
+    "TenantQuota",
+    "TenantQuotas",
+    "tenant_goodput_fold",
+]

@@ -106,6 +106,13 @@ CATALOG: Dict[str, str] = {
                            "poisoned request, before its KV blocks are released — a "
                            "failure here escalates to the full engine rebuild path "
                            "(DEGRADED, triage, rebuild) deterministically.",
+    "engine.adapter_load": "Inside AdapterRegistry.acquire, after the pool-slot "
+                           "decision but before the adapter weights land in the "
+                           "device pool — the failure carries the acquiring "
+                           "request's req_id so the supervisor quarantines ONLY "
+                           "that tenant's request (engine_error / token-exact "
+                           "retry); other tenants' streams must be uninterrupted "
+                           "and no adapter slot or KV block may leak.",
 }
 
 

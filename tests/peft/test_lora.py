@@ -102,6 +102,49 @@ class TestLoRA:
         merged_logits = merged_model(input_ids=ids).logits
         np.testing.assert_allclose(np.asarray(adapted), np.asarray(merged_logits), atol=1e-5)
 
+    def test_export_adapter_registry_roundtrip(self, tmp_path):
+        """export_adapter() -> AdapterRegistry.add round-trip: the serving
+        pool's canonical weights are exactly A and scaling-folded B, via both
+        the safetensors file (scaling in metadata) and the in-memory dict."""
+        from paddlenlp_tpu.serving.tenancy import AdapterRegistry
+
+        model = tiny_model()
+        lora = LoRAModel(model, LoRAConfig(r=4, lora_alpha=8))  # scaling = 2.0
+        flat = flatten_params(lora.params)
+        for p in flat:
+            if p.endswith("lora_B"):
+                flat[p] = jnp.ones_like(flat[p]) * 0.01
+        from paddlenlp_tpu.transformers.conversion_utils import unflatten_params
+
+        lora.params = unflatten_params(flat)
+        path = str(tmp_path / "adapter.safetensors")
+        exported = lora.export_adapter(path)
+        assert exported["q_proj.lora_A"].shape == (2, 64, 4)
+        assert exported["q_proj.lora_B"].shape == (2, 4, 64)
+        assert exported["down_proj.lora_B"].shape == (2, 4, 64)
+        assert len(exported) == 14  # 7 projections x A/B
+
+        registry = AdapterRegistry(config=model.config, max_rank=4)
+        digest = registry.add("tuned", exported, scaling=lora.lora_config.scaling)
+        w = registry.weights_of("tuned")
+        np.testing.assert_allclose(w["q_proj"]["A"], exported["q_proj.lora_A"], atol=0)
+        np.testing.assert_allclose(  # scaling folded into B at add time
+            w["q_proj"]["B"], exported["q_proj.lora_B"] * 2.0, rtol=1e-6)
+        # same bytes -> same digest: re-add is an idempotent no-op
+        assert registry.add("tuned", exported,
+                            scaling=lora.lora_config.scaling) == digest
+        # the safetensors file (scaling riding in its metadata) is an
+        # equivalent add source and content-addresses to the same digest
+        registry2 = AdapterRegistry(config=model.config, max_rank=4)
+        assert registry2.add("from-file", path) == digest
+
+    def test_export_adapter_stacks_unscanned_layers(self):
+        model = tiny_model(use_scan_layers=False)
+        lora = LoRAModel(model, LoRAConfig(r=4))
+        exported = lora.export_adapter()
+        # per-layer [in, r] leaves stack into the scanned [L, in, r] layout
+        assert exported["q_proj.lora_A"].shape == (2, 64, 4)
+
     def test_generate_with_adapters(self):
         model = tiny_model()
         lora = LoRAModel(model, LoRAConfig(r=4))

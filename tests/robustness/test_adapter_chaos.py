@@ -1,0 +1,253 @@
+"""Multi-LoRA adapter chaos (ISSUE 16 acceptance, robustness side).
+
+Two incidents against the multi-tenant serving stack:
+
+- ``engine.adapter_load`` armed mid-stream: a poisoned adapter hot-load is
+  attributed to the ONE request that asked for it — non-retryable requests
+  resolve in-band with ``finish_reason="engine_error"``, retryable ones
+  complete token-exact, every other tenant's stream decodes uninterrupted,
+  and the pool never leaks a slot (the full-rebuild path stays cold);
+- eviction under pressure: with every pool slot pinned by in-flight
+  requests, a third adapter's admission defers (like KV pressure) instead
+  of evicting an in-use adapter; the moment a pin drops it loads into the
+  LRU-evicted slot and finishes token-exact.
+
+CPU-only, tiny model — tier-1 speed."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, ServingServer
+from paddlenlp_tpu.serving.tenancy import AdapterRegistry
+from paddlenlp_tpu.serving.tenancy.adapters import adapter_dims_from_config
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+GEN_LEN = 24
+ENG_KW = dict(max_batch_size=4, block_size=4, num_blocks=128,
+              max_blocks_per_seq=32, decode_steps=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def adapter_source(cfg, idx, rank=4):
+    rng = np.random.default_rng(1000 + idx)
+    return {proj: {"A": rng.standard_normal((cfg.num_hidden_layers, d_in, rank)).astype(np.float32) * 0.02,
+                   "B": rng.standard_normal((cfg.num_hidden_layers, rank, d_out)).astype(np.float32) * 0.02}
+            for proj, (d_in, d_out) in adapter_dims_from_config(cfg).items()}
+
+
+def make_registry(cfg, ids, pool_slots):
+    reg = AdapterRegistry(config=cfg, max_rank=4, pool_slots=pool_slots)
+    for i, aid in enumerate(ids):
+        reg.add(aid, adapter_source(cfg, i))
+    return reg
+
+
+def solo_tokens(model, registry, prompt, adapter_id, n=GEN_LEN):
+    """Uncontended single-request run: the token-identity reference."""
+    eng = InferenceEngine(model, adapter_registry=registry, **ENG_KW)
+    rid = eng.add_request(list(prompt), SamplingParams(max_new_tokens=n),
+                          adapter_id=adapter_id)
+    done = {}
+    while eng.has_work():
+        for req in eng.step():
+            done[req.req_id] = req
+    return done[rid].output_ids
+
+
+def assert_no_slot_leak(reg):
+    st = reg.stats()
+    assert st["pinned"] == 0, st
+    assert st["free_slots"] + st["resident"] == st["pool_slots"], st
+
+
+class Stream(threading.Thread):
+    """One SSE completion; records tokens/finish and flags the first token."""
+
+    def __init__(self, port, payload):
+        super().__init__()
+        self.port, self.payload = port, dict(payload)
+        self.payload.setdefault("stream", True)
+        self.tokens, self.finish, self.error = [], None, None
+        self.first_token = threading.Event()
+
+    def run(self):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=300)
+            conn.request("POST", "/v1/completions", body=json.dumps(self.payload),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data: ") or line == b"data: [DONE]":
+                    if line == b"data: [DONE]":
+                        break
+                    continue
+                c = json.loads(line[len(b"data: "):])["choices"][0]
+                if c.get("finish_reason"):
+                    self.finish = c["finish_reason"]
+                elif "token" in c:
+                    self.tokens.append(c["token"])
+                    self.first_token.set()
+            conn.close()
+        except Exception as e:  # surfaced by the main thread's asserts
+            self.error = e
+
+
+class TestAdapterLoadFault:
+    def test_poisoned_hot_load_quarantines_only_its_tenant(self, model):
+        cfg = model.config
+        registry = make_registry(cfg, ["ad-a", "ad-b"], pool_slots=4)
+        metrics = MetricsRegistry()
+        srv = ServingServer(
+            InferenceEngine(model, adapter_registry=registry, **ENG_KW),
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0),
+            registry=metrics)
+        port = srv.start_in_thread()
+        try:
+            # two bystander tenants decoding BEFORE the fault arms: one on an
+            # already-resident adapter, one on the base model
+            bystanders = [
+                Stream(port, {"prompt": [5, 6, 7], "max_tokens": GEN_LEN,
+                              "adapter_id": "ad-b", "tenant": "globex"}),
+                Stream(port, {"prompt": [8, 9, 10], "max_tokens": GEN_LEN,
+                              "tenant": "base"}),
+            ]
+            for s in bystanders:
+                s.start()
+            for s in bystanders:
+                assert s.first_token.wait(timeout=120), s.error
+
+            # the NEXT adapter hot-load is poisoned; ad-a is not resident, so
+            # the acme request below is the one that trips it
+            FAULTS.arm("engine.adapter_load", nth=1)
+            victim = Stream(port, {"prompt": [11, 12, 13], "max_tokens": GEN_LEN,
+                                   "adapter_id": "ad-a", "tenant": "acme",
+                                   "max_retries": 0})
+            victim.start()
+            victim.join(timeout=300)
+            assert not victim.is_alive() and victim.error is None
+            # in-band engine_error for the poisoned tenant, nobody else
+            assert victim.finish == "engine_error", victim.finish
+            assert len(victim.tokens) < GEN_LEN
+
+            for s in bystanders:
+                s.join(timeout=300)
+                assert s.error is None
+                assert s.finish == "length" and len(s.tokens) == GEN_LEN
+
+            # slot-level quarantine, not a full engine rebuild
+            assert metrics.get(
+                "paddlenlp_serving_slot_quarantines_total").value() >= 1
+            restarts = metrics.get("paddlenlp_serving_engine_restarts_total")
+            assert restarts is None or (restarts.value() or 0) == 0
+            assert not srv.loop.degraded
+
+            # the fault consumed its one shot: the SAME adapter now loads and
+            # finishes token-exact against an uncontended reference run
+            retry = Stream(port, {"prompt": [11, 12, 13], "max_tokens": GEN_LEN,
+                                  "adapter_id": "ad-a", "tenant": "acme"})
+            retry.start()
+            retry.join(timeout=300)
+            assert retry.error is None and retry.finish == "length"
+            np.testing.assert_array_equal(
+                retry.tokens,
+                solo_tokens(model, make_registry(cfg, ["ad-a", "ad-b"], 4),
+                            [11, 12, 13], "ad-a"))
+            # bystander token-identity: the incident next door changed nothing
+            np.testing.assert_array_equal(
+                bystanders[0].tokens,
+                solo_tokens(model, make_registry(cfg, ["ad-a", "ad-b"], 4),
+                            [5, 6, 7], "ad-b"))
+
+            # tenant label lands on the failure accounting too
+            text = metrics.expose()
+            assert ('paddlenlp_serving_requests_total{status="engine_error",'
+                    'priority="interactive",tenant="acme"}') in text
+            assert ('paddlenlp_serving_requests_total{status="length",'
+                    'priority="interactive",tenant="globex"}') in text
+
+            assert_no_slot_leak(registry)
+            free0 = srv.loop.engine.mgr.num_free
+            assert free0 == srv.loop.engine.mgr.total_usable_blocks \
+                or srv.loop.engine.prefix_cache_enabled
+        finally:
+            srv.shutdown(drain_timeout_s=5)
+
+
+class TestEvictionUnderPressure:
+    def test_pinned_adapters_survive_pool_pressure(self, model):
+        cfg = model.config
+        registry = make_registry(cfg, ["ad-a", "ad-b", "ad-c"], pool_slots=2)
+        srv = ServingServer(
+            InferenceEngine(model, adapter_registry=registry, **ENG_KW),
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0),
+            registry=MetricsRegistry())
+        port = srv.start_in_thread()
+        try:
+            pinned = [
+                Stream(port, {"prompt": [5, 6, 7], "max_tokens": 32,
+                              "adapter_id": "ad-a", "tenant": "acme"}),
+                Stream(port, {"prompt": [8, 9, 10], "max_tokens": 32,
+                              "adapter_id": "ad-b", "tenant": "globex"}),
+            ]
+            for s in pinned:
+                s.start()
+            for s in pinned:
+                assert s.first_token.wait(timeout=120), s.error
+            assert registry.stats()["pinned"] == 2
+
+            # both slots pinned: ad-c's admission must DEFER (adapter
+            # pressure), never evict an in-use adapter
+            misses0 = registry.misses
+            third = Stream(port, {"prompt": [11, 12, 13], "max_tokens": 8,
+                                  "adapter_id": "ad-c", "tenant": "initech"})
+            third.start()
+            deadline = time.time() + 60
+            while time.time() < deadline and registry.misses == misses0:
+                time.sleep(0.005)
+            assert registry.misses > misses0, "ad-c admission never attempted"
+            if not (pinned[0].finish or pinned[1].finish):
+                # pressure window still open: the residents must be the two
+                # pinned adapters, untouched
+                assert set(registry.resident()) == {"ad-a", "ad-b"}
+                assert registry.stats()["evictions"] == 0
+
+            for s in pinned + [third]:
+                s.join(timeout=300)
+                assert s.error is None
+                assert s.finish == "length", (s.payload, s.finish)
+            # the deferred adapter eventually evicted a RELEASED slot and ran
+            assert registry.stats()["evictions"] >= 1
+            assert "ad-c" in registry.resident()
+            np.testing.assert_array_equal(
+                third.tokens,
+                solo_tokens(model, make_registry(cfg, ["ad-a", "ad-b", "ad-c"], 2),
+                            [11, 12, 13], "ad-c", n=8))
+            assert_no_slot_leak(registry)
+        finally:
+            srv.shutdown(drain_timeout_s=5)

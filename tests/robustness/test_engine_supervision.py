@@ -260,7 +260,7 @@ class TestSupervisor:
             assert h.retries == 1
             # whatever streamed before the final failure is preserved
             assert req.output_ids == list(h._streamed)
-            assert registry.get("paddlenlp_serving_requests_total").value(status="engine_error", priority="interactive") == 1
+            assert registry.get("paddlenlp_serving_requests_total").value(status="engine_error", priority="interactive", tenant="default") == 1
         finally:
             loop.stop(drain=False)
 
@@ -356,8 +356,8 @@ class TestSupervisor:
             release.set()  # now the engine explodes with the cancel pending
             req = h.result(timeout=10)
             assert req.finish_reason == "abort" and req.aborted
-            assert registry.get("paddlenlp_serving_requests_total").value(status="abort", priority="interactive") == 1
-            assert registry.get("paddlenlp_serving_requests_total").value(status="engine_error", priority="interactive") == 0
+            assert registry.get("paddlenlp_serving_requests_total").value(status="abort", priority="interactive", tenant="default") == 1
+            assert registry.get("paddlenlp_serving_requests_total").value(status="engine_error", priority="interactive", tenant="default") == 0
         finally:
             loop.stop(drain=False)
 

@@ -210,8 +210,8 @@ class TestServingRecovery:
                 'paddlenlp_serving_wasted_tokens_total{kind="rework"}') >= 1
             assert srv.loop.engine.ledger.verify_conservation()
             assert srv.loop.engine.ledger.rework_by["requeue_refill"] >= 1
-            assert 'paddlenlp_serving_requests_total{status="engine_error",priority="interactive"}' in text
-            assert 'paddlenlp_serving_requests_total{status="length",priority="interactive"}' in text
+            assert 'paddlenlp_serving_requests_total{status="engine_error",priority="interactive",tenant="default"}' in text
+            assert 'paddlenlp_serving_requests_total{status="length",priority="interactive",tenant="default"}' in text
 
             # ---- post-recovery health + fresh traffic ----
             status, health, _ = get_json(port, "/health")

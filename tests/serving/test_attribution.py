@@ -100,7 +100,7 @@ class TestAttributionParity:
         _complete(port, [40, 41, 42], max_tokens=4)
         hist = server.registry.get("paddlenlp_serving_latency_attribution_seconds")
         n_finished = server.registry.get(
-            "paddlenlp_serving_requests_total").value(status="length", priority="interactive")
+            "paddlenlp_serving_requests_total").value(status="length", priority="interactive", tenant="default")
         for phase in ATTRIBUTION_PHASES:
             # one observation per phase per finished request
             assert hist.count(phase=phase) == n_finished, phase

@@ -201,7 +201,7 @@ class TestPriorityShedOverHTTP:
             _s, health = get_json(port, "/health")
             assert health["brownout"] >= 1
             assert health["scheduler"]["rejected_shed"] == 1
-            assert srv.loop.metrics.shed.value(reason="shed", priority="best_effort") == 1.0
+            assert srv.loop.metrics.shed.value(reason="shed", priority="best_effort", tenant="default") == 1.0
         finally:
             post_json(port, "/admin/brownout", {"level": 0})
         assert srv.scheduler.brownout.level == 0

@@ -114,7 +114,7 @@ class TestReplicaEndpoint:
         # the batch-priority request is visible per class (PR-14 brownout
         # ladder observability satellite)
         assert fams["paddlenlp_serving_requests_total"].value(
-            status="length", priority="batch") >= 1
+            status="length", priority="batch", tenant="default") >= 1
         assert "paddlenlp_serving_step_gap_seconds_bucket" in text
         assert "paddlenlp_serving_jit_shape_buckets" in text
 
@@ -128,7 +128,7 @@ class TestReplicaEndpoint:
             assert status == 503
             assert body["error"]["type"] == "overloaded_shed"
             assert srv.loop.metrics.shed.value(
-                reason="shed", priority="best_effort") >= 1
+                reason="shed", priority="best_effort", tenant="default") >= 1
         finally:
             srv.scheduler.brownout.push(0, reason="slo_fast_burn")
 

@@ -1,0 +1,303 @@
+"""Batched multi-LoRA token identity + the multi-tenant serving plane (ISSUE 16).
+
+The load-bearing property: a request decoding in a MIXED batch — rows on
+three different adapters and a base-model row, all in one jitted step — must
+produce bitwise the tokens of an uncontended solo run. Greedy, seeded
+sampling and penalties; and the identity must survive the chunked-prefill x
+prefix-cache x tensor-parallel matrix. The prefix cache is keyed
+``(adapter_id, tokens)``: base KV must never warm an adapter's prompt or
+vice versa.
+
+HTTP side: ``POST /admin/adapters`` hot-load/unload/list, per-tenant
+``max_inflight`` quota (429 while other tenants admit), and tenant-labeled
+metrics + per-tenant goodput.
+
+CPU-only, tiny model — tier-1 speed."""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, ServingServer
+from paddlenlp_tpu.serving.tenancy import AdapterRegistry, TenantQuotas
+from paddlenlp_tpu.serving.tenancy.adapters import adapter_dims_from_config
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.safetensors_io import save_file
+
+ENG_KW = dict(max_batch_size=4, block_size=4, num_blocks=128,
+              max_blocks_per_seq=32, decode_steps=4)
+ADAPTER_IDS = ("ad-a", "ad-b", "ad-c")
+GEN = 12
+#: four mixed rows: three adapters + one base-model row, prompts long enough
+#: (12 tokens) that an 8-token prefill chunk actually splits them
+JOBS = [([3 + j, 7, 11, 2, 9, 4, 8, 6, 5, 10, 12, 13 + j], aid)
+        for j, aid in enumerate([*ADAPTER_IDS, None])]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def adapter_source(cfg, idx, rank=4):
+    rng = np.random.default_rng(1000 + idx)
+    return {proj: {"A": rng.standard_normal((cfg.num_hidden_layers, d_in, rank)).astype(np.float32) * 0.02,
+                   "B": rng.standard_normal((cfg.num_hidden_layers, rank, d_out)).astype(np.float32) * 0.02}
+            for proj, (d_in, d_out) in adapter_dims_from_config(cfg).items()}
+
+
+def make_registry(cfg, pool_slots=4):
+    reg = AdapterRegistry(config=cfg, max_rank=4, pool_slots=pool_slots)
+    for i, aid in enumerate(ADAPTER_IDS):
+        reg.add(aid, adapter_source(cfg, i))
+    return reg
+
+
+def run_jobs(eng, jobs, sampling):
+    """Submit every job, then drain — rows decode batched together."""
+    ids = [eng.add_request(list(p), sampling, adapter_id=aid) for p, aid in jobs]
+    done = {}
+    while eng.has_work():
+        for req in eng.step():
+            done[req.req_id] = req
+    return [done[i].output_ids for i in ids]
+
+
+def solo(model, job, sampling, **eng_kw):
+    """One-request run on a fresh engine + registry: the identity reference."""
+    kw = dict(ENG_KW, **eng_kw)
+    eng = InferenceEngine(model, adapter_registry=make_registry(model.config), **kw)
+    return run_jobs(eng, [job], sampling)[0]
+
+
+GREEDY = SamplingParams(max_new_tokens=GEN)
+SAMPLED = SamplingParams(max_new_tokens=GEN, do_sample=True, temperature=0.8,
+                         top_p=0.9, top_k=8, seed=7, repetition_penalty=1.2,
+                         presence_penalty=0.1, frequency_penalty=0.1)
+
+
+class TestBatchedIdentity:
+    @pytest.mark.parametrize("sampling", [GREEDY, SAMPLED],
+                             ids=["greedy", "sampled_penalties"])
+    def test_mixed_batch_bitwise_equals_solo(self, model, sampling):
+        eng = InferenceEngine(model, adapter_registry=make_registry(model.config),
+                              **ENG_KW)
+        batched = run_jobs(eng, JOBS, sampling)
+        for (prompt, aid), got in zip(JOBS, batched):
+            assert len(got) == GEN
+            np.testing.assert_array_equal(
+                got, solo(model, (prompt, aid), sampling),
+                err_msg=f"adapter={aid}")
+
+    def test_adapters_actually_steer(self, model):
+        """The deltas are live: with deltas strong enough to flip argmax,
+        every adapter's output differs from base and from each other (guards
+        against a silently-zero gather)."""
+        cfg = model.config
+        reg = AdapterRegistry(config=cfg, max_rank=4, pool_slots=4)
+        for i, aid in enumerate(ADAPTER_IDS):
+            reg.add(aid, adapter_source(cfg, i), scaling=40.0)
+        eng = InferenceEngine(model, adapter_registry=reg, **ENG_KW)
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12]
+        outs = run_jobs(eng, [(prompt, aid) for aid in (*ADAPTER_IDS, None)],
+                        SamplingParams(max_new_tokens=16))
+        seen = {tuple(o) for o in outs}
+        assert len(seen) == 4, "some adapter produced base-model tokens"
+
+
+class TestExecutionMatrix:
+    """Chunked prefill x prefix cache x tensor parallel: every cell's mixed
+    batch must match the PLAIN single-device engine's solo tokens bitwise —
+    the stronger form of identity (the matrix features are exact
+    transformations, not approximations)."""
+
+    @pytest.fixture(scope="class")
+    def reference(self, model):
+        return [solo(model, job, GREEDY) for job in JOBS]
+
+    @pytest.mark.parametrize("eng_kw", [
+        dict(prefill_chunk_tokens=8),
+        dict(mesh_shape=(1, 2)),
+        dict(mesh_shape=(1, 2), prefill_chunk_tokens=8),
+        dict(mesh_shape=(1, 2), prefill_chunk_tokens=8,
+             enable_prefix_cache=False),
+    ], ids=["chunked", "tp2", "tp2_chunked", "tp2_chunked_nocache"])
+    def test_cell_matches_plain_solo(self, model, reference, eng_kw):
+        eng = InferenceEngine(model, adapter_registry=make_registry(model.config),
+                              **dict(ENG_KW, **eng_kw))
+        batched = run_jobs(eng, JOBS, GREEDY)
+        for (prompt, aid), got, want in zip(JOBS, batched, reference):
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"adapter={aid} cell={eng_kw}")
+
+
+class TestPrefixCacheSalting:
+    def test_cache_keyed_by_adapter_id(self, model):
+        """Same prompt, different adapter => no cache reuse; same prompt,
+        same adapter => warm hit with identical tokens."""
+        eng = InferenceEngine(model, adapter_registry=make_registry(model.config),
+                              **ENG_KW)
+        prompt = [3, 7, 11, 2, 9, 4, 8, 6, 5, 10, 12, 13]  # 3 full blocks
+        first = run_jobs(eng, [(prompt, "ad-a")], GREEDY)[0]
+        assert eng.mgr.cache_hits == 0
+
+        # base-model rerun of the SAME prompt: the ad-a KV (base+delta
+        # product) must not serve it — and the tokens must be pure base
+        base = run_jobs(eng, [(prompt, None)], GREEDY)[0]
+        assert eng.mgr.cache_hits == 0, "adapter KV leaked into a base request"
+        np.testing.assert_array_equal(base, solo(model, (prompt, None), GREEDY))
+        assert base != first
+
+        # cross-adapter rerun: ad-b must not reuse ad-a's blocks either
+        run_jobs(eng, [(prompt, "ad-b")], GREEDY)
+        assert eng.mgr.cache_hits == 0, "adapter KV leaked across adapters"
+
+        # same-adapter rerun: NOW the cache engages, tokens unchanged
+        again = run_jobs(eng, [(prompt, "ad-a")], GREEDY)[0]
+        assert eng.mgr.cache_hits == 1
+        np.testing.assert_array_equal(again, first)
+
+
+def post(port, path, payload, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+class TestServingPlane:
+    def test_admin_adapters_hot_load_unload(self, model, tmp_path):
+        cfg = model.config
+        srv = ServingServer(
+            InferenceEngine(model, adapter_registry=make_registry(cfg), **ENG_KW),
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0),
+            registry=MetricsRegistry())
+        port = srv.start_in_thread()
+        try:
+            status, doc = post(port, "/admin/adapters", {"op": "list"})
+            assert status == 200 and doc["adapters"] == sorted(ADAPTER_IDS)
+
+            # unknown adapter on a completion: the door check answers 400
+            # with the registered ids, before anything is admitted
+            status, doc = post(port, "/v1/completions",
+                               {"prompt": [5, 6, 7], "max_tokens": 2,
+                                "adapter_id": "nope"})
+            assert status == 400 and "ad-a" in doc["error"]["message"]
+
+            # hot-load a 4th adapter from an export-format safetensors file
+            src = adapter_source(cfg, 9)
+            path = str(tmp_path / "ad-new.safetensors")
+            save_file({f"{proj}.{m}": w["A"] if m == "lora_A" else w["B"]
+                       for proj, w in src.items() for m in ("lora_A", "lora_B")},
+                      path, metadata={"format": "np", "scaling": "1.0"})
+            status, doc = post(port, "/admin/adapters",
+                               {"op": "load", "adapter_id": "ad-new", "path": path})
+            assert status == 200 and "ad-new" in doc["adapters"] and doc["digest"]
+
+            # the hot-loaded adapter serves token-exact vs a solo engine that
+            # registered the same weights at construction time
+            status, doc = post(port, "/v1/completions",
+                               {"prompt": [5, 6, 7, 8], "max_tokens": 8,
+                                "adapter_id": "ad-new"})
+            assert status == 200
+            reg2 = make_registry(cfg)
+            reg2.add("ad-new", src)
+            eng2 = InferenceEngine(model, adapter_registry=reg2, **ENG_KW)
+            np.testing.assert_array_equal(
+                doc["choices"][0]["token_ids"],
+                run_jobs(eng2, [([5, 6, 7, 8], "ad-new")],
+                         SamplingParams(max_new_tokens=8))[0])
+
+            status, doc = post(port, "/admin/adapters",
+                               {"op": "unload", "adapter_id": "ad-new"})
+            assert status == 200 and "ad-new" not in doc["adapters"]
+            status, _ = post(port, "/admin/adapters",
+                             {"op": "unload", "adapter_id": "ad-new"})
+            assert status == 404
+        finally:
+            srv.shutdown(drain_timeout_s=5)
+
+    def test_tenant_quota_sheds_only_the_capped_tenant(self, model):
+        metrics = MetricsRegistry()
+        srv = ServingServer(
+            InferenceEngine(model, adapter_registry=make_registry(model.config),
+                            **ENG_KW),
+            scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0),
+            tenant_quotas=TenantQuotas({"acme": {"max_inflight": 1}}),
+            registry=metrics)
+        port = srv.start_in_thread()
+        try:
+            first_token = threading.Event()
+            long_result = {}
+
+            def long_stream():
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+                conn.request("POST", "/v1/completions",
+                             body=json.dumps({"prompt": [5, 6, 7], "max_tokens": 32,
+                                              "stream": True, "tenant": "acme",
+                                              "adapter_id": "ad-a"}),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                long_result["status"] = resp.status
+                toks = []
+                while True:
+                    line = resp.readline()
+                    if not line or line.strip() == b"data: [DONE]":
+                        break
+                    line = line.strip()
+                    if line.startswith(b"data: "):
+                        c = json.loads(line[len(b"data: "):])["choices"][0]
+                        if "token" in c:
+                            toks.append(c["token"])
+                            first_token.set()
+                conn.close()
+                long_result["tokens"] = toks
+
+            t = threading.Thread(target=long_stream)
+            t.start()
+            assert first_token.wait(timeout=120)
+
+            # acme is at its 1-inflight cap: shed with 429 + Retry-After...
+            status, doc = post(port, "/v1/completions",
+                               {"prompt": [8, 9], "max_tokens": 2, "tenant": "acme"})
+            assert status == 429, doc
+            assert doc["error"]["type"] == "rate_limit_exceeded"
+            # ...while an uncapped tenant admits normally, same instant
+            status, doc = post(port, "/v1/completions",
+                               {"prompt": [8, 9], "max_tokens": 2, "tenant": "globex"})
+            assert status == 200, doc
+
+            t.join(timeout=300)
+            assert long_result["status"] == 200 and len(long_result["tokens"]) == 32
+
+            # cap releases with the stream: acme admits again
+            status, _ = post(port, "/v1/completions",
+                             {"prompt": [8, 9], "max_tokens": 2, "tenant": "acme"})
+            assert status == 200
+
+            # tenant-labeled accounting on both counters
+            text = metrics.expose()
+            assert ('paddlenlp_serving_requests_shed_total{reason="tenant_quota",'
+                    'priority="interactive",tenant="acme"}') in text
+            assert ('paddlenlp_serving_requests_total{status="length",'
+                    'priority="interactive",tenant="globex"}') in text
+            assert srv.scheduler.stats()["rejected_tenant_quota"] >= 1
+
+            # per-tenant goodput fold rides engine stats
+            tenancy = srv.loop.engine.stats()["tenancy"]
+            assert "acme" in tenancy["tenants"] and "globex" in tenancy["tenants"]
+            assert tenancy["tenants"]["acme"]["tokens_out"] >= 32
+            assert tenancy["adapters"]["registered"] == 3
+        finally:
+            srv.shutdown(drain_timeout_s=5)

@@ -312,8 +312,8 @@ class TestServingHTTP:
 
         assert metric_value("paddlenlp_serving_ttft_seconds_count") >= 9
         assert metric_value("paddlenlp_serving_ttft_seconds_sum") > 0
-        assert 'paddlenlp_serving_requests_total{status="length",priority="interactive"}' in text
-        assert 'paddlenlp_serving_requests_total{status="abort",priority="interactive"}' in text
+        assert 'paddlenlp_serving_requests_total{status="length",priority="interactive",tenant="default"}' in text
+        assert 'paddlenlp_serving_requests_total{status="abort",priority="interactive",tenant="default"}' in text
         assert metric_value("paddlenlp_serving_queue_depth") >= 0  # series present
         assert metric_value("paddlenlp_serving_kv_utilization") >= 0
         assert metric_value("paddlenlp_serving_tokens_generated_total") >= n_stream * gen_len

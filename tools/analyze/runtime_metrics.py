@@ -77,8 +77,8 @@ def catalog_exposition() -> str:
     # labeled series expose no samples until touched — exercise one labelset
     # of each so the lint sees real sample lines, not just HELP/TYPE headers
     serving.latency_attribution.observe(0.01, phase="queue")
-    serving.shed.inc(reason="shed", priority="best_effort")
-    serving.requests.inc(status="stop", priority="interactive")
+    serving.shed.inc(reason="shed", priority="best_effort", tenant="default")
+    serving.requests.inc(status="stop", priority="interactive", tenant="default")
     serving.wasted_tokens.inc(3, kind="padding")
     serving.compiles.inc(program="prefill")
     serving.compile_seconds.inc(0.5, program="prefill")
@@ -114,7 +114,7 @@ def federation_problems() -> list:
     for rid in ("replica-0", "replica-1"):
         registry = MetricsRegistry()
         metrics = ServingMetrics(_stub_engine(), registry=registry)
-        metrics.requests.inc(status="stop", priority="interactive")
+        metrics.requests.inc(status="stop", priority="interactive", tenant="default")
         metrics.ttft.observe(0.05)
         expositions[rid] = registry.expose()
     problems = [f"federation: {p}" for p in lint_federation(expositions)]

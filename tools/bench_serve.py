@@ -49,6 +49,27 @@ Usage::
                                                  # tp_degree (composes with
                                                  # --prefill-chunk and
                                                  # --prefix-share)
+    python tools/bench_serve.py --adapters 3 --tenant-mix
+                                                 # multi-tenant multi-LoRA arm:
+                                                 # 3 rank-4 adapters registered
+                                                 # in the engine's adapter pool;
+                                                 # 3 of 4 requests decode with
+                                                 # an adapter (round-robin), the
+                                                 # 4th rides the base model in
+                                                 # the SAME batches; --tenant-mix
+                                                 # spreads requests over three
+                                                 # tenants. JSON adds
+                                                 # adapter_hit_rate /
+                                                 # adapter_evictions + a
+                                                 # multi_lora record and a
+                                                 # per-tenant requests/shed
+                                                 # breakdown. --adapters 6
+                                                 # overcommits the 4-slot pool
+                                                 # so LRU hot-load/evict churn
+                                                 # shows up in the numbers. The
+                                                 # default (no-adapter) arm is
+                                                 # the one gated against
+                                                 # tools/BENCH_BASELINE.json
     python tools/bench_serve.py --replicas 3 --drain-mid-run
                                                  # halfway through the request
                                                  # stream, drain one replica via
@@ -275,6 +296,9 @@ def run() -> None:
         surge_schedule = [(off, phase, "best_effort" if i % 4 == 3 else "interactive")
                           for i, (off, phase) in enumerate(surge_schedule)]
         n_requests = len(surge_schedule)
+    n_adapters = _arg("--adapters", 0)
+    tenant_mix = "--tenant-mix" in sys.argv
+    tenants = ("acme", "globex", "initech")
     long_mix = "--long-prompt-mix" in sys.argv
     n_long = _arg("--long-prompts", 2)
     long_tokens = _arg("--long-prompt-tokens", 2048)
@@ -329,9 +353,41 @@ def run() -> None:
     # --long-prompts close to --requests can't all land); report THIS count
     n_long_issued = sum(1 for i in range(n_requests) if is_long(i))
 
+    # --adapters N: N deterministic rank-4 LoRA adapters served from the
+    # engine's slot pool. pool_slots caps at 4 so N > 4 overcommits the pool
+    # and the run exercises LRU hot-load/evict churn, not just warm gathers.
+    adapter_registries: list = []
+    adapter_pool_slots = min(n_adapters, 4) if n_adapters else 0
+
+    def adapter_source(idx: int) -> dict:
+        import numpy as _np
+
+        from paddlenlp_tpu.serving.tenancy.adapters import adapter_dims_from_config
+
+        rng = _np.random.default_rng(1000 + idx)
+        src = {}
+        for proj, (d_in, d_out) in adapter_dims_from_config(cfg).items():
+            src[proj] = {
+                "A": rng.standard_normal(
+                    (cfg.num_hidden_layers, d_in, 4)).astype(_np.float32) * 0.02,
+                "B": rng.standard_normal(
+                    (cfg.num_hidden_layers, 4, d_out)).astype(_np.float32) * 0.02,
+            }
+        return src
+
     def make_engine():
         # one shared model (read-only params), one engine per replica
-        return InferenceEngine(model, **eng_kw)
+        kw = dict(eng_kw)
+        if n_adapters:
+            from paddlenlp_tpu.serving.tenancy import AdapterRegistry
+
+            reg = AdapterRegistry(config=cfg, max_rank=4,
+                                  pool_slots=adapter_pool_slots)
+            for a in range(n_adapters):
+                reg.add(f"bench-ad-{a}", adapter_source(a))
+            adapter_registries.append(reg)
+            kw["adapter_registry"] = reg
+        return InferenceEngine(model, **kw)
 
     registry = MetricsRegistry()
     fleet = server = None
@@ -377,7 +433,16 @@ def run() -> None:
             prompt = shared_prefix + [5 + i % 8, 6, 7]
         else:
             prompt = [5 + i % 8, 6, 7]
-        body = json.dumps({"prompt": prompt, "max_tokens": max_tokens, "stream": True})
+        payload = {"prompt": prompt, "max_tokens": max_tokens, "stream": True}
+        # 3 of 4 requests decode with an adapter (round-robin over the pool),
+        # the 4th stays on the base model — mixed batches are the point; the
+        # warmup (i == 0) carries an adapter so the gathered-delta program
+        # compiles outside the measured window
+        if n_adapters and i >= 0 and i % 4 != 3:
+            payload["adapter_id"] = f"bench-ad-{i % n_adapters}"
+        if tenant_mix and i >= 0:
+            payload["tenant"] = tenants[i % len(tenants)]
+        body = json.dumps(payload)
         conn.request("POST", "/v1/completions", body=body,
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
@@ -797,6 +862,28 @@ def run() -> None:
             quantile_max("paddlenlp_serving_step_gap_seconds", 0.99) * 1e3, 3),
         "shape_buckets": int(scalar_sum("paddlenlp_serving_jit_shape_buckets")),
     }
+    if n_adapters:
+        hits = sum(r.hits for r in adapter_registries)
+        misses = sum(r.misses for r in adapter_registries)
+        record["adapter_hit_rate"] = round(hits / max(hits + misses, 1), 4)
+        record["adapter_evictions"] = sum(r.evictions for r in adapter_registries)
+        record["multi_lora"] = {
+            "adapters": n_adapters,
+            "pool_slots": adapter_pool_slots,
+            "hits": hits,
+            "misses": misses,
+            "loads": sum(r.loads for r in adapter_registries),
+        }
+    if tenant_mix:
+        # per-tenant ledger straight off the serving counters: every admitted
+        # request and every shed, keyed by the tenant label the isolation
+        # layer stamps — summed across replicas
+        record["tenants"] = {
+            "requests": {k: int(v) for k, v in sorted(labeled_by(
+                "paddlenlp_serving_requests_total", "tenant").items())},
+            "shed": {k: int(v) for k, v in sorted(labeled_by(
+                "paddlenlp_serving_requests_shed_total", "tenant").items())},
+        }
     # recorder-overhead A/B facts: run once with PDNLP_TPU_FLIGHT_RECORDER=0
     # and once without, diff value/tails — these two fields label the arms
     record["flight_recorder"] = RECORDER.enabled
