@@ -141,6 +141,26 @@ class Request:
     # ... and which rework bucket this request's re-fed positions land in
     # (preemption recompute vs a supervisor requeue across a rebuild)
     rework_src: str = "preempt_refill"
+    # usage-metering bookkeeping (serving.tenancy.metering.UsageMeter reads
+    # these at finish): prefix-cache tokens credited at FIRST admission only
+    # (None until admitted — a preemption re-admission must not re-credit) ...
+    cached_tokens: Optional[int] = None
+    # ... engine-attributed useful fed positions, mirroring the per-tenant
+    # goodput fold token for token so summed finished-request usage
+    # reconciles exactly against the ledger's useful total ...
+    useful_tokens: int = 0
+    # ... speculative work billed to this request ...
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    # ... the block·seconds integral of KV residency (advanced by a per-step
+    # checkpoint while kv_occ_t holds the open episode's start; finalized in
+    # _free_kv, so it accumulates across preemption episodes) ...
+    kv_block_seconds: float = 0.0
+    kv_occ_t: Optional[float] = None
+    # ... and wall seconds holding a real adapter-pool slot (refcount
+    # bracket: acquire in _admit_slots, release in _free_kv)
+    adapter_slot_seconds: float = 0.0
+    adapter_acq_t: Optional[float] = None
 
     @property
     def needs_prefill(self) -> bool:
@@ -454,6 +474,13 @@ class InferenceEngine:
         request sharing the prefix skips their prefill; aborts and
         preemptions release by refcount without registering."""
         freed = self.mgr.lengths.get(req.req_id)
+        if req.kv_occ_t is not None:
+            # close the open KV-occupancy episode while the block table still
+            # exists: the block·seconds integral is what usage metering bills
+            # for cache residency
+            req.kv_block_seconds += (time.perf_counter() - req.kv_occ_t) \
+                * len(self.mgr.tables.get(req.req_id, ()))
+            req.kv_occ_t = None
         if cache and self.enable_prefix_cache and req.finish_reason in ("stop", "length"):
             # salt = adapter_id: an adapter's KV is the product of base+delta
             # forwards, so cached prefixes are only shareable within the SAME
@@ -467,6 +494,9 @@ class InferenceEngine:
             # re-admission re-acquires (content-addressed => token-exact)
             self.adapter_registry.release(req.adapter_id)
             req.adapter_slot = 0
+            if req.adapter_acq_t is not None:
+                req.adapter_slot_seconds += time.perf_counter() - req.adapter_acq_t
+                req.adapter_acq_t = None
         TRACER.instant("kv_free", cat="engine", trace=req.trace,
                        req_id=req.req_id, tokens_held=freed,
                        free_blocks=self.mgr.num_free,
@@ -832,6 +862,15 @@ class InferenceEngine:
             else:
                 self._admit(finished)
                 self._decode_running(finished)
+        # usage metering: advance each admitted request's kv_block_seconds
+        # integral piecewise per step (block counts grow during decode, so a
+        # single count-at-free rectangle would misbill long requests)
+        t_occ = time.perf_counter()
+        for req in self.slots:
+            if req is not None and req.kv_occ_t is not None:
+                req.kv_block_seconds += (t_occ - req.kv_occ_t) \
+                    * len(self.mgr.tables.get(req.req_id, ()))
+                req.kv_occ_t = t_occ
         t_end = time.perf_counter()
         host_s = max(t_end - t_step0 - self._step_device_s, 0.0)
         self.ledger.note_step(max(gap_s, 0.0), self._step_device_s, host_s)
@@ -882,6 +921,9 @@ class InferenceEngine:
         tg = self._tenant_counts(req.tenant)
         tg["useful"] += n - rework
         tg["rework"] += rework
+        # per-request mirror of the same attribution: the usage record's
+        # useful_tokens must reconcile against the ledger token for token
+        req.useful_tokens += n - rework
         return rework, (by or None)
 
     @staticmethod
@@ -1012,6 +1054,10 @@ class InferenceEngine:
                     raise
             self.waiting.popleft()
             req.adapter_slot = adapter_slot
+            if adapter_slot:
+                # adapter_slot_seconds episode opens with the refcount; the
+                # release in _free_kv closes it (accumulates across preemptions)
+                req.adapter_acq_t = time.perf_counter()
             if req.sched_t is None:  # preserved across preemption-requeues
                 req.sched_t = time.time()
             if cache_on:
@@ -1026,6 +1072,12 @@ class InferenceEngine:
             # a stale pending count into later spans
             req.cow_pending = (prompt_len - n_cached
                                if (match is not None and match[2] is not None) else 0)
+            # usage metering: the KV-occupancy episode opens with the blocks;
+            # the cache credit bills ONCE, at first admission — re-admission
+            # hits after a preemption are rework economics, not a discount
+            req.kv_occ_t = time.perf_counter()
+            if req.cached_tokens is None:
+                req.cached_tokens = n_cached
             TRACER.instant("kv_alloc", cat="engine", trace=req.trace,
                            req_id=req.req_id, tokens=prompt_len,
                            cached_tokens=n_cached,
@@ -1491,6 +1543,7 @@ class InferenceEngine:
             d = drafts[i]
             g_drafted += len(d)
             self.spec_stats["drafted"] += len(d)
+            req.spec_drafted += len(d)
             if mode == "sample":
                 with TRACER.span("sampling", cat="engine", trace=req.trace,
                                  req_id=req.req_id, kind="rejection", drafted=len(d)):
@@ -1502,6 +1555,7 @@ class InferenceEngine:
                     n_acc += 1
                 emitted = list(d[:n_acc]) + [int(targets[n_acc])]  # sync-ok: argmax already host (backend.verify synced)
                 self.spec_stats["accepted"] += n_acc
+                req.spec_accepted += n_acc
             for tok in emitted:
                 self._emit(req, int(tok))
                 self._last_token[i] = int(tok)
@@ -1510,6 +1564,7 @@ class InferenceEngine:
                 # per-tenant fold: accepted/bonus tokens are the useful verify
                 # positions (rejected drafts are step-global spec waste)
                 self._tenant_counts(req.tenant)["useful"] += 1
+                req.useful_tokens += 1
                 if req.done:
                     break
             # the last emitted token was sampled, not fed: mark to total-1
@@ -1545,6 +1600,7 @@ class InferenceEngine:
             if rng.uniform() < min(1.0, float(p[x]) / max(qv, 1e-20)):
                 emitted.append(x)
                 self.spec_stats["accepted"] += 1
+                req.spec_accepted += 1
                 continue
             residual = np.maximum(p - (q[t] if q is not None else 0.0), 0.0)
             s = residual.sum()
@@ -1637,6 +1693,7 @@ class InferenceEngine:
                 # per-tenant fold: each emitted decode token consumed one fed
                 # position (this path bypasses _note_fed_span)
                 self._tenant_counts(req.tenant)["useful"] += 1
+                req.useful_tokens += 1
         # goodput: the decode jit always burns B x decode_steps positions;
         # every emitted token is one useful fed position, the rest (idle
         # slots, post-EOS sub-steps, unconsumed budget) is padding

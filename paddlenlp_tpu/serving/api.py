@@ -107,7 +107,8 @@ class ServingServer:
                  engine_factory=None,
                  supervisor_policy: Optional[SupervisorPolicy] = None,
                  trace_sample_every: Optional[int] = None,
-                 tenant_quotas: Optional[TenantQuotas] = None):
+                 tenant_quotas: Optional[TenantQuotas] = None,
+                 usage_meter=None):
         self.engine = engine
         self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
         self.registry = registry or REGISTRY
@@ -119,7 +120,8 @@ class ServingServer:
         self.max_body_bytes = max_body_bytes
         self.max_src_tokens = max_src_tokens
         self.loop = EngineLoop(engine, metrics=ServingMetrics(engine, self.registry),
-                               engine_factory=engine_factory, policy=supervisor_policy)
+                               engine_factory=engine_factory, policy=supervisor_policy,
+                               usage=usage_meter)
         self.scheduler = Scheduler(self.loop, scheduler_config,
                                    tenant_quotas=tenant_quotas)
         # brownout side effects: level >= 2 turns speculative decode off on
@@ -268,6 +270,14 @@ class ServingServer:
         doc["engine_state"] = self.loop.state
         return doc
 
+    def usage(self) -> dict:
+        """The ``GET /debug/usage`` document: the meter's rolling per-tenant/
+        per-adapter aggregate plus durable-ledger stats. This is the replica
+        view the router's ``/fleet/usage`` fold sums."""
+        doc = self.loop.usage.snapshot()
+        doc["engine_state"] = self.loop.state
+        return doc
+
     def _apply_brownout_level(self, level: int):
         """Brownout ladder side effects on the live engine: level >= 2
         disables speculative decode (spend device time on committed tokens
@@ -402,6 +412,8 @@ class ServingServer:
                         })
                     elif self.path == "/debug/efficiency":
                         self._send_json(200, server.efficiency())
+                    elif self.path == "/debug/usage":
+                        self._send_json(200, server.usage())
                     else:
                         self._send_error_json(404, f"no route {self.path}", "not_found")
                 except (BrokenPipeError, ConnectionResetError):

@@ -64,6 +64,7 @@ from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .metrics import REGISTRY, MetricsRegistry
 from .tenancy.adapters import UnknownAdapterError
+from .tenancy.metering import UsageMeter
 from .tenancy.quotas import DEFAULT_TENANT
 
 __all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy",
@@ -162,12 +163,14 @@ class _FailedRequest:
 
     def __init__(self, req_id, prompt_ids, output_ids, trace,
                  arrival_t, finish_reason="engine_error",
-                 tenant: str = DEFAULT_TENANT):
+                 tenant: str = DEFAULT_TENANT,
+                 adapter_id: Optional[str] = None):
         self.req_id = req_id if req_id is not None else -1
         self.prompt_ids = list(prompt_ids)
         self.output_ids = list(output_ids)
         self.trace = trace
         self.tenant = tenant
+        self.adapter_id = adapter_id
         self.aborted = False
         self.done = True
         self.finish_reason = finish_reason
@@ -467,6 +470,18 @@ class ServingMetrics:
         self.spec_accepted = r.counter(
             "paddlenlp_serving_spec_accepted_tokens_total",
             "Speculative tokens accepted by the verify forward")
+        # billing-grade usage: token counters labeled by who pays for them
+        # (UsageMeter increments these once per finished request)
+        self.usage_tokens = r.counter(
+            "paddlenlp_serving_usage_tokens_total",
+            "Metered usage tokens booked per finished request, by tenant, "
+            "adapter (\"base\" = no LoRA), and kind "
+            "(prompt | cached = prefix-cache credit | completion)",
+            labelnames=("tenant", "adapter", "kind"))
+        self.usage_records = r.counter(
+            "paddlenlp_serving_usage_records_total",
+            "Usage records booked (exactly one per finished request id)",
+            labelnames=("tenant",))
         self.rebind(engine)
 
     def rebind(self, engine):
@@ -648,12 +663,19 @@ class EngineLoop:
                  registry: Optional[MetricsRegistry] = None, idle_wait_s: float = 0.05,
                  engine_factory: Optional[Callable[[], object]] = None,
                  policy: Optional[SupervisorPolicy] = None,
-                 postmortem: Optional[PostmortemDumper] = None):
+                 postmortem: Optional[PostmortemDumper] = None,
+                 usage: Optional[UsageMeter] = None):
         self.engine = engine
         self.metrics = metrics or ServingMetrics(engine, registry)
         self.idle_wait_s = idle_wait_s
         self.engine_factory = engine_factory
         self.policy = policy or SupervisorPolicy()
+        # billing-grade usage: one record per finished request, booked at
+        # resolution time (every finish path funnels through _trace_finished
+        # except shutdown cleanup, which books directly). PDNLP_TPU_USAGE_DIR
+        # arms the durable JSONL ledger.
+        self.usage = usage if usage is not None \
+            else UsageMeter.from_env(metrics=self.metrics)
         # incident black box: supervisor degrades and slot quarantines
         # auto-dump a bundle (events + spans + health + metrics + config) to
         # PDNLP_TPU_POSTMORTEM_DIR; POST /debug/postmortem forces one
@@ -767,6 +789,12 @@ class EngineLoop:
                 return False
         self._started = False
         self._state = "stopped"
+        try:
+            # seal the open usage segment: sealed segments are what the
+            # offline aggregator (tools/usage_report.py) merges
+            self.usage.close()
+        except Exception:  # noqa: BLE001
+            logger.warning("usage ledger seal on stop failed", exc_info=True)
         return True
 
     def pending_count(self) -> int:
@@ -1073,7 +1101,8 @@ class EngineLoop:
                         finish_reason: str = "engine_error"):
         req = _FailedRequest(handle.req_id, handle._prompt_ids or [], streamed,
                              handle.trace, handle.submitted_t,
-                             finish_reason=finish_reason, tenant=handle.tenant)
+                             finish_reason=finish_reason, tenant=handle.tenant,
+                             adapter_id=handle.adapter_id)
         req.aborted = finish_reason == "abort"
         req.priority = handle.priority  # requests_total{priority} label
         if handle._first_token_t is not None:
@@ -1324,12 +1353,21 @@ class EngineLoop:
                     self._queue_wait_samples.append(
                         wait / (max(handle.depth_at_submit, 0) + 1))
                     self._qw_fresh_t = time.time()
+        # billing: exactly one usage record per request id — _trace_finished
+        # is the funnel every resolution path passes through (normal finish,
+        # abort, engine_error quarantine), and the meter's seen-id set makes
+        # a double resolution book nothing twice
+        usage_record = self.usage.record_finished(req, handle,
+                                                  attribution=attribution)
         self.recent_finished.append({
             "trace": trace,
             "req_id": req.req_id,
             "state": "finished",
             "finish_reason": req.finish_reason,
             "retries": handle.retries if handle is not None else 0,
+            "tenant": getattr(req, "tenant", None) or DEFAULT_TENANT,
+            "adapter_id": getattr(req, "adapter_id", None)
+            or (handle.adapter_id if handle is not None else None),
             "prompt_len": len(req.prompt_ids),
             "output_tokens": len(req.output_ids),
             "arrival_t": req.arrival_t,
@@ -1338,6 +1376,11 @@ class EngineLoop:
             "decode_time_s": req.decode_time,
             "finish_t": req.finish_t,
             "attribution": attribution,
+            "usage": None if usage_record is None else {
+                k: usage_record[k]
+                for k in ("prompt_tokens", "cached_tokens", "completion_tokens",
+                          "useful_tokens", "kv_block_seconds",
+                          "adapter_slot_seconds")},
         })
 
     def inflight_info(self) -> List[Dict]:
@@ -1391,8 +1434,29 @@ class EngineLoop:
                 if open_t is not None:
                     mig_wait += max(now - open_t, 0.0)
                 info["migration_wait_s"] = mig_wait
+                info["usage_so_far"] = self._usage_so_far(req, handle)
             out.append(info)
         return out
+
+    def _usage_so_far(self, req, handle: RequestHandle) -> Dict:
+        """Running usage totals for one in-flight request (the live half of a
+        usage record): tokens so far plus the KV-residency integral extended
+        to 'now'. Same stale-but-never-corrupt contract as inflight_info."""
+        kv_s = float(getattr(req, "kv_block_seconds", 0.0) or 0.0)
+        occ_t = getattr(req, "kv_occ_t", None)
+        if occ_t is not None:
+            try:
+                held = len(self.engine.mgr.tables.get(req.req_id, ()))
+            except Exception:  # mgr mutated mid-read: report the booked part
+                held = 0
+            kv_s += max(time.perf_counter() - occ_t, 0.0) * held
+        return {
+            "prompt_tokens": handle.prompt_len,
+            "cached_tokens": int(getattr(req, "cached_tokens", 0) or 0),
+            "completion_tokens": len(handle._streamed),
+            "useful_tokens": int(getattr(req, "useful_tokens", 0) or 0),
+            "kv_block_seconds": round(kv_s, 6),
+        }
 
     # ------------------------------------------------------------- postmortem
     def _postmortem_health(self) -> Dict:
@@ -1407,6 +1471,7 @@ class EngineLoop:
             "engine": self.engine.stats(),
             "inflight": self.inflight_info(),
             "recent_finished": list(self.recent_finished),
+            "usage": self.usage.snapshot(),
         }
 
     def _postmortem_config(self) -> Dict:
@@ -1438,6 +1503,10 @@ class EngineLoop:
                 req = self.engine.abort(handle.req_id)
                 if req is not None:
                     self.metrics.on_finished(req)
+                    # shutdown bypasses _trace_finished (no span emission at
+                    # teardown) but the request still consumed tokens — book
+                    # it, same idempotent path
+                    self.usage.record_finished(req, handle)
                     handle._resolve(req)
                     continue
             handle._resolve(None)

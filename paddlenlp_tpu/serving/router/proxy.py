@@ -97,6 +97,7 @@ from ...observability.slo import (
     SLOTracker,
     slo_inputs_from_families,
 )
+from ...observability.usage import merge_aggregates
 from ...observability.tracer import (
     TRACEPARENT_HEADER,
     TRACER,
@@ -594,6 +595,9 @@ class RouterServer:
                     if parts.path == "/debug/efficiency":
                         self._send_json(200, router.fleet_efficiency())
                         return
+                    if parts.path == "/fleet/usage":
+                        self._send_json(200, router.fleet_usage())
+                        return
                     if parts.path == "/replicas":
                         self._send_json(200, router.admin_list_replicas())
                         return
@@ -632,6 +636,11 @@ class RouterServer:
                         payload = self._read_body()
                         if payload is not None:
                             code, doc = router.admin_drain_replica(payload)
+                            self._send_json(code, doc)
+                    elif self.path == "/admin/adapters":
+                        payload = self._read_body()
+                        if payload is not None:
+                            code, doc = router.admin_adapters_fleet(payload)
                             self._send_json(code, doc)
                     elif self.path.split("?", 1)[0] == "/debug/postmortem":
                         # drain any request body first (keep-alive hygiene)
@@ -955,6 +964,90 @@ class RouterServer:
                 "wasted_tokens": wasted,
             },
         }
+
+    def fleet_usage(self) -> Dict:
+        """Router-tier ``GET /fleet/usage``: every live replica's rolling
+        usage aggregate plus a fleet sum per tenant/adapter. Same degrade
+        contract as the other fleet planes: a failed scrape lands the replica
+        in ``skipped`` and shrinks the fold — never a 500. NOTE this is the
+        *rolling* (per-replica-lifetime) view: a request that failed over
+        mid-stream may appear on two replicas; the offline
+        ``tools/usage_report.py`` merge over the durable ledgers dedups by
+        record id and is the billing-authoritative number."""
+        docs: Dict[str, Dict] = {}
+        skipped: List[str] = []
+        for snap in self.pool.snapshots():
+            if snap.state == DOWN:
+                skipped.append(snap.id)
+                continue
+            try:
+                docs[snap.id] = json.loads(
+                    self._scrape_replica(snap, "/debug/usage"))
+            except Exception as e:
+                logger.warning(
+                    f"router: usage scrape of {snap.id} failed: {e!r}")
+                skipped.append(snap.id)
+        return {
+            "tier": "router",
+            "replicas": docs,
+            "skipped": skipped,
+            "fleet": merge_aggregates(docs.values()),
+        }
+
+    def admin_adapters_fleet(self, payload: dict) -> Tuple[int, Dict]:
+        """POST /admin/adapters at the router: fan the adapter op (load /
+        unload / list) out to every live replica so one call changes the
+        whole fleet's adapter catalog. Best-effort per replica (the
+        drain-propagation contract): a DOWN replica is skipped, a failed or
+        rejected propagation is reported per replica — the call itself
+        always answers 200 with the outcome map, because partial application
+        is the *expected* steady state under churn (a replica that missed
+        the load will 404 per request and the client retries elsewhere)."""
+        results: Dict[str, Dict] = {}
+        skipped: List[str] = []
+
+        def push(snap):
+            conn = http.client.HTTPConnection(snap.host, snap.port, timeout=10)
+            try:
+                conn.request("POST", "/admin/adapters",
+                             body=json.dumps(payload).encode(),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read().decode()
+            finally:
+                conn.close()
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                doc = {"raw": body[:512]}
+            return resp.status, doc
+
+        live = []
+        for snap in self.pool.snapshots():
+            if snap.state == DOWN:
+                skipped.append(snap.id)
+            else:
+                live.append(snap)
+        if live:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(live))) as pool:
+                futures = {pool.submit(push, s): s for s in live}
+                for fut in concurrent.futures.as_completed(futures):
+                    snap = futures[fut]
+                    try:
+                        status, doc = fut.result()
+                        results[snap.id] = {"status": status,
+                                            "ok": status == 200,
+                                            "response": doc}
+                    except Exception as e:
+                        logger.warning(
+                            f"router: adapter op on {snap.id} failed: {e!r}")
+                        results[snap.id] = {"status": None, "ok": False,
+                                            "error": repr(e)}
+        ok = sorted(r for r, d in results.items() if d["ok"])
+        failed = sorted(r for r, d in results.items() if not d["ok"])
+        return 200, {"op": payload.get("op", "list"), "replicas": results,
+                     "skipped": skipped, "ok": ok, "failed": failed}
 
     @staticmethod
     def _fold_stage_series(parsed: Dict[str, Dict]) -> Dict:

@@ -12,14 +12,21 @@ Two halves, deliberately separable:
   inflight, KV-block share) that ride the existing priority classes, plus
   the per-tenant goodput fold over the engine's request-attributed token
   accounting.
+- :mod:`.metering` — :class:`UsageMeter`: billing-grade usage records (one
+  per finished request, trace-id idempotent) with a rolling per-tenant/
+  per-adapter aggregate and an optional durable JSONL ledger
+  (``observability/usage.py``) whose totals reconcile against the goodput
+  ledger's useful-token truth.
 """
 
 from .adapters import (AdapterPressure, AdapterRegistry, UnknownAdapterError,
                        adapter_dims_from_config, PROJ_NAMES)
+from .metering import UsageMeter
 from .quotas import (DEFAULT_TENANT, TenantQuota, TenantQuotas,
                      tenant_goodput_fold)
 
 __all__ = [
+    "UsageMeter",
     "AdapterPressure",
     "AdapterRegistry",
     "UnknownAdapterError",

@@ -106,6 +106,12 @@ CATALOG: Dict[str, str] = {
                            "poisoned request, before its KV blocks are released — a "
                            "failure here escalates to the full engine rebuild path "
                            "(DEGRADED, triage, rebuild) deterministically.",
+    "usage.seal": "Inside UsageLedger segment sealing, after the open segment's "
+                  "last append but before the atomic rename-commit of the sealed "
+                  "file — a crash here must leave a loadable ledger (the open "
+                  "segment's torn tail dropped + counted, every sealed byte "
+                  "intact). 'partial' truncates the open segment mid-line first: "
+                  "the torn-write case the reload tolerance exists for.",
     "engine.adapter_load": "Inside AdapterRegistry.acquire, after the pool-slot "
                            "decision but before the adapter weights land in the "
                            "device pool — the failure carries the acquiring "

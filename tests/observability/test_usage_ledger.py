@@ -1,0 +1,325 @@
+"""Usage ledger + meter + offline report unit tests (no engine, no HTTP).
+
+Covers the durability contract end to end: segment rotation and atomic
+sealing, torn-tail and sealed/open-twin tolerance on reload, the
+``usage.seal`` fault point's partial-write chaos window, the meter's
+exactly-once booking (trace-id dedup, handle and no-handle paths), and
+``tools/usage_report.py``'s merge/dedup/price/reconcile including the
+double-bill conflict exit code."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from paddlenlp_tpu.observability.usage import (
+    SUM_FIELDS,
+    UsageLedger,
+    empty_aggregate,
+    fold_record,
+    load_ledger_dir,
+    merge_aggregates,
+)
+from paddlenlp_tpu.serving.tenancy.metering import UsageMeter
+from paddlenlp_tpu.utils.faults import FAULTS, InjectedFault
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import usage_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def rec(i, tenant="acme", adapter=None, finish="stop", **kw):
+    base = {
+        "record_id": f"tr-{i}", "tenant": tenant, "adapter_id": adapter,
+        "finish_reason": finish, "prompt_tokens": 10, "cached_tokens": 2,
+        "completion_tokens": 5, "useful_tokens": 12, "spec_drafted": 0,
+        "spec_accepted": 0, "kv_block_seconds": 0.25, "adapter_slot_seconds": 0.0,
+    }
+    base.update(kw)
+    return base
+
+
+# --------------------------------------------------------------------- ledger
+class TestUsageLedger:
+    def test_rotation_by_size_and_reload(self, tmp_path):
+        led = UsageLedger(str(tmp_path), replica="r0", max_segment_records=3)
+        for i in range(7):
+            led.append(rec(i))
+        # 7 records at 3/segment: two sealed segments, one open with 1 record
+        stats = led.stats()
+        assert stats["sealed_segments"] == 2
+        assert stats["open_records"] == 1
+        assert stats["records_total"] == 7
+        records, report = load_ledger_dir(str(tmp_path))
+        assert report["sealed_segments"] == 2
+        assert report["open_segments"] == 1
+        assert [r["record_id"] for r in records] == [f"tr-{i}" for i in range(7)]
+        led.close()
+        # close seals the tail; everything sealed now, nothing lost
+        records, report = load_ledger_dir(str(tmp_path))
+        assert report["open_segments"] == 0
+        assert report["sealed_segments"] == 3
+        assert len(records) == 7
+
+    def test_closed_ledger_refuses_appends(self, tmp_path):
+        led = UsageLedger(str(tmp_path), replica="r0")
+        led.append(rec(0))
+        led.close()
+        with pytest.raises(RuntimeError):
+            led.append(rec(1))
+
+    def test_restart_resumes_past_existing_segments(self, tmp_path):
+        led = UsageLedger(str(tmp_path), replica="r0", max_segment_records=1)
+        led.append(rec(0))
+        led.close()
+        # same replica name restarting into the same dir must not overwrite
+        led2 = UsageLedger(str(tmp_path), replica="r0", max_segment_records=1)
+        led2.append(rec(1))
+        led2.close()
+        records, report = load_ledger_dir(str(tmp_path))
+        assert len(records) == 2
+        assert report["sealed_segments"] == 2
+
+    def test_torn_open_tail_dropped_and_counted(self, tmp_path):
+        led = UsageLedger(str(tmp_path), replica="r0")
+        led.append(rec(0))
+        led.append(rec(1))
+        # simulate the kill -9 mid-append: torn JSON tail on the open segment
+        open_path = led._open_path
+        with open(open_path, "a", encoding="utf-8") as f:
+            f.write('{"record_id": "tr-torn", "prompt_to')
+        records, report = load_ledger_dir(str(tmp_path))
+        assert len(records) == 2
+        assert report["torn_lines_dropped"] == 1
+
+    def test_sealed_open_twin_prefers_sealed(self, tmp_path):
+        led = UsageLedger(str(tmp_path), replica="r0")
+        led.append(rec(0))
+        open_path = led._open_path
+        open_copy = open(open_path, encoding="utf-8").read()
+        led.seal()
+        # crash between rename-commit and unlink: the open file survives
+        with open(open_path, "w", encoding="utf-8") as f:
+            f.write(open_copy)
+        records, report = load_ledger_dir(str(tmp_path))
+        assert len(records) == 1  # not double-counted
+        assert report["twins_skipped"] == 1
+
+    def test_seal_fault_partial_leaves_loadable_ledger(self, tmp_path):
+        """action="partial" on usage.seal truncates the open segment mid-line
+        and raises before the rename — the kill-during-seal chaos case. The
+        directory must stay loadable: sealed history intact, the torn tail of
+        the open segment dropped + counted."""
+        led = UsageLedger(str(tmp_path), replica="r0", max_segment_records=2)
+        led.append(rec(0))
+        led.append(rec(1))  # seals segment 0
+        led.append(rec(2))
+        FAULTS.arm("usage.seal", action="partial", nth=1)
+        with pytest.raises(InjectedFault):
+            led.seal()
+        records, report = load_ledger_dir(str(tmp_path))
+        assert report["sealed_segments"] == 1
+        assert report["open_segments"] == 1
+        # segment 0's two records survived; the truncated open tail dropped
+        assert [r["record_id"] for r in records] == ["tr-0", "tr-1"]
+        assert report["torn_lines_dropped"] == 1
+
+
+# ----------------------------------------------------------------- aggregates
+class TestAggregates:
+    def test_fold_and_merge_shapes_agree(self):
+        agg = empty_aggregate()
+        fold_record(agg, rec(0))
+        fold_record(agg, rec(1, tenant="globex", adapter="ad-a"))
+        assert agg["records"] == 2
+        assert agg["totals"]["prompt_tokens"] == 20
+        assert agg["tenants"]["acme"]["records"] == 1
+        assert agg["adapters"]["base"]["records"] == 1
+        assert agg["adapters"]["ad-a"]["completion_tokens"] == 5
+        merged = merge_aggregates([agg, agg])
+        assert merged["records"] == 4
+        assert merged["totals"]["kv_block_seconds"] == pytest.approx(1.0)
+        assert merged["tenants"]["globex"]["useful_tokens"] == 24
+        # report-side SUM_FIELDS is a mirror, not an import — keep in lockstep
+        assert tuple(usage_report.SUM_FIELDS) == tuple(SUM_FIELDS)
+
+
+# -------------------------------------------------------------------- meter
+class _Req:
+    def __init__(self, **kw):
+        self.req_id = kw.pop("req_id", 1)
+        self.tenant = kw.pop("tenant", "acme")
+        self.adapter_id = kw.pop("adapter_id", None)
+        self.priority = "interactive"
+        self.finish_reason = kw.pop("finish_reason", "stop")
+        self.aborted = False
+        self.prompt_ids = kw.pop("prompt_ids", [1] * 8)
+        self.output_ids = kw.pop("output_ids", [2] * 3)
+        self.base_prompt_len = kw.pop("base_prompt_len", len(self.prompt_ids))
+        self.cached_tokens = 4
+        self.useful_tokens = 6
+        self.spec_drafted = 2
+        self.spec_accepted = 1
+        self.kv_block_seconds = 0.5
+        self.adapter_slot_seconds = 0.0
+        self.arrival_t = 1.0
+        self.finish_t = 2.5
+        self.trace = kw.pop("trace", "tr-1")
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _Handle:
+    def __init__(self, trace="tr-1", prompt_len=8, streamed=3, retries=1,
+                 adapter_id="ad-a"):
+        self.trace = trace
+        self.prompt_len = prompt_len
+        self._streamed = [7] * streamed
+        self.retries = retries
+        self.adapter_id = adapter_id
+        self.tenant = "acme"
+
+
+class TestUsageMeter:
+    def test_trace_id_dedup_books_once(self):
+        m = UsageMeter()
+        assert m.record_finished(_Req()) is not None
+        assert m.record_finished(_Req()) is None  # same trace: suppressed
+        snap = m.snapshot()
+        assert snap["records"] == 1
+        assert snap["duplicates_suppressed"] == 1
+
+    def test_traceless_requests_never_dedup(self):
+        m = UsageMeter()
+        # engine req_ids restart per engine — two trace-less requests with
+        # the same req_id are different requests, both must bill
+        assert m.record_finished(_Req(trace=None)) is not None
+        assert m.record_finished(_Req(trace=None)) is not None
+        assert m.snapshot()["records"] == 2
+
+    def test_handle_path_bills_streamed_tokens(self):
+        m = UsageMeter()
+        r = m.record_finished(_Req(), _Handle(streamed=5), attribution={"queue": 0.1})
+        assert r["prompt_tokens"] == 8
+        assert r["completion_tokens"] == 5  # handle truth, not req.output_ids
+        assert r["adapter_id"] is None or r["adapter_id"] == "ad-a"
+        assert r["retries"] == 1
+        assert r["e2e_s"] == pytest.approx(1.5)
+        assert r["attribution"] == {"queue": 0.1}
+
+    def test_no_handle_path_bills_folded_tokens_as_completion(self):
+        m = UsageMeter()
+        # a preemption folded 4 generated tokens into prompt_ids: prompt is
+        # the original 8, the folded 4 + 3 output bill as completion
+        r = m.record_finished(_Req(prompt_ids=[1] * 12, base_prompt_len=8))
+        assert r["prompt_tokens"] == 8
+        assert r["completion_tokens"] == 3 + 4
+
+    def test_metrics_counters_booked_per_record(self):
+        class _Counter:
+            def __init__(self):
+                self.calls = []
+
+            def inc(self, v=1, **labels):
+                self.calls.append((v, labels))
+
+        class _Metrics:
+            usage_tokens = _Counter()
+            usage_records = _Counter()
+
+        m = UsageMeter(metrics=_Metrics())
+        m.record_finished(_Req(adapter_id="ad-b"))
+        kinds = {c[1]["kind"]: c[0] for c in _Metrics.usage_tokens.calls}
+        assert kinds == {"prompt": 8, "cached": 4, "completion": 3}
+        assert all(c[1]["adapter"] == "ad-b" for c in _Metrics.usage_tokens.calls)
+        assert _Metrics.usage_records.calls == [(1, {"tenant": "acme"})]
+
+    def test_durable_meter_survives_reload(self, tmp_path):
+        m = UsageMeter(ledger=UsageLedger(str(tmp_path), replica="r0"))
+        m.record_finished(_Req())
+        m.record_finished(_Req(trace="tr-2", tenant="globex"))
+        m.close()
+        records, _ = load_ledger_dir(str(tmp_path))
+        assert {r["record_id"] for r in records} == {"tr-1", "tr-2"}
+        assert all(r["replica"] == "r0" for r in records)
+
+
+# ------------------------------------------------------------- offline report
+class TestUsageReport:
+    def _write_segment(self, path, records):
+        with open(path, "w", encoding="utf-8") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+
+    def test_merge_dedup_price_reconcile(self, tmp_path, capsys):
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.mkdir(), b.mkdir()
+        self._write_segment(a / "usage-r0-000000.jsonl",
+                            [rec(0), rec(1, tenant="globex", adapter="ad-a")])
+        # replica b booked tr-1's failed first attempt (mid-stream failover)
+        # plus a torn line
+        with open(b / "usage-r1-000000.open.jsonl", "w", encoding="utf-8") as f:
+            f.write(json.dumps(rec(1, tenant="globex", adapter="ad-a",
+                                   finish="engine_error", completion_tokens=2,
+                                   useful_tokens=4)) + "\n")
+            f.write('{"torn')
+        code = usage_report.main([str(a), str(b), "--useful-total", "24",
+                                  "--price-per-1k", "2.0"])
+        out = capsys.readouterr().out
+        assert code == 0
+        assert "2 billed" in out
+        assert "1 failover-superseded" in out
+        assert "1 torn lines dropped" in out
+        assert "reconciliation" in out and "-> ok" in out
+
+    def test_double_bill_conflict_exits_1(self, tmp_path, capsys):
+        d = tmp_path / "led"
+        d.mkdir()
+        self._write_segment(d / "usage-r0-000000.jsonl", [rec(0)])
+        # the hand-corrupted case: same id, both successful, doubled tokens
+        self._write_segment(d / "usage-r1-000000.jsonl",
+                            [rec(0, prompt_tokens=20, completion_tokens=10)])
+        code = usage_report.main([str(d)])
+        assert code == 1
+        assert "CONFLICT" in capsys.readouterr().out
+
+    def test_identical_duplicates_collapse_silently(self, tmp_path):
+        d = tmp_path / "led"
+        d.mkdir()
+        self._write_segment(d / "usage-r0-000000.jsonl", [rec(0)])
+        self._write_segment(d / "usage-r1-000000.jsonl", [rec(0)])
+        code = usage_report.main([str(d), "--json"])
+        assert code == 0
+
+    def test_reconciliation_divergence_beyond_slack_exits_1(self, tmp_path, capsys):
+        d = tmp_path / "led"
+        d.mkdir()
+        self._write_segment(d / "usage-r0-000000.jsonl", [rec(0)])  # useful 12
+        assert usage_report.main([str(d), "--useful-total", "20",
+                                  "--slack", "8"]) == 0
+        capsys.readouterr()
+        code = usage_report.main([str(d), "--useful-total", "20", "--slack", "7"])
+        assert code == 1
+        assert "DIVERGED" in capsys.readouterr().out
+
+    def test_json_output_matches_fold_shape(self, tmp_path, capsys):
+        d = tmp_path / "led"
+        d.mkdir()
+        self._write_segment(d / "usage-r0-000000.jsonl",
+                            [rec(0), rec(1, tenant="globex")])
+        assert usage_report.main([str(d), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        agg = empty_aggregate()
+        fold_record(agg, rec(0))
+        fold_record(agg, rec(1, tenant="globex"))
+        assert doc["usage"] == agg

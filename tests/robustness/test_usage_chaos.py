@@ -1,0 +1,202 @@
+"""Usage metering under chaos: exactly-once billing across a rebuild.
+
+A fault in ``engine.step`` mid-stream triggers the supervisor: in-flight
+requests are stashed, the engine rebuilds, the requests requeue with their
+streamed tokens folded into the prompt and finish token-exact. Billing-wise
+all of that must collapse to **exactly one usage record per request** — the
+stash never books, the post-rebuild resolution books once, and the sealed
+ledger (plus ``tools/usage_report.py``) shows one bill per trace id with the
+full client-visible completion. The reconciliation gap under chaos is
+one-sided: metered useful ≤ the counters' total, because the counters also
+saw the dead engine's completed work per retried request (the documented
+slack).
+
+The companion torn-write case (kill between segment append and seal via the
+``usage.seal`` fault point) lives in
+``tests/observability/test_usage_ledger.py``.
+
+CPU-only, tiny model — tier-1 speed."""
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine
+from paddlenlp_tpu.observability.usage import load_ledger_dir
+from paddlenlp_tpu.serving import (
+    MetricsRegistry,
+    SchedulerConfig,
+    ServingServer,
+    SupervisorPolicy,
+)
+from paddlenlp_tpu.serving.tenancy.metering import ENV_DIR
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import usage_report  # noqa: E402
+
+GEN = 24
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=112,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      eos_token_id=None, pad_token_id=0, use_scan_layers=True)
+    return LlamaForCausalLM.from_config(cfg, seed=0)
+
+
+def make_engine(model):
+    return InferenceEngine(model, max_batch_size=4, block_size=4, num_blocks=128,
+                           max_blocks_per_seq=32, decode_steps=4)
+
+
+class SSEStream:
+    def __init__(self, port, payload, timeout=300):
+        self.conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        self.conn.request("POST", "/v1/completions", body=json.dumps(payload),
+                          headers={"Content-Type": "application/json"})
+        self.resp = self.conn.getresponse()
+        self.status = self.resp.status
+
+    def events(self):
+        while True:
+            line = self.resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                return
+            yield json.loads(data)
+
+    def close(self):
+        self.conn.close()
+
+
+class TestUsageUnderChaos:
+    def test_one_record_per_request_across_rebuild(self, model, tmp_path,
+                                                   monkeypatch):
+        ledger_dir = tmp_path / "ledger"
+        monkeypatch.setenv(ENV_DIR, str(ledger_dir))
+        n_stream, n_err = 6, 1
+        registry = MetricsRegistry()
+        srv = ServingServer(
+            make_engine(model),
+            engine_factory=lambda: make_engine(model),
+            supervisor_policy=SupervisorPolicy(max_retries=2, backoff_base_s=0.25,
+                                               backoff_max_s=1.0),
+            scheduler_config=SchedulerConfig(max_inflight=16,
+                                             default_timeout_s=600.0),
+            registry=registry,
+        )
+        port = srv.start_in_thread()
+        try:
+            # fault on the 4th step: every stream admitted, none finished
+            # (1 prefill + 3x4 decode tokens < GEN)
+            FAULTS.arm("engine.step", nth=4)
+
+            results, errors = {}, {}
+
+            def worker(i, sink, extra):
+                s = SSEStream(port, dict({"prompt": [5 + i % 40, 6 + i % 40,
+                                                     7 + i % 40],
+                                          "max_tokens": GEN, "stream": True,
+                                          "tenant": ("acme", "globex")[i % 2]},
+                                         **extra))
+                assert s.status == 200
+                toks, finish = [], None
+                for ev in s.events():
+                    c = ev["choices"][0]
+                    if c.get("finish_reason"):
+                        finish = c["finish_reason"]
+                    elif "token" in c:
+                        toks.append(c["token"])
+                sink[i] = (toks, finish)
+                s.close()
+
+            threads = [threading.Thread(target=worker, args=(i, results, {}))
+                       for i in range(n_stream)]
+            threads += [threading.Thread(target=worker,
+                                         args=(100 + i, errors,
+                                               {"max_retries": 0}))
+                        for i in range(n_err)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            assert not any(t.is_alive() for t in threads)
+
+            assert srv.loop.metrics.engine_restarts.value() >= 1
+            for i, (toks, finish) in results.items():
+                assert finish == "length" and len(toks) == GEN, (i, finish)
+            for i, (toks, finish) in errors.items():
+                assert finish == "engine_error", (i, finish)
+
+            usage = srv.usage()
+            # the retried streams resolved ONCE each despite stash + requeue:
+            # one record per request, none suppressed as duplicates (nothing
+            # even attempted a double booking)
+            assert usage["records"] == n_stream + n_err
+            assert usage["duplicates_suppressed"] == 0
+            retried = [r for r in srv.loop.recent_finished if r["retries"]]
+            assert retried, "fault never forced a retry"
+            for row in retried:
+                # the bill covers the full client-visible completion, not
+                # just post-rebuild work
+                assert row["usage"]["completion_tokens"] == GEN
+
+            exposition = registry.expose()
+            counter_useful = 0.0
+            for line in exposition.splitlines():
+                if line.startswith("paddlenlp_serving_useful_tokens_total "):
+                    counter_useful = float(line.split()[-1])
+            metered_useful = usage["totals"]["useful_tokens"]
+        finally:
+            srv.shutdown(drain_timeout_s=10)
+
+        # sealed ledger: exactly one record per request id, full bills
+        records, report = load_ledger_dir(str(ledger_dir))
+        assert report["open_segments"] == 0
+        assert len(records) == n_stream + n_err
+        assert len({r["record_id"] for r in records}) == n_stream + n_err
+        by_reason = {}
+        for r in records:
+            by_reason[r["finish_reason"]] = by_reason.get(r["finish_reason"], 0) + 1
+        assert by_reason == {"length": n_stream, "engine_error": n_err}
+        retried_records = [r for r in records if r["retries"]]
+        assert retried_records
+        for r in retried_records:
+            assert r["completion_tokens"] == GEN
+
+        # one-sided reconciliation gap: the counters kept the dead engine's
+        # completed work, the records only attribute surviving-engine work
+        gap = counter_useful - metered_useful
+        assert gap >= 0, (counter_useful, metered_useful)
+        assert usage_report.reconcile(
+            usage_report.aggregate(records), [counter_useful], slack=gap)["ok"]
+        # ... and without slack the report flags the divergence (gap is only
+        # zero if the fault raced ahead of any completed work, which nth=4
+        # prevents)
+        assert gap > 0
+        assert usage_report.main([str(ledger_dir), "--useful-total",
+                                  str(counter_useful)]) == 1

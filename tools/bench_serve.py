@@ -884,6 +884,25 @@ def run() -> None:
             "shed": {k: int(v) for k, v in sorted(labeled_by(
                 "paddlenlp_serving_requests_shed_total", "tenant").items())},
         }
+        # billing view: fold every replica's usage-meter aggregate and
+        # cross-check metered useful tokens against the goodput counters —
+        # every booked request finished on one engine here, so the match is
+        # exact (the chaos-only slack sources never fire in a clean bench)
+        from paddlenlp_tpu.observability.usage import merge_aggregates
+
+        usage_servers = fleet.servers if fleet is not None else [server]
+        usage_fold = merge_aggregates(
+            [s.loop.usage.snapshot() for s in usage_servers])
+        ledger_useful = labeled_sum("paddlenlp_serving_useful_tokens_total")
+        record["usage"] = {
+            "records": usage_fold["records"],
+            "reconciliation_ok": usage_fold["totals"]["useful_tokens"]
+            == int(ledger_useful),
+            "per_tenant_tokens": {
+                t: int(b.get("prompt_tokens", 0) - b.get("cached_tokens", 0)
+                       + b.get("completion_tokens", 0))
+                for t, b in sorted(usage_fold["tenants"].items())},
+        }
     # recorder-overhead A/B facts: run once with PDNLP_TPU_FLIGHT_RECORDER=0
     # and once without, diff value/tails — these two fields label the arms
     record["flight_recorder"] = RECORDER.enabled

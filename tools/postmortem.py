@@ -170,6 +170,23 @@ def render_timeline(entries: List[Dict]) -> List[str]:
     return lines
 
 
+def _top_tenant_line(bundle: Dict) -> Optional[str]:
+    """One headline from the bundle's rolling usage aggregate: who was
+    burning the most billed tokens when the incident fired. None when the
+    bundle predates usage metering (or is router-tier)."""
+    usage = (bundle.get("health") or {}).get("usage") or {}
+    tenants = usage.get("tenants") or {}
+    if not tenants:
+        return None
+    def billed(b):
+        return (b.get("prompt_tokens", 0) - b.get("cached_tokens", 0)
+                + b.get("completion_tokens", 0))
+    top, bucket = max(tenants.items(), key=lambda kv: billed(kv[1]))
+    return (f"usage: {usage.get('records', 0)} records, top tenant "
+            f"{top} ({billed(bucket)} billed tokens, "
+            f"{bucket.get('records', 0)} requests)")
+
+
 def _summary(bundles: List[Dict]) -> List[str]:
     lines = []
     for b in bundles:
@@ -186,6 +203,9 @@ def _summary(bundles: List[Dict]) -> List[str]:
         for k in ("loop_state", "pending", "slot_quarantines", "policy"):
             if k in health:
                 lines.append(f"  {k}={health[k]}")
+        top = _top_tenant_line(b)
+        if top is not None:
+            lines.append(f"  {top}")
     return lines
 
 
@@ -224,6 +244,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "(request unfinished at dump time, or router-only bundle)")
         return 0
     if list_mode:
+        for b in bundles:
+            top = _top_tenant_line(b)
+            if top is not None:
+                print(f"{b['_path']}: {top}")
         for key, per in sorted(request_ids(bundles).items()):
             counts = " ".join(f"{t}={n}" for t, n in sorted(per.items()))
             print(f"{key:<16} {counts}")

@@ -1,0 +1,317 @@
+"""Offline usage-ledger aggregator: merge, dedup, price, reconcile.
+
+Reads the durable JSONL usage ledgers N replicas wrote (one directory per
+replica, or one shared directory — segment names carry the replica id either
+way) and produces the billing view:
+
+- **merge + dedup**: records are keyed by ``record_id`` (the request's trace
+  id). A mid-stream failover legitimately books the same id on two replicas;
+  the merge keeps the terminal-success record (``finish_reason`` stop/length)
+  and counts the loser as ``failover_superseded``. Two *successful* records
+  for one id with different token payloads is a billing conflict — reported
+  and exit code 1 (nobody gets double-billed silently);
+- **pricing**: ``--price-per-1k`` (default 0: token report only) or a
+  ``--prices FILE`` JSON table ``{tenant: $/1k}`` (``"*"`` = default). The
+  billed quantity per record is ``prompt - cached + completion`` — prefix-
+  cache hits are a credit, exactly the tokens the device never re-fed;
+- **reconciliation**: ``--useful-total N`` (repeatable; pass each replica's
+  goodput-ledger ``useful`` total) cross-checks the metered
+  ``useful_tokens`` sum against the device-side truth. Divergence beyond
+  ``--slack`` (absolute tokens, default 0) exits 1. The documented slack
+  sources: requests retried across an engine rebuild undershoot by the dead
+  engine's completed work, and counter totals include requests still
+  in flight / never booked (aborted pre-admission).
+
+Reading is tolerant, mirroring ``observability/usage.py``: sealed segments
+(``usage-*-NNNNNN.jsonl``) are authoritative; an open segment
+(``.open.jsonl``) with a sealed twin is skipped; torn or corrupt lines are
+dropped and counted, never fatal.
+
+Stdlib-only on purpose (no jax, no repo imports): runnable on a laptop
+against ledger directories scp'd off the fleet.
+
+Usage::
+
+    python tools/usage_report.py /var/ledger/replica-a /var/ledger/replica-b
+    python tools/usage_report.py LEDGER_DIR --prices prices.json
+    python tools/usage_report.py LEDGER_DIR --useful-total 48211 --slack 64
+    python tools/usage_report.py LEDGER_DIR --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_dirs", "dedup_records", "aggregate", "price", "reconcile",
+           "main"]
+
+OPEN_SUFFIX = ".open.jsonl"
+SEALED_SUFFIX = ".jsonl"
+
+#: mirrors observability.usage.SUM_FIELDS — the shared aggregate shape is
+#: the contract that lets this report be diffed against GET /fleet/usage
+SUM_FIELDS = (
+    "prompt_tokens",
+    "cached_tokens",
+    "completion_tokens",
+    "useful_tokens",
+    "spec_drafted",
+    "spec_accepted",
+    "kv_block_seconds",
+    "adapter_slot_seconds",
+)
+
+#: terminal finish reasons that mean "the client got a complete answer" —
+#: the survivor pick for failover-duplicated record ids
+SUCCESS_REASONS = {"stop", "length"}
+
+
+# --------------------------------------------------------------------- read
+def _parse_lines(path: str) -> Tuple[List[Dict], int]:
+    records: List[Dict] = []
+    dropped = 0
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            raw = f.read().split("\n")
+    except OSError:
+        return records, dropped
+    for line in raw:
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("record is not an object")
+            records.append(rec)
+        except ValueError:
+            dropped += 1
+    return records, dropped
+
+
+def load_dirs(directories: List[str]) -> Tuple[List[Dict], Dict]:
+    """Read every segment under every directory; returns (records, report).
+    Same tolerance contract as the in-repo loader: sealed beats its open
+    twin, bad lines drop + count."""
+    report = {"dirs": list(directories), "sealed_segments": 0,
+              "open_segments": 0, "torn_lines_dropped": 0,
+              "twins_skipped": 0, "records_read": 0}
+    records: List[Dict] = []
+    for directory in directories:
+        try:
+            names = sorted(os.listdir(directory))
+        except OSError as e:
+            print(f"usage_report: cannot read {directory}: {e}", file=sys.stderr)
+            continue
+        sealed_stems = {n[: -len(SEALED_SUFFIX)] for n in names
+                        if n.endswith(SEALED_SUFFIX)
+                        and not n.endswith(OPEN_SUFFIX)}
+        for name in names:
+            path = os.path.join(directory, name)
+            if name.endswith(OPEN_SUFFIX):
+                if name[: -len(OPEN_SUFFIX)] in sealed_stems:
+                    report["twins_skipped"] += 1
+                    continue
+                report["open_segments"] += 1
+            elif name.endswith(SEALED_SUFFIX):
+                report["sealed_segments"] += 1
+            else:
+                continue
+            recs, dropped = _parse_lines(path)
+            records.extend(recs)
+            report["torn_lines_dropped"] += dropped
+    report["records_read"] = len(records)
+    return records, report
+
+
+# -------------------------------------------------------------------- dedup
+def _tokens_key(rec: Dict) -> Tuple:
+    return tuple(rec.get(k) or 0 for k in
+                 ("prompt_tokens", "cached_tokens", "completion_tokens"))
+
+
+def _is_success(rec: Dict) -> bool:
+    return rec.get("finish_reason") in SUCCESS_REASONS
+
+
+def dedup_records(records: List[Dict]) -> Tuple[List[Dict], Dict, List[Dict]]:
+    """Collapse records sharing a record_id to one bill each.
+
+    Returns ``(kept, counts, conflicts)``. Identical duplicates collapse
+    silently (a re-sealed segment copied twice). A success + failure pair for
+    one id is the mid-stream-failover signature: the success wins, the loser
+    counts as ``failover_superseded``. Two successes with *different* token
+    payloads is a double bill — both land in ``conflicts`` (caller exits 1)
+    and the first is kept so totals stay deterministic."""
+    by_id: "Dict[str, Dict]" = {}
+    order: List[str] = []
+    counts = {"unique": 0, "identical_duplicates": 0,
+              "failover_superseded": 0, "conflicts": 0}
+    conflicts: List[Dict] = []
+    for rec in records:
+        rid = rec.get("record_id")
+        if not isinstance(rid, str) or not rid:
+            rid = f"_anon-{len(order)}"  # never merge id-less records
+        cur = by_id.get(rid)
+        if cur is None:
+            by_id[rid] = rec
+            order.append(rid)
+            counts["unique"] += 1
+            continue
+        if _tokens_key(cur) == _tokens_key(rec) \
+                and cur.get("finish_reason") == rec.get("finish_reason"):
+            counts["identical_duplicates"] += 1
+            continue
+        cur_ok, new_ok = _is_success(cur), _is_success(rec)
+        if cur_ok and new_ok:
+            counts["conflicts"] += 1
+            conflicts.append({"record_id": rid, "kept": cur, "dropped": rec})
+        elif new_ok and not cur_ok:
+            by_id[rid] = rec  # failover: the completed attempt is the bill
+            counts["failover_superseded"] += 1
+        else:
+            # failure duplicate of a success (or of another failure): the
+            # kept record already covers the client-visible outcome
+            counts["failover_superseded"] += 1
+    return [by_id[r] for r in order], counts, conflicts
+
+
+# ---------------------------------------------------------------- aggregate
+def _fold(bucket: Dict, rec: Dict):
+    bucket["records"] = bucket.get("records", 0) + 1
+    for k in SUM_FIELDS:
+        v = rec.get(k) or 0
+        bucket[k] = round(bucket.get(k, 0) + v, 6) if isinstance(v, float) \
+            else bucket.get(k, 0) + v
+
+
+def aggregate(records: List[Dict]) -> Dict:
+    """The /fleet/usage fold shape: fleet totals + per-tenant + per-adapter
+    buckets (None adapter bills to "base")."""
+    agg = {"records": 0, "totals": {k: 0 for k in SUM_FIELDS},
+           "tenants": {}, "adapters": {}}
+    for rec in records:
+        agg["records"] += 1
+        for k in SUM_FIELDS:
+            v = rec.get(k) or 0
+            t = agg["totals"]
+            t[k] = round(t[k] + v, 6) if isinstance(v, float) else t[k] + v
+        _fold(agg["tenants"].setdefault(rec.get("tenant") or "default", {}), rec)
+        _fold(agg["adapters"].setdefault(rec.get("adapter_id") or "base", {}), rec)
+    return agg
+
+
+def billed_tokens(bucket: Dict) -> int:
+    """The billable quantity: prompt minus prefix-cache credit plus
+    completion."""
+    return (bucket.get("prompt_tokens", 0) - bucket.get("cached_tokens", 0)
+            + bucket.get("completion_tokens", 0))
+
+
+def price(agg: Dict, default_per_1k: float,
+          table: Optional[Dict[str, float]] = None) -> Dict:
+    """Per-tenant dollars from the $/1k-token table (``"*"`` = fallback)."""
+    table = table or {}
+    out = {}
+    for tenant, bucket in sorted(agg["tenants"].items()):
+        rate = table.get(tenant, table.get("*", default_per_1k))
+        toks = billed_tokens(bucket)
+        out[tenant] = {"billed_tokens": toks, "rate_per_1k": rate,
+                       "amount": round(toks / 1000.0 * rate, 6)}
+    return out
+
+
+def reconcile(agg: Dict, useful_totals: List[float], slack: float) -> Dict:
+    """Metered useful tokens vs the goodput ledgers' device-side truth."""
+    metered = agg["totals"]["useful_tokens"]
+    counter = sum(useful_totals)
+    gap = counter - metered
+    return {"metered_useful_tokens": metered,
+            "ledger_useful_tokens": counter,
+            "gap": gap, "slack": slack, "ok": abs(gap) <= slack}
+
+
+# --------------------------------------------------------------------- main
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge usage ledgers: dedup, price, reconcile.")
+    ap.add_argument("dirs", nargs="+", help="ledger directories (one or more)")
+    ap.add_argument("--price-per-1k", type=float, default=0.0,
+                    help="default $ per 1k billed tokens")
+    ap.add_argument("--prices", help="JSON file {tenant: $/1k}, '*' = default")
+    ap.add_argument("--useful-total", type=float, action="append", default=[],
+                    help="a goodput ledger's useful-token total (repeatable; "
+                         "summed across replicas)")
+    ap.add_argument("--slack", type=float, default=0.0,
+                    help="absolute token slack tolerated by the reconciliation")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    table = None
+    if args.prices:
+        with open(args.prices, encoding="utf-8") as f:
+            table = json.load(f)
+
+    records, read_report = load_dirs(args.dirs)
+    kept, dedup_counts, conflicts = dedup_records(records)
+    agg = aggregate(kept)
+    invoice = price(agg, args.price_per_1k, table)
+    recon = reconcile(agg, args.useful_total, args.slack) \
+        if args.useful_total else None
+
+    rc = 0
+    if conflicts:
+        rc = 1
+    if recon is not None and not recon["ok"]:
+        rc = 1
+
+    doc = {"read": read_report, "dedup": dedup_counts, "usage": agg,
+           "invoice": invoice, "reconciliation": recon,
+           "conflicts": [{"record_id": c["record_id"]} for c in conflicts],
+           "ok": rc == 0}
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return rc
+
+    print(f"segments: {read_report['sealed_segments']} sealed, "
+          f"{read_report['open_segments']} open, "
+          f"{read_report['torn_lines_dropped']} torn lines dropped, "
+          f"{read_report['twins_skipped']} twins skipped")
+    print(f"records: {read_report['records_read']} read -> "
+          f"{agg['records']} billed "
+          f"({dedup_counts['identical_duplicates']} identical dups, "
+          f"{dedup_counts['failover_superseded']} failover-superseded)")
+    t = agg["totals"]
+    print(f"totals: prompt={t['prompt_tokens']} cached={t['cached_tokens']} "
+          f"completion={t['completion_tokens']} useful={t['useful_tokens']} "
+          f"kv_block_s={t['kv_block_seconds']}")
+    print("per tenant:")
+    for tenant in sorted(agg["tenants"]):
+        b = agg["tenants"][tenant]
+        line = (f"  {tenant}: requests={b.get('records', 0)} "
+                f"billed_tokens={billed_tokens(b)}")
+        if tenant in invoice and invoice[tenant]["rate_per_1k"]:
+            line += f" amount=${invoice[tenant]['amount']}"
+        print(line)
+    print("per adapter:")
+    for adapter in sorted(agg["adapters"]):
+        b = agg["adapters"][adapter]
+        print(f"  {adapter}: requests={b.get('records', 0)} "
+              f"billed_tokens={billed_tokens(b)} "
+              f"slot_s={b.get('adapter_slot_seconds', 0)}")
+    for c in conflicts:
+        print(f"CONFLICT: record_id {c['record_id']!r} has two successful "
+              f"records with different token payloads (double bill)")
+    if recon is not None:
+        verdict = "ok" if recon["ok"] else "DIVERGED"
+        print(f"reconciliation: metered useful={recon['metered_useful_tokens']} "
+              f"vs ledger useful={recon['ledger_useful_tokens']} "
+              f"gap={recon['gap']} slack={recon['slack']} -> {verdict}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
