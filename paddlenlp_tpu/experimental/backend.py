@@ -169,6 +169,16 @@ class ModelBackend:
     def apply_cow(self, pairs):
         raise NotImplementedError
 
+    def sync_params(self, new_params):
+        """Install a new base-weight tree as THE params for every subsequent
+        step. The explicit sibling of the lazy params-property rebind: callers
+        that need the placement to happen NOW (a serving weight swap that
+        wants device OOM / layout failures to surface inside its rollback
+        window, not on the next request's step) go through here. Backends
+        must keep their existing device layout — same NamedShardings, same
+        mesh — and must not touch the KV pool or penalty counts."""
+        raise NotImplementedError
+
     def describe(self) -> dict:
         raise NotImplementedError
 
@@ -222,6 +232,11 @@ class SingleDeviceBackend(ModelBackend):
     @property
     def params(self):
         return self.model.params
+
+    def sync_params(self, new_params):
+        # single device: the params property reads model.params directly, so
+        # the rebind IS the install (jit retraces nothing — same avals)
+        self.model.params = new_params
 
     # ---------------------------------------------------------------- lora
     def _place_lora(self, host_pool):

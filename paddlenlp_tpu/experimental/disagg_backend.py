@@ -206,6 +206,22 @@ class DisaggBackend(ModelBackend):
     def params(self):
         return self.decode_stage.params
 
+    def sync_params(self, new_params):
+        """Atomic two-stage resync: both stage placements are staged BEFORE
+        either stage's binding moves, so no step can ever launch prefill rows
+        on one weight version and decode rows on the other — if the second
+        ``device_put`` raises, neither stage changed. Each stage keeps its own
+        mesh/NamedSharding layout; pools, counts and in-flight migrations are
+        untouched (KV is invalidated one level up via the prefix-cache
+        epoch)."""
+        p_placed = jax.device_put(new_params, self.prefill_stage.infer.param_shardings)
+        d_placed = jax.device_put(new_params, self.decode_stage.infer.param_shardings)
+        self.model.params = new_params
+        self.prefill_stage._params_src = new_params
+        self.prefill_stage._params = p_placed
+        self.decode_stage._params_src = new_params
+        self.decode_stage._params = d_placed
+
     # ------------------------------------------------------------- steps
     def prefill(self, input_ids, block_tables, suffix_lens, cached_entries,
                 sampling, slot_idx, adapter_table=None):

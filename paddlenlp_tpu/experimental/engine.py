@@ -566,6 +566,15 @@ class InferenceEngine:
         the params that produced it."""
         self.mgr.clear_prefix_cache()
 
+    def sync_params(self, new_params):
+        """Install a new base-weight tree through the backend seam (eager
+        placement on the backend's existing device layout — see
+        :meth:`ModelBackend.sync_params`). Callers own the rest of the swap
+        protocol: quiesce, :meth:`clear_prefix_cache` (cached KV is only
+        valid under the params that produced it), and
+        :meth:`resync_counts` for any slots kept live across the swap."""
+        self.backend.sync_params(new_params)
+
     # ------------------------------------------------------------------ stage migration
     def _slot_of(self, req_id: int) -> Optional[int]:
         for slot, r in enumerate(self.slots):

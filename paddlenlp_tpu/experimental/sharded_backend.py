@@ -282,6 +282,15 @@ class ShardedBackend(SingleDeviceBackend):
             self._params = jax.device_put(self.model.params, self.infer.param_shardings)
         return self._params
 
+    def sync_params(self, new_params):
+        # eager re-place with the EXISTING NamedSharding layout: placement
+        # failures surface here (inside a swap's rollback window), and the
+        # id-check in the params property then sees a settled rebind
+        placed = jax.device_put(new_params, self.infer.param_shardings)
+        self.model.params = new_params
+        self._params_src = new_params
+        self._params = placed
+
     def describe(self) -> dict:
         axes = {k: int(v) for k, v in self.mesh.shape.items()}
         out = {

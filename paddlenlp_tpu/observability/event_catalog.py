@@ -53,6 +53,15 @@ EVENT_CATALOG: Dict[str, str] = {
     "router.hedge_commit": "one hedged leg produced the first usable event and was committed (fields: outcome=primary_won|hedge_won)",
     "router.hedge_abort": "the losing hedged leg was torn down (socket closed; /v1/abort when its upstream id was known)",
     "router.drain_evict": "a drain outlived its deadline; a token-less stream pinned to the draining replica was broken into pre-token failover",
+    # ------------------------------------------------------------- weight swap / rollout
+    "swap.begin": "a weight-swap command reached the engine loop and quiesce began (fields: version, mode=finish_old|pause_resume)",
+    "swap.done": "new params installed, canary passed, cache epoch bumped; the replica serves the new version (fields: version, resumed)",
+    "swap.rollback": "the swap failed after quiesce; the retained old params were restored and the replica kept serving (reason=swap_failed|canary_mismatch)",
+    "rollout.start": "the router began a rolling fleet weight rollout (fields: version, replicas)",
+    "rollout.replica": "one replica completed drain -> swap -> canary -> rejoin under the new version (fields: replica, wall_s)",
+    "rollout.abort": "a replica failed its swap/rejoin; the rollout stopped and already-swapped replicas were rolled back (reason=swap_failed|drain_timeout|rejoin_timeout|rollback_failed)",
+    "rollout.done": "every replica converged on the new weights version (fields: version, wall_s)",
+    "router.version_skew": "a mid-stream failover was refused because the surviving candidates run a different weights version; the stream was terminated in-band (fields: replica, version)",
     # ------------------------------------------------------------- autoscaler (fleet policy loop)
     "scale.up": "the autoscaler grew the fleet after sustained overload (fields: added, replicas)",
     "scale.down": "the autoscaler drained + removed replicas after sustained underload (fields: removed, replicas)",
@@ -71,6 +80,9 @@ EVENT_REASONS: Dict[str, Tuple[str, ...]] = {
     "sched.reject": ("saturated", "draining", "degraded", "deadline", "shed",
                      "tenant_quota"),
     "brownout.enter": ("saturation", "slo_fast_burn"),
+    "swap.rollback": ("swap_failed", "canary_mismatch"),
+    "rollout.abort": ("swap_failed", "drain_timeout", "rejoin_timeout",
+                      "rollback_failed"),
     "scale.hold": ("cooldown", "hysteresis", "max_envelope", "min_envelope",
                    "provision_backoff"),
 }

@@ -32,6 +32,14 @@ Routes::
                            AdapterRegistry: {"op": "load", "adapter_id",
                            "path" | "weights", "scaling"?} | {"op": "unload",
                            "adapter_id"} | {"op": "list"}
+    POST /admin/weights    live base-weight hot-swap from a committed
+                           checkpoint: {"ckpt_dir": str, "version"?,
+                           "mode"?: "finish_old"|"pause_resume",
+                           "canary"?: bool, "canary_digest"?, "timeout_s"?}
+                           — 409 on uncommitted/torn checkpoints, dimension
+                           conflicts, or a swap already in flight; a failed
+                           swap rolls back to the old weights and also
+                           answers 409 (body carries the rollback detail)
 
 Backpressure maps to HTTP: 429 when the admission window is full (retryable),
 503 while draining, 413 for oversized bodies. A client disconnect mid-stream
@@ -47,6 +55,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 from http.server import ThreadingHTTPServer
 from typing import Dict, Optional
@@ -54,8 +63,9 @@ from typing import Dict, Optional
 from ..observability.exporter import handle_profile_request, route_observability
 from ..observability.postmortem import handle_postmortem_request
 from ..observability.tracer import TRACEPARENT_HEADER, TRACER, parse_traceparent, use_trace
+from ..utils.faults import FaultPoint
 from ..utils.log import logger
-from .engine_loop import EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
+from .engine_loop import CANARY_PROMPT_IDS, EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
 from .httputil import JsonRequestHandler
 from .metrics import REGISTRY, MetricsRegistry
 from .brownout import PRIORITIES
@@ -71,9 +81,26 @@ from .scheduler import (
 from .tenancy.adapters import UnknownAdapterError
 from .tenancy.quotas import DEFAULT_TENANT, TenantQuotas
 
-__all__ = ["ServingServer"]
+__all__ = ["ServingServer", "WeightSwapConflictError"]
 
 MAX_BODY_BYTES = 8 << 20  # 8 MiB: far above any sane prompt payload
+
+# fires inside /admin/weights BEFORE any validation or load — an injected
+# fault here must surface as a clean HTTP error with zero engine mutation
+_F_WEIGHT_LOAD = FaultPoint("engine.weight_load")
+
+#: model-config dimensions that shape the parameter tree (and the LoRA pool
+#: arrays): a checkpoint disagreeing on any of these can never be hot-swapped
+_DIM_FIELDS = ("vocab_size", "hidden_size", "intermediate_size",
+               "num_hidden_layers", "num_attention_heads",
+               "num_key_value_heads", "head_dim")
+
+
+class WeightSwapConflictError(ValueError):
+    """A weight-swap request that can never succeed against this replica as
+    it stands: uncommitted/torn checkpoint, dimension mismatch vs the live
+    model config or resident adapters, or a swap already in flight (HTTP
+    409, never 500 — the engine was not touched)."""
 
 
 def _sampling_from_payload(payload: dict, max_new_default: int = 64):
@@ -259,6 +286,13 @@ class ServingServer:
         self.scheduler.start_drain()
         return {"draining": True, "retry_after_s": self._drain_retry_after}
 
+    def stop_drain(self) -> dict:
+        """Rejoin half of a rolling weight rollout: resume admitting new work
+        after a drain. The engine loop never stopped, so there is nothing to
+        restart — the admission gate just reopens."""
+        self.scheduler.stop_drain()
+        return {"draining": False}
+
     def efficiency(self) -> dict:
         """The ``GET /debug/efficiency`` document: the live engine's goodput
         ledger + step anatomy (the loop swaps engines on rebuild, so this
@@ -348,6 +382,106 @@ class ServingServer:
         doc["stats"] = registry.stats()
         return doc
 
+    def _check_ckpt_dims(self, ckpt_dir: str):
+        """409-gate a swap on checkpoint/model dimension agreement BEFORE any
+        bytes are loaded. Two layers: the checkpoint's own config must agree
+        with the live model config on every tree-shaping dimension, and when
+        LoRA adapters are resident their pool projection shapes (derived from
+        the same dims) must survive the swap — a mismatch is listed per-field
+        so the operator sees exactly what conflicts."""
+        from .tenancy.adapters import adapter_dims_from_config
+
+        model = self.loop.engine.model
+        cur = model.config
+        try:
+            new = type(cur).from_pretrained(ckpt_dir)
+        except Exception as e:
+            raise WeightSwapConflictError(
+                f"checkpoint {ckpt_dir} has no readable model config: {e}")
+        conflicts = []
+        for field in _DIM_FIELDS:
+            a, b = getattr(cur, field, None), getattr(new, field, None)
+            if a is not None and b is not None and int(a) != int(b):
+                conflicts.append(f"{field}: model {a} vs checkpoint {b}")
+        if conflicts:
+            raise WeightSwapConflictError(
+                "checkpoint dimensions conflict with the live model config: "
+                + "; ".join(conflicts))
+        registry = getattr(self.loop.engine, "adapter_registry", None)
+        resident = registry.ids() if registry is not None else []
+        if resident:
+            cur_dims = adapter_dims_from_config(cur)
+            new_dims = adapter_dims_from_config(new)
+            bad = [f"{proj}: {cur_dims[proj]} vs {new_dims[proj]}"
+                   for proj in cur_dims if cur_dims[proj] != new_dims.get(proj)]
+            if bad:
+                raise WeightSwapConflictError(
+                    f"checkpoint projection shapes conflict with resident "
+                    f"adapters {resident}: " + "; ".join(bad))
+
+    def _load_ckpt_params(self, ckpt_dir: str):
+        """Materialize the checkpoint's parameter tree host-side (placement
+        onto the backend's device layout happens inside the quiesced swap via
+        ``sync_params``). Built against the LIVE config so the tree structure
+        is guaranteed identical; a leaf-shape surprise inside the loader
+        (torn shard, wrong file) is still a 409, not a 500."""
+        model = self.loop.engine.model
+        try:
+            loaded = type(model).from_pretrained(
+                ckpt_dir, config=model.config, dtype=model.dtype,
+                param_dtype=model.param_dtype)
+        except ValueError as e:
+            raise WeightSwapConflictError(
+                f"checkpoint {ckpt_dir} does not match the live parameter "
+                f"tree: {e}")
+        return loaded.params
+
+    def admin_weights(self, payload: dict) -> dict:
+        """Live base-weight hot-swap (POST /admin/weights): validate a
+        committed checkpoint, 409-gate dimension conflicts, load the new tree,
+        then hand it to the engine loop which quiesces at a step boundary,
+        installs through the backend seam, bumps the prefix-cache epoch, runs
+        the canary probe, and rolls back all-or-nothing on any failure.
+        Everything that can fail cheaply fails HERE, on the HTTP thread,
+        before the loop is asked to touch the engine."""
+        ckpt_dir = payload.get("ckpt_dir")
+        if not ckpt_dir or not isinstance(ckpt_dir, str):
+            raise ValueError("missing required field 'ckpt_dir' (string path)")
+        _F_WEIGHT_LOAD.fire(path=ckpt_dir)
+        from ..trainer.unified_checkpoint import validate_checkpoint
+
+        reason = validate_checkpoint(ckpt_dir, verify_hashes=True)
+        if reason is not None:
+            raise WeightSwapConflictError(
+                f"checkpoint {ckpt_dir} is not swappable: {reason}")
+        self._check_ckpt_dims(ckpt_dir)
+        new_params = self._load_ckpt_params(ckpt_dir)
+        version = str(payload.get("version")
+                      or os.path.basename(os.path.normpath(ckpt_dir)))
+        mode = str(payload.get("mode", "finish_old"))
+        timeout_s = payload.get("timeout_s")
+        timeout_s = 120.0 if timeout_s is None else float(timeout_s)
+        canary = bool(payload.get("canary", True))
+        canary_digest = payload.get("canary_digest")
+        if canary_digest is not None:
+            canary_digest = str(canary_digest)
+        canary_ids = payload.get("canary_prompt")
+        if canary_ids is not None:
+            canary_ids = tuple(int(t) for t in canary_ids)
+        elif canary:
+            canary_ids = CANARY_PROMPT_IDS
+        try:
+            result = self.loop.request_weight_swap(
+                new_params, version, mode=mode,
+                canary_prompt_ids=canary_ids, canary_digest=canary_digest,
+                timeout_s=timeout_s)
+        except RuntimeError as e:
+            # another swap holds the loop, or the loop is not running: the
+            # engine was not touched — a clean conflict, not a server error
+            raise WeightSwapConflictError(str(e))
+        result["ckpt_dir"] = ckpt_dir
+        return result
+
     def _decode_delta(self, toks, emitted: int, final: bool = False):
         """Incremental detokenization: full-decode + diff. A trailing U+FFFD
         means a codepoint is still split across tokens — hold it back until the
@@ -397,6 +531,10 @@ class ServingServer:
                             "status": status,
                             "scheduler": server.scheduler.stats(),
                             "engine": server.loop.engine.stats(),
+                            # base-weight version this replica serves: the
+                            # router's rollout gate and version-skew failover
+                            # guard both key off this field
+                            "weights_version": server.loop.weights_version,
                             # overload ladder level, top-level so the router's
                             # health poller can read it without digging into
                             # scheduler stats (>= 2 suppresses hedging here)
@@ -446,7 +584,10 @@ class ServingServer:
                         payload = self._read_body()
                         if payload is not None:
                             try:
-                                doc = server.start_drain(payload.get("retry_after_s"))
+                                if payload.get("undo"):
+                                    doc = server.stop_drain()
+                                else:
+                                    doc = server.start_drain(payload.get("retry_after_s"))
                             except (TypeError, ValueError):
                                 self._send_error_json(
                                     400,
@@ -455,6 +596,24 @@ class ServingServer:
                                     "invalid_request")
                             else:
                                 self._send_json(200, doc)
+                    elif self.path == "/admin/weights":
+                        payload = self._read_body()
+                        if payload is not None:
+                            try:
+                                doc = server.admin_weights(payload)
+                            except WeightSwapConflictError as e:
+                                self._send_error_json(409, str(e), "weights_conflict")
+                            except TimeoutError as e:
+                                self._send_error_json(
+                                    504, f"weight swap timed out: {e}", "swap_timeout")
+                            except (TypeError, ValueError) as e:
+                                self._send_error_json(400, str(e), "invalid_request")
+                            else:
+                                # a swap that failed mid-flight rolled back and
+                                # kept serving the old weights: a conflict with
+                                # the full rollback detail in the body, so the
+                                # router's rollout orchestrator can abort on it
+                                self._send_json(200 if doc.get("ok") else 409, doc)
                     elif self.path == "/admin/adapters":
                         payload = self._read_body()
                         if payload is not None:
@@ -557,6 +716,7 @@ class ServingServer:
                     "choices": [choice],
                     "usage": {
                         "prompt_tokens": handle.prompt_len,
+                        "cached_tokens": int(getattr(req, "cached_tokens", 0) or 0),
                         "completion_tokens": len(toks),
                         "total_tokens": handle.prompt_len + len(toks),
                     },

@@ -49,6 +49,7 @@ annotations enforced by ``tools/analyze`` (lock-discipline checker).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import queue
 import threading
@@ -68,7 +69,8 @@ from .tenancy.metering import UsageMeter
 from .tenancy.quotas import DEFAULT_TENANT
 
 __all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy",
-           "ATTRIBUTION_PHASES", "request_attribution"]
+           "ATTRIBUTION_PHASES", "request_attribution", "canary_digest",
+           "CANARY_PROMPT_IDS"]
 
 #: the per-request latency-attribution phase vocabulary. Non-overlapping by
 #: construction: queue + admission_gate span arrival -> first admission,
@@ -125,6 +127,72 @@ _END = object()  # token-queue sentinel: stream closed
 
 _F_REBUILD = FaultPoint("engine.rebuild")
 _F_SLOT_REBUILD = FaultPoint("engine.slot_rebuild")
+_F_WEIGHT_SWAP = FaultPoint("engine.weight_swap")
+
+#: the fixed greedy canary probe: low token ids exist in every vocab the stack
+#: serves, and greedy decoding makes the output a pure function of the weights
+#: — the same prompt on two replicas with the same checkpoint MUST digest
+#: identically (the rollout's cross-replica verification contract)
+CANARY_PROMPT_IDS = (1, 2, 3, 4, 5, 6, 7, 8)
+
+
+def canary_digest(token_ids) -> str:
+    """Stable digest of a greedy canary generation (order-sensitive, dtype-
+    insensitive): what ships in a rollout request and what a reference replica
+    records. Pure stdlib so tools/rollout.py can import-free reimplement it."""
+    return hashlib.sha256(
+        ",".join(str(int(t)) for t in token_ids).encode()).hexdigest()
+
+
+class _CanaryMismatch(RuntimeError):
+    """The post-swap canary generation digested differently than expected."""
+
+
+class _WeightSwap:
+    """One in-flight weight-swap command (HTTP thread <-> loop thread handoff).
+
+    The HTTP handler does all validation and checkpoint loading BEFORE
+    constructing this (nothing engine-side has mutated if loading fails); the
+    loop thread owns quiesce, ``sync_params``, the cache-epoch bump, the
+    canary and rollback. ``mode``:
+
+    - ``finish_old``: in-flight requests finish under the old weights; the
+      swap waits for the engine to drain (new submissions are held, not
+      rejected — the drain is bounded by the caller's timeout).
+    - ``pause_resume``: in-flight requests are stashed immediately (the
+      supervisor's recompute-requeue trick) and resume under the NEW weights;
+      their continuations are explicitly NOT token-identical to what the old
+      weights would have produced (``token_identity: false`` in the result).
+    """
+
+    def __init__(self, new_params, version: str, mode: str = "finish_old",
+                 canary_prompt_ids=None, canary_sampling=None,
+                 canary_digest: Optional[str] = None):
+        self.new_params = new_params
+        self.version = version
+        self.mode = mode
+        self.canary_prompt_ids = canary_prompt_ids
+        self.canary_sampling = canary_sampling
+        self.canary_digest = canary_digest
+        self.result: Optional[Dict] = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def finish(self, result: Dict):
+        self.result = result
+        self._done.set()
+
+    def fail(self, error: BaseException):
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float]) -> Dict:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"weight swap to {self.version!r} not finished within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
 
 
 @dataclasses.dataclass
@@ -482,6 +550,14 @@ class ServingMetrics:
             "paddlenlp_serving_usage_records_total",
             "Usage records booked (exactly one per finished request id)",
             labelnames=("tenant",))
+        # info-style gauge (value is always 1 on the live series): the base-
+        # weight version this replica serves — the router's federated scrape
+        # makes a mixed-version fleet visible as multiple {version} series
+        self.weights_info = r.gauge(
+            "paddlenlp_serving_weights_info",
+            "Base-weight version this replica currently serves (1 = active; "
+            "a completed swap removes the superseded version's series)",
+            labelnames=("version",))
         self.rebind(engine)
 
     def rebind(self, engine):
@@ -722,6 +798,15 @@ class EngineLoop:
         # /debug/requests tail: finished-request summaries (appended only on
         # the loop thread; deque ops are atomic so HTTP readers need no lock)
         self.recent_finished: deque = deque(maxlen=64)
+        # live weight-swap state: the version string this replica serves
+        # (reported on /health; the rollout orchestrator's convergence check),
+        # the swap currently quiescing, and submissions held while it does.
+        # All loop-thread-confined except weights_version, which HTTP threads
+        # read as a single-slot value (momentarily stale reads are fine).
+        self.weights_version = "v0"
+        self._pending_swap: Optional[_WeightSwap] = None
+        self._held_cmds: List[tuple] = []
+        self.metrics.weights_info.set(1.0, version=self.weights_version)
 
     # ------------------------------------------------------------- lifecycle
     def start(self):
@@ -798,7 +883,8 @@ class EngineLoop:
         return True
 
     def pending_count(self) -> int:
-        return len(self._handles) + len(self._requeue) + self._cmds.qsize()
+        return (len(self._handles) + len(self._requeue) + len(self._held_cmds)
+                + self._cmds.qsize())
 
     # ------------------------------------------------------------- client api
     def submit(self, prompt_ids, sampling=None, deadline_s: Optional[float] = None,
@@ -838,6 +924,35 @@ class EngineLoop:
         self._cmds.put(("abort", handle))
         self._wake.set()
 
+    def request_weight_swap(self, new_params, version: str, *,
+                            mode: str = "finish_old",
+                            canary_prompt_ids=None, canary_sampling=None,
+                            canary_digest: Optional[str] = None,
+                            timeout_s: Optional[float] = 120.0) -> Dict:
+        """Thread-safe live weight swap; blocks until the loop installed the
+        new params (canary passed) or rolled back to the retained old ones.
+
+        The caller (the /admin/weights handler) must have fully validated and
+        loaded ``new_params`` already — by the time this is called the only
+        remaining failure modes are the swap itself and the canary, both of
+        which roll back. Returns the loop's result dict (``ok``,
+        ``weights_version``, ``canary_digest``, ``resumed``,
+        ``token_identity``, ``wall_s`` — plus ``reason``/``error`` on a
+        rollback); raises TimeoutError when the quiesce outlives
+        ``timeout_s`` (the swap stays queued and will still run)."""
+        if not self.running:
+            raise RuntimeError("engine loop is not running")
+        if mode not in ("finish_old", "pause_resume"):
+            raise ValueError(f"unknown swap mode {mode!r} "
+                             "(want finish_old | pause_resume)")
+        swap = _WeightSwap(new_params, version, mode=mode,
+                           canary_prompt_ids=canary_prompt_ids,
+                           canary_sampling=canary_sampling,
+                           canary_digest=canary_digest)
+        self._cmds.put(("weights", swap))
+        self._wake.set()
+        return swap.wait(timeout_s)
+
     # ------------------------------------------------------------- loop body
     def _run(self):
         try:
@@ -862,6 +977,15 @@ class EngineLoop:
         self._drain_cmds()
         self._phase = "deadlines"
         self._enforce_deadlines()
+        if self._pending_swap is not None:
+            swap = self._pending_swap
+            # finish_old waits for the engine to run dry at a step boundary
+            # (held submissions guarantee it eventually does; deadlines bound
+            # wedged streams); pause_resume stashes and swaps immediately
+            if swap.mode == "pause_resume" or (
+                    not self._handles and not self._requeue):
+                self._phase = "weight_swap"
+                self._execute_swap(swap)
         if self.engine.has_work():
             self._phase = "step"
             stats_before = self.engine.num_preemptions
@@ -1169,6 +1293,12 @@ class EngineLoop:
             h._resolve(None, error=e)
         self._handles.clear()
         self._requeue = []
+        if self._pending_swap is not None:
+            self._pending_swap.fail(e)
+            self._pending_swap = None
+        for cmd in self._held_cmds:
+            cmd[1]._resolve(None, error=e)
+        self._held_cmds = []
         while True:
             try:
                 cmd = self._cmds.get_nowait()
@@ -1176,6 +1306,8 @@ class EngineLoop:
                 break
             if cmd[0] == "submit":
                 cmd[1]._resolve(None, error=e)
+            elif cmd[0] == "weights":
+                cmd[1].fail(e)
 
     # ------------------------------------------------------------- commands
     def _drain_cmds(self):
@@ -1189,6 +1321,13 @@ class EngineLoop:
                 _, _, prompt_ids, sampling = cmd
                 if handle._cancelled:
                     handle._resolve(None)
+                    continue
+                if self._pending_swap is not None:
+                    # a swap is quiescing: new work must not extend the drain
+                    # (finish_old) or race the canary — hold it, re-inject
+                    # after the swap settles (the handle's clock keeps
+                    # running, so queue-wait metrics see the swap stall)
+                    self._held_cmds.append(cmd)
                     continue
                 handle.depth_at_submit = self._engine_backlog()
                 stream_cb = self._make_stream_cb(handle)
@@ -1209,6 +1348,109 @@ class EngineLoop:
                 self._handles[handle.req_id] = handle
             elif kind == "abort":
                 self._abort_handle(handle)
+            elif kind == "weights":
+                if self._pending_swap is not None:
+                    handle.fail(RuntimeError(
+                        "another weight swap is already in progress"))
+                else:
+                    self._pending_swap = handle
+
+    # ------------------------------------------------------------- weight swap
+    def _execute_swap(self, swap: _WeightSwap):
+        """Perform one quiesced weight swap on the loop thread — all-or-
+        nothing per replica: retain old params → ``sync_params`` (eager
+        placement) → prefix-cache epoch bump → greedy canary → commit; ANY
+        failure restores the retained old params and re-bumps the epoch, so
+        the replica keeps serving the version it served before. Old params
+        are released (last reference dropped) only after the canary passed."""
+        t0 = time.time()
+        RECORDER.record("swap.begin", version=swap.version, mode=swap.mode)
+        if swap.mode == "pause_resume" and self._handles:
+            # stash in-flight requests exactly like the supervisor's triage:
+            # streamed tokens fold into the retry prompt and the request
+            # resumes under whichever params the swap settles on — explicitly
+            # NOT token-identical to an uninterrupted old-weights generation
+            for handle in list(self._handles.values()):
+                if handle.done():
+                    continue
+                handle._prefilled_hint = self._prefilled_len_of(handle.req_id)
+                self.engine.abort(handle.req_id)
+                self.metrics.request_retries.inc()
+                self._requeue.append(handle)
+            self._handles.clear()
+            self._last_token_t.clear()
+        engine = self.engine
+        old_params = engine.model.params  # retained until canary pass
+        digest = None
+        try:
+            _F_WEIGHT_SWAP.fire(version=swap.version)
+            engine.sync_params(swap.new_params)
+            engine.clear_prefix_cache()
+            if swap.canary_prompt_ids:
+                digest = self._run_canary(swap)
+                if swap.canary_digest is not None and digest != swap.canary_digest:
+                    raise _CanaryMismatch(
+                        f"canary digest {digest[:16]}... != expected "
+                        f"{swap.canary_digest[:16]}...")
+        except Exception as e:
+            reason = ("canary_mismatch" if isinstance(e, _CanaryMismatch)
+                      else "swap_failed")
+            try:
+                # a canary that died mid-generate may have left engine-side
+                # request state: reset drops it (no client work is resident)
+                if engine.has_work() and callable(getattr(engine, "reset", None)):
+                    engine.reset()
+                engine.sync_params(old_params)
+                engine.clear_prefix_cache()
+            except Exception as rb:
+                # rollback itself failing leaves the replica poisoned — the
+                # next step exception sends it through the supervisor
+                logger.error(f"weight-swap rollback failed: {rb!r}")
+            RECORDER.record("swap.rollback", version=swap.version, reason=reason,
+                            error=repr(e)[:200])
+            self.postmortem.dump("weight_swap_rollback", detail={
+                "version": swap.version, "reason": reason,
+                "error": repr(e)[:500], "canary_digest": digest})
+            logger.error(
+                f"weight swap to {swap.version!r} rolled back ({reason}): {e!r}")
+            result = {"ok": False, "reason": reason, "error": repr(e)[:500],
+                      "rolled_back": True,
+                      "weights_version": self.weights_version,
+                      "canary_digest": digest,
+                      "wall_s": round(time.time() - t0, 3)}
+        else:
+            old_version = self.weights_version
+            self.weights_version = swap.version
+            if old_version != swap.version:
+                self.metrics.weights_info.remove_series(version=old_version)
+            self.metrics.weights_info.set(1.0, version=swap.version)
+            RECORDER.record("swap.done", version=swap.version,
+                            resumed=len(self._requeue))
+            logger.info(f"weights swapped: {old_version!r} -> {swap.version!r} "
+                        f"in {time.time() - t0:.2f}s (canary {digest and digest[:12]})")
+            result = {"ok": True, "weights_version": swap.version,
+                      "canary_digest": digest,
+                      "wall_s": round(time.time() - t0, 3)}
+        finally:
+            self._pending_swap = None
+            held, self._held_cmds = self._held_cmds, []
+            for cmd in held:
+                self._cmds.put(cmd)
+        # pause_resume: the stash resumes under whichever params won (the new
+        # ones, or the rolled-back old ones) — resumed continuations are
+        # never token-identity-guaranteed, and the result says so
+        resumed = self._resubmit_stashed() if self._requeue else 0
+        result["resumed"] = resumed
+        result["token_identity"] = resumed == 0
+        swap.finish(result)
+
+    def _run_canary(self, swap: _WeightSwap) -> str:
+        """Greedy canary self-check on the drained engine: generate the fixed
+        probe and digest the output ids. Runs on the loop thread between
+        steps, so it never interleaves with client work."""
+        out = self.engine.generate([list(swap.canary_prompt_ids)],
+                                   swap.canary_sampling)[0]
+        return canary_digest(out)
 
     def _add_to_engine(self, handle: RequestHandle, prompt_ids, sampling,
                        stream_cb, rework_hwm: int = 0) -> int:
@@ -1467,6 +1709,7 @@ class EngineLoop:
             "loop_state": self._state,
             "phase": self._phase,
             "pending": self.pending_count(),
+            "weights_version": self.weights_version,
             "slot_quarantines": self.slot_quarantines,
             "engine": self.engine.stats(),
             "inflight": self.inflight_info(),
@@ -1516,6 +1759,15 @@ class EngineLoop:
         for handle in self._requeue:
             handle._resolve(None)
         self._requeue = []
+        # a swap the stop interrupted (and submissions it was holding):
+        # their waiters are blocked — fail/resolve them
+        stop_err = RuntimeError("engine loop stopped")
+        if self._pending_swap is not None:
+            self._pending_swap.fail(stop_err)
+            self._pending_swap = None
+        for cmd in self._held_cmds:
+            cmd[1]._resolve(None)
+        self._held_cmds = []
         # submit commands that raced the stop and never reached the engine:
         # their clients are blocked in result() — resolve them too
         while True:
@@ -1525,3 +1777,5 @@ class EngineLoop:
                 break
             if cmd[0] == "submit":
                 cmd[1]._resolve(None)
+            elif cmd[0] == "weights":
+                cmd[1].fail(stop_err)

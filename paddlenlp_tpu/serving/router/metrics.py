@@ -83,6 +83,11 @@ class RouterMetrics:
             "paddlenlp_router_membership_changes_total",
             "Admin-plane replica membership mutations by op (add/drain/remove)",
             labelnames=("op",))
+        self.version_skew_terminations = r.counter(
+            "paddlenlp_router_version_skew_total",
+            "Token-bearing streams terminated in-band with "
+            "finish_reason=version_skew because a weight rollout left no "
+            "surviving replica on the stream's weights version")
         # same family name the replicas' ServingMetrics registers: the router
         # contributes the hedge_race phase (time from shadow launch to the
         # first usable event) so one histogram family carries the whole

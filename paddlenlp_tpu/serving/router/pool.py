@@ -136,6 +136,9 @@ class ProbeResult:
     kv_utilization: Optional[float] = None
     retry_after_s: Optional[float] = None
     brownout_level: int = 0  # the replica's overload-brownout ladder level
+    # the base-weight version the replica reports on /health (rollout gate +
+    # version-skew failover guard); None when the probe could not read one
+    weights_version: Optional[str] = None
     error: Optional[str] = None
     # clock-sync piggyback: the replica's tracer-timeline "now" plus the
     # probe's RTT — one offset estimate per probe (NTP-style midpoint)
@@ -163,6 +166,9 @@ class ReplicaSnapshot:
     # the replica's overload-brownout level (0 normal .. 3 clamp): >= 2 means
     # the replica asked the fleet to stop racing hedge shadows against it
     brownout_level: int = 0
+    # last /health-reported base-weight version (None until first probe):
+    # the policy's skew guard and the rollout's rejoin gate both read this
+    weights_version: Optional[str] = None
 
     def to_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -185,6 +191,7 @@ class Replica:
         self.kv_utilization = 0.0
         self.retry_after_s: Optional[float] = None
         self.brownout_level = 0
+        self.weights_version: Optional[str] = None
         self.consecutive_failures = 0
         self.recovery_streak = 0
         self.last_poll_t: Optional[float] = None
@@ -213,7 +220,8 @@ class Replica:
             kv_utilization=self.kv_utilization, retry_after_s=self.retry_after_s,
             consecutive_failures=self.consecutive_failures, last_poll_t=self.last_poll_t,
             clock_offset_s=self.clock_offset_s, draining=self.draining,
-            drained=self.drained, brownout_level=self.brownout_level)
+            drained=self.drained, brownout_level=self.brownout_level,
+            weights_version=self.weights_version)
 
 
 class ReplicaPool:
@@ -294,6 +302,25 @@ class ReplicaPool:
                        f"(deadline {deadline_s:.1f}s)")
         self.tracer.instant("membership", cat="router", op="drain",
                             replica=replica_id, deadline_s=deadline_s)
+        return self.drain_status(replica_id)
+
+    def cancel_drain(self, replica_id: str) -> Dict:
+        """Undo :meth:`start_drain` — the rejoin half of a rolling weight
+        rollout (drain, swap, un-drain) for a replica that is NOT leaving the
+        fleet. Clears the whole drain lifecycle so the policy layer offers it
+        again; idempotent on a replica that was never draining."""
+        _F_MEMBERSHIP.fire(op="undrain", replica=replica_id)
+        with self._lock:
+            replica = self._by_id.get(replica_id)
+            if replica is None:
+                raise KeyError(f"unknown replica {replica_id!r}")
+            replica.draining = False
+            replica.drained = False
+            replica.drain_deadline_t = None
+            replica.drain_expired_notified = False
+        logger.warning(f"router: replica {replica_id} drain cancelled (rejoining)")
+        self.tracer.instant("membership", cat="router", op="undrain",
+                            replica=replica_id)
         return self.drain_status(replica_id)
 
     def remove(self, replica_id: str, force: bool = False) -> Dict:
@@ -503,6 +530,8 @@ class ReplicaPool:
             queue_depth=int(engine.get("queue_depth", 0)),
             retry_after_s=float(retry_after) if retry_after else None,
             brownout_level=int(brownout) if isinstance(brownout, (int, float)) else 0,
+            weights_version=(str(body["weights_version"])
+                             if body.get("weights_version") is not None else None),
         )
         # clock-offset estimate for trace stitching: the replica stamped its
         # tracer-timeline "now" somewhere inside [t0, t1]; assume the midpoint
@@ -585,6 +614,10 @@ class ReplicaPool:
                 replica.inflight = result.inflight
                 replica.queue_depth = result.queue_depth
                 replica.brownout_level = result.brownout_level
+                # proxy-feedback observations carry no version; keep the last
+                # probed one rather than forgetting it
+                if result.weights_version is not None:
+                    replica.weights_version = result.weights_version
                 if result.kv_utilization is not None:
                     replica.kv_utilization = result.kv_utilization
                 if result.clock_offset_s is not None and result.rtt_s is not None:

@@ -77,6 +77,7 @@ import http.client
 import itertools
 import json
 import math
+import os
 import queue
 import socket
 import threading
@@ -130,6 +131,21 @@ __all__ = ["RouterServer"]
 MAX_BODY_BYTES = 8 << 20
 
 _F_FORWARD = FaultPoint("router.forward")
+
+# fires at the top of each per-replica rollout step (before the drain): an
+# injected fault must abort the whole rollout, roll swapped replicas back,
+# and leave every replica serving traffic
+_F_ROLLOUT = FaultPoint("router.rollout")
+
+
+class _RolloutFailure(RuntimeError):
+    """One replica's rollout step failed. ``reason`` draws from the
+    ``rollout.abort`` closed enum (event_catalog.EVENT_REASONS)."""
+
+    def __init__(self, reason: str, detail: str, replica: Optional[str] = None):
+        super().__init__(detail)
+        self.reason = reason
+        self.replica = replica
 
 #: transport-level failures on the upstream leg; InjectedFault rides along so
 #: the router.forward fault point is handled exactly like a real socket error
@@ -285,7 +301,7 @@ class _RelayState:
 
     __slots__ = ("rid", "stream", "headers_sent", "tokens_relayed", "arrival_t",
                  "attempts", "finished", "sampled", "replica_id", "upstream_conn",
-                 "upstream_resp", "upstream_cid")
+                 "upstream_resp", "upstream_cid", "weights_version")
 
     def __init__(self, rid: str, stream: bool, sampled: bool = True):
         self.rid = rid
@@ -300,6 +316,10 @@ class _RelayState:
         self.upstream_conn = None  # live upstream HTTPConnection (drain eviction)
         self.upstream_resp = None  # its HTTPResponse (owns the socket once read)
         self.upstream_cid: Optional[str] = None  # upstream cmpl-N id once seen
+        # base-weight version of the pinned replica at attempt start: a
+        # mid-stream death during a fleet rollout terminates as version_skew
+        # (not replica_error) when the stream's version is no longer served
+        self.weights_version: Optional[str] = None
 
 
 class RouterServer:
@@ -388,6 +408,11 @@ class RouterServer:
         # has open are folded into the score instead
         self._forward_inflight: Dict[str, int] = {}
         self._inflight_lock = threading.Lock()
+        # rolling weight rollout: one at a time fleet-wide; the state doc is
+        # what GET /admin/weights/rollout (and /replicas) report
+        self._rollout_lock = threading.Lock()
+        self._rollout: Optional[Dict] = None  # guarded-by: _rollout_lock
+        self._rollout_thread: Optional[threading.Thread] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------- routing
@@ -601,6 +626,9 @@ class RouterServer:
                     if parts.path == "/replicas":
                         self._send_json(200, router.admin_list_replicas())
                         return
+                    if parts.path == "/admin/weights/rollout":
+                        self._send_json(200, {"rollout": router.rollout_status()})
+                        return
                     routed = route_observability(self.path, router.registry, router.tracer)
                     if routed is not None:
                         self._send_raw(routed[0], routed[2], routed[1])
@@ -641,6 +669,11 @@ class RouterServer:
                         payload = self._read_body()
                         if payload is not None:
                             code, doc = router.admin_adapters_fleet(payload)
+                            self._send_json(code, doc)
+                    elif self.path == "/admin/weights/rollout":
+                        payload = self._read_body()
+                        if payload is not None:
+                            code, doc = router.admin_weights_rollout(payload)
                             self._send_json(code, doc)
                     elif self.path.split("?", 1)[0] == "/debug/postmortem":
                         # drain any request body first (keep-alive hygiene)
@@ -697,7 +730,10 @@ class RouterServer:
             doc["drain"] = self.pool.drain_status(snap.id)
             doc["open_forwards"] = self._open_forwards_on(snap.id)
             replicas.append(doc)
-        return {"replicas": replicas, "removed": self.pool.removed()}
+        return {"replicas": replicas, "removed": self.pool.removed(),
+                # mixed-version visibility: per-replica weights_version above,
+                # plus the rollout (if any) responsible for the mix
+                "rollout": self.rollout_status()}
 
     def admin_add_replica(self, payload: dict) -> Tuple[int, Dict]:
         """POST /replicas {"host", "port", "id"?}: join a replica to the pool.
@@ -1049,6 +1085,291 @@ class RouterServer:
         return 200, {"op": payload.get("op", "list"), "replicas": results,
                      "skipped": skipped, "ok": ok, "failed": failed}
 
+    # ------------------------------------------------------------- weight rollout
+    def rollout_status(self) -> Optional[Dict]:
+        """Point-in-time copy of the current/last rollout's state doc (None
+        before the first rollout). Served on GET /admin/weights/rollout and
+        embedded in GET /replicas for mixed-version-fleet visibility."""
+        with self._rollout_lock:
+            return dict(self._rollout) if self._rollout is not None else None
+
+    def _rollout_set(self, **kw):
+        with self._rollout_lock:
+            if self._rollout is not None:
+                self._rollout.update(kw)
+
+    def _rollout_append(self, key: str, value):
+        with self._rollout_lock:
+            if self._rollout is not None:
+                self._rollout[key].append(value)
+
+    def admin_weights_rollout(self, payload: dict) -> Tuple[int, Dict]:
+        """POST /admin/weights/rollout: rolling fleet weight update, one
+        replica at a time — drain → swap (replica-side validate + canary +
+        all-or-nothing install) → un-drain → health-gated rejoin → next. The
+        first failure aborts the whole rollout and rolls already-swapped
+        replicas back (see :meth:`_abort_rollout`). ::
+
+            {"ckpt_dir": str, "version"?, "rollback_ckpt_dir"?,
+             "canary_digest"?, "mode"?: "finish_old"|"pause_resume",
+             "drain_deadline_s"?, "rejoin_timeout_s"?, "swap_timeout_s"?,
+             "wait"?: bool}
+
+        Asynchronous by default (poll GET /admin/weights/rollout);
+        ``wait=true`` blocks until the rollout lands or aborts (409)."""
+        ckpt_dir = payload.get("ckpt_dir")
+        if not ckpt_dir or not isinstance(ckpt_dir, str):
+            return 400, {"error": {"message": "missing required field 'ckpt_dir'",
+                                   "type": "invalid_request", "code": 400}}
+        version = str(payload.get("version")
+                      or os.path.basename(os.path.normpath(ckpt_dir)))
+        try:
+            plan = {
+                "version": version,
+                "ckpt_dir": ckpt_dir,
+                "rollback_ckpt_dir": payload.get("rollback_ckpt_dir"),
+                "canary_digest": payload.get("canary_digest"),
+                "mode": payload.get("mode"),
+                "drain_deadline_s": float(payload.get("drain_deadline_s", 30.0)),
+                "rejoin_timeout_s": float(payload.get("rejoin_timeout_s", 30.0)),
+                "swap_timeout_s": float(payload.get("swap_timeout_s", 120.0)),
+            }
+        except (TypeError, ValueError) as e:
+            return 400, {"error": {"message": f"bad rollout parameter: {e}",
+                                   "type": "invalid_request", "code": 400}}
+        # target set fixed at submission: live, non-draining replicas in
+        # snapshot order (a replica joining mid-rollout is NOT picked up —
+        # it should be provisioned from the new checkpoint anyway)
+        targets = [s for s in self.pool.snapshots()
+                   if s.state != DOWN and not s.draining]
+        if not targets:
+            return 409, {"error": {"message": "no live replica to roll out to",
+                                   "type": "rollout_refused", "code": 409}}
+        state = {
+            "version": version, "ckpt_dir": ckpt_dir,
+            "rollback_ckpt_dir": plan["rollback_ckpt_dir"],
+            "status": "running", "replicas": [s.id for s in targets],
+            "completed": [], "skipped": [], "rolled_back": [],
+            "rollback_failed": [], "rollback_skipped": False,
+            "current": None, "abort_reason": None, "error": None,
+            "wall_s": None,
+        }
+        with self._rollout_lock:
+            if self._rollout is not None and self._rollout.get("status") == "running":
+                return 409, {"error": {
+                    "message": f"a rollout to {self._rollout['version']!r} is "
+                               "already running",
+                    "type": "rollout_in_progress", "code": 409}}
+            self._rollout = state
+        if payload.get("wait"):
+            self._run_rollout(state, plan, targets)
+            final = self.rollout_status()
+            return (200 if final["status"] == "done" else 409), {"rollout": final}
+        t = threading.Thread(target=self._run_rollout,
+                             args=(state, plan, targets),
+                             daemon=True, name="weights-rollout")
+        self._rollout_thread = t
+        t.start()
+        return 200, {"rollout": self.rollout_status()}
+
+    def _post_replica_json(self, host: str, port: int, path: str, doc: dict,
+                           timeout_s: float = 30.0) -> Tuple[int, Dict]:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            conn.request("POST", path, body=json.dumps(doc).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        try:
+            body = json.loads(raw or b"{}")
+        except ValueError:
+            body = {"raw": raw[:512].decode("utf-8", "replace")}
+        return resp.status, body
+
+    def _undrain_replica(self, rid: str):
+        """Rejoin plumbing: clear the router-side drain AND reopen the
+        replica's own admission gate (drain propagation's mirror image)."""
+        try:
+            self.pool.cancel_drain(rid)
+        except KeyError:
+            return
+        replica = self.pool.get(rid)
+        if replica is not None:
+            try:
+                self._post_replica_json(replica.host, replica.port,
+                                        "/admin/drain", {"undo": True},
+                                        timeout_s=10.0)
+            except _UPSTREAM_ERRORS + (ValueError,) as e:
+                logger.warning(f"router: undrain propagation to {rid} failed: {e!r}")
+
+    def _rollout_step(self, snap: ReplicaSnapshot, plan: Dict) -> Dict:
+        """Drain → swap → un-drain → health-gated rejoin for ONE replica.
+        Raises :class:`_RolloutFailure` on any failure; the caller owns the
+        fleet-level abort. The replica side is all-or-nothing (validated
+        checkpoint, quiesced install, canary, rollback-on-failure), so a
+        raise here means this replica still serves its OLD weights."""
+        rid, version = snap.id, plan["version"]
+        try:
+            _F_ROLLOUT.fire(replica=rid)
+        except InjectedFault as e:
+            raise _RolloutFailure("swap_failed",
+                                  f"injected rollout fault on {rid}: {e!r}",
+                                  replica=rid)
+        replica = self.pool.get(rid)
+        if replica is None:
+            raise _RolloutFailure("swap_failed",
+                                  f"replica {rid} left the pool mid-rollout",
+                                  replica=rid)
+        # drain both tiers synchronously (we are on the rollout thread): the
+        # policy stops offering the replica, direct traffic 503s, in-flight
+        # streams finish — the swap then quiesces an already-quiet engine
+        self.pool.start_drain(rid, deadline_s=plan["drain_deadline_s"])
+        self._propagate_drain(replica.host, replica.port, plan["drain_deadline_s"])
+        deadline = time.time() + plan["drain_deadline_s"] + 10.0
+        while not (self.pool.drain_status(rid) or {}).get("drained"):
+            if time.time() >= deadline:
+                raise _RolloutFailure(
+                    "drain_timeout",
+                    f"{rid} still has live streams past its drain deadline",
+                    replica=rid)
+            time.sleep(0.05)
+        body = {"ckpt_dir": plan["ckpt_dir"], "version": version,
+                "timeout_s": plan["swap_timeout_s"]}
+        if plan["canary_digest"] is not None:
+            body["canary_digest"] = plan["canary_digest"]
+        if plan["mode"] is not None:
+            body["mode"] = plan["mode"]
+        try:
+            status, doc = self._post_replica_json(
+                replica.host, replica.port, "/admin/weights", body,
+                timeout_s=plan["swap_timeout_s"] + 30.0)
+        except _UPSTREAM_ERRORS + (ValueError,) as e:
+            raise _RolloutFailure("swap_failed",
+                                  f"swap POST to {rid} failed: {e!r}",
+                                  replica=rid)
+        if status != 200 or not doc.get("ok"):
+            raise _RolloutFailure(
+                "swap_failed",
+                f"{rid} refused/failed the swap (HTTP {status}): "
+                f"{json.dumps(doc)[:512]}",
+                replica=rid)
+        self._undrain_replica(rid)
+        # rejoin gate: back in rotation only once /health is good AND reports
+        # the target version — a replica that silently reverted (process
+        # restart onto old weights) must not count as converged
+        deadline = time.time() + plan["rejoin_timeout_s"]
+        while True:
+            self.pool.probe_one(rid)
+            replica = self.pool.get(rid)
+            cur = replica.snapshot() if replica is not None else None
+            if (cur is not None and cur.state in (HEALTHY, RECOVERING)
+                    and cur.weights_version == version):
+                break
+            if time.time() >= deadline:
+                raise _RolloutFailure(
+                    "rejoin_timeout",
+                    f"{rid} did not rejoin healthy on {version!r} "
+                    f"(state={cur.state if cur else None}, "
+                    f"weights_version={cur.weights_version if cur else None})",
+                    replica=rid)
+            time.sleep(0.05)
+        return doc
+
+    def _run_rollout(self, state: Dict, plan: Dict, targets: List[ReplicaSnapshot]):
+        """The rollout thread body: replicas one at a time, abort-and-rollback
+        on the first failure. ``state`` is the live status doc (shared with
+        :meth:`rollout_status` under the rollout lock)."""
+        version, t0 = plan["version"], time.time()
+        RECORDER.record("rollout.start", version=version, replicas=len(targets))
+        logger.warning(f"router: weight rollout to {version!r} starting "
+                       f"({len(targets)} replica(s))")
+        swapped: List[Tuple[str, Optional[str]]] = []  # (rid, pre-swap version)
+        try:
+            for snap in targets:
+                if snap.weights_version == version:
+                    self._rollout_append("skipped", snap.id)
+                    continue
+                self._rollout_set(current=snap.id)
+                step_t0 = time.time()
+                doc = self._rollout_step(snap, plan)
+                swapped.append((snap.id, snap.weights_version))
+                if plan["canary_digest"] is None:
+                    # the first swapped replica becomes the canary reference:
+                    # every later replica must reproduce its probe output
+                    # bit-for-bit or roll back
+                    plan["canary_digest"] = doc.get("canary_digest")
+                self._rollout_append("completed", snap.id)
+                RECORDER.record("rollout.replica", replica=snap.id,
+                                wall_s=round(time.time() - step_t0, 3))
+        except _RolloutFailure as e:
+            self._abort_rollout(state, plan, e, swapped)
+            return
+        wall_s = round(time.time() - t0, 3)
+        self._rollout_set(status="done", current=None, wall_s=wall_s)
+        RECORDER.record("rollout.done", version=version, wall_s=wall_s)
+        logger.warning(f"router: weight rollout to {version!r} done in {wall_s}s")
+
+    def _abort_rollout(self, state: Dict, plan: Dict, failure: "_RolloutFailure",
+                       swapped: List[Tuple[str, Optional[str]]]):
+        """First failure aborts the WHOLE rollout: the failed replica is
+        un-drained (the replica-side swap is all-or-nothing, so it still
+        serves its old weights), and every already-swapped replica is rolled
+        back via ``rollback_ckpt_dir`` — a replica releases its retained old
+        params the moment its canary passes, so fleet-level rollback must
+        reload the old bytes from disk. Without a ``rollback_ckpt_dir`` the
+        swapped replicas stay on the new version (reported as
+        ``rollback_skipped``) — a mixed fleet the operator must resolve."""
+        version, reason, failed = plan["version"], failure.reason, failure.replica
+        logger.warning(
+            f"router: rollout to {version!r} aborted at {failed} ({reason}): "
+            f"{failure} — rolling back {len(swapped)} swapped replica(s)")
+        RECORDER.record("rollout.abort", reason=reason, replica=failed,
+                        version=version)
+        if failed is not None:
+            self._undrain_replica(failed)
+        rolled_back: List[str] = []
+        rollback_failed: List[str] = []
+        if swapped and plan.get("rollback_ckpt_dir"):
+            # newest swap first: converge the fleet back from the rollout's
+            # leading edge (no drain needed — the replica-side swap quiesces)
+            for rid, prev_version in reversed(swapped):
+                replica = self.pool.get(rid)
+                body = {"ckpt_dir": plan["rollback_ckpt_dir"]}
+                if prev_version is not None:
+                    body["version"] = prev_version
+                status, doc = None, {}
+                if replica is not None:
+                    try:
+                        status, doc = self._post_replica_json(
+                            replica.host, replica.port, "/admin/weights", body,
+                            timeout_s=plan["swap_timeout_s"] + 30.0)
+                    except _UPSTREAM_ERRORS + (ValueError,) as e:
+                        doc = {"error": repr(e)}
+                if status == 200 and doc.get("ok"):
+                    rolled_back.append(rid)
+                else:
+                    logger.warning(f"router: rollback of {rid} failed: "
+                                   f"{json.dumps(doc)[:256]}")
+                    rollback_failed.append(rid)
+            if rollback_failed:
+                RECORDER.record("rollout.abort", reason="rollback_failed",
+                                version=version, replicas=len(rollback_failed))
+        elif swapped:
+            self._rollout_set(rollback_skipped=True)
+            logger.warning(
+                "router: no rollback_ckpt_dir — already-swapped replicas "
+                f"{[r for r, _ in swapped]} stay on {version!r}")
+        self._rollout_set(status="aborted", current=None, abort_reason=reason,
+                          error=str(failure), rolled_back=rolled_back,
+                          rollback_failed=rollback_failed)
+        self.postmortem.dump("rollout_abort", detail={
+            "version": version, "reason": reason, "failed_replica": failed,
+            "error": str(failure), "rolled_back": rolled_back,
+            "rollback_failed": rollback_failed,
+            "completed": list(state.get("completed", []))})
+
     @staticmethod
     def _fold_stage_series(parsed: Dict[str, Dict]) -> Dict:
         """Fleet fold of the per-stage gauges disaggregated replicas expose
@@ -1213,6 +1534,7 @@ class RouterServer:
                 if state.attempts == 1:
                     self.metrics.hedges.inc(outcome="brownout")
             state.replica_id = cand.id
+            state.weights_version = cand.weights_version
             # a fresh attempt must not inherit the previous replica's
             # completion id: replicas mint cmpl-N independently, and a stale
             # cid paired with the NEW replica would abort a stranger's request
@@ -1897,17 +2219,47 @@ class RouterServer:
         if owner is not None and owner[0] == cand.id:
             self.abort(state.rid)
 
+    def _midstream_disposition(self, state: _RelayState,
+                               cand: Optional[ReplicaSnapshot]) -> str:
+        """The router-level disposition for a stream that died AFTER tokens
+        were relayed. Continuing it elsewhere would re-emit divergent tokens,
+        so it always terminates in-band — the split is only over *why*:
+
+        - ``replica_error``: the replica failed; the fleet still serves the
+          version this stream was generating under (an ordinary retry
+          regenerates equivalently).
+        - ``version_skew``: a fleet weight rollout moved the surviving
+          candidates (or the pinned replica itself) to a DIFFERENT weights
+          version than the one the relayed tokens came from — a silent resume
+          would splice two models' outputs into one stream. The refusal is
+          recorded (``router.version_skew``) so a rollout postmortem shows
+          which streams it cost."""
+        if state.tokens_relayed == 0 or state.weights_version is None:
+            return "replica_error"
+        versions = {s.weights_version for s in self.pool.snapshots()
+                    if s.state != DOWN and s.weights_version is not None}
+        if versions and state.weights_version not in versions:
+            RECORDER.record("router.version_skew", trace=state.rid,
+                            replica=state.replica_id,
+                            version=state.weights_version)
+            self.metrics.version_skew_terminations.inc()
+            return "version_skew"
+        return "replica_error"
+
     def _terminate_midstream(self, handler, state: _RelayState,
                              cand: Optional[ReplicaSnapshot], payload: dict):
         """In-band terminal for a stream whose replica died after tokens were
         relayed (PR 3's engine_error contract, one level up): final chunk with
-        ``finish_reason="replica_error"`` + usage covering what the client
+        ``finish_reason="replica_error"`` (``"version_skew"`` when a weight
+        rollout made resumption impossible — see
+        :meth:`_midstream_disposition`) + usage covering what the client
         actually received, then [DONE] — never a mid-stream connection reset."""
         replica_id = cand.id if cand is not None else "none"
         if cand is not None:
             self.pool.note_forward_failure(cand.id)
+        finish_reason = self._midstream_disposition(state, cand)
         prompt = payload.get("prompt")
-        self._finish(state, replica_id, "replica_error")
+        self._finish(state, replica_id, finish_reason)
         try:
             usage = {"completion_tokens": state.tokens_relayed}
             if isinstance(prompt, (list, tuple)):
@@ -1918,7 +2270,7 @@ class RouterServer:
                 usage["total_tokens"] = len(prompt) + state.tokens_relayed
             final = {"id": state.rid, "object": "text_completion.chunk",
                      "replica": replica_id,
-                     "choices": [{"index": 0, "finish_reason": "replica_error"}],
+                     "choices": [{"index": 0, "finish_reason": finish_reason}],
                      "usage": usage}
             handler.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
             handler.wfile.write(b"data: [DONE]\n\n")

@@ -373,6 +373,15 @@ class Scheduler:
             self._draining = True
         TRACER.instant("membership", cat="scheduler", op="drain_direct")
 
+    def stop_drain(self):
+        """Undo :meth:`start_drain`: resume admitting new work. The rejoin
+        half of a rolling weight rollout — the router drains a replica, swaps
+        its weights, then un-drains it so it takes traffic again without a
+        process restart."""
+        with self._lock:
+            self._draining = False
+        TRACER.instant("membership", cat="scheduler", op="undrain_direct")
+
     def drain(self, timeout_s: Optional[float] = 30.0) -> bool:
         """Stop admitting; wait for in-flight work. Returns True if empty."""
         self.start_drain()

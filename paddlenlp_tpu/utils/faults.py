@@ -119,6 +119,23 @@ CATALOG: Dict[str, str] = {
                            "that tenant's request (engine_error / token-exact "
                            "retry); other tenants' streams must be uninterrupted "
                            "and no adapter slot or KV block may leak.",
+    "engine.weight_load": "Inside the /admin/weights handler, before the committed "
+                          "checkpoint is validated and loaded — a failure here must "
+                          "map to a clean HTTP error with ZERO engine-side mutation "
+                          "(no params touched, no cache epoch bumped, the loop "
+                          "keeps serving under the old weights).",
+    "engine.weight_swap": "Inside the engine loop's quiesced swap execution, after "
+                          "the old params are retained but before sync_params "
+                          "installs the new tree — a failure here must roll the "
+                          "replica back to the retained old weights (cache epoch "
+                          "re-bumped, canary skipped) with zero stream loss and "
+                          "no param-buffer or KV-block leak.",
+    "router.rollout": "Top of one per-replica rollout step (drain → swap → rejoin) "
+                      "in the router's fleet weight rollout, before the drain is "
+                      "initiated — a failure here must abort the whole rollout, "
+                      "roll already-swapped replicas back to the old version, "
+                      "undrain everything and leave the fleet serving on the old "
+                      "weights with zero client-visible errors.",
 }
 
 

@@ -1,0 +1,375 @@
+"""Zero-downtime fleet weight rollout chaos (ISSUE 18 acceptance), against
+REAL in-process replicas (tiny CPU model — tier-1 speed).
+
+- **Clean rollout under live traffic**: a two-replica fleet rolls from v0 to
+  v1 weights one replica at a time while SSE streams are mid-flight and a
+  prober hammers the router. Zero downtime (every prober request answers
+  200), zero stream loss (every pre-rollout stream finishes token-exact
+  under the OLD weights — drain lets them complete before their replica
+  swaps), and post-rollout outputs are token-exact against a fresh engine
+  started on the NEW weights.
+- **Swap fault mid-rollout**: ``engine.weight_swap`` armed to fire on the
+  SECOND replica of a three-replica fleet, under 8 live streams. The faulted
+  replica rolls itself back (all-or-nothing), the router aborts the rollout
+  and rolls the already-swapped replica back from ``rollback_ckpt_dir``, and
+  the fleet converges back on v0: zero stream loss, zero 5xx, every replica
+  reporting v0 and generating v0 tokens, no KV block or parameter leak.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import SchedulerConfig, SupervisorPolicy
+from paddlenlp_tpu.serving.engine_loop import CANARY_PROMPT_IDS, canary_digest
+from paddlenlp_tpu.serving.router import launch_fleet
+from paddlenlp_tpu.trainer.unified_checkpoint import save_unified_checkpoint
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+from tools.rollout import main as rollout_main
+
+CFG = dict(vocab_size=96, hidden_size=64, intermediate_size=112,
+           num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+           max_position_embeddings=256, eos_token_id=None, pad_token_id=0,
+           use_scan_layers=True)
+ENG_KW = dict(max_batch_size=8, block_size=4, num_blocks=256,
+              max_blocks_per_seq=32, decode_steps=4)
+GEN_LEN = 24
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig(**CFG)
+
+
+@pytest.fixture(scope="module")
+def ckpts(cfg, tmp_path_factory):
+    root = tmp_path_factory.mktemp("rollout")
+    save_unified_checkpoint(str(root / "v0"),
+                            LlamaForCausalLM.from_config(cfg, seed=0), None)
+    save_unified_checkpoint(str(root / "v1"),
+                            LlamaForCausalLM.from_config(cfg, seed=1), None)
+    return root
+
+
+@pytest.fixture(scope="module")
+def solo_old(cfg):
+    return InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0), **ENG_KW)
+
+
+@pytest.fixture(scope="module")
+def solo_new(cfg):
+    return InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=1), **ENG_KW)
+
+
+def make_engine_factory(cfg):
+    """Every replica gets its OWN model instance — the single-device backend
+    installs swapped params by rebinding ``model.params``, so a shared model
+    would leak one replica's swap into its neighbors."""
+    def make_engine():
+        return InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0),
+                               **ENG_KW)
+    return make_engine
+
+
+def post_json(port, path, payload, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def stream_request(port, prompt, max_tokens, out, key, timeout=600):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions",
+                     body=json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                                      "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        toks, finish = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            c = ev["choices"][0]
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+            elif "token" in c:
+                toks.append(c["token"])
+        out[key] = (resp.status, toks, finish)
+    finally:
+        conn.close()
+
+
+class Prober:
+    """Background zero-downtime witness: keeps firing small completions at
+    the router and records every status code until stopped."""
+
+    def __init__(self, port):
+        self.port = port
+        self.statuses = []
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            try:
+                status, _ = post_json(self.port, "/v1/completions",
+                                      {"prompt": [60, 61, 62, (63 + i) % 90 + 1],
+                                       "max_tokens": 4}, timeout=120)
+                self.statuses.append(status)
+            except OSError as e:  # a transport error IS downtime
+                self.statuses.append(repr(e))
+            i += 1
+            time.sleep(0.05)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=120)
+
+
+def assert_no_kv_leak(server):
+    mgr = server.loop.engine.mgr
+    assert mgr.num_free == mgr.total_usable_blocks, \
+        f"KV leak: {mgr.total_usable_blocks - mgr.num_free} blocks still held"
+
+
+def launch(n, cfg):
+    return launch_fleet(
+        n, make_engine_factory(cfg), poll_interval_s=0.05,
+        scheduler_config=SchedulerConfig(max_inflight=16, default_timeout_s=600.0),
+        supervisor_policy=SupervisorPolicy(backoff_base_s=0.1, backoff_max_s=0.5))
+
+
+class TestCleanRollout:
+    def test_rolling_swap_zero_downtime_token_exact(
+            self, cfg, ckpts, solo_old, solo_new, capsys):
+        fleet = launch(2, cfg)
+        router, port = fleet.router, fleet.router_port
+        try:
+            # warm both replicas (jit compiles outside the measured window)
+            for p in fleet.ports:
+                status, _ = post_json(p, "/v1/completions",
+                                      {"prompt": [9, 8, 7], "max_tokens": GEN_LEN})
+                assert status == 200
+
+            expected = canary_digest(
+                solo_new.generate([list(CANARY_PROMPT_IDS)], None)[0])
+            n_stream = 4
+            results = {}
+            threads = [threading.Thread(
+                target=stream_request,
+                args=(port, [20 + i, 21, 22, 23], GEN_LEN, results, i))
+                for i in range(n_stream)]
+            with Prober(port) as prober:
+                for t in threads:
+                    t.start()
+                time.sleep(0.2)  # streams in flight before the rollout starts
+                # drive the rollout through the operator CLI: submit, follow
+                # to terminal, one JSONL decision line per transition, rc 0
+                rc = rollout_main(["--router", f"127.0.0.1:{port}",
+                                   "--ckpt-dir", str(ckpts / "v1"),
+                                   "--rollback-ckpt-dir", str(ckpts / "v0"),
+                                   "--canary-digest", expected,
+                                   "--drain-deadline", "60",
+                                   "--rejoin-timeout", "60"])
+                assert rc == 0
+                log = [json.loads(line) for line
+                       in capsys.readouterr().out.splitlines() if line.strip()]
+                assert log[0]["event"] == "submitted"
+                assert sum(e["event"] == "replica_done" for e in log) == 2
+                assert log[-1]["event"] == "terminal"
+                assert log[-1]["status"] == "done"
+                status, doc = get_json(port, "/admin/weights/rollout")
+                assert status == 200
+                rollout = doc["rollout"]
+                assert rollout["status"] == "done"
+                assert sorted(rollout["completed"]) == sorted(
+                    fleet.replica_id(i) for i in range(2))
+                assert rollout["skipped"] == [] and rollout["abort_reason"] is None
+                for t in threads:
+                    t.join(timeout=600)
+                # the fleet answers on the new weights before the prober stops
+                status, body = post_json(port, "/v1/completions",
+                                         {"prompt": [5, 4, 3], "max_tokens": 8})
+                assert status == 200
+
+            # ---- zero downtime: every prober request answered 200
+            assert prober.statuses, "prober never ran"
+            assert all(s == 200 for s in prober.statuses), \
+                [s for s in prober.statuses if s != 200][:5]
+
+            # ---- zero stream loss: pre-rollout streams finished token-exact
+            # under the OLD weights (drain let them complete before the swap)
+            for i in range(n_stream):
+                status, toks, finish = results[i]
+                assert status == 200 and finish == "length", (i, results[i])
+                want = solo_old.generate(
+                    [[20 + i, 21, 22, 23]], SamplingParams(max_new_tokens=GEN_LEN))[0]
+                np.testing.assert_array_equal(toks, want)
+
+            # ---- post-rollout: token-exact vs a fresh engine on NEW weights
+            want = solo_new.generate([[5, 4, 3]], SamplingParams(max_new_tokens=8))[0]
+            np.testing.assert_array_equal(body["choices"][0]["token_ids"], want)
+
+            # ---- every replica converged: health + pool + metrics agree
+            for i, p in enumerate(fleet.ports):
+                status, health = get_json(p, "/health")
+                assert status == 200 and health["weights_version"] == "v1"
+                assert fleet.servers[i].loop.weights_version == "v1"
+            status, reps = get_json(port, "/replicas")
+            assert status == 200
+            assert all(r["weights_version"] == "v1" for r in reps["replicas"])
+            assert reps["rollout"]["status"] == "done"
+
+            # ---- nothing leaked on either replica
+            for server in fleet.servers:
+                assert_no_kv_leak(server)
+        finally:
+            fleet.shutdown(drain_timeout_s=5)
+
+
+class TestFaultedRolloutRollsBack:
+    def test_swap_fault_on_second_replica_fleet_rolls_back(
+            self, cfg, ckpts, solo_old, capsys):
+        fleet = launch(3, cfg)
+        router, port = fleet.router, fleet.router_port
+        try:
+            for p in fleet.ports:
+                status, _ = post_json(p, "/v1/completions",
+                                      {"prompt": [9, 8, 7], "max_tokens": GEN_LEN})
+                assert status == 200
+
+            # the fault point fires inside the quiesced swap, BEFORE
+            # sync_params: hit 1 = first replica's swap (passes), hit 2 =
+            # second replica's swap (fails -> replica-side rollback ->
+            # router-side abort). The faults registry is process-global, so
+            # the in-process fleet shares one hit counter.
+            FAULTS.arm("engine.weight_swap", nth=(2,))
+
+            n_stream = 8
+            results = {}
+            threads = [threading.Thread(
+                target=stream_request,
+                args=(port, [30 + i, 31, 32, 33], GEN_LEN, results, i))
+                for i in range(n_stream)]
+            with Prober(port) as prober:
+                for t in threads:
+                    t.start()
+                time.sleep(0.2)
+                # the CLI contract under fire: rc 1 when the rollout aborts
+                # and rolls back, with the abort visible in the decision log
+                rc = rollout_main(["--router", f"127.0.0.1:{port}",
+                                   "--ckpt-dir", str(ckpts / "v1"),
+                                   "--rollback-ckpt-dir", str(ckpts / "v0"),
+                                   "--drain-deadline", "60",
+                                   "--rejoin-timeout", "60"])
+                assert rc == 1
+                log = [json.loads(line) for line
+                       in capsys.readouterr().out.splitlines() if line.strip()]
+                assert log[-1]["event"] == "terminal"
+                assert log[-1]["status"] == "aborted"
+                assert log[-1]["abort_reason"] == "swap_failed"
+                status, doc = get_json(port, "/admin/weights/rollout")
+                assert status == 200
+                rollout = doc["rollout"]
+                assert rollout["status"] == "aborted"
+                assert rollout["abort_reason"] == "swap_failed"
+                for t in threads:
+                    t.join(timeout=600)
+
+            assert FAULTS.fired("engine.weight_swap") == 1
+
+            # ---- exactly one replica had swapped; it was rolled back
+            assert len(rollout["completed"]) == 1
+            assert rollout["rolled_back"] == rollout["completed"]
+            assert rollout["rollback_failed"] == []
+
+            # ---- zero stream loss, zero 5xx: every live stream finished
+            # token-exact under the OLD weights
+            for i in range(n_stream):
+                status, toks, finish = results[i]
+                assert status == 200 and finish == "length", (i, results[i])
+                want = solo_old.generate(
+                    [[30 + i, 31, 32, 33]], SamplingParams(max_new_tokens=GEN_LEN))[0]
+                np.testing.assert_array_equal(toks, want)
+            assert all(s == 200 for s in prober.statuses), \
+                [s for s in prober.statuses if s != 200][:5]
+
+            # ---- the fleet converged BACK: every replica reports v0, routes
+            # traffic, generates v0 tokens, and holds the v0 parameters
+            deadline = time.time() + 30
+            while time.time() < deadline and not all(
+                    s.weights_version == "v0" and not s.draining
+                    for s in router.pool.snapshots()):
+                time.sleep(0.05)
+            for i, p in enumerate(fleet.ports):
+                status, health = get_json(p, "/health")
+                assert status == 200 and health["weights_version"] == "v0"
+                status, body = post_json(p, "/v1/completions",
+                                         {"prompt": [50, 51, 52], "max_tokens": 8})
+                assert status == 200, (fleet.replica_id(i), body)
+            want = solo_old.generate([[50, 51, 52]],
+                                     SamplingParams(max_new_tokens=8))[0]
+            np.testing.assert_array_equal(body["choices"][0]["token_ids"], want)
+
+            # ---- no parameter leak: every replica's resident tree is the v0
+            # tree again, bit-for-bit (the retained new tree was dropped)
+            v0_leaf = np.asarray(
+                next(iter(_leaves(solo_old.model.params))))
+            for server in fleet.servers:
+                leaf = np.asarray(next(iter(_leaves(server.engine.model.params))))
+                np.testing.assert_array_equal(leaf, v0_leaf)
+                assert_no_kv_leak(server)
+
+            # ---- the router still takes a fresh rollout after the abort
+            # (the in-progress guard was released)
+            status, reps = get_json(port, "/replicas")
+            assert status == 200 and reps["rollout"]["status"] == "aborted"
+        finally:
+            fleet.shutdown(drain_timeout_s=5)
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)

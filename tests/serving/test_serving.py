@@ -327,7 +327,8 @@ class TestServingHTTP:
         assert status == 200
         choice = body["choices"][0]
         assert len(choice["token_ids"]) == 5 and choice["finish_reason"] == "length"
-        assert body["usage"] == {"prompt_tokens": 3, "completion_tokens": 5, "total_tokens": 8}
+        assert body["usage"] == {"prompt_tokens": 3, "cached_tokens": 0,
+                                 "completion_tokens": 5, "total_tokens": 8}
         assert body["timing"]["ttft_s"] > 0
 
     def test_http_errors(self, server):

@@ -1,0 +1,323 @@
+"""Live base-checkpoint hot-swap, replica side (POST /admin/weights).
+
+The swap contract under test:
+
+- **door checks are 409s, not mutations**: a missing / torn / uncommitted
+  checkpoint and a dimension conflict against the live model config all
+  answer ``weights_conflict`` with the engine untouched;
+- **all-or-nothing install**: a canary-digest mismatch rolls back to the
+  retained old params — the replica keeps serving (and reporting) the
+  version it served before;
+- **finish_old quiesce**: streams in flight when the swap lands finish
+  token-exact under the OLD weights, and the first post-swap request is
+  token-exact against a fresh engine built on the NEW weights;
+- **cache-epoch regression (HTTP path)**: prefix blocks registered before
+  the swap never serve a post-swap request, and a stream that finishes
+  after the epoch bump (pause_resume) must not re-register its pre-swap KV
+  into the new epoch.
+"""
+
+import http.client
+import json
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import MetricsRegistry, SchedulerConfig, ServingServer
+from paddlenlp_tpu.serving.engine_loop import CANARY_PROMPT_IDS, canary_digest
+from paddlenlp_tpu.trainer.unified_checkpoint import save_unified_checkpoint
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+from paddlenlp_tpu.utils.faults import FAULTS
+
+CFG = dict(vocab_size=96, hidden_size=64, intermediate_size=112,
+           num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+           max_position_embeddings=256, eos_token_id=None, pad_token_id=0,
+           use_scan_layers=True)
+ENG_KW = dict(max_batch_size=4, block_size=4, num_blocks=256,
+              max_blocks_per_seq=32, decode_steps=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig(**CFG)
+
+
+@pytest.fixture(scope="module")
+def ckpts(cfg, tmp_path_factory):
+    """On-disk checkpoint fixtures: v0/v1 committed (seed 0/1 weights), a
+    torn copy of v1 (commit manifest deleted), and a dimension-conflicting
+    committed checkpoint (half-width model)."""
+    root = tmp_path_factory.mktemp("weights")
+    save_unified_checkpoint(str(root / "v0"),
+                            LlamaForCausalLM.from_config(cfg, seed=0), None)
+    save_unified_checkpoint(str(root / "v1"),
+                            LlamaForCausalLM.from_config(cfg, seed=1), None)
+    shutil.copytree(root / "v1", root / "torn")
+    (root / "torn" / "commit.json").unlink()
+    narrow = LlamaConfig(**{**CFG, "hidden_size": 32, "intermediate_size": 64})
+    save_unified_checkpoint(str(root / "narrow"),
+                            LlamaForCausalLM.from_config(narrow, seed=0), None)
+    return root
+
+
+@pytest.fixture(scope="module")
+def solo_old(cfg):
+    return InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0), **ENG_KW)
+
+
+@pytest.fixture(scope="module")
+def solo_new(cfg):
+    return InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=1), **ENG_KW)
+
+
+@pytest.fixture
+def server(cfg):
+    """A fresh replica per test — swap tests mutate the served weights, so
+    nothing may be shared. Each replica gets its OWN model instance: the
+    single-device backend installs params by rebinding ``model.params``."""
+    registry = MetricsRegistry()
+    srv = ServingServer(
+        InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0), **ENG_KW),
+        registry=registry,
+        scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0))
+    port = srv.start_in_thread()
+    yield srv, port, registry
+    srv.shutdown(drain_timeout_s=5)
+
+
+def post_json(port, path, payload, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def stream_request(port, prompt, max_tokens, out, key, timeout=600, **extra):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions",
+                     body=json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                                      "stream": True, **extra}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        toks, finish = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            c = ev["choices"][0]
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+            elif "token" in c:
+                toks.append(c["token"])
+        out[key] = (resp.status, toks, finish)
+    finally:
+        conn.close()
+
+
+def wait_decoding(srv, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if any(r.get("output_tokens", 0) > 0 for r in srv.loop.inflight_info()):
+            return
+        time.sleep(0.005)
+    raise AssertionError("stream never started decoding")
+
+
+def assert_no_kv_leak(srv):
+    mgr = srv.loop.engine.mgr
+    assert mgr.num_free == mgr.total_usable_blocks, \
+        f"KV leak: {mgr.total_usable_blocks - mgr.num_free} blocks still held"
+
+
+def new_canary_digest(solo_new):
+    return canary_digest(solo_new.generate([list(CANARY_PROMPT_IDS)], None)[0])
+
+
+PROMPT = [11, 12, 13, 14, 15, 16]
+
+
+class TestSwapDoorChecks:
+    """Every rejection answers 409 with the engine untouched — the same
+    replica keeps serving v0, token-exact, after the whole gauntlet."""
+
+    def test_conflicts_are_409_and_engine_untouched(self, server, ckpts, solo_old):
+        srv, port, _registry = server
+        status, body = post_json(port, "/admin/weights", {})
+        assert status == 400, body
+
+        for bad, needle in [
+            (str(ckpts / "missing"), "not swappable"),
+            (str(ckpts / "torn"), "not swappable"),
+            (str(ckpts / "narrow"), "hidden_size"),
+        ]:
+            status, body = post_json(port, "/admin/weights", {"ckpt_dir": bad})
+            assert status == 409, (bad, body)
+            assert body["error"]["type"] == "weights_conflict", body
+            assert needle in body["error"]["message"], body
+
+        status, health = get_json(port, "/health")
+        assert status == 200 and health["weights_version"] == "v0"
+        status, body = post_json(port, "/v1/completions",
+                                 {"prompt": PROMPT, "max_tokens": 8})
+        assert status == 200
+        want = solo_old.generate([PROMPT], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(body["choices"][0]["token_ids"], want)
+
+    def test_canary_mismatch_rolls_back(self, server, ckpts, solo_old):
+        srv, port, _registry = server
+        status, body = post_json(port, "/admin/weights",
+                                 {"ckpt_dir": str(ckpts / "v1"),
+                                  "canary_digest": "0" * 64})
+        assert status == 409, body
+        assert body["ok"] is False and body["rolled_back"] is True
+        assert body["reason"] == "canary_mismatch"
+        # the replica still serves v0 — version AND tokens
+        _, health = get_json(port, "/health")
+        assert health["weights_version"] == "v0"
+        status, body = post_json(port, "/v1/completions",
+                                 {"prompt": PROMPT, "max_tokens": 8})
+        assert status == 200
+        want = solo_old.generate([PROMPT], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(body["choices"][0]["token_ids"], want)
+        assert_no_kv_leak(srv)
+
+
+class TestSwapFinishOld:
+    def test_inflight_finish_old_then_new_weights_serve(
+            self, server, ckpts, solo_old, solo_new):
+        srv, port, registry = server
+        results = {}
+        threads = [threading.Thread(
+            target=stream_request,
+            args=(port, PROMPT + [30 + i], 24, results, i)) for i in range(2)]
+        for t in threads:
+            t.start()
+        wait_decoding(srv)
+
+        expected = new_canary_digest(solo_new)
+        status, doc = post_json(port, "/admin/weights",
+                                {"ckpt_dir": str(ckpts / "v1"),
+                                 "canary_digest": expected})
+        assert status == 200, doc
+        assert doc["ok"] is True and doc["weights_version"] == "v1"
+        assert doc["canary_digest"] == expected
+        # finish_old: nothing was paused/resumed — token identity holds
+        assert doc["resumed"] == 0 and doc["token_identity"] is True
+
+        for t in threads:
+            t.join(timeout=600)
+        for i in range(2):
+            status, toks, finish = results[i]
+            assert status == 200 and finish == "length", (i, results[i])
+            want = solo_old.generate(
+                [PROMPT + [30 + i]], SamplingParams(max_new_tokens=24))[0]
+            np.testing.assert_array_equal(toks, want)
+
+        # the replica now serves the NEW weights, token-exact vs fresh-start
+        status, body = post_json(port, "/v1/completions",
+                                 {"prompt": PROMPT, "max_tokens": 8})
+        assert status == 200
+        want = solo_new.generate([PROMPT], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(body["choices"][0]["token_ids"], want)
+
+        _, health = get_json(port, "/health")
+        assert health["weights_version"] == "v1"
+        expo = registry.expose()
+        assert 'paddlenlp_serving_weights_info{version="v1"} 1' in expo
+        assert 'version="v0"' not in expo
+        assert_no_kv_leak(srv)
+
+
+class TestCacheEpochAcrossSwap:
+    def test_pre_swap_prefix_blocks_never_serve_post_swap(
+            self, server, ckpts, solo_new):
+        srv, port, _registry = server
+        # register PROMPT's blocks in the (old-weights) prefix index and
+        # prove the index is live: the second identical request hits it
+        status, a = post_json(port, "/v1/completions",
+                              {"prompt": PROMPT, "max_tokens": 8})
+        assert status == 200 and a["usage"]["cached_tokens"] == 0
+        status, a2 = post_json(port, "/v1/completions",
+                               {"prompt": PROMPT, "max_tokens": 8})
+        assert status == 200 and a2["usage"]["cached_tokens"] > 0
+
+        # a stream in flight ACROSS the epoch bump: pause_resume aborts it
+        # engine-side and resumes it after the install, so it FINISHES after
+        # clear_prefix_cache — its pre-swap KV must not re-register. Steps
+        # are delay-faulted so the stream is still decoding when the swap
+        # (door checks + checkpoint load take ~1s) reaches the loop.
+        FAULTS.arm("engine.step", action="delay", delay_s=0.2, times=50)
+        results = {}
+        # a prompt disjoint from PROMPT: when the resumed stream finishes and
+        # (validly) registers its re-prefilled new-weights KV, none of its
+        # blocks can satisfy a PROMPT-prefix lookup
+        c_prompt = [70, 71, 72, 73, 74, 75]
+        t = threading.Thread(target=stream_request,
+                             args=(port, c_prompt, 24, results, "c"))
+        t.start()
+        wait_decoding(srv)
+        status, doc = post_json(port, "/admin/weights",
+                                {"ckpt_dir": str(ckpts / "v1"),
+                                 "mode": "pause_resume"})
+        assert status == 200, doc
+        assert doc["ok"] is True
+        # the paused stream resumed under the new weights: explicitly NOT
+        # token-identical, and the result doc says so
+        assert doc["resumed"] == 1 and doc["token_identity"] is False
+        t.join(timeout=600)
+        status, toks, finish = results["c"]
+        assert status == 200 and finish == "length" and len(toks) == 24
+
+        # post-swap, the same prompt must prefill from scratch (zero cached
+        # tokens — the old epoch is unreachable) and be token-exact against
+        # a fresh engine on the new weights
+        status, b = post_json(port, "/v1/completions",
+                              {"prompt": PROMPT, "max_tokens": 8})
+        assert status == 200, b
+        assert b["usage"]["cached_tokens"] == 0, \
+            "stale pre-swap KV served a post-swap request"
+        want = solo_new.generate([PROMPT], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(b["choices"][0]["token_ids"], want)
+
+        # positive control: the resumed stream's re-prefill happened under
+        # the NEW weights, so reusing ITS registered blocks is valid — a
+        # c-prefixed request may hit the cache and must stay token-exact
+        status, d = post_json(port, "/v1/completions",
+                              {"prompt": c_prompt, "max_tokens": 8})
+        assert status == 200
+        want = solo_new.generate([c_prompt], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(d["choices"][0]["token_ids"], want)
+        assert_no_kv_leak(srv)

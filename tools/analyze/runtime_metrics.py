@@ -85,6 +85,7 @@ def catalog_exposition() -> str:
     serving.step_gap.observe(0.002)
     serving.usage_tokens.inc(5, tenant="default", adapter="base", kind="prompt")
     serving.usage_records.inc(tenant="default")
+    serving.weights_info.set(1.0, version="v0")
     router.latency_attribution.observe(0.02, phase="hedge_race")
     router.replica_healthy.set(1.0, replica="replica-0")
     router.requests.inc(replica="replica-0", outcome="ok")

@@ -20,7 +20,12 @@ the seed of the BENCH trajectory gate:
   grow at most ``--max-waste-growth`` (default 0.10, absolute);
 - ``goodput.compiles`` may grow to ``max(2x baseline, baseline + 8)`` —
   the compile-cache regression gate (a retrace storm fails before it ever
-  shows up in latency).
+  shows up in latency);
+- when the candidate carries a ``rollout`` record (``--swap-mid-run``),
+  ``rollout.streams_lost`` must be exactly 0 — zero-downtime is an invariant,
+  not a tolerance — and ``rollout.ttft_p99_during_swap_ms`` rides the same
+  latency band, anchored on the baseline's own swap tail when present and on
+  its overall ``p99_ttft_ms`` otherwise.
 
 Usage::
 
@@ -155,6 +160,27 @@ def compare(candidate: Dict, baseline: Dict,
         limit = 0.0
     check("goodput.compiles", limit, "max",
           _get(candidate, "goodput.compiles"), base_compiles)
+    # rollout arm (--swap-mid-run): streams_lost is an invariant, not a
+    # tolerance — ANY stream lost to the hot-swap is a regression regardless
+    # of what the baseline recorded
+    if isinstance(candidate.get("rollout"), dict):
+        lost = _get(candidate, "rollout.streams_lost")
+        if lost is None:
+            skipped.append("rollout.streams_lost")
+        else:
+            compared += 1
+            if lost > 0:
+                regressions.append({
+                    "field": "rollout.streams_lost", "baseline": 0.0,
+                    "candidate": lost, "limit": 0.0, "direction": "above"})
+        base_swap = _get(baseline, "rollout.ttft_p99_during_swap_ms")
+        if base_swap is None:
+            # baseline ran without the arm: its overall TTFT tail still
+            # bounds how much the swap window is allowed to cost
+            base_swap = _get(baseline, "p99_ttft_ms")
+        check("rollout.ttft_p99_during_swap_ms",
+              (base_swap or 0.0) * max_latency_ratio + latency_slack_ms, "max",
+              _get(candidate, "rollout.ttft_p99_during_swap_ms"), base_swap)
     return regressions, skipped, compared
 
 

@@ -80,6 +80,20 @@ Usage::
                                                  # churn caused, so elasticity
                                                  # shows up in the bench
                                                  # trajectory
+    python tools/bench_serve.py --replicas 2 --swap-mid-run
+                                                 # halfway through the request
+                                                 # stream, roll a new base
+                                                 # checkpoint across the fleet
+                                                 # (POST /admin/weights/rollout:
+                                                 # drain -> swap -> canary ->
+                                                 # rejoin, one replica at a
+                                                 # time) while requests keep
+                                                 # flowing — the JSON line adds
+                                                 # a rollout record (wall_s,
+                                                 # streams_lost which must be 0,
+                                                 # p99 TTFT during the swap
+                                                 # window) so zero-downtime is a
+                                                 # gateable number
     python tools/bench_serve.py --replicas 2 --hedge-after-ms 250
                                                  # arm request hedging: a stream
                                                  # (or batch request) with no
@@ -259,6 +273,7 @@ def run() -> None:
     max_tokens = _arg("--max-tokens", 16)
     n_replicas = _arg("--replicas", 1)
     drain_mid_run = "--drain-mid-run" in sys.argv
+    swap_mid_run = "--swap-mid-run" in sys.argv
     hedge_after_ms = _farg("--hedge-after-ms", 0.0)
     prefix_share = _farg("--prefix-share", 0.0)
     surge = _parse_surge()
@@ -270,6 +285,9 @@ def run() -> None:
         n_replicas = autoscale[0]
     if drain_mid_run and n_replicas < 2:
         _fail("--drain-mid-run needs --replicas >= 2 (one replica must survive)")
+    if swap_mid_run and n_replicas < 2:
+        _fail("--swap-mid-run needs --replicas >= 2 (the rollout swaps one "
+              "replica at a time while the rest keep serving)")
     # --surge R1,R2,T: precompute the open-loop arrival schedule (the ramp
     # integrates the linear rate; flat R1 shoulders bracket it so the JSON
     # can report p99 TTFT before/during/after)
@@ -376,7 +394,11 @@ def run() -> None:
         return src
 
     def make_engine():
-        # one shared model (read-only params), one engine per replica
+        # one shared model (read-only params), one engine per replica — except
+        # under --swap-mid-run: the hot-swap rebinds model.params, so a shared
+        # model object would leak the new weights into replicas that have not
+        # swapped yet; each replica gets its own identically-seeded model
+        mdl = LlamaForCausalLM.from_config(cfg, seed=0) if swap_mid_run else model
         kw = dict(eng_kw)
         if n_adapters:
             from paddlenlp_tpu.serving.tenancy import AdapterRegistry
@@ -387,7 +409,23 @@ def run() -> None:
                 reg.add(f"bench-ad-{a}", adapter_source(a))
             adapter_registries.append(reg)
             kw["adapter_registry"] = reg
-        return InferenceEngine(model, **kw)
+        return InferenceEngine(mdl, **kw)
+
+    # --swap-mid-run: commit the two checkpoints the rollout needs BEFORE the
+    # timed window (v1 is the new weights, v0 the rollback target) so the
+    # measured wall clock holds only the drain/swap/canary/rejoin walk itself
+    swap_ckpts: dict = {}
+    if swap_mid_run:
+        import tempfile
+
+        from paddlenlp_tpu.trainer.unified_checkpoint import save_unified_checkpoint
+
+        ck_root = tempfile.mkdtemp(prefix="bench_swap_ck_")
+        for ver, seed in (("v0", 0), ("v1", 1)):
+            path = os.path.join(ck_root, ver)
+            save_unified_checkpoint(
+                path, LlamaForCausalLM.from_config(cfg, seed=seed), None)
+            swap_ckpts[ver] = path
 
     registry = MetricsRegistry()
     fleet = server = None
@@ -573,8 +611,44 @@ def run() -> None:
             drain_result["drained_ok"] = False
             drain_result["error"] = repr(e)
 
+    # --swap-mid-run: halfway through the request stream, roll the v1
+    # checkpoint across every replica via the router's rollout orchestrator
+    # (drain -> swap -> canary -> health-gated rejoin, one replica at a time)
+    # while the remaining requests keep flowing. ttft_timed pairs each TTFT
+    # with its absolute first-token timestamp so the record can isolate the
+    # tail measured INSIDE the swap window.
+    rollout_result: dict = {}
+    ttft_timed: list = []  # (abs first-token time, ttft_s)
+
+    def swap_worker():
+        rollout_result["t0"] = time.time()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=RUN_TIMEOUT_S)
+            conn.request("POST", "/admin/weights/rollout",
+                         body=json.dumps({"ckpt_dir": swap_ckpts["v1"],
+                                          "rollback_ckpt_dir": swap_ckpts["v0"],
+                                          "drain_deadline_s": 60.0,
+                                          "rejoin_timeout_s": 60.0,
+                                          "wait": True}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+            conn.close()
+            ro = doc.get("rollout") or {}
+            rollout_result["status"] = ro.get("status")
+            rollout_result["wall_s"] = ro.get("wall_s")
+            rollout_result["replicas_swapped"] = len(ro.get("completed") or [])
+            rollout_result["abort_reason"] = ro.get("abort_reason")
+            rollout_result["ok"] = bool(
+                resp.status == 200 and ro.get("status") == "done")
+        except Exception as e:
+            rollout_result["ok"] = False
+            rollout_result["error"] = repr(e)
+        rollout_result["t1"] = time.time()
+
     def worker(i: int):
         local = {"ttft": [], "tokens": 0, "gaps_short": []}
+        t_req = time.time()
         try:
             one_request(i, local)
         except Exception as e:
@@ -587,6 +661,8 @@ def run() -> None:
             stats["ttft"].extend(local["ttft"])
             stats["tokens"] += local["tokens"]
             stats["gaps_short"].extend(local["gaps_short"])
+            if swap_mid_run:
+                ttft_timed.extend((t_req + v, v) for v in local["ttft"])
 
     def surge_request(i: int, phase: str, priority: str):
         """One open-loop surge request: sheds (503 overloaded_shed) and
@@ -650,6 +726,7 @@ def run() -> None:
     t0 = time.time()
     threads = []
     drain_thread = None
+    swap_thread = None
     if surge:
         # SLO burn trajectory: sampled like an on-call dashboard would, once
         # a second over the whole run (router mode only)
@@ -708,6 +785,9 @@ def run() -> None:
             if drain_mid_run and drain_thread is None and i >= n_requests // 2:
                 drain_thread = threading.Thread(target=drain_worker, daemon=True)
                 drain_thread.start()
+            if swap_mid_run and swap_thread is None and i >= n_requests // 2:
+                swap_thread = threading.Thread(target=swap_worker, daemon=True)
+                swap_thread.start()
             t = threading.Thread(target=worker, args=(i,))
             t.start()
             threads.append(t)
@@ -715,6 +795,8 @@ def run() -> None:
             t.join()
     if drain_thread is not None:
         drain_thread.join(timeout=90)
+    if swap_thread is not None:
+        swap_thread.join(timeout=RUN_TIMEOUT_S)
     dt = time.time() - t0
 
     # scrape /metrics over HTTP (the same path a real Prometheus takes) BEFORE
@@ -759,6 +841,8 @@ def run() -> None:
 
     if errors:
         _fail(f"{len(errors)}/{n_requests} requests failed: {errors[:3]}")
+    if swap_mid_run and not rollout_result.get("ok"):
+        _fail(f"--swap-mid-run rollout did not land: {rollout_result}")
     ttfts = sorted(stats["ttft"])
     p = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)] if ttfts else 0.0
 
@@ -1023,6 +1107,23 @@ def run() -> None:
                 record["drain_wall_s"] = drain_result["drain_wall_s"]
             if "error" in drain_result:
                 record["drain_error"] = drain_result["error"]
+        if swap_mid_run:
+            # zero-downtime readout: the run already _fail()s on any client
+            # error, so streams_lost is the gateable proof that the rollout
+            # cost nothing; the in-window p99 isolates the tail the drain/
+            # swap/canary walk added on top of steady-state serving
+            w0 = rollout_result.get("t0", t0)
+            w1 = rollout_result.get("t1", t0 + dt)
+            during = sorted(v for at, v in ttft_timed if w0 <= at <= w1)
+            d_p99 = (during[min(int(0.99 * len(during)), len(during) - 1)]
+                     if during else 0.0)
+            record["rollout"] = {
+                "status": rollout_result.get("status"),
+                "wall_s": rollout_result.get("wall_s"),
+                "replicas_swapped": rollout_result.get("replicas_swapped", 0),
+                "streams_lost": len(errors),
+                "ttft_p99_during_swap_ms": round(d_p99 * 1e3, 1),
+            }
         if fleet_slo is not None and fleet_slo.get("windows"):
             # the longest window covers the whole bench run (process lifetime)
             widest = fleet_slo["windows"][max(

@@ -1,0 +1,200 @@
+"""Fleet weight-rollout driver: the zero-downtime hot-swap plane as an
+operator CLI.
+
+Submits ``POST /admin/weights/rollout`` to a running router and follows the
+rollout to its terminal state, emitting one JSONL decision line per observed
+transition (submitted, per-replica completion, skew detection, terminal).
+Exit code ``0`` when the rollout lands, ``1`` when it aborts and rolls back
+(or ``--abort-on-skew`` rolled the fleet back), ``2`` on usage errors.
+
+Stdlib-only on purpose — this talks to the router over HTTP exactly like any
+external orchestrator would::
+
+    python tools/rollout.py --router 127.0.0.1:8010 \\
+        --ckpt-dir /ckpts/step-9000 --rollback-ckpt-dir /ckpts/step-8000 \\
+        --canary-digest 547d0132... --abort-on-skew
+
+``--canary-digest`` pins the cross-replica canary reference (otherwise the
+first swapped replica's digest becomes it). ``--abort-on-skew`` watches the
+router's ``paddlenlp_router_version_skew_total`` counter during the rollout:
+any client stream terminated for version skew marks the rollout harmful, and
+once it lands the fleet is rolled BACK to ``--rollback-ckpt-dir`` (rc 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import time
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--router", required=True, help="router HOST:PORT")
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="committed checkpoint directory to roll out")
+    ap.add_argument("--version", default=None,
+                    help="weights version label (default: ckpt dir basename)")
+    ap.add_argument("--rollback-ckpt-dir", default=None,
+                    help="checkpoint already-swapped replicas reload on abort")
+    ap.add_argument("--canary-digest", default=None,
+                    help="expected canary token digest (pins the reference "
+                         "every replica must reproduce)")
+    ap.add_argument("--mode", default=None,
+                    choices=("finish_old", "pause_resume"),
+                    help="in-flight handling during each replica's swap")
+    ap.add_argument("--drain-deadline", type=float, default=30.0)
+    ap.add_argument("--rejoin-timeout", type=float, default=30.0)
+    ap.add_argument("--swap-timeout", type=float, default=120.0)
+    ap.add_argument("--poll-interval", type=float, default=0.2,
+                    help="rollout status poll cadence, seconds")
+    ap.add_argument("--abort-on-skew", action="store_true",
+                    help="roll the fleet back (rc 1) if any stream was "
+                         "terminated with finish_reason=version_skew during "
+                         "the rollout (requires --rollback-ckpt-dir)")
+    return ap.parse_args(argv)
+
+
+def _request(host, port, method, path, payload=None, timeout=60.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = None if payload is None else json.dumps(payload).encode()
+        headers = {} if body is None else {"Content-Type": "application/json"}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get_json(host, port, path, timeout=60.0):
+    status, raw = _request(host, port, "GET", path, timeout=timeout)
+    return status, json.loads(raw or b"{}")
+
+
+def _skew_count(host, port) -> float:
+    """Current value of the router's version-skew termination counter (0.0
+    when the scrape fails or the series has not been incremented yet)."""
+    try:
+        status, raw = _request(host, port, "GET", "/metrics", timeout=30.0)
+    except OSError:
+        return 0.0
+    if status != 200:
+        return 0.0
+    for line in raw.decode("utf-8", "replace").splitlines():
+        if line.startswith("paddlenlp_router_version_skew_total"):
+            try:
+                return float(line.rsplit(None, 1)[1])
+            except (IndexError, ValueError):
+                return 0.0
+    return 0.0
+
+
+def _decision(event: str, **fields):
+    print(json.dumps({"t": round(time.time(), 3), "event": event, **fields}),
+          flush=True)
+
+
+def _follow(host, port, poll_interval, *, watch_skew, skew_base):
+    """Poll the rollout to a terminal state, emitting a decision line per
+    replica completion. Returns (final_state_doc, skew_seen)."""
+    seen_done, seen_skipped = set(), set()
+    skew_seen = False
+    while True:
+        status, doc = _get_json(host, port, "/admin/weights/rollout")
+        state = (doc or {}).get("rollout")
+        if status != 200 or not state:
+            _decision("poll_error", status=status)
+            time.sleep(poll_interval)
+            continue
+        for rid in state.get("completed", []):
+            if rid not in seen_done:
+                seen_done.add(rid)
+                _decision("replica_done", replica=rid, version=state["version"])
+        for rid in state.get("skipped", []):
+            if rid not in seen_skipped:
+                seen_skipped.add(rid)
+                _decision("replica_skipped", replica=rid,
+                          version=state["version"])
+        if watch_skew and not skew_seen:
+            skew = _skew_count(host, port)
+            if skew > skew_base:
+                skew_seen = True
+                _decision("skew_detected", terminations=skew - skew_base)
+        if state.get("status") != "running":
+            return state, skew_seen
+        time.sleep(poll_interval)
+
+
+def _submit(host, port, body, poll_interval, *, watch_skew=False, skew_base=0.0):
+    status, doc = None, {}
+    try:
+        status, raw = _request(host, port, "POST", "/admin/weights/rollout",
+                               body, timeout=60.0)
+        doc = json.loads(raw or b"{}")
+    except (OSError, ValueError) as e:
+        _decision("submit_error", error=repr(e))
+        return None, False
+    if status != 200:
+        _decision("submit_rejected", status=status, response=doc)
+        return None, False
+    _decision("submitted", version=doc["rollout"]["version"],
+              replicas=doc["rollout"]["replicas"])
+    return _follow(host, port, poll_interval,
+                   watch_skew=watch_skew, skew_base=skew_base)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    host, _, port_s = args.router.partition(":")
+    if not port_s or not port_s.isdigit():
+        print(json.dumps({"error": f"--router must be HOST:PORT, got {args.router!r}"}))
+        return 2
+    if args.abort_on_skew and not args.rollback_ckpt_dir:
+        print(json.dumps({"error": "--abort-on-skew requires --rollback-ckpt-dir"}))
+        return 2
+    port = int(port_s)
+
+    body = {"ckpt_dir": args.ckpt_dir,
+            "drain_deadline_s": args.drain_deadline,
+            "rejoin_timeout_s": args.rejoin_timeout,
+            "swap_timeout_s": args.swap_timeout}
+    for key, val in (("version", args.version),
+                     ("rollback_ckpt_dir", args.rollback_ckpt_dir),
+                     ("canary_digest", args.canary_digest),
+                     ("mode", args.mode)):
+        if val is not None:
+            body[key] = val
+
+    skew_base = _skew_count(host, port) if args.abort_on_skew else 0.0
+    state, skew_seen = _submit(host, port, body, args.poll_interval,
+                               watch_skew=args.abort_on_skew,
+                               skew_base=skew_base)
+    if state is None:
+        return 2
+    _decision("terminal", status=state["status"], version=state["version"],
+              completed=state.get("completed", []),
+              rolled_back=state.get("rolled_back", []),
+              abort_reason=state.get("abort_reason"), wall_s=state.get("wall_s"))
+    if state["status"] != "done":
+        return 1
+    if skew_seen:
+        # the rollout landed but cost live client streams: treat it as
+        # harmful and converge the fleet back onto the rollback checkpoint
+        _decision("skew_rollback_start", ckpt_dir=args.rollback_ckpt_dir)
+        back, _ = _submit(host, port,
+                          {"ckpt_dir": args.rollback_ckpt_dir,
+                           "drain_deadline_s": args.drain_deadline,
+                           "rejoin_timeout_s": args.rejoin_timeout,
+                           "swap_timeout_s": args.swap_timeout},
+                          args.poll_interval)
+        _decision("skew_rollback_done",
+                  status=None if back is None else back["status"])
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
