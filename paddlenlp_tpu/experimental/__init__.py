@@ -2,4 +2,5 @@ from .backend import MixedRow, ModelBackend, SingleDeviceBackend  # noqa: F401
 from .disagg_backend import DisaggBackend  # noqa: F401
 from .engine import InferenceEngine, Request, SamplingParams  # noqa: F401
 from .inference_model import PagedInferenceModel  # noqa: F401
+from .kv_host_tier import HostKVTier, HostPromoteTicket  # noqa: F401
 from .paged_cache import BlockManager, PagedKVPool, init_paged_pool  # noqa: F401

@@ -70,7 +70,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .inference_model import PagedInferenceModel
-from .paged_cache import copy_blocks, init_paged_pool
+from .kv_host_tier import HostPromoteTicket, gather_blocks, scatter_blocks
+from .paged_cache import PagedKVPool, copy_blocks, init_paged_pool
 
 __all__ = ["ModelBackend", "SingleDeviceBackend", "MixedRow", "samp_arrays"]
 
@@ -168,6 +169,43 @@ class ModelBackend:
 
     def apply_cow(self, pairs):
         raise NotImplementedError
+
+    def kv_spill(self, block_ids):
+        """Gather ``block_ids`` out of the pool and start their D2H copy
+        (hierarchical prefix cache, kv_host_tier.py). Returns ``(kv, scale)``
+        gathered [L, 2, n_padded, K, bs, H] planes with
+        ``copy_to_host_async`` dispatched — the engine hands them straight to
+        :meth:`HostKVTier.put`. Must be called BEFORE any launch that writes
+        the (just-recycled) blocks; dispatch order then guarantees the gather
+        reads the pre-overwrite bytes."""
+        raise NotImplementedError
+
+    def kv_promote(self, seq_id, block_ids, host_kv, host_scale=None):
+        """Scatter host-tier KV back into freshly-allocated pool blocks (the
+        async H2D dispatched ahead of prefill). Returns a
+        :class:`HostPromoteTicket` whose markers feed
+        :meth:`migration_ready` — the engine keeps the sequence in
+        ``kv_stage == "promoting"`` until the copy lands."""
+        raise NotImplementedError
+
+    def kv_writeback(self, block_ids):
+        """Make ``block_ids``' KV readable by future *prefill* work. A no-op
+        everywhere except staged backends: generated-token KV is written in
+        the decode pool, so registering generated blocks in the prefix index
+        needs their bytes copied back into the prefill pool first."""
+        return None
+
+    def migration_ready(self, ticket) -> bool:
+        """Non-blocking landed check for any marker-carrying copy ticket
+        (stage migrations and host-tier promotions share it). Purely a
+        scheduling signal — the pool's functional threading already orders
+        every read after the copy — so a runtime without ``is_ready``
+        introspection just reports landed."""
+        for m in ticket.markers:
+            probe = getattr(m, "is_ready", None)
+            if probe is not None and not probe():
+                return False
+        return True
 
     def sync_params(self, new_params):
         """Install a new base-weight tree as THE params for every subsequent
@@ -350,6 +388,73 @@ class SingleDeviceBackend(ModelBackend):
 
     def apply_cow(self, pairs):
         self.pool = copy_blocks(self.pool, pairs)
+
+    # ---------------------------------------------------------------- host tier
+    def _build_host_tier_jits(self):
+        """(gather, scatter) programs for spill/promote. The sharded backend
+        overrides this to compile them with explicit shardings; the jits are
+        dtype-polymorphic so one pair serves the kv and scale planes."""
+        return (jax.jit(gather_blocks, donate_argnums=()),
+                jax.jit(scatter_blocks, donate_argnums=(0,)))
+
+    def _host_tier_jits(self):
+        jits = getattr(self, "_host_jits", None)
+        if jits is None:
+            jits = self._build_host_tier_jits()
+            self._host_jits = jits
+        return jits
+
+    def _place_host_blocks(self, data):
+        """Start the H2D transfer of a promoted block slice (the sharded
+        backend lands it with the pool's NamedSharding)."""
+        return jnp.asarray(data)
+
+    @staticmethod
+    def _pad_block_ids(block_ids):
+        """pow2-pad with sentinel self-references (block 0 is never a live
+        dst), bounding gather/scatter to log2(max_blocks_per_seq) compiles —
+        the migration padding rule."""
+        ids = [int(b) for b in block_ids]
+        padded = 1
+        while padded < max(len(ids), 1):
+            padded *= 2
+        return ids, jnp.asarray(ids + [0] * (padded - len(ids)), jnp.int32), padded
+
+    def kv_spill(self, block_ids):
+        ids, ids_arr, _ = self._pad_block_ids(block_ids)
+        gather, _ = self._host_tier_jits()
+        kv = gather(self.pool.kv, ids_arr)
+        kv.copy_to_host_async()
+        scale = None
+        if self.pool.scale is not None:
+            scale = gather(self.pool.scale, ids_arr)
+            scale.copy_to_host_async()
+        return kv, scale
+
+    def kv_promote(self, seq_id, block_ids, host_kv, host_scale=None):
+        ids, ids_arr, padded = self._pad_block_ids(block_ids)
+        n = len(ids)
+        if padded != n:
+            # pad with ZERO rows, not gathered bytes: the sentinel ids point
+            # the extra scatter rows at block 0, which must stay all-zeros
+            pad = np.zeros(host_kv.shape[:2] + (padded - n,) + host_kv.shape[3:],
+                           host_kv.dtype)
+            host_kv = np.concatenate([host_kv, pad], axis=2)
+            if host_scale is not None:
+                spad = np.zeros(host_scale.shape[:2] + (padded - n,) + host_scale.shape[3:],
+                                host_scale.dtype)
+                host_scale = np.concatenate([host_scale, spad], axis=2)
+        _, scatter = self._host_tier_jits()
+        new_kv, marker = scatter(self.pool.kv, self._place_host_blocks(host_kv), ids_arr)
+        markers = [marker]
+        scale = self.pool.scale
+        if scale is not None:
+            if host_scale is None:
+                raise ValueError("quantized pool promote needs the spilled scale plane")
+            scale, s_marker = scatter(scale, self._place_host_blocks(host_scale), ids_arr)
+            markers.append(s_marker)
+        self.pool = PagedKVPool(kv=new_kv, scale=scale)
+        return HostPromoteTicket(seq_id=seq_id, n_blocks=n, markers=tuple(markers))
 
     # ---------------------------------------------------------------- mixed
     def mixed_step(self, chunk_rows: List[MixedRow], decode_rows: List[MixedRow]) -> np.ndarray:

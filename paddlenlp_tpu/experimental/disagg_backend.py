@@ -174,6 +174,18 @@ class DisaggBackend(ModelBackend):
             _scatter_blocks, donate_argnums=(0,),
             in_shardings=(d_kv_s, d_kv_s, d_inf._repl),
             out_shardings=(d_kv_s, d_inf._repl))
+        # the reverse direction (decode→prefill) serves kv_writeback:
+        # generated-token KV exists only in the decode pool, but prefix-cache
+        # reads (chunk attention, host-tier spills) happen on the prefill
+        # stage — registering generated blocks requires carrying them back
+        self._gather_kv_back = jax.jit(
+            _gather_blocks, donate_argnums=(),
+            in_shardings=(d_kv_s, d_inf._repl), out_shardings=d_kv_s)
+        self._scatter_kv_back = jax.jit(
+            _scatter_blocks, donate_argnums=(0,),
+            in_shardings=(p_kv_s, p_kv_s, p_inf._repl),
+            out_shardings=(p_kv_s, p_inf._repl))
+        self._kv_back_sharding = p_kv_s
         if self.decode_stage.pool.scale is not None:
             p_s = p_inf.pool_shardings.scale
             d_s = d_inf.pool_shardings.scale
@@ -185,6 +197,14 @@ class DisaggBackend(ModelBackend):
                 _scatter_blocks, donate_argnums=(0,),
                 in_shardings=(d_s, d_s, d_inf._repl),
                 out_shardings=(d_s, d_inf._repl))
+            self._gather_scale_back = jax.jit(
+                _gather_blocks, donate_argnums=(),
+                in_shardings=(d_s, d_inf._repl), out_shardings=d_s)
+            self._scatter_scale_back = jax.jit(
+                _scatter_blocks, donate_argnums=(0,),
+                in_shardings=(p_s, p_s, p_inf._repl),
+                out_shardings=(p_s, p_inf._repl))
+            self._scale_back_sharding = p_s
 
     # ------------------------------------------------------------- device state
     # the decode stage is "the" pool/counts/infer for read paths that predate
@@ -329,15 +349,47 @@ class DisaggBackend(ModelBackend):
         self.recent_migrations.append((next(self._mig_seq), n, moved_bytes))
         return MigrationTicket(seq_id=seq_id, n_blocks=n, markers=tuple(markers))
 
-    def migration_ready(self, ticket: MigrationTicket) -> bool:
-        """Non-blocking landed check. Purely a scheduling signal — the decode
-        pool's functional threading already orders every read after the copy —
-        so a runtime without ``is_ready`` introspection just reports landed."""
-        for m in ticket.markers:
-            probe = getattr(m, "is_ready", None)
-            if probe is not None and not probe():
-                return False
-        return True
+    # migration_ready: inherited from ModelBackend — the marker poll is the
+    # same non-blocking scheduling signal for stage migrations and host-tier
+    # promotions (correctness never needs it; functional threading orders
+    # every pool read after the copy).
+
+    # ------------------------------------------------------------- host tier
+    # Registered prefix blocks live canonically in the PREFILL pool (written
+    # there by chunk/prefill work, carried to decode by migrations), so the
+    # hierarchical tier spills from and promotes into the prefill stage; a
+    # promoted sequence's ordinary prefill→decode migration then carries the
+    # promoted blocks across like any other prefix hit.
+    def kv_spill(self, block_ids):
+        return self.prefill_stage.kv_spill(block_ids)
+
+    def kv_promote(self, seq_id, block_ids, host_kv, host_scale=None):
+        return self.prefill_stage.kv_promote(seq_id, block_ids, host_kv,
+                                             host_scale=host_scale)
+
+    def kv_writeback(self, block_ids):
+        """Carry generated-token KV decode→prefill so the blocks can join the
+        prefix index: async gather on the decode mesh, cross-mesh
+        ``device_put``, scatter into the (donated) prefill pool — kv_migrate
+        run in reverse, with the same sentinel padding. No ticket: nothing
+        gates on the landing (future prefill reads are functionally ordered
+        after the scatter)."""
+        ids = [int(b) for b in block_ids]
+        n = len(ids)
+        padded = 1
+        while padded < max(n, 1):
+            padded *= 2
+        ids_arr = jnp.asarray(ids + [0] * (padded - n), jnp.int32)
+        src = self._gather_kv_back(self.decode_stage.pool.kv, ids_arr)
+        moved = jax.device_put(src, self._kv_back_sharding)
+        new_kv, _ = self._scatter_kv_back(self.prefill_stage.pool.kv, moved, ids_arr)
+        scale = self.prefill_stage.pool.scale
+        if scale is not None:
+            s_src = self._gather_scale_back(self.decode_stage.pool.scale, ids_arr)
+            s_moved = jax.device_put(s_src, self._scale_back_sharding)
+            scale, _ = self._scatter_scale_back(scale, s_moved, ids_arr)
+        self.prefill_stage.pool = PagedKVPool(kv=new_kv, scale=scale)
+        return None
 
     # ------------------------------------------------------------- misc
     def describe(self) -> dict:

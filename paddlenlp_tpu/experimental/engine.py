@@ -57,6 +57,7 @@ from ..serving.tenancy.quotas import DEFAULT_TENANT, TenantQuotas, tenant_goodpu
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .backend import MixedRow, ModelBackend, SingleDeviceBackend, _bucket
+from .kv_host_tier import HostKVTier, pool_block_bytes
 from .paged_cache import BlockManager
 
 __all__ = ["InferenceEngine", "Request", "SamplingParams"]
@@ -64,6 +65,8 @@ __all__ = ["InferenceEngine", "Request", "SamplingParams"]
 _F_STEP = FaultPoint("engine.step")
 _F_CHUNK = FaultPoint("engine.prefill_chunk")
 _F_MIGRATE = FaultPoint("engine.kv_migrate")
+_F_SPILL = FaultPoint("engine.kv_spill")
+_F_PROMOTE = FaultPoint("engine.kv_promote")
 
 
 @dataclasses.dataclass
@@ -131,6 +134,11 @@ class Request:
     # (accumulated on land; migrate_start_t marks an episode still open)
     migration_wait_s: float = 0.0
     migrate_start_t: Optional[float] = None
+    # ... and seconds waiting for a host-tier KV promotion (H2D copy of
+    # spilled prefix blocks) to land before prefill could proceed
+    # (accumulated on land; promote_start_t marks an episode still open)
+    promote_wait_s: float = 0.0
+    promote_start_t: Optional[float] = None
     # goodput-ledger bookkeeping: highest absolute position ever fed through
     # a forward for this request (prompt+output indexing survives the
     # preemption fold) — re-feeding below the mark is rework, not useful ...
@@ -259,6 +267,12 @@ class InferenceEngine:
         # dict form). The max_inflight leg is enforced upstream by the
         # serving scheduler; the engine owns the block-share admission gate.
         tenant_quotas=None,
+        # hierarchical KV cache: host-RAM spill tier capacity in BLOCKS
+        # (0 = off). Zero-ref prefix blocks popped off the cache LRU demote
+        # to pinned host memory (batched async D2H) instead of being
+        # destroyed; a prefix match landing on them promotes back with an
+        # async H2D copy overlapped with decode. Requires enable_prefix_cache.
+        host_kv_blocks: int = 0,
     ):
         self.model = model
         self.tokenizer = tokenizer
@@ -316,6 +330,21 @@ class InferenceEngine:
         self.enable_prefix_cache = enable_prefix_cache
         self.mgr = BlockManager(num_blocks, block_size, max_blocks_per_seq,
                                 enable_prefix_cache=enable_prefix_cache)
+        # hierarchical KV: the optional host-RAM tier under the BlockManager,
+        # plus the engine-held in-flight promotion tickets (req_id -> ticket;
+        # the same marker-poll scheduling gate as stage migrations)
+        self.host_kv_blocks = int(host_kv_blocks or 0)
+        self._host_tier: Optional[HostKVTier] = None
+        if self.host_kv_blocks > 0:
+            if not enable_prefix_cache:
+                raise ValueError(
+                    "host_kv_blocks requires enable_prefix_cache=True: the "
+                    "tier is the prefix cache's second level")
+            self._host_tier = HostKVTier(
+                self.host_kv_blocks,
+                block_bytes=pool_block_bytes(self.backend.pool))
+            self.mgr.attach_host_tier(self._host_tier)
+        self._promoting: Dict[int, object] = {}
         self.max_batch_size = max_batch_size
         self.decode_steps = decode_steps
         self.waiting: deque[Request] = deque()
@@ -462,6 +491,7 @@ class InferenceEngine:
                 self._free_kv(req)
                 self.slots[slot] = None
                 self._drop_migration(req_id)
+                self._drop_promotion(req_id)
                 self._finish_abort(req)
                 return req
         return None
@@ -484,8 +514,30 @@ class InferenceEngine:
         if cache and self.enable_prefix_cache and req.finish_reason in ("stop", "length"):
             # salt = adapter_id: an adapter's KV is the product of base+delta
             # forwards, so cached prefixes are only shareable within the SAME
-            # adapter (base-model requests keep the historical unsalted hashes)
-            self.mgr.finish_seq_cached(req.req_id, req.prompt_ids, salt=req.adapter_id)
+            # adapter (base-model requests keep the historical unsalted hashes).
+            # GENERATED blocks register too (conversation-lifetime caching: a
+            # chat turn's completion is the next turn's prompt prefix) — the
+            # last sampled token is excluded because it was emitted, never fed,
+            # so its KV position was never written
+            token_ids = req.prompt_ids
+            if len(req.output_ids) > 1:
+                gen = np.asarray(req.output_ids[:-1], np.int32)  # sync-ok: host int list, no device sync
+                token_ids = np.concatenate([req.prompt_ids, gen])
+            bs = self.mgr.block_size
+            nb_full = len(token_ids) // bs
+            wb = nb_full - len(req.prompt_ids) // bs
+            if self.staged and wb > 0 and req.req_id in self.mgr.tables:
+                # staged backends: decode wrote the generated positions into
+                # the DECODE pool, but cached prefixes serve prefill from the
+                # PREFILL pool — copy the generation-bearing full blocks back
+                # before registering them. The prompt/generation boundary
+                # block is complete in the decode pool (migration moved it
+                # whole before decode appended), so the write-back slice
+                # starts there, not one block later.
+                table = self.mgr.tables[req.req_id]
+                self.backend.kv_writeback(
+                    list(table[len(req.prompt_ids) // bs : nb_full]))
+            self.mgr.finish_seq_cached(req.req_id, token_ids, salt=req.adapter_id)
         else:
             self.mgr.free_seq(req.req_id)
         if req.adapter_slot:
@@ -529,6 +581,7 @@ class InferenceEngine:
                 self._free_kv(req)
                 self.slots[slot] = None
                 self._drop_migration(req_id)
+                self._drop_promotion(req_id)
                 self._spec_rngs.pop(req_id, None)
                 return True
         self._spec_rngs.pop(req_id, None)
@@ -681,6 +734,79 @@ class InferenceEngine:
                         reason=reason, inflight=len(self._migrating),
                         pending=len(self._migrate_pending))
 
+    # ------------------------------------------------------------------ host KV tier
+    def _drop_promotion(self, req_id: int):
+        """Forget a request's in-flight promotion (abort / preempt /
+        quarantine). A dispatched H2D copy needs no cancellation: functional
+        pool threading orders it before any later read, and it only wrote
+        the request's own blocks, which are about to be freed."""
+        self._promoting.pop(req_id, None)
+
+    def _drain_spills(self):
+        """Flush prefix blocks the allocator popped off the cache LRU since
+        the last drain into the host tier: ONE batched D2H gather, dispatched
+        BEFORE any launch that could overwrite the recycled blocks (JAX
+        dispatch order makes the gather read the pre-write values, and
+        ``copy_to_host_async`` overlaps the transfer with the step's real
+        work). A failure drops the spill — the blocks were already recycled,
+        which is exactly the pre-tier behavior — and leaks nothing."""
+        if self._host_tier is None:
+            return
+        pairs = self.mgr.drain_pending_spills()
+        if not pairs:
+            return
+        t0 = time.perf_counter()
+        try:
+            _F_SPILL.fire(blocks=len(pairs))
+            kv, scale = self.backend.kv_spill([b for _h, b in pairs])
+            self._host_tier.put([h for h, _b in pairs], kv, scale)
+        except Exception as e:
+            RECORDER.record("spill.drop", blocks=len(pairs),
+                            error=type(e).__name__)
+            logger.warning(f"host-tier spill of {len(pairs)} blocks dropped: {e}")
+            return
+        RECORDER.record("spill.batch", blocks=len(pairs),
+                        resident=self._host_tier.num_blocks)
+        TRACER.add_span("kv_spill", TRACER.epoch_time(t0),
+                        time.perf_counter() - t0, cat="engine",
+                        blocks=len(pairs), resident=self._host_tier.num_blocks,
+                        step=self._cur_step)
+
+    def _advance_promotions(self, finished: List[Request]):
+        """Poll in-flight host→device KV promotions (same marker-poll gate as
+        stage migrations). Landing re-opens the request's prefill path:
+        chunked engines start feeding its remaining suffix next
+        ``_mixed_step``; monolithic engines launch the deferred prefill batch
+        right here, in the same step the copy landed."""
+        to_prefill: List[tuple] = []
+        for req_id, ticket in list(self._promoting.items()):
+            ticket.polls += 1
+            if not (self.backend.migration_ready(ticket)
+                    or ticket.polls >= self.migration_force_land_polls):
+                continue
+            del self._promoting[req_id]
+            slot = self._slot_of(req_id)
+            if slot is None:
+                continue  # aborted/preempted while the copy was in flight
+            req = self.slots[slot]
+            # staged backends resume the ordinary prefill→migrate→decode walk
+            # (promoted blocks landed in the prefill-stage pool); single-pool
+            # backends just become row-eligible again
+            req.kv_stage = "prefill" if self.staged else "decode"
+            if req.promote_start_t is not None:
+                # the promote-wait episode closes: bank it for attribution
+                req.promote_wait_s += time.time() - req.promote_start_t
+                req.promote_start_t = None
+            RECORDER.record("promote.land", req_id=req_id, trace=req.trace,
+                            blocks=ticket.n_blocks, polls=ticket.polls)
+            TRACER.instant("kv_promoted", cat="engine", trace=req.trace,
+                           req_id=req_id, blocks=ticket.n_blocks,
+                           polls=ticket.polls)
+            if not self.prefill_chunk_tokens and req.needs_prefill:
+                to_prefill.append((slot, req, req.prefilled_len))
+        if to_prefill:
+            self._prefill_batch(to_prefill, finished)
+
     def reset(self):
         """Drop ALL scheduler/allocator state after a failed step — the
         in-place recovery the serving supervisor uses when it has no
@@ -705,6 +831,13 @@ class InferenceEngine:
         self._migrating.clear()
         self._migrate_pending.clear()
         self._migrate_defer_noted.clear()
+        self._promoting.clear()
+        if self._host_tier is not None:
+            # tier content stays valid across reset (content-addressed KV
+            # under unchanged params) — only the device-side index dropped
+            # with the manager; re-attach so spills keep flowing. Pending
+            # spills died with the old manager: their block ids are stale.
+            self.mgr.attach_host_tier(self._host_tier)
         # the failed step never ran its anatomy tail: without this, the first
         # post-recovery step would book the whole outage (triage + reset) as
         # a "step gap" and pollute the histogram the bench gate reads
@@ -728,6 +861,17 @@ class InferenceEngine:
                 "cached_tokens": self.mgr.cached_tokens_total,
                 "evictions": self.mgr.evictions,
                 "cached_blocks": self.mgr.num_cached_blocks,
+                # the host-RAM spill tier under the device cache: always
+                # present (zeros when off) so the metrics plane reads one shape
+                "host": dict(
+                    {"enabled": self._host_tier is not None,
+                     "promotes_inflight": len(self._promoting)},
+                    **(self._host_tier.snapshot() if self._host_tier is not None
+                       else {"blocks": 0, "capacity": 0, "spills": 0,
+                             "spill_batches": 0, "promotes": 0,
+                             "promoted_blocks": 0, "promote_bytes": 0,
+                             "evictions": 0}),
+                ),
             },
             "chunked_prefill": {
                 "enabled": bool(self.prefill_chunk_tokens),
@@ -854,6 +998,10 @@ class InferenceEngine:
         # whose step_num matches the step= arg on the host prefill/decode
         # spans — host stall or device stall is one cross-reference away
         with jax.profiler.StepTraceAnnotation("engine_step", step_num=self._cur_step):
+            if self._promoting:
+                # land finished host→device promotions FIRST, so a landed
+                # request prefills (or chunks) in this very step
+                self._advance_promotions(finished)
             if self.staged:
                 # land finished prefill→decode block copies and start deferred
                 # ones BEFORE row selection, so a landed sequence decodes in
@@ -1081,6 +1229,79 @@ class InferenceEngine:
             # a stale pending count into later spans
             req.cow_pending = (prompt_len - n_cached
                                if (match is not None and match[2] is not None) else 0)
+            # hierarchical KV: the device-index match may continue into the
+            # host tier — promote those blocks back with an async H2D copy
+            # instead of re-prefilling them. The copy is dispatched NOW
+            # (ahead of any prefill) and the request sits in kv_stage
+            # "promoting" until the marker lands, overlapped with other
+            # slots' decode steps. A full-cover COW admission skips this:
+            # its whole prompt is already device-resident.
+            if cache_on and self._host_tier is not None \
+                    and not (match is not None and match[2] is not None):
+                bs = self.mgr.block_size
+                host_hashes = self.mgr.host_match(
+                    req.prompt_ids, prompt_len, salt=req.adapter_id,
+                    skip=n_cached // bs)
+                # at least one prompt token must remain uncached: the first
+                # output token is sampled by the final prompt forward
+                while host_hashes and n_cached + len(host_hashes) * bs >= prompt_len:
+                    host_hashes = host_hashes[:-1]
+                if host_hashes:
+                    # drain pending spills FIRST: this very allocate() may
+                    # have popped LRU blocks that are about to be promote
+                    # targets — their D2H gather must be enqueued before the
+                    # promote scatter overwrites them. The drain's put() can
+                    # LRU-evict tier entries, so re-truncate the match to the
+                    # still-resident prefix afterwards.
+                    self._drain_spills()
+                    resident: List[bytes] = []
+                    for h in host_hashes:
+                        if not self._host_tier.contains(h):
+                            break
+                        resident.append(h)
+                    host_hashes = resident
+                if host_hashes:
+                    promote_blocks = list(_new[: len(host_hashes)])
+                    t_pr = time.perf_counter()
+                    nbytes = len(host_hashes) * self._host_tier.block_bytes
+                    try:
+                        _F_PROMOTE.fire(req_id=req.req_id,
+                                        blocks=len(host_hashes))
+                        host_kv, host_scale, nbytes = \
+                            self._host_tier.take(host_hashes)
+                        ticket = self.backend.kv_promote(
+                            req.req_id, promote_blocks, host_kv, host_scale)
+                    except Exception as e:
+                        # token-exact fallback: a pre-take failure leaves the
+                        # entries tier-resident; a post-take one already
+                        # popped them — either way the request keeps its
+                        # allocated blocks, prefill just recomputes the span
+                        # cold and the finish re-registers it. No host- or
+                        # device-tier entry leaks, no stream is lost.
+                        RECORDER.record("promote.fail", req_id=req.req_id,
+                                        trace=req.trace,
+                                        blocks=len(host_hashes),
+                                        error=type(e).__name__)
+                        logger.warning(
+                            f"req {req.req_id}: host-tier promote failed "
+                            f"({e}); falling back to cold prefill")
+                    else:
+                        self.mgr.register_promoted(promote_blocks, host_hashes)
+                        if n_cached == 0:
+                            self.mgr.cache_hits += 1
+                        self.mgr.cached_tokens_total += len(host_hashes) * bs
+                        n_cached += len(host_hashes) * bs
+                        req.kv_stage = "promoting"
+                        req.promote_start_t = time.time()
+                        self._promoting[req.req_id] = ticket
+                        RECORDER.record("promote.start", req_id=req.req_id,
+                                        trace=req.trace,
+                                        blocks=len(host_hashes), bytes=nbytes)
+                        TRACER.add_span("kv_promote", TRACER.epoch_time(t_pr),
+                                        time.perf_counter() - t_pr,
+                                        cat="engine", trace=req.trace,
+                                        req_id=req.req_id,
+                                        blocks=len(host_hashes), bytes=nbytes)
             # usage metering: the KV-occupancy episode opens with the blocks;
             # the cache credit bills ONCE, at first admission — re-admission
             # hits after a preemption are rework economics, not a discount
@@ -1094,7 +1315,10 @@ class InferenceEngine:
             if self.staged:
                 # the sequence's KV is prefill-stage-resident until its last
                 # chunk lands and the blocks migrate to the decode pool
-                req.kv_stage = "prefill"
+                # ("promoting" is prefill-stage too — _stage_blocks agrees —
+                # and flips to "prefill" when the H2D copy lands)
+                if req.kv_stage != "promoting":
+                    req.kv_stage = "prefill"
                 held_prefill += len(self.mgr.tables[req.req_id])
             slot = free.pop(0)
             RECORDER.record("admit.accept", req_id=req.req_id, trace=req.trace,
@@ -1114,6 +1338,10 @@ class InferenceEngine:
                             step=self._cur_step,
                             queue_depth=queue_depth, admitted=len(admitted),
                             rejected_capacity=len(finished) - n_finished0)
+        # spill drain BEFORE the COW copies: a pending spill's D2H gather must
+        # be enqueued before any device write can touch the recycled blocks
+        # (apply_cow may write into freshly popped LRU blocks)
+        self._drain_spills()
         if cache_on and admitted:
             # prefix_cache phase: match/COW bookkeeping + the owed block copies
             pc_t0 = time.perf_counter()
@@ -1129,6 +1357,26 @@ class InferenceEngine:
 
     def _admit(self, finished: List[Request]):
         admitted = self._admit_slots(finished)
+        if not admitted:
+            return
+        launch: List[tuple] = []
+        for slot, req, n_cached in admitted:
+            if req.kv_stage == "promoting":
+                # promoted KV is still in flight: the request holds its slot
+                # (prefilled_len = device + promoted cache credit) and its
+                # prefill launches from _advance_promotions when the copy
+                # lands — never against un-landed blocks
+                req.prefilled_len = n_cached
+                self.slots[slot] = req
+            else:
+                launch.append((slot, req, n_cached))
+        self._prefill_batch(launch, finished)
+
+    def _prefill_batch(self, admitted: List[tuple], finished: List[Request]):
+        """Launch monolithic prefill for ``[(slot, req, n_cached), ...]`` —
+        the back half of :meth:`_admit`, also invoked from
+        :meth:`_advance_promotions` for requests whose prefill was deferred
+        behind a host-tier promotion."""
         if not admitted:
             return
         # batch prefills, grouped by padded UNCACHED suffix length (bounded
@@ -1257,6 +1505,9 @@ class InferenceEngine:
                 self._preempt(victim, cause="mixed_capacity")
                 if victim == slot:
                     break
+        # the capacity pass may have popped LRU blocks: enqueue their D2H
+        # gather before the mixed forward can overwrite them
+        self._drain_spills()
         budget = self.prefill_chunk_tokens
         chunk_rows: List[tuple] = []  # (slot, req, n_new)
         decode_rows: List[tuple] = []  # (slot, req)
@@ -1264,6 +1515,8 @@ class InferenceEngine:
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
+            if req.kv_stage == "promoting":
+                continue  # promoted KV still in flight: no row until it lands
             if req.needs_prefill:
                 prefilling.append(slot)
             elif req.kv_stage == "decode":
@@ -1470,6 +1723,14 @@ class InferenceEngine:
             # re-admission restarts the walk) — bank the wait for attribution
             req.migration_wait_s += time.time() - req.migrate_start_t
             req.migrate_start_t = None
+        if req.promote_start_t is not None:
+            # same for an open promote-wait episode: the in-flight H2D copy
+            # targets blocks being freed; re-admission re-matches the tier
+            req.promote_wait_s += time.time() - req.promote_start_t
+            req.promote_start_t = None
+        self._drop_promotion(req.req_id)
+        if not self.staged and req.kv_stage == "promoting":
+            req.kv_stage = "decode"  # the single-pool default
         self._free_kv(req)
         self.slots[slot] = None
         req.prompt_ids = np.concatenate([req.prompt_ids, np.asarray(req.output_ids, np.int32)])  # sync-ok: host-side id lists
@@ -1513,6 +1774,9 @@ class InferenceEngine:
             grow = req.total_len + K - self.mgr.lengths[req.req_id]
             if grow > 0 and self.mgr.extend(req.req_id, grow) is None:
                 self._preempt(slot, cause="spec_reserve")
+        # the reservation pass may have popped LRU blocks: enqueue their D2H
+        # gather before the verify forward can overwrite them
+        self._drain_spills()
         if not any(r is not None for r in self.slots):
             return
 
@@ -1663,6 +1927,9 @@ class InferenceEngine:
             if self.mgr.extend(req.req_id, max(needed, 1)) is None:
                 start_len.pop(req.req_id, None)
                 self._preempt(slot)
+        # extends may have popped LRU blocks: enqueue their D2H gather before
+        # the decode forward can overwrite them
+        self._drain_spills()
 
         if not any(r is not None and r.kv_stage == "decode" for r in self.slots):
             return

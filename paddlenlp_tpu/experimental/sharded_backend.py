@@ -53,6 +53,7 @@ from ..parallel.partition import spec_tree_from_rules
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
 from .backend import SingleDeviceBackend
+from .kv_host_tier import gather_blocks, scatter_blocks
 from .inference_model import PagedInferenceModel
 from .paged_cache import PagedKVPool
 
@@ -272,6 +273,26 @@ class ShardedBackend(SingleDeviceBackend):
 
     def _init_counts(self):
         return jax.device_put(super()._init_counts(), self.infer._repl)
+
+    def _build_host_tier_jits(self):
+        # host-tier spill/promote with the step programs' explicit-placement
+        # contract: gather/scatter on the pool's sharding (the block-slice
+        # layout equals the pool layout — the kv-heads axis shards, blocks
+        # replicate), ids and the marker replicated, scatter pool donated.
+        # The kv sharding serves the scale plane too: same NamedSharding,
+        # same axis-3 split.
+        kv_s = self.infer.pool_shardings.kv
+        r = self.infer._repl
+        gather = jax.jit(gather_blocks, donate_argnums=(),
+                         in_shardings=(kv_s, r), out_shardings=kv_s)
+        scatter = jax.jit(scatter_blocks, donate_argnums=(0,),
+                          in_shardings=(kv_s, kv_s, r), out_shardings=(kv_s, r))
+        return gather, scatter
+
+    def _place_host_blocks(self, data):
+        # promoted rows land pre-placed on the pool layout so the scatter jit
+        # never reshards its data operand at dispatch
+        return jax.device_put(data, self.infer.pool_shardings.kv)
 
     @property
     def params(self):

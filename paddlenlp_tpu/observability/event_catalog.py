@@ -36,6 +36,12 @@ EVENT_CATALOG: Dict[str, str] = {
     "migrate.start": "one sequence's prefill->decode KV-block migration was dispatched (fields: blocks, inflight)",
     "migrate.defer": "the head pending migration was deferred; recorded once per wait episode (reason=decode_pressure|inflight_limit)",
     "migrate.land": "a sequence's migrated blocks landed in the decode pool; it is now decode-eligible (fields: blocks, polls)",
+    # ------------------------------------------------------------- hierarchical KV (host tier)
+    "spill.batch": "LRU-evicted prefix blocks were gathered D2H in one batch and registered in the host KV tier (fields: blocks, resident)",
+    "spill.drop": "a spill batch failed and was dropped — the evicted blocks are simply not cached, the pre-tier behavior (fields: blocks, error)",
+    "promote.start": "an admitted request's prefix matched host-tier blocks; their H2D promotion copy was dispatched ahead of prefill (fields: blocks, bytes)",
+    "promote.land": "a request's promoted blocks landed in the device pool; its deferred prefill proceeds (fields: blocks, polls)",
+    "promote.fail": "a promotion failed; the request fell back token-exactly to cold re-prefill of the span (fields: blocks, error)",
     # ------------------------------------------------------------- scheduler (admission control)
     "sched.reject": "the scheduler shed a submission before it reached the engine (reason=saturated|draining|degraded|deadline|shed|tenant_quota -> HTTP 429/503)",
     # ------------------------------------------------------------- brownout (overload degradation ladder)

@@ -37,6 +37,9 @@ SPAN_CATALOG = {
     "preempt": "instant: KV exhaustion evicted the youngest sequence for recompute-requeue",
     "kv_migrate": "dispatch of one sequence's prefill->decode KV-block migration (disaggregated backend)",
     "kv_migrated": "instant: a sequence's migrated blocks landed in the decode pool; it is now decode-eligible",
+    "kv_spill": "one batched D2H gather of LRU-evicted prefix blocks into the host KV tier",
+    "kv_promote": "dispatch of one request's host->device KV promotion copy ahead of its prefill",
+    "kv_promoted": "instant: a request's promoted blocks landed in the device pool; its deferred prefill proceeds",
     # ------------------------------------------------------------- engine loop / supervisor
     "engine_failure": "instant: engine.step() raised; the loop is entering DEGRADED",
     "engine_degraded": "one DEGRADED window: triage -> backoff -> rebuild -> requeue",

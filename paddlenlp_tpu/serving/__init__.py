@@ -9,6 +9,7 @@ jax-backed engine lazily at construction time.
 from . import router  # noqa: F401  (multi-replica front tier; stdlib-only)
 from .api import ServingServer  # noqa: F401
 from .brownout import BrownoutController, BrownoutPolicy, PRIORITIES  # noqa: F401
+from .chat import ChatTemplate  # noqa: F401
 from .engine_loop import (  # noqa: F401
     EngineLoop,
     RequestHandle,
@@ -29,6 +30,7 @@ from .scheduler import (  # noqa: F401
 __all__ = [
     "router",
     "ServingServer",
+    "ChatTemplate",
     "EngineLoop",
     "RequestHandle",
     "ServingMetrics",

@@ -14,6 +14,12 @@ Routes::
                             "priority": "interactive"|"batch"|"best_effort",
                             "deadline_ms": float, "tenant": str,
                             "adapter_id": str}
+    POST /v1/chat/completions
+                           {"messages": [{"role", "content"}, ...],
+                            "conversation"?: str, ... same fields} — prefix-
+                           stable chat rendering over the same pipeline; the
+                            optional conversation key is the router's sticky-
+                            affinity hint
     POST /v1/abort         {"id": "cmpl-N"}        — cancel an in-flight request
     GET  /metrics          Prometheus text exposition
     GET  /health           liveness + scheduler/engine stats + tracer clock
@@ -65,6 +71,7 @@ from ..observability.postmortem import handle_postmortem_request
 from ..observability.tracer import TRACEPARENT_HEADER, TRACER, parse_traceparent, use_trace
 from ..utils.faults import FaultPoint
 from ..utils.log import logger
+from .chat import ChatTemplate
 from .engine_loop import CANARY_PROMPT_IDS, EngineLoop, RequestHandle, ServingMetrics, SupervisorPolicy
 from .httputil import JsonRequestHandler
 from .metrics import REGISTRY, MetricsRegistry
@@ -135,8 +142,12 @@ class ServingServer:
                  supervisor_policy: Optional[SupervisorPolicy] = None,
                  trace_sample_every: Optional[int] = None,
                  tenant_quotas: Optional[TenantQuotas] = None,
-                 usage_meter=None):
+                 usage_meter=None,
+                 chat_template: Optional[ChatTemplate] = None):
         self.engine = engine
+        # /v1/chat/completions rendering (prefix-stable by construction so
+        # multi-turn conversations ride the hierarchical prefix cache)
+        self.chat_template = chat_template or ChatTemplate()
         self.tokenizer = tokenizer if tokenizer is not None else getattr(engine, "tokenizer", None)
         self.registry = registry or REGISTRY
         self.tracer = TRACER
@@ -192,7 +203,8 @@ class ServingServer:
             ids = ids[-self.max_src_tokens:]
         return ids
 
-    def submit(self, payload: dict, traceparent: Optional[str] = None):
+    def submit(self, payload: dict, traceparent: Optional[str] = None,
+               cid_prefix: str = "cmpl"):
         """Parse + admit one completion request. Returns (completion_id, handle).
 
         ``traceparent`` is the raw inbound propagation header (if any): the
@@ -254,11 +266,42 @@ class ServingServer:
                                        max_retries=max_retries, trace=trace_id,
                                        priority=priority, deadline_s=deadline_s,
                                        tenant=tenant, adapter_id=adapter_id)
-        cid = f"cmpl-{next(self._ids)}"
+        cid = f"{cid_prefix}-{next(self._ids)}"
         with self._live_lock:
             self._live[cid] = handle
         handle.add_done_callback(lambda _h: self._forget(cid))
         return cid, handle
+
+    def submit_chat(self, payload: dict, traceparent: Optional[str] = None):
+        """Parse + admit one chat-completion request (POST
+        /v1/chat/completions): render the conversation to token ids with the
+        prefix-stable :class:`ChatTemplate`, then feed the ordinary
+        completion pipeline — every downstream field (stream, priority,
+        deadline, tenant, adapter_id, timeout) means exactly what it does on
+        /v1/completions. ``conversation`` is an optional opaque sticky-
+        routing key: the router pins a conversation's turns to one replica so
+        its cached (device- or host-tier) KV keeps being re-used; the replica
+        itself does not interpret it."""
+        if "messages" not in payload:
+            raise ValueError("missing required field 'messages'")
+        if "prompt" in payload:
+            raise ValueError("chat completions take 'messages', not 'prompt'")
+        conversation = payload.get("conversation")
+        if conversation is not None and not isinstance(conversation, str):
+            raise ValueError("conversation must be a string key")
+
+        def encode(text: str):
+            if self.tokenizer is None:
+                raise ValueError("string message content needs a tokenizer; "
+                                 "pass token-id lists instead")
+            ids = self.tokenizer.encode(text)
+            return getattr(ids, "ids", ids)
+
+        ids = self.chat_template.render(payload["messages"], encode)
+        body = {k: v for k, v in payload.items()
+                if k not in ("messages", "conversation")}
+        body["prompt"] = ids
+        return self.submit(body, traceparent=traceparent, cid_prefix="chatcmpl")
 
     def _forget(self, cid: str):
         with self._live_lock:
@@ -575,6 +618,10 @@ class ServingServer:
                         payload = self._read_body()
                         if payload is not None:
                             self._completions(payload)
+                    elif self.path == "/v1/chat/completions":
+                        payload = self._read_body()
+                        if payload is not None:
+                            self._completions(payload, chat=True)
                     elif self.path == "/v1/abort":
                         payload = self._read_body()
                         if payload is not None:
@@ -646,9 +693,10 @@ class ServingServer:
                     except (BrokenPipeError, ConnectionResetError):
                         pass
 
-            def _completions(self, payload: dict):
+            def _completions(self, payload: dict, chat: bool = False):
                 try:
-                    cid, handle = server.submit(
+                    submit = server.submit_chat if chat else server.submit
+                    cid, handle = submit(
                         payload, traceparent=self.headers.get(TRACEPARENT_HEADER))
                 except SaturatedError as e:
                     # Retry-After from the live queue-wait estimate: the hint
@@ -693,11 +741,11 @@ class ServingServer:
                 # request carry it in JSON log mode (log <-> trace join key)
                 with use_trace(handle.trace):
                     if payload.get("stream"):
-                        self._stream_response(cid, handle)
+                        self._stream_response(cid, handle, chat=chat)
                     else:
-                        self._batch_response(cid, handle)
+                        self._batch_response(cid, handle, chat=chat)
 
-            def _batch_response(self, cid: str, handle):
+            def _batch_response(self, cid: str, handle, chat: bool = False):
                 try:
                     req = handle.result()  # deadline enforced by the loop
                 except UnknownAdapterError as e:
@@ -707,12 +755,23 @@ class ServingServer:
                     return
                 choice = {"index": 0, "finish_reason": req.finish_reason if req else "abort"}
                 toks = list(req.output_ids) if req is not None else []
-                choice["token_ids"] = toks
-                if server.tokenizer is not None:
-                    choice["text"] = server.tokenizer.decode(toks, skip_special_tokens=True)
+                text = (server.tokenizer.decode(toks, skip_special_tokens=True)
+                        if server.tokenizer is not None else None)
+                if chat:
+                    # chat shape: the completion is an assistant message whose
+                    # token_ids are what the NEXT turn should thread back as
+                    # assistant content for an exact prefix-cache replay
+                    message = {"role": "assistant", "token_ids": toks}
+                    if text is not None:
+                        message["content"] = text
+                    choice["message"] = message
+                else:
+                    choice["token_ids"] = toks
+                    if text is not None:
+                        choice["text"] = text
                 self._send_json(200, {
                     "id": cid,
-                    "object": "text_completion",
+                    "object": "chat.completion" if chat else "text_completion",
                     "choices": [choice],
                     "usage": {
                         "prompt_tokens": handle.prompt_len,
@@ -727,7 +786,7 @@ class ServingServer:
                     },
                 })
 
-            def _stream_response(self, cid: str, handle):
+            def _stream_response(self, cid: str, handle, chat: bool = False):
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
@@ -738,25 +797,40 @@ class ServingServer:
                     self.wfile.write(f"data: {json.dumps(obj)}\n\n".encode())
                     self.wfile.flush()
 
+                obj = "chat.completion.chunk" if chat else "text_completion.chunk"
                 toks, emitted = [], 0
                 try:
+                    if chat:
+                        # role preamble first, in the OpenAI chat-chunk shape
+                        chunk({"id": cid, "object": obj, "choices": [
+                            {"index": 0, "delta": {"role": "assistant"},
+                             "finish_reason": None}]})
                     for tok in handle.tokens():
                         toks.append(tok)
                         piece, emitted = server._decode_delta(toks, emitted)
-                        c = {"index": 0, "token": tok, "finish_reason": None}
-                        if piece is not None:
-                            c["text"] = piece
-                        chunk({"id": cid, "object": "text_completion.chunk", "choices": [c]})
+                        if chat:
+                            delta = {"token": tok}
+                            if piece is not None:
+                                delta["content"] = piece
+                            c = {"index": 0, "delta": delta, "finish_reason": None}
+                        else:
+                            c = {"index": 0, "token": tok, "finish_reason": None}
+                            if piece is not None:
+                                c["text"] = piece
+                        chunk({"id": cid, "object": obj, "choices": [c]})
                     req = handle.result()
                     final = {"index": 0,
                              "finish_reason": req.finish_reason if req else "abort"}
                     # flush any held-back partial-codepoint text
                     piece, emitted = server._decode_delta(toks, emitted, final=True)
-                    if piece:
+                    if chat:
+                        final["delta"] = {"content": piece} if piece else {}
+                    elif piece:
                         final["text"] = piece
-                    chunk({"id": cid, "object": "text_completion.chunk",
+                    chunk({"id": cid, "object": obj,
                            "choices": [final],
                            "usage": {"prompt_tokens": handle.prompt_len,
+                                     "cached_tokens": int(getattr(req, "cached_tokens", 0) or 0),
                                      "completion_tokens": len(toks),
                                      "total_tokens": handle.prompt_len + len(toks)}})
                     self.wfile.write(b"data: [DONE]\n\n")

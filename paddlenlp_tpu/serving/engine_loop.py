@@ -73,14 +73,15 @@ __all__ = ["EngineLoop", "RequestHandle", "ServingMetrics", "SupervisorPolicy",
            "CANARY_PROMPT_IDS"]
 
 #: the per-request latency-attribution phase vocabulary. Non-overlapping by
-#: construction: queue + admission_gate span arrival -> first admission,
-#: prefill spans admission -> first token, and the decode window
+#: construction: queue + admission_gate span arrival -> first admission, the
+#: admission -> first-token window splits into promote_wait (waiting on a
+#: host-tier KV promotion copy) + prefill remainder, and the decode window
 #: (first token -> finish) splits into chunk_stall + migration_wait + decode
 #: remainder — so the phases always sum to e2e exactly when the timeline is
-#: complete. The router adds a seventh phase, ``hedge_race``, to the same
+#: complete. The router adds an eighth phase, ``hedge_race``, to the same
 #: histogram family for its first-token races.
-ATTRIBUTION_PHASES = ("queue", "admission_gate", "prefill", "chunk_stall",
-                      "migration_wait", "decode")
+ATTRIBUTION_PHASES = ("queue", "admission_gate", "promote_wait", "prefill",
+                      "chunk_stall", "migration_wait", "decode")
 
 
 def request_attribution(req) -> Optional[Dict[str, float]]:
@@ -107,7 +108,16 @@ def request_attribution(req) -> Optional[Dict[str, float]]:
         out["queue"] = max(end_queue - arrival, 0.0)
     if sched is not None:
         end_prefill = first if first is not None else finish
-        out["prefill"] = max(end_prefill - sched, 0.0)
+        prefill_raw = max(end_prefill - sched, 0.0)
+        promote = max(getattr(req, "promote_wait_s", 0.0), 0.0)
+        open_promote = getattr(req, "promote_start_t", None)
+        if open_promote is not None:
+            # finished (abort/quarantine) with the promotion copy still in
+            # flight: the open episode ends at the prefill window's end
+            promote += max(end_prefill - open_promote, 0.0)
+        promote = min(promote, prefill_raw)
+        out["promote_wait"] = promote
+        out["prefill"] = prefill_raw - promote
     if first is not None:
         decode_raw = max(finish - first, 0.0)
         stall = min(max(getattr(req, "chunk_stall_s", 0.0), 0.0), decode_raw)
@@ -406,8 +416,8 @@ class ServingMetrics:
         self.latency_attribution = r.histogram(
             "paddlenlp_serving_latency_attribution_seconds",
             "Per-request e2e latency decomposed by phase (queue/"
-            "admission_gate/prefill/chunk_stall/migration_wait/decode on "
-            "replicas; hedge_race on the router) — phases sum to e2e",
+            "admission_gate/promote_wait/prefill/chunk_stall/migration_wait/"
+            "decode on replicas; hedge_race on the router) — phases sum to e2e",
             labelnames=("phase",))
         self.ttft = r.histogram(
             "paddlenlp_serving_ttft_seconds", "Time from arrival to first token")
@@ -474,6 +484,21 @@ class ServingMetrics:
         self.kv_migration_inflight = r.gauge(
             "paddlenlp_serving_kv_migration_inflight",
             "Prefill->decode block migrations currently in flight")
+        # hierarchical KV: the host spill tier under the prefix cache
+        self.kv_host_blocks = r.gauge(
+            "paddlenlp_serving_kv_host_blocks",
+            "Prefix-cache KV blocks currently resident in the host spill tier")
+        self.kv_host_spills = r.counter(
+            "paddlenlp_serving_kv_host_spills_total",
+            "LRU-evicted prefix-cache blocks demoted device->host (batched D2H)")
+        self.kv_host_promotes = r.counter(
+            "paddlenlp_serving_kv_host_promotes_total",
+            "Host-tier blocks promoted host->device ahead of a prefix-matched "
+            "request's prefill")
+        self.kv_host_promote_bytes = r.counter(
+            "paddlenlp_serving_kv_host_promote_bytes_total",
+            "Bytes of KV copied host->device by promotions (the promotion-"
+            "bandwidth series)")
         self.mesh_devices = r.gauge(
             "paddlenlp_serving_mesh_devices",
             "Devices this replica's engine backend spans (1 = single-chip)")
@@ -608,6 +633,14 @@ class ServingMetrics:
             "cached_tokens": getattr(mgr, "cached_tokens_total", 0),
             "evictions": getattr(mgr, "evictions", 0),
         }
+        # host-tier residency is a pull gauge off the tier itself; the spill/
+        # promote counters are deltas off its monotone stats, rebaselined here
+        # (engine reset keeps the tier instance, so totals usually carry over)
+        tier = getattr(engine, "_host_tier", None)
+        self.kv_host_blocks.set_function(
+            lambda: tier.num_blocks if tier is not None else 0)
+        self._host_last = dict(tier.stats) if tier is not None else \
+            {"spills": 0, "promoted_blocks": 0, "promote_bytes": 0}
         self._engine = engine
         self._chunk_last = dict(getattr(engine, "chunk_stats", {"chunks": 0}))
         # migration counters are deltas off the backend's monotone totals; a
@@ -656,6 +689,15 @@ class ServingMetrics:
                 if delta > 0:
                     counter.inc(delta)
                 self._pc_last[key] = pc.get(key, 0)
+            host = pc.get("host")
+            if host and host.get("enabled"):
+                for key, counter in (("spills", self.kv_host_spills),
+                                     ("promoted_blocks", self.kv_host_promotes),
+                                     ("promote_bytes", self.kv_host_promote_bytes)):
+                    delta = host.get(key, 0) - self._host_last.get(key, 0)
+                    if delta > 0:
+                        counter.inc(delta)
+                    self._host_last[key] = host.get(key, 0)
         cp = stats.get("chunked_prefill")
         if cp:
             delta = cp.get("chunks", 0) - self._chunk_last.get("chunks", 0)
@@ -1676,6 +1718,14 @@ class EngineLoop:
                 if open_t is not None:
                     mig_wait += max(now - open_t, 0.0)
                 info["migration_wait_s"] = mig_wait
+                # host-tier visibility: how long the request has waited on its
+                # H2D promotion copy so far (kv_stage == "promoting" while the
+                # copy is in flight) — a stuck promotion is visible LIVE too
+                promote_wait = getattr(req, "promote_wait_s", 0.0)
+                open_t = getattr(req, "promote_start_t", None)
+                if open_t is not None:
+                    promote_wait += max(now - open_t, 0.0)
+                info["promote_wait_s"] = promote_wait
                 info["usage_so_far"] = self._usage_so_far(req, handle)
             out.append(info)
         return out

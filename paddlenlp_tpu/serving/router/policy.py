@@ -65,7 +65,8 @@ class LeastLoadedPolicy:
 
     def select(self, snapshots: Sequence[ReplicaSnapshot], prompt: Prompt = None,
                exclude: FrozenSet[str] = frozenset(),
-               adapter_id: Optional[str] = None) -> List[ReplicaSnapshot]:
+               adapter_id: Optional[str] = None,
+               conversation: Optional[str] = None) -> List[ReplicaSnapshot]:
         return sorted(_eligible(snapshots, exclude),
                       key=lambda s: (_STATE_RANK.get(s.state, 3), load_score(s), s.id))
 
@@ -136,7 +137,15 @@ class PrefixAffinityPolicy:
     cache — keyed ``(adapter_id, tokens)`` — stays coherent per adapter).
     The same weighted spill bounds a hot adapter pin, and the ring walk is
     the agreed failover/spill order, so a melting pin co-locates the adapter
-    on exactly one more replica."""
+    on exactly one more replica.
+
+    **Conversation affinity.** A ``/v1/chat/completions`` request carrying a
+    ``conversation`` key hashes on ``c:<conversation>`` — the strongest
+    affinity signal, outranking adapter and prompt-prefix keys. Every turn of
+    a conversation lands on the replica whose hierarchical prefix cache holds
+    the previous turns' prompt AND completion KV (device or host tier), so
+    turn N+1 re-prefills only its new user message even across HBM cache
+    pressure. The ring walk and weighted spill apply unchanged."""
 
     name = "prefix_affinity"
 
@@ -153,8 +162,10 @@ class PrefixAffinityPolicy:
         self._ring_ids: Optional[Tuple[str, ...]] = None
         self._fallback = LeastLoadedPolicy()
 
-    def prefix_key(self, prompt: Prompt,
-                   adapter_id: Optional[str] = None) -> Optional[str]:
+    def prefix_key(self, prompt: Prompt, adapter_id: Optional[str] = None,
+                   conversation: Optional[str] = None) -> Optional[str]:
+        if conversation:
+            return "c:" + conversation
         if adapter_id:
             return "a:" + adapter_id
         if prompt is None:
@@ -175,8 +186,9 @@ class PrefixAffinityPolicy:
 
     def select(self, snapshots: Sequence[ReplicaSnapshot], prompt: Prompt = None,
                exclude: FrozenSet[str] = frozenset(),
-               adapter_id: Optional[str] = None) -> List[ReplicaSnapshot]:
-        key = self.prefix_key(prompt, adapter_id)
+               adapter_id: Optional[str] = None,
+               conversation: Optional[str] = None) -> List[ReplicaSnapshot]:
+        key = self.prefix_key(prompt, adapter_id, conversation)
         if key is None:
             return self._fallback.select(snapshots, prompt, exclude)
         # ring membership is computed over ALL replicas (not just eligible

@@ -301,11 +301,18 @@ class _RelayState:
 
     __slots__ = ("rid", "stream", "headers_sent", "tokens_relayed", "arrival_t",
                  "attempts", "finished", "sampled", "replica_id", "upstream_conn",
-                 "upstream_resp", "upstream_cid", "weights_version")
+                 "upstream_resp", "upstream_cid", "weights_version",
+                 "upstream_path")
 
-    def __init__(self, rid: str, stream: bool, sampled: bool = True):
+    def __init__(self, rid: str, stream: bool, sampled: bool = True,
+                 upstream_path: str = "/v1/completions"):
         self.rid = rid
         self.stream = stream
+        # which replica endpoint every forward attempt of this request hits
+        # (/v1/completions, or /v1/chat/completions for chat requests — the
+        # replica re-renders the conversation itself, so failover resubmits
+        # the original chat body unchanged)
+        self.upstream_path = upstream_path
         self.headers_sent = False
         self.tokens_relayed = 0
         self.arrival_t = time.perf_counter()  # original timing anchor
@@ -417,24 +424,30 @@ class RouterServer:
 
     # ------------------------------------------------------------- routing
     def _candidates(self, prompt, exclude: set, state: _RelayState,
-                    adapter_id: Optional[str] = None) -> List[ReplicaSnapshot]:
+                    adapter_id: Optional[str] = None,
+                    conversation: Optional[str] = None) -> List[ReplicaSnapshot]:
         """One routing decision: snapshot the pool, let the policy order it.
         Re-run per attempt so health transitions observed mid-request (a
         candidate marked DOWN by the poller) are honored immediately.
-        ``adapter_id`` feeds adapter affinity (forwarded only when present,
-        and dropped for policies predating the kwarg)."""
+        ``adapter_id`` feeds adapter affinity and ``conversation`` feeds
+        conversation stickiness (forwarded only when present, and dropped
+        for policies predating the kwargs)."""
         t0 = time.perf_counter()
         with self.tracer.span("route", cat="router", trace=state.rid,
                               attempt=state.attempts, excluded=len(exclude)) as sp:
             snaps = self._adjusted_snapshots()
-            kw = {"adapter_id": adapter_id} if adapter_id is not None else {}
+            kw = {}
+            if adapter_id is not None:
+                kw["adapter_id"] = adapter_id
+            if conversation is not None:
+                kw["conversation"] = conversation
             try:
                 candidates = self.policy.select(snaps, prompt=prompt,
                                                 exclude=frozenset(exclude), **kw)
             except TypeError:
                 if not kw:
                     raise
-                # custom policy without adapter affinity: route on prompt only
+                # custom policy without the affinity kwargs: route on prompt only
                 candidates = self.policy.select(snaps, prompt=prompt,
                                                 exclude=frozenset(exclude))
             sp.set(candidates=[c.id for c in candidates[:4]])
@@ -650,6 +663,10 @@ class RouterServer:
                         payload = self._read_body()
                         if payload is not None:
                             router._handle_completion(self, payload)
+                    elif self.path == "/v1/chat/completions":
+                        payload = self._read_body()
+                        if payload is not None:
+                            router._handle_completion(self, payload, chat=True)
                     elif self.path == "/v1/abort":
                         payload = self._read_body()
                         if payload is not None:
@@ -1489,7 +1506,7 @@ class RouterServer:
         return merged
 
     # ------------------------------------------------------------- forwarding
-    def _handle_completion(self, handler, payload: dict):
+    def _handle_completion(self, handler, payload: dict, chat: bool = False):
         rid = f"rtr-{next(self._ids)}"
         # the head-based sampling decision: made once here, pinned on the
         # router's tracer, and propagated to the replica in the traceparent
@@ -1497,22 +1514,35 @@ class RouterServer:
         sampled = trace_sampled(rid, self.trace_sample_every)
         if self.trace_sample_every > 1:
             self.tracer.mark_trace(rid, sampled)
-        state = _RelayState(rid, bool(payload.get("stream")), sampled=sampled)
+        state = _RelayState(rid, bool(payload.get("stream")), sampled=sampled,
+                            upstream_path="/v1/chat/completions" if chat
+                            else "/v1/completions")
         prompt = payload.get("prompt")
+        if chat and prompt is None:
+            # chat has no top-level prompt; the first message's content is the
+            # shared conversation head — exactly the span prefix affinity
+            # should co-locate when no conversation key pins harder
+            msgs = payload.get("messages")
+            if isinstance(msgs, list) and msgs and isinstance(msgs[0], dict):
+                prompt = msgs[0].get("content")
         adapter_id = payload.get("adapter_id")
         adapter_id = str(adapter_id) if adapter_id is not None else None
+        conversation = payload.get("conversation")
+        conversation = str(conversation) if conversation is not None else None
         body = json.dumps(payload).encode()
         exclude: set = set()
 
         with use_trace(rid):
             self._relay_attempts(handler, state, payload, prompt, body, exclude,
-                                 adapter_id=adapter_id)
+                                 adapter_id=adapter_id, conversation=conversation)
 
     def _relay_attempts(self, handler, state: _RelayState, payload: dict,
                         prompt, body: bytes, exclude: set,
-                        adapter_id: Optional[str] = None):
+                        adapter_id: Optional[str] = None,
+                        conversation: Optional[str] = None):
         while state.attempts < self.max_attempts:
-            candidates = self._candidates(prompt, exclude, state, adapter_id)
+            candidates = self._candidates(prompt, exclude, state, adapter_id,
+                                          conversation)
             if not candidates:
                 break
             cand = candidates[0]
@@ -1667,7 +1697,7 @@ class RouterServer:
         try:
             try:
                 _F_FORWARD.fire(replica=cand.id)
-                conn.request("POST", "/v1/completions", body=body,
+                conn.request("POST", state.upstream_path, body=body,
                              headers=self._forward_headers(state))
                 resp = conn.getresponse()
                 state.upstream_resp = resp
@@ -1716,7 +1746,7 @@ class RouterServer:
         try:
             try:
                 _F_FORWARD.fire(replica=cand.id)
-                conn.request("POST", "/v1/completions", body=body,
+                conn.request("POST", state.upstream_path, body=body,
                              headers=self._forward_headers(state))
                 resp = conn.getresponse()
                 state.upstream_resp = resp
@@ -1865,7 +1895,7 @@ class RouterServer:
             try:
                 try:
                     _F_FORWARD.fire(replica=snap.id)
-                    conn.request("POST", "/v1/completions", body=body,
+                    conn.request("POST", state.upstream_path, body=body,
                                  headers=self._forward_headers(state))
                     resp = conn.getresponse()
                     resps[leg] = resp
@@ -2068,7 +2098,7 @@ class RouterServer:
             try:
                 try:
                     _F_FORWARD.fire(replica=snap.id)
-                    conn.request("POST", "/v1/completions", body=body,
+                    conn.request("POST", state.upstream_path, body=body,
                                  headers=self._forward_headers(state))
                     resp = conn.getresponse()
                     resps[leg] = resp

@@ -136,6 +136,18 @@ CATALOG: Dict[str, str] = {
                       "roll already-swapped replicas back to the old version, "
                       "undrain everything and leave the fleet serving on the old "
                       "weights with zero client-visible errors.",
+    "engine.kv_spill": "Inside the engine's spill drain, before the batched D2H "
+                       "gather of LRU-evicted prefix blocks into the host KV tier — "
+                       "a failure here must simply drop the spill (the blocks were "
+                       "already recycled; pre-tier behavior) with no host- or "
+                       "device-tier entry leaked and every live stream unaffected.",
+    "engine.kv_promote": "Immediately before the engine dispatches a host→device "
+                         "KV promotion for an admitted request whose prefix "
+                         "matched host-tier blocks — a failure here must fall "
+                         "back token-exactly to a cold re-prefill of the promoted "
+                         "span (the request keeps its allocated blocks, prefill "
+                         "recomputes them), with zero stream loss and no host- or "
+                         "device-tier block leak.",
 }
 
 

@@ -348,6 +348,10 @@ class TestEnginePrefixCache:
         assert st["enabled"] and st["hits"] >= 3
         assert st["cached_tokens"] >= 16 and st["cached_blocks"] >= 4
         st_off = eng_off.stats()["prefix_cache"]
+        # the host-tier sub-dict is ALWAYS present (zeros when no tier is
+        # attached) so the metrics plane reads one shape
+        host_off = st_off.pop("host")
+        assert host_off["enabled"] is False and host_off["blocks"] == 0
         assert st_off == {"enabled": False, "hits": 0, "cached_tokens": 0,
                           "evictions": 0, "cached_blocks": 0}
 

@@ -1,0 +1,273 @@
+"""Conversation-lifetime serving: /v1/chat/completions over the hierarchical
+prefix cache.
+
+- **prefix stability** (the invariant the whole feature rests on): turn N+1's
+  rendered prompt begins with turn N's rendered prompt + its completion ids,
+  by construction of :class:`ChatTemplate`;
+- **multi-turn cache reuse over HTTP**: turn 2's ``usage.cached_tokens``
+  covers turn 1's prompt AND completion (the engine registers generated
+  blocks on finish), and the reply is token-exact against a fresh engine fed
+  the same rendered ids;
+- **SSE chat-chunk shapes**: role preamble first, per-token ``delta`` chunks,
+  a final chunk carrying ``usage`` (with ``cached_tokens``), ``[DONE]``;
+- **validation**: malformed conversations answer 400, never a 500 or a hang;
+- **router conversation affinity**: a ``conversation`` key outranks adapter
+  and prompt-prefix keys and pins every turn — whatever the prompt — to the
+  same replica, deterministically.
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from paddlenlp_tpu.experimental import InferenceEngine, SamplingParams
+from paddlenlp_tpu.serving import (
+    ChatTemplate,
+    MetricsRegistry,
+    SchedulerConfig,
+    ServingServer,
+)
+from paddlenlp_tpu.serving.router import HEALTHY, PrefixAffinityPolicy, ReplicaSnapshot
+from paddlenlp_tpu.transformers import LlamaConfig, LlamaForCausalLM
+
+CFG = dict(vocab_size=96, hidden_size=64, intermediate_size=112,
+           num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+           max_position_embeddings=256, eos_token_id=None, pad_token_id=0,
+           use_scan_layers=True)
+ENG_KW = dict(max_batch_size=4, block_size=4, num_blocks=64,
+              max_blocks_per_seq=32, decode_steps=4,
+              enable_prefix_cache=True, host_kv_blocks=64)
+TPL = ChatTemplate()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig(**CFG)
+
+
+@pytest.fixture(scope="module")
+def server(cfg):
+    registry = MetricsRegistry()
+    srv = ServingServer(
+        InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0), **ENG_KW),
+        registry=registry,
+        scheduler_config=SchedulerConfig(max_inflight=8, default_timeout_s=600.0))
+    port = srv.start_in_thread()
+    yield srv, port
+    srv.shutdown(drain_timeout_s=5)
+
+
+@pytest.fixture(scope="module")
+def solo(cfg):
+    """Reference engine on the same weights: chat replies must be token-exact
+    against generating from the rendered ids directly."""
+    return InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0), **{
+        **ENG_KW, "enable_prefix_cache": False, "host_kv_blocks": 0})
+
+
+def post_json(port, path, payload, timeout=600):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def stream_chat(port, payload, timeout=600):
+    """Returns (status, [raw chunk dicts], saw_done)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/chat/completions",
+                     body=json.dumps({**payload, "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return resp.status, [json.loads(resp.read() or b"{}")], False
+        events, done = [], False
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            data = line[len(b"data: "):]
+            if data == b"[DONE]":
+                done = True
+                break
+            events.append(json.loads(data))
+        return resp.status, events, done
+    finally:
+        conn.close()
+
+
+class TestChatTemplate:
+    def test_render_shape_and_generation_marker(self):
+        ids = TPL.render([{"role": "user", "content": [10, 11, 12]}],
+                         encode=None)
+        assert ids == [TPL.user_token_id, 10, 11, 12, TPL.sep_token_id,
+                       TPL.assistant_token_id]
+
+    def test_prefix_stability_across_turns(self):
+        """The invariant: render(turn N+1) starts with render(turn N) +
+        completion ids — checked over a 3-turn conversation with a system
+        message."""
+        msgs = [{"role": "system", "content": [30, 31]},
+                {"role": "user", "content": [10, 11, 12]}]
+        r1 = TPL.render(msgs, encode=None)
+        completion1 = [40, 41, 42]
+        msgs2 = msgs + [{"role": "assistant", "content": completion1},
+                        {"role": "user", "content": [13, 14]}]
+        r2 = TPL.render(msgs2, encode=None)
+        assert r2[:len(r1) + len(completion1)] == r1 + completion1
+        completion2 = [43, 44]
+        msgs3 = msgs2 + [{"role": "assistant", "content": completion2},
+                         {"role": "user", "content": [15]}]
+        r3 = TPL.render(msgs3, encode=None)
+        assert r3[:len(r2) + len(completion2)] == r2 + completion2
+
+    def test_validation_errors(self):
+        enc = None
+        with pytest.raises(ValueError, match="non-empty"):
+            TPL.render([], enc)
+        with pytest.raises(ValueError, match="role"):
+            TPL.render([{"role": "bot", "content": [5]}], enc)
+        with pytest.raises(ValueError, match="system message"):
+            TPL.render([{"role": "user", "content": [5]},
+                        {"role": "system", "content": [6]}], enc)
+        with pytest.raises(ValueError, match="empty"):
+            TPL.render([{"role": "user", "content": []}], enc)
+        with pytest.raises(ValueError, match="assistant"):
+            TPL.render([{"role": "user", "content": [5]},
+                        {"role": "assistant", "content": [6]}], enc)
+        with pytest.raises(ValueError, match="tokenizer"):
+            TPL.render([{"role": "user", "content": "hello"}],
+                       lambda s: (_ for _ in ()).throw(
+                           ValueError("string message content needs a tokenizer")))
+
+
+class TestMultiTurnOverHttp:
+    def test_turn2_cached_tokens_cover_prompt_and_completion(self, server, solo):
+        _srv, port = server
+        user1 = list(range(10, 26))  # 16 tokens
+        msgs = [{"role": "user", "content": user1}]
+        status, t1 = post_json(port, "/v1/chat/completions",
+                               {"messages": msgs, "max_tokens": 8})
+        assert status == 200, t1
+        assert t1["object"] == "chat.completion"
+        msg = t1["choices"][0]["message"]
+        assert msg["role"] == "assistant" and len(msg["token_ids"]) == 8
+        assert t1["id"].startswith("chatcmpl-")
+        assert t1["usage"]["cached_tokens"] == 0
+
+        # token identity turn 1: the server generated from render(msgs)
+        r1 = TPL.render(msgs, encode=None)
+        want1 = solo.generate([r1], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(msg["token_ids"], want1)
+
+        # turn 2 threads the completion back as assistant token ids
+        msgs2 = msgs + [{"role": "assistant", "content": msg["token_ids"]},
+                        {"role": "user", "content": [30, 31, 32]}]
+        status, t2 = post_json(port, "/v1/chat/completions",
+                               {"messages": msgs2, "max_tokens": 8})
+        assert status == 200, t2
+        # turn-1 render (19 ids) + completion (8) = 27 shared ids -> every
+        # full block of BOTH is served from cache: strictly more than the
+        # turn-1 prompt alone could explain
+        shared = len(r1) + 8
+        assert t2["usage"]["cached_tokens"] >= shared // 4 * 4 > len(r1), \
+            t2["usage"]
+        r2 = TPL.render(msgs2, encode=None)
+        want2 = solo.generate([r2], SamplingParams(max_new_tokens=8))[0]
+        np.testing.assert_array_equal(t2["choices"][0]["message"]["token_ids"],
+                                      want2)
+
+    def test_sse_chat_chunk_shapes(self, server, solo):
+        _srv, port = server
+        msgs = [{"role": "user", "content": [50, 51, 52, 53]}]
+        status, events, done = stream_chat(
+            port, {"messages": msgs, "max_tokens": 6,
+                   "conversation": "sse-shape"})
+        assert status == 200 and done
+        assert all(ev["object"] == "chat.completion.chunk" for ev in events)
+        # role preamble first, no token on it
+        first = events[0]["choices"][0]
+        assert first["delta"] == {"role": "assistant"}
+        assert first["finish_reason"] is None
+        toks = [ev["choices"][0]["delta"]["token"] for ev in events[1:-1]]
+        assert len(toks) == 6
+        final = events[-1]
+        assert final["choices"][0]["finish_reason"] == "length"
+        usage = final["usage"]
+        assert set(usage) == {"prompt_tokens", "cached_tokens",
+                              "completion_tokens", "total_tokens"}
+        assert usage["completion_tokens"] == 6
+        assert usage["prompt_tokens"] == len(TPL.render(msgs, encode=None))
+        want = solo.generate([TPL.render(msgs, encode=None)],
+                             SamplingParams(max_new_tokens=6))[0]
+        np.testing.assert_array_equal(toks, want)
+
+    def test_validation_is_400_over_http(self, server):
+        _srv, port = server
+        cases = [
+            {"max_tokens": 4},  # no messages
+            {"messages": [{"role": "user", "content": [5]}],
+             "prompt": [5, 6]},  # both surfaces
+            {"messages": []},
+            {"messages": [{"role": "bot", "content": [5]}]},
+            {"messages": [{"role": "user", "content": []}]},
+            {"messages": [{"role": "user", "content": [5]}],
+             "conversation": 7},  # non-string key
+            {"messages": [{"role": "user", "content": "hi"}]},  # no tokenizer
+        ]
+        for payload in cases:
+            status, body = post_json(port, "/v1/chat/completions",
+                                     {**payload, "max_tokens": 4})
+            assert status == 400, (payload, body)
+            assert body["error"]["type"] == "invalid_request", body
+
+
+def snap(rid, state=HEALTHY, inflight=0):
+    return ReplicaSnapshot(id=rid, host="127.0.0.1", port=0, state=state,
+                           inflight=inflight, queue_depth=0, kv_utilization=0.0,
+                           retry_after_s=None, consecutive_failures=0,
+                           last_poll_t=None)
+
+
+class TestConversationAffinity:
+    def test_key_precedence(self):
+        pol = PrefixAffinityPolicy()
+        assert pol.prefix_key([1, 2, 3]) == "t:1,2,3"
+        assert pol.prefix_key([1, 2, 3], adapter_id="fr") == "a:fr"
+        assert pol.prefix_key([1, 2, 3], adapter_id="fr",
+                              conversation="conv-9") == "c:conv-9"
+        assert pol.prefix_key(None, conversation="conv-9") == "c:conv-9"
+
+    def test_conversation_sticks_across_changing_prompts(self):
+        """Every turn of one conversation — the prompt GROWS each turn — pins
+        to the same replica; distinct conversations spread over the ring."""
+        pol = PrefixAffinityPolicy()
+        replicas = [snap(f"r{i}") for i in range(4)]
+        prompt = list(range(10, 30))
+        picks = set()
+        for turn in range(5):
+            prompt = prompt + [40 + turn] * 8  # turn-over-turn growth
+            order = pol.select(replicas, prompt=prompt, conversation="conv-a")
+            picks.add(order[0].id)
+        assert len(picks) == 1
+        spread = {pol.select(replicas, prompt=prompt,
+                             conversation=f"conv-{i}")[0].id
+                  for i in range(16)}
+        assert len(spread) > 1
+
+    def test_no_conversation_falls_back_to_prefix(self):
+        pol = PrefixAffinityPolicy()
+        replicas = [snap(f"r{i}") for i in range(4)]
+        a = pol.select(replicas, prompt=[1, 2, 3, 4])
+        b = pol.select(replicas, prompt=[1, 2, 3, 4], conversation=None)
+        assert a[0].id == b[0].id

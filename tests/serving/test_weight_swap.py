@@ -261,6 +261,62 @@ class TestSwapFinishOld:
         assert_no_kv_leak(srv)
 
 
+class TestHostTierAcrossSwap:
+    def test_swap_invalidates_host_tier(self, cfg, ckpts, solo_new):
+        """The hierarchical cache's second level obeys the same epoch: KV
+        spilled to host RAM before a weight swap must never promote into
+        post-swap traffic (it holds OLD-weights activations)."""
+        # a replica with a SMALL device pool over a host tier, so churn
+        # demotes the prompt's blocks to host RAM instead of destroying them
+        srv = ServingServer(
+            InferenceEngine(LlamaForCausalLM.from_config(cfg, seed=0),
+                            max_batch_size=4, block_size=4, num_blocks=15,
+                            max_blocks_per_seq=16, decode_steps=4,
+                            enable_prefix_cache=True, host_kv_blocks=64),
+            registry=MetricsRegistry(),
+            scheduler_config=SchedulerConfig(max_inflight=8,
+                                             default_timeout_s=600.0))
+        port = srv.start_in_thread()
+        try:
+            prompt = list(range(30, 46))  # 4 full blocks
+            status, _ = post_json(port, "/v1/completions",
+                                  {"prompt": prompt, "max_tokens": 8})
+            assert status == 200
+            status, _ = post_json(port, "/v1/completions",
+                                  {"prompt": [40 + i % 50 for i in range(52)],
+                                   "max_tokens": 4})
+            assert status == 200
+            eng = srv.loop.engine
+            assert eng._host_tier.num_blocks > 0, "churn never spilled"
+            # the tier is LIVE pre-swap: the repeat promotes from host RAM
+            status, b = post_json(port, "/v1/completions",
+                                  {"prompt": prompt, "max_tokens": 8})
+            assert status == 200 and b["usage"]["cached_tokens"] > 0
+            assert eng._host_tier.stats["promoted_blocks"] > 0
+            assert eng._host_tier.num_blocks > 0  # churn's own spilled blocks
+
+            status, doc = post_json(port, "/admin/weights",
+                                    {"ckpt_dir": str(ckpts / "v1")})
+            assert status == 200 and doc["ok"] is True, doc
+            # the swap emptied BOTH cache levels...
+            assert eng._host_tier.num_blocks == 0
+            promotes0 = eng._host_tier.stats["promotes"]
+            status, c = post_json(port, "/v1/completions",
+                                  {"prompt": prompt, "max_tokens": 8})
+            assert status == 200
+            # ...so the post-swap repeat prefills cold (no device hit, no
+            # host promote) and is token-exact against fresh new weights
+            assert c["usage"]["cached_tokens"] == 0, \
+                "stale pre-swap KV served a post-swap request"
+            assert eng._host_tier.stats["promotes"] == promotes0
+            want = solo_new.generate([prompt],
+                                     SamplingParams(max_new_tokens=8))[0]
+            np.testing.assert_array_equal(c["choices"][0]["token_ids"], want)
+            assert_no_kv_leak(srv)
+        finally:
+            srv.shutdown(drain_timeout_s=5)
+
+
 class TestCacheEpochAcrossSwap:
     def test_pre_swap_prefix_blocks_never_serve_post_swap(
             self, server, ckpts, solo_new):

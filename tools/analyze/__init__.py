@@ -78,10 +78,16 @@ DEFAULT_CONFIG: Dict = {
             "InferenceEngine._mixed_step", "InferenceEngine._decode_running",
             "InferenceEngine._decode_spec", "InferenceEngine._settle_sampled",
             "InferenceEngine._advance_migrations",
+            "InferenceEngine._advance_promotions",
+            "InferenceEngine._drain_spills",
             "InferenceEngine._emit", "InferenceEngine._free_kv",
             "InferenceEngine._preempt",
         ],
+        "paddlenlp_tpu/experimental/kv_host_tier.py": [
+            "HostKVTier.put", "HostKVTier.take", "_SpillBatch.settle",
+        ],
         "paddlenlp_tpu/experimental/backend.py": [
+            "ModelBackend.migration_ready", "ModelBackend.kv_writeback",
             "SingleDeviceBackend.prefill", "SingleDeviceBackend.decode",
             "SingleDeviceBackend.verify", "SingleDeviceBackend.mixed_step",
             "SingleDeviceBackend.mixed_step_begin",
@@ -89,6 +95,7 @@ DEFAULT_CONFIG: Dict = {
             "SingleDeviceBackend._mixed_flat_launch",
             "SingleDeviceBackend._cached_counts", "SingleDeviceBackend.seed_counts",
             "SingleDeviceBackend.reset_counts", "SingleDeviceBackend.apply_cow",
+            "SingleDeviceBackend.kv_spill", "SingleDeviceBackend.kv_promote",
         ],
         "paddlenlp_tpu/experimental/sharded_backend.py": [
             "ShardedBackend.params",
@@ -98,7 +105,8 @@ DEFAULT_CONFIG: Dict = {
             "DisaggBackend.verify", "DisaggBackend.mixed_step",
             "DisaggBackend.seed_counts", "DisaggBackend.reset_counts",
             "DisaggBackend.apply_cow", "DisaggBackend.kv_migrate",
-            "DisaggBackend.migration_ready",
+            "DisaggBackend.kv_spill", "DisaggBackend.kv_promote",
+            "DisaggBackend.kv_writeback",
         ],
         "paddlenlp_tpu/serving/engine_loop.py": [
             "EngineLoop._run_iteration", "EngineLoop._drain_cmds",

@@ -25,7 +25,15 @@ the seed of the BENCH trajectory gate:
   ``rollout.streams_lost`` must be exactly 0 — zero-downtime is an invariant,
   not a tolerance — and ``rollout.ttft_p99_during_swap_ms`` rides the same
   latency band, anchored on the baseline's own swap tail when present and on
-  its overall ``p99_ttft_ms`` otherwise.
+  its overall ``p99_ttft_ms`` otherwise;
+- when the candidate carries a ``multi_turn`` record (``--multi-turn K``),
+  three invariants gate the conversation-lifetime hierarchy regardless of
+  baseline: every turn >= 2 must show a cache-hit rate > 0 (a returning
+  conversation that re-prefills its whole history is a cache regression, not
+  noise), the last turn's TTFT must beat turn 1's (the whole point of
+  keeping the history warm), and ``host_spills`` must be > 0 (the bench
+  forces HBM pressure; zero spills means the pressure schedule broke and the
+  hit rate proves nothing about the host tier).
 
 Usage::
 
@@ -181,6 +189,46 @@ def compare(candidate: Dict, baseline: Dict,
         check("rollout.ttft_p99_during_swap_ms",
               (base_swap or 0.0) * max_latency_ratio + latency_slack_ms, "max",
               _get(candidate, "rollout.ttft_p99_during_swap_ms"), base_swap)
+    # multi-turn arm (--multi-turn K): conversation-lifetime invariants, all
+    # baseline-independent — the candidate record alone either demonstrates
+    # the hierarchical cache worked or it doesn't
+    if isinstance(candidate.get("multi_turn"), dict):
+        mt = candidate["multi_turn"]
+        rates = mt.get("per_turn_cache_hit_rate")
+        if not isinstance(rates, list) or len(rates) < 2:
+            skipped.append("multi_turn.per_turn_cache_hit_rate")
+        else:
+            compared += 1
+            cold = [i + 1 for i, r in enumerate(rates[1:], start=1) if not r > 0]
+            if cold:
+                regressions.append({
+                    "field": "multi_turn.per_turn_cache_hit_rate",
+                    "baseline": None, "candidate": rates, "limit": 0.0,
+                    "direction": "below",
+                    "detail": f"turns {cold} re-prefilled with zero cache hits"})
+        turn1 = _get(candidate, "multi_turn.ttft_turn1_ms")
+        turnk = _get(candidate, "multi_turn.ttft_turnk_ms")
+        if turn1 is None or turnk is None:
+            skipped.append("multi_turn.ttft_turnk_ms")
+        else:
+            compared += 1
+            if turnk >= turn1:
+                regressions.append({
+                    "field": "multi_turn.ttft_turnk_ms", "baseline": turn1,
+                    "candidate": turnk, "limit": round(turn1, 6),
+                    "direction": "above",
+                    "detail": "warm turn-k TTFT did not beat cold turn-1 TTFT"})
+        spills = _get(candidate, "multi_turn.host_spills")
+        if spills is None:
+            skipped.append("multi_turn.host_spills")
+        else:
+            compared += 1
+            if spills <= 0:
+                regressions.append({
+                    "field": "multi_turn.host_spills", "baseline": None,
+                    "candidate": spills, "limit": 0.0, "direction": "below",
+                    "detail": "no HBM pressure reached the host tier — "
+                              "hit rates prove nothing about spill/promote"})
     return regressions, skipped, compared
 
 

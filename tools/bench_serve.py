@@ -136,6 +136,29 @@ Usage::
                                                  # trajectory) + autoscale
                                                  # (scale events, final
                                                  # replica count)
+    python tools/bench_serve.py --multi-turn 4   # conversation-lifetime arm:
+                                                 # 16 conversations of 4 chat
+                                                 # turns each through
+                                                 # /v1/chat/completions, turn 1
+                                                 # opening with a long (64-tok)
+                                                 # user message. The engine runs
+                                                 # with a deliberately small
+                                                 # device KV pool + a host spill
+                                                 # tier (host_kv_blocks), so
+                                                 # between a conversation's
+                                                 # turns the OTHER conversations
+                                                 # churn its cached blocks out
+                                                 # to host RAM — turn k's
+                                                 # history promotes back H2D
+                                                 # ahead of prefill. JSON adds a
+                                                 # multi_turn record (per-turn
+                                                 # cache-hit rate, TTFT turn 1
+                                                 # vs turn k, spill/promote
+                                                 # counts + promote bandwidth)
+                                                 # that tools/bench_compare.py
+                                                 # gates: hit rate > 0 on turns
+                                                 # >= 2 and turn-k TTFT below
+                                                 # turn-1 TTFT
     python tools/bench_serve.py --disagg 2,2 --long-prompt-mix --prefill-chunk 64
                                                  # disaggregated prefill/decode
                                                  # engine: prompt work on a
@@ -314,6 +337,15 @@ def run() -> None:
         surge_schedule = [(off, phase, "best_effort" if i % 4 == 3 else "interactive")
                           for i, (off, phase) in enumerate(surge_schedule)]
         n_requests = len(surge_schedule)
+    multi_turn = _arg("--multi-turn", 0)
+    if multi_turn:
+        if multi_turn < 2:
+            _fail(f"--multi-turn must be >= 2 turns, got {multi_turn}")
+        if surge or drain_mid_run or swap_mid_run or "--long-prompt-mix" in sys.argv \
+                or _parse_disagg() is not None:
+            _fail("--multi-turn composes with --replicas/--prefill-chunk/"
+                  "--mesh-shape only (not --surge/--drain-mid-run/"
+                  "--swap-mid-run/--long-prompt-mix/--disagg)")
     n_adapters = _arg("--adapters", 0)
     tenant_mix = "--tenant-mix" in sys.argv
     tenants = ("acme", "globex", "initech")
@@ -352,6 +384,28 @@ def run() -> None:
     else:
         eng_kw = dict(max_batch_size=4, block_size=4, num_blocks=256,
                       max_blocks_per_seq=32, decode_steps=4)
+    # --multi-turn K: conversations of K chat turns. The device pool is
+    # deliberately SMALL relative to the conversations' total cached KV, so
+    # finished turns' blocks spill to the host tier under LRU pressure and
+    # turn k's history must promote back — the hierarchy is what's measured.
+    n_convs = 0
+    mt_open_tokens, mt_user_tokens = 64, 4
+    if multi_turn:
+        n_convs = n_requests
+        eng_kw = dict(max_batch_size=4, block_size=4, num_blocks=160,
+                      max_blocks_per_seq=48, decode_steps=4,
+                      enable_prefix_cache=True, host_kv_blocks=2048)
+        # final-turn render: [u]+64+[sep] opener, then per prior turn an
+        # assistant ([a]+completion+[sep]) + user ([u]+4+[sep]) pair, + the
+        # trailing assistant marker — must fit per-seq KV with the completion
+        final_prompt = (2 + mt_open_tokens) \
+            + (multi_turn - 1) * (2 + max_tokens + 2 + mt_user_tokens) + 1
+        cap = eng_kw["max_blocks_per_seq"] * eng_kw["block_size"]
+        if final_prompt + max_tokens > cap:
+            _fail(f"--multi-turn {multi_turn} x --max-tokens {max_tokens}: "
+                  f"final-turn prompt (~{final_prompt}) + completion exceeds "
+                  f"the per-seq KV capacity ({cap} tokens)")
+        n_requests = n_convs * multi_turn  # throughput counts every turn
     if prefill_chunk:
         eng_kw["prefill_chunk_tokens"] = prefill_chunk
     if mesh_shape:
@@ -664,6 +718,66 @@ def run() -> None:
             if swap_mid_run:
                 ttft_timed.extend((t_req + v, v) for v in local["ttft"])
 
+    # --multi-turn: per-conversation history (token-id assistant content, the
+    # exact sampled ids — re-encoding text could diverge from the cache) and
+    # per-turn readouts. conv_hist is only touched by that conversation's
+    # worker thread within a turn wave, and waves are join()-separated.
+    conv_hist: list = [[] for _ in range(n_convs)]
+    turn_rows: list = [[] for _ in range(multi_turn)]  # (ttft, cached, prompt)
+
+    def chat_turn(conv: int, turn: int):
+        t0_turn = time.time()
+        if turn == 0:
+            # a long opener (system-prompt stand-in): the span turns 2..K
+            # re-use from cache instead of re-prefilling
+            content = [(11 * conv + 5 + j) % 88 + 5 for j in range(mt_open_tokens)]
+        else:
+            content = [(11 * conv + 7 * turn + j) % 88 + 5
+                       for j in range(mt_user_tokens)]
+        messages = conv_hist[conv] + [{"role": "user", "content": content}]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=RUN_TIMEOUT_S)
+        conn.request("POST", "/v1/chat/completions",
+                     body=json.dumps({"messages": messages,
+                                      "max_tokens": max_tokens, "stream": True,
+                                      "conversation": f"bench-conv-{conv}"}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f"conv {conv} turn {turn}: HTTP {resp.status}")
+        ttft, toks, usage = None, [], {}
+        while True:
+            line = resp.readline()
+            if not line or line.strip() == b"data: [DONE]":
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            ev = json.loads(line[len(b"data: "):])
+            delta = (ev.get("choices") or [{}])[0].get("delta") or {}
+            if "token" in delta:
+                if ttft is None:
+                    ttft = time.time() - t0_turn
+                toks.append(delta["token"])
+            if ev.get("usage"):
+                usage = ev["usage"]
+        conn.close()
+        conv_hist[conv] = messages + [{"role": "assistant", "content": toks}]
+        with lock:
+            stats["ttft"].append(ttft if ttft is not None else float("nan"))
+            stats["tokens"] += len(toks)
+            turn_rows[turn].append((ttft if ttft is not None else 0.0,
+                                    int(usage.get("cached_tokens", 0)),
+                                    int(usage.get("prompt_tokens", 0))))
+
+    def conv_worker(conv: int, turn: int):
+        try:
+            chat_turn(conv, turn)
+        except Exception as e:
+            with lock:
+                errors.append(f"conv {conv} turn {turn}: {e!r}")
+        finally:
+            sem.release()
+
     def surge_request(i: int, phase: str, priority: str):
         """One open-loop surge request: sheds (503 overloaded_shed) and
         backpressure rejections are COUNTED, not errors — graceful
@@ -779,6 +893,20 @@ def run() -> None:
         if sampler is not None:
             stop_sampler.set()
             sampler.join(timeout=5)
+    elif multi_turn:
+        # turn waves: every conversation's turn t runs (concurrency-bounded)
+        # before any turn t+1 starts, so between a conversation's consecutive
+        # turns the other conversations' prefills churn the device cache —
+        # the forced-pressure schedule that makes the host tier earn the hit
+        for turn in range(multi_turn):
+            wave = []
+            for c in range(n_convs):
+                sem.acquire()
+                th = threading.Thread(target=conv_worker, args=(c, turn))
+                th.start()
+                wave.append(th)
+            for th in wave:
+                th.join()
     else:
         for i in range(n_requests):
             sem.acquire()
@@ -906,8 +1034,8 @@ def run() -> None:
 
     attr_name = "paddlenlp_serving_latency_attribution_seconds"
     attribution = {}
-    for phase in ("queue", "admission_gate", "prefill", "chunk_stall",
-                  "migration_wait", "decode"):
+    for phase in ("queue", "admission_gate", "promote_wait", "prefill",
+                  "chunk_stall", "migration_wait", "decode"):
         p50 = max([histogram_quantile(f[attr_name], 0.5, phase=phase)
                    for f in replica_fams if attr_name in f] or [0.0])
         p99 = max([histogram_quantile(f[attr_name], 0.99, phase=phase)
@@ -1041,6 +1169,39 @@ def run() -> None:
             "prefill_chunks": int(scalar_sum("paddlenlp_serving_prefill_chunks_total")),
             "decode_stall_p99_ms": round(
                 quantile_max("paddlenlp_serving_decode_stall_seconds", 0.99) * 1e3, 1),
+        }
+    if multi_turn:
+        # per-turn view of the conversation-lifetime hierarchy: turn 1 is the
+        # cold long opener, turns 2..K should hit the (device or host) cache
+        # for the whole history — hit rate > 0 with spills > 0 is the proof
+        # the HOST tier served turns the device LRU had already evicted
+        per_turn = []
+        for t, rows in enumerate(turn_rows):
+            tt = sorted(r[0] for r in rows)
+            cached = sum(r[1] for r in rows)
+            prompt = sum(r[2] for r in rows)
+            per_turn.append({
+                "turn": t + 1,
+                "ttft_p50_ms": round(
+                    (tt[len(tt) // 2] if tt else 0.0) * 1e3, 1),
+                "cache_hit_rate": round(cached / prompt, 4) if prompt else 0.0,
+                "cached_tokens": cached,
+                "prompt_tokens": prompt,
+            })
+        mt_promote_bytes = scalar_sum("paddlenlp_serving_kv_host_promote_bytes_total")
+        record["multi_turn"] = {
+            "turns": multi_turn,
+            "conversations": n_convs,
+            "ttft_turn1_ms": per_turn[0]["ttft_p50_ms"],
+            "ttft_turnk_ms": per_turn[-1]["ttft_p50_ms"],
+            "per_turn": per_turn,
+            "per_turn_cache_hit_rate": [pt["cache_hit_rate"] for pt in per_turn],
+            "host_spills": int(scalar_sum("paddlenlp_serving_kv_host_spills_total")),
+            "host_promotes": int(
+                scalar_sum("paddlenlp_serving_kv_host_promotes_total")),
+            "host_blocks": int(scalar_sum("paddlenlp_serving_kv_host_blocks")),
+            "promote_bytes": int(mt_promote_bytes),
+            "promote_bandwidth_mb_s": round(mt_promote_bytes / dt / 1e6, 3),
         }
     if disagg:
         # per-stage view: TTFT is prefill-stage latency, the chatty client
